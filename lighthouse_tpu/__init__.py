@@ -1,0 +1,9 @@
+"""lighthouse_tpu — a TPU-native framework with the capabilities of Lighthouse.
+
+The north star (BASELINE.md) is batched BLS12-381 signature verification as
+JAX/XLA kernels on TPU, slotted behind the reference's `crypto/bls` backend
+seam, plus the consensus framework shell around it (SSZ, types, state
+transition, fork choice, replay, bridge).
+"""
+
+__version__ = "0.1.0"

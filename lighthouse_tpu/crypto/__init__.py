@@ -1,0 +1,6 @@
+"""Crypto layer (reference analogue: /root/reference/crypto).
+
+- `constants`: BLS12-381 domain parameters
+- `ref`: pure-Python spec oracle (the `milagro`-role differential backend)
+- `tpu`: JAX/XLA batched kernels (the product: the 5th bls backend)
+"""

@@ -1,0 +1,1 @@
+from . import fields, curves, pairing, hash_to_curve, bls  # noqa: F401

@@ -1,0 +1,126 @@
+"""Ethereum BLS signatures (min-pubkey, proof-of-possession scheme) — oracle.
+
+Mirrors the semantics the reference exposes through `crypto/bls`:
+  - sign/verify/aggregate per draft-irtf-cfrg-bls-signature-05, ciphersuite
+    BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_
+  - `verify_signature_sets`: randomized batch verification with 64-bit nonzero
+    blinding scalars and per-set pubkey aggregation, exactly the blst algorithm
+    (/root/reference/crypto/bls/src/impls/blst.rs:37-120)
+  - infinity-pubkey rejection at the set layer
+    (/root/reference/crypto/bls/src/generic_public_key.rs:70-72 via
+     generic_signature_set.rs:62-122)
+
+Used as the differential oracle for the TPU backend and as the host fallback
+path of the bridge.
+"""
+
+import secrets
+
+from ..constants import R, DST_POP, RAND_BITS
+from . import fields as F
+from . import curves as C
+from . import pairing as PR
+from .hash_to_curve import hash_to_g2
+
+
+class SignatureSet:
+    """One verification statement: signature over message by >= 1 pubkeys.
+
+    Mirrors GenericSignatureSet (generic_signature_set.rs:62): the message is a
+    32-byte root, pubkeys are aggregated (G1 sum) before pairing.
+    """
+
+    __slots__ = ("signature", "pubkeys", "message")
+
+    def __init__(self, signature, pubkeys, message):
+        self.signature = signature  # G2 point or None
+        self.pubkeys = list(pubkeys)  # G1 points (None = infinity, invalid)
+        self.message = message  # bytes (32-byte signing root)
+
+
+def keygen():
+    """Test-only keygen (uniform scalar; NOT the EIP-2333 HKDF derivation)."""
+    sk = 0
+    while sk == 0:
+        sk = secrets.randbelow(R)
+    return sk
+
+
+def sk_to_pk(sk):
+    return C.g1_mul(C.G1_GEN, sk % R)
+
+
+def sign(sk, msg, dst=DST_POP):
+    return C.g2_mul(hash_to_g2(msg, dst), sk % R)
+
+
+def verify(pk, msg, sig, dst=DST_POP):
+    if pk is None or sig is None:
+        return False
+    if not C.g2_in_subgroup(sig) or not C.g1_in_subgroup(pk):
+        return False
+    h = hash_to_g2(msg, dst)
+    # e(pk, H(m)) == e(g1, sig)  <=>  e(-g1, sig) * e(pk, H(m)) == 1
+    out = PR.multi_pairing([(C.g1_neg(C.G1_GEN), sig), (pk, h)])
+    return F.f12_is_one(out)
+
+
+def aggregate(sigs):
+    out = None
+    for s in sigs:
+        out = C.g2_add(out, s)
+    return out
+
+
+def aggregate_pubkeys(pks):
+    out = None
+    for p in pks:
+        out = C.g1_add(out, p)
+    return out
+
+
+def fast_aggregate_verify(pks, msg, sig, dst=DST_POP):
+    if not pks or any(p is None for p in pks):
+        return False
+    return verify(aggregate_pubkeys(pks), msg, sig, dst)
+
+
+def aggregate_verify(pks, msgs, sig, dst=DST_POP):
+    if not pks or len(pks) != len(msgs) or any(p is None for p in pks):
+        return False
+    if sig is None or not C.g2_in_subgroup(sig):
+        return False
+    pairs = [(C.g1_neg(C.G1_GEN), sig)]
+    for pk, m in zip(pks, msgs):
+        pairs.append((pk, hash_to_g2(m, dst)))
+    return F.f12_is_one(PR.multi_pairing(pairs))
+
+
+def verify_signature_sets(sets, dst=DST_POP, rng=None):
+    """Randomized batch verification, blst semantics (impls/blst.rs:37-120).
+
+    Per set i: draw nonzero 64-bit r_i, check sig_i in G2 subgroup, aggregate
+    the set's pubkeys, then test
+        e(-g1, sum_i [r_i] sig_i) * prod_i e([r_i] agg_pk_i, H(m_i)) == 1.
+    """
+    sets = list(sets)
+    if not sets:
+        return False  # blst returns false on empty input
+    rand = rng if rng is not None else (lambda: secrets.randbits(RAND_BITS))
+    sig_acc = None
+    pairs = []
+    for s in sets:
+        if s.signature is None or not s.pubkeys:
+            return False
+        if any(pk is None for pk in s.pubkeys):
+            return False  # infinity pubkey rejection
+        if not C.g2_in_subgroup(s.signature):
+            return False
+        r = 0
+        while r == 0:
+            r = rand() & ((1 << RAND_BITS) - 1)
+        sig_acc = C.g2_add(sig_acc, C.g2_mul(s.signature, r))
+        agg_pk = aggregate_pubkeys(s.pubkeys)
+        pairs.append((C.g1_mul(agg_pk, r), hash_to_g2(s.message, dst)))
+    pairs.append((C.g1_neg(C.G1_GEN), sig_acc))
+    return F.f12_is_one(PR.multi_pairing(pairs))

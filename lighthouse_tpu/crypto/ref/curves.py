@@ -1,0 +1,285 @@
+"""Pure-Python BLS12-381 curve groups — the spec oracle.
+
+Affine arithmetic on E1/Fp and E2/Fp2, the psi (untwist-Frobenius-twist)
+endomorphism, subgroup checks, and the ZCash compressed serialization used by
+Ethereum (48-byte G1 pubkeys / 96-byte G2 signatures — the wire shapes of the
+reference's `SignatureSet`, /root/reference/crypto/bls/src/generic_signature_set.rs).
+
+Points are `None` (infinity) or `(x, y)` tuples; Fp2 coordinates are `(c0, c1)`.
+"""
+
+from ..constants import P, R, B1, B2, G1_X, G1_Y, G2_X, G2_Y, BLS_X
+from . import fields as F
+
+G1_GEN = (G1_X, G1_Y)
+G2_GEN = (G2_X, G2_Y)
+
+
+# ---------------------------------------------------------------- G1 (E/Fp)
+
+def g1_is_on_curve(pt):
+    if pt is None:
+        return True
+    x, y = pt
+    return (y * y - (x * x * x + B1)) % P == 0
+
+
+def g1_neg(pt):
+    if pt is None:
+        return None
+    return (pt[0], (-pt[1]) % P)
+
+
+def g1_add(p1, p2):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return None
+        # doubling
+        lam = (3 * x1 * x1) * F.fp_inv(2 * y1) % P
+    else:
+        lam = (y2 - y1) * F.fp_inv((x2 - x1) % P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    y3 = (lam * (x1 - x3) - y1) % P
+    return (x3, y3)
+
+
+def g1_double(pt):
+    return g1_add(pt, pt)
+
+
+def g1_mul(pt, k):
+    if k < 0:
+        return g1_mul(g1_neg(pt), -k)
+    out = None
+    add = pt
+    while k > 0:
+        if k & 1:
+            out = g1_add(out, add)
+        add = g1_add(add, add)
+        k >>= 1
+    return out
+
+
+def g1_in_subgroup(pt):
+    if pt is None:
+        return True
+    if not g1_is_on_curve(pt):
+        return False
+    return g1_mul(pt, R) is None
+
+
+# ---------------------------------------------------------------- G2 (E'/Fp2)
+
+def g2_is_on_curve(pt):
+    if pt is None:
+        return True
+    x, y = pt
+    lhs = F.f2_sqr(y)
+    rhs = F.f2_add(F.f2_mul(F.f2_sqr(x), x), B2)
+    return F.f2_eq(lhs, rhs)
+
+
+def g2_neg(pt):
+    if pt is None:
+        return None
+    return (pt[0], F.f2_neg(pt[1]))
+
+
+def g2_add(p1, p2):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if F.f2_eq(x1, x2):
+        if F.f2_is_zero(F.f2_add(y1, y2)):
+            return None
+        num = F.f2_muls(F.f2_sqr(x1), 3)
+        lam = F.f2_mul(num, F.f2_inv(F.f2_muls(y1, 2)))
+    else:
+        lam = F.f2_mul(F.f2_sub(y2, y1), F.f2_inv(F.f2_sub(x2, x1)))
+    x3 = F.f2_sub(F.f2_sub(F.f2_sqr(lam), x1), x2)
+    y3 = F.f2_sub(F.f2_mul(lam, F.f2_sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def g2_double(pt):
+    return g2_add(pt, pt)
+
+
+def g2_mul(pt, k):
+    if k < 0:
+        return g2_mul(g2_neg(pt), -k)
+    out = None
+    add = pt
+    while k > 0:
+        if k & 1:
+            out = g2_add(out, add)
+        add = g2_add(add, add)
+        k >>= 1
+    return out
+
+
+# psi: the untwist-Frobenius-twist endomorphism on E'.
+#   psi(x, y) = (c_x * conj(x), c_y * conj(y))
+# with c_x = 1/xi^((p-1)/3), c_y = 1/xi^((p-1)/2) — computed, not memorized.
+# On G2, psi acts as multiplication by x (the BLS parameter); tests verify
+# psi(G2_GEN) == [-BLS_X] G2_GEN.
+_PSI_CX = None
+_PSI_CY = None
+
+
+def _psi_consts():
+    global _PSI_CX, _PSI_CY
+    if _PSI_CX is None:
+        _PSI_CX = F.f2_inv(F.f2_pow(F.XI, (P - 1) // 3))
+        _PSI_CY = F.f2_inv(F.f2_pow(F.XI, (P - 1) // 2))
+    return _PSI_CX, _PSI_CY
+
+
+def g2_psi(pt):
+    if pt is None:
+        return None
+    cx, cy = _psi_consts()
+    x, y = pt
+    return (F.f2_mul(cx, F.f2_conj(x)), F.f2_mul(cy, F.f2_conj(y)))
+
+
+def g2_in_subgroup(pt):
+    """Fast subgroup check: psi(P) == [x]P  (Bowe, "Faster subgroup checks")."""
+    if pt is None:
+        return True
+    if not g2_is_on_curve(pt):
+        return False
+    lhs = g2_psi(pt)
+    rhs = g2_neg(g2_mul(pt, BLS_X))  # x is negative
+    if lhs is None or rhs is None:
+        return lhs is None and rhs is None
+    return F.f2_eq(lhs[0], rhs[0]) and F.f2_eq(lhs[1], rhs[1])
+
+
+def g2_clear_cofactor(pt):
+    """RFC 9380 G.3 (Budroni-Pintore): computes [h_eff]P using psi.
+
+    h_eff P = [x^2 - x - 1]P + [x - 1]psi(P) + psi(psi(2P))
+    (with x the negative BLS parameter).
+    """
+    x = -BLS_X
+    t1 = g2_mul(pt, x)                      # [x]P
+    t2 = g2_psi(pt)                         # psi(P)
+    out = g2_add(g2_mul(t1, x), g2_neg(t1))           # [x^2 - x]P
+    out = g2_add(out, g2_neg(pt))                     # [x^2 - x - 1]P
+    out = g2_add(out, g2_mul(t2, x))                  # + [x]psi(P)
+    out = g2_add(out, g2_neg(t2))                     # - psi(P)
+    out = g2_add(out, g2_psi(g2_psi(g2_double(pt))))  # + psi^2(2P)
+    return out
+
+
+# ---------------------------------------------------------------- serialization
+# ZCash BLS12-381 encoding: 48-byte compressed G1, 96-byte compressed G2.
+# Top three bits of byte 0: [compressed, infinity, y-sign].
+
+def _fp_to_bytes(a):
+    return int(a % P).to_bytes(48, "big")
+
+
+def _fp_from_bytes(b):
+    v = int.from_bytes(b, "big")
+    if v >= P:
+        raise ValueError("field element >= modulus")
+    return v
+
+
+def g1_compress(pt):
+    if pt is None:
+        out = bytearray(48)
+        out[0] = 0xC0
+        return bytes(out)
+    x, y = pt
+    out = bytearray(_fp_to_bytes(x))
+    out[0] |= 0x80
+    if y > (P - 1) // 2:
+        out[0] |= 0x20
+    return bytes(out)
+
+
+def g1_decompress(data, subgroup_check=True):
+    if len(data) != 48:
+        raise ValueError("G1 compressed encoding must be 48 bytes")
+    flags = data[0]
+    if not flags & 0x80:
+        raise ValueError("uncompressed flag in compressed context")
+    is_inf = bool(flags & 0x40)
+    y_big = bool(flags & 0x20)
+    body = bytes([data[0] & 0x1F]) + data[1:]
+    if is_inf:
+        if any(body) or y_big:
+            raise ValueError("malformed infinity encoding")
+        return None
+    x = _fp_from_bytes(body)
+    y2 = (x * x * x + B1) % P
+    y = F.fp_sqrt(y2)
+    if y is None:
+        raise ValueError("x not on curve")
+    if (y > (P - 1) // 2) != y_big:
+        y = (-y) % P
+    pt = (x, y)
+    if subgroup_check and not g1_in_subgroup(pt):
+        raise ValueError("point not in G1 subgroup")
+    return pt
+
+
+def _f2_lex_gt_half(y):
+    """ZCash sign convention for Fp2: compare (c1, c0) lexicographically."""
+    c0, c1 = y
+    if c1 != 0:
+        return c1 > (P - 1) // 2
+    return c0 > (P - 1) // 2
+
+
+def g2_compress(pt):
+    if pt is None:
+        out = bytearray(96)
+        out[0] = 0xC0
+        return bytes(out)
+    x, y = pt
+    out = bytearray(_fp_to_bytes(x[1]) + _fp_to_bytes(x[0]))
+    out[0] |= 0x80
+    if _f2_lex_gt_half(y):
+        out[0] |= 0x20
+    return bytes(out)
+
+
+def g2_decompress(data, subgroup_check=True):
+    if len(data) != 96:
+        raise ValueError("G2 compressed encoding must be 96 bytes")
+    flags = data[0]
+    if not flags & 0x80:
+        raise ValueError("uncompressed flag in compressed context")
+    is_inf = bool(flags & 0x40)
+    y_big = bool(flags & 0x20)
+    body = bytes([data[0] & 0x1F]) + data[1:]
+    if is_inf:
+        if any(body) or y_big:
+            raise ValueError("malformed infinity encoding")
+        return None
+    c1 = _fp_from_bytes(body[:48])
+    c0 = _fp_from_bytes(body[48:])
+    x = (c0, c1)
+    y2 = F.f2_add(F.f2_mul(F.f2_sqr(x), x), B2)
+    y = F.f2_sqrt(y2)
+    if y is None:
+        raise ValueError("x not on curve")
+    if _f2_lex_gt_half(y) != y_big:
+        y = F.f2_neg(y)
+    pt = (x, y)
+    if subgroup_check and not g2_in_subgroup(pt):
+        raise ValueError("point not in G2 subgroup")
+    return pt

@@ -1,0 +1,326 @@
+"""Pure-Python BLS12-381 field towers — the spec oracle.
+
+Plain-int implementation of Fp, Fp2 = Fp[u]/(u^2+1), Fp6 = Fp2[v]/(v^3 - xi)
+with xi = 1+u, and Fp12 = Fp6[w]/(w^2 - v).  This is the trusted reference the
+JAX/TPU kernels are differentially tested against; it favors obviousness over
+speed (the reference client's analogue is the pure-Rust `milagro` backend used
+as a differential oracle for `blst` — /root/reference/crypto/bls/src/impls/milagro.rs).
+
+Representation conventions:
+  Fp   : int in [0, P)
+  Fp2  : tuple (c0, c1)            = c0 + c1*u
+  Fp6  : tuple (a0, a1, a2) of Fp2 = a0 + a1*v + a2*v^2
+  Fp12 : tuple (b0, b1) of Fp6     = b0 + b1*w
+"""
+
+from ..constants import P
+
+# ---------------------------------------------------------------- Fp
+
+def fp_add(a, b):
+    return (a + b) % P
+
+
+def fp_sub(a, b):
+    return (a - b) % P
+
+
+def fp_mul(a, b):
+    return (a * b) % P
+
+
+def fp_neg(a):
+    return (-a) % P
+
+
+def fp_inv(a):
+    if a % P == 0:
+        raise ZeroDivisionError("inverse of 0 in Fp")
+    return pow(a, P - 2, P)
+
+
+def fp_sqrt(a):
+    """Square root in Fp (P = 3 mod 4). Returns None if a is not a QR."""
+    a = a % P
+    c = pow(a, (P + 1) // 4, P)
+    return c if (c * c) % P == a else None
+
+
+def fp_sgn0(a):
+    return a % 2
+
+
+# ---------------------------------------------------------------- Fp2
+
+F2_ZERO = (0, 0)
+F2_ONE = (1, 0)
+
+
+def f2(c0, c1=0):
+    return (c0 % P, c1 % P)
+
+
+def f2_add(a, b):
+    return ((a[0] + b[0]) % P, (a[1] + b[1]) % P)
+
+
+def f2_sub(a, b):
+    return ((a[0] - b[0]) % P, (a[1] - b[1]) % P)
+
+
+def f2_neg(a):
+    return ((-a[0]) % P, (-a[1]) % P)
+
+
+def f2_mul(a, b):
+    # (a0 + a1 u)(b0 + b1 u) = (a0b0 - a1b1) + (a0b1 + a1b0) u
+    return ((a[0] * b[0] - a[1] * b[1]) % P, (a[0] * b[1] + a[1] * b[0]) % P)
+
+
+def f2_muls(a, s):
+    """Multiply by an Fp scalar."""
+    return ((a[0] * s) % P, (a[1] * s) % P)
+
+
+def f2_sqr(a):
+    return f2_mul(a, a)
+
+
+def f2_conj(a):
+    return (a[0], (-a[1]) % P)
+
+
+def f2_inv(a):
+    # 1/(a0 + a1 u) = conj(a) / (a0^2 + a1^2)
+    n = (a[0] * a[0] + a[1] * a[1]) % P
+    ni = fp_inv(n)
+    return ((a[0] * ni) % P, (-a[1] * ni) % P)
+
+
+def f2_pow(a, e):
+    out = F2_ONE
+    base = a
+    while e > 0:
+        if e & 1:
+            out = f2_mul(out, base)
+        base = f2_sqr(base)
+        e >>= 1
+    return out
+
+
+def f2_is_zero(a):
+    return a[0] % P == 0 and a[1] % P == 0
+
+
+def f2_eq(a, b):
+    return a[0] % P == b[0] % P and a[1] % P == b[1] % P
+
+
+def f2_sqrt(a):
+    """Square root in Fp2 via the norm trick. Returns None for non-residues."""
+    if f2_is_zero(a):
+        return F2_ZERO
+    a0, a1 = a[0] % P, a[1] % P
+    if a1 == 0:
+        s = fp_sqrt(a0)
+        if s is not None:
+            return (s, 0)
+        # a0 is a non-residue in Fp: sqrt is purely imaginary, (t*u)^2 = -t^2
+        t = fp_sqrt((-a0) % P)
+        if t is None:
+            return None
+        return (0, t)
+    # Norm n = a0^2 + a1^2 must be a QR in Fp.
+    n = (a0 * a0 + a1 * a1) % P
+    s = fp_sqrt(n)
+    if s is None:
+        return None
+    # x0^2 = (a0 + s)/2 or (a0 - s)/2
+    inv2 = fp_inv(2)
+    for sign in (s, (-s) % P):
+        h = ((a0 + sign) * inv2) % P
+        x0 = fp_sqrt(h)
+        if x0 is None:
+            continue
+        if x0 == 0:
+            continue
+        x1 = (a1 * fp_inv((2 * x0) % P)) % P
+        cand = (x0, x1)
+        if f2_eq(f2_sqr(cand), a):
+            return cand
+    return None
+
+
+def f2_sgn0(a):
+    """RFC 9380 sgn0 for m=2."""
+    s0 = a[0] % 2
+    z0 = 1 if a[0] % P == 0 else 0
+    s1 = a[1] % 2
+    return s0 | (z0 & s1)
+
+
+# xi = 1 + u, the Fp6/Fp12 tower non-residue.
+XI = (1, 1)
+
+
+def f2_mul_xi(a):
+    # (c0 + c1 u)(1 + u) = (c0 - c1) + (c0 + c1) u
+    return ((a[0] - a[1]) % P, (a[0] + a[1]) % P)
+
+
+# ---------------------------------------------------------------- Fp6
+
+F6_ZERO = (F2_ZERO, F2_ZERO, F2_ZERO)
+F6_ONE = (F2_ONE, F2_ZERO, F2_ZERO)
+
+
+def f6_add(a, b):
+    return (f2_add(a[0], b[0]), f2_add(a[1], b[1]), f2_add(a[2], b[2]))
+
+
+def f6_sub(a, b):
+    return (f2_sub(a[0], b[0]), f2_sub(a[1], b[1]), f2_sub(a[2], b[2]))
+
+
+def f6_neg(a):
+    return (f2_neg(a[0]), f2_neg(a[1]), f2_neg(a[2]))
+
+
+def f6_mul(a, b):
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    t0 = f2_mul(a0, b0)
+    t1 = f2_mul(a1, b1)
+    t2 = f2_mul(a2, b2)
+    # c0 = t0 + xi*((a1+a2)(b1+b2) - t1 - t2)
+    c0 = f2_add(t0, f2_mul_xi(f2_sub(f2_sub(f2_mul(f2_add(a1, a2), f2_add(b1, b2)), t1), t2)))
+    # c1 = (a0+a1)(b0+b1) - t0 - t1 + xi*t2
+    c1 = f2_add(f2_sub(f2_sub(f2_mul(f2_add(a0, a1), f2_add(b0, b1)), t0), t1), f2_mul_xi(t2))
+    # c2 = (a0+a2)(b0+b2) - t0 - t2 + t1
+    c2 = f2_add(f2_sub(f2_sub(f2_mul(f2_add(a0, a2), f2_add(b0, b2)), t0), t2), t1)
+    return (c0, c1, c2)
+
+
+def f6_sqr(a):
+    return f6_mul(a, a)
+
+
+def f6_mul_v(a):
+    """Multiply by v: (a0 + a1 v + a2 v^2) * v = xi*a2 + a0 v + a1 v^2."""
+    return (f2_mul_xi(a[2]), a[0], a[1])
+
+
+def f6_inv(a):
+    a0, a1, a2 = a
+    c0 = f2_sub(f2_sqr(a0), f2_mul_xi(f2_mul(a1, a2)))
+    c1 = f2_sub(f2_mul_xi(f2_sqr(a2)), f2_mul(a0, a1))
+    c2 = f2_sub(f2_sqr(a1), f2_mul(a0, a2))
+    t = f2_add(f2_mul(a2, f2_mul_xi(c1)), f2_add(f2_mul(a0, c0), f2_mul_xi(f2_mul(a1, c2))))
+    ti = f2_inv(t)
+    return (f2_mul(c0, ti), f2_mul(c1, ti), f2_mul(c2, ti))
+
+
+def f6_is_zero(a):
+    return all(f2_is_zero(c) for c in a)
+
+
+# ---------------------------------------------------------------- Fp12
+
+F12_ZERO = (F6_ZERO, F6_ZERO)
+F12_ONE = (F6_ONE, F6_ZERO)
+
+
+def f12_add(a, b):
+    return (f6_add(a[0], b[0]), f6_add(a[1], b[1]))
+
+
+def f12_sub(a, b):
+    return (f6_sub(a[0], b[0]), f6_sub(a[1], b[1]))
+
+
+def f12_mul(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    t0 = f6_mul(a0, b0)
+    t1 = f6_mul(a1, b1)
+    c0 = f6_add(t0, f6_mul_v(t1))  # w^2 = v
+    c1 = f6_sub(f6_sub(f6_mul(f6_add(a0, a1), f6_add(b0, b1)), t0), t1)
+    return (c0, c1)
+
+
+def f12_sqr(a):
+    return f12_mul(a, a)
+
+
+def f12_conj(a):
+    """Conjugation = exponentiation by p^6 (w -> -w)."""
+    return (a[0], f6_neg(a[1]))
+
+
+def f12_inv(a):
+    a0, a1 = a
+    t = f6_sub(f6_sqr(a0), f6_mul_v(f6_sqr(a1)))
+    ti = f6_inv(t)
+    return (f6_mul(a0, ti), f6_neg(f6_mul(a1, ti)))
+
+
+def f12_pow(a, e):
+    if e < 0:
+        return f12_pow(f12_inv(a), -e)
+    out = F12_ONE
+    base = a
+    while e > 0:
+        if e & 1:
+            out = f12_mul(out, base)
+        base = f12_sqr(base)
+        e >>= 1
+    return out
+
+
+def f12_eq(a, b):
+    return f12_is_zero(f12_sub(a, b))
+
+
+def f12_is_zero(a):
+    return f6_is_zero(a[0]) and f6_is_zero(a[1])
+
+
+def f12_is_one(a):
+    return f12_eq(a, F12_ONE)
+
+
+# Frobenius: pi(x) = x^p on Fp12, computed coefficient-wise.  Writing an Fp12
+# element as sum_{k=0..5} c_k w^k (c_k in Fp2, w^6 = xi), pi maps
+# c_k w^k -> conj(c_k) * g^k * w^k with g = xi^((p-1)/6) in Fp2.
+_FROB_GAMMA = None
+
+
+def _frob_gammas():
+    global _FROB_GAMMA
+    if _FROB_GAMMA is None:
+        g = f2_pow(XI, (P - 1) // 6)
+        gs = [F2_ONE]
+        for _ in range(5):
+            gs.append(f2_mul(gs[-1], g))
+        _FROB_GAMMA = gs
+    return _FROB_GAMMA
+
+
+def f12_to_coeffs(a):
+    """Fp12 tower -> coefficients of w^0..w^5 over Fp2 (w^2 = v, w^6 = xi)."""
+    (b00, b01, b02), (b10, b11, b12) = a
+    # b0 = b00 + b01 v + b02 v^2 = b00 + b01 w^2 + b02 w^4
+    # b1*w = b10 w + b11 w^3 + b12 w^5
+    return [b00, b10, b01, b11, b02, b12]
+
+
+def f12_from_coeffs(cs):
+    return ((cs[0], cs[2], cs[4]), (cs[1], cs[3], cs[5]))
+
+
+def f12_frobenius(a, power=1):
+    cs = f12_to_coeffs(a)
+    gs = _frob_gammas()
+    for _ in range(power % 12):
+        cs = [f2_mul(f2_conj(c), gs[k]) for k, c in enumerate(cs)]
+    return f12_from_coeffs(cs)

@@ -1,0 +1,122 @@
+"""RFC 9380 BLS12381G2_XMD:SHA-256_SSWU_RO_ — pure-Python spec oracle.
+
+Pipeline: expand_message_xmd(SHA-256) -> hash_to_field(Fp2, count=2)
+-> simplified SWU onto the 3-isogenous curve E2' -> 3-isogeny to E2
+-> psi-based cofactor clearing (RFC 9380 G.3, exact [h_eff] multiple).
+
+The reference client reaches this through blst's hash-to-curve with
+DST = BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_
+(/root/reference/crypto/bls/src/impls/blst.rs:15).
+"""
+
+import hashlib
+
+from ..constants import (
+    P,
+    H2C_A,
+    H2C_B,
+    H2C_Z,
+    ISO3_XNUM,
+    ISO3_XDEN,
+    ISO3_YNUM,
+    ISO3_YDEN,
+    DST_POP,
+)
+from . import fields as F
+from . import curves as C
+
+_B_IN_BYTES = 32   # SHA-256 output size
+_S_IN_BYTES = 64   # SHA-256 block size
+_L = 64            # bytes per field coordinate, ceil((381 + 128)/8)
+
+
+def expand_message_xmd(msg, dst, len_in_bytes):
+    if len(dst) > 255:
+        dst = hashlib.sha256(b"H2C-OVERSIZE-DST-" + dst).digest()
+    ell = -(-len_in_bytes // _B_IN_BYTES)
+    if ell > 255:
+        raise ValueError("len_in_bytes too large")
+    dst_prime = dst + bytes([len(dst)])
+    z_pad = bytes(_S_IN_BYTES)
+    l_i_b = len_in_bytes.to_bytes(2, "big")
+    b0 = hashlib.sha256(z_pad + msg + l_i_b + b"\x00" + dst_prime).digest()
+    b = [hashlib.sha256(b0 + b"\x01" + dst_prime).digest()]
+    for i in range(2, ell + 1):
+        prev = b[-1]
+        xored = bytes(x ^ y for x, y in zip(b0, prev))
+        b.append(hashlib.sha256(xored + bytes([i]) + dst_prime).digest())
+    return b"".join(b)[:len_in_bytes]
+
+
+def hash_to_field_fp2(msg, count, dst=DST_POP):
+    length = count * 2 * _L
+    uniform = expand_message_xmd(msg, dst, length)
+    out = []
+    for i in range(count):
+        cs = []
+        for j in range(2):
+            off = _L * (j + i * 2)
+            cs.append(int.from_bytes(uniform[off:off + _L], "big") % P)
+        out.append(tuple(cs))
+    return out
+
+
+def sswu(u):
+    """Simplified SWU map onto E2': y^2 = x^3 + A'x + B' (RFC 9380 6.6.2)."""
+    A, B, Z = H2C_A, H2C_B, H2C_Z
+    u2 = F.f2_sqr(u)
+    zu2 = F.f2_mul(Z, u2)
+    tv1 = F.f2_add(F.f2_sqr(zu2), zu2)          # Z^2 u^4 + Z u^2
+    neg_b_over_a = F.f2_mul(F.f2_neg(B), F.f2_inv(A))
+    if F.f2_is_zero(tv1):
+        x1 = F.f2_mul(B, F.f2_inv(F.f2_mul(Z, A)))
+    else:
+        x1 = F.f2_mul(neg_b_over_a, F.f2_add(F.F2_ONE, F.f2_inv(tv1)))
+    gx1 = F.f2_add(F.f2_add(F.f2_mul(F.f2_sqr(x1), x1), F.f2_mul(A, x1)), B)
+    y1 = F.f2_sqrt(gx1)
+    if y1 is not None:
+        x, y = x1, y1
+    else:
+        x2 = F.f2_mul(zu2, x1)
+        gx2 = F.f2_add(F.f2_add(F.f2_mul(F.f2_sqr(x2), x2), F.f2_mul(A, x2)), B)
+        y2 = F.f2_sqrt(gx2)
+        if y2 is None:
+            raise AssertionError("SSWU: neither gx1 nor gx2 is square (impossible)")
+        x, y = x2, y2
+    if F.f2_sgn0(u) != F.f2_sgn0(y):
+        y = F.f2_neg(y)
+    return (x, y)
+
+
+def _horner(coeffs, x):
+    """Evaluate sum coeffs[i] * x^i (coeffs low-to-high, Fp2)."""
+    acc = F.F2_ZERO
+    for c in reversed(coeffs):
+        acc = F.f2_add(F.f2_mul(acc, x), c)
+    return acc
+
+
+def iso_map(pt):
+    """The 3-isogeny E2' -> E2 (RFC 9380 E.3)."""
+    if pt is None:
+        return None
+    x, y = pt
+    xnum = _horner(ISO3_XNUM, x)
+    xden = _horner(ISO3_XDEN, x)
+    ynum = _horner(ISO3_YNUM, x)
+    yden = _horner(ISO3_YDEN, x)
+    X = F.f2_mul(xnum, F.f2_inv(xden))
+    Y = F.f2_mul(y, F.f2_mul(ynum, F.f2_inv(yden)))
+    return (X, Y)
+
+
+def map_to_curve_g2(u):
+    return iso_map(sswu(u))
+
+
+def hash_to_g2(msg, dst=DST_POP):
+    u0, u1 = hash_to_field_fp2(msg, 2, dst)
+    q0 = map_to_curve_g2(u0)
+    q1 = map_to_curve_g2(u1)
+    r = C.g2_add(q0, q1)
+    return C.g2_clear_cofactor(r)

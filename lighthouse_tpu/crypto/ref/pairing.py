@@ -1,0 +1,134 @@
+"""Pure-Python optimal ate pairing on BLS12-381 — the spec oracle.
+
+Deliberately the *generic* formulation: G2 points are untwisted into E(Fp12)
+and the Miller loop runs with full Fp12 line arithmetic, so correctness follows
+directly from the textbook definitions with no sparse-multiplication or
+twist-type subtleties.  The JAX/TPU pairing (lighthouse_tpu.crypto.tpu.pairing)
+implements the fast twisted form and is differentially tested against this.
+
+Final exponentiation here is a direct big-integer exponentiation by
+(p^4 - p^2 + 1) // r after the easy part — slow but unambiguous.
+"""
+
+from ..constants import P, R, BLS_X
+from . import fields as F
+
+# w^-2 and w^-3 in Fp12 for the untwist map (x, y) -> (x * w^-2, y * w^-3).
+# As tower elements: w^-2 = w^4/xi = (1/xi) * v^2 (coefficient at w^4),
+# w^-3 = w^3/xi = (1/xi) * v * w (coefficient at w^3).
+
+
+def _untwist(q):
+    """Map a point of E'(Fp2) to E(Fp12)."""
+    if q is None:
+        return None
+    x, y = q
+    xi_inv = F.f2_inv(F.XI)
+    # x * w^-2: coefficient x * (1/xi) at w^4  -> tower slot (0, _, x/xi), (0,0,0)
+    xc = F.f2_mul(x, xi_inv)
+    X = ((F.F2_ZERO, F.F2_ZERO, xc), F.F6_ZERO)
+    # y * w^-3: coefficient y * (1/xi) at w^3 -> tower slot b1, v-coeff 1
+    yc = F.f2_mul(y, xi_inv)
+    Y = (F.F6_ZERO, (F.F2_ZERO, yc, F.F2_ZERO))
+    return (X, Y)
+
+
+def _line(a, b, pt):
+    """Evaluate the line through a and b (on E(Fp12)) at affine point pt.
+
+    a, b are (X, Y) with Fp12 coordinates; pt is (x, y) with Fp coordinates
+    embedded into Fp12.  Returns an Fp12 value.
+    """
+    ax, ay = a
+    bx, by = b
+    px, py = pt
+    pxe = ((F.f2(px), F.F2_ZERO, F.F2_ZERO), F.F6_ZERO)
+    pye = ((F.f2(py), F.F2_ZERO, F.F2_ZERO), F.F6_ZERO)
+    if not F.f12_eq(ax, bx):
+        # chord
+        lam_num = F.f12_sub(by, ay)
+        lam_den = F.f12_sub(bx, ax)
+        # l = (y_p - a_y) * den - (x_p - a_x) * num  (scaled line; scaling is
+        # killed by the final exponentiation)
+        return F.f12_sub(
+            F.f12_mul(F.f12_sub(pye, ay), lam_den),
+            F.f12_mul(F.f12_sub(pxe, ax), lam_num),
+        )
+    elif F.f12_eq(ay, by):
+        # tangent: lam = 3 x^2 / 2 y
+        three = F.f12_mul(((F.f2(3), F.F2_ZERO, F.F2_ZERO), F.F6_ZERO), F.f12_mul(ax, ax))
+        two_y = F.f12_add(ay, ay)
+        return F.f12_sub(
+            F.f12_mul(F.f12_sub(pye, ay), two_y),
+            F.f12_mul(F.f12_sub(pxe, ax), three),
+        )
+    else:
+        # vertical
+        return F.f12_sub(pxe, ax)
+
+
+def miller_loop(p, q):
+    """f_{|x|, Q'}(P) with Q' = untwist(q), then conjugated (x < 0)."""
+    if p is None or q is None:
+        return F.F12_ONE
+    qq = _untwist(q)
+    t = qq
+    f = F.F12_ONE
+    bits = bin(BLS_X)[2:]
+    for bit in bits[1:]:
+        f = F.f12_mul(F.f12_sqr(f), _line(t, t, p))
+        t = _ec12_double(t)
+        if bit == "1":
+            f = F.f12_mul(f, _line(t, qq, p))
+            t = _ec12_add(t, qq)
+    # BLS parameter is negative: f_{-n} ~ 1/f_n (verticals vanish after final exp)
+    return F.f12_conj(f)
+
+
+def _ec12_add(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    ax, ay = a
+    bx, by = b
+    if F.f12_eq(ax, bx):
+        if F.f12_is_zero(F.f12_add(ay, by)):
+            return None
+        return _ec12_double(a)
+    lam = F.f12_mul(F.f12_sub(by, ay), F.f12_inv(F.f12_sub(bx, ax)))
+    x3 = F.f12_sub(F.f12_sub(F.f12_sqr(lam), ax), bx)
+    y3 = F.f12_sub(F.f12_mul(lam, F.f12_sub(ax, x3)), ay)
+    return (x3, y3)
+
+
+def _ec12_double(a):
+    ax, ay = a
+    three = ((F.f2(3), F.F2_ZERO, F.F2_ZERO), F.F6_ZERO)
+    lam = F.f12_mul(F.f12_mul(three, F.f12_sqr(ax)), F.f12_inv(F.f12_add(ay, ay)))
+    x3 = F.f12_sub(F.f12_sub(F.f12_sqr(lam), ax), ax)
+    y3 = F.f12_sub(F.f12_mul(lam, F.f12_sub(ax, x3)), ay)
+    return (x3, y3)
+
+
+def final_exponentiation(f):
+    """f^((p^12 - 1)/r) via easy part + direct hard-part exponentiation."""
+    # easy part: f^(p^6 - 1) then ^(p^2 + 1)
+    f = F.f12_mul(F.f12_conj(f), F.f12_inv(f))
+    f = F.f12_mul(F.f12_frobenius(f, 2), f)
+    # hard part
+    e = (P ** 4 - P ** 2 + 1) // R
+    return F.f12_pow(f, e)
+
+
+def pairing(p, q):
+    """e(P, Q) for P in G1(E/Fp) affine, Q in G2(E'/Fp2) affine."""
+    return final_exponentiation(miller_loop(p, q))
+
+
+def multi_pairing(pairs):
+    """prod e(P_i, Q_i): one shared final exponentiation."""
+    f = F.F12_ONE
+    for p, q in pairs:
+        f = F.f12_mul(f, miller_loop(p, q))
+    return final_exponentiation(f)

@@ -1,0 +1,129 @@
+"""Oracle curve/group validation — the constant-transcription safety net.
+
+Every memorized constant in crypto/constants.py is cross-validated here by an
+algebraic identity that a transcription error cannot survive.
+"""
+
+import random
+
+from lighthouse_tpu.crypto.constants import P, R, BLS_X, H1, H2, G2_X, G2_Y
+from lighthouse_tpu.crypto.ref import fields as F
+from lighthouse_tpu.crypto.ref import curves as C
+
+rng = random.Random(99)
+
+
+def test_generators_on_curve():
+    assert C.g1_is_on_curve(C.G1_GEN)
+    assert C.g2_is_on_curve(C.G2_GEN)
+
+
+def test_subgroup_orders():
+    assert C.g1_mul(C.G1_GEN, R) is None
+    assert C.g2_mul(C.G2_GEN, R) is None
+
+
+def test_curve_order_identity():
+    # #E1(Fp) = h1 * r = p - x  (t = x + 1 for BLS12 curves)
+    assert H1 * R == P + BLS_X  # x negative: p - x = p + |x|
+
+
+def test_psi_eigenvalue_on_g2():
+    # psi acts as multiplication by x on G2; validates the twist constants.
+    assert C.g2_in_subgroup(C.G2_GEN)
+    q = C.g2_mul(C.G2_GEN, 12345)
+    assert C.g2_in_subgroup(q)
+
+
+def test_psi_rejects_non_subgroup_point():
+    # find a point on E2 outside G2 (cofactor h2 > 1 so a random point is
+    # outside the subgroup with overwhelming probability)
+    x = (5, 0)
+    while True:
+        y2 = F.f2_add(F.f2_mul(F.f2_sqr(x), x), (4, 4))
+        y = F.f2_sqrt(y2)
+        if y is not None:
+            break
+        x = (x[0] + 1, 0)
+    pt = (x, y)
+    assert C.g2_is_on_curve(pt)
+    assert not C.g2_in_subgroup(pt)
+    # but clearing the cofactor lands it in G2
+    cleared = C.g2_clear_cofactor(pt)
+    assert C.g2_in_subgroup(cleared)
+    assert C.g2_mul(cleared, R) is None
+
+
+def test_clear_cofactor_is_h_eff_multiple():
+    # RFC 9380 G.3 psi-method equals multiplication by
+    # h_eff = h2 * (3 * ... ) — concretely, validate: psi-method output equals
+    # a fixed scalar multiple of the input that annihilates under r and is
+    # consistent across inputs: clear(aP) == a*clear(P) for subgroup-free scalar.
+    x = (7, 3)
+    while True:
+        y2 = F.f2_add(F.f2_mul(F.f2_sqr(x), x), (4, 4))
+        y = F.f2_sqrt(y2)
+        if y is not None:
+            break
+        x = (x[0] + 1, 3)
+    pt = (x, y)
+    a = 9173
+    lhs = C.g2_clear_cofactor(C.g2_mul(pt, a))
+    rhs = C.g2_mul(C.g2_clear_cofactor(pt), a)
+    assert lhs == rhs or (
+        lhs is not None
+        and rhs is not None
+        and F.f2_eq(lhs[0], rhs[0])
+        and F.f2_eq(lhs[1], rhs[1])
+    )
+
+
+def test_g1_group_law():
+    g = C.G1_GEN
+    assert C.g1_add(C.g1_mul(g, 5), C.g1_mul(g, 7)) == C.g1_mul(g, 12)
+    assert C.g1_add(g, C.g1_neg(g)) is None
+    assert C.g1_add(None, g) == g
+
+
+def test_g2_group_law():
+    g = C.G2_GEN
+    p5, p7, p12 = C.g2_mul(g, 5), C.g2_mul(g, 7), C.g2_mul(g, 12)
+    s = C.g2_add(p5, p7)
+    assert F.f2_eq(s[0], p12[0]) and F.f2_eq(s[1], p12[1])
+    assert C.g2_add(g, C.g2_neg(g)) is None
+
+
+def test_g1_serialization_roundtrip():
+    for k in (1, 2, 3, 0xDEADBEEF):
+        pt = C.g1_mul(C.G1_GEN, k)
+        enc = C.g1_compress(pt)
+        assert len(enc) == 48
+        assert C.g1_decompress(enc) == pt
+    assert C.g1_compress(None)[0] == 0xC0
+    assert C.g1_decompress(C.g1_compress(None)) is None
+
+
+def test_g2_serialization_roundtrip():
+    for k in (1, 5, 0xABCDEF):
+        pt = C.g2_mul(C.G2_GEN, k)
+        enc = C.g2_compress(pt)
+        assert len(enc) == 96
+        dec = C.g2_decompress(enc)
+        assert F.f2_eq(dec[0], pt[0]) and F.f2_eq(dec[1], pt[1])
+    assert C.g2_decompress(C.g2_compress(None)) is None
+
+
+def test_g1_generator_known_encoding():
+    # The compressed G1 generator starts with 0x97 (well-known eth2 constant:
+    # 0x80 compression flag | high bits of x).
+    enc = C.g1_compress(C.G1_GEN)
+    assert enc[0] == 0x97
+
+def test_decompress_rejects_bad_field_element():
+    bad = bytearray(C.g1_compress(C.G1_GEN))
+    bad[1:] = b"\xff" * 47  # x >= P
+    try:
+        C.g1_decompress(bytes(bad))
+        assert False, "should reject x >= P"
+    except ValueError:
+        pass

@@ -1,0 +1,94 @@
+"""Oracle field-tower sanity: ring axioms, inverses, Frobenius, sqrt."""
+
+import random
+
+from lighthouse_tpu.crypto.constants import P
+from lighthouse_tpu.crypto.ref import fields as F
+
+rng = random.Random(1234)
+
+
+def rand_fp():
+    return rng.randrange(P)
+
+
+def rand_f2():
+    return (rand_fp(), rand_fp())
+
+
+def rand_f6():
+    return (rand_f2(), rand_f2(), rand_f2())
+
+
+def rand_f12():
+    return (rand_f6(), rand_f6())
+
+
+def test_fp2_inverse():
+    for _ in range(10):
+        a = rand_f2()
+        assert F.f2_eq(F.f2_mul(a, F.f2_inv(a)), F.F2_ONE)
+
+
+def test_fp2_sqrt_roundtrip():
+    for _ in range(20):
+        a = rand_f2()
+        sq = F.f2_sqr(a)
+        s = F.f2_sqrt(sq)
+        assert s is not None
+        assert F.f2_eq(F.f2_sqr(s), sq)
+
+
+def test_fp2_mul_xi_consistent():
+    for _ in range(5):
+        a = rand_f2()
+        assert F.f2_eq(F.f2_mul_xi(a), F.f2_mul(a, F.XI))
+
+
+def test_fp6_mul_matches_schoolbook_via_inverse():
+    for _ in range(5):
+        a = rand_f6()
+        ai = F.f6_inv(a)
+        assert F.f6_sub(F.f6_mul(a, ai), F.F6_ONE) == F.F6_ZERO or all(
+            F.f2_is_zero(c) for c in F.f6_sub(F.f6_mul(a, ai), F.F6_ONE)
+        )
+
+
+def test_fp6_mul_v():
+    v = (F.F2_ZERO, F.F2_ONE, F.F2_ZERO)
+    for _ in range(5):
+        a = rand_f6()
+        assert F.f6_sub(F.f6_mul_v(a), F.f6_mul(a, v)) == F.F6_ZERO or F.f6_is_zero(
+            F.f6_sub(F.f6_mul_v(a), F.f6_mul(a, v))
+        )
+
+
+def test_fp12_inverse_and_assoc():
+    for _ in range(3):
+        a, b, c = rand_f12(), rand_f12(), rand_f12()
+        assert F.f12_is_one(F.f12_mul(a, F.f12_inv(a)))
+        lhs = F.f12_mul(F.f12_mul(a, b), c)
+        rhs = F.f12_mul(a, F.f12_mul(b, c))
+        assert F.f12_eq(lhs, rhs)
+
+
+def test_frobenius_is_pth_power():
+    # pi(a) == a^p, checked against generic exponentiation
+    a = rand_f12()
+    assert F.f12_eq(F.f12_frobenius(a, 1), F.f12_pow(a, P))
+
+
+def test_frobenius_power_composition():
+    a = rand_f12()
+    assert F.f12_eq(
+        F.f12_frobenius(a, 2), F.f12_frobenius(F.f12_frobenius(a, 1), 1)
+    )
+    # p^6 is conjugation
+    assert F.f12_eq(F.f12_frobenius(a, 6), F.f12_conj(a))
+
+
+def test_sgn0():
+    assert F.f2_sgn0((0, 1)) == 1
+    assert F.f2_sgn0((0, 2)) == 0
+    assert F.f2_sgn0((1, 0)) == 1
+    assert F.f2_sgn0((2, 1)) == 0

@@ -1,0 +1,109 @@
+"""Oracle hash-to-curve + BLS signature scheme validation."""
+
+import hashlib
+
+from lighthouse_tpu.crypto.constants import H2C_A, H2C_B, P
+from lighthouse_tpu.crypto.ref import fields as F
+from lighthouse_tpu.crypto.ref import curves as C
+from lighthouse_tpu.crypto.ref import bls
+from lighthouse_tpu.crypto.ref.hash_to_curve import (
+    expand_message_xmd,
+    hash_to_field_fp2,
+    sswu,
+    iso_map,
+    hash_to_g2,
+)
+
+
+def test_expand_message_xmd_shape_and_determinism():
+    out = expand_message_xmd(b"abc", b"QUUX-V01-CS02-with-expander-SHA256-128", 32)
+    assert len(out) == 32
+    out2 = expand_message_xmd(b"abc", b"QUUX-V01-CS02-with-expander-SHA256-128", 32)
+    assert out == out2
+    assert expand_message_xmd(b"abd", b"X", 32) != expand_message_xmd(b"abc", b"X", 32)
+    assert len(expand_message_xmd(b"", b"X", 256)) == 256
+
+
+def test_sswu_lands_on_iso_curve():
+    for i in range(4):
+        u = hash_to_field_fp2(bytes([i]), 1)[0]
+        x, y = sswu(u)
+        lhs = F.f2_sqr(y)
+        rhs = F.f2_add(
+            F.f2_add(F.f2_mul(F.f2_sqr(x), x), F.f2_mul(H2C_A, x)), H2C_B
+        )
+        assert F.f2_eq(lhs, rhs), "SSWU output not on E2'"
+
+
+def test_iso_map_lands_on_e2():
+    # THE constant-validation test: a wrong memorized isogeny coefficient makes
+    # this fail with overwhelming probability.
+    for i in range(6):
+        u = hash_to_field_fp2(b"iso" + bytes([i]), 1)[0]
+        pt = iso_map(sswu(u))
+        assert C.g2_is_on_curve(pt), "isogeny image not on E2 — bad ISO3 constants"
+
+
+def test_hash_to_g2_in_subgroup():
+    for msg in (b"", b"abc", b"a" * 100):
+        h = hash_to_g2(msg)
+        assert C.g2_is_on_curve(h)
+        assert C.g2_in_subgroup(h)
+        assert C.g2_mul(h, 1) == h
+
+
+def test_hash_to_g2_deterministic_and_distinct():
+    a = hash_to_g2(b"same")
+    b = hash_to_g2(b"same")
+    c = hash_to_g2(b"diff")
+    assert F.f2_eq(a[0], b[0]) and F.f2_eq(a[1], b[1])
+    assert not F.f2_eq(a[0], c[0])
+
+
+def test_sign_verify_roundtrip():
+    sk = 0x1234567890ABCDEF
+    pk = bls.sk_to_pk(sk)
+    msg = hashlib.sha256(b"attestation root").digest()
+    sig = bls.sign(sk, msg)
+    assert bls.verify(pk, msg, sig)
+    assert not bls.verify(pk, b"\x00" * 32, sig)
+    assert not bls.verify(bls.sk_to_pk(sk + 1), msg, sig)
+
+
+def test_fast_aggregate_verify():
+    msg = hashlib.sha256(b"block root").digest()
+    sks = [100 + i for i in range(4)]
+    pks = [bls.sk_to_pk(sk) for sk in sks]
+    agg = bls.aggregate([bls.sign(sk, msg) for sk in sks])
+    assert bls.fast_aggregate_verify(pks, msg, agg)
+    assert not bls.fast_aggregate_verify(pks[:3], msg, agg)
+    assert not bls.fast_aggregate_verify([], msg, agg)
+
+
+def test_aggregate_verify_distinct_messages():
+    sks = [7, 8, 9]
+    msgs = [hashlib.sha256(bytes([i])).digest() for i in range(3)]
+    pks = [bls.sk_to_pk(sk) for sk in sks]
+    sig = bls.aggregate([bls.sign(sk, m) for sk, m in zip(sks, msgs)])
+    assert bls.aggregate_verify(pks, msgs, sig)
+    assert not bls.aggregate_verify(pks, list(reversed(msgs)), sig)
+
+
+def test_verify_signature_sets_batch():
+    msgs = [hashlib.sha256(b"m%d" % i).digest() for i in range(3)]
+    sks = [[11, 12], [13], [14, 15, 16]]
+    sets = []
+    for m, group in zip(msgs, sks):
+        agg_sig = bls.aggregate([bls.sign(sk, m) for sk in group])
+        sets.append(bls.SignatureSet(agg_sig, [bls.sk_to_pk(sk) for sk in group], m))
+    assert bls.verify_signature_sets(sets)
+    # tamper one signature -> whole batch fails
+    bad = list(sets)
+    bad[1] = bls.SignatureSet(sets[0].signature, sets[1].pubkeys, sets[1].message)
+    assert not bls.verify_signature_sets(bad)
+    # infinity pubkey rejection
+    bad2 = list(sets)
+    bad2[0] = bls.SignatureSet(sets[0].signature, [None], sets[0].message)
+    assert not bls.verify_signature_sets(bad2)
+    # empty batch
+    assert not bls.verify_signature_sets([])

@@ -1,0 +1,38 @@
+"""Oracle pairing validation: bilinearity, non-degeneracy, multi-pairing."""
+
+from lighthouse_tpu.crypto.ref import fields as F
+from lighthouse_tpu.crypto.ref import curves as C
+from lighthouse_tpu.crypto.ref import pairing as PR
+
+
+def test_nondegeneracy():
+    e = PR.pairing(C.G1_GEN, C.G2_GEN)
+    assert not F.f12_is_one(e)
+    assert not F.f12_is_zero(e)
+
+
+def test_bilinearity():
+    a, b = 6, 11
+    e_ab = PR.pairing(C.g1_mul(C.G1_GEN, a), C.g2_mul(C.G2_GEN, b))
+    e_base = PR.pairing(C.G1_GEN, C.G2_GEN)
+    assert F.f12_eq(e_ab, F.f12_pow(e_base, a * b))
+
+
+def test_bilinearity_both_slots():
+    a, b = 4, 9
+    lhs = PR.pairing(C.g1_mul(C.G1_GEN, a * b), C.G2_GEN)
+    rhs = PR.pairing(C.g1_mul(C.G1_GEN, a), C.g2_mul(C.G2_GEN, b))
+    assert F.f12_eq(lhs, rhs)
+
+
+def test_multi_pairing_cancellation():
+    # e(-aG1, G2) * e(aG1, G2) == 1
+    a = 7
+    p = C.g1_mul(C.G1_GEN, a)
+    out = PR.multi_pairing([(C.g1_neg(p), C.G2_GEN), (p, C.G2_GEN)])
+    assert F.f12_is_one(out)
+
+
+def test_pairing_with_infinity_is_one():
+    assert F.f12_is_one(PR.pairing(None, C.G2_GEN))
+    assert F.f12_is_one(PR.pairing(C.G1_GEN, None))
