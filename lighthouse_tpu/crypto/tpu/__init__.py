@@ -1,0 +1,14 @@
+"""JAX/TPU BLS12-381 kernels — the device-side compute path.
+
+This package is the TPU-native equivalent of the reference client's `blst`
+backend (/root/reference/crypto/bls/src/impls/blst.rs): base-field limb
+arithmetic in Montgomery form, Fp2/Fp6/Fp12 towers, G1/G2 curve ops, the
+optimal-ate pairing, hash-to-curve, and the batched randomized
+`verify_signature_sets` pipeline — all expressed as jittable, vmappable,
+shardable JAX functions with fixed trip counts (XLA-friendly control flow).
+
+Layout convention: a base-field element is a uint32 array of shape
+``(24, *batch)`` — 24 sixteen-bit limbs, little-endian, **limbs leading** so
+that batch dimensions map onto TPU vector lanes (the VPU is 8x128; putting
+the 24-limb axis last would waste 80% of each lane group).
+"""

@@ -1,0 +1,251 @@
+"""Base-field (Fp, p = BLS12-381 prime) limb arithmetic in JAX.
+
+Representation: an Fp element is a ``uint32`` array of shape ``(24, *batch)``
+— 24 little-endian 16-bit limbs (the SURVEY.md §7 "24x16-bit limbs in int32"
+schedule).  All values are kept in **Montgomery form** (x·R mod p, R = 2^384)
+and fully reduced (< p) between operations.
+
+Why 24x16/uint32: a 16x16-bit limb product fits exactly in uint32; splitting
+each product into lo/hi 16-bit halves lets 24-term column sums accumulate in
+uint32 with ~9 bits of headroom, so the only sequential dependency is one
+carry-propagation scan per multiplication.  No int64 anywhere — TPU has no
+native 64-bit integer path.
+
+The multiplication is the SOS (separated operand scanning) Montgomery
+multiply: t = a*b; m = (t mod R)·(-p^-1) mod R; result = (t + m*p)/R, with a
+final conditional subtraction.  This mirrors what blst's assembly does per
+word (reference: /root/reference/crypto/bls/src/impls/blst.rs uses blst's
+mul_mont_384); here every limb op is a vectorized lane-parallel op over the
+trailing batch dimensions.
+
+Control flow: fixed-exponent powers run as `lax.scan` over a compile-time
+bit array — fixed trip count, no data-dependent branching, XLA-friendly.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..constants import P
+
+U32 = jnp.uint32
+LB = 16                      # bits per limb
+NLIMB = 24                   # 24 * 16 = 384 bits >= 381
+MASK = np.uint32((1 << LB) - 1)
+R_BITS = NLIMB * LB          # Montgomery R = 2^384
+R_INT = 1 << R_BITS
+R1 = R_INT % P               # R mod p  (= Montgomery form of 1)
+R2 = (R_INT * R_INT) % P     # R^2 mod p (to_mont multiplier)
+NPRIME = (-pow(P, -1, R_INT)) % R_INT   # -p^-1 mod R
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Host-side: python int -> (24,) uint32 limb array (little-endian)."""
+    assert 0 <= x < R_INT
+    return np.array([(x >> (LB * i)) & 0xFFFF for i in range(NLIMB)], dtype=np.uint32)
+
+
+def limbs_to_int(a) -> int:
+    """Host-side: limb array (24, *batch is NOT allowed here) -> python int."""
+    a = np.asarray(a)
+    assert a.shape == (NLIMB,), a.shape
+    return sum(int(v) << (LB * i) for i, v in enumerate(a))
+
+
+def ints_to_array(xs) -> np.ndarray:
+    """Host-side: list of ints -> (24, len) uint32 array (batch trailing)."""
+    return np.stack([int_to_limbs(x) for x in xs], axis=-1)
+
+
+def array_to_ints(a) -> list:
+    a = np.asarray(a)
+    flat = a.reshape(NLIMB, -1)
+    return [sum(int(flat[i, j]) << (LB * i) for i in range(NLIMB)) for j in range(flat.shape[1])]
+
+
+P_LIMBS = int_to_limbs(P)
+NPRIME_LIMBS = int_to_limbs(NPRIME)
+R2_LIMBS = int_to_limbs(R2)
+ONE_MONT = int_to_limbs(R1)           # 1 in Montgomery form
+ZERO_LIMBS = np.zeros(NLIMB, dtype=np.uint32)
+
+
+# ---------------------------------------------------------------- helpers
+
+def _bshape(*arrs):
+    """Broadcast batch shape of limb arrays (limbs axis 0 removed)."""
+    return jnp.broadcast_shapes(*[a.shape[1:] for a in arrs])
+
+
+def zeros(batch_shape=()):
+    return jnp.zeros((NLIMB,) + tuple(batch_shape), U32)
+
+
+def _carry_scan(cols, n_out):
+    """Propagate carries over `cols` (M, *batch), cols < 2^31.
+
+    Returns (n_out,)-limb normalized array (16-bit limbs) and the final
+    carry (anything that overflows limb n_out-1); carries are exact because
+    per-step values never exceed uint32.
+    """
+    init = jnp.zeros(cols.shape[1:], U32)
+
+    def step(carry, col):
+        t = col + carry
+        return t >> LB, t & MASK
+
+    carry, out = lax.scan(step, init, cols)
+    if n_out > cols.shape[0]:
+        pad = jnp.zeros((n_out - cols.shape[0] - 1,) + cols.shape[1:], U32)
+        out = jnp.concatenate([out, carry[None], pad], axis=0)
+        carry = jnp.zeros_like(carry)
+    return out[:n_out], carry
+
+
+def _mul_cols(a, b, n_out=2 * NLIMB):
+    """Column sums of the schoolbook product a*b.
+
+    a, b: (24, *batch) with 16-bit limbs.  Returns (n_out, *batch) uint32
+    columns, each < 24·2^16·2 ≈ 2^22 (lo+hi split keeps uint32 exact).
+    """
+    shape = (n_out,) + _bshape(a, b)
+    lo = jnp.zeros(shape, U32)
+    hi = jnp.zeros(shape, U32)
+    for i in range(min(NLIMB, n_out)):
+        p = a[i] * b[: n_out - i]          # exact in uint32 (16x16)
+        lo = lo.at[i:i + p.shape[0]].add(p & MASK)
+        nh = min(p.shape[0], n_out - i - 1)
+        if nh > 0:
+            hi = hi.at[i + 1:i + 1 + nh].add(p[:nh] >> LB)
+    return lo + hi
+
+
+def _add_limbs(a, b):
+    """(a + b) with full carry propagation; returns (limbs, carry_out)."""
+    return _carry_scan(a + b, NLIMB)
+
+
+def _sub_limbs(a, b):
+    """a - b with borrow chain; returns (diff mod 2^384, borrow_out in {0,1})."""
+    init = jnp.zeros(_bshape(a, b), U32)
+
+    def step(borrow, ab):
+        ai, bi = ab
+        need = bi + borrow
+        t = (ai - need) & MASK
+        return jnp.where(ai < need, jnp.uint32(1), jnp.uint32(0)).astype(U32), t
+
+    bshape = _bshape(a, b)
+    ab = (jnp.broadcast_to(a, (NLIMB,) + bshape), jnp.broadcast_to(b, (NLIMB,) + bshape))
+    borrow, out = lax.scan(step, init, ab)
+    return out, borrow
+
+
+def _cond_sub_p(a):
+    """If a >= p subtract p (a < 2p assumed)."""
+    diff, borrow = _sub_limbs(a, jnp.asarray(P_LIMBS)[(...,) + (None,) * (a.ndim - 1)])
+    return jnp.where(borrow[None] == 0, diff, a)
+
+
+# ---------------------------------------------------------------- public ops
+
+def add(a, b):
+    s, _ = _add_limbs(a, b)       # a+b < 2p < 2^384: no carry out
+    return _cond_sub_p(s)
+
+
+def sub(a, b):
+    d, borrow = _sub_limbs(a, b)
+    fixed, _ = _add_limbs(d, jnp.asarray(P_LIMBS)[(...,) + (None,) * (d.ndim - 1)])
+    return jnp.where(borrow[None] == 0, d, fixed)
+
+
+def neg(a):
+    return sub(zeros(a.shape[1:]), a)
+
+
+def mont_mul(a, b):
+    """Montgomery product a·b·R^-1 mod p (SOS method)."""
+    t, _ = _carry_scan(_mul_cols(a, b), 2 * NLIMB)            # a*b, 48 limbs
+    np_arr = jnp.asarray(NPRIME_LIMBS)[(...,) + (None,) * (t.ndim - 1)]
+    m, _ = _carry_scan(_mul_cols(t[:NLIMB], np_arr, NLIMB), NLIMB)   # low half
+    p_arr = jnp.asarray(P_LIMBS)[(...,) + (None,) * (t.ndim - 1)]
+    u = _mul_cols(m, p_arr) + t                               # t + m*p, cols < 2^23
+    full, _ = _carry_scan(u, 2 * NLIMB)                       # divisible by R
+    return _cond_sub_p(full[NLIMB:])                          # (t + m*p)/R < 2p
+
+
+def mont_sqr(a):
+    return mont_mul(a, a)
+
+
+def to_mont(a):
+    r2 = jnp.asarray(R2_LIMBS)[(...,) + (None,) * (a.ndim - 1)]
+    return mont_mul(a, r2)
+
+
+def from_mont(a):
+    one = jnp.zeros_like(a).at[0].set(1)
+    return mont_mul(a, one)
+
+
+def is_zero(a):
+    return jnp.all(a == 0, axis=0)
+
+
+def eq(a, b):
+    return jnp.all(a == b, axis=0)
+
+
+def select(cond, a, b):
+    """cond: batch-shaped bool; picks a where true."""
+    return jnp.where(cond[None], a, b)
+
+
+def _exp_bits(e: int) -> np.ndarray:
+    """LSB-first bit array of a fixed exponent (host-side constant)."""
+    n = max(e.bit_length(), 1)
+    return np.array([(e >> i) & 1 for i in range(n)], dtype=np.bool_)
+
+
+def mont_pow(a, e: int):
+    """a^e (Montgomery in, Montgomery out) by square-and-multiply scan.
+
+    `e` is a python int fixed at trace time — the scan runs over a constant
+    bit array (LSB first), so the trip count is static.
+    """
+    bits = jnp.asarray(_exp_bits(e))
+    one = jnp.broadcast_to(
+        jnp.asarray(ONE_MONT)[(...,) + (None,) * (a.ndim - 1)], a.shape
+    )
+
+    def step(state, bit):
+        acc, base = state
+        acc = jnp.where(bit, mont_mul(acc, base), acc)
+        return (acc, mont_sqr(base)), None
+
+    (acc, _), _ = lax.scan(step, (one, a), bits)
+    return acc
+
+
+def inv(a):
+    """a^-1 via Fermat (a^(p-2)); maps 0 -> 0 (the RFC 9380 `inv0`)."""
+    return mont_pow(a, P - 2)
+
+
+def const(x: int, batch_shape=(), mont=True):
+    """Embed a python int as a (24, *batch) device constant."""
+    v = (x * R_INT) % P if mont else x % P
+    arr = jnp.asarray(int_to_limbs(v))
+    return jnp.broadcast_to(arr[(...,) + (None,) * len(batch_shape)], (NLIMB,) + tuple(batch_shape))
+
+
+def to_int(a) -> int:
+    """Host-side: Montgomery limb array (24,) -> python int (de-Montgomeryized)."""
+    return (limbs_to_int(np.asarray(a)) * pow(R_INT, -1, P)) % P
+
+
+def from_int(x: int, batch_shape=()):
+    """Host-side: python int -> Montgomery device array."""
+    return const(x, batch_shape, mont=True)

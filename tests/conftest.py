@@ -6,9 +6,18 @@ hardware in CI); the driver separately dry-runs `__graft_entry__.dryrun_multichi
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-set: the environment pre-sets JAX_PLATFORMS=axon (the tunnelled TPU
+# plugin registered from sitecustomize), where every eager op is a ~0.6s
+# network round-trip.  Tests must run on the local CPU backend.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (after env setup)
+
+# jax may already have been imported by sitecustomize with platforms=axon;
+# override the live config too.
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,99 @@
+"""Differential tests: JAX limb Fp arithmetic vs the pure-Python oracle."""
+
+import random
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lighthouse_tpu.crypto.constants import P
+from lighthouse_tpu.crypto.ref import fields as RF
+from lighthouse_tpu.crypto.tpu import fp
+
+rng = random.Random(0xB15)
+
+
+def rand_fp(n):
+    return [rng.randrange(P) for _ in range(n)]
+
+
+def to_dev(xs):
+    """ints -> Montgomery limb array (24, n)."""
+    return fp.to_mont(jnp.asarray(fp.ints_to_array(xs)))
+
+
+def from_dev(a):
+    r_inv = pow(fp.R_INT, -1, P)
+    return [(v * r_inv) % P for v in fp.array_to_ints(np.asarray(a))]
+
+
+def test_limb_roundtrip():
+    xs = rand_fp(7) + [0, 1, P - 1]
+    arr = fp.ints_to_array(xs)
+    assert fp.array_to_ints(arr) == xs
+
+
+def test_mont_roundtrip():
+    xs = rand_fp(5) + [0, 1, P - 1]
+    a = to_dev(xs)
+    back = fp.array_to_ints(np.asarray(fp.from_mont(a)))
+    assert back == xs
+
+
+@pytest.mark.parametrize("op,ref", [
+    (fp.add, RF.fp_add),
+    (fp.sub, RF.fp_sub),
+    (fp.mont_mul, RF.fp_mul),
+])
+def test_binary_ops(op, ref):
+    n = 17
+    xs, ys = rand_fp(n), rand_fp(n)
+    # exercise edge values too
+    xs[:3] = [0, P - 1, 1]
+    ys[:3] = [0, P - 1, P - 1]
+    out = from_dev(op(to_dev(xs), to_dev(ys)))
+    assert out == [ref(x, y) % P for x, y in zip(xs, ys)]
+
+
+def test_neg():
+    xs = rand_fp(5) + [0, 1, P - 1]
+    out = from_dev(fp.neg(to_dev(xs)))
+    assert out == [RF.fp_neg(x) for x in xs]
+
+
+def test_inv():
+    xs = rand_fp(4) + [1, P - 1, 0]
+    out = from_dev(fp.inv(to_dev(xs)))
+    expect = [RF.fp_inv(x) if x else 0 for x in xs]  # inv0 convention
+    assert out == expect
+
+
+def test_pow_fixed_exponent():
+    xs = rand_fp(3)
+    e = 0xD201000000010000
+    out = from_dev(fp.mont_pow(to_dev(xs), e))
+    assert out == [pow(x, e, P) for x in xs]
+
+
+def test_eq_is_zero_select():
+    xs = [5, 0, 7]
+    ys = [5, 0, 8]
+    a, b = to_dev(xs), to_dev(ys)
+    assert list(np.asarray(fp.eq(a, b))) == [True, True, False]
+    assert list(np.asarray(fp.is_zero(a))) == [False, True, False]
+    sel = from_dev(fp.select(fp.eq(a, b), a, fp.neg(a)))
+    assert sel == [5, 0, (-7) % P]
+
+
+def test_broadcast_scalar_against_batch():
+    xs = rand_fp(6)
+    c = fp.const(3, ())
+    out = from_dev(fp.mont_mul(c[:, None] if c.ndim == 1 else c, to_dev(xs)))
+    assert out == [(3 * x) % P for x in xs]
+
+
+def test_multi_dim_batch():
+    xs = rand_fp(6)
+    a = to_dev(xs).reshape(fp.NLIMB, 2, 3)
+    out = fp.mont_mul(a, a).reshape(fp.NLIMB, 6)
+    assert from_dev(out) == [(x * x) % P for x in xs]
