@@ -103,22 +103,33 @@ def _carry_scan(cols, n_out):
     return out[:n_out], carry
 
 
+# Constant antidiagonal-gather matrix: flat product index s = i*24+j (lo
+# half) contributes to column i+j; s + 576 (hi half) to column i+j+1.  One
+# integer contraction with this keeps the HLO op count per multiplication
+# tiny — essential because a full Miller-loop step contains ~10^2 field muls
+# and XLA compile time scales with graph size (SURVEY.md §7 hard part 2).
+def _diag_mat():
+    m = np.zeros((2 * NLIMB, 2 * NLIMB * NLIMB), dtype=np.uint32)
+    for i in range(NLIMB):
+        for j in range(NLIMB):
+            m[i + j, i * NLIMB + j] = 1
+            m[i + j + 1, NLIMB * NLIMB + i * NLIMB + j] = 1
+    return m
+
+
+_DIAG_MAT = _diag_mat()
+
+
 def _mul_cols(a, b, n_out=2 * NLIMB):
     """Column sums of the schoolbook product a*b.
 
     a, b: (24, *batch) with 16-bit limbs.  Returns (n_out, *batch) uint32
-    columns, each < 24·2^16·2 ≈ 2^22 (lo+hi split keeps uint32 exact).
+    columns, each < 2·24·2^16 ≈ 2^22 (lo/hi split keeps uint32 exact).
     """
-    shape = (n_out,) + _bshape(a, b)
-    lo = jnp.zeros(shape, U32)
-    hi = jnp.zeros(shape, U32)
-    for i in range(min(NLIMB, n_out)):
-        p = a[i] * b[: n_out - i]          # exact in uint32 (16x16)
-        lo = lo.at[i:i + p.shape[0]].add(p & MASK)
-        nh = min(p.shape[0], n_out - i - 1)
-        if nh > 0:
-            hi = hi.at[i + 1:i + 1 + nh].add(p[:nh] >> LB)
-    return lo + hi
+    bshape = _bshape(a, b)
+    prods = (a[:, None] * b[None, :]).reshape((NLIMB * NLIMB,) + bshape)
+    lohi = jnp.concatenate([prods & MASK, prods >> LB], axis=0)
+    return jnp.einsum("ks,s...->k...", jnp.asarray(_DIAG_MAT[:n_out]), lohi)
 
 
 def _add_limbs(a, b):
@@ -249,3 +260,31 @@ def to_int(a) -> int:
 def from_int(x: int, batch_shape=()):
     """Host-side: python int -> Montgomery device array."""
     return const(x, batch_shape, mont=True)
+
+
+# ----------------------------------------------- stacked-op helpers
+# The tower layers fold every *independent* field multiplication of a
+# formula into ONE batched mont_mul by stacking operands along a new axis 1
+# (just after the limb axis).  This is the core TPU-first restructuring: it
+# keeps the XLA graph small (one dot per tower op instead of dozens) and
+# feeds the vector units wider batches.
+
+def fstack(elems):
+    """Stack Fp elements along a new axis 1: [(24,*B)] -> (24, n, *B)."""
+    elems = jnp.broadcast_arrays(*elems)
+    return jnp.stack(elems, axis=1)
+
+
+def funstack(arr):
+    """Inverse of fstack: (24, n, *B) -> tuple of n (24, *B) arrays."""
+    return tuple(arr[:, i] for i in range(arr.shape[1]))
+
+
+def tstack(trees):
+    """Stack identical pytrees of Fp leaves along axis 1."""
+    return jax.tree_util.tree_map(lambda *xs: fstack(xs), *trees)
+
+
+def tunstack(tree, n):
+    """Inverse of tstack."""
+    return [jax.tree_util.tree_map(lambda x: x[:, i], tree) for i in range(n)]

@@ -1,0 +1,146 @@
+"""Differential tests: JAX Fp2/Fp6/Fp12 towers vs the pure-Python oracle."""
+
+import random
+
+import numpy as np
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.constants import P, BLS_X
+from lighthouse_tpu.crypto.ref import fields as RF
+from lighthouse_tpu.crypto.tpu import fp, tower as T
+from .helpers import J
+
+rng = random.Random(0xF12)
+
+R_INV = pow(fp.R_INT, P - 2, P)
+
+
+# --- host <-> device converters for batched tower elements ----------------
+
+def fp_dev(xs):
+    return fp.to_mont(jnp.asarray(fp.ints_to_array(xs)))
+
+
+def fp_host(a):
+    return [(v * R_INV) % P for v in fp.array_to_ints(np.asarray(a))]
+
+
+def f2_dev(vals):  # vals: list of (c0, c1) int tuples
+    return (fp_dev([v[0] for v in vals]), fp_dev([v[1] for v in vals]))
+
+
+def f2_host(a):
+    return list(zip(fp_host(a[0]), fp_host(a[1])))
+
+
+def f6_dev(vals):
+    return tuple(f2_dev([v[i] for v in vals]) for i in range(3))
+
+
+def f6_host(a):
+    parts = [f2_host(c) for c in a]
+    return list(zip(*parts))
+
+
+def f12_dev(vals):
+    return tuple(f6_dev([v[i] for v in vals]) for i in range(2))
+
+
+def f12_host(a):
+    parts = [f6_host(c) for c in a]
+    return list(zip(*parts))
+
+
+def rand_f2(n):
+    return [(rng.randrange(P), rng.randrange(P)) for _ in range(n)]
+
+
+def rand_f6(n):
+    return [tuple(rand_f2(3)) for _ in range(n)]
+
+
+def rand_f12(n):
+    return [tuple(rand_f6(2)) for _ in range(n)]
+
+
+N = 4  # one batch size everywhere -> every jitted op compiles exactly once
+
+
+# ------------------------------------------------------------------ Fp2
+
+def test_f2_ops():
+    xs, ys = rand_f2(N), rand_f2(N)
+    xs[0] = (0, 0)
+    ys[1] = (0, 0)
+    a, b = f2_dev(xs), f2_dev(ys)
+    assert f2_host(J(T.f2_mul)(a, b)) == [RF.f2_mul(x, y) for x, y in zip(xs, ys)]
+    assert f2_host(J(T.f2_add)(a, b)) == [RF.f2_add(x, y) for x, y in zip(xs, ys)]
+    assert f2_host(J(T.f2_sub)(a, b)) == [RF.f2_sub(x, y) for x, y in zip(xs, ys)]
+    assert f2_host(J(T.f2_sqr)(a)) == [RF.f2_sqr(x) for x in xs]
+    assert f2_host(J(T.f2_mul_xi)(a)) == [RF.f2_mul_xi(x) for x in xs]
+    assert f2_host(J(T.f2_conj)(a)) == [RF.f2_conj(x) for x in xs]
+    assert f2_host(J(T.f2_neg)(a)) == [RF.f2_neg(x) for x in xs]
+
+
+def test_f2_inv():
+    xs = rand_f2(N)
+    out = f2_host(J(T.f2_inv)(f2_dev(xs)))
+    assert out == [RF.f2_inv(x) for x in xs]
+
+
+def test_f2_pow():
+    xs = rand_f2(N)
+    out = f2_host(J(lambda a: T.f2_pow(a, BLS_X))(f2_dev(xs)))
+    assert out == [RF.f2_pow(x, BLS_X) for x in xs]
+
+
+# ------------------------------------------------------------------ Fp6
+
+def test_f6_mul_inv():
+    xs, ys = rand_f6(N), rand_f6(N)
+    a, b = f6_dev(xs), f6_dev(ys)
+    assert f6_host(J(T.f6_mul)(a, b)) == [RF.f6_mul(x, y) for x, y in zip(xs, ys)]
+    assert f6_host(J(T.f6_mul_v)(a)) == [RF.f6_mul_v(x) for x in xs]
+    assert f6_host(J(T.f6_inv)(a)) == [RF.f6_inv(x) for x in xs]
+
+
+# ------------------------------------------------------------------ Fp12
+
+def test_f12_mul_sqr_inv_conj():
+    xs, ys = rand_f12(N), rand_f12(N)
+    a, b = f12_dev(xs), f12_dev(ys)
+    assert f12_host(J(T.f12_mul)(a, b)) == [RF.f12_mul(x, y) for x, y in zip(xs, ys)]
+    assert f12_host(J(T.f12_sqr)(a)) == [RF.f12_sqr(x) for x in xs]
+    assert f12_host(J(T.f12_inv)(a)) == [RF.f12_inv(x) for x in xs]
+    assert f12_host(J(T.f12_conj)(a)) == [RF.f12_conj(x) for x in xs]
+
+
+def test_f12_frobenius():
+    xs = rand_f12(N)
+    a = f12_dev(xs)
+    for power in (1, 2, 3):
+        out = f12_host(J(T.f12_frobenius, static_argnums=1)(a, power))
+        assert out == [RF.f12_frobenius(x, power) for x in xs]
+
+
+def test_f12_cyclotomic_sqr():
+    # Build cyclotomic-subgroup elements: f^((p^6-1)(p^2+1)) for random f.
+    raw = rand_f12(N)
+    cyc = []
+    for x in raw:
+        y = RF.f12_mul(RF.f12_conj(x), RF.f12_inv(x))       # ^(p^6 - 1)
+        y = RF.f12_mul(RF.f12_frobenius(y, 2), y)           # ^(p^2 + 1)
+        cyc.append(y)
+    a = f12_dev(cyc)
+    out = f12_host(J(T.f12_cyclotomic_sqr)(a))
+    assert out == [RF.f12_sqr(x) for x in cyc]
+
+
+def test_f12_select_eq():
+    xs = rand_f12(N)
+    a = f12_dev(xs)
+    b = f12_dev(list(reversed(xs)))
+    assert np.asarray(J(T.f12_eq)(a, a)).all()
+    sel = J(T.f12_select)(jnp.asarray([True, False, True, False]), a, b)
+    out = f12_host(sel)
+    assert out == [xs[0], xs[2], xs[2], xs[0]]
