@@ -21,3 +21,8 @@ import jax  # noqa: E402  (after env setup)
 # jax may already have been imported by sitecustomize with platforms=axon;
 # override the live config too.
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compile cache: the kernel graphs (scan ladders, Miller loops)
+# are compile-heavy; cache across test runs.
+jax.config.update("jax_compilation_cache_dir", "/tmp/lighthouse_tpu_xla_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -1,0 +1,164 @@
+"""Differential tests: JAX G1/G2 Jacobian ops vs the pure-Python oracle."""
+
+import random
+
+import numpy as np
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.constants import P, R, BLS_X, B1, B2
+from lighthouse_tpu.crypto.ref import curves as RC
+from lighthouse_tpu.crypto.ref import fields as RF
+from lighthouse_tpu.crypto.tpu import curve as C
+from lighthouse_tpu.crypto.tpu import fp, tower as tw
+from .helpers import J
+
+rng = random.Random(0xC0)
+
+N = 4
+
+
+def rand_g1(n, with_inf=True):
+    pts = [RC.g1_mul(RC.G1_GEN, rng.randrange(1, R)) for _ in range(n)]
+    if with_inf:
+        pts[1] = None
+    return pts
+
+
+def rand_g2(n, with_inf=True):
+    pts = [RC.g2_mul(RC.G2_GEN, rng.randrange(1, R)) for _ in range(n)]
+    if with_inf:
+        pts[1] = None
+    return pts
+
+
+def g1_add_dev(p, q):
+    return C.add(C.FP_OPS, p, q)
+
+
+def g2_add_dev(p, q):
+    return C.add(C.F2_OPS, p, q)
+
+
+def test_g1_add_double_edge_cases():
+    ps = rand_g1(N)
+    qs = rand_g1(N)
+    qs[2] = ps[2]                 # equal points -> doubling path
+    qs[3] = RC.g1_neg(ps[3])      # inverse points -> infinity path
+    a, b = C.g1_from_ints(ps), C.g1_from_ints(qs)
+    out = C.g1_to_ints(J(g1_add_dev)(a, b))
+    assert out == [RC.g1_add(p, q) for p, q in zip(ps, qs)]
+    dbl = C.g1_to_ints(J(lambda p: C.double(C.FP_OPS, p))(a))
+    assert dbl == [RC.g1_double(p) for p in ps]
+
+
+def test_g2_add_double_edge_cases():
+    ps = rand_g2(N)
+    qs = rand_g2(N)
+    qs[2] = ps[2]
+    qs[3] = RC.g2_neg(ps[3])
+    a, b = C.g2_from_ints(ps), C.g2_from_ints(qs)
+    out = C.g2_to_ints(J(g2_add_dev)(a, b))
+    assert out == [RC.g2_add(p, q) for p, q in zip(ps, qs)]
+    dbl = C.g2_to_ints(J(lambda p: C.double(C.F2_OPS, p))(a))
+    assert dbl == [RC.g2_double(p) for p in ps]
+
+
+def test_g1_mul_u64():
+    ps = rand_g1(N)
+    ks = [rng.randrange(1, 1 << 64) for _ in range(N)]
+    scal = jnp.asarray(
+        np.stack([np.array([k & 0xFFFFFFFF for k in ks], dtype=np.uint32),
+                  np.array([k >> 32 for k in ks], dtype=np.uint32)])
+    )
+    out = C.g1_to_ints(J(lambda p, s: C.mul_u64(C.FP_OPS, p, s))(C.g1_from_ints(ps), scal))
+    assert out == [RC.g1_mul(p, k) for p, k in zip(ps, ks)]
+
+
+def test_g2_mul_int_fixed():
+    ps = rand_g2(N)
+    out = C.g2_to_ints(J(lambda p: C.mul_int(C.F2_OPS, p, BLS_X))(C.g2_from_ints(ps)))
+    assert out == [RC.g2_mul(p, BLS_X) for p in ps]
+    # negative scalar
+    out = C.g2_to_ints(J(lambda p: C.mul_int(C.F2_OPS, p, -5))(C.g2_from_ints(ps)))
+    assert out == [RC.g2_mul(p, -5) for p in ps]
+
+
+def test_on_curve_and_eq():
+    ps = rand_g1(N)
+    a = C.g1_from_ints(ps)
+    assert np.asarray(J(lambda p: C.on_curve(C.FP_OPS, p, B1))(a)).all()
+    # tweak x -> off curve (keep index 1 = infinity, stays "on curve")
+    bad = [(p[0], (p[1] + 1) % P) if p else None for p in ps]
+    ab = C.g1_from_ints(bad)
+    oc = np.asarray(J(lambda p: C.on_curve(C.FP_OPS, p, B1))(ab))
+    assert list(oc) == [p is None for p in bad]
+    assert np.asarray(J(lambda p, q: C.eq_points(C.FP_OPS, p, q))(a, a)).all()
+
+
+def test_g1_subgroup_check():
+    good = rand_g1(N)                       # in subgroup (incl. infinity)
+    assert np.asarray(J(C.g1_in_subgroup)(C.g1_from_ints(good))).all()
+    # Construct on-curve points NOT in the subgroup: scale a curve point of
+    # full order by the subgroup order r (cofactor h1 != 1 guarantees some).
+    bad = []
+    while len(bad) < N:
+        x = rng.randrange(P)
+        y2 = (x * x * x + B1) % P
+        y = RF.fp_sqrt(y2)
+        if y is None:
+            continue
+        pt = (x, y)
+        if not RC.g1_in_subgroup(pt):
+            bad.append(pt)
+    res = np.asarray(J(C.g1_in_subgroup)(C.g1_from_ints(bad)))
+    assert not res.any()
+
+
+def test_g2_subgroup_check():
+    good = rand_g2(N)
+    assert np.asarray(J(C.g2_in_subgroup)(C.g2_from_ints(good))).all()
+    bad = []
+    while len(bad) < 2:
+        x = (rng.randrange(P), rng.randrange(P))
+        y2 = RF.f2_add(RF.f2_mul(RF.f2_sqr(x), x), B2)
+        y = RF.f2_sqrt(y2)
+        if y is None:
+            continue
+        pt = (x, y)
+        if not RC.g2_in_subgroup(pt):
+            bad.append(pt)
+    res = np.asarray(J(C.g2_in_subgroup)(C.g2_from_ints(bad)))
+    assert not res.any()
+
+
+def test_g2_psi_and_clear_cofactor():
+    ps = rand_g2(2, with_inf=False)
+    out = C.g2_to_ints(J(C.g2_psi)(C.g2_from_ints(ps)))
+    assert out == [RC.g2_psi(p) for p in ps]
+    # cofactor clearing on arbitrary curve points
+    pts = []
+    while len(pts) < 2:
+        x = (rng.randrange(P), rng.randrange(P))
+        y2 = RF.f2_add(RF.f2_mul(RF.f2_sqr(x), x), B2)
+        y = RF.f2_sqrt(y2)
+        if y is not None:
+            pts.append((x, y))
+    out = C.g2_to_ints(J(C.g2_clear_cofactor)(C.g2_from_ints(pts)))
+    expect = [RC.g2_clear_cofactor(p) for p in pts]
+    assert out == expect
+    # results must land in the subgroup
+    assert all(RC.g2_in_subgroup(p) for p in out)
+
+
+def test_subgroup_checks_match_mul_by_r():
+    """The fast endomorphism checks agree with multiply-by-r on mixed points."""
+    pts1, pts2 = [], []
+    while len(pts1) < 6:
+        x = rng.randrange(P)
+        y = RF.fp_sqrt((x * x * x + B1) % P)
+        if y is not None:
+            pts1.append((x, y))
+    pts1.extend(rand_g1(2, with_inf=False))
+    expect = [RC.g1_mul(p, R) is None for p in pts1]
+    got = list(np.asarray(J(C.g1_in_subgroup)(C.g1_from_ints(pts1))))
+    assert got == expect
