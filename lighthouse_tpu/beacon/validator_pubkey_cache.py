@@ -1,0 +1,74 @@
+"""Append-only validator-index -> decompressed-pubkey cache.
+
+Mirror of /root/reference/beacon_node/beacon_chain/src/
+validator_pubkey_cache.rs (310 LoC): validator pubkeys are decompressed and
+subgroup-checked ONCE at registry-import time, so the per-call verify path
+never pays decompression (the reason the reference cache exists —
+validator_pubkey_cache.rs:10-23).  Validation runs as a batched device
+kernel (`bls.validate_pubkeys_kernel` — on-curve + subgroup + infinity
+rejection, the `key_validate` semantics of blst deserialization plus
+generic_public_key.rs:70-72).
+
+Persistence is a plain append-only file of 48-byte compressed keys
+(the reference appends `DatabasePubkey` items to its store); decompressed
+points are rebuilt at load.
+"""
+
+import os
+
+import numpy as np
+
+from ..crypto.ref.curves import g1_compress, g1_decompress
+from ..crypto.tpu import bls as tb
+from ..crypto.tpu import curve as cv
+
+
+class ValidatorPubkeyCache:
+    def __init__(self, path=None):
+        self._points = []          # affine int G1 points, index = validator index
+        self._path = path
+        if path and os.path.exists(path):
+            self._load()
+
+    def __len__(self):
+        return len(self._points)
+
+    def get(self, validator_index):
+        """G1 point for a validator, or None if unknown (never invalid —
+        import rejects invalid keys)."""
+        if 0 <= validator_index < len(self._points):
+            return self._points[validator_index]
+        return None
+
+    def import_new_pubkeys(self, compressed_keys):
+        """Append newly-seen validator pubkeys (48-byte each), validating
+        the whole batch on device.  Raises on any invalid key — mirroring
+        the reference's refusal to cache undecodable keys."""
+        if not compressed_keys:
+            return
+        pts = [g1_decompress(bytes(k), subgroup_check=False) for k in compressed_keys]
+        dev = cv.g1_from_ints(pts)
+        ok = np.asarray(tb._jit_validate_pk(dev))
+        if not ok.all():
+            bad = [i for i, v in enumerate(ok) if not v]
+            raise ValueError(f"invalid pubkeys at batch offsets {bad}")
+        start = len(self._points)
+        self._points.extend(pts)
+        if self._path:
+            with open(self._path, "ab") as f:
+                for p in pts:
+                    f.write(g1_compress(p))
+        return range(start, len(self._points))
+
+    def _load(self):
+        data = open(self._path, "rb").read()
+        assert len(data) % 48 == 0, "corrupt pubkey cache file"
+        self._points = [
+            g1_decompress(data[i : i + 48], subgroup_check=False)
+            for i in range(0, len(data), 48)
+        ]
+
+    def as_get_pubkey(self):
+        """Closure for the signature-set constructors
+        (block_verification.rs:1863-1895 get_signature_verifier)."""
+        return self.get

@@ -333,6 +333,39 @@ def g2_clear_cofactor(p):
     return out
 
 
+def point_tree_sum(ops, p, axis=-1):
+    """Sum a batched point over one trailing batch axis (log2 tree of adds).
+
+    The complete `add` absorbs infinity padding, so callers pad ragged point
+    lists with (1, 1, 0) — this is the per-set pubkey-aggregation reduction
+    of the batch verifier (/root/reference/crypto/bls/src/impls/blst.rs:103-107
+    does the same sum with sequential blst adds).
+    """
+    leaf = jax.tree_util.tree_leaves(p[0])[0]
+    ax = axis if axis >= 0 else leaf.ndim + axis
+    assert ax >= 1, "axis must be a batch axis (leaf axis 0 is limbs)"
+
+    def take(tree, sl):
+        return jax.tree_util.tree_map(
+            lambda x: x[(slice(None),) * ax + (sl,)], tree
+        )
+
+    n = leaf.shape[ax]
+    while n > 1:
+        m = n // 2
+        s = add(ops, take(p, slice(0, m)), take(p, slice(m, 2 * m)))
+        if n % 2:
+            rest = take(p, slice(2 * m, n))
+            p = jax.tree_util.tree_map(
+                lambda a, b: jnp.concatenate([a, b], axis=ax), s, rest
+            )
+            n = m + 1
+        else:
+            p = s
+            n = m
+    return jax.tree_util.tree_map(lambda x: jnp.squeeze(x, axis=ax), p)
+
+
 # ------------------------------------------------------------ host converters
 
 def g1_from_ints(pts):

@@ -1,14 +1,18 @@
 """Base-field (Fp, p = BLS12-381 prime) limb arithmetic in JAX.
 
-Representation: an Fp element is a ``uint32`` array of shape ``(24, *batch)``
-— 24 little-endian 16-bit limbs (the SURVEY.md §7 "24x16-bit limbs in int32"
-schedule).  All values are kept in **Montgomery form** (x·R mod p, R = 2^384)
-and fully reduced (< p) between operations.
+Representation: an Fp element is a ``uint32`` array of shape ``(48, *batch)``
+— 48 little-endian **8-bit** limbs.  All values are kept in **Montgomery
+form** (x·R mod p, R = 2^384) and fully reduced (< p) between operations.
 
-Why 24x16/uint32: a 16x16-bit limb product fits exactly in uint32; splitting
-each product into lo/hi 16-bit halves lets 24-term column sums accumulate in
-uint32 with ~9 bits of headroom, so the only sequential dependency is one
-carry-propagation scan per multiplication.  No int64 anywhere — TPU has no
+Why 48x8-bit limbs: the schoolbook product becomes a **float32 matmul**.
+An 8x8-bit limb product (< 2^16) and a 48-term antidiagonal column sum
+(< 48·2^16 < 2^24) are both exactly representable in f32, so the O(n^2)
+heart of the multiplication is one GEMM against a constant 0/1
+antidiagonal-gather matrix — which XLA lowers to the MXU on TPU (f32
+matmul) and to Eigen BLAS on CPU.  Integer dtypes would fall off the
+matrix path on both platforms (measured ~10x slower); 16-bit limbs would
+overflow the f32 mantissa.  This is the "matmul-as-bignum-mul" schedule
+anticipated by SURVEY.md §7 (hard part 1).  No int64 anywhere — TPU has no
 native 64-bit integer path.
 
 The multiplication is the SOS (separated operand scanning) Montgomery
@@ -30,8 +34,9 @@ from jax import lax
 from ..constants import P
 
 U32 = jnp.uint32
-LB = 16                      # bits per limb
-NLIMB = 24                   # 24 * 16 = 384 bits >= 381
+F32 = jnp.float32
+LB = 8                       # bits per limb
+NLIMB = 48                   # 48 * 8 = 384 bits >= 381
 MASK = np.uint32((1 << LB) - 1)
 R_BITS = NLIMB * LB          # Montgomery R = 2^384
 R_INT = 1 << R_BITS
@@ -41,13 +46,13 @@ NPRIME = (-pow(P, -1, R_INT)) % R_INT   # -p^-1 mod R
 
 
 def int_to_limbs(x: int) -> np.ndarray:
-    """Host-side: python int -> (24,) uint32 limb array (little-endian)."""
+    """Host-side: python int -> (NLIMB,) uint32 limb array (little-endian)."""
     assert 0 <= x < R_INT
-    return np.array([(x >> (LB * i)) & 0xFFFF for i in range(NLIMB)], dtype=np.uint32)
+    return np.array([(x >> (LB * i)) & int(MASK) for i in range(NLIMB)], dtype=np.uint32)
 
 
 def limbs_to_int(a) -> int:
-    """Host-side: limb array (24, *batch is NOT allowed here) -> python int."""
+    """Host-side: limb array (NLIMB, no batch) -> python int."""
     a = np.asarray(a)
     assert a.shape == (NLIMB,), a.shape
     return sum(int(v) << (LB * i) for i, v in enumerate(a))
@@ -85,9 +90,12 @@ def zeros(batch_shape=()):
 def _carry_scan(cols, n_out):
     """Propagate carries over `cols` (M, *batch), cols < 2^31.
 
-    Returns (n_out,)-limb normalized array (16-bit limbs) and the final
-    carry (anything that overflows limb n_out-1); carries are exact because
-    per-step values never exceed uint32.
+    Returns (n_out,)-limb normalized array and the final carry.  A
+    sequential `lax.scan` deliberately: measured against log-depth
+    Kogge-Stone carry-lookahead (pure elementwise ops), XLA's per-op
+    overhead made KS ~10x slower at runtime AND ~10x slower to compile on
+    CPU — one scan instance is a single compiled loop, the cheapest form
+    of this dependency chain under XLA.
     """
     init = jnp.zeros(cols.shape[1:], U32)
 
@@ -103,17 +111,15 @@ def _carry_scan(cols, n_out):
     return out[:n_out], carry
 
 
-# Constant antidiagonal-gather matrix: flat product index s = i*24+j (lo
-# half) contributes to column i+j; s + 576 (hi half) to column i+j+1.  One
-# integer contraction with this keeps the HLO op count per multiplication
-# tiny — essential because a full Miller-loop step contains ~10^2 field muls
-# and XLA compile time scales with graph size (SURVEY.md §7 hard part 2).
+# Constant antidiagonal-gather matrix: flat product index s = i*NLIMB+j
+# contributes to column i+j.  One f32 contraction with this keeps the HLO op
+# count per multiplication tiny (compile time scales with graph size,
+# SURVEY.md §7 hard part 2) and puts the O(n^2) work on the matrix units.
 def _diag_mat():
-    m = np.zeros((2 * NLIMB, 2 * NLIMB * NLIMB), dtype=np.uint32)
+    m = np.zeros((2 * NLIMB, NLIMB * NLIMB), dtype=np.float32)
     for i in range(NLIMB):
         for j in range(NLIMB):
-            m[i + j, i * NLIMB + j] = 1
-            m[i + j + 1, NLIMB * NLIMB + i * NLIMB + j] = 1
+            m[i + j, i * NLIMB + j] = 1.0
     return m
 
 
@@ -121,15 +127,23 @@ _DIAG_MAT = _diag_mat()
 
 
 def _mul_cols(a, b, n_out=2 * NLIMB):
-    """Column sums of the schoolbook product a*b.
+    """Column sums of the schoolbook product a*b — one f32 GEMM.
 
-    a, b: (24, *batch) with 16-bit limbs.  Returns (n_out, *batch) uint32
-    columns, each < 2·24·2^16 ≈ 2^22 (lo/hi split keeps uint32 exact).
+    a, b: (NLIMB, *batch) with 8-bit limbs.  Products (< 2^16) and column
+    sums (< 48·2^16 < 2^24) are exact in f32.  Returns (n_out, *batch)
+    uint32 columns.
     """
     bshape = _bshape(a, b)
-    prods = (a[:, None] * b[None, :]).reshape((NLIMB * NLIMB,) + bshape)
-    lohi = jnp.concatenate([prods & MASK, prods >> LB], axis=0)
-    return jnp.einsum("ks,s...->k...", jnp.asarray(_DIAG_MAT[:n_out]), lohi)
+    af = a.astype(F32)
+    bf = b.astype(F32)
+    prods = (af[:, None] * bf[None, :]).reshape((NLIMB * NLIMB,) + bshape)
+    cols = jnp.einsum(
+        "ks,s...->k...",
+        jnp.asarray(_DIAG_MAT[:n_out]),
+        prods,
+        preferred_element_type=F32,
+    )
+    return cols.astype(U32)
 
 
 def _add_limbs(a, b):
@@ -162,29 +176,113 @@ def _cond_sub_p(a):
 # ---------------------------------------------------------------- public ops
 
 def add(a, b):
-    s, _ = _add_limbs(a, b)       # a+b < 2p < 2^384: no carry out
-    return _cond_sub_p(s)
+    """(a + b) mod p — ONE scan computing both a+b and a+b-p (tuple carry),
+    then a lane select on the final borrow.  Fusing the conditional
+    subtraction into the same scan halves the scan-instance count of every
+    field addition — scan instances, not op cost, dominate XLA compile
+    time for the pairing graph."""
+    bshape = _bshape(a, b)
+    p_arr = jnp.broadcast_to(
+        jnp.asarray(P_LIMBS)[(...,) + (None,) * len(bshape)], (NLIMB,) + bshape
+    )
+    ab = (
+        jnp.broadcast_to(a, (NLIMB,) + bshape),
+        jnp.broadcast_to(b, (NLIMB,) + bshape),
+        p_arr,
+    )
+    init = (jnp.zeros(bshape, U32), jnp.zeros(bshape, U32))
+
+    def step(state, abp):
+        carry, borrow = state
+        ai, bi, pi = abp
+        t = ai + bi + carry
+        s_limb = t & MASK
+        need = pi + borrow
+        d = (s_limb - need) & MASK
+        new_borrow = jnp.where(s_limb < need, jnp.uint32(1), jnp.uint32(0))
+        return (t >> LB, new_borrow), (s_limb, d)
+
+    (carry_out, borrow_out), (s, d) = lax.scan(step, init, ab)
+    # a+b < 2p < 2^384 so carry_out is 0; result >= p iff borrow_out == 0
+    return jnp.where(borrow_out[None] == 0, d, s)
 
 
 def sub(a, b):
-    d, borrow = _sub_limbs(a, b)
-    fixed, _ = _add_limbs(d, jnp.asarray(P_LIMBS)[(...,) + (None,) * (d.ndim - 1)])
-    return jnp.where(borrow[None] == 0, d, fixed)
+    """(a - b) mod p — ONE scan computing both a-b and a-b+p, selected on
+    the final borrow."""
+    bshape = _bshape(a, b)
+    p_arr = jnp.broadcast_to(
+        jnp.asarray(P_LIMBS)[(...,) + (None,) * len(bshape)], (NLIMB,) + bshape
+    )
+    ab = (
+        jnp.broadcast_to(a, (NLIMB,) + bshape),
+        jnp.broadcast_to(b, (NLIMB,) + bshape),
+        p_arr,
+    )
+    init = (jnp.zeros(bshape, U32), jnp.zeros(bshape, U32))
+
+    def step(state, abp):
+        borrow, carry = state
+        ai, bi, pi = abp
+        need = bi + borrow
+        d = (ai - need) & MASK
+        new_borrow = jnp.where(ai < need, jnp.uint32(1), jnp.uint32(0))
+        t = d + pi + carry
+        f = t & MASK
+        return (new_borrow, t >> LB), (d, f)
+
+    (borrow_out, _), (d, f) = lax.scan(step, init, ab)
+    return jnp.where(borrow_out[None] == 0, d, f)
 
 
 def neg(a):
     return sub(zeros(a.shape[1:]), a)
 
 
+def _fold(cols, n_out):
+    """One redundant carry fold: limbs' high bytes shift up one position.
+
+    Truncation at n_out = mod 2^(LB*n_out).  No carry chain — O(1) depth.
+    """
+    lo = cols & MASK
+    hi = cols >> LB
+    shifted = jnp.concatenate(
+        [jnp.zeros((1,) + cols.shape[1:], U32), hi[: n_out - 1]], axis=0
+    )
+    return lo[:n_out] + shifted
+
+
+def _fold3(cols, n_out):
+    """Three-byte redundant fold for columns < 2^24: limbs end <= 765."""
+    b0 = cols & MASK
+    b1 = (cols >> LB) & MASK
+    b2 = cols >> (2 * LB)
+    z1 = jnp.zeros((1,) + cols.shape[1:], U32)
+    z2 = jnp.zeros((2,) + cols.shape[1:], U32)
+    s1 = jnp.concatenate([z1, b1[: n_out - 1]], axis=0)
+    s2 = jnp.concatenate([z2, b2[: n_out - 2]], axis=0)
+    return b0[:n_out] + s1 + s2
+
+
 def mont_mul(a, b):
-    """Montgomery product a·b·R^-1 mod p (SOS method)."""
-    t, _ = _carry_scan(_mul_cols(a, b), 2 * NLIMB)            # a*b, 48 limbs
-    np_arr = jnp.asarray(NPRIME_LIMBS)[(...,) + (None,) * (t.ndim - 1)]
-    m, _ = _carry_scan(_mul_cols(t[:NLIMB], np_arr, NLIMB), NLIMB)   # low half
-    p_arr = jnp.asarray(P_LIMBS)[(...,) + (None,) * (t.ndim - 1)]
-    u = _mul_cols(m, p_arr) + t                               # t + m*p, cols < 2^23
+    """Montgomery product a·b·R^-1 mod p (SOS method).
+
+    Two `lax.scan`s only: the Montgomery quotient m never needs normalized
+    limbs — it is kept in a REDUNDANT fold form (limbs <= 257, value <
+    1.008·R), which keeps every downstream f32 product exact (257·255 <
+    2^16, column sums < 2^23) and bounds the result at u/R < p²/R +
+    1.008·p < 1.22·p, so the single conditional subtraction still returns
+    a fully-reduced value.  Inputs must be fully reduced (< p), which all
+    public ops maintain.
+    """
+    cols_t = _mul_cols(a, b)                                  # 96 cols < 2^22
+    t_red = _fold(_fold3(cols_t, NLIMB), NLIMB)               # == t mod R, limbs <= 257
+    np_arr = jnp.asarray(NPRIME_LIMBS)[(...,) + (None,) * (cols_t.ndim - 1)]
+    m_red = _fold(_fold3(_mul_cols(t_red, np_arr, NLIMB), NLIMB), NLIMB)
+    p_arr = jnp.asarray(P_LIMBS)[(...,) + (None,) * (cols_t.ndim - 1)]
+    u = _mul_cols(m_red, p_arr) + cols_t                      # cols < 2^23
     full, _ = _carry_scan(u, 2 * NLIMB)                       # divisible by R
-    return _cond_sub_p(full[NLIMB:])                          # (t + m*p)/R < 2p
+    return _cond_sub_p(full[NLIMB:])                          # (t + m*p)/R < 1.22p
 
 
 def mont_sqr(a):

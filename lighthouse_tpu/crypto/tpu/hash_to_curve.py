@@ -1,0 +1,236 @@
+"""Hash-to-G2 (BLS12381G2_XMD:SHA-256_SSWU_RO_) — device field pipeline.
+
+Split exactly where the data changes character:
+  * `expand_message_xmd` / `hash_to_field` stay on the **host** (SHA-256 is
+    byte-twiddling the TPU has no business doing; the reference reaches it
+    through blst's C code, /root/reference/crypto/bls/src/impls/blst.rs:15).
+    Output: Fp2 field elements as limb arrays, batched over messages.
+  * Everything after — simplified SWU onto the 3-isogenous curve, the
+    3-isogeny back to E2', and psi-based cofactor clearing — is pure field
+    arithmetic and runs **on device**, fully batched and branchless.
+
+Division-free by construction: SSWU keeps x as a fraction (xn/xd), the
+isogeny is evaluated on fractions (numerator/denominator Horner pairs), and
+the result materializes directly in Jacobian coordinates
+(X, Y, Z) = (Nx*Dx*Dy^2, y*Ny*Dx^3*Dy^2, Dx*Dy) — no field inversion
+anywhere on the hash path.
+
+The square-root dispatch (RFC 9380 sqrt_ratio, q = p^2 ≡ 9 mod 16) is
+branchless: one fixed-exponent scan produces the candidate root
+y0 = u*v^7*(u*v^15)^((q-9)/16), whose square differs from u/v by an 8th
+root of unity; all 8 correction constants (4 square-branch 1/nu, 4
+nonsquare-branch sqrt(Z/mu)) are derived at import via the oracle and the
+right one is lane-selected by testing (y0*k)^2*v against u and Z*u.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..constants import (
+    P,
+    H2C_A,
+    H2C_B,
+    H2C_Z,
+    ISO3_XNUM,
+    ISO3_XDEN,
+    ISO3_YNUM,
+    ISO3_YDEN,
+    DST_POP,
+)
+from ..ref import fields as RF
+from ..ref.hash_to_curve import hash_to_field_fp2
+from . import fp
+from . import tower as tw
+from . import curve as cv
+
+# ----------------------------------------------------- sqrt_ratio constants
+
+_Q = P * P
+assert _Q % 16 == 9
+_SQRT_EXP = (_Q - 9) // 16
+
+# 8th roots of unity in Fp2 and the correction tables (host-derived; a wrong
+# constant cannot survive the differential tests).
+_I = (0, 1)                                   # sqrt(-1)
+_S = RF.f2_sqrt(_I)                           # sqrt(i): generator of C8
+_C8 = [(1, 0)]
+for _ in range(7):
+    _C8.append(RF.f2_mul(_C8[-1], _S))
+_C4 = {(1, 0), _I, (P - 1, 0), RF.f2_neg(_I)}
+
+# Square branch: candidates c = 1/nu, nu in {1, s, i, i*s}, covering
+# mu = c^-2 in {1, i, -1, -i}.
+_CAND_SQ = [
+    (1, 0),
+    RF.f2_inv(_I),
+    RF.f2_inv(_S),
+    RF.f2_inv(RF.f2_mul(_I, _S)),
+]
+# Nonsquare branch: d = sqrt(Z/mu) for the four nonsquare 8th roots mu.
+_MU_NONSQ = [m for m in _C8 if m not in _C4]
+_CAND_NSQ = [RF.f2_sqrt(RF.f2_mul(H2C_Z, RF.f2_inv(m))) for m in _MU_NONSQ]
+assert all(c is not None for c in _CAND_NSQ)
+
+
+def _f2c(v, bshape):
+    return tw.f2_const(v[0], v[1], batch_shape=bshape)
+
+
+def sqrt_ratio(u, v):
+    """RFC 9380 sqrt_ratio for Fp2: (is_square, y).
+
+    y = sqrt(u/v) when u/v is square, else sqrt(Z*u/v).  Batched and
+    branchless; `v` must be nonzero (guaranteed by the SSWU caller).
+    """
+    bshape = u[0].shape[1:]
+    mm = lambda xs, ys: fp.tunstack(tw.f2_mul(fp.tstack(xs), fp.tstack(ys)), len(xs))
+
+    [v2] = mm([v], [v])
+    v4, v3 = mm([v2, v2], [v2, v])
+    v7, v8 = mm([v4, v4], [v3, v4])
+    [uv7] = mm([u], [v7])
+    [uv15] = mm([uv7], [v8])
+    y0_base = tw.f2_pow(uv15, _SQRT_EXP)           # (u*v^15)^((q-9)/16)
+    [y0] = mm([uv7], [y0_base])                    # u*v^7*(u*v^15)^m
+
+    cands = _CAND_SQ + _CAND_NSQ
+    ys = mm([y0] * 8, [_f2c(c, bshape) for c in cands])
+    y2s = fp.tunstack(tw.f2_sqr(fp.tstack(ys)), 8)
+    y2vs = mm(y2s, [v] * 8)
+    [zu] = mm([_f2c(H2C_Z, bshape)], [u])
+    matches = [
+        tw.f2_eq(y2v, u if j < 4 else zu) for j, y2v in enumerate(y2vs)
+    ]
+    # exactly one candidate matches generically; u == 0 matches several in
+    # the square branch but all give y = 0, and first-match select is stable.
+    y = tw.f2_zero(bshape)
+    taken = jnp.zeros(bshape, bool)
+    for m, yc in zip(matches, ys):
+        pick = m & ~taken
+        y = tw.f2_select(pick, yc, y)
+        taken = taken | m
+    is_square = matches[0] | matches[1] | matches[2] | matches[3]
+    return is_square, y
+
+
+# ------------------------------------------------------------------- sgn0
+
+def sgn0(a):
+    """RFC 9380 sgn0 for Fp2 (m=2): parity of the canonical representation."""
+    c0, c1 = fp.funstack(fp.from_mont(fp.fstack([a[0], a[1]])))
+    s0 = (c0[0] & 1).astype(bool)
+    s1 = (c1[0] & 1).astype(bool)
+    z0 = fp.is_zero(c0)
+    return jnp.where(z0, s1, s0)
+
+
+# ------------------------------------------------------------------- SSWU
+
+def sswu_fraction(u):
+    """Simplified SWU onto E2' (RFC 9380 F.2, division-free).
+
+    Returns (xn, xd, y): affine x = xn/xd on the isogenous curve, y exact.
+    """
+    bshape = u[0].shape[1:]
+    A = _f2c(H2C_A, bshape)
+    B = _f2c(H2C_B, bshape)
+    Z = _f2c(H2C_Z, bshape)
+    mm = lambda xs, ys: fp.tunstack(tw.f2_mul(fp.tstack(xs), fp.tstack(ys)), len(xs))
+
+    tv1 = tw.f2_sqr(u)
+    [tv1] = mm([Z], [tv1])                        # Z u^2
+    tv2 = tw.f2_add(tw.f2_sqr(tv1), tv1)          # Z^2u^4 + Zu^2
+    tv3_in = tw.f2_add(tv2, tw.f2_one(bshape))
+    tv4_sel = tw.f2_select(tw.f2_is_zero(tv2), Z, tw.f2_neg(tv2))
+    tv3, tv4 = mm([B, A], [tv3_in, tv4_sel])
+    tv2q, tv6 = mm([tv3, tv4], [tv3, tv4])        # tv3^2, tv4^2
+    tv5, x1n = mm([A, tv1], [tv6, tv3])           # A tv4^2 ; x2 numer = tv1*tv3
+    tv2q = tw.f2_add(tv2q, tv5)
+    gnum_a, tv6 = mm([tv2q, tv6], [tv3, tv4])     # (tv3^2+A tv4^2) tv3 ; tv4^3
+    [tv5b] = mm([B], [tv6])
+    gnum = tw.f2_add(gnum_a, tv5b)                # gx1 numerator
+    is_sq, y1 = sqrt_ratio(gnum, tv6)
+
+    [uy] = mm([tv1], [u])                         # Z u^3
+    [y2] = mm([uy], [y1])
+    xn = tw.f2_select(is_sq, tv3, x1n)
+    y = tw.f2_select(is_sq, y1, y2)
+    flip = sgn0(u) != sgn0(y)
+    y = tw.f2_select(flip, tw.f2_neg(y), y)
+    return xn, tv4, y
+
+
+# ------------------------------------------------------------------ isogeny
+
+def _horner_frac(coeffs, xn_pows, xd_pows, deg, bshape):
+    """sum coeffs[i] * xn^i * xd^(deg-i) as one stacked multiply chain."""
+    mm = lambda xs, ys: fp.tunstack(tw.f2_mul(fp.tstack(xs), fp.tstack(ys)), len(xs))
+    terms_in = [
+        mmv for mmv in mm(
+            [xn_pows[i] for i in range(len(coeffs))],
+            [xd_pows[deg - i] for i in range(len(coeffs))],
+        )
+    ]
+    scaled = mm(terms_in, [_f2c(c, bshape) for c in coeffs])
+    acc = scaled[0]
+    for t in scaled[1:]:
+        acc = tw.f2_add(acc, t)
+    return acc
+
+
+def iso3_map_jacobian(xn, xd, y):
+    """3-isogeny E2' -> E2 on fractions, emitting Jacobian coordinates."""
+    bshape = xn[0].shape[1:]
+    mm = lambda xs, ys: fp.tunstack(tw.f2_mul(fp.tstack(xs), fp.tstack(ys)), len(xs))
+
+    xn2, xd2 = mm([xn, xd], [xn, xd])
+    xn3, xd3 = mm([xn2, xd2], [xn, xd])
+    one = tw.f2_one(bshape)
+    xn_pows = [one, xn, xn2, xn3]
+    xd_pows = [one, xd, xd2, xd3]
+
+    Nx = _horner_frac(ISO3_XNUM, xn_pows, xd_pows, 3, bshape)
+    Dxp = _horner_frac(ISO3_XDEN, xn_pows, xd_pows, 2, bshape)
+    Ny = _horner_frac(ISO3_YNUM, xn_pows, xd_pows, 3, bshape)
+    Dy = _horner_frac(ISO3_YDEN, xn_pows, xd_pows, 3, bshape)
+
+    [Dx] = mm([xd], [Dxp])                        # full x denominator
+    Dy2, Dx2 = mm([Dy, Dx], [Dy, Dx])
+    DxDy2, yNy, Dx3 = mm([Dx, y, Dx2], [Dy2, Ny, Dx])
+    X, t = mm([Nx, yNy], [DxDy2, Dy2])
+    [Y] = mm([t], [Dx3])
+    Zj = mm([Dx], [Dy])[0]
+    return (X, Y, Zj)
+
+
+def map_to_curve_g2(u):
+    """Full SSWU + isogeny: Fp2 element -> Jacobian point on E2."""
+    xn, xd, y = sswu_fraction(u)
+    return iso3_map_jacobian(xn, xd, y)
+
+
+# ------------------------------------------------------------ full pipeline
+
+def hash_to_g2_device(u0, u1):
+    """Device part: two field elements -> one G2 (subgroup) Jacobian point."""
+    p0 = map_to_curve_g2(u0)
+    p1 = map_to_curve_g2(u1)
+    r = cv.add(cv.F2_OPS, p0, p1)
+    return cv.g2_clear_cofactor(r)
+
+
+def hash_to_field_host(msgs, dst=DST_POP):
+    """Host: list of byte-strings -> two batched device Fp2 elements."""
+    us = [hash_to_field_fp2(m, 2, dst) for m in msgs]
+    def dev(vals):
+        c0 = fp.to_mont(jnp.asarray(fp.ints_to_array([v[0] for v in vals])))
+        c1 = fp.to_mont(jnp.asarray(fp.ints_to_array([v[1] for v in vals])))
+        return (c0, c1)
+    return dev([u[0] for u in us]), dev([u[1] for u in us])
+
+
+def hash_to_g2(msgs, dst=DST_POP):
+    """Host+device: messages -> batched Jacobian G2 points."""
+    u0, u1 = hash_to_field_host(msgs, dst)
+    return hash_to_g2_device(u0, u1)

@@ -1,0 +1,6 @@
+"""Fork choice (LMD-GHOST) — mirror of /root/reference/consensus/proto_array
+and /root/reference/consensus/fork_choice (SURVEY.md §2.4)."""
+
+from .proto_array import ProtoArrayForkChoice, ProtoNode
+
+__all__ = ["ProtoArrayForkChoice", "ProtoNode"]

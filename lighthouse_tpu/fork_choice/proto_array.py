@@ -1,0 +1,292 @@
+"""Array-backed LMD-GHOST fork choice (proto-array).
+
+Mirror of /root/reference/consensus/proto_array/src/proto_array.rs and
+proto_array_fork_choice.rs (~6.2k LoC of Rust): an append-only node array
+over the block DAG where each node caches `best_child`/`best_descendant`,
+so `find_head` is O(depth) pointer-chasing and vote application is one
+backward pass of weight deltas (`apply_score_changes`).
+
+Semantics covered: latest-message votes with balance deltas
+(`VoteTracker`, proto_array_fork_choice.rs), justification/finalization
+viability filtering (`node_leads_to_viable_head`), proposer boost
+(spec `get_proposer_score`), and finalization pruning.  Execution-status
+invalidation (Bellatrix optimistic sync) is tracked as a per-node flag with
+`InvalidateOne`-style propagation; the engine-API plumbing that drives it
+lives above this layer.
+"""
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ProtoNode:
+    root: bytes
+    parent: int | None           # index into the array
+    justified_epoch: int
+    finalized_epoch: int
+    slot: int = 0
+    weight: int = 0
+    best_child: int | None = None
+    best_descendant: int | None = None
+    invalid: bool = False        # execution-status invalidated
+
+
+@dataclass
+class VoteTracker:
+    current_root: bytes = b""
+    next_root: bytes = b""
+    next_epoch: int = 0
+
+
+class ProtoArrayForkChoice:
+    def __init__(
+        self,
+        finalized_root: bytes,
+        justified_epoch: int = 0,
+        finalized_epoch: int = 0,
+        finalized_slot: int = 0,
+    ):
+        self.nodes: list[ProtoNode] = []
+        self.indices: dict[bytes, int] = {}
+        self.votes: dict[int, VoteTracker] = {}      # validator index -> tracker
+        self.balances: dict[int, int] = {}           # effective balances used last pass
+        self.justified_epoch = justified_epoch
+        self.finalized_epoch = finalized_epoch
+        self.proposer_boost_root: bytes | None = None
+        self.proposer_boost_amount = 0
+        self.on_block(
+            finalized_root, None, justified_epoch, finalized_epoch, finalized_slot
+        )
+
+    # ------------------------------------------------------------- blocks
+
+    def on_block(self, root, parent_root, justified_epoch, finalized_epoch, slot=0):
+        """proto_array.rs on_block: append a node, link parent, update bests."""
+        if root in self.indices:
+            return
+        parent = self.indices.get(parent_root) if parent_root is not None else None
+        idx = len(self.nodes)
+        node = ProtoNode(
+            root=root,
+            parent=parent,
+            justified_epoch=justified_epoch,
+            finalized_epoch=finalized_epoch,
+            slot=slot,
+        )
+        self.nodes.append(node)
+        self.indices[root] = idx
+        if parent is not None:
+            self._maybe_update_best_child_and_descendant(parent, idx)
+
+    def contains_block(self, root):
+        return root in self.indices
+
+    # -------------------------------------------------------------- votes
+
+    def process_attestation(self, validator_index, block_root, target_epoch):
+        """fork_choice.rs on_attestation -> VoteTracker next_* update
+        (latest-message-driven: newer target epoch wins)."""
+        vote = self.votes.setdefault(validator_index, VoteTracker())
+        if target_epoch > vote.next_epoch:
+            vote.next_root = block_root
+            vote.next_epoch = target_epoch
+
+    # --------------------------------------------------------- find_head
+
+    def find_head(self, justified_root, justified_balances, justified_epoch=None,
+                  finalized_epoch=None, proposer_boost_root=None,
+                  proposer_boost_amount=0):
+        """proto_array_fork_choice.rs:444 find_head: apply pending vote
+        deltas then chase best_descendant from the justified root."""
+        if justified_epoch is not None:
+            self.justified_epoch = justified_epoch
+        if finalized_epoch is not None:
+            self.finalized_epoch = finalized_epoch
+        self.proposer_boost_root = proposer_boost_root
+        self.proposer_boost_amount = proposer_boost_amount
+
+        deltas = self._compute_deltas(justified_balances)
+        self._apply_score_changes(deltas)
+
+        start = self.indices.get(justified_root)
+        if start is None:
+            raise KeyError(f"unknown justified root {justified_root.hex()}")
+        node = self.nodes[start]
+        best = node.best_descendant
+        head = self.nodes[best] if best is not None else node
+        if not self._node_is_viable_for_head(head):
+            raise RuntimeError("best node is not viable for head")
+        return head.root
+
+    # ---------------------------------------------------------- internals
+
+    def _compute_deltas(self, new_balances):
+        """proto_array_fork_choice.rs compute_deltas: move each changed
+        vote's old balance off current_root and new balance onto next_root."""
+        deltas = [0] * len(self.nodes)
+        for v, vote in self.votes.items():
+            old_bal = self.balances.get(v, 0)
+            new_bal = new_balances.get(v, 0)
+            if vote.current_root != vote.next_root or old_bal != new_bal:
+                cur = self.indices.get(vote.current_root)
+                if cur is not None:
+                    deltas[cur] -= old_bal
+                nxt = self.indices.get(vote.next_root)
+                if nxt is not None:
+                    deltas[nxt] += new_bal
+                vote.current_root = vote.next_root
+        self.balances = dict(new_balances)
+        return deltas
+
+    def _proposer_boost(self, idx):
+        if (
+            self.proposer_boost_root is not None
+            and self.nodes[idx].root == self.proposer_boost_root
+        ):
+            return self.proposer_boost_amount
+        return 0
+
+    def _apply_score_changes(self, deltas):
+        """proto_array.rs apply_score_changes — TWO backward passes: all
+        weight deltas first (with back-propagation to parent deltas), then
+        best_child/best_descendant re-evaluation over a fully coherent set
+        of weights (proto_array.rs:283-299 'we _must_ perform these
+        functions separate')."""
+        boost = [self._proposer_boost(i) for i in range(len(self.nodes))]
+        if not hasattr(self, "_prev_boost"):
+            self._prev_boost = [0] * len(self.nodes)
+        self._prev_boost += [0] * (len(self.nodes) - len(self._prev_boost))
+        for i in range(len(self.nodes)):
+            deltas[i] += boost[i] - self._prev_boost[i]
+        self._prev_boost = boost
+
+        for i in range(len(self.nodes) - 1, -1, -1):
+            node = self.nodes[i]
+            d = deltas[i]
+            if node.invalid:
+                d = -node.weight            # invalid nodes pin to zero weight
+            node.weight += d
+            if node.weight < 0:
+                raise RuntimeError("negative node weight")
+            if node.parent is not None:
+                deltas[node.parent] += d
+
+        for i in range(len(self.nodes) - 1, -1, -1):
+            node = self.nodes[i]
+            if node.parent is not None:
+                self._maybe_update_best_child_and_descendant(node.parent, i)
+
+    def _node_is_viable_for_head(self, node):
+        """proto_array.rs node_is_viable_for_head: justified/finalized epochs
+        must match the store's (or be genesis defaults), and the node must
+        not be execution-invalidated."""
+        if node.invalid:
+            return False
+        j_ok = node.justified_epoch == self.justified_epoch or self.justified_epoch == 0
+        f_ok = node.finalized_epoch == self.finalized_epoch or self.finalized_epoch == 0
+        return j_ok and f_ok
+
+    def _node_leads_to_viable_head(self, node):
+        if node.best_descendant is not None:
+            return self._node_is_viable_for_head(self.nodes[node.best_descendant])
+        return self._node_is_viable_for_head(node)
+
+    def _maybe_update_best_child_and_descendant(self, parent_idx, child_idx):
+        """proto_array.rs maybe_update_best_child_and_descendant — the four
+        case analysis: adopt child / keep current / compare weights."""
+        parent = self.nodes[parent_idx]
+        child = self.nodes[child_idx]
+        child_leads = self._node_leads_to_viable_head(child)
+
+        def adopt():
+            parent.best_child = child_idx
+            parent.best_descendant = (
+                child.best_descendant if child.best_descendant is not None else child_idx
+            )
+
+        def clear():
+            parent.best_child = None
+            parent.best_descendant = None
+
+        if parent.best_child is None:
+            if child_leads:
+                adopt()
+            return
+        if parent.best_child == child_idx:
+            if not child_leads:
+                clear()
+                # try to find another viable child
+                for j, n in enumerate(self.nodes):
+                    if n.parent == parent_idx and j != child_idx and \
+                            self._node_leads_to_viable_head(n):
+                        parent.best_child = j
+                        parent.best_descendant = (
+                            n.best_descendant if n.best_descendant is not None else j
+                        )
+                        break
+            else:
+                adopt()
+            return
+        current_best = self.nodes[parent.best_child]
+        current_leads = self._node_leads_to_viable_head(current_best)
+        if child_leads and not current_leads:
+            adopt()
+        elif child_leads and current_leads:
+            # weight tie-break: higher weight wins; tie -> higher root bytes
+            if child.weight > current_best.weight or (
+                child.weight == current_best.weight and child.root >= current_best.root
+            ):
+                adopt()
+
+    # ---------------------------------------------------------- pruning
+
+    def prune(self, new_finalized_root):
+        """proto_array.rs maybe_prune: drop everything not descended from
+        the new finalized root and reindex."""
+        if new_finalized_root not in self.indices:
+            raise KeyError("unknown finalized root")
+        keep = set()
+        fin_idx = self.indices[new_finalized_root]
+        for i, n in enumerate(self.nodes):
+            j = i
+            chain = []
+            while j is not None and j not in keep and j != fin_idx:
+                chain.append(j)
+                j = self.nodes[j].parent
+            if j is not None:  # reached finalized root or kept set
+                keep.update(chain)
+        keep.add(fin_idx)
+        old_to_new = {}
+        new_nodes = []
+        for i in sorted(keep):
+            old_to_new[i] = len(new_nodes)
+            new_nodes.append(self.nodes[i])
+        for n in new_nodes:
+            n.parent = old_to_new.get(n.parent) if n.parent in old_to_new else None
+            n.best_child = old_to_new.get(n.best_child)
+            n.best_descendant = old_to_new.get(n.best_descendant)
+        self.nodes = new_nodes
+        self.indices = {n.root: i for i, n in enumerate(new_nodes)}
+        self._prev_boost = [0] * len(new_nodes)
+
+    # ---------------------------------------------------------- invalidation
+
+    def invalidate_block(self, root, invalidate_descendants=True):
+        """Execution-layer invalidation (proto_array.rs InvalidationOperation
+        InvalidateOne + descendant propagation)."""
+        if root not in self.indices:
+            return
+        target = self.indices[root]
+        self.nodes[target].invalid = True
+        if invalidate_descendants:
+            for i, n in enumerate(self.nodes):
+                j = n.parent
+                while j is not None:
+                    if j == target:
+                        n.invalid = True
+                        break
+                    j = self.nodes[j].parent
+        # force best-child re-evaluation along the whole array
+        for i, n in enumerate(self.nodes):
+            if n.parent is not None:
+                self._maybe_update_best_child_and_descendant(n.parent, i)

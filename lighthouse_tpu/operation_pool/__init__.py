@@ -1,0 +1,13 @@
+"""Operation pool — attestation/slashing/exit pools for block production.
+
+Mirror of /root/reference/beacon_node/operation_pool (SURVEY.md §2.5):
+greedy weighted maximum-coverage attestation packing (max_cover.rs +
+AttMaxCover in attestation.rs), naive aggregation of compatible
+attestations, and simple dedup pools for slashings/exits with validity
+re-checks at extraction time.
+"""
+
+from .max_cover import MaxCoverItem, maximum_cover
+from .pool import OperationPool
+
+__all__ = ["MaxCoverItem", "maximum_cover", "OperationPool"]

@@ -1,0 +1,45 @@
+"""Greedy approximate maximum-coverage (max_cover.rs).
+
+Classic (1 - 1/e)-approximation: repeatedly take the item whose covering
+set adds the most marginal weight, then subtract its cover from the rest.
+"""
+
+
+class MaxCoverItem:
+    """An item proposing to cover a weighted set of elements.
+
+    cover: dict element -> weight (AttMaxCover's fresh_validators_rewards).
+    obj: the underlying object extracted into the solution.
+    """
+
+    def __init__(self, obj, cover):
+        self.obj = obj
+        self.cover = dict(cover)
+
+    def score(self):
+        return sum(self.cover.values())
+
+
+def maximum_cover(items, limit):
+    """max_cover.rs maximum_cover: greedy select up to `limit` items."""
+    work = [MaxCoverItem(i.obj, i.cover) for i in items]
+    available = [True] * len(work)
+    solution = []
+    for _ in range(min(limit, len(work))):
+        best_i, best_score = None, 0
+        for i, (w, ok) in enumerate(zip(work, available)):
+            if ok:
+                s = w.score()
+                if s > best_score:
+                    best_i, best_score = i, s
+        if best_i is None:
+            break
+        chosen = work[best_i]
+        available[best_i] = False
+        solution.append(chosen)
+        covered = set(chosen.cover)
+        for i, (w, ok) in enumerate(zip(work, available)):
+            if ok:
+                for el in covered:
+                    w.cover.pop(el, None)
+    return solution

@@ -1,0 +1,161 @@
+"""The operation pool proper (operation_pool/src/lib.rs).
+
+Holds gossip-verified operations between blocks and packs them for block
+production: `get_attestations` runs weighted max-cover over per-committee
+aggregates (lib.rs:248,330); slashings/exits dedup on the offending index
+and re-check slashability at extraction.
+"""
+
+from collections import defaultdict
+
+from ..ssz import hash_tree_root
+from ..state_processing import phase0 as sp
+from .max_cover import MaxCoverItem, maximum_cover
+
+
+def _bits_or(a, b):
+    return [x | y for x, y in zip(a, b)]
+
+
+def _bits_overlap(a, b):
+    return any(x & y for x, y in zip(a, b))
+
+
+class OperationPool:
+    def __init__(self, spec):
+        self.spec = spec
+        # keyed by attestation data root -> list of (bits, attestation)
+        self.attestations = defaultdict(list)
+        self.proposer_slashings = {}      # proposer index -> slashing
+        self.attester_slashings = []
+        self.voluntary_exits = {}         # validator index -> signed exit
+
+    # ---------------------------------------------------------- insertion
+
+    def insert_attestation(self, attestation):
+        """Naive aggregation: merge into an existing compatible aggregate
+        when bitsets are disjoint (naive_aggregation_pool.rs semantics),
+        else store alongside."""
+        from ..crypto.ref import bls as RB
+        from ..crypto.ref.curves import g2_compress, g2_decompress
+
+        key = hash_tree_root(attestation.data)
+        bits = list(attestation.aggregation_bits)
+        for entry in self.attestations[key]:
+            if not _bits_overlap(entry["bits"], bits):
+                agg = RB.aggregate(
+                    [
+                        g2_decompress(bytes(entry["att"].signature), subgroup_check=False),
+                        g2_decompress(bytes(attestation.signature), subgroup_check=False),
+                    ]
+                )
+                entry["att"].aggregation_bits = _bits_or(entry["bits"], bits)
+                entry["att"].signature = g2_compress(agg)
+                entry["bits"] = list(entry["att"].aggregation_bits)
+                return
+        self.attestations[key].append(
+            {"bits": bits, "att": attestation.copy()}
+        )
+
+    def insert_proposer_slashing(self, slashing):
+        self.proposer_slashings[
+            slashing.signed_header_1.message.proposer_index
+        ] = slashing
+
+    def insert_attester_slashing(self, slashing):
+        self.attester_slashings.append(slashing)
+
+    def insert_voluntary_exit(self, signed_exit):
+        self.voluntary_exits[signed_exit.message.validator_index] = signed_exit
+
+    # ---------------------------------------------------------- extraction
+
+    def get_attestations(self, state, preset):
+        """Weighted max-cover packing (lib.rs get_attestations + AttMaxCover):
+        cover = attesting validators not yet covered, weighted by base
+        reward; prev/current epoch packed separately then concatenated."""
+        current_epoch = sp.get_current_epoch(state, preset)
+        prev_epoch = sp.get_previous_epoch(state, preset)
+        items_cur, items_prev = [], []
+        for entries in self.attestations.values():
+            for entry in entries:
+                att = entry["att"]
+                data = att.data
+                if data.target.epoch not in (prev_epoch, current_epoch):
+                    continue
+                if not (
+                    data.slot + sp.MIN_ATTESTATION_INCLUSION_DELAY
+                    <= state.slot
+                    <= data.slot + preset.slots_per_epoch
+                ):
+                    continue
+                try:
+                    indices = sp.get_attesting_indices(
+                        state, data, entry["bits"], preset
+                    )
+                except AssertionError:
+                    continue
+                fresh = {
+                    i: state.validators[i].effective_balance
+                    for i in indices
+                    if not state.validators[i].slashed
+                }
+                if not fresh:
+                    continue
+                item = MaxCoverItem(att, fresh)
+                (items_cur if data.target.epoch == current_epoch else items_prev).append(
+                    item
+                )
+        limit = preset.max_attestations
+        prev_cover = maximum_cover(items_prev, limit)
+        cur_cover = maximum_cover(items_cur, limit - len(prev_cover))
+        return [c.obj for c in prev_cover + cur_cover][:limit]
+
+    def get_slashings_and_exits(self, state, preset):
+        epoch = sp.get_current_epoch(state, preset)
+        proposer_slashings = [
+            s
+            for i, s in self.proposer_slashings.items()
+            if sp.is_slashable_validator(state.validators[i], epoch)
+        ][: preset.max_proposer_slashings]
+        attester_slashings = []
+        covered = set()
+        for s in self.attester_slashings:
+            both = set(s.attestation_1.attesting_indices) & set(
+                s.attestation_2.attesting_indices
+            )
+            fresh = {
+                i
+                for i in both
+                if sp.is_slashable_validator(state.validators[i], epoch)
+            } - covered
+            if fresh and len(attester_slashings) < preset.max_attester_slashings:
+                attester_slashings.append(s)
+                covered |= fresh
+        exits = [
+            e
+            for i, e in self.voluntary_exits.items()
+            if sp.is_active_validator(state.validators[i], epoch)
+            and state.validators[i].exit_epoch == sp.FAR_FUTURE_EPOCH
+        ][: preset.max_voluntary_exits]
+        return proposer_slashings, attester_slashings, exits
+
+    def prune(self, state, preset):
+        """Drop operations that can no longer be included (persistence.rs
+        prune_all semantics)."""
+        current_epoch = sp.get_current_epoch(state, preset)
+        for key in list(self.attestations):
+            kept = [
+                e
+                for e in self.attestations[key]
+                if e["att"].data.target.epoch + 1 >= current_epoch
+            ]
+            if kept:
+                self.attestations[key] = kept
+            else:
+                del self.attestations[key]
+        self.voluntary_exits = {
+            i: e
+            for i, e in self.voluntary_exits.items()
+            if state.validators[i].exit_epoch == sp.FAR_FUTURE_EPOCH
+        }

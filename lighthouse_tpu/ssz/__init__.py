@@ -1,0 +1,47 @@
+"""SimpleSerialize (SSZ) encode/decode + Merkleization.
+
+Mirror of the reference's L1 serialization layer
+(/root/reference/consensus/ssz, ssz_types, tree_hash — SURVEY.md §2.2):
+`Encode`/`Decode` become `encode`/`decode` over declarative type descriptors,
+`FixedVector`/`VariableList`/`Bitfield` become `Vector`/`List`/`Bitvector`/
+`Bitlist`, and `TreeHash::tree_hash_root` becomes `hash_tree_root`.
+
+Host-side by design: SSZ is byte-twiddling and belongs on CPU; the TPU
+kernels only ever see 32-byte roots (signing roots) and decompressed
+points, exactly like blst does in the reference
+(generic_signature_set.rs:71 — messages are pre-hashed Hash256).
+"""
+
+from .core import (
+    Boolean,
+    ByteList,
+    ByteVector,
+    Bitlist,
+    Bitvector,
+    Container,
+    List,
+    SSZType,
+    Uint,
+    Vector,
+    decode,
+    encode,
+    uint8,
+    uint16,
+    uint32,
+    uint64,
+    uint128,
+    uint256,
+    Bytes4,
+    Bytes20,
+    Bytes32,
+    Bytes48,
+    Bytes96,
+)
+from .hash import hash_tree_root
+
+__all__ = [
+    "Boolean", "ByteList", "ByteVector", "Bitlist", "Bitvector", "Container",
+    "List", "SSZType", "Uint", "Vector", "decode", "encode", "uint8",
+    "uint16", "uint32", "uint64", "uint128", "uint256", "Bytes4", "Bytes20",
+    "Bytes32", "Bytes48", "Bytes96", "hash_tree_root",
+]

@@ -1,0 +1,443 @@
+"""SSZ type descriptors and (de)serialization.
+
+Values are plain Python: ints for uints/bools-as-ints, `bytes` for byte
+types, lists for vectors/lists/bitfields (bits as 0/1 ints), and Container
+instances for containers.  Type descriptors are lightweight objects carrying
+the SSZ schema, mirroring how the reference derives Encode/Decode
+(/root/reference/consensus/ssz_derive) and typenum-parameterized
+FixedVector/VariableList (/root/reference/consensus/ssz_types).
+"""
+
+BYTES_PER_LENGTH_OFFSET = 4
+
+
+class DecodeError(Exception):
+    pass
+
+
+class SSZType:
+    def is_fixed_size(self):
+        raise NotImplementedError
+
+    def fixed_size(self):
+        """Byte length if fixed-size, else BYTES_PER_LENGTH_OFFSET."""
+        raise NotImplementedError
+
+    def serialize(self, value) -> bytes:
+        raise NotImplementedError
+
+    def deserialize(self, data: bytes):
+        raise NotImplementedError
+
+    def default(self):
+        raise NotImplementedError
+
+
+class Uint(SSZType):
+    def __init__(self, bits):
+        assert bits in (8, 16, 32, 64, 128, 256)
+        self.bits = bits
+
+    def is_fixed_size(self):
+        return True
+
+    def fixed_size(self):
+        return self.bits // 8
+
+    def serialize(self, value):
+        return int(value).to_bytes(self.bits // 8, "little")
+
+    def deserialize(self, data):
+        if len(data) != self.bits // 8:
+            raise DecodeError(f"uint{self.bits}: bad length {len(data)}")
+        return int.from_bytes(data, "little")
+
+    def default(self):
+        return 0
+
+
+class Boolean(SSZType):
+    def is_fixed_size(self):
+        return True
+
+    def fixed_size(self):
+        return 1
+
+    def serialize(self, value):
+        if value not in (True, False, 0, 1):
+            raise ValueError("bad boolean")
+        return b"\x01" if value else b"\x00"
+
+    def deserialize(self, data):
+        if data == b"\x00":
+            return False
+        if data == b"\x01":
+            return True
+        raise DecodeError("bad boolean byte")
+
+    def default(self):
+        return False
+
+
+uint8 = Uint(8)
+uint16 = Uint(16)
+uint32 = Uint(32)
+uint64 = Uint(64)
+uint128 = Uint(128)
+uint256 = Uint(256)
+boolean = Boolean()
+
+
+class ByteVector(SSZType):
+    def __init__(self, length):
+        self.length = length
+
+    def is_fixed_size(self):
+        return True
+
+    def fixed_size(self):
+        return self.length
+
+    def serialize(self, value):
+        value = bytes(value)
+        if len(value) != self.length:
+            raise ValueError(f"ByteVector[{self.length}]: got {len(value)}")
+        return value
+
+    def deserialize(self, data):
+        if len(data) != self.length:
+            raise DecodeError(f"ByteVector[{self.length}]: got {len(data)}")
+        return bytes(data)
+
+    def default(self):
+        return bytes(self.length)
+
+
+Bytes4 = ByteVector(4)
+Bytes20 = ByteVector(20)
+Bytes32 = ByteVector(32)
+Bytes48 = ByteVector(48)
+Bytes96 = ByteVector(96)
+
+
+class ByteList(SSZType):
+    def __init__(self, limit):
+        self.limit = limit
+
+    def is_fixed_size(self):
+        return False
+
+    def fixed_size(self):
+        return BYTES_PER_LENGTH_OFFSET
+
+    def serialize(self, value):
+        value = bytes(value)
+        if len(value) > self.limit:
+            raise ValueError("ByteList over limit")
+        return value
+
+    def deserialize(self, data):
+        if len(data) > self.limit:
+            raise DecodeError("ByteList over limit")
+        return bytes(data)
+
+    def default(self):
+        return b""
+
+
+class Vector(SSZType):
+    def __init__(self, elem, length):
+        assert length > 0
+        self.elem = elem
+        self.length = length
+
+    def is_fixed_size(self):
+        return self.elem.is_fixed_size()
+
+    def fixed_size(self):
+        if not self.is_fixed_size():
+            return BYTES_PER_LENGTH_OFFSET
+        return self.elem.fixed_size() * self.length
+
+    def serialize(self, value):
+        if len(value) != self.length:
+            raise ValueError(f"Vector[{self.length}]: got {len(value)}")
+        return _serialize_sequence(self.elem, value)
+
+    def deserialize(self, data):
+        out = _deserialize_sequence(self.elem, data)
+        if len(out) != self.length:
+            raise DecodeError(f"Vector[{self.length}]: got {len(out)}")
+        return out
+
+    def default(self):
+        return [self.elem.default() for _ in range(self.length)]
+
+
+class List(SSZType):
+    def __init__(self, elem, limit):
+        self.elem = elem
+        self.limit = limit
+
+    def is_fixed_size(self):
+        return False
+
+    def fixed_size(self):
+        return BYTES_PER_LENGTH_OFFSET
+
+    def serialize(self, value):
+        if len(value) > self.limit:
+            raise ValueError(f"List[{self.limit}]: got {len(value)}")
+        return _serialize_sequence(self.elem, value)
+
+    def deserialize(self, data):
+        out = _deserialize_sequence(self.elem, data)
+        if len(out) > self.limit:
+            raise DecodeError(f"List[{self.limit}]: got {len(out)}")
+        return out
+
+    def default(self):
+        return []
+
+
+class Bitvector(SSZType):
+    def __init__(self, length):
+        assert length > 0
+        self.length = length
+
+    def is_fixed_size(self):
+        return True
+
+    def fixed_size(self):
+        return (self.length + 7) // 8
+
+    def serialize(self, value):
+        if len(value) != self.length:
+            raise ValueError(f"Bitvector[{self.length}]: got {len(value)}")
+        return _bits_to_bytes(value)
+
+    def deserialize(self, data):
+        if len(data) != self.fixed_size():
+            raise DecodeError("Bitvector: bad byte length")
+        bits = _bytes_to_bits(data)[: self.length]
+        if any(_bytes_to_bits(data)[self.length :]):
+            raise DecodeError("Bitvector: nonzero padding")
+        return bits
+
+    def default(self):
+        return [0] * self.length
+
+
+class Bitlist(SSZType):
+    def __init__(self, limit):
+        self.limit = limit
+
+    def is_fixed_size(self):
+        return False
+
+    def fixed_size(self):
+        return BYTES_PER_LENGTH_OFFSET
+
+    def serialize(self, value):
+        if len(value) > self.limit:
+            raise ValueError(f"Bitlist[{self.limit}]: got {len(value)}")
+        # delimiter bit at position len
+        return _bits_to_bytes(list(value) + [1])
+
+    def deserialize(self, data):
+        if not data:
+            raise DecodeError("Bitlist: empty")
+        bits = _bytes_to_bits(data)
+        # find delimiter: highest set bit
+        while bits and bits[-1] == 0:
+            bits.pop()
+        if not bits:
+            raise DecodeError("Bitlist: missing delimiter")
+        bits.pop()  # remove delimiter
+        if len(bits) > self.limit:
+            raise DecodeError("Bitlist over limit")
+        if len(data) != (len(bits) + 1 + 7) // 8:
+            raise DecodeError("Bitlist: trailing bytes")
+        return bits
+
+    def default(self):
+        return []
+
+
+class Container(SSZType):
+    """Declarative container: subclass with `fields = [(name, ssz_type), ...]`.
+
+    The descriptor IS the class; instances hold the field values.  Mirrors
+    `#[derive(Encode, Decode, TreeHash)]` containers in consensus/types.
+    """
+
+    fields = []
+
+    def __init__(self, **kwargs):
+        for name, typ in type(self).fields:
+            if name in kwargs:
+                setattr(self, name, kwargs.pop(name))
+            else:
+                setattr(self, name, typ.default())
+        if kwargs:
+            raise TypeError(f"unknown fields: {sorted(kwargs)}")
+
+    # ---- descriptor protocol (classmethods so the class doubles as type)
+
+    @classmethod
+    def is_fixed_size(cls):
+        return all(t.is_fixed_size() for _, t in cls.fields)
+
+    @classmethod
+    def fixed_size(cls):
+        if not cls.is_fixed_size():
+            return BYTES_PER_LENGTH_OFFSET
+        return sum(t.fixed_size() for _, t in cls.fields)
+
+    @classmethod
+    def serialize(cls, value):
+        fixed_parts = []
+        var_parts = []
+        for name, typ in cls.fields:
+            v = getattr(value, name)
+            if typ.is_fixed_size():
+                fixed_parts.append(typ.serialize(v))
+                var_parts.append(b"")
+            else:
+                fixed_parts.append(None)  # offset placeholder
+                var_parts.append(typ.serialize(v))
+        fixed_len = sum(
+            len(p) if p is not None else BYTES_PER_LENGTH_OFFSET
+            for p in fixed_parts
+        )
+        out = []
+        var_offset = fixed_len
+        for p, v in zip(fixed_parts, var_parts):
+            if p is None:
+                out.append(var_offset.to_bytes(4, "little"))
+                var_offset += len(v)
+            else:
+                out.append(p)
+        return b"".join(out) + b"".join(var_parts)
+
+    @classmethod
+    def deserialize(cls, data):
+        values = {}
+        # first pass: fixed walk, collect offsets
+        pos = 0
+        offsets = []
+        order = []
+        for name, typ in cls.fields:
+            if typ.is_fixed_size():
+                n = typ.fixed_size()
+                if pos + n > len(data):
+                    raise DecodeError(f"{cls.__name__}.{name}: short read")
+                values[name] = typ.deserialize(data[pos : pos + n])
+                pos += n
+            else:
+                if pos + 4 > len(data):
+                    raise DecodeError(f"{cls.__name__}.{name}: short offset")
+                offsets.append((name, typ, int.from_bytes(data[pos : pos + 4], "little")))
+                pos += 4
+        if offsets:
+            if offsets[0][2] != pos:
+                raise DecodeError(f"{cls.__name__}: bad first offset")
+            bounds = [o[2] for o in offsets] + [len(data)]
+            for (name, typ, off), end in zip(offsets, bounds[1:]):
+                if off > end:
+                    raise DecodeError(f"{cls.__name__}.{name}: offsets not increasing")
+                values[name] = typ.deserialize(data[off:end])
+        elif pos != len(data):
+            raise DecodeError(f"{cls.__name__}: trailing bytes")
+        return cls(**values)
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    # ---- value conveniences
+
+    def __eq__(self, other):
+        return type(self) is type(other) and all(
+            getattr(self, n) == getattr(other, n) for n, _ in type(self).fields
+        )
+
+    def __repr__(self):
+        inner = ", ".join(
+            f"{n}={getattr(self, n)!r}" for n, _ in type(self).fields
+        )
+        return f"{type(self).__name__}({inner})"
+
+    def copy(self):
+        import copy as _copy
+
+        return _copy.deepcopy(self)
+
+
+# ------------------------------------------------------------- sequences
+
+
+def _serialize_sequence(elem, values):
+    if elem.is_fixed_size():
+        return b"".join(elem.serialize(v) for v in values)
+    parts = [elem.serialize(v) for v in values]
+    offset = BYTES_PER_LENGTH_OFFSET * len(parts)
+    out = []
+    for p in parts:
+        out.append(offset.to_bytes(4, "little"))
+        offset += len(p)
+    return b"".join(out) + b"".join(parts)
+
+
+def _deserialize_sequence(elem, data):
+    if elem.is_fixed_size():
+        n = elem.fixed_size()
+        if len(data) % n:
+            raise DecodeError("sequence: length not a multiple of element size")
+        return [elem.deserialize(data[i : i + n]) for i in range(0, len(data), n)]
+    if not data:
+        return []
+    if len(data) < 4:
+        raise DecodeError("sequence: short offset")
+    first = int.from_bytes(data[:4], "little")
+    if first % 4 or first > len(data):
+        raise DecodeError("sequence: bad first offset")
+    count = first // 4
+    offsets = [
+        int.from_bytes(data[i * 4 : i * 4 + 4], "little") for i in range(count)
+    ]
+    if offsets and offsets[0] != first:
+        raise DecodeError("sequence: inconsistent first offset")
+    bounds = offsets + [len(data)]
+    out = []
+    for off, end in zip(offsets, bounds[1:]):
+        if off > end:
+            raise DecodeError("sequence: offsets not increasing")
+        out.append(elem.deserialize(data[off:end]))
+    return out
+
+
+def _bits_to_bytes(bits):
+    out = bytearray((len(bits) + 7) // 8)
+    for i, b in enumerate(bits):
+        if b:
+            out[i // 8] |= 1 << (i % 8)
+    return bytes(out)
+
+
+def _bytes_to_bits(data):
+    return [(byte >> i) & 1 for byte in data for i in range(8)]
+
+
+# ------------------------------------------------------------- public API
+
+
+def encode(typ, value=None):
+    """encode(type, value) or encode(container_instance)."""
+    if value is None and isinstance(typ, Container):
+        return type(typ).serialize(typ)
+    return typ.serialize(value)
+
+
+def decode(typ, data):
+    return typ.deserialize(data)

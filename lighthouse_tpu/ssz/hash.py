@@ -1,0 +1,126 @@
+"""SSZ Merkleization — `hash_tree_root` over the core type descriptors.
+
+Mirror of /root/reference/consensus/tree_hash (SURVEY.md §2.2): chunk
+packing, power-of-two virtual-padding merkleization with precomputed
+zero-subtree hashes, and length mix-in for lists/bitlists.  Signing roots
+(SigningData{object_root, domain}.tree_hash_root(), signature_sets.rs:
+142-150) are built on top of this in lighthouse_tpu.types.
+"""
+
+import hashlib
+
+from . import core
+
+BYTES_PER_CHUNK = 32
+
+
+def _sha256(x):
+    return hashlib.sha256(x).digest()
+
+
+# zero-subtree hashes: ZERO_HASHES[i] = root of an all-zero tree of depth i
+ZERO_HASHES = [b"\x00" * 32]
+for _ in range(64):
+    ZERO_HASHES.append(_sha256(ZERO_HASHES[-1] + ZERO_HASHES[-1]))
+
+
+def _pack_bytes(data):
+    """Right-pad to a whole number of 32-byte chunks."""
+    if not data:
+        return []
+    pad = (-len(data)) % BYTES_PER_CHUNK
+    data = data + b"\x00" * pad
+    return [data[i : i + 32] for i in range(0, len(data), 32)]
+
+
+def merkleize(chunks, limit=None):
+    """Merkle root with virtual padding to `limit` leaves (or next pow2)."""
+    count = len(chunks)
+    if limit is None:
+        limit = count
+    if count > limit:
+        raise ValueError("more chunks than limit")
+    # depth of the (virtually padded) tree
+    depth = max(limit - 1, 0).bit_length()
+    if count == 0:
+        return ZERO_HASHES[depth]
+    layer = list(chunks)
+    for d in range(depth):
+        odd = len(layer) % 2
+        nxt = []
+        for i in range(0, len(layer) - odd, 2):
+            nxt.append(_sha256(layer[i] + layer[i + 1]))
+        if odd:
+            nxt.append(_sha256(layer[-1] + ZERO_HASHES[d]))
+        layer = nxt
+    return layer[0]
+
+
+def mix_in_length(root, length):
+    return _sha256(root + int(length).to_bytes(32, "little"))
+
+
+def _chunk_count(typ):
+    """Leaf-count limit for merkleization, per the SSZ spec."""
+    if isinstance(typ, (core.Uint, core.Boolean)):
+        return 1
+    if isinstance(typ, core.ByteVector):
+        return (typ.length + 31) // 32
+    if isinstance(typ, core.ByteList):
+        return (typ.limit + 31) // 32
+    if isinstance(typ, core.Bitvector):
+        return (typ.length + 255) // 256
+    if isinstance(typ, core.Bitlist):
+        return (typ.limit + 255) // 256
+    if isinstance(typ, core.Vector):
+        if _is_basic(typ.elem):
+            return (typ.length * typ.elem.fixed_size() + 31) // 32
+        return typ.length
+    if isinstance(typ, core.List):
+        if _is_basic(typ.elem):
+            return (typ.limit * typ.elem.fixed_size() + 31) // 32
+        return typ.limit
+    raise TypeError(f"no chunk count for {typ}")
+
+
+def _is_basic(typ):
+    return isinstance(typ, (core.Uint, core.Boolean))
+
+
+def hash_tree_root(typ, value=None):
+    """hash_tree_root(type, value) or hash_tree_root(container_instance)."""
+    if value is None and isinstance(typ, core.Container):
+        typ, value = type(typ), typ
+
+    if _is_basic(typ):
+        return _pack_bytes(typ.serialize(value))[0]
+    if isinstance(typ, (core.ByteVector, core.ByteList)):
+        chunks = _pack_bytes(bytes(value))
+        root = merkleize(chunks, _chunk_count(typ))
+        if isinstance(typ, core.ByteList):
+            root = mix_in_length(root, len(value))
+        return root
+    if isinstance(typ, (core.Bitvector, core.Bitlist)):
+        chunks = _pack_bytes(core._bits_to_bytes(list(value)))
+        root = merkleize(chunks, _chunk_count(typ))
+        if isinstance(typ, core.Bitlist):
+            root = mix_in_length(root, len(value))
+        return root
+    if isinstance(typ, core.Vector):
+        root = _sequence_root(typ.elem, value, _chunk_count(typ))
+        return root
+    if isinstance(typ, core.List):
+        root = _sequence_root(typ.elem, value, _chunk_count(typ))
+        return mix_in_length(root, len(value))
+    if isinstance(typ, type) and issubclass(typ, core.Container):
+        leaves = [hash_tree_root(t, getattr(value, n)) for n, t in typ.fields]
+        return merkleize(leaves, len(leaves))
+    raise TypeError(f"cannot hash_tree_root {typ}")
+
+
+def _sequence_root(elem, values, limit):
+    if _is_basic(elem):
+        packed = b"".join(elem.serialize(v) for v in values)
+        return merkleize(_pack_bytes(packed), limit)
+    leaves = [hash_tree_root(elem, v) for v in values]
+    return merkleize(leaves, limit)

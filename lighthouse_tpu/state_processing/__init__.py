@@ -1,0 +1,11 @@
+"""State-transition layer (L3) — signature-set construction first.
+
+Mirror of /root/reference/consensus/state_processing (SURVEY.md §2.4),
+built out breadth-first: the signature-set constructors land first because
+they are the seam the TPU verify kernel consumes; per-block/per-epoch
+processing and the block replayer follow.
+"""
+
+from . import signature_sets
+
+__all__ = ["signature_sets"]

@@ -1,0 +1,120 @@
+"""Block replay driver + chain-segment bulk signature verification.
+
+Mirrors two reference seams (SURVEY.md §2.4-2.5):
+
+  * `BlockReplayer` (consensus/state_processing/src/block_replayer.rs:24-218)
+    — builder-pattern replay of a block sequence over a state with a
+    pluggable signature strategy and pre/post hooks; drives historical
+    state reconstruction and the epoch-replay benchmark config.
+
+  * `signature_verify_chain_segment`
+    (beacon_node/beacon_chain/src/block_verification.rs:531) — collect the
+    signature sets of a WHOLE segment of blocks into one list and verify
+    them in a single batched call: the largest batches in the client, and
+    the shape the TPU kernel is built for.
+"""
+
+from ..ssz import hash_tree_root
+from .phase0 import (
+    BlockProcessingError,
+    BlockSignatureStrategy,
+    per_block_processing,
+    process_slots,
+)
+
+
+class BlockReplayer:
+    """block_replayer.rs: replay blocks over a state.
+
+    with_signature_strategy / with_pre_block_hook / with_post_block_hook
+    mirror the Rust builder; `apply_blocks` runs slot + block processing
+    per block (state-root validation optional, as in StateRootStrategy).
+    """
+
+    def __init__(self, state, spec):
+        self.state = state
+        self.spec = spec
+        self.signature_strategy = BlockSignatureStrategy.NO_VERIFICATION
+        self.verify_fn = None
+        self.pre_block_hook = None
+        self.post_block_hook = None
+        self.verify_state_roots = True
+
+    def with_signature_strategy(self, strategy, verify_fn=None):
+        self.signature_strategy = strategy
+        self.verify_fn = verify_fn
+        return self
+
+    def with_pre_block_hook(self, hook):
+        self.pre_block_hook = hook
+        return self
+
+    def with_post_block_hook(self, hook):
+        self.post_block_hook = hook
+        return self
+
+    def with_state_root_verification(self, on):
+        self.verify_state_roots = on
+        return self
+
+    def apply_blocks(self, blocks, target_slot=None):
+        collected = (
+            []
+            if self.signature_strategy == BlockSignatureStrategy.VERIFY_BULK
+            else None
+        )
+        for signed in blocks:
+            slot = signed.message.slot
+            if self.pre_block_hook:
+                self.pre_block_hook(self.state, signed)
+            if self.state.slot < slot:
+                process_slots(self.state, slot, self.spec.preset)
+            per_block_processing(
+                self.state,
+                signed,
+                self.spec,
+                signature_strategy=self.signature_strategy,
+                verify_fn=self.verify_fn,
+                collected_sets=collected,
+            )
+            if self.verify_state_roots:
+                if signed.message.state_root != hash_tree_root(self.state):
+                    raise BlockProcessingError("state root mismatch in replay")
+            if self.post_block_hook:
+                self.post_block_hook(self.state, signed)
+        if collected:
+            verify = self.verify_fn
+            if verify is None:
+                from ..crypto.ref.bls import verify_signature_sets as verify
+            if not verify(collected):
+                raise BlockProcessingError("segment bulk signature verification failed")
+        if target_slot is not None and self.state.slot < target_slot:
+            process_slots(self.state, target_slot, self.spec.preset)
+        return self.state
+
+
+def signature_verify_chain_segment(state, blocks, spec, verify_fn=None):
+    """block_verification.rs:531 — one giant verify_signature_sets call for
+    an epoch-batch of blocks.  Returns the collected sets' verdict without
+    mutating the caller's state (replays on a copy)."""
+    collected = []
+    replayer = (
+        BlockReplayer(state.copy(), spec)
+        .with_signature_strategy(BlockSignatureStrategy.VERIFY_BULK)
+        .with_state_root_verification(False)
+    )
+    # collect without verifying per-block
+    for signed in blocks:
+        slot = signed.message.slot
+        if replayer.state.slot < slot:
+            process_slots(replayer.state, slot, spec.preset)
+        per_block_processing(
+            replayer.state,
+            signed,
+            spec,
+            signature_strategy=BlockSignatureStrategy.VERIFY_BULK,
+            collected_sets=collected,
+        )
+    if verify_fn is None:
+        from ..crypto.ref.bls import verify_signature_sets as verify_fn
+    return verify_fn(collected), collected

@@ -1,0 +1,83 @@
+"""Genesis state construction (interop flavor).
+
+Mirror of /root/reference/consensus/state_processing/src/genesis.rs plus the
+deterministic interop keypairs of /root/reference/common/eth2_interop_keypairs
+(privkey_i = int(sha256(i_le32)) mod r — the standard interop derivation) and
+the interop genesis path of /root/reference/beacon_node/genesis/src/interop.rs.
+"""
+
+import hashlib
+
+from ..crypto.constants import R
+from ..crypto.ref import bls as RB
+from ..crypto.ref.curves import g1_compress
+from ..ssz import hash_tree_root
+from ..types.containers import BeaconBlockHeader, Checkpoint, Fork
+from ..types.state import Validator, state_types
+from .phase0 import FAR_FUTURE_EPOCH, GENESIS_EPOCH, MAX_EFFECTIVE_BALANCE
+
+
+def interop_keypairs(n):
+    """Deterministic interop validator keys (eth2_interop_keypairs)."""
+    keys = []
+    for i in range(n):
+        sk = (
+            int.from_bytes(
+                hashlib.sha256(i.to_bytes(32, "little")).digest(), "little"
+            )
+            % R
+        )
+        keys.append((sk, RB.sk_to_pk(sk)))
+    return keys
+
+
+def interop_genesis_state(keypairs, genesis_time, spec, eth1_block_hash=b"\x42" * 32):
+    """Build a genesis BeaconState with all validators active at epoch 0."""
+    preset = spec.preset
+    T = state_types(preset)
+
+    validators = []
+    balances = []
+    for _, pk in keypairs:
+        pk_bytes = g1_compress(pk)
+        validators.append(
+            Validator(
+                pubkey=pk_bytes,
+                withdrawal_credentials=b"\x00" + hashlib.sha256(pk_bytes).digest()[1:],
+                effective_balance=MAX_EFFECTIVE_BALANCE,
+                slashed=False,
+                activation_eligibility_epoch=GENESIS_EPOCH,
+                activation_epoch=GENESIS_EPOCH,
+                exit_epoch=FAR_FUTURE_EPOCH,
+                withdrawable_epoch=FAR_FUTURE_EPOCH,
+            )
+        )
+        balances.append(MAX_EFFECTIVE_BALANCE)
+
+    state = T.BeaconState(
+        genesis_time=genesis_time,
+        slot=0,
+        fork=Fork(
+            previous_version=spec.genesis_fork_version,
+            current_version=spec.genesis_fork_version,
+            epoch=GENESIS_EPOCH,
+        ),
+        latest_block_header=BeaconBlockHeader(
+            body_root=hash_tree_root(T.BeaconBlockBody())
+        ),
+        eth1_data=T.Eth1Data(
+            deposit_root=bytes(32),
+            deposit_count=len(validators),
+            block_hash=eth1_block_hash,
+        ),
+        eth1_deposit_index=len(validators),
+        validators=validators,
+        balances=balances,
+        randao_mixes=[eth1_block_hash] * preset.epochs_per_historical_vector,
+        previous_justified_checkpoint=Checkpoint(),
+        current_justified_checkpoint=Checkpoint(),
+        finalized_checkpoint=Checkpoint(),
+    )
+    validators_type = dict(T.BeaconState.fields)["validators"]
+    state.genesis_validators_root = hash_tree_root(validators_type, validators)
+    return state

@@ -1,0 +1,894 @@
+"""Phase0 beacon state transition — per-slot, per-epoch, per-block.
+
+Mirror of /root/reference/consensus/state_processing (SURVEY.md §2.4):
+`per_slot_processing` (per_slot_processing.rs), `process_epoch`
+(per_epoch_processing/base.rs), `per_block_processing`
+(per_block_processing.rs:95) with the `BlockSignatureStrategy` seam
+(per_block_processing.rs:49) — signature checks either run inline
+(VerifyIndividual), are skipped (NoVerification), or are COLLECTED into
+SignatureSets for one batched device verification (VerifyBulk — the
+BlockSignatureVerifier path that feeds the TPU kernel).
+
+Faithful to the phase0 consensus spec; helpers keep the spec's names so the
+code cross-references both the spec and the reference's Rust.
+"""
+
+import hashlib
+
+from ..ssz import hash_tree_root, uint64
+from ..types import Domain, compute_signing_root
+from ..types.containers import Checkpoint, BeaconBlockHeader
+from ..types.state import state_types, Validator
+from . import signature_sets as sset
+from .shuffle import shuffle_list, shuffled_index
+
+# ------------------------------------------------------------ spec constants
+
+FAR_FUTURE_EPOCH = 2**64 - 1
+BASE_REWARDS_PER_EPOCH = 4
+DEPOSIT_CONTRACT_TREE_DEPTH = 32
+GENESIS_EPOCH = 0
+GENESIS_SLOT = 0
+
+MAX_EFFECTIVE_BALANCE = 32 * 10**9
+EFFECTIVE_BALANCE_INCREMENT = 10**9
+EJECTION_BALANCE = 16 * 10**9
+MIN_DEPOSIT_AMOUNT = 10**9
+
+MIN_ATTESTATION_INCLUSION_DELAY = 1
+MIN_SEED_LOOKAHEAD = 1
+MAX_SEED_LOOKAHEAD = 4
+MIN_EPOCHS_TO_INACTIVITY_PENALTY = 4
+EPOCHS_PER_ETH1_VOTING_PERIOD = 64
+
+MIN_PER_EPOCH_CHURN_LIMIT = 4
+CHURN_LIMIT_QUOTIENT = 2**16
+
+BASE_REWARD_FACTOR = 64
+WHISTLEBLOWER_REWARD_QUOTIENT = 512
+PROPOSER_REWARD_QUOTIENT = 8
+INACTIVITY_PENALTY_QUOTIENT = 2**26
+MIN_SLASHING_PENALTY_QUOTIENT = 128
+PROPORTIONAL_SLASHING_MULTIPLIER = 1
+
+DOMAIN_BEACON_PROPOSER = Domain.BEACON_PROPOSER
+DOMAIN_BEACON_ATTESTER = Domain.BEACON_ATTESTER
+
+
+def _sha(x):
+    return hashlib.sha256(x).digest()
+
+
+# ----------------------------------------------------------------- accessors
+
+
+def get_current_epoch(state, preset):
+    return state.slot // preset.slots_per_epoch
+
+
+def get_previous_epoch(state, preset):
+    cur = get_current_epoch(state, preset)
+    return GENESIS_EPOCH if cur == GENESIS_EPOCH else cur - 1
+def compute_start_slot_at_epoch(epoch, preset):
+    return epoch * preset.slots_per_epoch
+
+
+def is_active_validator(v, epoch):
+    return v.activation_epoch <= epoch < v.exit_epoch
+
+
+def is_slashable_validator(v, epoch):
+    return (not v.slashed) and v.activation_epoch <= epoch < v.withdrawable_epoch
+
+
+def get_active_validator_indices(state, epoch):
+    return [i for i, v in enumerate(state.validators) if is_active_validator(v, epoch)]
+
+
+def get_randao_mix(state, epoch, preset):
+    return state.randao_mixes[epoch % preset.epochs_per_historical_vector]
+
+
+def get_seed(state, epoch, domain_type, preset):
+    mix = get_randao_mix(
+        state,
+        epoch + preset.epochs_per_historical_vector - MIN_SEED_LOOKAHEAD - 1,
+        preset,
+    )
+    return _sha(
+        Domain.to_bytes(domain_type) + int(epoch).to_bytes(8, "little") + mix
+    )
+
+
+def get_validator_churn_limit(state, preset):
+    active = get_active_validator_indices(state, get_current_epoch(state, preset))
+    return max(MIN_PER_EPOCH_CHURN_LIMIT, len(active) // CHURN_LIMIT_QUOTIENT)
+
+
+def get_total_balance(state, indices):
+    return max(
+        EFFECTIVE_BALANCE_INCREMENT,
+        sum(state.validators[i].effective_balance for i in indices),
+    )
+
+
+def get_total_active_balance(state, preset):
+    return get_total_balance(
+        state, get_active_validator_indices(state, get_current_epoch(state, preset))
+    )
+
+
+def get_block_root_at_slot(state, slot, preset):
+    assert slot < state.slot <= slot + preset.slots_per_historical_root
+    return state.block_roots[slot % preset.slots_per_historical_root]
+
+
+def get_block_root(state, epoch, preset):
+    return get_block_root_at_slot(
+        state, compute_start_slot_at_epoch(epoch, preset), preset
+    )
+
+
+def compute_activation_exit_epoch(epoch):
+    return epoch + 1 + MAX_SEED_LOOKAHEAD
+
+
+# ------------------------------------------------------- proposer/committees
+
+
+def compute_proposer_index(state, indices, seed):
+    """Spec compute_proposer_index: effective-balance-weighted selection."""
+    assert indices
+    i = 0
+    total = len(indices)
+    while True:
+        candidate = indices[shuffled_index(i % total, total, seed)]
+        random_byte = _sha(seed + (i // 32).to_bytes(8, "little"))[i % 32]
+        eb = state.validators[candidate].effective_balance
+        if eb * 255 >= MAX_EFFECTIVE_BALANCE * random_byte:
+            return candidate
+        i += 1
+
+
+def get_beacon_proposer_index(state, preset):
+    epoch = get_current_epoch(state, preset)
+    seed = _sha(
+        get_seed(state, epoch, DOMAIN_BEACON_PROPOSER, preset)
+        + int(state.slot).to_bytes(8, "little")
+    )
+    return compute_proposer_index(
+        state, get_active_validator_indices(state, epoch), seed
+    )
+
+
+def get_committee_count_per_slot(state, epoch, preset):
+    n_active = len(get_active_validator_indices(state, epoch))
+    return max(
+        1,
+        min(
+            preset.max_committees_per_slot,
+            n_active // preset.slots_per_epoch // preset.target_committee_size,
+        ),
+    )
+
+
+def get_beacon_committee(state, slot, index, preset):
+    epoch = slot // preset.slots_per_epoch
+    per_slot = get_committee_count_per_slot(state, epoch, preset)
+    indices = get_active_validator_indices(state, epoch)
+    seed = get_seed(state, epoch, DOMAIN_BEACON_ATTESTER, preset)
+    committee_index = (slot % preset.slots_per_epoch) * per_slot + index
+    count = per_slot * preset.slots_per_epoch
+    n = len(indices)
+    shuffled = shuffle_list(indices, seed)
+    start = n * committee_index // count
+    end = n * (committee_index + 1) // count
+    return list(shuffled[start:end])
+
+
+def get_attesting_indices(state, data, bits, preset):
+    committee = get_beacon_committee(state, data.slot, data.index, preset)
+    assert len(bits) == len(committee)
+    return sorted(i for i, b in zip(committee, bits) if b)
+
+
+def get_indexed_attestation(state, attestation, preset):
+    T = state_types(preset)
+    indices = get_attesting_indices(
+        state, attestation.data, attestation.aggregation_bits, preset
+    )
+    return T.IndexedAttestation(
+        attesting_indices=indices,
+        data=attestation.data,
+        signature=attestation.signature,
+    )
+
+
+def is_slashable_attestation_data(d1, d2):
+    return (d1 != d2 and d1.target.epoch == d2.target.epoch) or (
+        d1.source.epoch < d2.source.epoch and d2.target.epoch < d1.target.epoch
+    )
+
+
+def is_valid_indexed_attestation_structure(indexed):
+    ids = list(indexed.attesting_indices)
+    return bool(ids) and ids == sorted(set(ids))
+
+
+# ------------------------------------------------------------ registry mutes
+
+
+def initiate_validator_exit(state, index, preset):
+    v = state.validators[index]
+    if v.exit_epoch != FAR_FUTURE_EPOCH:
+        return
+    exit_epochs = [
+        u.exit_epoch for u in state.validators if u.exit_epoch != FAR_FUTURE_EPOCH
+    ]
+    exit_queue_epoch = max(
+        exit_epochs
+        + [compute_activation_exit_epoch(get_current_epoch(state, preset))]
+    )
+    churn = len(
+        [u for u in state.validators if u.exit_epoch == exit_queue_epoch]
+    )
+    if churn >= get_validator_churn_limit(state, preset):
+        exit_queue_epoch += 1
+    v.exit_epoch = exit_queue_epoch
+    v.withdrawable_epoch = exit_queue_epoch + 256  # MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+
+
+def slash_validator(state, slashed_index, preset, whistleblower_index=None):
+    epoch = get_current_epoch(state, preset)
+    initiate_validator_exit(state, slashed_index, preset)
+    v = state.validators[slashed_index]
+    v.slashed = True
+    v.withdrawable_epoch = max(
+        v.withdrawable_epoch, epoch + preset.epochs_per_slashings_vector
+    )
+    state.slashings[epoch % preset.epochs_per_slashings_vector] += v.effective_balance
+    decrease_balance(
+        state, slashed_index, v.effective_balance // MIN_SLASHING_PENALTY_QUOTIENT
+    )
+    proposer_index = get_beacon_proposer_index(state, preset)
+    if whistleblower_index is None:
+        whistleblower_index = proposer_index
+    whistleblower_reward = v.effective_balance // WHISTLEBLOWER_REWARD_QUOTIENT
+    proposer_reward = whistleblower_reward // PROPOSER_REWARD_QUOTIENT
+    increase_balance(state, proposer_index, proposer_reward)
+    increase_balance(state, whistleblower_index, whistleblower_reward - proposer_reward)
+
+
+def increase_balance(state, index, delta):
+    state.balances[index] += delta
+
+
+def decrease_balance(state, index, delta):
+    state.balances[index] = max(0, state.balances[index] - delta)
+
+
+# ------------------------------------------------------------------ slots
+
+
+def process_slots(state, slot, preset):
+    """Spec process_slots / reference per_slot_processing."""
+    assert state.slot < slot
+    while state.slot < slot:
+        process_slot(state, preset)
+        if (state.slot + 1) % preset.slots_per_epoch == 0:
+            process_epoch(state, preset)
+        state.slot += 1
+
+
+def process_slot(state, preset):
+    previous_state_root = hash_tree_root(state)
+    state.state_roots[state.slot % preset.slots_per_historical_root] = previous_state_root
+    if state.latest_block_header.state_root == bytes(32):
+        state.latest_block_header.state_root = previous_state_root
+    previous_block_root = hash_tree_root(state.latest_block_header)
+    state.block_roots[state.slot % preset.slots_per_historical_root] = previous_block_root
+
+
+# ------------------------------------------------------------------ epoch
+
+
+def process_epoch(state, preset):
+    """per_epoch_processing/base.rs process_epoch."""
+    process_justification_and_finalization(state, preset)
+    process_rewards_and_penalties(state, preset)
+    process_registry_updates(state, preset)
+    process_slashings(state, preset)
+    process_final_updates(state, preset)
+
+
+def _matching_source_attestations(state, epoch, preset):
+    if epoch == get_current_epoch(state, preset):
+        return list(state.current_epoch_attestations)
+    if epoch == get_previous_epoch(state, preset):
+        return list(state.previous_epoch_attestations)
+    raise AssertionError("epoch out of range")
+
+
+def _matching_target_attestations(state, epoch, preset):
+    return [
+        a
+        for a in _matching_source_attestations(state, epoch, preset)
+        if a.data.target.root == get_block_root(state, epoch, preset)
+    ]
+
+
+def _matching_head_attestations(state, epoch, preset):
+    return [
+        a
+        for a in _matching_target_attestations(state, epoch, preset)
+        if a.data.beacon_block_root
+        == get_block_root_at_slot(state, a.data.slot, preset)
+    ]
+
+
+def _unslashed_attesting_indices(state, attestations, preset):
+    out = set()
+    for a in attestations:
+        out |= set(
+            get_attesting_indices(state, a.data, a.aggregation_bits, preset)
+        )
+    return sorted(i for i in out if not state.validators[i].slashed)
+
+
+def process_justification_and_finalization(state, preset):
+    if get_current_epoch(state, preset) <= GENESIS_EPOCH + 1:
+        return
+    previous_epoch = get_previous_epoch(state, preset)
+    current_epoch = get_current_epoch(state, preset)
+    old_previous_justified = state.previous_justified_checkpoint
+    old_current_justified = state.current_justified_checkpoint
+
+    state.previous_justified_checkpoint = state.current_justified_checkpoint
+    bits = list(state.justification_bits)
+    bits = [0] + bits[: len(bits) - 1]
+
+    total_active = get_total_active_balance(state, preset)
+    prev_target = _unslashed_attesting_indices(
+        state, _matching_target_attestations(state, previous_epoch, preset), preset
+    )
+    if get_total_balance(state, prev_target) * 3 >= total_active * 2:
+        state.current_justified_checkpoint = Checkpoint(
+            epoch=previous_epoch, root=get_block_root(state, previous_epoch, preset)
+        )
+        bits[1] = 1
+    cur_target = _unslashed_attesting_indices(
+        state, _matching_target_attestations(state, current_epoch, preset), preset
+    )
+    if get_total_balance(state, cur_target) * 3 >= total_active * 2:
+        state.current_justified_checkpoint = Checkpoint(
+            epoch=current_epoch, root=get_block_root(state, current_epoch, preset)
+        )
+        bits[0] = 1
+    state.justification_bits = bits
+
+    # finalization: the 2nd/3rd/4th-bit rules
+    if all(bits[1:4]) and old_previous_justified.epoch + 3 == current_epoch:
+        state.finalized_checkpoint = old_previous_justified
+    if all(bits[1:3]) and old_previous_justified.epoch + 2 == current_epoch:
+        state.finalized_checkpoint = old_previous_justified
+    if all(bits[0:3]) and old_current_justified.epoch + 2 == current_epoch:
+        state.finalized_checkpoint = old_current_justified
+    if all(bits[0:2]) and old_current_justified.epoch + 1 == current_epoch:
+        state.finalized_checkpoint = old_current_justified
+
+
+def get_base_reward(state, index, preset, total_balance=None):
+    if total_balance is None:
+        total_balance = get_total_active_balance(state, preset)
+    eb = state.validators[index].effective_balance
+    return (
+        eb
+        * BASE_REWARD_FACTOR
+        // int(total_balance**0.5)
+        // BASE_REWARDS_PER_EPOCH
+    )
+
+
+def _isqrt(n):
+    import math
+
+    return math.isqrt(n)
+
+
+def process_rewards_and_penalties(state, preset):
+    """per_epoch_processing rewards: the phase0 duty-based deltas."""
+    if get_current_epoch(state, preset) == GENESIS_EPOCH:
+        return
+    previous_epoch = get_previous_epoch(state, preset)
+    total_balance = get_total_active_balance(state, preset)
+    sqrt_total = _isqrt(total_balance)
+
+    def base_reward(i):
+        return (
+            state.validators[i].effective_balance
+            * BASE_REWARD_FACTOR
+            // sqrt_total
+            // BASE_REWARDS_PER_EPOCH
+        )
+
+    eligible = [
+        i
+        for i, v in enumerate(state.validators)
+        if is_active_validator(v, previous_epoch)
+        or (v.slashed and previous_epoch + 1 < v.withdrawable_epoch)
+    ]
+
+    src_atts = _matching_source_attestations(state, previous_epoch, preset)
+    tgt_atts = _matching_target_attestations(state, previous_epoch, preset)
+    head_atts = _matching_head_attestations(state, previous_epoch, preset)
+
+    rewards = [0] * len(state.validators)
+    penalties = [0] * len(state.validators)
+
+    for atts, _name in ((src_atts, "src"), (tgt_atts, "tgt"), (head_atts, "head")):
+        unslashed = set(_unslashed_attesting_indices(state, atts, preset))
+        attesting_balance = get_total_balance(state, sorted(unslashed))
+        for i in eligible:
+            if i in unslashed:
+                increment = EFFECTIVE_BALANCE_INCREMENT
+                reward_numerator = base_reward(i) * (attesting_balance // increment)
+                rewards[i] += reward_numerator // (total_balance // increment)
+            else:
+                penalties[i] += base_reward(i)
+
+    # proposer/inclusion-delay micro-rewards
+    src_indices = set(_unslashed_attesting_indices(state, src_atts, preset))
+    for i in src_indices:
+        eligible_atts = [
+            a
+            for a in src_atts
+            if i in get_attesting_indices(state, a.data, a.aggregation_bits, preset)
+        ]
+        attestation = min(eligible_atts, key=lambda a: a.inclusion_delay)
+        proposer_reward = base_reward(i) // PROPOSER_REWARD_QUOTIENT
+        rewards[attestation.proposer_index] += proposer_reward
+        max_attester_reward = base_reward(i) - proposer_reward
+        rewards[i] += max_attester_reward // attestation.inclusion_delay
+
+    # inactivity leak
+    finality_delay = previous_epoch - state.finalized_checkpoint.epoch
+    if finality_delay > MIN_EPOCHS_TO_INACTIVITY_PENALTY:
+        tgt_indices = set(_unslashed_attesting_indices(state, tgt_atts, preset))
+        for i in eligible:
+            penalties[i] += BASE_REWARDS_PER_EPOCH * base_reward(i) - (
+                base_reward(i) // PROPOSER_REWARD_QUOTIENT
+            )
+            if i not in tgt_indices:
+                penalties[i] += (
+                    state.validators[i].effective_balance
+                    * finality_delay
+                    // INACTIVITY_PENALTY_QUOTIENT
+                )
+
+    for i in range(len(state.validators)):
+        increase_balance(state, i, rewards[i])
+        decrease_balance(state, i, penalties[i])
+
+
+def process_registry_updates(state, preset):
+    current_epoch = get_current_epoch(state, preset)
+    for i, v in enumerate(state.validators):
+        if (
+            v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
+            and v.effective_balance == MAX_EFFECTIVE_BALANCE
+        ):
+            v.activation_eligibility_epoch = current_epoch + 1
+        if is_active_validator(v, current_epoch) and v.effective_balance <= EJECTION_BALANCE:
+            initiate_validator_exit(state, i, preset)
+
+    activation_queue = sorted(
+        [
+            i
+            for i, v in enumerate(state.validators)
+            if v.activation_eligibility_epoch != FAR_FUTURE_EPOCH
+            and v.activation_epoch == FAR_FUTURE_EPOCH
+            and v.activation_eligibility_epoch <= state.finalized_checkpoint.epoch
+        ],
+        key=lambda i: (state.validators[i].activation_eligibility_epoch, i),
+    )
+    for i in activation_queue[: get_validator_churn_limit(state, preset)]:
+        state.validators[i].activation_epoch = compute_activation_exit_epoch(
+            current_epoch
+        )
+
+
+def process_slashings(state, preset):
+    epoch = get_current_epoch(state, preset)
+    total_balance = get_total_active_balance(state, preset)
+    adjusted = min(
+        sum(state.slashings) * PROPORTIONAL_SLASHING_MULTIPLIER, total_balance
+    )
+    for i, v in enumerate(state.validators):
+        if (
+            v.slashed
+            and epoch + preset.epochs_per_slashings_vector // 2 == v.withdrawable_epoch
+        ):
+            increment = EFFECTIVE_BALANCE_INCREMENT
+            penalty_numerator = v.effective_balance // increment * adjusted
+            penalty = penalty_numerator // total_balance * increment
+            decrease_balance(state, i, penalty)
+
+
+def process_final_updates(state, preset):
+    current_epoch = get_current_epoch(state, preset)
+    next_epoch = current_epoch + 1
+    # eth1 data votes reset
+    if next_epoch % EPOCHS_PER_ETH1_VOTING_PERIOD == 0:
+        state.eth1_data_votes = []
+    # effective balance updates (hysteresis)
+    HYSTERESIS_QUOTIENT = 4
+    HYSTERESIS_DOWNWARD_MULTIPLIER = 1
+    HYSTERESIS_UPWARD_MULTIPLIER = 5
+    for i, v in enumerate(state.validators):
+        balance = state.balances[i]
+        hysteresis_increment = EFFECTIVE_BALANCE_INCREMENT // HYSTERESIS_QUOTIENT
+        downward = hysteresis_increment * HYSTERESIS_DOWNWARD_MULTIPLIER
+        upward = hysteresis_increment * HYSTERESIS_UPWARD_MULTIPLIER
+        if balance + downward < v.effective_balance or v.effective_balance + upward < balance:
+            v.effective_balance = min(
+                balance - balance % EFFECTIVE_BALANCE_INCREMENT, MAX_EFFECTIVE_BALANCE
+            )
+    # slashings reset
+    state.slashings[next_epoch % preset.epochs_per_slashings_vector] = 0
+    # randao mix carry-over
+    state.randao_mixes[next_epoch % preset.epochs_per_historical_vector] = (
+        get_randao_mix(state, current_epoch, preset)
+    )
+    # historical roots accumulator
+    if next_epoch % (preset.slots_per_historical_root // preset.slots_per_epoch) == 0:
+        T = state_types(preset)
+        batch = T.HistoricalBatch(
+            block_roots=list(state.block_roots), state_roots=list(state.state_roots)
+        )
+        state.historical_roots.append(hash_tree_root(batch))
+    # attestation rotation
+    state.previous_epoch_attestations = state.current_epoch_attestations
+    state.current_epoch_attestations = []
+
+
+# ------------------------------------------------------------------ block
+
+
+class BlockSignatureStrategy:
+    """per_block_processing.rs:49 BlockSignatureStrategy."""
+
+    NO_VERIFICATION = "no_verification"
+    VERIFY_INDIVIDUAL = "verify_individual"
+    VERIFY_BULK = "verify_bulk"
+
+
+class BlockProcessingError(Exception):
+    pass
+
+
+def per_block_processing(
+    state,
+    signed_block,
+    spec,
+    signature_strategy=BlockSignatureStrategy.VERIFY_INDIVIDUAL,
+    verify_fn=None,
+    collected_sets=None,
+):
+    """per_block_processing.rs:95.
+
+    `verify_fn(sets) -> bool` is the batch verifier (oracle or TPU kernel);
+    under VERIFY_BULK with `collected_sets` provided, sets are appended
+    there instead of verified (the BlockSignatureVerifier accumulation
+    path), letting callers batch many blocks into one device call
+    (block_verification.rs:531 signature_verify_chain_segment).
+    """
+    preset = spec.preset
+    block = signed_block.message
+    verifying = signature_strategy != BlockSignatureStrategy.NO_VERIFICATION
+    sets = []
+
+    get_pubkey = _registry_pubkey_closure(state)
+    fork = state.fork
+    gvr = state.genesis_validators_root
+
+    if verifying:
+        header = BeaconBlockHeader(
+            slot=block.slot,
+            proposer_index=block.proposer_index,
+            parent_root=block.parent_root,
+            state_root=block.state_root,
+            body_root=hash_tree_root(block.body),
+        )
+        from ..types.containers import SignedBeaconBlockHeader
+
+        sets.append(
+            sset.block_proposal_signature_set(
+                get_pubkey,
+                SignedBeaconBlockHeader(
+                    message=header, signature=signed_block.signature
+                ),
+                fork,
+                gvr,
+                spec,
+            )
+        )
+
+    process_block_header(state, block, preset)
+    process_randao(state, block.body, spec, verifying, sets, get_pubkey)
+    process_eth1_data(state, block.body, preset)
+    process_operations(state, block.body, spec, verifying, sets, get_pubkey)
+
+    if verifying:
+        if collected_sets is not None:
+            collected_sets.extend(sets)
+        else:
+            if verify_fn is None:
+                from ..crypto.ref.bls import verify_signature_sets as verify_fn
+            if not verify_fn(sets):
+                raise BlockProcessingError("bulk signature verification failed")
+    return state
+
+
+def _registry_pubkey_closure(state):
+    from ..crypto.ref.curves import g1_decompress
+
+    cache = {}
+
+    def get_pubkey(i):
+        if i in cache:
+            return cache[i]
+        if i >= len(state.validators):
+            return None
+        try:
+            pt = g1_decompress(bytes(state.validators[i].pubkey), subgroup_check=False)
+        except Exception:
+            return None
+        cache[i] = pt
+        return pt
+
+    return get_pubkey
+
+
+def process_block_header(state, block, preset):
+    assert block.slot == state.slot, "block/state slot mismatch"
+    assert block.slot > state.latest_block_header.slot, "block older than header"
+    assert block.proposer_index == get_beacon_proposer_index(state, preset), (
+        "wrong proposer index"
+    )
+    assert block.parent_root == hash_tree_root(state.latest_block_header), (
+        "parent root mismatch"
+    )
+    state.latest_block_header = BeaconBlockHeader(
+        slot=block.slot,
+        proposer_index=block.proposer_index,
+        parent_root=block.parent_root,
+        state_root=bytes(32),
+        body_root=hash_tree_root(block.body),
+    )
+    proposer = state.validators[block.proposer_index]
+    assert not proposer.slashed, "proposer slashed"
+
+
+def process_randao(state, body, spec, verifying, sets, get_pubkey):
+    preset = spec.preset
+    epoch = get_current_epoch(state, preset)
+    if verifying:
+        sets.append(
+            sset.randao_signature_set(
+                get_pubkey,
+                get_beacon_proposer_index(state, preset),
+                epoch,
+                body.randao_reveal,
+                state.fork,
+                state.genesis_validators_root,
+                spec,
+            )
+        )
+    mix = bytes(
+        a ^ b
+        for a, b in zip(
+            get_randao_mix(state, epoch, preset),
+            _sha(bytes(body.randao_reveal)),
+        )
+    )
+    state.randao_mixes[epoch % preset.epochs_per_historical_vector] = mix
+
+
+def process_eth1_data(state, body, preset):
+    state.eth1_data_votes.append(body.eth1_data)
+    period_slots = EPOCHS_PER_ETH1_VOTING_PERIOD * preset.slots_per_epoch
+    if (
+        sum(1 for v in state.eth1_data_votes if v == body.eth1_data) * 2
+        > period_slots
+    ):
+        state.eth1_data = body.eth1_data
+
+
+def process_operations(state, body, spec, verifying, sets, get_pubkey):
+    preset = spec.preset
+    expected_deposits = min(
+        preset.max_deposits,
+        state.eth1_data.deposit_count - state.eth1_deposit_index,
+    )
+    assert len(body.deposits) == expected_deposits, "wrong deposit count"
+
+    for op in body.proposer_slashings:
+        process_proposer_slashing(state, op, spec, verifying, sets, get_pubkey)
+    for op in body.attester_slashings:
+        process_attester_slashing(state, op, spec, verifying, sets, get_pubkey)
+    for op in body.attestations:
+        process_attestation(state, op, spec, verifying, sets, get_pubkey)
+    for op in body.deposits:
+        process_deposit(state, op, spec)
+    for op in body.voluntary_exits:
+        process_voluntary_exit(state, op, spec, verifying, sets, get_pubkey)
+
+
+def process_proposer_slashing(state, slashing, spec, verifying, sets, get_pubkey):
+    preset = spec.preset
+    h1 = slashing.signed_header_1.message
+    h2 = slashing.signed_header_2.message
+    assert h1.slot == h2.slot, "slots differ"
+    assert h1.proposer_index == h2.proposer_index, "proposer differs"
+    assert h1 != h2, "identical headers"
+    proposer = state.validators[h1.proposer_index]
+    assert is_slashable_validator(proposer, get_current_epoch(state, preset))
+    if verifying:
+        sets.extend(
+            sset.proposer_slashing_signature_sets(
+                get_pubkey, slashing, state.fork, state.genesis_validators_root, spec
+            )
+        )
+    slash_validator(state, h1.proposer_index, preset)
+
+
+def process_attester_slashing(state, slashing, spec, verifying, sets, get_pubkey):
+    preset = spec.preset
+    a1, a2 = slashing.attestation_1, slashing.attestation_2
+    assert is_slashable_attestation_data(a1.data, a2.data)
+    assert is_valid_indexed_attestation_structure(a1)
+    assert is_valid_indexed_attestation_structure(a2)
+    if verifying:
+        sets.extend(
+            sset.attester_slashing_signature_sets(
+                get_pubkey, slashing, state.fork, state.genesis_validators_root, spec
+            )
+        )
+    slashed_any = False
+    epoch = get_current_epoch(state, preset)
+    both = set(a1.attesting_indices) & set(a2.attesting_indices)
+    for i in sorted(both):
+        if is_slashable_validator(state.validators[i], epoch):
+            slash_validator(state, i, preset)
+            slashed_any = True
+    assert slashed_any, "no slashable validators"
+
+
+def process_attestation(state, attestation, spec, verifying, sets, get_pubkey):
+    preset = spec.preset
+    data = attestation.data
+    assert data.target.epoch in (
+        get_previous_epoch(state, preset),
+        get_current_epoch(state, preset),
+    ), "bad target epoch"
+    assert data.target.epoch == data.slot // preset.slots_per_epoch
+    assert (
+        data.slot + MIN_ATTESTATION_INCLUSION_DELAY
+        <= state.slot
+        <= data.slot + preset.slots_per_epoch
+    ), "inclusion window"
+    assert data.index < get_committee_count_per_slot(
+        state, data.target.epoch, preset
+    ), "bad committee index"
+    committee = get_beacon_committee(state, data.slot, data.index, preset)
+    assert len(attestation.aggregation_bits) == len(committee), "bits length"
+
+    T = state_types(preset)
+    pending = T.PendingAttestation(
+        aggregation_bits=list(attestation.aggregation_bits),
+        data=data,
+        inclusion_delay=state.slot - data.slot,
+        proposer_index=get_beacon_proposer_index(state, preset),
+    )
+    if data.target.epoch == get_current_epoch(state, preset):
+        assert data.source == state.current_justified_checkpoint, "bad source"
+        state.current_epoch_attestations.append(pending)
+    else:
+        assert data.source == state.previous_justified_checkpoint, "bad source"
+        state.previous_epoch_attestations.append(pending)
+
+    indexed = get_indexed_attestation(state, attestation, preset)
+    assert is_valid_indexed_attestation_structure(indexed)
+    if verifying:
+        sets.append(
+            sset.indexed_attestation_signature_set(
+                get_pubkey, indexed, state.fork, state.genesis_validators_root, spec
+            )
+        )
+
+
+def process_deposit(state, deposit, spec):
+    """Deposit proof verified against eth1_data.deposit_root; signature
+    verified standalone (invalid signatures are legal no-ops — deposits are
+    excluded from the block batch, block_signature_verifier.rs:124)."""
+    from ..ssz.hash import merkleize, mix_in_length
+    from ..crypto.ref import bls as RB
+
+    preset = spec.preset
+    leaf = hash_tree_root(deposit.data)
+    assert _verify_merkle_branch(
+        leaf,
+        [bytes(p) for p in deposit.proof],
+        DEPOSIT_CONTRACT_TREE_DEPTH + 1,
+        state.eth1_deposit_index,
+        state.eth1_data.deposit_root,
+    ), "bad deposit proof"
+    state.eth1_deposit_index += 1
+
+    pubkey = bytes(deposit.data.pubkey)
+    amount = deposit.data.amount
+    existing = {bytes(v.pubkey): i for i, v in enumerate(state.validators)}
+    if pubkey not in existing:
+        pk_pt, message, sig_pt = sset.deposit_pubkey_signature_message(
+            deposit.data, spec
+        )
+        from ..crypto.ref.curves import g1_decompress
+
+        try:
+            pk_point = g1_decompress(pubkey)
+        except Exception:
+            return  # invalid pubkey: no-op deposit
+        if sig_pt is None or not RB.verify(pk_point, message, sig_pt):
+            return  # invalid proof-of-possession: no-op
+        state.validators.append(
+            Validator(
+                pubkey=pubkey,
+                withdrawal_credentials=bytes(deposit.data.withdrawal_credentials),
+                effective_balance=min(
+                    amount - amount % EFFECTIVE_BALANCE_INCREMENT,
+                    MAX_EFFECTIVE_BALANCE,
+                ),
+                slashed=False,
+                activation_eligibility_epoch=FAR_FUTURE_EPOCH,
+                activation_epoch=FAR_FUTURE_EPOCH,
+                exit_epoch=FAR_FUTURE_EPOCH,
+                withdrawable_epoch=FAR_FUTURE_EPOCH,
+            )
+        )
+        state.balances.append(amount)
+    else:
+        increase_balance(state, existing[pubkey], amount)
+
+
+def _verify_merkle_branch(leaf, branch, depth, index, root):
+    value = leaf
+    for i in range(depth):
+        if (index >> i) & 1:
+            value = _sha(branch[i] + value)
+        else:
+            value = _sha(value + branch[i])
+    return value == root
+
+
+def process_voluntary_exit(state, signed_exit, spec, verifying, sets, get_pubkey):
+    preset = spec.preset
+    exit_msg = signed_exit.message
+    v = state.validators[exit_msg.validator_index]
+    current_epoch = get_current_epoch(state, preset)
+    assert is_active_validator(v, current_epoch), "not active"
+    assert v.exit_epoch == FAR_FUTURE_EPOCH, "already exiting"
+    assert current_epoch >= exit_msg.epoch, "exit epoch in future"
+    assert current_epoch >= v.activation_epoch + spec.shard_committee_period, (
+        "too early to exit"
+    )
+    if verifying:
+        sets.append(
+            sset.exit_signature_set(
+                get_pubkey,
+                signed_exit,
+                state.fork,
+                state.genesis_validators_root,
+                spec,
+            )
+        )
+    initiate_validator_exit(state, exit_msg.validator_index, preset)

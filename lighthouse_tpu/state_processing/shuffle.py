@@ -1,0 +1,80 @@
+"""Swap-or-not committee shuffling (spec `compute_shuffled_index`).
+
+Mirror of /root/reference/consensus/swap_or_not_shuffle (448 LoC): the
+90-round swap-or-not network used for committee assignment.  Two
+implementations that differentially test each other:
+
+  * `shuffled_index` — the spec's single-index walk (get_permutated_index)
+  * `shuffle_list` — the whole-list batch form, vectorized with numpy
+    (the reference's shuffle_list walks rounds over the full index array
+    too; here each round is a handful of numpy gathers over all indices)
+
+Both directions (shuffle/unshuffle) are supported via round order reversal.
+"""
+
+import hashlib
+
+import numpy as np
+
+SHUFFLE_ROUND_COUNT = 90
+
+
+def _sha(x):
+    return hashlib.sha256(x).digest()
+
+
+def shuffled_index(index, index_count, seed, rounds=SHUFFLE_ROUND_COUNT):
+    """Spec compute_shuffled_index for a single index (forward)."""
+    assert 0 <= index < index_count
+    for r in range(rounds):
+        pivot = int.from_bytes(_sha(seed + bytes([r]))[:8], "little") % index_count
+        flip = (pivot + index_count - index) % index_count
+        position = max(index, flip)
+        source = _sha(seed + bytes([r]) + (position // 256).to_bytes(4, "little"))
+        byte = source[(position % 256) // 8]
+        bit = (byte >> (position % 8)) & 1
+        index = flip if bit else index
+    return index
+
+
+def shuffle_list(indices, seed, rounds=SHUFFLE_ROUND_COUNT, forwards=True):
+    """Apply the permutation to a whole list at once (vectorized).
+
+    Returns a new numpy array `out` with out[i] = element now at position i
+    — matching applying `shuffled_index` to every position.
+    """
+    n = len(indices)
+    if n <= 1:
+        return np.asarray(indices).copy()
+    arr = np.asarray(indices)
+    # positions[i] walks the same trajectory as shuffled_index(i); running
+    # all i at once makes each round a few numpy gathers.
+    positions = np.arange(n, dtype=np.uint64)
+    round_order = range(rounds) if forwards else range(rounds - 1, -1, -1)
+    for r in round_order:
+        pivot = int.from_bytes(_sha(seed + bytes([r]))[:8], "little") % n
+        flip = (pivot + n - positions) % n
+        position = np.maximum(positions, flip)
+        # hash one 32-byte block per 256 positions
+        n_blocks = (n + 255) // 256
+        blocks = np.frombuffer(
+            b"".join(
+                _sha(seed + bytes([r]) + b.to_bytes(4, "little"))
+                for b in range(n_blocks)
+            ),
+            dtype=np.uint8,
+        )
+        byte_idx = (position % 256) // 8 + (position // 256) * 32
+        bits = (blocks[byte_idx.astype(np.int64)] >> (position % 8).astype(np.uint8)) & 1
+        positions = np.where(bits.astype(bool), flip, positions)
+    # spec: shuffled[p] = indices[compute_shuffled_index(p)] — a gather
+    return arr[positions.astype(np.int64)]
+
+
+def compute_committee(indices, seed, committee_index, committee_count):
+    """Spec compute_committee: slice of the shuffled validator list."""
+    n = len(indices)
+    shuffled = shuffle_list(indices, seed)
+    start = n * committee_index // committee_count
+    end = n * (committee_index + 1) // committee_count
+    return shuffled[start:end]

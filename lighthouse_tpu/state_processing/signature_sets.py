@@ -1,0 +1,336 @@
+"""The consensus-object -> SignatureSet constructors.
+
+Mirror of /root/reference/consensus/state_processing/src/per_block_processing/
+signature_sets.rs (656 LoC, 14 constructors) — every signature in a beacon
+chain reaches the batch verifier through one of these shapes.  Each
+constructor returns a `lighthouse_tpu.crypto.ref.bls.SignatureSet`
+(signature: affine G2 | None, pubkeys: [affine G1], message: signing root),
+the exact input type of both the oracle and the TPU
+`verify_signature_sets` kernels.
+
+Pubkeys are resolved through a `get_pubkey(validator_index) -> G1 | None`
+closure — the analogue of the decompressed `ValidatorPubkeyCache` closure
+the reference threads through its verifier
+(/root/reference/beacon_node/beacon_chain/src/block_verification.rs:1863-1895).
+Signature bytes are decompressed WITHOUT a subgroup check here; the batch
+kernel performs the per-call G2 subgroup check exactly like blst
+(impls/blst.rs:73-77).
+"""
+
+from ..crypto.ref.bls import SignatureSet
+from ..crypto.ref.curves import g2_decompress
+from ..ssz import hash_tree_root, uint64
+from ..types import Domain, compute_domain, compute_epoch_at_slot, compute_signing_root
+from ..types.containers import (
+    AggregateAndProof,
+    DepositMessage,
+    SigningData,
+    SyncAggregatorSelectionData,
+)
+
+
+class SignatureSetError(Exception):
+    """Mirror of signature_sets.rs Error: missing pubkey / bad signature."""
+
+
+def _pubkey(get_pubkey, index):
+    pk = get_pubkey(index)
+    if pk is None:
+        raise SignatureSetError(f"validator pubkey missing or invalid: {index}")
+    return pk
+
+
+def _sig(signature_bytes):
+    if isinstance(signature_bytes, (bytes, bytearray)):
+        try:
+            return g2_decompress(bytes(signature_bytes), subgroup_check=False)
+        except Exception as e:  # noqa: BLE001 — mirror DecodeError surface
+            raise SignatureSetError(f"undecodable signature: {e}") from e
+    return signature_bytes  # already an affine point / None
+
+
+# --------------------------------------------------------------- block/randao
+
+
+def block_proposal_signature_set(
+    get_pubkey, signed_header, fork, genesis_validators_root, spec
+):
+    """signature_sets.rs:74 — proposer signature over the block root.
+
+    Operates on the (header, signature) pair: hash_tree_root(block) ==
+    hash_tree_root(header) by SSZ construction, so header-based sets verify
+    full blocks.
+    """
+    header = signed_header.message
+    epoch = compute_epoch_at_slot(header.slot, spec.preset)
+    domain = spec.get_domain(
+        Domain.BEACON_PROPOSER, epoch, fork, genesis_validators_root
+    )
+    message = compute_signing_root(header, domain)
+    return SignatureSet(
+        _sig(signed_header.signature),
+        [_pubkey(get_pubkey, header.proposer_index)],
+        message,
+    )
+
+
+def randao_signature_set(
+    get_pubkey, proposer_index, epoch, randao_reveal, fork,
+    genesis_validators_root, spec,
+):
+    """signature_sets.rs:186 — RANDAO reveal signs hash_tree_root(epoch)."""
+    domain = spec.get_domain(Domain.RANDAO, epoch, fork, genesis_validators_root)
+    message = compute_signing_root_uint64(epoch, domain)
+    return SignatureSet(
+        _sig(randao_reveal), [_pubkey(get_pubkey, proposer_index)], message
+    )
+
+
+def compute_signing_root_uint64(value, domain):
+    root = hash_tree_root(uint64, value)
+    return hash_tree_root(SigningData(object_root=root, domain=bytes(domain)))
+
+
+# ------------------------------------------------------------------ slashings
+
+
+def proposer_slashing_signature_sets(
+    get_pubkey, slashing, fork, genesis_validators_root, spec
+):
+    """signature_sets.rs:223 — two header sets for the two conflicting blocks."""
+    return (
+        block_proposal_signature_set(
+            get_pubkey, slashing.signed_header_1, fork, genesis_validators_root, spec
+        ),
+        block_proposal_signature_set(
+            get_pubkey, slashing.signed_header_2, fork, genesis_validators_root, spec
+        ),
+    )
+
+
+def attester_slashing_signature_sets(
+    get_pubkey, slashing, fork, genesis_validators_root, spec
+):
+    """signature_sets.rs:335 — two indexed-attestation sets."""
+    return (
+        indexed_attestation_signature_set(
+            get_pubkey, slashing.attestation_1, fork, genesis_validators_root, spec
+        ),
+        indexed_attestation_signature_set(
+            get_pubkey, slashing.attestation_2, fork, genesis_validators_root, spec
+        ),
+    )
+
+
+# --------------------------------------------------------------- attestations
+
+
+def indexed_attestation_signature_set(
+    get_pubkey, indexed_attestation, fork, genesis_validators_root, spec
+):
+    """signature_sets.rs:271 — multi-pubkey set over AttestationData."""
+    data = indexed_attestation.data
+    domain = spec.get_domain(
+        Domain.BEACON_ATTESTER, data.target.epoch, fork, genesis_validators_root
+    )
+    message = compute_signing_root(data, domain)
+    pubkeys = [
+        _pubkey(get_pubkey, i) for i in indexed_attestation.attesting_indices
+    ]
+    return SignatureSet(_sig(indexed_attestation.signature), pubkeys, message)
+
+
+# ----------------------------------------------------------- deposits / exits
+
+
+def deposit_pubkey_signature_message(deposit_data, spec):
+    """signature_sets.rs:364 — deposit sets use only the genesis fork version
+    and an empty genesis_validators_root (proof-of-possession domain).
+    Returns (pubkey_bytes, message, signature_point) — deposits are verified
+    standalone, never in the block batch (block_signature_verifier.rs:124)."""
+    domain = compute_domain(
+        Domain.DEPOSIT, spec.genesis_fork_version, b"\x00" * 32
+    )
+    msg = DepositMessage(
+        pubkey=deposit_data.pubkey,
+        withdrawal_credentials=deposit_data.withdrawal_credentials,
+        amount=deposit_data.amount,
+    )
+    message = compute_signing_root(msg, domain)
+    return deposit_data.pubkey, message, _sig(deposit_data.signature)
+
+
+def exit_signature_set(
+    get_pubkey, signed_exit, fork, genesis_validators_root, spec
+):
+    """signature_sets.rs:377."""
+    exit_msg = signed_exit.message
+    domain = spec.get_domain(
+        Domain.VOLUNTARY_EXIT, exit_msg.epoch, fork, genesis_validators_root
+    )
+    message = compute_signing_root(exit_msg, domain)
+    return SignatureSet(
+        _sig(signed_exit.signature),
+        [_pubkey(get_pubkey, exit_msg.validator_index)],
+        message,
+    )
+
+
+# ----------------------------------------------------- aggregate-and-proof
+
+
+def signed_aggregate_selection_proof_signature_set(
+    get_pubkey, signed_aggregate, fork, genesis_validators_root, spec
+):
+    """signature_sets.rs:406 — selection proof signs the slot."""
+    msg = signed_aggregate.message
+    slot = msg.aggregate.data.slot
+    epoch = compute_epoch_at_slot(slot, spec.preset)
+    domain = spec.get_domain(
+        Domain.SELECTION_PROOF, epoch, fork, genesis_validators_root
+    )
+    message = compute_signing_root_uint64(slot, domain)
+    return SignatureSet(
+        _sig(msg.selection_proof),
+        [_pubkey(get_pubkey, msg.aggregator_index)],
+        message,
+    )
+
+
+def signed_aggregate_signature_set(
+    get_pubkey, signed_aggregate, fork, genesis_validators_root, spec
+):
+    """signature_sets.rs:436 — aggregator signs the AggregateAndProof."""
+    msg = signed_aggregate.message
+    epoch = compute_epoch_at_slot(msg.aggregate.data.slot, spec.preset)
+    domain = spec.get_domain(
+        Domain.AGGREGATE_AND_PROOF, epoch, fork, genesis_validators_root
+    )
+    message = compute_signing_root(msg, domain)
+    return SignatureSet(
+        _sig(signed_aggregate.signature),
+        [_pubkey(get_pubkey, msg.aggregator_index)],
+        message,
+    )
+
+
+# ------------------------------------------------------------ sync committee
+
+
+def signed_sync_aggregate_selection_proof_signature_set(
+    get_pubkey, signed_contribution, fork, genesis_validators_root, spec
+):
+    """signature_sets.rs:471 — SyncAggregatorSelectionData proof."""
+    msg = signed_contribution.message
+    contribution = msg.contribution
+    epoch = compute_epoch_at_slot(contribution.slot, spec.preset)
+    domain = spec.get_domain(
+        Domain.SYNC_COMMITTEE_SELECTION_PROOF, epoch, fork, genesis_validators_root
+    )
+    selection_data = SyncAggregatorSelectionData(
+        slot=contribution.slot,
+        subcommittee_index=contribution.subcommittee_index,
+    )
+    message = compute_signing_root(selection_data, domain)
+    return SignatureSet(
+        _sig(msg.selection_proof),
+        [_pubkey(get_pubkey, msg.aggregator_index)],
+        message,
+    )
+
+
+def signed_sync_aggregate_signature_set(
+    get_pubkey, signed_contribution, fork, genesis_validators_root, spec
+):
+    """signature_sets.rs:508 — aggregator signs the ContributionAndProof."""
+    msg = signed_contribution.message
+    epoch = compute_epoch_at_slot(msg.contribution.slot, spec.preset)
+    domain = spec.get_domain(
+        Domain.CONTRIBUTION_AND_PROOF, epoch, fork, genesis_validators_root
+    )
+    message = compute_signing_root(msg, domain)
+    return SignatureSet(
+        _sig(signed_contribution.signature),
+        [_pubkey(get_pubkey, msg.aggregator_index)],
+        message,
+    )
+
+
+def sync_committee_contribution_signature_set_from_pubkeys(
+    pubkeys, contribution, fork, genesis_validators_root, spec
+):
+    """signature_sets.rs:543 — participants sign the beacon block root."""
+    epoch = compute_epoch_at_slot(contribution.slot, spec.preset)
+    domain = spec.get_domain(
+        Domain.SYNC_COMMITTEE, epoch, fork, genesis_validators_root
+    )
+    message = compute_signing_root_bytes32(
+        contribution.beacon_block_root, domain
+    )
+    return SignatureSet(_sig(contribution.signature), list(pubkeys), message)
+
+
+def sync_committee_message_set_from_pubkeys(
+    pubkey, sync_message, fork, genesis_validators_root, spec
+):
+    """signature_sets.rs:569 — single sync-committee message."""
+    epoch = compute_epoch_at_slot(sync_message.slot, spec.preset)
+    domain = spec.get_domain(
+        Domain.SYNC_COMMITTEE, epoch, fork, genesis_validators_root
+    )
+    message = compute_signing_root_bytes32(
+        sync_message.beacon_block_root, domain
+    )
+    return SignatureSet(_sig(sync_message.signature), [pubkey], message)
+
+
+def compute_signing_root_bytes32(root, domain):
+    return hash_tree_root(
+        SigningData(object_root=bytes(root), domain=bytes(domain))
+    )
+
+
+_INFINITY_SIG_BYTES = bytes([0xC0]) + bytes(95)
+
+
+def sync_aggregate_signature_set(
+    participant_pubkeys, sync_aggregate, slot, block_root, fork,
+    genesis_validators_root, spec,
+):
+    """signature_sets.rs:611-617 — the infinity-signature special case: an
+    empty-participation aggregate with the infinity signature is vacuously
+    valid and produces NO set (returns None)."""
+    if (
+        not any(sync_aggregate.sync_committee_bits)
+        and bytes(sync_aggregate.sync_committee_signature) == _INFINITY_SIG_BYTES
+    ):
+        return None
+    epoch = compute_epoch_at_slot(slot, spec.preset)
+    domain = spec.get_domain(
+        Domain.SYNC_COMMITTEE, epoch, fork, genesis_validators_root
+    )
+    message = compute_signing_root_bytes32(block_root, domain)
+    return SignatureSet(
+        _sig(sync_aggregate.sync_committee_signature),
+        list(participant_pubkeys),
+        message,
+    )
+
+
+# ------------------------------------------------------------ capella change
+
+
+def bls_execution_change_signature_set(signed_change, genesis_validators_root, spec):
+    """signature_sets.rs BLS-to-execution-change: genesis-fork-version domain
+    (with the real genesis_validators_root, per capella spec), and the pubkey
+    comes from the message itself (not the validator registry)."""
+    domain = compute_domain(
+        Domain.BLS_TO_EXECUTION_CHANGE,
+        spec.genesis_fork_version,
+        genesis_validators_root,
+    )
+    message = compute_signing_root(signed_change.message, domain)
+    from ..crypto.ref.curves import g1_decompress
+
+    pk = g1_decompress(bytes(signed_change.message.from_bls_pubkey))
+    return SignatureSet(_sig(signed_change.signature), [pk], message)

@@ -1,0 +1,6 @@
+"""Test fixtures — mirror of the reference's testing ladder (SURVEY.md §4):
+`BeaconChainHarness` (beacon_chain/src/test_utils.rs) becomes `Harness`."""
+
+from .harness import Harness
+
+__all__ = ["Harness"]

@@ -1,0 +1,163 @@
+"""In-process chain harness: deterministic keys, block production, attesting.
+
+Mirror of /root/reference/beacon_node/beacon_chain/src/test_utils.rs
+(BeaconChainHarness, 2,221 LoC): drive a real state-transition with
+deterministic interop validators, produce signed blocks and full-committee
+attestations, and step slots/epochs — the fixture every higher-layer test
+builds on (the reference's extend_chain / add_attested_blocks_at_slots).
+"""
+
+from ..crypto.ref import bls as RB
+from ..crypto.ref.curves import g2_compress
+from ..ssz import hash_tree_root
+from ..types import Domain, compute_epoch_at_slot, compute_signing_root
+from ..types.containers import AttestationData, Checkpoint
+from ..types.state import state_types
+from ..state_processing import signature_sets as sset
+from ..state_processing.genesis import interop_genesis_state, interop_keypairs
+from ..state_processing.phase0 import (
+    BlockSignatureStrategy,
+    get_beacon_committee,
+    get_beacon_proposer_index,
+    get_block_root_at_slot,
+    get_committee_count_per_slot,
+    get_current_epoch,
+    per_block_processing,
+    process_slots,
+)
+
+
+class Harness:
+    def __init__(self, n_validators, spec, genesis_time=0):
+        self.spec = spec
+        self.preset = spec.preset
+        self.T = state_types(spec.preset)
+        self.keypairs = interop_keypairs(n_validators)
+        self.state = interop_genesis_state(self.keypairs, genesis_time, spec)
+        self.blocks = {}  # root -> SignedBeaconBlock
+
+    # ------------------------------------------------------------- signing
+
+    def _sk(self, validator_index):
+        return self.keypairs[validator_index][0]
+
+    def _sign_root(self, validator_index, root):
+        return g2_compress(RB.sign(self._sk(validator_index), root))
+
+    # ------------------------------------------------------- block producer
+
+    def produce_block(self, slot, attestations=()):
+        """Build a valid signed block at `slot` on the current state."""
+        spec, preset = self.spec, self.preset
+        state = self.state.copy()
+        if state.slot < slot:
+            process_slots(state, slot, preset)
+        proposer = get_beacon_proposer_index(state, preset)
+        epoch = get_current_epoch(state, preset)
+
+        domain = spec.get_domain(
+            Domain.RANDAO, epoch, state.fork, state.genesis_validators_root
+        )
+        randao_reveal = self._sign_root(
+            proposer, sset.compute_signing_root_uint64(epoch, domain)
+        )
+
+        body = self.T.BeaconBlockBody(
+            randao_reveal=randao_reveal,
+            eth1_data=state.eth1_data,
+            attestations=list(attestations),
+        )
+        block = self.T.BeaconBlock(
+            slot=slot,
+            proposer_index=proposer,
+            parent_root=hash_tree_root(state.latest_block_header),
+            state_root=bytes(32),
+            body=body,
+        )
+        # compute the post-state root
+        tmp = state.copy()
+        per_block_processing(
+            tmp,
+            self.T.SignedBeaconBlock(message=block),
+            spec,
+            signature_strategy=BlockSignatureStrategy.NO_VERIFICATION,
+        )
+        block.state_root = hash_tree_root(tmp)
+
+        pd = spec.get_domain(
+            Domain.BEACON_PROPOSER, epoch, state.fork, state.genesis_validators_root
+        )
+        sig = self._sign_root(proposer, compute_signing_root(block, pd))
+        return self.T.SignedBeaconBlock(message=block, signature=sig)
+
+    # ----------------------------------------------------------- attesters
+
+    def attest_slot(self, state, slot, head_root):
+        """Full-participation attestations for every committee at `slot`."""
+        spec, preset = self.spec, self.preset
+        epoch = slot // preset.slots_per_epoch
+        start_slot = epoch * preset.slots_per_epoch
+        if start_slot == state.slot or start_slot >= slot:
+            target_root = head_root
+        else:
+            target_root = get_block_root_at_slot(state, start_slot, preset)
+        out = []
+        for index in range(get_committee_count_per_slot(state, epoch, preset)):
+            committee = get_beacon_committee(state, slot, index, preset)
+            data = AttestationData(
+                slot=slot,
+                index=index,
+                beacon_block_root=head_root,
+                source=state.current_justified_checkpoint,
+                target=Checkpoint(epoch=epoch, root=target_root),
+            )
+            domain = spec.get_domain(
+                Domain.BEACON_ATTESTER, epoch, state.fork,
+                state.genesis_validators_root,
+            )
+            root = compute_signing_root(data, domain)
+            sig = RB.aggregate([RB.sign(self._sk(i), root) for i in committee])
+            out.append(
+                self.T.Attestation(
+                    aggregation_bits=[1] * len(committee),
+                    data=data,
+                    signature=g2_compress(sig),
+                )
+            )
+        return out
+
+    # ------------------------------------------------------------ chain ops
+
+    def process_block(self, signed_block, strategy=BlockSignatureStrategy.VERIFY_BULK,
+                      verify_fn=None):
+        """Advance self.state through the block (slots + block processing)."""
+        slot = signed_block.message.slot
+        if self.state.slot < slot:
+            process_slots(self.state, slot, self.preset)
+        per_block_processing(
+            self.state, signed_block, self.spec,
+            signature_strategy=strategy, verify_fn=verify_fn,
+        )
+        assert signed_block.message.state_root == hash_tree_root(self.state), (
+            "state root mismatch"
+        )
+        root = hash_tree_root(signed_block.message)
+        self.blocks[root] = signed_block
+        return root
+
+    def extend_chain(self, n_slots, attested=True, strategy=None, verify_fn=None):
+        """Produce+process `n_slots` blocks, attesting at every slot
+        (test_utils.rs extend_chain with AttestationStrategy::AllValidators)."""
+        strategy = strategy or BlockSignatureStrategy.VERIFY_BULK
+        pending_atts = []
+        roots = []
+        for _ in range(n_slots):
+            slot = self.state.slot + 1
+            block = self.produce_block(slot, attestations=pending_atts)
+            root = self.process_block(block, strategy=strategy, verify_fn=verify_fn)
+            roots.append(root)
+            if attested:
+                pending_atts = self.attest_slot(self.state, slot, root)
+            else:
+                pending_atts = []
+        return roots

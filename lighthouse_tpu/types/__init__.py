@@ -1,0 +1,58 @@
+"""Consensus types — mirror of /root/reference/consensus/types (SURVEY.md §2.3).
+
+Phase0/Altair-focused container set sufficient for every signature-set shape
+in /root/reference/consensus/state_processing/src/per_block_processing/
+signature_sets.rs, plus the spec/preset machinery (`EthSpec` → `Preset`,
+`ChainSpec` → `ChainSpec`) and domain/signing-root helpers.
+"""
+
+from .containers import (
+    AggregateAndProof,
+    Attestation,
+    AttestationData,
+    AttesterSlashing,
+    BeaconBlockHeader,
+    BLSToExecutionChange,
+    Checkpoint,
+    ContributionAndProof,
+    DepositData,
+    DepositMessage,
+    Fork,
+    ForkData,
+    IndexedAttestation,
+    ProposerSlashing,
+    SignedAggregateAndProof,
+    SignedBeaconBlockHeader,
+    SignedBLSToExecutionChange,
+    SignedContributionAndProof,
+    SignedVoluntaryExit,
+    SigningData,
+    SyncAggregate,
+    SyncCommitteeContribution,
+    SyncCommitteeMessage,
+    VoluntaryExit,
+)
+from .spec import (
+    ChainSpec,
+    MainnetPreset,
+    MinimalPreset,
+    Domain,
+    compute_domain,
+    compute_epoch_at_slot,
+    compute_fork_data_root,
+    compute_signing_root,
+)
+
+__all__ = [
+    "AggregateAndProof", "Attestation", "AttestationData", "AttesterSlashing",
+    "BeaconBlockHeader", "BLSToExecutionChange", "Checkpoint",
+    "ContributionAndProof", "DepositData", "DepositMessage", "Fork",
+    "ForkData", "IndexedAttestation", "ProposerSlashing",
+    "SignedAggregateAndProof", "SignedBeaconBlockHeader",
+    "SignedBLSToExecutionChange", "SignedContributionAndProof",
+    "SignedVoluntaryExit", "SigningData", "SyncAggregate",
+    "SyncCommitteeContribution", "SyncCommitteeMessage", "VoluntaryExit",
+    "ChainSpec", "MainnetPreset", "MinimalPreset", "Domain",
+    "compute_domain", "compute_epoch_at_slot", "compute_fork_data_root",
+    "compute_signing_root",
+]

@@ -1,0 +1,221 @@
+"""SSZ containers for the signature-bearing consensus objects.
+
+Field layouts follow the Ethereum consensus spec (phase0 + altair +
+capella's BLSToExecutionChange), i.e. the same shapes as
+/root/reference/consensus/types/src/*.rs.  Sizes use the mainnet preset
+constants where a typenum bound is required; `Preset`-parameterized types
+take the bound from the preset at class-build time via `for_preset`.
+"""
+
+from ..ssz import (
+    Bitlist,
+    Bitvector,
+    Bytes4,
+    Bytes20,
+    Bytes32,
+    Bytes48,
+    Bytes96,
+    Container,
+    List,
+    uint64,
+)
+
+# mainnet preset bounds (preset-parameterized types below take overrides)
+MAX_VALIDATORS_PER_COMMITTEE = 2048
+SYNC_COMMITTEE_SIZE = 512
+SYNC_COMMITTEE_SUBNET_COUNT = 4
+
+
+class Fork(Container):
+    fields = [
+        ("previous_version", Bytes4),
+        ("current_version", Bytes4),
+        ("epoch", uint64),
+    ]
+
+
+class ForkData(Container):
+    fields = [
+        ("current_version", Bytes4),
+        ("genesis_validators_root", Bytes32),
+    ]
+
+
+class SigningData(Container):
+    fields = [
+        ("object_root", Bytes32),
+        ("domain", Bytes32),
+    ]
+
+
+class Checkpoint(Container):
+    fields = [
+        ("epoch", uint64),
+        ("root", Bytes32),
+    ]
+
+
+class AttestationData(Container):
+    fields = [
+        ("slot", uint64),
+        ("index", uint64),
+        ("beacon_block_root", Bytes32),
+        ("source", Checkpoint),
+        ("target", Checkpoint),
+    ]
+
+
+class IndexedAttestation(Container):
+    fields = [
+        ("attesting_indices", List(uint64, MAX_VALIDATORS_PER_COMMITTEE)),
+        ("data", AttestationData),
+        ("signature", Bytes96),
+    ]
+
+
+class Attestation(Container):
+    fields = [
+        ("aggregation_bits", Bitlist(MAX_VALIDATORS_PER_COMMITTEE)),
+        ("data", AttestationData),
+        ("signature", Bytes96),
+    ]
+
+
+class BeaconBlockHeader(Container):
+    fields = [
+        ("slot", uint64),
+        ("proposer_index", uint64),
+        ("parent_root", Bytes32),
+        ("state_root", Bytes32),
+        ("body_root", Bytes32),
+    ]
+
+
+class SignedBeaconBlockHeader(Container):
+    fields = [
+        ("message", BeaconBlockHeader),
+        ("signature", Bytes96),
+    ]
+
+
+class ProposerSlashing(Container):
+    fields = [
+        ("signed_header_1", SignedBeaconBlockHeader),
+        ("signed_header_2", SignedBeaconBlockHeader),
+    ]
+
+
+class AttesterSlashing(Container):
+    fields = [
+        ("attestation_1", IndexedAttestation),
+        ("attestation_2", IndexedAttestation),
+    ]
+
+
+class DepositMessage(Container):
+    fields = [
+        ("pubkey", Bytes48),
+        ("withdrawal_credentials", Bytes32),
+        ("amount", uint64),
+    ]
+
+
+class DepositData(Container):
+    fields = [
+        ("pubkey", Bytes48),
+        ("withdrawal_credentials", Bytes32),
+        ("amount", uint64),
+        ("signature", Bytes96),
+    ]
+
+
+class VoluntaryExit(Container):
+    fields = [
+        ("epoch", uint64),
+        ("validator_index", uint64),
+    ]
+
+
+class SignedVoluntaryExit(Container):
+    fields = [
+        ("message", VoluntaryExit),
+        ("signature", Bytes96),
+    ]
+
+
+class AggregateAndProof(Container):
+    fields = [
+        ("aggregator_index", uint64),
+        ("aggregate", Attestation),
+        ("selection_proof", Bytes96),
+    ]
+
+
+class SignedAggregateAndProof(Container):
+    fields = [
+        ("message", AggregateAndProof),
+        ("signature", Bytes96),
+    ]
+
+
+class SyncAggregate(Container):
+    fields = [
+        ("sync_committee_bits", Bitvector(SYNC_COMMITTEE_SIZE)),
+        ("sync_committee_signature", Bytes96),
+    ]
+
+
+class SyncCommitteeMessage(Container):
+    fields = [
+        ("slot", uint64),
+        ("beacon_block_root", Bytes32),
+        ("validator_index", uint64),
+        ("signature", Bytes96),
+    ]
+
+
+class SyncCommitteeContribution(Container):
+    fields = [
+        ("slot", uint64),
+        ("beacon_block_root", Bytes32),
+        ("subcommittee_index", uint64),
+        ("aggregation_bits", Bitvector(SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT)),
+        ("signature", Bytes96),
+    ]
+
+
+class ContributionAndProof(Container):
+    fields = [
+        ("aggregator_index", uint64),
+        ("contribution", SyncCommitteeContribution),
+        ("selection_proof", Bytes96),
+    ]
+
+
+class SignedContributionAndProof(Container):
+    fields = [
+        ("message", ContributionAndProof),
+        ("signature", Bytes96),
+    ]
+
+
+class BLSToExecutionChange(Container):
+    fields = [
+        ("validator_index", uint64),
+        ("from_bls_pubkey", Bytes48),
+        ("to_execution_address", Bytes20),
+    ]
+
+
+class SignedBLSToExecutionChange(Container):
+    fields = [
+        ("message", BLSToExecutionChange),
+        ("signature", Bytes96),
+    ]
+
+
+class SyncAggregatorSelectionData(Container):
+    fields = [
+        ("slot", uint64),
+        ("subcommittee_index", uint64),
+    ]

@@ -1,0 +1,176 @@
+"""Presets, ChainSpec, domains and signing-root computation.
+
+Mirror of the reference's `EthSpec` trait + `ChainSpec`
+(/root/reference/consensus/types/src/eth_spec.rs:51 and chain_spec.rs):
+compile-time preset constants become `Preset` dataclass instances
+(MainnetPreset / MinimalPreset), runtime network constants become
+`ChainSpec` with the fork schedule and `get_domain`
+(chain_spec.rs `get_domain`, spec `compute_domain`).
+"""
+
+from dataclasses import dataclass, field
+
+from ..ssz import hash_tree_root
+from .containers import Fork, ForkData, SigningData
+
+
+class Domain:
+    """Domain types (spec constants; chain_spec.rs Domain enum)."""
+
+    BEACON_PROPOSER = 0
+    BEACON_ATTESTER = 1
+    RANDAO = 2
+    DEPOSIT = 3
+    VOLUNTARY_EXIT = 4
+    SELECTION_PROOF = 5
+    AGGREGATE_AND_PROOF = 6
+    SYNC_COMMITTEE = 7
+    SYNC_COMMITTEE_SELECTION_PROOF = 8
+    CONTRIBUTION_AND_PROOF = 9
+    BLS_TO_EXECUTION_CHANGE = 10
+
+    @staticmethod
+    def to_bytes(domain_type: int) -> bytes:
+        return int(domain_type).to_bytes(4, "little")
+
+
+@dataclass(frozen=True)
+class Preset:
+    """Compile-time preset constants (EthSpec associated consts)."""
+
+    name: str
+    slots_per_epoch: int
+    max_validators_per_committee: int
+    sync_committee_size: int
+    epochs_per_sync_committee_period: int
+    max_committees_per_slot: int
+    target_committee_size: int
+    validator_registry_limit: int
+    slots_per_historical_root: int
+    epochs_per_historical_vector: int
+    epochs_per_slashings_vector: int
+    historical_roots_limit: int
+    max_proposer_slashings: int = 16
+    max_attester_slashings: int = 2
+    max_attestations: int = 128
+    max_deposits: int = 16
+    max_voluntary_exits: int = 16
+    max_bls_to_execution_changes: int = 16
+    sync_committee_subnet_count: int = 4
+
+
+MainnetPreset = Preset(
+    name="mainnet",
+    slots_per_epoch=32,
+    max_validators_per_committee=2048,
+    sync_committee_size=512,
+    epochs_per_sync_committee_period=256,
+    max_committees_per_slot=64,
+    target_committee_size=128,
+    validator_registry_limit=2**40,
+    slots_per_historical_root=8192,
+    epochs_per_historical_vector=65536,
+    epochs_per_slashings_vector=8192,
+    historical_roots_limit=2**24,
+)
+
+MinimalPreset = Preset(
+    name="minimal",
+    slots_per_epoch=8,
+    max_validators_per_committee=2048,
+    sync_committee_size=32,
+    epochs_per_sync_committee_period=8,
+    max_committees_per_slot=4,
+    target_committee_size=4,
+    validator_registry_limit=2**40,
+    slots_per_historical_root=64,
+    epochs_per_historical_vector=64,
+    epochs_per_slashings_vector=64,
+    historical_roots_limit=2**24,
+)
+
+
+@dataclass
+class ChainSpec:
+    """Runtime network constants + fork schedule (chain_spec.rs)."""
+
+    preset: Preset = MainnetPreset
+    genesis_fork_version: bytes = b"\x00\x00\x00\x00"
+    altair_fork_version: bytes = b"\x01\x00\x00\x00"
+    bellatrix_fork_version: bytes = b"\x02\x00\x00\x00"
+    capella_fork_version: bytes = b"\x03\x00\x00\x00"
+    altair_fork_epoch: int | None = None
+    bellatrix_fork_epoch: int | None = None
+    capella_fork_epoch: int | None = None
+    seconds_per_slot: int = 12
+    min_genesis_time: int = 0
+    shard_committee_period: int = 256
+    min_validator_withdrawability_delay: int = 256
+    max_seed_lookahead: int = 4
+    min_seed_lookahead: int = 1
+
+    def fork_name_at_epoch(self, epoch):
+        if self.capella_fork_epoch is not None and epoch >= self.capella_fork_epoch:
+            return "capella"
+        if self.bellatrix_fork_epoch is not None and epoch >= self.bellatrix_fork_epoch:
+            return "bellatrix"
+        if self.altair_fork_epoch is not None and epoch >= self.altair_fork_epoch:
+            return "altair"
+        return "base"
+
+    def fork_version_at_epoch(self, epoch):
+        return {
+            "capella": self.capella_fork_version,
+            "bellatrix": self.bellatrix_fork_version,
+            "altair": self.altair_fork_version,
+            "base": self.genesis_fork_version,
+        }[self.fork_name_at_epoch(epoch)]
+
+    def fork_at_epoch(self, epoch):
+        """The Fork container a state at `epoch` would carry."""
+        schedule = [(0, self.genesis_fork_version)]
+        for e, v in (
+            (self.altair_fork_epoch, self.altair_fork_version),
+            (self.bellatrix_fork_epoch, self.bellatrix_fork_version),
+            (self.capella_fork_epoch, self.capella_fork_version),
+        ):
+            if e is not None:
+                schedule.append((e, v))
+        prev_v, cur_v, cur_e = schedule[0][1], schedule[0][1], 0
+        for e, v in schedule[1:]:
+            if epoch >= e:
+                prev_v, cur_v, cur_e = cur_v, v, e
+        return Fork(previous_version=prev_v, current_version=cur_v, epoch=cur_e)
+
+    def get_domain(self, domain_type, epoch, fork, genesis_validators_root):
+        """chain_spec.rs get_domain: fork-version-aware domain bytes."""
+        fork_version = (
+            fork.previous_version if epoch < fork.epoch else fork.current_version
+        )
+        return compute_domain(domain_type, fork_version, genesis_validators_root)
+
+
+def compute_epoch_at_slot(slot, preset=MainnetPreset):
+    return slot // preset.slots_per_epoch
+
+
+def compute_fork_data_root(current_version, genesis_validators_root):
+    return hash_tree_root(
+        ForkData(
+            current_version=current_version,
+            genesis_validators_root=genesis_validators_root,
+        )
+    )
+
+
+def compute_domain(domain_type, fork_version, genesis_validators_root):
+    fork_data_root = compute_fork_data_root(fork_version, genesis_validators_root)
+    return Domain.to_bytes(domain_type) + fork_data_root[:28]
+
+
+def compute_signing_root(obj, domain) -> bytes:
+    """SigningData{object_root, domain}.hash_tree_root()
+    (signature_sets.rs:142-150)."""
+    return hash_tree_root(
+        SigningData(object_root=hash_tree_root(obj), domain=bytes(domain))
+    )

@@ -1,0 +1,174 @@
+"""BeaconState, blocks, and registry containers — preset-parameterized.
+
+Mirror of /root/reference/consensus/types/src/{beacon_state,beacon_block,
+validator,...}.rs.  The reference parameterizes container bounds with the
+`EthSpec` trait at compile time (eth_spec.rs:51); here `state_types(preset)`
+builds (and caches) the bound-specialized classes per `Preset` — mainnet
+and minimal get distinct, correctly-bounded SSZ types.
+
+Phase0 container set (the altair+ additions ride on the same factory as
+they land).
+"""
+
+from functools import lru_cache
+
+from ..ssz import (
+    Bitlist,
+    Bitvector,
+    Boolean,
+    Bytes32,
+    Bytes48,
+    Bytes96,
+    ByteVector,
+    Container,
+    List,
+    Vector,
+    uint64,
+)
+from .containers import (
+    AttestationData,
+    AttesterSlashing,
+    Checkpoint,
+    DepositData,
+    Fork,
+    BeaconBlockHeader,
+    ProposerSlashing,
+    SignedVoluntaryExit,
+    SyncAggregate,
+)
+
+JUSTIFICATION_BITS_LENGTH = 4
+DEPOSIT_CONTRACT_TREE_DEPTH = 32
+
+
+class Validator(Container):
+    fields = [
+        ("pubkey", Bytes48),
+        ("withdrawal_credentials", Bytes32),
+        ("effective_balance", uint64),
+        ("slashed", Boolean()),
+        ("activation_eligibility_epoch", uint64),
+        ("activation_epoch", uint64),
+        ("exit_epoch", uint64),
+        ("withdrawable_epoch", uint64),
+    ]
+
+
+class Eth1Data(Container):
+    fields = [
+        ("deposit_root", Bytes32),
+        ("deposit_count", uint64),
+        ("block_hash", Bytes32),
+    ]
+
+
+class Deposit(Container):
+    fields = [
+        ("proof", Vector(Bytes32, DEPOSIT_CONTRACT_TREE_DEPTH + 1)),
+        ("data", DepositData),
+    ]
+
+
+@lru_cache(maxsize=None)
+def state_types(preset):
+    """Build the preset-bound container classes (cached per Preset)."""
+
+    class Attestation(Container):
+        fields = [
+            ("aggregation_bits", Bitlist(preset.max_validators_per_committee)),
+            ("data", AttestationData),
+            ("signature", Bytes96),
+        ]
+
+    class PendingAttestation(Container):
+        fields = [
+            ("aggregation_bits", Bitlist(preset.max_validators_per_committee)),
+            ("data", AttestationData),
+            ("inclusion_delay", uint64),
+            ("proposer_index", uint64),
+        ]
+
+    class IndexedAttestation(Container):
+        fields = [
+            ("attesting_indices", List(uint64, preset.max_validators_per_committee)),
+            ("data", AttestationData),
+            ("signature", Bytes96),
+        ]
+
+    class BeaconBlockBody(Container):
+        fields = [
+            ("randao_reveal", Bytes96),
+            ("eth1_data", Eth1Data),
+            ("graffiti", Bytes32),
+            ("proposer_slashings", List(ProposerSlashing, preset.max_proposer_slashings)),
+            ("attester_slashings", List(AttesterSlashing, preset.max_attester_slashings)),
+            ("attestations", List(Attestation, preset.max_attestations)),
+            ("deposits", List(Deposit, preset.max_deposits)),
+            ("voluntary_exits", List(SignedVoluntaryExit, preset.max_voluntary_exits)),
+        ]
+
+    class BeaconBlock(Container):
+        fields = [
+            ("slot", uint64),
+            ("proposer_index", uint64),
+            ("parent_root", Bytes32),
+            ("state_root", Bytes32),
+            ("body", BeaconBlockBody),
+        ]
+
+    class SignedBeaconBlock(Container):
+        fields = [
+            ("message", BeaconBlock),
+            ("signature", Bytes96),
+        ]
+
+    class HistoricalBatch(Container):
+        fields = [
+            ("block_roots", Vector(Bytes32, preset.slots_per_historical_root)),
+            ("state_roots", Vector(Bytes32, preset.slots_per_historical_root)),
+        ]
+
+    class BeaconState(Container):
+        fields = [
+            ("genesis_time", uint64),
+            ("genesis_validators_root", Bytes32),
+            ("slot", uint64),
+            ("fork", Fork),
+            ("latest_block_header", BeaconBlockHeader),
+            ("block_roots", Vector(Bytes32, preset.slots_per_historical_root)),
+            ("state_roots", Vector(Bytes32, preset.slots_per_historical_root)),
+            ("historical_roots", List(Bytes32, preset.historical_roots_limit)),
+            ("eth1_data", Eth1Data),
+            ("eth1_data_votes", List(
+                Eth1Data, preset.slots_per_epoch * 64  # EPOCHS_PER_ETH1_VOTING_PERIOD
+            )),
+            ("eth1_deposit_index", uint64),
+            ("validators", List(Validator, preset.validator_registry_limit)),
+            ("balances", List(uint64, preset.validator_registry_limit)),
+            ("randao_mixes", Vector(Bytes32, preset.epochs_per_historical_vector)),
+            ("slashings", Vector(uint64, preset.epochs_per_slashings_vector)),
+            ("previous_epoch_attestations", List(
+                PendingAttestation, preset.max_attestations * preset.slots_per_epoch
+            )),
+            ("current_epoch_attestations", List(
+                PendingAttestation, preset.max_attestations * preset.slots_per_epoch
+            )),
+            ("justification_bits", Bitvector(JUSTIFICATION_BITS_LENGTH)),
+            ("previous_justified_checkpoint", Checkpoint),
+            ("current_justified_checkpoint", Checkpoint),
+            ("finalized_checkpoint", Checkpoint),
+        ]
+
+    ns = type("StateTypes", (), {})
+    ns.Attestation = Attestation
+    ns.PendingAttestation = PendingAttestation
+    ns.IndexedAttestation = IndexedAttestation
+    ns.BeaconBlockBody = BeaconBlockBody
+    ns.BeaconBlock = BeaconBlock
+    ns.SignedBeaconBlock = SignedBeaconBlock
+    ns.HistoricalBatch = HistoricalBatch
+    ns.BeaconState = BeaconState
+    ns.Validator = Validator
+    ns.Eth1Data = Eth1Data
+    ns.Deposit = Deposit
+    return ns

@@ -26,3 +26,7 @@ jax.config.update("jax_platforms", "cpu")
 # are compile-heavy; cache across test runs.
 jax.config.update("jax_compilation_cache_dir", "/tmp/lighthouse_tpu_xla_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running device tests")

@@ -1,0 +1,120 @@
+"""EF-bls-handler-style conformance cases, oracle-driven.
+
+The EF consensus-spec-tests BLS vectors are not vendored in this image
+(testing/ef_tests downloads them by tag — /root/reference/testing/ef_tests/
+Makefile:10-16), so these tests reproduce the HANDLER semantics
+(/root/reference/testing/ef_tests/src/cases/bls_*.rs: sign, verify,
+aggregate, fast_aggregate_verify, aggregate_verify, batch_verify) over
+deterministic locally-generated cases, including every edge case the EF
+suite is known to probe: infinity pubkeys/signatures, empty inputs,
+wrong-message, non-subgroup points, and serialization round-trips.
+"""
+
+import random
+
+import pytest
+
+from lighthouse_tpu.crypto.constants import P, R
+from lighthouse_tpu.crypto.ref import bls as B
+from lighthouse_tpu.crypto.ref import curves as C
+
+rng = random.Random(0xEF)
+
+SK = [rng.randrange(1, R) for _ in range(4)]
+PK = [B.sk_to_pk(sk) for sk in SK]
+MSG = [bytes([i]) * 32 for i in range(4)]
+
+
+def test_sign_verify():
+    for sk, pk, m in zip(SK, PK, MSG):
+        sig = B.sign(sk, m)
+        assert B.verify(pk, m, sig)
+        assert not B.verify(pk, b"\xff" * 32, sig)
+        assert not B.verify(PK[(PK.index(pk) + 1) % 4], m, sig)
+
+
+def test_verify_rejects_infinity():
+    sig = B.sign(SK[0], MSG[0])
+    assert not B.verify(None, MSG[0], sig)
+    assert not B.verify(PK[0], MSG[0], None)
+
+
+def test_aggregate_verify_distinct_messages():
+    sigs = [B.sign(sk, m) for sk, m in zip(SK, MSG)]
+    agg = B.aggregate(sigs)
+    assert B.aggregate_verify(PK, MSG, agg)
+    assert not B.aggregate_verify(PK, MSG[::-1], agg)
+    assert not B.aggregate_verify(PK[:3], MSG[:3], agg)
+
+
+def test_fast_aggregate_verify_common_message():
+    m = b"\x42" * 32
+    sigs = [B.sign(sk, m) for sk in SK]
+    agg = B.aggregate(sigs)
+    assert B.fast_aggregate_verify(PK, m, agg)
+    assert not B.fast_aggregate_verify(PK[:2], m, agg)
+    assert not B.fast_aggregate_verify([], m, agg)
+    assert not B.fast_aggregate_verify(PK + [None], m, agg)
+
+
+def test_g1_serialization_roundtrip_and_flags():
+    for pk in PK:
+        b48 = C.g1_compress(pk)
+        assert len(b48) == 48
+        assert b48[0] & 0x80  # compression flag
+        assert C.g1_decompress(b48) == pk
+    inf = C.g1_compress(None)
+    assert inf[0] == 0xC0 and inf[1:] == bytes(47)
+    assert C.g1_decompress(inf, subgroup_check=False) is None
+
+
+def test_g2_serialization_roundtrip():
+    for sk, m in zip(SK, MSG):
+        sig = B.sign(sk, m)
+        b96 = C.g2_compress(sig)
+        assert len(b96) == 96
+        assert C.g2_decompress(b96) == sig
+    assert C.g2_compress(None)[0] == 0xC0
+
+
+def test_decompress_rejects_bad_encodings():
+    good = C.g1_compress(PK[0])
+    # clear compression bit
+    with pytest.raises(Exception):
+        C.g1_decompress(bytes([good[0] & 0x7F]) + good[1:])
+    # x >= p
+    bad_x = bytes([0x9F]) + b"\xff" * 47
+    with pytest.raises(Exception):
+        C.g1_decompress(bad_x)
+
+
+def test_batch_verify_matches_individual():
+    sets = []
+    for sk, pk, m in zip(SK, PK, MSG):
+        sets.append(B.SignatureSet(B.sign(sk, m), [pk], m))
+    assert B.verify_signature_sets(sets)
+    # corrupt one member: batch must fail even though others verify
+    bad = B.SignatureSet(B.sign(SK[0], MSG[1]), [PK[0]], MSG[0])
+    assert not B.verify_signature_sets(sets + [bad])
+
+
+def test_batch_verify_empty_and_structural():
+    assert B.verify_signature_sets([]) is False
+    s = B.SignatureSet(B.sign(SK[0], MSG[0]), [], MSG[0])
+    assert B.verify_signature_sets([s]) is False
+
+
+@pytest.mark.slow
+def test_tpu_backend_agrees_on_conformance_corpus():
+    """The corpus above, through the device kernel — the bls_batch_verify
+    conformance gate (testing/ef_tests/src/cases/bls_batch_verify.rs:25-67)."""
+    from lighthouse_tpu.crypto.tpu import bls as tb
+
+    sets = [
+        B.SignatureSet(B.sign(sk, m), [pk], m)
+        for sk, pk, m in zip(SK, PK, MSG)
+    ]
+    assert tb.verify_signature_sets(sets) is True
+    bad = B.SignatureSet(B.sign(SK[0], MSG[1]), [PK[0]], MSG[0])
+    assert tb.verify_signature_sets(sets + [bad]) is False
+    assert tb.verify_signature_sets_per_set(sets + [bad]) == [True] * 4 + [False]

@@ -1,0 +1,127 @@
+"""Proto-array fork choice scenario tests.
+
+Hand-built scenarios mirroring the reference's YAML vector semantics
+(/root/reference/consensus/proto_array/src/fork_choice_test_definition/):
+vote application, re-orgs, justification filtering, proposer boost,
+pruning, and execution invalidation.
+"""
+
+import pytest
+
+from lighthouse_tpu.fork_choice import ProtoArrayForkChoice
+
+
+def h(i):
+    return bytes([i]) * 32
+
+
+def test_linear_chain_head_is_tip():
+    fc = ProtoArrayForkChoice(h(0))
+    fc.on_block(h(1), h(0), 0, 0, slot=1)
+    fc.on_block(h(2), h(1), 0, 0, slot=2)
+    assert fc.find_head(h(0), {}) == h(2)
+
+
+def test_votes_move_head_between_forks():
+    fc = ProtoArrayForkChoice(h(0))
+    fc.on_block(h(1), h(0), 0, 0, slot=1)   # fork A
+    fc.on_block(h(2), h(0), 0, 0, slot=1)   # fork B
+    bal = {0: 32, 1: 32, 2: 32}
+    fc.process_attestation(0, h(1), 1)
+    fc.process_attestation(1, h(2), 1)
+    fc.process_attestation(2, h(2), 1)
+    assert fc.find_head(h(0), bal) == h(2)
+    # validators migrate to fork A in a later epoch
+    fc.process_attestation(1, h(1), 2)
+    fc.process_attestation(2, h(1), 2)
+    assert fc.find_head(h(0), bal) == h(1)
+
+
+def test_stale_vote_does_not_regress():
+    fc = ProtoArrayForkChoice(h(0))
+    fc.on_block(h(1), h(0), 0, 0)
+    fc.on_block(h(2), h(0), 0, 0)
+    fc.process_attestation(0, h(1), 5)
+    fc.process_attestation(0, h(2), 3)   # older target epoch: ignored
+    assert fc.find_head(h(0), {0: 32}) == h(1)
+
+
+def test_balance_changes_reweight():
+    fc = ProtoArrayForkChoice(h(0))
+    fc.on_block(h(1), h(0), 0, 0)
+    fc.on_block(h(2), h(0), 0, 0)
+    fc.process_attestation(0, h(1), 1)
+    fc.process_attestation(1, h(2), 1)
+    assert fc.find_head(h(0), {0: 40, 1: 32}) == h(1)
+    # validator 0 gets slashed down; fork B now outweighs
+    assert fc.find_head(h(0), {0: 8, 1: 32}) == h(2)
+
+
+def test_tie_break_prefers_higher_root():
+    fc = ProtoArrayForkChoice(h(0))
+    fc.on_block(h(1), h(0), 0, 0)
+    fc.on_block(h(2), h(0), 0, 0)
+    assert fc.find_head(h(0), {}) == h(2)  # equal (zero) weights: higher root
+
+
+def test_justification_filter_excludes_wrong_epoch_branch():
+    fc = ProtoArrayForkChoice(h(0), justified_epoch=0, finalized_epoch=0)
+    fc.on_block(h(1), h(0), 1, 0)   # branch claiming justified epoch 1
+    fc.on_block(h(2), h(0), 2, 0)   # branch claiming justified epoch 2
+    # store moves to justified epoch 2: only h(2) is viable
+    head = fc.find_head(h(0), {}, justified_epoch=2)
+    assert head == h(2)
+
+
+def test_proposer_boost_flips_close_race():
+    fc = ProtoArrayForkChoice(h(0))
+    fc.on_block(h(1), h(0), 0, 0)
+    fc.on_block(h(2), h(0), 0, 0)
+    fc.process_attestation(0, h(1), 1)
+    fc.process_attestation(1, h(2), 1)
+    bal = {0: 32, 1: 32}
+    # equal stake; boost on h(1) wins the race
+    head = fc.find_head(h(0), bal, proposer_boost_root=h(1), proposer_boost_amount=10)
+    assert head == h(1)
+    # boost expires (next slot): falls back to tie-break
+    head = fc.find_head(h(0), bal, proposer_boost_root=None)
+    assert head == h(2)
+
+
+def test_prune_keeps_descendants():
+    fc = ProtoArrayForkChoice(h(0))
+    fc.on_block(h(1), h(0), 0, 0)
+    fc.on_block(h(2), h(1), 0, 0)
+    fc.on_block(h(3), h(0), 0, 0)   # sibling branch, will be pruned
+    fc.prune(h(1))
+    assert fc.contains_block(h(1))
+    assert fc.contains_block(h(2))
+    assert not fc.contains_block(h(3))
+    assert fc.find_head(h(1), {}) == h(2)
+
+
+def test_execution_invalidation_reroutes_head():
+    fc = ProtoArrayForkChoice(h(0))
+    fc.on_block(h(1), h(0), 0, 0)
+    fc.on_block(h(2), h(1), 0, 0)
+    fc.on_block(h(3), h(0), 0, 0)
+    fc.process_attestation(0, h(2), 1)
+    assert fc.find_head(h(0), {0: 32}) == h(2)
+    fc.invalidate_block(h(1))           # invalidates h(1) and descendant h(2)
+    assert fc.find_head(h(0), {0: 32}) == h(3)
+
+
+def test_deep_tree_weight_propagation():
+    fc = ProtoArrayForkChoice(h(0))
+    # two chains of length 3 from genesis
+    fc.on_block(h(1), h(0), 0, 0)
+    fc.on_block(h(2), h(1), 0, 0)
+    fc.on_block(h(3), h(2), 0, 0)
+    fc.on_block(h(4), h(0), 0, 0)
+    fc.on_block(h(5), h(4), 0, 0)
+    fc.on_block(h(6), h(5), 0, 0)
+    votes = {0: 10, 1: 10, 2: 10}
+    fc.process_attestation(0, h(3), 1)      # tip of chain A
+    fc.process_attestation(1, h(5), 1)      # mid of chain B
+    fc.process_attestation(2, h(6), 1)      # tip of chain B
+    assert fc.find_head(h(0), votes) == h(6)  # B has 20 vs A 10

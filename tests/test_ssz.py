@@ -1,0 +1,151 @@
+"""SSZ serialization + Merkleization tests.
+
+Vectors are hand-derived from the consensus SSZ spec (simple-serialize.md):
+offset layout, bitlist delimiter placement, chunk packing, length mix-in.
+Roundtrip and structural properties cover the rest (the EF ssz_static
+vectors are not vendored in this environment — SURVEY.md §4.2).
+"""
+
+import hashlib
+
+import pytest
+
+from lighthouse_tpu import ssz
+from lighthouse_tpu.ssz import core as c
+from lighthouse_tpu.ssz.hash import ZERO_HASHES, merkleize, mix_in_length
+
+
+def sha(x):
+    return hashlib.sha256(x).digest()
+
+
+def test_uint_roundtrip_and_layout():
+    assert ssz.encode(ssz.uint64, 0x0102030405060708) == bytes(
+        [8, 7, 6, 5, 4, 3, 2, 1]
+    )
+    for v in (0, 1, 2**64 - 1):
+        assert ssz.decode(ssz.uint64, ssz.encode(ssz.uint64, v)) == v
+    assert ssz.encode(ssz.uint16, 0xABCD) == b"\xcd\xab"
+
+
+def test_boolean():
+    assert ssz.encode(c.boolean, True) == b"\x01"
+    assert ssz.decode(c.boolean, b"\x00") is False
+    with pytest.raises(c.DecodeError):
+        ssz.decode(c.boolean, b"\x02")
+
+
+def test_bitvector_bits_lsb_first():
+    bv = ssz.Bitvector(10)
+    bits = [1, 0, 1, 0, 0, 0, 0, 0, 1, 1]
+    enc = ssz.encode(bv, bits)
+    assert enc == bytes([0b00000101, 0b00000011])
+    assert ssz.decode(bv, enc) == bits
+
+
+def test_bitlist_delimiter():
+    bl = ssz.Bitlist(8)
+    bits = [1, 1, 0, 1, 0, 1, 0, 0]
+    enc = ssz.encode(bl, bits)
+    # 8 data bits then delimiter bit at position 8 -> second byte 0x01
+    assert enc == bytes([0b00101011, 0x01])
+    assert ssz.decode(bl, enc) == bits
+    # empty bitlist = just the delimiter
+    assert ssz.encode(bl, []) == b"\x01"
+    assert ssz.decode(bl, b"\x01") == []
+
+
+def test_vector_and_list_of_uint64():
+    v = ssz.Vector(ssz.uint64, 3)
+    enc = ssz.encode(v, [1, 2, 3])
+    assert enc == (1).to_bytes(8, "little") + (2).to_bytes(8, "little") + (
+        3
+    ).to_bytes(8, "little")
+    l = ssz.List(ssz.uint64, 100)
+    assert ssz.decode(l, enc) == [1, 2, 3]
+
+
+def test_variable_offsets_in_container():
+    class Inner(ssz.Container):
+        fields = [("a", ssz.uint8)]
+
+    class Outer(ssz.Container):
+        fields = [
+            ("x", ssz.uint16),
+            ("items", ssz.List(ssz.uint8, 10)),
+            ("y", ssz.uint8),
+        ]
+
+    o = Outer(x=0x0102, items=[9, 8], y=7)
+    enc = ssz.encode(o)
+    # fixed part: x (2B) + offset (4B) + y (1B) = 7; items start at 7
+    assert enc == b"\x02\x01" + (7).to_bytes(4, "little") + b"\x07" + b"\x09\x08"
+    assert ssz.decode(Outer, enc) == o
+
+
+def test_nested_variable_list_roundtrip():
+    t = ssz.List(ssz.List(ssz.uint16, 4), 4)
+    val = [[1], [2, 3], [], [4, 5, 6]]
+    assert ssz.decode(t, ssz.encode(t, val)) == val
+
+
+def test_hash_tree_root_uint():
+    assert ssz.hash_tree_root(ssz.uint64, 5) == (5).to_bytes(8, "little") + bytes(24)
+    assert ssz.hash_tree_root(ssz.uint256, 7) == (7).to_bytes(32, "little")
+
+
+def test_hash_tree_root_bytes32_identity():
+    r = bytes(range(32))
+    assert ssz.hash_tree_root(ssz.Bytes32, r) == r
+
+
+def test_hash_tree_root_bytes48_pads():
+    v = bytes(48)
+    assert ssz.hash_tree_root(ssz.Bytes48, v) == sha(bytes(64))
+
+
+def test_merkleize_padding_and_zero_hashes():
+    a, b = sha(b"a"), sha(b"b")
+    assert merkleize([a], 1) == a
+    assert merkleize([a, b], 2) == sha(a + b)
+    # virtual padding: limit 4 with 2 chunks pads with a zero subtree
+    assert merkleize([a, b], 4) == sha(sha(a + b) + ZERO_HASHES[1])
+    assert merkleize([], 4) == ZERO_HASHES[2]
+
+
+def test_list_root_mixes_length():
+    t = ssz.List(ssz.uint64, 4)  # 4*8 = 32 bytes -> single chunk limit
+    packed = (1).to_bytes(8, "little") + (2).to_bytes(8, "little") + bytes(16)
+    assert ssz.hash_tree_root(t, [1, 2]) == mix_in_length(packed, 2)
+
+
+def test_container_root_is_field_merkle():
+    class Pair(ssz.Container):
+        fields = [("a", ssz.uint64), ("b", ssz.Bytes32)]
+
+    v = Pair(a=3, b=bytes(range(32)))
+    want = sha(((3).to_bytes(8, "little") + bytes(24)) + bytes(range(32)))
+    assert ssz.hash_tree_root(v) == want
+
+
+def test_bitlist_root():
+    t = ssz.Bitlist(5)
+    # bits [1,0,1] -> packed chunk 0b00000101, mixed with length 3
+    chunk = bytes([0b101]) + bytes(31)
+    assert ssz.hash_tree_root(t, [1, 0, 1]) == mix_in_length(chunk, 3)
+
+
+def test_attestation_data_roundtrip():
+    from lighthouse_tpu.types import AttestationData, Checkpoint
+
+    ad = AttestationData(
+        slot=5,
+        index=2,
+        beacon_block_root=bytes(range(32)),
+        source=Checkpoint(epoch=0, root=bytes(32)),
+        target=Checkpoint(epoch=1, root=bytes(range(32))),
+    )
+    enc = ssz.encode(ad)
+    assert len(enc) == 8 + 8 + 32 + 40 + 40  # fixed-size container
+    assert ssz.decode(AttestationData, enc) == ad
+    assert len(ssz.hash_tree_root(ad)) == 32

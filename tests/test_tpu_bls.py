@@ -1,0 +1,114 @@
+"""Differential tests: device verify_signature_sets vs the oracle.
+
+Covers the blst semantics the reference relies on
+(/root/reference/crypto/bls/src/impls/blst.rs:37-120 and the EF
+bls_batch_verify handler shapes): valid batches, tampered members,
+multi-pubkey sets, infinity rejection, empty input, and the per-set
+fallback verdicts.
+"""
+
+import random
+
+import numpy as np
+
+from lighthouse_tpu.crypto.ref import bls as RB
+from lighthouse_tpu.crypto.ref import curves as RC
+from lighthouse_tpu.crypto.tpu import bls as tb
+
+rng = random.Random(0xB15)
+
+
+def _mk_sets(spec):
+    """spec: list of (n_pubkeys, valid). Returns oracle SignatureSets."""
+    sets = []
+    for n_pk, valid in spec:
+        sks = [rng.randrange(1, 2**200) for _ in range(n_pk)]
+        msg = bytes(rng.randrange(256) for _ in range(32))
+        pks = [RB.sk_to_pk(sk) for sk in sks]
+        sig = RB.aggregate([RB.sign(sk, msg) for sk in sks])
+        if not valid:
+            sig = RC.g2_mul(sig, 7)  # corrupt
+        sets.append(RB.SignatureSet(sig, pks, msg))
+    return sets
+
+
+def fixed_rng():
+    state = [1]
+
+    def draw():
+        state[0] = (state[0] * 6364136223846793005 + 1442695040888963407) % 2**64
+        return state[0]
+
+    return draw
+
+
+def test_batched_verify_valid():
+    sets = _mk_sets([(1, True), (3, True), (2, True)])
+    assert RB.verify_signature_sets(sets, rng=fixed_rng()) is True
+    assert tb.verify_signature_sets(sets, rng=fixed_rng()) is True
+
+
+def test_batched_verify_detects_bad_set():
+    sets = _mk_sets([(1, True), (2, False), (1, True)])
+    assert RB.verify_signature_sets(sets, rng=fixed_rng()) is False
+    assert tb.verify_signature_sets(sets, rng=fixed_rng()) is False
+
+
+def test_batched_verify_rejects_structural():
+    sets = _mk_sets([(1, True)])
+    assert tb.verify_signature_sets([]) is False
+    bad = RB.SignatureSet(None, sets[0].pubkeys, sets[0].message)
+    assert tb.verify_signature_sets([bad]) is False
+    inf_pk = RB.SignatureSet(sets[0].signature, [None], sets[0].message)
+    assert tb.verify_signature_sets([inf_pk]) is False
+    no_pk = RB.SignatureSet(sets[0].signature, [], sets[0].message)
+    assert tb.verify_signature_sets([no_pk]) is False
+
+
+def test_batched_verify_rejects_non_subgroup_signature():
+    # A point on the curve but outside the r-torsion: scale a valid signature
+    # by the cofactor structure trick — easiest is to use a curve point from
+    # hashing then adding a known non-subgroup point; construct via oracle:
+    # any point with x s.t. it's on curve but fails subgroup. Multiply the
+    # generator of E2' cofactor part: take h2-torsion component by clearing
+    # incompletely.
+    from lighthouse_tpu.crypto.ref.hash_to_curve import map_to_curve_g2, hash_to_field_fp2
+
+    u = hash_to_field_fp2(b"non-subgroup", 2)[0]
+    raw = map_to_curve_g2(u)  # on E2 but (with overwhelming prob) not in G2
+    assert not RC.g2_in_subgroup(raw)
+    sets = _mk_sets([(1, True)])
+    bad = RB.SignatureSet(raw, sets[0].pubkeys, sets[0].message)
+    assert RB.verify_signature_sets([bad], rng=fixed_rng()) is False
+    assert tb.verify_signature_sets([bad], rng=fixed_rng()) is False
+
+
+def test_per_set_verdicts():
+    sets = _mk_sets([(1, True), (2, False), (4, True), (1, False), (1, True)])
+    got = tb.verify_signature_sets_per_set(sets)
+    assert got == [True, False, True, False, True]
+
+
+def test_aggregate_pubkey_sets_match_reference_semantics():
+    # one set whose pubkeys aggregate (fast_aggregate_verify shape:
+    # signature_sets.rs sync_aggregate path)
+    n = 8
+    sks = [rng.randrange(1, 2**200) for _ in range(n)]
+    msg = bytes(32)
+    pks = [RB.sk_to_pk(sk) for sk in sks]
+    sig = RB.aggregate([RB.sign(sk, msg) for sk in sks])
+    s = RB.SignatureSet(sig, pks, msg)
+    assert tb.verify_signature_sets([s], rng=fixed_rng()) is True
+    # flipping one pubkey breaks it
+    s_bad = RB.SignatureSet(sig, pks[:-1] + [RB.sk_to_pk(12345)], msg)
+    assert tb.verify_signature_sets([s_bad], rng=fixed_rng()) is False
+
+
+def test_validate_pubkeys_kernel():
+    from lighthouse_tpu.crypto.tpu import curve as cv
+    import jax
+
+    pks = [RB.sk_to_pk(rng.randrange(1, 2**200)) for _ in range(3)]
+    dev = cv.g1_from_ints(pks + [None])
+    ok = np.asarray(tb._jit_validate_pk(dev))
+    assert list(ok) == [True, True, True, False]

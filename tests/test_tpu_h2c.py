@@ -1,0 +1,53 @@
+"""Differential tests: device SSWU / isogeny / hash-to-G2 vs the oracle."""
+
+import random
+
+import numpy as np
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.constants import P, DST_POP, H2C_Z
+from lighthouse_tpu.crypto.ref import fields as RF
+from lighthouse_tpu.crypto.ref import hash_to_curve as RH
+from lighthouse_tpu.crypto.tpu import curve as cv
+from lighthouse_tpu.crypto.tpu import hash_to_curve as h2c
+from .helpers import J
+from .test_tpu_tower import f2_dev, f2_host
+
+rng = random.Random(0x42C)
+
+
+def rand_f2s(n):
+    return [(rng.randrange(P), rng.randrange(P)) for _ in range(n)]
+
+
+def test_sqrt_ratio_square_and_nonsquare():
+    us = rand_f2s(4)
+    vs = rand_f2s(4)
+    is_sq, y = J(h2c.sqrt_ratio)(f2_dev(us), f2_dev(vs))
+    got_sq = np.asarray(is_sq)
+    got_y = f2_host(y)
+    for u, v, sq, yy in zip(us, vs, got_sq, got_y):
+        w = RF.f2_mul(u, RF.f2_inv(v))
+        oracle_root = RF.f2_sqrt(w)
+        assert bool(sq) == (oracle_root is not None)
+        target = w if sq else RF.f2_mul(H2C_Z, w)
+        assert RF.f2_mul(yy, yy) == target
+
+
+def test_map_to_curve_matches_oracle():
+    us = rand_f2s(3) + [(0, 0)]
+    out = J(h2c.map_to_curve_g2)(f2_dev(us))
+    got = cv.g2_to_ints(out)
+    want = [RH.map_to_curve_g2(u) for u in us]
+    assert got == want
+
+
+def test_hash_to_g2_matches_oracle():
+    msgs = [b"", b"abc", bytes(range(32)), b"lighthouse-tpu"]
+    u0, u1 = h2c.hash_to_field_host(msgs, DST_POP)
+    out = J(h2c.hash_to_g2_device)(u0, u1)
+    got = cv.g2_to_ints(out)
+    want = [RH.hash_to_g2(m, DST_POP) for m in msgs]
+    assert got == want
+    # and the result is always in the r-torsion subgroup
+    assert bool(np.all(np.asarray(J(cv.g2_in_subgroup)(out))))
