@@ -137,11 +137,18 @@ def _mul_cols(a, b, n_out=2 * NLIMB):
     af = a.astype(F32)
     bf = b.astype(F32)
     prods = (af[:, None] * bf[None, :]).reshape((NLIMB * NLIMB,) + bshape)
+    # precision=HIGHEST is load-bearing on TPU: the default lowers f32
+    # matmuls to bf16 MXU passes, whose 8-bit mantissa destroys the 16-bit
+    # limb products this schedule depends on (every Montgomery product would
+    # be silently corrupt on device while staying exact on CPU).  HIGHEST
+    # selects the 6-pass f32 emulation, which is bit-exact for our < 2^24
+    # column sums.
     cols = jnp.einsum(
         "ks,s...->k...",
         jnp.asarray(_DIAG_MAT[:n_out]),
         prods,
         preferred_element_type=F32,
+        precision=lax.Precision.HIGHEST,
     )
     return cols.astype(U32)
 

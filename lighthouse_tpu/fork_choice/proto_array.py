@@ -54,6 +54,11 @@ class ProtoArrayForkChoice:
         self.finalized_epoch = finalized_epoch
         self.proposer_boost_root: bytes | None = None
         self.proposer_boost_amount = 0
+        # Previously-applied boost, tracked BY ROOT (proto_array.rs
+        # ProposerBoost {root, score}) so it survives pruning/reindexing and
+        # is correctly reverted on the next apply_score_changes.
+        self._prev_boost_root: bytes | None = None
+        self._prev_boost_amount = 0
         self.on_block(
             finalized_root, None, justified_epoch, finalized_epoch, finalized_slot
         )
@@ -138,27 +143,28 @@ class ProtoArrayForkChoice:
         self.balances = dict(new_balances)
         return deltas
 
-    def _proposer_boost(self, idx):
-        if (
-            self.proposer_boost_root is not None
-            and self.nodes[idx].root == self.proposer_boost_root
-        ):
-            return self.proposer_boost_amount
-        return 0
-
     def _apply_score_changes(self, deltas):
         """proto_array.rs apply_score_changes — TWO backward passes: all
         weight deltas first (with back-propagation to parent deltas), then
         best_child/best_descendant re-evaluation over a fully coherent set
         of weights (proto_array.rs:283-299 'we _must_ perform these
         functions separate')."""
-        boost = [self._proposer_boost(i) for i in range(len(self.nodes))]
-        if not hasattr(self, "_prev_boost"):
-            self._prev_boost = [0] * len(self.nodes)
-        self._prev_boost += [0] * (len(self.nodes) - len(self._prev_boost))
-        for i in range(len(self.nodes)):
-            deltas[i] += boost[i] - self._prev_boost[i]
-        self._prev_boost = boost
+        # Revert the previously-applied proposer boost (by root — the node
+        # may have been reindexed by prune; if it was pruned away entirely
+        # the revert is moot, matching proto_array.rs), then apply the new
+        # one.
+        if self._prev_boost_root is not None:
+            prev = self.indices.get(self._prev_boost_root)
+            if prev is not None:
+                deltas[prev] -= self._prev_boost_amount
+        self._prev_boost_root = None
+        self._prev_boost_amount = 0
+        if self.proposer_boost_root is not None and self.proposer_boost_amount:
+            cur = self.indices.get(self.proposer_boost_root)
+            if cur is not None:
+                deltas[cur] += self.proposer_boost_amount
+                self._prev_boost_root = self.proposer_boost_root
+                self._prev_boost_amount = self.proposer_boost_amount
 
         for i in range(len(self.nodes) - 1, -1, -1):
             node = self.nodes[i]
@@ -214,16 +220,11 @@ class ProtoArrayForkChoice:
             return
         if parent.best_child == child_idx:
             if not child_leads:
+                # Reference behavior (proto_array.rs case 2b): clear to None
+                # and let the normal weight-compare pass re-elect the best
+                # child — adopting an arbitrary sibling here could transiently
+                # report a lighter fork as head.
                 clear()
-                # try to find another viable child
-                for j, n in enumerate(self.nodes):
-                    if n.parent == parent_idx and j != child_idx and \
-                            self._node_leads_to_viable_head(n):
-                        parent.best_child = j
-                        parent.best_descendant = (
-                            n.best_descendant if n.best_descendant is not None else j
-                        )
-                        break
             else:
                 adopt()
             return
@@ -267,7 +268,8 @@ class ProtoArrayForkChoice:
             n.best_descendant = old_to_new.get(n.best_descendant)
         self.nodes = new_nodes
         self.indices = {n.root: i for i, n in enumerate(new_nodes)}
-        self._prev_boost = [0] * len(new_nodes)
+        # _prev_boost_root intentionally survives pruning: the boost is
+        # reverted by root lookup on the next apply_score_changes.
 
     # ---------------------------------------------------------- invalidation
 

@@ -39,7 +39,9 @@ MIN_ATTESTATION_INCLUSION_DELAY = 1
 MIN_SEED_LOOKAHEAD = 1
 MAX_SEED_LOOKAHEAD = 4
 MIN_EPOCHS_TO_INACTIVITY_PENALTY = 4
-EPOCHS_PER_ETH1_VOTING_PERIOD = 64
+# Config (ChainSpec) value, same for mainnet and minimal; overridable by
+# threading a ChainSpec into the exit path (initiate_validator_exit).
+MIN_VALIDATOR_WITHDRAWABILITY_DELAY = 256
 
 MIN_PER_EPOCH_CHURN_LIMIT = 4
 CHURN_LIMIT_QUOTIENT = 2**16
@@ -218,7 +220,7 @@ def is_valid_indexed_attestation_structure(indexed):
 # ------------------------------------------------------------ registry mutes
 
 
-def initiate_validator_exit(state, index, preset):
+def initiate_validator_exit(state, index, preset, spec=None):
     v = state.validators[index]
     if v.exit_epoch != FAR_FUTURE_EPOCH:
         return
@@ -235,12 +237,17 @@ def initiate_validator_exit(state, index, preset):
     if churn >= get_validator_churn_limit(state, preset):
         exit_queue_epoch += 1
     v.exit_epoch = exit_queue_epoch
-    v.withdrawable_epoch = exit_queue_epoch + 256  # MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    delay = (
+        spec.min_validator_withdrawability_delay
+        if spec is not None
+        else MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    )
+    v.withdrawable_epoch = exit_queue_epoch + delay
 
 
-def slash_validator(state, slashed_index, preset, whistleblower_index=None):
+def slash_validator(state, slashed_index, preset, whistleblower_index=None, spec=None):
     epoch = get_current_epoch(state, preset)
-    initiate_validator_exit(state, slashed_index, preset)
+    initiate_validator_exit(state, slashed_index, preset, spec=spec)
     v = state.validators[slashed_index]
     v.slashed = True
     v.withdrawable_epoch = max(
@@ -270,13 +277,13 @@ def decrease_balance(state, index, delta):
 # ------------------------------------------------------------------ slots
 
 
-def process_slots(state, slot, preset):
+def process_slots(state, slot, preset, spec=None):
     """Spec process_slots / reference per_slot_processing."""
     assert state.slot < slot
     while state.slot < slot:
         process_slot(state, preset)
         if (state.slot + 1) % preset.slots_per_epoch == 0:
-            process_epoch(state, preset)
+            process_epoch(state, preset, spec=spec)
         state.slot += 1
 
 
@@ -292,11 +299,11 @@ def process_slot(state, preset):
 # ------------------------------------------------------------------ epoch
 
 
-def process_epoch(state, preset):
+def process_epoch(state, preset, spec=None):
     """per_epoch_processing/base.rs process_epoch."""
     process_justification_and_finalization(state, preset)
     process_rewards_and_penalties(state, preset)
-    process_registry_updates(state, preset)
+    process_registry_updates(state, preset, spec=spec)
     process_slashings(state, preset)
     process_final_updates(state, preset)
 
@@ -425,14 +432,25 @@ def process_rewards_and_penalties(state, preset):
     rewards = [0] * len(state.validators)
     penalties = [0] * len(state.validators)
 
+    # Spec `is_in_inactivity_leak`: during a leak attesting validators get
+    # the FULL base reward (which the inactivity penalty below cancels),
+    # not the participation-scaled reward (reference:
+    # per_epoch_processing/base/rewards_and_penalties.rs
+    # get_attestation_component_delta).
+    finality_delay = previous_epoch - state.finalized_checkpoint.epoch
+    in_leak = finality_delay > MIN_EPOCHS_TO_INACTIVITY_PENALTY
+
     for atts, _name in ((src_atts, "src"), (tgt_atts, "tgt"), (head_atts, "head")):
         unslashed = set(_unslashed_attesting_indices(state, atts, preset))
         attesting_balance = get_total_balance(state, sorted(unslashed))
         for i in eligible:
             if i in unslashed:
-                increment = EFFECTIVE_BALANCE_INCREMENT
-                reward_numerator = base_reward(i) * (attesting_balance // increment)
-                rewards[i] += reward_numerator // (total_balance // increment)
+                if in_leak:
+                    rewards[i] += base_reward(i)
+                else:
+                    increment = EFFECTIVE_BALANCE_INCREMENT
+                    reward_numerator = base_reward(i) * (attesting_balance // increment)
+                    rewards[i] += reward_numerator // (total_balance // increment)
             else:
                 penalties[i] += base_reward(i)
 
@@ -451,8 +469,7 @@ def process_rewards_and_penalties(state, preset):
         rewards[i] += max_attester_reward // attestation.inclusion_delay
 
     # inactivity leak
-    finality_delay = previous_epoch - state.finalized_checkpoint.epoch
-    if finality_delay > MIN_EPOCHS_TO_INACTIVITY_PENALTY:
+    if in_leak:
         tgt_indices = set(_unslashed_attesting_indices(state, tgt_atts, preset))
         for i in eligible:
             penalties[i] += BASE_REWARDS_PER_EPOCH * base_reward(i) - (
@@ -470,7 +487,7 @@ def process_rewards_and_penalties(state, preset):
         decrease_balance(state, i, penalties[i])
 
 
-def process_registry_updates(state, preset):
+def process_registry_updates(state, preset, spec=None):
     current_epoch = get_current_epoch(state, preset)
     for i, v in enumerate(state.validators):
         if (
@@ -479,7 +496,7 @@ def process_registry_updates(state, preset):
         ):
             v.activation_eligibility_epoch = current_epoch + 1
         if is_active_validator(v, current_epoch) and v.effective_balance <= EJECTION_BALANCE:
-            initiate_validator_exit(state, i, preset)
+            initiate_validator_exit(state, i, preset, spec=spec)
 
     activation_queue = sorted(
         [
@@ -518,7 +535,7 @@ def process_final_updates(state, preset):
     current_epoch = get_current_epoch(state, preset)
     next_epoch = current_epoch + 1
     # eth1 data votes reset
-    if next_epoch % EPOCHS_PER_ETH1_VOTING_PERIOD == 0:
+    if next_epoch % preset.epochs_per_eth1_voting_period == 0:
         state.eth1_data_votes = []
     # effective balance updates (hysteresis)
     HYSTERESIS_QUOTIENT = 4
@@ -696,7 +713,7 @@ def process_randao(state, body, spec, verifying, sets, get_pubkey):
 
 def process_eth1_data(state, body, preset):
     state.eth1_data_votes.append(body.eth1_data)
-    period_slots = EPOCHS_PER_ETH1_VOTING_PERIOD * preset.slots_per_epoch
+    period_slots = preset.epochs_per_eth1_voting_period * preset.slots_per_epoch
     if (
         sum(1 for v in state.eth1_data_votes if v == body.eth1_data) * 2
         > period_slots
@@ -739,7 +756,7 @@ def process_proposer_slashing(state, slashing, spec, verifying, sets, get_pubkey
                 get_pubkey, slashing, state.fork, state.genesis_validators_root, spec
             )
         )
-    slash_validator(state, h1.proposer_index, preset)
+    slash_validator(state, h1.proposer_index, preset, spec=spec)
 
 
 def process_attester_slashing(state, slashing, spec, verifying, sets, get_pubkey):
@@ -759,7 +776,7 @@ def process_attester_slashing(state, slashing, spec, verifying, sets, get_pubkey
     both = set(a1.attesting_indices) & set(a2.attesting_indices)
     for i in sorted(both):
         if is_slashable_validator(state.validators[i], epoch):
-            slash_validator(state, i, preset)
+            slash_validator(state, i, preset, spec=spec)
             slashed_any = True
     assert slashed_any, "no slashable validators"
 
@@ -891,4 +908,4 @@ def process_voluntary_exit(state, signed_exit, spec, verifying, sets, get_pubkey
                 spec,
             )
         )
-    initiate_validator_exit(state, exit_msg.validator_index, preset)
+    initiate_validator_exit(state, exit_msg.validator_index, preset, spec=spec)

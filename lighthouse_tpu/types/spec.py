@@ -50,6 +50,7 @@ class Preset:
     epochs_per_historical_vector: int
     epochs_per_slashings_vector: int
     historical_roots_limit: int
+    epochs_per_eth1_voting_period: int = 64
     max_proposer_slashings: int = 16
     max_attester_slashings: int = 2
     max_attestations: int = 128
@@ -72,6 +73,7 @@ MainnetPreset = Preset(
     epochs_per_historical_vector=65536,
     epochs_per_slashings_vector=8192,
     historical_roots_limit=2**24,
+    epochs_per_eth1_voting_period=64,
 )
 
 MinimalPreset = Preset(
@@ -87,6 +89,7 @@ MinimalPreset = Preset(
     epochs_per_historical_vector=64,
     epochs_per_slashings_vector=64,
     historical_roots_limit=2**24,
+    epochs_per_eth1_voting_period=4,
 )
 
 

@@ -140,7 +140,8 @@ def state_types(preset):
             ("historical_roots", List(Bytes32, preset.historical_roots_limit)),
             ("eth1_data", Eth1Data),
             ("eth1_data_votes", List(
-                Eth1Data, preset.slots_per_epoch * 64  # EPOCHS_PER_ETH1_VOTING_PERIOD
+                Eth1Data,
+                preset.slots_per_epoch * preset.epochs_per_eth1_voting_period,
             )),
             ("eth1_deposit_index", uint64),
             ("validators", List(Validator, preset.validator_registry_limit)),
