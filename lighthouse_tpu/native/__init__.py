@@ -1,0 +1,90 @@
+"""Native (C++) runtime kernels, loaded via ctypes.
+
+Mirror of the reference's native-dependency layer (SURVEY.md §2.10): where
+lighthouse links C/asm (blst, ring/sha2, leveldb), this package loads C++
+shared objects built from `csrc/`.  Every binding has a pure-Python
+fallback so the framework still runs where a toolchain is unavailable —
+the reference's `portable` feature flag, in spirit.
+
+Currently bound:
+  * sha256_merkle — batched SHA-256 pair hashing for SSZ Merkleization
+    (runtime SHA-NI/scalar dispatch, the eth2_hashing analogue).
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_CSRC = os.path.join(_HERE, "..", "..", "csrc")
+_SO = os.path.join(_HERE, "libsha256_merkle.so")
+
+
+def _build():
+    src = os.path.join(_CSRC, "sha256_merkle.cpp")
+    if not os.path.exists(src):
+        return None
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, src],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except Exception:
+        return None
+    return _SO
+
+
+def _load():
+    path = _SO if os.path.exists(_SO) else _build()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.sha256_pairs.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+    ]
+    lib.sha256_pairs.restype = None
+    lib.sha256_backend.restype = ctypes.c_int
+    return lib
+
+
+_lib = _load()
+HAVE_NATIVE = _lib is not None
+SHA_BACKEND = (
+    "sha-ni" if (_lib and _lib.sha256_backend() == 1)
+    else ("scalar-c++" if _lib else "hashlib")
+)
+
+
+def hash_pairs(buf: np.ndarray) -> np.ndarray:
+    """n independent 64-byte messages -> n 32-byte digests.
+
+    `buf` is a C-contiguous uint8 array of shape (n, 64).
+    """
+    n = buf.shape[0]
+    out = np.empty((n, 32), dtype=np.uint8)
+    if n == 0:
+        return out
+    if _lib is not None:
+        if not buf.flags.c_contiguous:
+            buf = np.ascontiguousarray(buf)
+        _lib.sha256_pairs(
+            buf.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_uint64(n),
+        )
+        return out
+    for i in range(n):
+        out[i] = np.frombuffer(
+            hashlib.sha256(buf[i].tobytes()).digest(), dtype=np.uint8
+        )
+    return out

@@ -9,9 +9,15 @@ zero-subtree hashes, and length mix-in for lists/bitlists.  Signing roots
 
 import hashlib
 
+import numpy as np
+
 from . import core
+from ..native import hash_pairs
 
 BYTES_PER_CHUNK = 32
+
+# below this many chunks the Python loop beats the numpy round-trip
+_NATIVE_MIN_CHUNKS = 16
 
 
 def _sha256(x):
@@ -44,6 +50,9 @@ def merkleize(chunks, limit=None):
     depth = max(limit - 1, 0).bit_length()
     if count == 0:
         return ZERO_HASHES[depth]
+    if count >= _NATIVE_MIN_CHUNKS:
+        arr = np.frombuffer(b"".join(chunks), dtype=np.uint8).reshape(count, 32)
+        return merkleize_np(arr, limit)
     layer = list(chunks)
     for d in range(depth):
         odd = len(layer) % 2
@@ -54,6 +63,37 @@ def merkleize(chunks, limit=None):
             nxt.append(_sha256(layer[-1] + ZERO_HASHES[d]))
         layer = nxt
     return layer[0]
+
+
+_ZERO_HASHES_NP = [
+    np.frombuffer(z, dtype=np.uint8).copy() for z in ZERO_HASHES
+]
+
+
+def merkleize_np(chunks: np.ndarray, limit=None) -> bytes:
+    """`merkleize` over a (n, 32) uint8 numpy chunk array — each tree level
+    is ONE batched native SHA-256 call (the cached_tree_hash/eth2_hashing
+    hot path of the reference, done as data-parallel hashing here)."""
+    count = chunks.shape[0]
+    if limit is None:
+        limit = count
+    if count > limit:
+        raise ValueError("more chunks than limit")
+    depth = max(limit - 1, 0).bit_length()
+    if count == 0:
+        return ZERO_HASHES[depth]
+    layer = chunks
+    for d in range(depth):
+        if layer.shape[0] == 1:
+            # chain with zero-subtree hashes — no more real siblings
+            root = layer[0].tobytes()
+            for d2 in range(d, depth):
+                root = _sha256(root + ZERO_HASHES[d2])
+            return root
+        if layer.shape[0] % 2:
+            layer = np.concatenate([layer, _ZERO_HASHES_NP[d][None]], axis=0)
+        layer = hash_pairs(layer.reshape(-1, 64))
+    return layer[0].tobytes()
 
 
 def mix_in_length(root, length):

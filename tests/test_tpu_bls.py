@@ -15,6 +15,10 @@ from lighthouse_tpu.crypto.ref import bls as RB
 from lighthouse_tpu.crypto.ref import curves as RC
 from lighthouse_tpu.crypto.tpu import bls as tb
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compiles the pairing graph
+
 rng = random.Random(0xB15)
 
 

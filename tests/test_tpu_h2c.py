@@ -13,6 +13,10 @@ from lighthouse_tpu.crypto.tpu import hash_to_curve as h2c
 from .helpers import J
 from .test_tpu_tower import f2_dev, f2_host
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compiles the pairing graph
+
 rng = random.Random(0x42C)
 
 

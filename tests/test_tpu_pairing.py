@@ -16,6 +16,10 @@ from lighthouse_tpu.crypto.tpu import tower as tw
 from .helpers import J
 from .test_tpu_tower import f12_host, fp_dev, f2_dev
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compiles the pairing graph
+
 rng = random.Random(0xA7E)
 
 
