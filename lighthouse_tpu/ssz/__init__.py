@@ -14,6 +14,7 @@ points, exactly like blst does in the reference
 
 from .core import (
     Boolean,
+    DecodeError,
     ByteList,
     ByteVector,
     Bitlist,
@@ -40,7 +41,7 @@ from .core import (
 from .hash import hash_tree_root
 
 __all__ = [
-    "Boolean", "ByteList", "ByteVector", "Bitlist", "Bitvector", "Container",
+    "Boolean", "DecodeError", "ByteList", "ByteVector", "Bitlist", "Bitvector", "Container",
     "List", "SSZType", "Uint", "Vector", "decode", "encode", "uint8",
     "uint16", "uint32", "uint64", "uint128", "uint256", "Bytes4", "Bytes20",
     "Bytes32", "Bytes48", "Bytes96", "hash_tree_root",

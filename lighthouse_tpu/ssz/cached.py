@@ -1,0 +1,214 @@
+"""Incremental tree hashing for BeaconState.
+
+Mirror of /root/reference/consensus/cached_tree_hash (SURVEY.md §2.2): the
+reference keeps per-list Merkle caches so `state.tree_hash_root()` after a
+slot of mutations re-hashes only dirty subtrees.  Here each numpy-backed
+state collection (types.collections) carries a `rev` counter and a dirty
+index set; `StateHasher` keeps one `MerkleListCache` per big-list field and
+re-hashes only changed leaves with the native batched SHA kernel.
+
+Integration is transparent: `hash_tree_root(state)` routes through the
+hasher attached to the state instance (created on first use; deep-copied
+along with the state, preserving incrementality across `state.copy()`).
+"""
+
+import hashlib
+
+import numpy as np
+
+from . import core
+from .hash import (
+    ZERO_HASHES,
+    hash_tree_root,
+    merkleize,
+    mix_in_length,
+    pack_u64_np,
+)
+from ..native import hash_pairs
+
+
+def _sha256(x):
+    return hashlib.sha256(x).digest()
+
+
+def _next_pow2(n):
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+class MerkleListCache:
+    """Materialized Merkle tree over a chunk array with virtual zero
+    padding to `limit` chunks; updates re-hash only dirty paths."""
+
+    def __init__(self, limit):
+        self.limit = limit
+        self.depth = max(limit - 1, 0).bit_length()
+        self.levels = None
+        self.n = 0
+        self._root = None
+
+    def update(self, leaves: np.ndarray, dirty=None) -> bytes:
+        """Set the leaf array to `leaves` ((n, 32) uint8) and return the
+        root.  `dirty`: optional iterable of changed row indices; when
+        None, changed rows are found by diffing against the stored level-0
+        (vectorized compare)."""
+        n = leaves.shape[0]
+        if n > self.limit:
+            raise ValueError("over limit")
+        w = _next_pow2(n)
+        if self.levels is None or w != self.levels[0].shape[0] or n < self.n:
+            return self._rebuild(leaves)
+        lvl0 = self.levels[0]
+        if dirty is None:
+            changed = np.nonzero((lvl0[:n] != leaves).any(axis=1))[0]
+        else:
+            changed = np.asarray(
+                sorted(i for i in dirty if i < n), dtype=np.int64
+            )
+            if len(changed):
+                # only keep genuinely-changed rows (cheap re-check)
+                mask = (lvl0[changed] != leaves[changed]).any(axis=1)
+                changed = changed[mask]
+        if self.n != n:
+            appended = np.arange(self.n, n, dtype=np.int64)
+            changed = np.union1d(changed, appended)
+        if len(changed) == 0:
+            self.n = n
+            return self._root
+        lvl0[changed] = leaves[changed]
+        self.n = n
+        cur = np.unique(changed >> 1)
+        for k in range(len(self.levels) - 1):
+            src = self.levels[k]
+            pairs = src.reshape(-1, 64)[cur]
+            self.levels[k + 1][cur] = hash_pairs(pairs)
+            cur = np.unique(cur >> 1)
+        self._root = self._chain_root()
+        return self._root
+
+    def _rebuild(self, leaves: np.ndarray) -> bytes:
+        n = leaves.shape[0]
+        w = _next_pow2(n)
+        lvl = np.zeros((w, 32), dtype=np.uint8)
+        lvl[:n] = leaves
+        # zero-chunk padding at level 0 hashes up to the correct
+        # zero-subtree hash at every level by construction
+        self.levels = [lvl]
+        while lvl.shape[0] > 1:
+            lvl = hash_pairs(lvl.reshape(-1, 64))
+            self.levels.append(lvl)
+        self.n = n
+        self._root = self._chain_root()
+        return self._root
+
+    def _chain_root(self) -> bytes:
+        root = self.levels[-1][0].tobytes()
+        for d in range(len(self.levels) - 1, self.depth):
+            root = _sha256(root + ZERO_HASHES[d])
+        return root
+
+
+class StateHasher:
+    """Per-state incremental `hash_tree_root`."""
+
+    def __init__(self):
+        self.caches = {}        # field -> MerkleListCache
+        self.revs = {}          # field -> (collection, last-seen rev)
+        self.roots = {}         # field -> last root
+        self.elem_roots = {}    # id(elem) -> (elem, root), for container lists
+        self.vleaves = None     # validator leaf-root array
+
+    def root(self, state) -> bytes:
+        cls = type(state)
+        field_roots = []
+        for name, typ in cls.fields:
+            value = getattr(state, name)
+            rev = getattr(value, "rev", None)
+            if rev is not None:
+                # entry holds the collection object itself: field assignment
+                # replaces it with a fresh rev=0 instance, and holding the
+                # reference keeps the old id from being recycled
+                hit = self.revs.get(name)
+                if hit is not None and hit[0] is value and hit[1] == rev:
+                    field_roots.append(self.roots[name])
+                    continue
+            root = self._field_root(name, typ, value)
+            if rev is not None:
+                self.revs[name] = (value, getattr(value, "rev", None))
+                self.roots[name] = root
+            field_roots.append(root)
+        return merkleize(field_roots, len(field_roots))
+
+    # -- per-field strategies ---------------------------------------------
+    def _field_root(self, name, typ, value):
+        from .hash import _chunk_count, _is_basic
+
+        if hasattr(value, "leaf_roots"):            # ValidatorRegistry
+            return self._validators_root(name, typ, value)
+        if hasattr(value, "np"):                    # numpy-backed collections
+            arr = value.np
+            if _is_basic(getattr(typ, "elem", None)):
+                leaves = pack_u64_np(arr)
+            else:
+                leaves = arr
+            cache = self._cache(name, _chunk_count(typ))
+            root = cache.update(leaves)
+            if isinstance(typ, core.List):
+                root = mix_in_length(root, len(value))
+            return root
+        if isinstance(typ, core.List) and not _is_basic(typ.elem) and not isinstance(
+            typ, (core.ByteList,)
+        ):
+            # list of containers: cache per-element roots by identity
+            leaves = [self._elem_root(typ.elem, v) for v in value]
+            root = merkleize(leaves, _chunk_count(typ))
+            return mix_in_length(root, len(value))
+        return hash_tree_root(typ, value)
+
+    def _validators_root(self, name, typ, reg):
+        from .hash import _chunk_count
+
+        n = len(reg)
+        cache = self._cache(name, _chunk_count(typ))
+        if self.vleaves is None or self.vleaves.shape[0] < n:
+            grown = np.zeros((max(16, _next_pow2(n)), 32), dtype=np.uint8)
+            if self.vleaves is not None:
+                grown[: self.vleaves.shape[0]] = self.vleaves
+                reg.dirty.update(range(self.vleaves.shape[0], n))
+            else:
+                reg.dirty.update(range(n))
+            self.vleaves = grown
+        dirty = sorted(i for i in reg.take_dirty() if i < n)
+        if dirty:
+            self.vleaves[np.asarray(dirty, dtype=np.int64)] = reg.leaf_roots(
+                only=dirty
+            )
+        root = cache.update(self.vleaves[:n], dirty=dirty)
+        return mix_in_length(root, n)
+
+    def _elem_root(self, elem_typ, v):
+        # entry holds (obj, root): the reference keeps the object alive so
+        # its id() cannot be recycled by a newer allocation
+        key = id(v)
+        hit = self.elem_roots.get(key)
+        if hit is not None and hit[0] is v:
+            return hit[1]
+        r = hash_tree_root(elem_typ, v)
+        if len(self.elem_roots) > 65536:
+            self.elem_roots.clear()
+        self.elem_roots[key] = (v, r)
+        return r
+
+    def _cache(self, name, limit):
+        c = self.caches.get(name)
+        if c is None:
+            c = self.caches[name] = MerkleListCache(limit)
+        return c
+
+
+def cached_state_root(state) -> bytes:
+    """hash_tree_root(state) through the instance-attached StateHasher."""
+    h = getattr(state, "_tree_hasher", None)
+    if h is None:
+        h = StateHasher()
+        object.__setattr__(state, "_tree_hasher", h)
+    return h.root(state)

@@ -378,6 +378,8 @@ class Container(SSZType):
 
 
 def _serialize_sequence(elem, values):
+    if hasattr(values, "ssz_serialize_fast"):
+        return values.ssz_serialize_fast()
     if elem.is_fixed_size():
         return b"".join(elem.serialize(v) for v in values)
     parts = [elem.serialize(v) for v in values]

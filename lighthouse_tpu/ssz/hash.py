@@ -153,12 +153,33 @@ def hash_tree_root(typ, value=None):
         root = _sequence_root(typ.elem, value, _chunk_count(typ))
         return mix_in_length(root, len(value))
     if isinstance(typ, type) and issubclass(typ, core.Container):
+        if getattr(typ, "_cached_tree_hash", False):
+            from .cached import cached_state_root
+
+            return cached_state_root(value)
         leaves = [hash_tree_root(t, getattr(value, n)) for n, t in typ.fields]
         return merkleize(leaves, len(leaves))
     raise TypeError(f"cannot hash_tree_root {typ}")
 
 
+def pack_u64_np(arr: np.ndarray) -> np.ndarray:
+    """uint64 array -> (ceil(n/4), 32) uint8 chunk array (SSZ packing)."""
+    n = len(arr)
+    n_chunks = max((n + 3) // 4, 0)
+    buf = np.zeros(n_chunks * 32, dtype=np.uint8)
+    buf[: n * 8] = arr.astype("<u8").view(np.uint8)
+    return buf.reshape(n_chunks, 32)
+
+
 def _sequence_root(elem, values, limit):
+    # numpy-backed fast paths (types.collections)
+    if hasattr(values, "leaf_roots"):                 # ValidatorRegistry
+        return merkleize_np(values.leaf_roots(), limit)
+    if hasattr(values, "np"):
+        arr = values.np
+        if _is_basic(elem):                           # U64List / U64Vector
+            return merkleize_np(pack_u64_np(arr), limit)
+        return merkleize_np(arr, limit)               # RootVector
     if _is_basic(elem):
         packed = b"".join(elem.serialize(v) for v in values)
         return merkleize(_pack_bytes(packed), limit)

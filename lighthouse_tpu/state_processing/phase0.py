@@ -15,6 +15,8 @@ code cross-references both the spec and the reference's Rust.
 
 import hashlib
 
+import numpy as np
+
 from ..ssz import hash_tree_root, uint64
 from ..types import Domain, compute_signing_root
 from ..types.containers import Checkpoint, BeaconBlockHeader
@@ -83,8 +85,19 @@ def is_slashable_validator(v, epoch):
     return (not v.slashed) and v.activation_epoch <= epoch < v.withdrawable_epoch
 
 
+def get_active_validator_indices_np(state, epoch):
+    """Active indices as a numpy array — one vectorized mask over the SoA
+    registry (types/collections.py) instead of a Python object walk."""
+    reg = state.validators
+    n = len(reg)
+    ae = reg.activation_epoch[:n]
+    ee = reg.exit_epoch[:n]
+    e = np.uint64(epoch)
+    return np.nonzero((ae <= e) & (e < ee))[0]
+
+
 def get_active_validator_indices(state, epoch):
-    return [i for i, v in enumerate(state.validators) if is_active_validator(v, epoch)]
+    return get_active_validator_indices_np(state, epoch).tolist()
 
 
 def get_randao_mix(state, epoch, preset):
@@ -103,20 +116,21 @@ def get_seed(state, epoch, domain_type, preset):
 
 
 def get_validator_churn_limit(state, preset):
-    active = get_active_validator_indices(state, get_current_epoch(state, preset))
+    active = get_active_validator_indices_np(state, get_current_epoch(state, preset))
     return max(MIN_PER_EPOCH_CHURN_LIMIT, len(active) // CHURN_LIMIT_QUOTIENT)
 
 
 def get_total_balance(state, indices):
-    return max(
-        EFFECTIVE_BALANCE_INCREMENT,
-        sum(state.validators[i].effective_balance for i in indices),
-    )
+    reg = state.validators
+    idx = np.asarray(indices, dtype=np.int64)
+    total = int(reg.effective_balance[idx].sum()) if len(idx) else 0
+    return max(EFFECTIVE_BALANCE_INCREMENT, total)
 
 
 def get_total_active_balance(state, preset):
     return get_total_balance(
-        state, get_active_validator_indices(state, get_current_epoch(state, preset))
+        state,
+        get_active_validator_indices_np(state, get_current_epoch(state, preset)),
     )
 
 
@@ -153,18 +167,28 @@ def compute_proposer_index(state, indices, seed):
 
 
 def get_beacon_proposer_index(state, preset):
+    # memoized per (slot, registry shape/rev): process_block_header, randao
+    # and every attestation all ask for the same proposer (the reference's
+    # beacon_proposer_cache)
+    reg = state.validators
+    key = (int(state.slot), len(reg), reg.rev)
+    cache = getattr(state, "_proposer_cache", None)
+    if cache is not None and cache[0] == key:
+        return cache[1]
     epoch = get_current_epoch(state, preset)
     seed = _sha(
         get_seed(state, epoch, DOMAIN_BEACON_PROPOSER, preset)
         + int(state.slot).to_bytes(8, "little")
     )
-    return compute_proposer_index(
+    proposer = compute_proposer_index(
         state, get_active_validator_indices(state, epoch), seed
     )
+    object.__setattr__(state, "_proposer_cache", (key, proposer))
+    return proposer
 
 
 def get_committee_count_per_slot(state, epoch, preset):
-    n_active = len(get_active_validator_indices(state, epoch))
+    n_active = len(get_active_validator_indices_np(state, epoch))
     return max(
         1,
         min(
@@ -175,23 +199,39 @@ def get_committee_count_per_slot(state, epoch, preset):
 
 
 def get_beacon_committee(state, slot, index, preset):
+    """O(1) slice of the per-epoch committee cache (ONE shuffle per epoch —
+    the reference's shuffling_cache; round 1 re-shuffled per call)."""
+    from .committee_cache import committees_for_epoch
+
     epoch = slot // preset.slots_per_epoch
-    per_slot = get_committee_count_per_slot(state, epoch, preset)
-    indices = get_active_validator_indices(state, epoch)
-    seed = get_seed(state, epoch, DOMAIN_BEACON_ATTESTER, preset)
-    committee_index = (slot % preset.slots_per_epoch) * per_slot + index
-    count = per_slot * preset.slots_per_epoch
-    n = len(indices)
-    shuffled = shuffle_list(indices, seed)
-    start = n * committee_index // count
-    end = n * (committee_index + 1) // count
-    return list(shuffled[start:end])
+    cache = committees_for_epoch(state, epoch, preset)
+    return [int(i) for i in cache.committee(slot, index)]
+
+
+def get_attesting_indices_np(state, data, bits, preset):
+    from .committee_cache import committees_for_epoch
+
+    epoch = data.slot // preset.slots_per_epoch
+    cache = committees_for_epoch(state, epoch, preset)
+    committee = cache.committee(data.slot, data.index)
+    assert len(bits) == len(committee)
+    mask = np.asarray(list(bits), dtype=bool)
+    return np.sort(committee[mask].astype(np.int64))
 
 
 def get_attesting_indices(state, data, bits, preset):
-    committee = get_beacon_committee(state, data.slot, data.index, preset)
-    assert len(bits) == len(committee)
-    return sorted(i for i, b in zip(committee, bits) if b)
+    return [int(i) for i in get_attesting_indices_np(state, data, bits, preset)]
+
+
+def _att_indices_cached(state, att, preset):
+    """Attesting indices of a PendingAttestation, memoized on the object
+    (immutable once appended to the state)."""
+    cached = getattr(att, "_cached_indices", None)
+    if cached is not None:
+        return cached
+    idx = get_attesting_indices_np(state, att.data, att.aggregation_bits, preset)
+    object.__setattr__(att, "_cached_indices", idx)
+    return idx
 
 
 def get_indexed_attestation(state, attestation, preset):
@@ -224,16 +264,15 @@ def initiate_validator_exit(state, index, preset, spec=None):
     v = state.validators[index]
     if v.exit_epoch != FAR_FUTURE_EPOCH:
         return
-    exit_epochs = [
-        u.exit_epoch for u in state.validators if u.exit_epoch != FAR_FUTURE_EPOCH
-    ]
+    reg = state.validators
+    n = len(reg)
+    exits = reg.exit_epoch[:n]
+    exiting = exits[exits != np.uint64(FAR_FUTURE_EPOCH)]
     exit_queue_epoch = max(
-        exit_epochs
-        + [compute_activation_exit_epoch(get_current_epoch(state, preset))]
+        int(exiting.max()) if len(exiting) else 0,
+        compute_activation_exit_epoch(get_current_epoch(state, preset)),
     )
-    churn = len(
-        [u for u in state.validators if u.exit_epoch == exit_queue_epoch]
-    )
+    churn = int((exits == np.uint64(exit_queue_epoch)).sum())
     if churn >= get_validator_churn_limit(state, preset):
         exit_queue_epoch += 1
     v.exit_epoch = exit_queue_epoch
@@ -333,13 +372,17 @@ def _matching_head_attestations(state, epoch, preset):
     ]
 
 
+def _unslashed_attesting_indices_np(state, attestations, preset):
+    if not attestations:
+        return np.zeros(0, dtype=np.int64)
+    parts = [_att_indices_cached(state, a, preset) for a in attestations]
+    idx = np.unique(np.concatenate(parts))
+    reg = state.validators
+    return idx[~reg.slashed[idx]]
+
+
 def _unslashed_attesting_indices(state, attestations, preset):
-    out = set()
-    for a in attestations:
-        out |= set(
-            get_attesting_indices(state, a.data, a.aggregation_bits, preset)
-        )
-    return sorted(i for i in out if not state.validators[i].slashed)
+    return [int(i) for i in _unslashed_attesting_indices_np(state, attestations, preset)]
 
 
 def process_justification_and_finalization(state, preset):
@@ -403,34 +446,37 @@ def _isqrt(n):
 
 
 def process_rewards_and_penalties(state, preset):
-    """per_epoch_processing rewards: the phase0 duty-based deltas."""
+    """per_epoch_processing rewards: the phase0 duty-based deltas.
+
+    Fully vectorized over the SoA registry (the rayon-walked per-validator
+    loops of per_epoch_processing/base/rewards_and_penalties.rs become
+    numpy array ops; SURVEY.md §2.9).  All intermediates fit uint64:
+    base_reward <= 32e9*64/sqrt(total) and numerators < 2^50 at 1M
+    validators.
+    """
     if get_current_epoch(state, preset) == GENESIS_EPOCH:
         return
     previous_epoch = get_previous_epoch(state, preset)
     total_balance = get_total_active_balance(state, preset)
     sqrt_total = _isqrt(total_balance)
 
-    def base_reward(i):
-        return (
-            state.validators[i].effective_balance
-            * BASE_REWARD_FACTOR
-            // sqrt_total
-            // BASE_REWARDS_PER_EPOCH
-        )
+    reg = state.validators
+    n = len(reg)
+    eb = reg.effective_balance[:n].astype(np.int64)
+    base_reward_arr = eb * BASE_REWARD_FACTOR // sqrt_total // BASE_REWARDS_PER_EPOCH
 
-    eligible = [
-        i
-        for i, v in enumerate(state.validators)
-        if is_active_validator(v, previous_epoch)
-        or (v.slashed and previous_epoch + 1 < v.withdrawable_epoch)
-    ]
+    prev = np.uint64(previous_epoch)
+    active_prev = (reg.activation_epoch[:n] <= prev) & (prev < reg.exit_epoch[:n])
+    eligible = active_prev | (
+        reg.slashed[:n] & (prev + np.uint64(1) < reg.withdrawable_epoch[:n])
+    )
 
     src_atts = _matching_source_attestations(state, previous_epoch, preset)
     tgt_atts = _matching_target_attestations(state, previous_epoch, preset)
     head_atts = _matching_head_attestations(state, previous_epoch, preset)
 
-    rewards = [0] * len(state.validators)
-    penalties = [0] * len(state.validators)
+    rewards = np.zeros(n, dtype=np.int64)
+    penalties = np.zeros(n, dtype=np.int64)
 
     # Spec `is_in_inactivity_leak`: during a leak attesting validators get
     # the FULL base reward (which the inactivity penalty below cancels),
@@ -440,95 +486,143 @@ def process_rewards_and_penalties(state, preset):
     finality_delay = previous_epoch - state.finalized_checkpoint.epoch
     in_leak = finality_delay > MIN_EPOCHS_TO_INACTIVITY_PENALTY
 
-    for atts, _name in ((src_atts, "src"), (tgt_atts, "tgt"), (head_atts, "head")):
-        unslashed = set(_unslashed_attesting_indices(state, atts, preset))
-        attesting_balance = get_total_balance(state, sorted(unslashed))
-        for i in eligible:
-            if i in unslashed:
-                if in_leak:
-                    rewards[i] += base_reward(i)
-                else:
-                    increment = EFFECTIVE_BALANCE_INCREMENT
-                    reward_numerator = base_reward(i) * (attesting_balance // increment)
-                    rewards[i] += reward_numerator // (total_balance // increment)
-            else:
-                penalties[i] += base_reward(i)
+    increment = EFFECTIVE_BALANCE_INCREMENT
+    for atts in (src_atts, tgt_atts, head_atts):
+        unslashed = _unslashed_attesting_indices_np(state, atts, preset)
+        attesting_balance = get_total_balance(state, unslashed)
+        in_set = np.zeros(n, dtype=bool)
+        in_set[unslashed] = True
+        attesting = eligible & in_set
+        missing = eligible & ~in_set
+        if in_leak:
+            rewards[attesting] += base_reward_arr[attesting]
+        else:
+            rewards[attesting] += (
+                base_reward_arr[attesting] * (attesting_balance // increment)
+            ) // (total_balance // increment)
+        penalties[missing] += base_reward_arr[missing]
 
-    # proposer/inclusion-delay micro-rewards
-    src_indices = set(_unslashed_attesting_indices(state, src_atts, preset))
-    for i in src_indices:
-        eligible_atts = [
-            a
-            for a in src_atts
-            if i in get_attesting_indices(state, a.data, a.aggregation_bits, preset)
-        ]
-        attestation = min(eligible_atts, key=lambda a: a.inclusion_delay)
-        proposer_reward = base_reward(i) // PROPOSER_REWARD_QUOTIENT
-        rewards[attestation.proposer_index] += proposer_reward
-        max_attester_reward = base_reward(i) - proposer_reward
-        rewards[i] += max_attester_reward // attestation.inclusion_delay
+    # proposer/inclusion-delay micro-rewards: for each source-attesting
+    # validator, the MINIMUM-inclusion-delay attestation containing it
+    # (first in list order on ties — Python min / spec semantics)
+    if src_atts:
+        rows_i, rows_delay, rows_prop, rows_pos = [], [], [], []
+        for pos, a in enumerate(src_atts):
+            idx = _att_indices_cached(state, a, preset)
+            rows_i.append(idx)
+            rows_delay.append(np.full(len(idx), int(a.inclusion_delay), np.int64))
+            rows_prop.append(np.full(len(idx), int(a.proposer_index), np.int64))
+            rows_pos.append(np.full(len(idx), pos, np.int64))
+        all_i = np.concatenate(rows_i)
+        all_delay = np.concatenate(rows_delay)
+        all_prop = np.concatenate(rows_prop)
+        all_pos = np.concatenate(rows_pos)
+        # sort by (validator, delay, list position); first row per validator
+        # is its chosen attestation
+        order = np.lexsort((all_pos, all_delay, all_i))
+        all_i, all_delay, all_prop = all_i[order], all_delay[order], all_prop[order]
+        first = np.ones(len(all_i), dtype=bool)
+        first[1:] = all_i[1:] != all_i[:-1]
+        sel_i, sel_delay, sel_prop = all_i[first], all_delay[first], all_prop[first]
+        unslashed_src = _unslashed_attesting_indices_np(state, src_atts, preset)
+        src_mask = np.zeros(n, dtype=bool)
+        src_mask[unslashed_src] = True
+        keep = src_mask[sel_i]
+        sel_i, sel_delay, sel_prop = sel_i[keep], sel_delay[keep], sel_prop[keep]
+        proposer_reward = base_reward_arr[sel_i] // PROPOSER_REWARD_QUOTIENT
+        np.add.at(rewards, sel_prop, proposer_reward)
+        max_attester = base_reward_arr[sel_i] - proposer_reward
+        np.add.at(rewards, sel_i, max_attester // sel_delay)
 
     # inactivity leak
     if in_leak:
-        tgt_indices = set(_unslashed_attesting_indices(state, tgt_atts, preset))
-        for i in eligible:
-            penalties[i] += BASE_REWARDS_PER_EPOCH * base_reward(i) - (
-                base_reward(i) // PROPOSER_REWARD_QUOTIENT
-            )
-            if i not in tgt_indices:
-                penalties[i] += (
-                    state.validators[i].effective_balance
-                    * finality_delay
-                    // INACTIVITY_PENALTY_QUOTIENT
-                )
+        tgt_idx = _unslashed_attesting_indices_np(state, tgt_atts, preset)
+        tgt_mask = np.zeros(n, dtype=bool)
+        tgt_mask[tgt_idx] = True
+        penalties[eligible] += (
+            BASE_REWARDS_PER_EPOCH * base_reward_arr[eligible]
+            - base_reward_arr[eligible] // PROPOSER_REWARD_QUOTIENT
+        )
+        lagging = eligible & ~tgt_mask
+        penalties[lagging] += eb[lagging] * finality_delay // INACTIVITY_PENALTY_QUOTIENT
 
-    for i in range(len(state.validators)):
-        increase_balance(state, i, rewards[i])
-        decrease_balance(state, i, penalties[i])
+    # penalties are floored at zero PER decrease_balance call in the spec;
+    # here the only interleaving is rewards-then-penalties per validator,
+    # which max(bal + r - p, 0) reproduces exactly.  int64 holds balances
+    # up to 2^62; beyond that (legal-but-absurd SSZ input) use exact ints.
+    bal_u = state.balances.np
+    if len(bal_u) and int(bal_u.max()) >= 2**62:
+        for i in range(n):
+            increase_balance(state, i, int(rewards[i]))
+            decrease_balance(state, i, int(penalties[i]))
+    else:
+        bal = np.maximum(bal_u.astype(np.int64) + rewards - penalties, 0)
+        state.balances.set_np(bal.astype(np.uint64))
 
 
 def process_registry_updates(state, preset, spec=None):
-    current_epoch = get_current_epoch(state, preset)
-    for i, v in enumerate(state.validators):
-        if (
-            v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
-            and v.effective_balance == MAX_EFFECTIVE_BALANCE
-        ):
-            v.activation_eligibility_epoch = current_epoch + 1
-        if is_active_validator(v, current_epoch) and v.effective_balance <= EJECTION_BALANCE:
-            initiate_validator_exit(state, i, preset, spec=spec)
+    current_epoch = np.uint64(get_current_epoch(state, preset))
+    reg = state.validators
+    n = len(reg)
+    far = np.uint64(FAR_FUTURE_EPOCH)
 
-    activation_queue = sorted(
-        [
-            i
-            for i, v in enumerate(state.validators)
-            if v.activation_eligibility_epoch != FAR_FUTURE_EPOCH
-            and v.activation_epoch == FAR_FUTURE_EPOCH
-            and v.activation_eligibility_epoch <= state.finalized_checkpoint.epoch
-        ],
-        key=lambda i: (state.validators[i].activation_eligibility_epoch, i),
+    # activation eligibility (vectorized bulk write)
+    newly_eligible = (reg.activation_eligibility_epoch[:n] == far) & (
+        reg.effective_balance[:n] == np.uint64(MAX_EFFECTIVE_BALANCE)
     )
-    for i in activation_queue[: get_validator_churn_limit(state, preset)]:
-        state.validators[i].activation_epoch = compute_activation_exit_epoch(
-            current_epoch
-        )
+    if newly_eligible.any():
+        aee = reg.activation_eligibility_epoch[:n].copy()
+        aee[newly_eligible] = current_epoch + np.uint64(1)
+        reg.set_field_np("activation_eligibility_epoch", aee)
+
+    # ejections (sequential — exit-queue churn semantics are order-dependent)
+    active = (reg.activation_epoch[:n] <= current_epoch) & (
+        current_epoch < reg.exit_epoch[:n]
+    )
+    eject = np.nonzero(
+        active & (reg.effective_balance[:n] <= np.uint64(EJECTION_BALANCE))
+    )[0]
+    for i in eject:
+        initiate_validator_exit(state, int(i), preset, spec=spec)
+
+    # activation queue: eligible, not yet activated, finalized eligibility
+    aee = reg.activation_eligibility_epoch[:n]
+    queue_mask = (
+        (aee != far)
+        & (reg.activation_epoch[:n] == far)
+        & (aee <= np.uint64(state.finalized_checkpoint.epoch))
+    )
+    queue = np.nonzero(queue_mask)[0]
+    order = np.lexsort((queue, aee[queue]))
+    churn = get_validator_churn_limit(state, preset)
+    dequeued = queue[order][:churn]
+    if len(dequeued):
+        ae = reg.activation_epoch[:n].copy()
+        ae[dequeued] = compute_activation_exit_epoch(int(current_epoch))
+        reg.set_field_np("activation_epoch", ae)
 
 
 def process_slashings(state, preset):
     epoch = get_current_epoch(state, preset)
     total_balance = get_total_active_balance(state, preset)
     adjusted = min(
-        sum(state.slashings) * PROPORTIONAL_SLASHING_MULTIPLIER, total_balance
+        int(state.slashings.np.sum()) * PROPORTIONAL_SLASHING_MULTIPLIER,
+        total_balance,
     )
-    for i, v in enumerate(state.validators):
-        if (
-            v.slashed
-            and epoch + preset.epochs_per_slashings_vector // 2 == v.withdrawable_epoch
-        ):
-            increment = EFFECTIVE_BALANCE_INCREMENT
-            penalty_numerator = v.effective_balance // increment * adjusted
-            penalty = penalty_numerator // total_balance * increment
-            decrease_balance(state, i, penalty)
+    reg = state.validators
+    n = len(reg)
+    target = np.uint64(epoch + preset.epochs_per_slashings_vector // 2)
+    hit = reg.slashed[:n] & (reg.withdrawable_epoch[:n] == target)
+    if not hit.any():
+        return
+    increment = EFFECTIVE_BALANCE_INCREMENT
+    # few hits; exact python-int math (adjusted*quotient can exceed uint64)
+    for i in np.nonzero(hit)[0]:
+        penalty = (
+            int(reg.effective_balance[i]) // increment
+            * adjusted // total_balance * increment
+        )
+        decrease_balance(state, int(i), penalty)
 
 
 def process_final_updates(state, preset):
@@ -537,19 +631,25 @@ def process_final_updates(state, preset):
     # eth1 data votes reset
     if next_epoch % preset.epochs_per_eth1_voting_period == 0:
         state.eth1_data_votes = []
-    # effective balance updates (hysteresis)
+    # effective balance updates (hysteresis) — vectorized over the registry
     HYSTERESIS_QUOTIENT = 4
     HYSTERESIS_DOWNWARD_MULTIPLIER = 1
     HYSTERESIS_UPWARD_MULTIPLIER = 5
-    for i, v in enumerate(state.validators):
-        balance = state.balances[i]
-        hysteresis_increment = EFFECTIVE_BALANCE_INCREMENT // HYSTERESIS_QUOTIENT
-        downward = hysteresis_increment * HYSTERESIS_DOWNWARD_MULTIPLIER
-        upward = hysteresis_increment * HYSTERESIS_UPWARD_MULTIPLIER
-        if balance + downward < v.effective_balance or v.effective_balance + upward < balance:
-            v.effective_balance = min(
-                balance - balance % EFFECTIVE_BALANCE_INCREMENT, MAX_EFFECTIVE_BALANCE
-            )
+    reg = state.validators
+    n = len(reg)
+    bal = state.balances.np
+    eb = reg.effective_balance[:n]
+    hysteresis_increment = np.uint64(EFFECTIVE_BALANCE_INCREMENT // HYSTERESIS_QUOTIENT)
+    downward = hysteresis_increment * np.uint64(HYSTERESIS_DOWNWARD_MULTIPLIER)
+    upward = hysteresis_increment * np.uint64(HYSTERESIS_UPWARD_MULTIPLIER)
+    adjust = (bal + downward < eb) | (eb + upward < bal)
+    if adjust.any():
+        new_eb = eb.copy()
+        new_eb[adjust] = np.minimum(
+            bal[adjust] - bal[adjust] % np.uint64(EFFECTIVE_BALANCE_INCREMENT),
+            np.uint64(MAX_EFFECTIVE_BALANCE),
+        )
+        reg.set_field_np("effective_balance", new_eb)
     # slashings reset
     state.slashings[next_epoch % preset.epochs_per_slashings_vector] = 0
     # randao mix carry-over

@@ -1,0 +1,119 @@
+"""Scaled-state construction rig — build N-validator states in O(arrays).
+
+The reference hits the 1M-validator regime with mainnet data
+(SURVEY.md §5.7); tests and benchmarks here synthesize equivalent states
+directly into the SoA registry (types/collections.py) without per-object
+Python work: random pubkeys (signature verification is not part of the
+epoch-replay benchmark — BASELINE.md config 5 runs the BlockReplayer with
+NoVerification, mirroring /root/reference/consensus/state_processing/src/
+block_replayer.rs strategy seams), full effective balances, and
+`participation`-dense pending attestations for the previous/current epoch.
+"""
+
+import numpy as np
+
+from ..state_processing import phase0
+from ..state_processing.committee_cache import committees_for_epoch
+from ..types.containers import AttestationData, Checkpoint
+from ..types.state import state_types
+
+FAR = 2**64 - 1
+MAX_EB = 32 * 10**9
+
+
+def make_scaled_state(n_validators, spec, epoch=4, participation=0.99, seed=0):
+    """A BeaconState at the start of `epoch` with a full previous-epoch
+    attestation load at the given participation rate."""
+    preset = spec.preset
+    T = state_types(preset)
+    rng = np.random.default_rng(seed)
+
+    state = T.BeaconState()
+    reg = state.validators
+    cap = max(16, 1 << max(n_validators - 1, 1).bit_length())
+    reg.pubkey = rng.integers(0, 256, (cap, 48), dtype=np.int64).astype(np.uint8)
+    reg.withdrawal_credentials = np.zeros((cap, 32), np.uint8)
+    reg.effective_balance = np.full(cap, MAX_EB, np.uint64)
+    reg.slashed = np.zeros(cap, bool)
+    reg.activation_eligibility_epoch = np.zeros(cap, np.uint64)
+    reg.activation_epoch = np.zeros(cap, np.uint64)
+    reg.exit_epoch = np.full(cap, FAR, np.uint64)
+    reg.withdrawable_epoch = np.full(cap, FAR, np.uint64)
+    reg._n = n_validators
+    reg.dirty = set(range(n_validators))
+    reg.rev += 1
+
+    bal = state.balances
+    bal._a = np.full(cap, MAX_EB, np.uint64)
+    bal._n = n_validators
+    bal.dirty = set(range(n_validators))
+    bal.rev += 1
+
+    state.slot = epoch * preset.slots_per_epoch
+    state.genesis_validators_root = b"\x11" * 32
+    for i in range(len(state.randao_mixes)):
+        state.randao_mixes[i] = bytes(
+            rng.integers(0, 256, 32, dtype=np.int64).astype(np.uint8)
+        )
+    # block roots: distinct per slot so matching-head logic has targets
+    for s in range(min(state.slot, len(state.block_roots))):
+        state.block_roots[s % len(state.block_roots)] = (
+            int(s).to_bytes(8, "little") + b"\x22" * 24
+        )
+    prev_epoch = epoch - 1
+    state.previous_justified_checkpoint = Checkpoint(
+        epoch=max(prev_epoch - 1, 0),
+        root=phase0.get_block_root(state, max(prev_epoch - 1, 0), preset),
+    )
+    state.current_justified_checkpoint = Checkpoint(
+        epoch=prev_epoch, root=phase0.get_block_root(state, prev_epoch, preset)
+    )
+    state.finalized_checkpoint = Checkpoint(
+        epoch=max(prev_epoch - 1, 0),
+        root=phase0.get_block_root(state, max(prev_epoch - 1, 0), preset),
+    )
+    state.justification_bits = [1, 1, 0, 0]
+
+    fill_epoch_attestations(state, prev_epoch, spec, participation, rng, target="previous")
+    return state
+
+
+def fill_epoch_attestations(state, epoch, spec, participation, rng, target="previous"):
+    """Append PendingAttestations covering every committee of `epoch`."""
+    preset = spec.preset
+    T = state_types(preset)
+    cache = committees_for_epoch(state, epoch, preset)
+    target_root = phase0.get_block_root(state, epoch, preset)
+    source = (
+        state.previous_justified_checkpoint
+        if target == "previous"
+        else state.current_justified_checkpoint
+    )
+    dest = (
+        state.previous_epoch_attestations
+        if target == "previous"
+        else state.current_epoch_attestations
+    )
+    for slot in range(
+        epoch * preset.slots_per_epoch, (epoch + 1) * preset.slots_per_epoch
+    ):
+        for index in range(cache.committees_per_slot):
+            committee = cache.committee(slot, index)
+            bits = (rng.random(len(committee)) < participation).astype(int).tolist()
+            if not any(bits):
+                bits[0] = 1
+            att = T.PendingAttestation(
+                aggregation_bits=bits,
+                data=AttestationData(
+                    slot=slot,
+                    index=index,
+                    beacon_block_root=phase0.get_block_root_at_slot(
+                        state, slot, preset
+                    ),
+                    source=source,
+                    target=Checkpoint(epoch=epoch, root=target_root),
+                ),
+                inclusion_delay=int(rng.integers(1, 4)),
+                proposer_index=0,
+            )
+            dest.append(att)

@@ -1,0 +1,529 @@
+"""Numpy-backed (structure-of-arrays) state collections.
+
+The TPU-first redesign of the reference's `BeaconState` storage: where
+lighthouse keeps `Vec<Validator>` / `Vec<u64>` and walks them with rayon
+(/root/reference/consensus/types/src/beacon_state.rs; SURVEY.md §5.7 — the
+1M-validator scaling dimension), the hot registry fields here live as
+contiguous numpy arrays.  Epoch processing, committee shuffling, leaf
+hashing for the incremental Merkle cache, and SSZ serialization all become
+vectorized array ops; Python-object views are produced lazily only where
+spec-shaped per-item code touches single elements.
+
+Collections track mutation with a monotonically increasing `rev` counter
+(cheap cache keying for epoch caches) and a dirty-index set (consumed by
+the tree-hash cache to rehash only changed leaves —
+/root/reference/consensus/cached_tree_hash/ in spirit).
+"""
+
+import numpy as np
+
+FAR_FUTURE_EPOCH = 2**64 - 1
+
+_VALIDATOR_FIXED_SIZE = 121  # 48+32+8+1+8+8+8+8
+
+
+class U64List:
+    """Growable uint64 list (balances, inactivity_scores)."""
+
+    __slots__ = ("_a", "_n", "rev", "dirty")
+
+    def __init__(self, values=()):
+        vals = np.asarray(list(values), dtype=np.uint64)
+        self._n = len(vals)
+        cap = max(16, 1 << max(self._n - 1, 1).bit_length())
+        self._a = np.zeros(cap, dtype=np.uint64)
+        self._a[: self._n] = vals
+        self.rev = 0
+        self.dirty = set()
+
+    # -- list protocol ----------------------------------------------------
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [int(v) for v in self._a[: self._n][i]]
+        if i < 0:
+            i += self._n
+        if not 0 <= i < self._n:
+            raise IndexError(i)
+        return int(self._a[i])
+
+    def __setitem__(self, i, v):
+        if i < 0:
+            i += self._n
+        if not 0 <= i < self._n:
+            raise IndexError(i)
+        self._a[i] = v
+        self.rev += 1
+        self.dirty.add(i)
+
+    def append(self, v):
+        if self._n == len(self._a):
+            self._a = np.concatenate([self._a, np.zeros(len(self._a), np.uint64)])
+        self._a[self._n] = v
+        self.dirty.add(self._n)
+        self._n += 1
+        self.rev += 1
+
+    def __iter__(self):
+        for i in range(self._n):
+            yield int(self._a[i])
+
+    def __eq__(self, other):
+        if isinstance(other, U64List):
+            return np.array_equal(self.np, other.np)
+        try:
+            return len(other) == self._n and all(
+                int(a) == int(b) for a, b in zip(self, other)
+            )
+        except TypeError:
+            return NotImplemented
+
+    def __repr__(self):
+        return f"U64List({list(self)!r})"
+
+    def __deepcopy__(self, memo):
+        new = U64List.__new__(U64List)
+        new._a = self._a.copy()
+        new._n = self._n
+        new.rev = self.rev
+        new.dirty = set(self.dirty)
+        return new
+
+    # -- vectorized access -------------------------------------------------
+    @property
+    def np(self):
+        """Read-only live view of the occupied prefix."""
+        return self._a[: self._n]
+
+    def set_np(self, arr):
+        """Bulk overwrite from a uint64 array of the same length."""
+        arr = np.asarray(arr, dtype=np.uint64)
+        assert len(arr) == self._n
+        changed = np.nonzero(arr != self._a[: self._n])[0]
+        if len(changed):
+            self._a[: self._n] = arr
+            self.rev += 1
+            self.dirty.update(int(i) for i in changed)
+
+
+class U64Vector:
+    """Fixed-length uint64 vector (slashings)."""
+
+    __slots__ = ("_a", "rev")
+
+    def __init__(self, values):
+        self._a = np.asarray(list(values), dtype=np.uint64).copy()
+        self.rev = 0
+
+    def __len__(self):
+        return len(self._a)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [int(v) for v in self._a[i]]
+        return int(self._a[i])
+
+    def __setitem__(self, i, v):
+        self._a[i] = v
+        self.rev += 1
+
+    def __iter__(self):
+        return (int(v) for v in self._a)
+
+    def __eq__(self, other):
+        if isinstance(other, U64Vector):
+            return np.array_equal(self._a, other._a)
+        try:
+            return len(other) == len(self._a) and all(
+                int(a) == int(b) for a, b in zip(self, other)
+            )
+        except TypeError:
+            return NotImplemented
+
+    def __repr__(self):
+        return f"U64Vector({list(self)!r})"
+
+    def __deepcopy__(self, memo):
+        new = U64Vector.__new__(U64Vector)
+        new._a = self._a.copy()
+        new.rev = self.rev
+        return new
+
+    @property
+    def np(self):
+        return self._a
+
+
+class RootVector:
+    """Fixed-length vector of 32-byte roots (block_roots, state_roots,
+    randao_mixes) stored as one (n, 32) uint8 array — the Merkle leaves
+    directly."""
+
+    __slots__ = ("_a", "rev")
+
+    def __init__(self, values):
+        values = list(values)
+        self._a = np.zeros((len(values), 32), dtype=np.uint8)
+        for i, v in enumerate(values):
+            b = bytes(v)
+            assert len(b) == 32
+            self._a[i] = np.frombuffer(b, dtype=np.uint8)
+        self.rev = 0
+
+    def __len__(self):
+        return len(self._a)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [row.tobytes() for row in self._a[i]]
+        return self._a[i].tobytes()
+
+    def __setitem__(self, i, v):
+        b = bytes(v)
+        assert len(b) == 32
+        self._a[i] = np.frombuffer(b, dtype=np.uint8)
+        self.rev += 1
+
+    def __iter__(self):
+        return (row.tobytes() for row in self._a)
+
+    def __eq__(self, other):
+        if isinstance(other, RootVector):
+            return np.array_equal(self._a, other._a)
+        try:
+            return len(other) == len(self._a) and all(
+                bytes(a) == bytes(b) for a, b in zip(self, other)
+            )
+        except TypeError:
+            return NotImplemented
+
+    def __repr__(self):
+        return f"RootVector(len={len(self._a)})"
+
+    def __deepcopy__(self, memo):
+        new = RootVector.__new__(RootVector)
+        new._a = self._a.copy()
+        new.rev = self.rev
+        return new
+
+    @property
+    def np(self):
+        return self._a
+
+
+class ValidatorView:
+    """Lightweight per-validator proxy over the registry arrays.
+
+    Attribute reads return plain Python values (so spec-shaped arithmetic
+    stays exact int math); writes hit the arrays and mark the index dirty.
+    """
+
+    __slots__ = ("_r", "_i")
+
+    def __init__(self, registry, index):
+        object.__setattr__(self, "_r", registry)
+        object.__setattr__(self, "_i", index)
+
+    # reads
+    @property
+    def pubkey(self):
+        return self._r.pubkey[self._i].tobytes()
+
+    @property
+    def withdrawal_credentials(self):
+        return self._r.withdrawal_credentials[self._i].tobytes()
+
+    @property
+    def effective_balance(self):
+        return int(self._r.effective_balance[self._i])
+
+    @property
+    def slashed(self):
+        return bool(self._r.slashed[self._i])
+
+    @property
+    def activation_eligibility_epoch(self):
+        return int(self._r.activation_eligibility_epoch[self._i])
+
+    @property
+    def activation_epoch(self):
+        return int(self._r.activation_epoch[self._i])
+
+    @property
+    def exit_epoch(self):
+        return int(self._r.exit_epoch[self._i])
+
+    @property
+    def withdrawable_epoch(self):
+        return int(self._r.withdrawable_epoch[self._i])
+
+    # writes
+    def __setattr__(self, name, value):
+        r, i = self._r, self._i
+        if name in ("pubkey", "withdrawal_credentials"):
+            b = bytes(value)
+            getattr(r, name)[i] = np.frombuffer(b, dtype=np.uint8)
+        elif name in ValidatorView._FIELDS:
+            getattr(r, name)[i] = value
+        else:
+            raise AttributeError(name)
+        r.rev += 1
+        r.dirty.add(i)
+
+    _FIELDS = (
+        "pubkey",
+        "withdrawal_credentials",
+        "effective_balance",
+        "slashed",
+        "activation_eligibility_epoch",
+        "activation_epoch",
+        "exit_epoch",
+        "withdrawable_epoch",
+    )
+
+    def __eq__(self, other):
+        return all(
+            getattr(self, f) == getattr(other, f) for f in ValidatorView._FIELDS
+        )
+
+    def __repr__(self):
+        inner = ", ".join(f"{f}={getattr(self, f)!r}" for f in self._FIELDS)
+        return f"ValidatorView({inner})"
+
+
+class ValidatorRegistry:
+    """SoA storage for the validator registry.
+
+    Exposes the same element API as a list of `Validator` containers
+    (indexing, iteration, append) while keeping every field as one numpy
+    array for the vectorized epoch-processing and tree-hash paths.
+    """
+
+    __slots__ = (
+        "pubkey",
+        "withdrawal_credentials",
+        "effective_balance",
+        "slashed",
+        "activation_eligibility_epoch",
+        "activation_epoch",
+        "exit_epoch",
+        "withdrawable_epoch",
+        "_n",
+        "rev",
+        "dirty",
+    )
+
+    _U64_FIELDS = (
+        "effective_balance",
+        "activation_eligibility_epoch",
+        "activation_epoch",
+        "exit_epoch",
+        "withdrawable_epoch",
+    )
+
+    def __init__(self, validators=()):
+        validators = list(validators)
+        n = len(validators)
+        cap = max(16, 1 << max(n - 1, 1).bit_length())
+        self.pubkey = np.zeros((cap, 48), dtype=np.uint8)
+        self.withdrawal_credentials = np.zeros((cap, 32), dtype=np.uint8)
+        self.effective_balance = np.zeros(cap, dtype=np.uint64)
+        self.slashed = np.zeros(cap, dtype=bool)
+        self.activation_eligibility_epoch = np.zeros(cap, dtype=np.uint64)
+        self.activation_epoch = np.zeros(cap, dtype=np.uint64)
+        self.exit_epoch = np.zeros(cap, dtype=np.uint64)
+        self.withdrawable_epoch = np.zeros(cap, dtype=np.uint64)
+        self._n = 0
+        self.rev = 0
+        self.dirty = set()
+        for v in validators:
+            self.append(v)
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(self._n))]
+        if i < 0:
+            i += self._n
+        if not 0 <= i < self._n:
+            raise IndexError(i)
+        return ValidatorView(self, i)
+
+    def __iter__(self):
+        for i in range(self._n):
+            yield ValidatorView(self, i)
+
+    def append(self, v):
+        if self._n == len(self.effective_balance):
+            self._grow()
+        i = self._n
+        self.pubkey[i] = np.frombuffer(bytes(v.pubkey), dtype=np.uint8)
+        self.withdrawal_credentials[i] = np.frombuffer(
+            bytes(v.withdrawal_credentials), dtype=np.uint8
+        )
+        self.effective_balance[i] = v.effective_balance
+        self.slashed[i] = bool(v.slashed)
+        self.activation_eligibility_epoch[i] = v.activation_eligibility_epoch
+        self.activation_epoch[i] = v.activation_epoch
+        self.exit_epoch[i] = v.exit_epoch
+        self.withdrawable_epoch[i] = v.withdrawable_epoch
+        self._n += 1
+        self.rev += 1
+        self.dirty.add(i)
+
+    def _grow(self):
+        cap = len(self.effective_balance)
+        self.pubkey = np.concatenate([self.pubkey, np.zeros((cap, 48), np.uint8)])
+        self.withdrawal_credentials = np.concatenate(
+            [self.withdrawal_credentials, np.zeros((cap, 32), np.uint8)]
+        )
+        for f in ("slashed",):
+            setattr(self, f, np.concatenate([getattr(self, f), np.zeros(cap, bool)]))
+        for f in self._U64_FIELDS:
+            setattr(
+                self, f, np.concatenate([getattr(self, f), np.zeros(cap, np.uint64)])
+            )
+
+    def __eq__(self, other):
+        if isinstance(other, ValidatorRegistry):
+            n = self._n
+            return n == other._n and all(
+                np.array_equal(getattr(self, f)[:n], getattr(other, f)[:n])
+                for f in self.__slots__[:8]
+            )
+        try:
+            return len(other) == self._n and all(
+                a == b for a, b in zip(self, other)
+            )
+        except TypeError:
+            return NotImplemented
+
+    def __repr__(self):
+        return f"ValidatorRegistry(n={self._n})"
+
+    def __deepcopy__(self, memo):
+        new = ValidatorRegistry.__new__(ValidatorRegistry)
+        for f in self.__slots__[:8]:
+            setattr(new, f, getattr(self, f).copy())
+        new._n = self._n
+        new.rev = self.rev
+        new.dirty = set(self.dirty)
+        return new
+
+    # -- vectorized epoch-processing access --------------------------------
+    def arrays(self):
+        """Dict of live field arrays clipped to the occupied prefix."""
+        n = self._n
+        return {f: getattr(self, f)[:n] for f in self.__slots__[:8]}
+
+    def set_field_np(self, field, arr):
+        """Bulk overwrite of one u64/bool field; dirty-marks changed rows."""
+        cur = getattr(self, field)[: self._n]
+        arr = np.asarray(arr, dtype=cur.dtype)
+        changed = np.nonzero(arr != cur)[0]
+        if len(changed):
+            cur[changed] = arr[changed]
+            self.rev += 1
+            self.dirty.update(int(i) for i in changed)
+
+    # -- SSZ fast paths -----------------------------------------------------
+    def ssz_serialize_fast(self):
+        """Vectorized fixed-size Validator record serialization (121B each)."""
+        n = self._n
+        out = np.zeros((n, _VALIDATOR_FIXED_SIZE), dtype=np.uint8)
+        out[:, 0:48] = self.pubkey[:n]
+        out[:, 48:80] = self.withdrawal_credentials[:n]
+        out[:, 80:88] = self.effective_balance[:n].astype("<u8").view(np.uint8).reshape(n, 8)
+        out[:, 88] = self.slashed[:n]
+        out[:, 89:97] = (
+            self.activation_eligibility_epoch[:n].astype("<u8").view(np.uint8).reshape(n, 8)
+        )
+        out[:, 97:105] = self.activation_epoch[:n].astype("<u8").view(np.uint8).reshape(n, 8)
+        out[:, 105:113] = self.exit_epoch[:n].astype("<u8").view(np.uint8).reshape(n, 8)
+        out[:, 113:121] = (
+            self.withdrawable_epoch[:n].astype("<u8").view(np.uint8).reshape(n, 8)
+        )
+        return out.tobytes()
+
+    @classmethod
+    def ssz_deserialize_fast(cls, data: bytes):
+        if len(data) % _VALIDATOR_FIXED_SIZE:
+            raise ValueError("validator records: bad length")
+        n = len(data) // _VALIDATOR_FIXED_SIZE
+        rec = np.frombuffer(data, dtype=np.uint8).reshape(n, _VALIDATOR_FIXED_SIZE)
+        if n and rec[:, 88].max() > 1:
+            raise ValueError("validator records: invalid boolean byte")
+        new = cls()
+        cap = max(16, 1 << max(n - 1, 1).bit_length())
+        new.pubkey = np.zeros((cap, 48), np.uint8)
+        new.withdrawal_credentials = np.zeros((cap, 32), np.uint8)
+        for f in ("slashed",):
+            setattr(new, f, np.zeros(cap, bool))
+        for f in cls._U64_FIELDS:
+            setattr(new, f, np.zeros(cap, np.uint64))
+        new.pubkey[:n] = rec[:, 0:48]
+        new.withdrawal_credentials[:n] = rec[:, 48:80]
+        new.effective_balance[:n] = rec[:, 80:88].copy().view("<u8").ravel()
+        new.slashed[:n] = rec[:, 88] != 0
+        new.activation_eligibility_epoch[:n] = rec[:, 89:97].copy().view("<u8").ravel()
+        new.activation_epoch[:n] = rec[:, 97:105].copy().view("<u8").ravel()
+        new.exit_epoch[:n] = rec[:, 105:113].copy().view("<u8").ravel()
+        new.withdrawable_epoch[:n] = rec[:, 113:121].copy().view("<u8").ravel()
+        new._n = n
+        new.dirty = set(range(n))
+        return new
+
+    # -- tree-hash leaf extraction ------------------------------------------
+    def leaf_roots(self, only=None):
+        """hash_tree_root of each validator, vectorized (8 batched SHA calls).
+
+        `only`: optional sorted index array — compute just those rows (the
+        dirty-leaf path of the Merkle cache).
+        Layout per validator (8 leaves):
+          0: root of pubkey (two chunks: bytes 0..32, 32..48 padded)
+          1: withdrawal_credentials
+          2..7: u64/bool fields packed little-endian into chunk[0:8]/[0:1]
+        """
+        from ..native import hash_pairs
+
+        n = self._n
+        idx = np.arange(n) if only is None else np.asarray(only, dtype=np.int64)
+        k = len(idx)
+        if k == 0:
+            return np.zeros((0, 32), dtype=np.uint8)
+        # pubkey root: one 64-byte message per validator
+        pkbuf = np.zeros((k, 64), dtype=np.uint8)
+        pkbuf[:, 0:48] = self.pubkey[idx]
+        pk_root = hash_pairs(pkbuf)
+
+        leaves = np.zeros((k, 8, 32), dtype=np.uint8)
+        leaves[:, 0] = pk_root
+        leaves[:, 1] = self.withdrawal_credentials[idx]
+        leaves[:, 2, 0:8] = (
+            self.effective_balance[idx].astype("<u8").view(np.uint8).reshape(k, 8)
+        )
+        leaves[:, 3, 0] = self.slashed[idx]
+        for li, f in zip(
+            (4, 5, 6, 7),
+            (
+                "activation_eligibility_epoch",
+                "activation_epoch",
+                "exit_epoch",
+                "withdrawable_epoch",
+            ),
+        ):
+            leaves[:, li, 0:8] = (
+                getattr(self, f)[idx].astype("<u8").view(np.uint8).reshape(k, 8)
+            )
+        lvl = hash_pairs(leaves.reshape(k * 4, 64)).reshape(k, 4, 32)
+        lvl = hash_pairs(lvl.reshape(k * 2, 64)).reshape(k, 2, 32)
+        return hash_pairs(lvl.reshape(k, 64))
+
+    def take_dirty(self):
+        d = self.dirty
+        self.dirty = set()
+        return d

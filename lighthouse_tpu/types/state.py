@@ -12,8 +12,15 @@ they land).
 
 from functools import lru_cache
 
+from .collections import (
+    RootVector,
+    U64List,
+    U64Vector,
+    ValidatorRegistry,
+)
 from ..ssz import (
     Bitlist,
+    DecodeError,
     Bitvector,
     Boolean,
     Bytes32,
@@ -67,6 +74,39 @@ class Deposit(Container):
         ("proof", Vector(Bytes32, DEPOSIT_CONTRACT_TREE_DEPTH + 1)),
         ("data", DepositData),
     ]
+
+
+class ValidatorList(List):
+    """List[Validator] whose runtime value is the SoA ValidatorRegistry —
+    (de)serialization runs vectorized over the packed 121-byte records."""
+
+    def __init__(self, limit):
+        super().__init__(Validator, limit)
+
+    def deserialize(self, data):
+        try:
+            reg = ValidatorRegistry.ssz_deserialize_fast(bytes(data))
+        except ValueError as e:
+            raise DecodeError(str(e)) from e
+        if len(reg) > self.limit:
+            raise DecodeError(f"ValidatorList over limit: {len(reg)}")
+        return reg
+
+    def default(self):
+        return ValidatorRegistry()
+
+
+# Field-value wrappers: assignment into a BeaconState converts plain lists
+# into the numpy-backed collections (idempotent for already-wrapped values).
+_STATE_FIELD_WRAPPERS = {
+    "validators": lambda v: v if isinstance(v, ValidatorRegistry) else ValidatorRegistry(v),
+    "balances": lambda v: v if isinstance(v, U64List) else U64List(v),
+    "slashings": lambda v: v if isinstance(v, U64Vector) else U64Vector(v),
+    "block_roots": lambda v: v if isinstance(v, RootVector) else RootVector(v),
+    "state_roots": lambda v: v if isinstance(v, RootVector) else RootVector(v),
+    "randao_mixes": lambda v: v if isinstance(v, RootVector) else RootVector(v),
+    "inactivity_scores": lambda v: v if isinstance(v, U64List) else U64List(v),
+}
 
 
 @lru_cache(maxsize=None)
@@ -144,7 +184,7 @@ def state_types(preset):
                 preset.slots_per_epoch * preset.epochs_per_eth1_voting_period,
             )),
             ("eth1_deposit_index", uint64),
-            ("validators", List(Validator, preset.validator_registry_limit)),
+            ("validators", ValidatorList(preset.validator_registry_limit)),
             ("balances", List(uint64, preset.validator_registry_limit)),
             ("randao_mixes", Vector(Bytes32, preset.epochs_per_historical_vector)),
             ("slashings", Vector(uint64, preset.epochs_per_slashings_vector)),
@@ -159,6 +199,16 @@ def state_types(preset):
             ("current_justified_checkpoint", Checkpoint),
             ("finalized_checkpoint", Checkpoint),
         ]
+
+        # hash_tree_root(state) routes through the incremental StateHasher
+        # (ssz.cached — the cached_tree_hash analogue)
+        _cached_tree_hash = True
+
+        def __setattr__(self, name, value):
+            w = _STATE_FIELD_WRAPPERS.get(name)
+            if w is not None:
+                value = w(value)
+            object.__setattr__(self, name, value)
 
     ns = type("StateTypes", (), {})
     ns.Attestation = Attestation

@@ -1,0 +1,115 @@
+"""Incremental (cached) state tree hashing vs full re-merkleization.
+
+The cached hasher (ssz/cached.py, the cached_tree_hash analogue) must be
+bit-identical to a from-scratch hash after ANY mutation sequence.
+"""
+
+import random
+
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.ssz.hash import merkleize
+from lighthouse_tpu.ssz.cached import MerkleListCache, cached_state_root
+from lighthouse_tpu.state_processing.genesis import (
+    interop_genesis_state,
+    interop_keypairs,
+)
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+from lighthouse_tpu.types.state import state_types
+
+import numpy as np
+
+
+def _full_root(state):
+    """From-scratch root, bypassing the instance hasher."""
+    cls = type(state)
+    leaves = [hash_tree_root(t, getattr(state, n)) for n, t in cls.fields]
+    return merkleize(leaves, len(leaves))
+
+
+def test_merkle_list_cache_matches_merkleize():
+    rng = random.Random(11)
+    cache = MerkleListCache(limit=2**16)
+    leaves = np.zeros((0, 32), dtype=np.uint8)
+    for step in range(40):
+        action = rng.choice(["append", "mutate", "mutate", "append_many"])
+        if action == "append" or leaves.shape[0] == 0:
+            add = np.frombuffer(rng.randbytes(32), dtype=np.uint8)[None]
+            leaves = np.concatenate([leaves, add])
+        elif action == "append_many":
+            k = rng.randrange(1, 40)
+            add = np.frombuffer(rng.randbytes(32 * k), dtype=np.uint8).reshape(k, 32)
+            leaves = np.concatenate([leaves, add])
+        else:
+            i = rng.randrange(leaves.shape[0])
+            leaves = leaves.copy()
+            leaves[i] = np.frombuffer(rng.randbytes(32), dtype=np.uint8)
+        got = cache.update(leaves.copy())
+        want = merkleize([leaves[i].tobytes() for i in range(leaves.shape[0])], 2**16)
+        assert got == want, step
+
+
+def test_cached_state_root_matches_full_after_mutations():
+    rng = random.Random(7)
+    spec = ChainSpec(preset=MinimalPreset)
+    keypairs = interop_keypairs(16)
+    state = interop_genesis_state(keypairs, 0, spec)
+    T = state_types(MinimalPreset)
+
+    assert cached_state_root(state) == _full_root(state)
+
+    for step in range(30):
+        action = rng.randrange(6)
+        if action == 0:
+            i = rng.randrange(len(state.validators))
+            state.validators[i].effective_balance = rng.randrange(32 * 10**9)
+        elif action == 1:
+            i = rng.randrange(len(state.balances))
+            state.balances[i] = rng.randrange(64 * 10**9)
+        elif action == 2:
+            state.randao_mixes[rng.randrange(len(state.randao_mixes))] = (
+                rng.randbytes(32)
+            )
+        elif action == 3:
+            state.slot += 1
+            state.block_roots[state.slot % len(state.block_roots)] = rng.randbytes(32)
+        elif action == 4:
+            from lighthouse_tpu.types.state import Validator
+
+            state.validators.append(
+                Validator(
+                    pubkey=rng.randbytes(48),
+                    withdrawal_credentials=rng.randbytes(32),
+                    effective_balance=32 * 10**9,
+                    slashed=False,
+                    activation_eligibility_epoch=0,
+                    activation_epoch=0,
+                    exit_epoch=2**64 - 1,
+                    withdrawable_epoch=2**64 - 1,
+                )
+            )
+            state.balances.append(32 * 10**9)
+        else:
+            # attestation-list rotation (the id-reuse hazard path)
+            atts = [
+                T.PendingAttestation(
+                    aggregation_bits=[1, 0, 1],
+                    inclusion_delay=rng.randrange(1, 5),
+                    proposer_index=rng.randrange(16),
+                )
+                for _ in range(rng.randrange(1, 4))
+            ]
+            state.previous_epoch_attestations = state.current_epoch_attestations
+            state.current_epoch_attestations = atts
+        assert cached_state_root(state) == _full_root(state), (step, action)
+
+
+def test_copy_preserves_incremental_hashing():
+    spec = ChainSpec(preset=MinimalPreset)
+    state = interop_genesis_state(interop_keypairs(8), 0, spec)
+    r1 = hash_tree_root(state)
+    clone = state.copy()
+    assert hash_tree_root(clone) == r1
+    clone.balances[0] += 1
+    assert hash_tree_root(clone) != r1
+    assert hash_tree_root(state) == r1
+    assert hash_tree_root(clone) == _full_root(clone)
