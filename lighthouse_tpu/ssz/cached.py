@@ -22,7 +22,7 @@ from .hash import (
     hash_tree_root,
     merkleize,
     mix_in_length,
-    pack_u64_np,
+    pack_basic_np,
 )
 from ..native import hash_pairs
 
@@ -147,7 +147,7 @@ class StateHasher:
         if hasattr(value, "np"):                    # numpy-backed collections
             arr = value.np
             if _is_basic(getattr(typ, "elem", None)):
-                leaves = pack_u64_np(arr)
+                leaves = pack_basic_np(arr)         # dtype-aware SSZ packing
             else:
                 leaves = arr
             cache = self._cache(name, _chunk_count(typ))

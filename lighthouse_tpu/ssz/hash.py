@@ -162,13 +162,18 @@ def hash_tree_root(typ, value=None):
     raise TypeError(f"cannot hash_tree_root {typ}")
 
 
+def pack_basic_np(arr: np.ndarray) -> np.ndarray:
+    """Basic-typed numpy array -> (ceil(nbytes/32), 32) uint8 chunks."""
+    raw = arr.astype(arr.dtype.newbyteorder("<")).view(np.uint8).ravel()
+    n_chunks = max((len(raw) + 31) // 32, 0)
+    buf = np.zeros(n_chunks * 32, dtype=np.uint8)
+    buf[: len(raw)] = raw
+    return buf.reshape(n_chunks, 32)
+
+
 def pack_u64_np(arr: np.ndarray) -> np.ndarray:
     """uint64 array -> (ceil(n/4), 32) uint8 chunk array (SSZ packing)."""
-    n = len(arr)
-    n_chunks = max((n + 3) // 4, 0)
-    buf = np.zeros(n_chunks * 32, dtype=np.uint8)
-    buf[: n * 8] = arr.astype("<u8").view(np.uint8)
-    return buf.reshape(n_chunks, 32)
+    return pack_basic_np(arr.astype(np.uint64))
 
 
 def _sequence_root(elem, values, limit):
@@ -177,8 +182,8 @@ def _sequence_root(elem, values, limit):
         return merkleize_np(values.leaf_roots(), limit)
     if hasattr(values, "np"):
         arr = values.np
-        if _is_basic(elem):                           # U64List / U64Vector
-            return merkleize_np(pack_u64_np(arr), limit)
+        if _is_basic(elem):                           # U64List / U8List / ...
+            return merkleize_np(pack_basic_np(arr), limit)
         return merkleize_np(arr, limit)               # RootVector
     if _is_basic(elem):
         packed = b"".join(elem.serialize(v) for v in values)

@@ -1,0 +1,633 @@
+"""Altair beacon state transition — participation flags, sync committees.
+
+Mirror of /root/reference/consensus/state_processing/src/
+per_epoch_processing/altair.rs:22 (`altair::process_epoch`) and the altair
+arms of per_block_processing (sync-aggregate processing,
+flag-based attestation rewards).  Same vectorization strategy as phase0:
+every per-validator loop is a numpy array op over the SoA registry.
+
+Fork upgrade (`upgrade_to_altair`) mirrors
+/root/reference/consensus/state_processing/src/upgrade/altair.rs:
+pending attestations are translated into participation flags.
+"""
+
+import numpy as np
+
+from ..ssz import hash_tree_root
+from ..types import Domain
+from ..types.state import state_types
+from . import phase0
+from . import signature_sets as sset
+from .phase0 import (
+    BASE_REWARD_FACTOR,
+    EFFECTIVE_BALANCE_INCREMENT,
+    GENESIS_EPOCH,
+    MAX_EFFECTIVE_BALANCE,
+    MIN_EPOCHS_TO_INACTIVITY_PENALTY,
+    _isqrt,
+    _sha,
+    decrease_balance,
+    get_block_root,
+    get_block_root_at_slot,
+    get_current_epoch,
+    get_previous_epoch,
+    get_total_active_balance,
+    get_total_balance,
+    increase_balance,
+)
+
+# ------------------------------------------------------------ constants
+
+TIMELY_SOURCE_FLAG_INDEX = 0
+TIMELY_TARGET_FLAG_INDEX = 1
+TIMELY_HEAD_FLAG_INDEX = 2
+
+TIMELY_SOURCE_WEIGHT = 14
+TIMELY_TARGET_WEIGHT = 26
+TIMELY_HEAD_WEIGHT = 14
+SYNC_REWARD_WEIGHT = 2
+PROPOSER_WEIGHT = 8
+WEIGHT_DENOMINATOR = 64
+
+PARTICIPATION_FLAG_WEIGHTS = [
+    (TIMELY_SOURCE_FLAG_INDEX, TIMELY_SOURCE_WEIGHT),
+    (TIMELY_TARGET_FLAG_INDEX, TIMELY_TARGET_WEIGHT),
+    (TIMELY_HEAD_FLAG_INDEX, TIMELY_HEAD_WEIGHT),
+]
+
+INACTIVITY_PENALTY_QUOTIENT_ALTAIR = 3 * 2**24
+MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR = 64
+PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR = 2
+
+INACTIVITY_SCORE_BIAS = 4
+INACTIVITY_SCORE_RECOVERY_RATE = 16
+
+
+def is_altair_state(state):
+    return hasattr(state, "previous_epoch_participation")
+
+
+# ------------------------------------------------------------ accessors
+
+
+def get_base_reward_per_increment(state, preset, total_balance=None):
+    if total_balance is None:
+        total_balance = get_total_active_balance(state, preset)
+    return (
+        EFFECTIVE_BALANCE_INCREMENT * BASE_REWARD_FACTOR // _isqrt(total_balance)
+    )
+
+
+def get_base_reward(state, index, preset, total_balance=None):
+    """Spec altair get_base_reward (per-increment form)."""
+    increments = (
+        state.validators[index].effective_balance // EFFECTIVE_BALANCE_INCREMENT
+    )
+    return increments * get_base_reward_per_increment(state, preset, total_balance)
+
+
+def has_flag(flags, flag_index):
+    return (int(flags) >> flag_index) & 1 == 1
+
+
+def add_flag(flags, flag_index):
+    return int(flags) | (1 << flag_index)
+
+
+def get_unslashed_participating_indices_np(state, flag_index, epoch, preset):
+    """Vectorized spec get_unslashed_participating_indices."""
+    if epoch == get_current_epoch(state, preset):
+        part = state.current_epoch_participation.np
+    elif epoch == get_previous_epoch(state, preset):
+        part = state.previous_epoch_participation.np
+    else:
+        raise AssertionError("epoch out of range")
+    reg = state.validators
+    n = len(reg)
+    e = np.uint64(epoch)
+    active = (reg.activation_epoch[:n] <= e) & (e < reg.exit_epoch[:n])
+    flagged = (part[:n] >> np.uint8(flag_index)) & np.uint8(1)
+    return np.nonzero(active & flagged.astype(bool) & ~reg.slashed[:n])[0]
+
+
+def get_attestation_participation_flag_indices(state, data, inclusion_delay, preset):
+    """Spec: which flags an attestation earns given its timeliness."""
+    import math
+
+    if data.target.epoch == get_current_epoch(state, preset):
+        justified_checkpoint = state.current_justified_checkpoint
+    else:
+        justified_checkpoint = state.previous_justified_checkpoint
+    is_matching_source = data.source == justified_checkpoint
+    assert is_matching_source, "bad source"
+    is_matching_target = is_matching_source and data.target.root == get_block_root(
+        state, data.target.epoch, preset
+    )
+    is_matching_head = (
+        is_matching_target
+        and data.beacon_block_root == get_block_root_at_slot(state, data.slot, preset)
+    )
+    flags = []
+    if is_matching_source and inclusion_delay <= int(
+        math.isqrt(preset.slots_per_epoch)
+    ):
+        flags.append(TIMELY_SOURCE_FLAG_INDEX)
+    if is_matching_target and inclusion_delay <= preset.slots_per_epoch:
+        flags.append(TIMELY_TARGET_FLAG_INDEX)
+    if is_matching_head and inclusion_delay == phase0.MIN_ATTESTATION_INCLUSION_DELAY:
+        flags.append(TIMELY_HEAD_FLAG_INDEX)
+    return flags
+
+
+# --------------------------------------------------------- sync committee
+
+
+def get_next_sync_committee_indices(state, preset):
+    """Spec get_next_sync_committee_indices: effective-balance-weighted
+    sampling over the shuffled active set of the NEXT epoch."""
+    from .shuffle import shuffled_index
+
+    epoch = get_current_epoch(state, preset) + 1
+    active = phase0.get_active_validator_indices_np(state, epoch)
+    n = len(active)
+    assert n > 0
+    seed = phase0.get_seed(state, epoch, Domain.SYNC_COMMITTEE, preset)
+    indices = []
+    i = 0
+    reg = state.validators
+    while len(indices) < preset.sync_committee_size:
+        shuffled = shuffled_index(i % n, n, seed)
+        candidate = int(active[shuffled])
+        random_byte = _sha(seed + (i // 32).to_bytes(8, "little"))[i % 32]
+        eb = int(reg.effective_balance[candidate])
+        if eb * 255 >= MAX_EFFECTIVE_BALANCE * random_byte:
+            indices.append(candidate)
+        i += 1
+    return indices
+
+
+def get_next_sync_committee(state, preset):
+    from ..crypto.ref.bls import aggregate_pubkeys
+    from ..crypto.ref.curves import g1_compress, g1_decompress
+
+    T = state_types(preset)
+    indices = get_next_sync_committee_indices(state, preset)
+    pubkeys = [bytes(state.validators[i].pubkey) for i in indices]
+    points = [g1_decompress(pk, subgroup_check=False) for pk in pubkeys]
+    aggregate = g1_compress(aggregate_pubkeys(points))
+    return T.SyncCommittee(pubkeys=pubkeys, aggregate_pubkey=aggregate)
+
+
+def sync_committee_validator_indices(state, preset):
+    """Map current sync-committee pubkeys back to validator indices.
+
+    Cached on the state keyed by the committee object (constant for a whole
+    sync-committee period — the reference's sync-committee cache); the
+    registry is scanned once per period via a pubkey->index dict, not per
+    block."""
+    cached = getattr(state, "_sync_committee_indices", None)
+    if cached is not None and cached[0] is state.current_sync_committee:
+        return cached[1]
+    reg = state.validators
+    n = len(reg)
+    pk_to_index = {
+        reg.pubkey[i].tobytes(): i for i in range(n)
+    }
+    out = [
+        pk_to_index[bytes(pk)] for pk in state.current_sync_committee.pubkeys
+    ]
+    object.__setattr__(
+        state, "_sync_committee_indices", (state.current_sync_committee, out)
+    )
+    return out
+
+
+# ------------------------------------------------------------------ epoch
+
+
+def process_epoch(state, preset, spec=None):
+    """altair.rs:22 process_epoch."""
+    process_justification_and_finalization(state, preset)
+    process_inactivity_updates(state, preset)
+    process_rewards_and_penalties(state, preset)
+    phase0.process_registry_updates(state, preset, spec=spec)
+    process_slashings(state, preset)
+    phase0.process_final_updates_partial(state, preset)
+    process_participation_flag_updates(state)
+    process_sync_committee_updates(state, preset)
+
+
+def process_justification_and_finalization(state, preset):
+    if get_current_epoch(state, preset) <= GENESIS_EPOCH + 1:
+        return
+    previous_indices = get_unslashed_participating_indices_np(
+        state, TIMELY_TARGET_FLAG_INDEX, get_previous_epoch(state, preset), preset
+    )
+    current_indices = get_unslashed_participating_indices_np(
+        state, TIMELY_TARGET_FLAG_INDEX, get_current_epoch(state, preset), preset
+    )
+    total_active = get_total_active_balance(state, preset)
+    previous_target = get_total_balance(state, previous_indices)
+    current_target = get_total_balance(state, current_indices)
+    phase0.weigh_justification_and_finalization(
+        state, preset, total_active, previous_target, current_target
+    )
+
+
+def process_inactivity_updates(state, preset):
+    """Vectorized spec process_inactivity_updates."""
+    if get_current_epoch(state, preset) == GENESIS_EPOCH:
+        return
+    prev = get_previous_epoch(state, preset)
+    reg = state.validators
+    n = len(reg)
+    e = np.uint64(prev)
+    eligible = (
+        (reg.activation_epoch[:n] <= e) & (e < reg.exit_epoch[:n])
+    ) | (reg.slashed[:n] & (e + np.uint64(1) < reg.withdrawable_epoch[:n]))
+    part_tgt = np.zeros(n, dtype=bool)
+    part_tgt[
+        get_unslashed_participating_indices_np(
+            state, TIMELY_TARGET_FLAG_INDEX, prev, preset
+        )
+    ] = True
+
+    scores = state.inactivity_scores.np.astype(np.int64)
+    inc = np.where(part_tgt, -np.minimum(scores, 1), INACTIVITY_SCORE_BIAS)
+    scores = scores + np.where(eligible, inc, 0)
+    finality_delay = prev - state.finalized_checkpoint.epoch
+    if not finality_delay > MIN_EPOCHS_TO_INACTIVITY_PENALTY:
+        scores = scores - np.where(
+            eligible, np.minimum(scores, INACTIVITY_SCORE_RECOVERY_RATE), 0
+        )
+    state.inactivity_scores.set_np(np.maximum(scores, 0).astype(np.uint64))
+
+
+def process_rewards_and_penalties(state, preset):
+    """Vectorized altair flag-based deltas (get_flag_index_deltas +
+    get_inactivity_penalty_deltas)."""
+    if get_current_epoch(state, preset) == GENESIS_EPOCH:
+        return
+    prev = get_previous_epoch(state, preset)
+    reg = state.validators
+    n = len(reg)
+    total_balance = get_total_active_balance(state, preset)
+    brpi = get_base_reward_per_increment(state, preset, total_balance)
+    eb = reg.effective_balance[:n].astype(np.int64)
+    base_reward = (eb // EFFECTIVE_BALANCE_INCREMENT) * brpi
+
+    e = np.uint64(prev)
+    eligible = (
+        (reg.activation_epoch[:n] <= e) & (e < reg.exit_epoch[:n])
+    ) | (reg.slashed[:n] & (e + np.uint64(1) < reg.withdrawable_epoch[:n]))
+
+    finality_delay = prev - state.finalized_checkpoint.epoch
+    in_leak = finality_delay > MIN_EPOCHS_TO_INACTIVITY_PENALTY
+
+    rewards = np.zeros(n, dtype=np.int64)
+    penalties = np.zeros(n, dtype=np.int64)
+    total_increments = total_balance // EFFECTIVE_BALANCE_INCREMENT
+
+    for flag_index, weight in PARTICIPATION_FLAG_WEIGHTS:
+        unslashed = get_unslashed_participating_indices_np(
+            state, flag_index, prev, preset
+        )
+        in_set = np.zeros(n, dtype=bool)
+        in_set[unslashed] = True
+        attesting = eligible & in_set
+        missing = eligible & ~in_set
+        if not in_leak:
+            # spec get_total_balance floors at one increment
+            participating_increments = (
+                get_total_balance(state, unslashed) // EFFECTIVE_BALANCE_INCREMENT
+            )
+            rewards[attesting] += (
+                base_reward[attesting] * weight * participating_increments
+            ) // (total_increments * WEIGHT_DENOMINATOR)
+        if flag_index != TIMELY_HEAD_FLAG_INDEX:
+            penalties[missing] += base_reward[missing] * weight // WEIGHT_DENOMINATOR
+
+    # inactivity penalties (score-scaled, always applied to non-target)
+    tgt = get_unslashed_participating_indices_np(
+        state, TIMELY_TARGET_FLAG_INDEX, prev, preset
+    )
+    tgt_mask = np.zeros(n, dtype=bool)
+    tgt_mask[tgt] = True
+    lagging = eligible & ~tgt_mask
+    scores = state.inactivity_scores.np.astype(np.int64)
+    penalty_denominator = INACTIVITY_SCORE_BIAS * INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+    penalties[lagging] += (eb[lagging] * scores[lagging]) // penalty_denominator
+
+    bal_u = state.balances.np
+    if len(bal_u) and int(bal_u.max()) >= 2**62:
+        for i in range(n):
+            increase_balance(state, i, int(rewards[i]))
+            decrease_balance(state, i, int(penalties[i]))
+    else:
+        bal = np.maximum(bal_u.astype(np.int64) + rewards - penalties, 0)
+        state.balances.set_np(bal.astype(np.uint64))
+
+
+def process_slashings(state, preset):
+    phase0.process_slashings_with_multiplier(
+        state, preset, PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR
+    )
+
+
+def process_participation_flag_updates(state):
+    from ..types.collections import U8List
+
+    state.previous_epoch_participation = state.current_epoch_participation
+    state.current_epoch_participation = U8List(
+        np.zeros(len(state.validators), dtype=np.uint8)
+    )
+
+
+def process_sync_committee_updates(state, preset):
+    next_epoch = get_current_epoch(state, preset) + 1
+    if next_epoch % preset.epochs_per_sync_committee_period == 0:
+        state.current_sync_committee = state.next_sync_committee
+        state.next_sync_committee = get_next_sync_committee(state, preset)
+
+
+# ------------------------------------------------------------------ block
+
+
+def per_block_processing(
+    state,
+    signed_block,
+    spec,
+    signature_strategy=phase0.BlockSignatureStrategy.VERIFY_INDIVIDUAL,
+    verify_fn=None,
+    collected_sets=None,
+):
+    """Altair per_block_processing — same strategy seam as phase0."""
+    preset = spec.preset
+    block = signed_block.message
+    verifying = signature_strategy != phase0.BlockSignatureStrategy.NO_VERIFICATION
+    sets = []
+
+    get_pubkey = phase0._registry_pubkey_closure(state)
+
+    if verifying:
+        from ..types.containers import BeaconBlockHeader, SignedBeaconBlockHeader
+
+        header = BeaconBlockHeader(
+            slot=block.slot,
+            proposer_index=block.proposer_index,
+            parent_root=block.parent_root,
+            state_root=block.state_root,
+            body_root=hash_tree_root(block.body),
+        )
+        sets.append(
+            sset.block_proposal_signature_set(
+                get_pubkey,
+                SignedBeaconBlockHeader(message=header, signature=signed_block.signature),
+                state.fork,
+                state.genesis_validators_root,
+                spec,
+            )
+        )
+
+    phase0.process_block_header(state, block, preset)
+    phase0.process_randao(state, block.body, spec, verifying, sets, get_pubkey)
+    phase0.process_eth1_data(state, block.body, preset)
+    process_operations(state, block.body, spec, verifying, sets, get_pubkey)
+    process_sync_aggregate(
+        state, block.body.sync_aggregate, spec, verifying, sets, get_pubkey
+    )
+
+    if verifying:
+        if collected_sets is not None:
+            collected_sets.extend(sets)
+        else:
+            if verify_fn is None:
+                from ..crypto.ref.bls import verify_signature_sets as verify_fn
+            if not verify_fn(sets):
+                raise phase0.BlockProcessingError("bulk signature verification failed")
+    return state
+
+
+def process_operations(state, body, spec, verifying, sets, get_pubkey):
+    preset = spec.preset
+    expected_deposits = min(
+        preset.max_deposits,
+        state.eth1_data.deposit_count - state.eth1_deposit_index,
+    )
+    assert len(body.deposits) == expected_deposits, "wrong deposit count"
+
+    for op in body.proposer_slashings:
+        phase0.process_proposer_slashing(
+            state, op, spec, verifying, sets, get_pubkey,
+            slashing_quotient=MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR,
+        )
+    for op in body.attester_slashings:
+        phase0.process_attester_slashing(
+            state, op, spec, verifying, sets, get_pubkey,
+            slashing_quotient=MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR,
+        )
+    for op in body.attestations:
+        process_attestation(state, op, spec, verifying, sets, get_pubkey)
+    for op in body.deposits:
+        process_deposit(state, op, spec)
+    for op in body.voluntary_exits:
+        phase0.process_voluntary_exit(state, op, spec, verifying, sets, get_pubkey)
+
+
+def process_attestation(state, attestation, spec, verifying, sets, get_pubkey):
+    """Altair process_attestation: flag updates + immediate proposer reward."""
+    preset = spec.preset
+    data = attestation.data
+    assert data.target.epoch in (
+        get_previous_epoch(state, preset),
+        get_current_epoch(state, preset),
+    ), "bad target epoch"
+    assert data.target.epoch == data.slot // preset.slots_per_epoch
+    assert (
+        data.slot + phase0.MIN_ATTESTATION_INCLUSION_DELAY
+        <= state.slot
+        <= data.slot + preset.slots_per_epoch
+    ), "inclusion window"
+    assert data.index < phase0.get_committee_count_per_slot(
+        state, data.target.epoch, preset
+    ), "bad committee index"
+    committee = phase0.get_beacon_committee(state, data.slot, data.index, preset)
+    assert len(attestation.aggregation_bits) == len(committee), "bits length"
+
+    inclusion_delay = state.slot - data.slot
+    flag_indices = get_attestation_participation_flag_indices(
+        state, data, inclusion_delay, preset
+    )
+
+    indexed = phase0.get_indexed_attestation(state, attestation, preset)
+    assert phase0.is_valid_indexed_attestation_structure(indexed)
+    if verifying:
+        sets.append(
+            sset.indexed_attestation_signature_set(
+                get_pubkey, indexed, state.fork, state.genesis_validators_root, spec
+            )
+        )
+
+    if data.target.epoch == get_current_epoch(state, preset):
+        epoch_participation = state.current_epoch_participation
+    else:
+        epoch_participation = state.previous_epoch_participation
+
+    total_balance = get_total_active_balance(state, preset)
+    brpi = get_base_reward_per_increment(state, preset, total_balance)
+    proposer_reward_numerator = 0
+    for index in indexed.attesting_indices:
+        flags = epoch_participation[index]
+        base = (
+            state.validators[index].effective_balance // EFFECTIVE_BALANCE_INCREMENT
+        ) * brpi
+        for flag_index, weight in PARTICIPATION_FLAG_WEIGHTS:
+            if flag_index in flag_indices and not has_flag(flags, flag_index):
+                flags = add_flag(flags, flag_index)
+                proposer_reward_numerator += base * weight
+        epoch_participation[index] = flags
+
+    proposer_reward_denominator = (
+        (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+        * WEIGHT_DENOMINATOR
+        // PROPOSER_WEIGHT
+    )
+    proposer_reward = proposer_reward_numerator // proposer_reward_denominator
+    increase_balance(
+        state, phase0.get_beacon_proposer_index(state, preset), proposer_reward
+    )
+
+
+def process_deposit(state, deposit, spec):
+    phase0.process_deposit(state, deposit, spec)
+    # altair: new validators also get participation/inactivity slots
+    while len(state.inactivity_scores) < len(state.validators):
+        state.inactivity_scores.append(0)
+    while len(state.previous_epoch_participation) < len(state.validators):
+        state.previous_epoch_participation.append(0)
+    while len(state.current_epoch_participation) < len(state.validators):
+        state.current_epoch_participation.append(0)
+
+
+def process_sync_aggregate(state, aggregate, spec, verifying, sets, get_pubkey):
+    """Spec process_sync_aggregate: signature over previous-slot block root
+    by the current sync committee; participant + proposer rewards."""
+    preset = spec.preset
+    previous_slot = max(int(state.slot), 1) - 1
+    if verifying:
+        participant_points = [
+            _decompress(pk)
+            for pk, bit in zip(
+                state.current_sync_committee.pubkeys,
+                aggregate.sync_committee_bits,
+            )
+            if bit
+        ]
+        s = sset.sync_aggregate_signature_set(
+            participant_points,
+            aggregate,
+            previous_slot,
+            get_block_root_at_slot(state, previous_slot, preset)
+            if state.slot > 0
+            else hash_tree_root(state.latest_block_header),
+            state.fork,
+            state.genesis_validators_root,
+            spec,
+        )
+        if s is not None:
+            sets.append(s)
+
+    total_balance = get_total_active_balance(state, preset)
+    brpi = get_base_reward_per_increment(state, preset, total_balance)
+    total_increments = total_balance // EFFECTIVE_BALANCE_INCREMENT
+    total_base_rewards = brpi * total_increments
+    max_participant_rewards = (
+        total_base_rewards * SYNC_REWARD_WEIGHT
+        // WEIGHT_DENOMINATOR
+        // preset.slots_per_epoch
+    )
+    participant_reward = max_participant_rewards // preset.sync_committee_size
+    proposer_reward = (
+        participant_reward * PROPOSER_WEIGHT // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+    )
+
+    committee_indices = sync_committee_validator_indices(state, preset)
+    proposer_index = phase0.get_beacon_proposer_index(state, preset)
+    bits = list(aggregate.sync_committee_bits)
+    for participant_index, bit in zip(committee_indices, bits):
+        if bit:
+            increase_balance(state, participant_index, participant_reward)
+            increase_balance(state, proposer_index, proposer_reward)
+        else:
+            decrease_balance(state, participant_index, participant_reward)
+
+
+def _decompress(pk_bytes):
+    from ..crypto.ref.curves import g1_decompress
+
+    try:
+        return g1_decompress(bytes(pk_bytes), subgroup_check=False)
+    except Exception:
+        return None
+
+
+# ------------------------------------------------------------------ upgrade
+
+
+def upgrade_to_altair(pre, spec):
+    """upgrade/altair.rs: carry fields over, translate pending attestations
+    into participation flags, seed sync committees."""
+    preset = spec.preset
+    T = state_types(preset)
+    epoch = get_current_epoch(pre, preset)
+
+    post = T.BeaconStateAltair(
+        genesis_time=pre.genesis_time,
+        genesis_validators_root=pre.genesis_validators_root,
+        slot=pre.slot,
+        fork=type(pre.fork)(
+            previous_version=pre.fork.current_version,
+            current_version=spec.altair_fork_version,
+            epoch=epoch,
+        ),
+        latest_block_header=pre.latest_block_header,
+        block_roots=list(pre.block_roots),
+        state_roots=list(pre.state_roots),
+        historical_roots=list(pre.historical_roots),
+        eth1_data=pre.eth1_data,
+        eth1_data_votes=list(pre.eth1_data_votes),
+        eth1_deposit_index=pre.eth1_deposit_index,
+        validators=pre.validators,
+        balances=pre.balances,
+        randao_mixes=list(pre.randao_mixes),
+        slashings=list(pre.slashings),
+        previous_epoch_participation=np.zeros(len(pre.validators), np.uint8),
+        current_epoch_participation=np.zeros(len(pre.validators), np.uint8),
+        justification_bits=list(pre.justification_bits),
+        previous_justified_checkpoint=pre.previous_justified_checkpoint,
+        current_justified_checkpoint=pre.current_justified_checkpoint,
+        finalized_checkpoint=pre.finalized_checkpoint,
+        inactivity_scores=np.zeros(len(pre.validators), np.uint64),
+    )
+
+    # translate previous-epoch pending attestations into flags
+    part = post.previous_epoch_participation.np.copy()
+    for att in pre.previous_epoch_attestations:
+        inclusion_delay = int(att.inclusion_delay)
+        try:
+            flag_indices = get_attestation_participation_flag_indices(
+                post, att.data, inclusion_delay, preset
+            )
+        except AssertionError:
+            continue
+        idx = phase0._att_indices_cached(pre, att, preset)
+        flags = np.uint8(sum(1 << f for f in flag_indices))
+        part[idx] |= flags
+    post.previous_epoch_participation.set_np(part)
+
+    # the spec's two get_next_sync_committee calls see identical inputs
+    # (same state, same epoch+1 seed) — compute once
+    committee = get_next_sync_committee(post, preset)
+    post.current_sync_committee = committee
+    post.next_sync_committee = committee
+    return post

@@ -68,7 +68,7 @@ class BlockReplayer:
             if self.pre_block_hook:
                 self.pre_block_hook(self.state, signed)
             if self.state.slot < slot:
-                process_slots(self.state, slot, self.spec.preset, spec=self.spec)
+                self.state = process_slots(self.state, slot, self.spec.preset, spec=self.spec)
             per_block_processing(
                 self.state,
                 signed,
@@ -89,7 +89,7 @@ class BlockReplayer:
             if not verify(collected):
                 raise BlockProcessingError("segment bulk signature verification failed")
         if target_slot is not None and self.state.slot < target_slot:
-            process_slots(self.state, target_slot, self.spec.preset, spec=self.spec)
+            self.state = process_slots(self.state, target_slot, self.spec.preset, spec=self.spec)
         return self.state
 
 
@@ -107,7 +107,7 @@ def signature_verify_chain_segment(state, blocks, spec, verify_fn=None):
     for signed in blocks:
         slot = signed.message.slot
         if replayer.state.slot < slot:
-            process_slots(replayer.state, slot, spec.preset, spec=spec)
+            replayer.state = process_slots(replayer.state, slot, spec.preset, spec=spec)
         per_block_processing(
             replayer.state,
             signed,

@@ -284,7 +284,10 @@ def initiate_validator_exit(state, index, preset, spec=None):
     v.withdrawable_epoch = exit_queue_epoch + delay
 
 
-def slash_validator(state, slashed_index, preset, whistleblower_index=None, spec=None):
+def slash_validator(
+    state, slashed_index, preset, whistleblower_index=None, spec=None,
+    slashing_quotient=MIN_SLASHING_PENALTY_QUOTIENT,
+):
     epoch = get_current_epoch(state, preset)
     initiate_validator_exit(state, slashed_index, preset, spec=spec)
     v = state.validators[slashed_index]
@@ -294,7 +297,7 @@ def slash_validator(state, slashed_index, preset, whistleblower_index=None, spec
     )
     state.slashings[epoch % preset.epochs_per_slashings_vector] += v.effective_balance
     decrease_balance(
-        state, slashed_index, v.effective_balance // MIN_SLASHING_PENALTY_QUOTIENT
+        state, slashed_index, v.effective_balance // slashing_quotient
     )
     proposer_index = get_beacon_proposer_index(state, preset)
     if whistleblower_index is None:
@@ -317,13 +320,40 @@ def decrease_balance(state, index, delta):
 
 
 def process_slots(state, slot, preset, spec=None):
-    """Spec process_slots / reference per_slot_processing."""
+    """Spec process_slots / reference per_slot_processing.
+
+    Returns the (possibly fork-upgraded) state: crossing a fork boundary
+    replaces the state container (upgrade/altair.rs), so callers must use
+    the return value.
+    """
     assert state.slot < slot
     while state.slot < slot:
         process_slot(state, preset)
-        if (state.slot + 1) % preset.slots_per_epoch == 0:
-            process_epoch(state, preset, spec=spec)
+        next_is_epoch_start = (state.slot + 1) % preset.slots_per_epoch == 0
+        if next_is_epoch_start:
+            process_epoch_for_fork(state, preset, spec=spec)
         state.slot += 1
+        if next_is_epoch_start and spec is not None:
+            epoch = state.slot // preset.slots_per_epoch
+            if (
+                spec.altair_fork_epoch is not None
+                and epoch == spec.altair_fork_epoch
+                and not hasattr(state, "previous_epoch_participation")
+            ):
+                from .altair import upgrade_to_altair
+
+                state = upgrade_to_altair(state, spec)
+    return state
+
+
+def process_epoch_for_fork(state, preset, spec=None):
+    """Fork-dispatching epoch transition (per_epoch_processing.rs:31)."""
+    if hasattr(state, "previous_epoch_participation"):
+        from . import altair
+
+        altair.process_epoch(state, preset, spec=spec)
+    else:
+        process_epoch(state, preset, spec=spec)
 
 
 def process_slot(state, preset):
@@ -390,6 +420,29 @@ def process_justification_and_finalization(state, preset):
         return
     previous_epoch = get_previous_epoch(state, preset)
     current_epoch = get_current_epoch(state, preset)
+    total_active = get_total_active_balance(state, preset)
+    prev_target = _unslashed_attesting_indices_np(
+        state, _matching_target_attestations(state, previous_epoch, preset), preset
+    )
+    cur_target = _unslashed_attesting_indices_np(
+        state, _matching_target_attestations(state, current_epoch, preset), preset
+    )
+    weigh_justification_and_finalization(
+        state,
+        preset,
+        total_active,
+        get_total_balance(state, prev_target),
+        get_total_balance(state, cur_target),
+    )
+
+
+def weigh_justification_and_finalization(
+    state, preset, total_active, previous_target_balance, current_target_balance
+):
+    """Fork-independent core (spec weigh_justification_and_finalization;
+    shared by phase0 and altair epoch processing)."""
+    previous_epoch = get_previous_epoch(state, preset)
+    current_epoch = get_current_epoch(state, preset)
     old_previous_justified = state.previous_justified_checkpoint
     old_current_justified = state.current_justified_checkpoint
 
@@ -397,19 +450,12 @@ def process_justification_and_finalization(state, preset):
     bits = list(state.justification_bits)
     bits = [0] + bits[: len(bits) - 1]
 
-    total_active = get_total_active_balance(state, preset)
-    prev_target = _unslashed_attesting_indices(
-        state, _matching_target_attestations(state, previous_epoch, preset), preset
-    )
-    if get_total_balance(state, prev_target) * 3 >= total_active * 2:
+    if previous_target_balance * 3 >= total_active * 2:
         state.current_justified_checkpoint = Checkpoint(
             epoch=previous_epoch, root=get_block_root(state, previous_epoch, preset)
         )
         bits[1] = 1
-    cur_target = _unslashed_attesting_indices(
-        state, _matching_target_attestations(state, current_epoch, preset), preset
-    )
-    if get_total_balance(state, cur_target) * 3 >= total_active * 2:
+    if current_target_balance * 3 >= total_active * 2:
         state.current_justified_checkpoint = Checkpoint(
             epoch=current_epoch, root=get_block_root(state, current_epoch, preset)
         )
@@ -603,10 +649,14 @@ def process_registry_updates(state, preset, spec=None):
 
 
 def process_slashings(state, preset):
+    process_slashings_with_multiplier(state, preset, PROPORTIONAL_SLASHING_MULTIPLIER)
+
+
+def process_slashings_with_multiplier(state, preset, multiplier):
     epoch = get_current_epoch(state, preset)
     total_balance = get_total_active_balance(state, preset)
     adjusted = min(
-        int(state.slashings.np.sum()) * PROPORTIONAL_SLASHING_MULTIPLIER,
+        int(state.slashings.np.sum()) * multiplier,
         total_balance,
     )
     reg = state.validators
@@ -626,6 +676,15 @@ def process_slashings(state, preset):
 
 
 def process_final_updates(state, preset):
+    process_final_updates_partial(state, preset)
+    # attestation rotation (phase0 only; altair rotates participation flags)
+    state.previous_epoch_attestations = state.current_epoch_attestations
+    state.current_epoch_attestations = []
+
+
+def process_final_updates_partial(state, preset):
+    """Final updates shared by phase0 and altair (everything except the
+    pending-attestation rotation)."""
     current_epoch = get_current_epoch(state, preset)
     next_epoch = current_epoch + 1
     # eth1 data votes reset
@@ -663,9 +722,6 @@ def process_final_updates(state, preset):
             block_roots=list(state.block_roots), state_roots=list(state.state_roots)
         )
         state.historical_roots.append(hash_tree_root(batch))
-    # attestation rotation
-    state.previous_epoch_attestations = state.current_epoch_attestations
-    state.current_epoch_attestations = []
 
 
 # ------------------------------------------------------------------ block
@@ -698,7 +754,20 @@ def per_block_processing(
     there instead of verified (the BlockSignatureVerifier accumulation
     path), letting callers batch many blocks into one device call
     (block_verification.rs:531 signature_verify_chain_segment).
+
+    Dispatches to the altair arm for altair states.
     """
+    if hasattr(state, "previous_epoch_participation"):
+        from . import altair
+
+        return altair.per_block_processing(
+            state,
+            signed_block,
+            spec,
+            signature_strategy=signature_strategy,
+            verify_fn=verify_fn,
+            collected_sets=collected_sets,
+        )
     preset = spec.preset
     block = signed_block.message
     verifying = signature_strategy != BlockSignatureStrategy.NO_VERIFICATION
@@ -841,7 +910,10 @@ def process_operations(state, body, spec, verifying, sets, get_pubkey):
         process_voluntary_exit(state, op, spec, verifying, sets, get_pubkey)
 
 
-def process_proposer_slashing(state, slashing, spec, verifying, sets, get_pubkey):
+def process_proposer_slashing(
+    state, slashing, spec, verifying, sets, get_pubkey,
+    slashing_quotient=MIN_SLASHING_PENALTY_QUOTIENT,
+):
     preset = spec.preset
     h1 = slashing.signed_header_1.message
     h2 = slashing.signed_header_2.message
@@ -856,10 +928,16 @@ def process_proposer_slashing(state, slashing, spec, verifying, sets, get_pubkey
                 get_pubkey, slashing, state.fork, state.genesis_validators_root, spec
             )
         )
-    slash_validator(state, h1.proposer_index, preset, spec=spec)
+    slash_validator(
+        state, h1.proposer_index, preset, spec=spec,
+        slashing_quotient=slashing_quotient,
+    )
 
 
-def process_attester_slashing(state, slashing, spec, verifying, sets, get_pubkey):
+def process_attester_slashing(
+    state, slashing, spec, verifying, sets, get_pubkey,
+    slashing_quotient=MIN_SLASHING_PENALTY_QUOTIENT,
+):
     preset = spec.preset
     a1, a2 = slashing.attestation_1, slashing.attestation_2
     assert is_slashable_attestation_data(a1.data, a2.data)
@@ -876,7 +954,9 @@ def process_attester_slashing(state, slashing, spec, verifying, sets, get_pubkey
     both = set(a1.attesting_indices) & set(a2.attesting_indices)
     for i in sorted(both):
         if is_slashable_validator(state.validators[i], epoch):
-            slash_validator(state, i, preset, spec=spec)
+            slash_validator(
+                state, i, preset, spec=spec, slashing_quotient=slashing_quotient
+            )
             slashed_any = True
     assert slashed_any, "no slashable validators"
 

@@ -8,7 +8,7 @@ builds on (the reference's extend_chain / add_attested_blocks_at_slots).
 """
 
 from ..crypto.ref import bls as RB
-from ..crypto.ref.curves import g2_compress
+from ..crypto.ref.curves import g1_compress, g2_compress
 from ..ssz import hash_tree_root
 from ..types import Domain, compute_epoch_at_slot, compute_signing_root
 from ..types.containers import AttestationData, Checkpoint
@@ -47,11 +47,12 @@ class Harness:
     # ------------------------------------------------------- block producer
 
     def produce_block(self, slot, attestations=()):
-        """Build a valid signed block at `slot` on the current state."""
+        """Build a valid signed block at `slot` on the current state
+        (phase0 or altair body depending on the state's fork)."""
         spec, preset = self.spec, self.preset
         state = self.state.copy()
         if state.slot < slot:
-            process_slots(state, slot, preset)
+            state = process_slots(state, slot, preset, spec=spec)
         proposer = get_beacon_proposer_index(state, preset)
         epoch = get_current_epoch(state, preset)
 
@@ -62,12 +63,20 @@ class Harness:
             proposer, sset.compute_signing_root_uint64(epoch, domain)
         )
 
-        body = self.T.BeaconBlockBody(
+        altair = hasattr(state, "previous_epoch_participation")
+        body_kwargs = dict(
             randao_reveal=randao_reveal,
             eth1_data=state.eth1_data,
             attestations=list(attestations),
         )
-        block = self.T.BeaconBlock(
+        if altair:
+            body_kwargs["sync_aggregate"] = self._sync_aggregate(state, slot)
+            body = self.T.BeaconBlockBodyAltair(**body_kwargs)
+            block_cls, signed_cls = self.T.BeaconBlockAltair, self.T.SignedBeaconBlockAltair
+        else:
+            body = self.T.BeaconBlockBody(**body_kwargs)
+            block_cls, signed_cls = self.T.BeaconBlock, self.T.SignedBeaconBlock
+        block = block_cls(
             slot=slot,
             proposer_index=proposer,
             parent_root=hash_tree_root(state.latest_block_header),
@@ -78,7 +87,7 @@ class Harness:
         tmp = state.copy()
         per_block_processing(
             tmp,
-            self.T.SignedBeaconBlock(message=block),
+            signed_cls(message=block),
             spec,
             signature_strategy=BlockSignatureStrategy.NO_VERIFICATION,
         )
@@ -88,7 +97,40 @@ class Harness:
             Domain.BEACON_PROPOSER, epoch, state.fork, state.genesis_validators_root
         )
         sig = self._sign_root(proposer, compute_signing_root(block, pd))
-        return self.T.SignedBeaconBlock(message=block, signature=sig)
+        return signed_cls(message=block, signature=sig)
+
+    def _sync_aggregate(self, state, slot):
+        """Full-participation SyncAggregate signed by the current sync
+        committee over the previous block root (spec process_sync_aggregate)."""
+        spec, preset = self.spec, self.preset
+        previous_slot = max(int(slot), 1) - 1
+        block_root = hash_tree_root(state.latest_block_header)
+        prev_epoch = previous_slot // preset.slots_per_epoch
+        domain = spec.get_domain(
+            Domain.SYNC_COMMITTEE, prev_epoch, state.fork,
+            state.genesis_validators_root,
+        )
+        root = sset.compute_signing_root_bytes32(block_root, domain)
+        pk_to_index = {
+            g1_compress(self.keypairs[i][1]): i for i in range(len(self.keypairs))
+        }
+        # committee members repeat on small validator sets (sampling with
+        # replacement); sign once per distinct validator and scale by
+        # multiplicity — aggregate([sig]*k) == [k]sig
+        from collections import Counter
+        from ..crypto.ref import curves as C
+
+        counts = Counter(
+            pk_to_index[bytes(pk)] for pk in state.current_sync_committee.pubkeys
+        )
+        agg = None
+        for vi, k in counts.items():
+            part = C.g2_mul(RB.sign(self._sk(vi), root), k)
+            agg = part if agg is None else C.g2_add(agg, part)
+        return self.T.SyncAggregate(
+            sync_committee_bits=[1] * preset.sync_committee_size,
+            sync_committee_signature=g2_compress(agg),
+        )
 
     # ----------------------------------------------------------- attesters
 
@@ -133,7 +175,7 @@ class Harness:
         """Advance self.state through the block (slots + block processing)."""
         slot = signed_block.message.slot
         if self.state.slot < slot:
-            process_slots(self.state, slot, self.preset)
+            self.state = process_slots(self.state, slot, self.preset, spec=self.spec)
         per_block_processing(
             self.state, signed_block, self.spec,
             signature_strategy=strategy, verify_fn=verify_fn,

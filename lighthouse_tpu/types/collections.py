@@ -28,7 +28,10 @@ class U64List:
     __slots__ = ("_a", "_n", "rev", "dirty")
 
     def __init__(self, values=()):
-        vals = np.asarray(list(values), dtype=np.uint64)
+        if isinstance(values, np.ndarray):
+            vals = values.astype(np.uint64)
+        else:
+            vals = np.asarray(list(values), dtype=np.uint64)
         self._n = len(vals)
         cap = max(16, 1 << max(self._n - 1, 1).bit_length())
         self._a = np.zeros(cap, dtype=np.uint64)
@@ -91,6 +94,9 @@ class U64List:
         new.dirty = set(self.dirty)
         return new
 
+    def ssz_serialize_fast(self):
+        return self.np.astype("<u8").tobytes()
+
     # -- vectorized access -------------------------------------------------
     @property
     def np(self):
@@ -100,6 +106,94 @@ class U64List:
     def set_np(self, arr):
         """Bulk overwrite from a uint64 array of the same length."""
         arr = np.asarray(arr, dtype=np.uint64)
+        assert len(arr) == self._n
+        changed = np.nonzero(arr != self._a[: self._n])[0]
+        if len(changed):
+            self._a[: self._n] = arr
+            self.rev += 1
+            self.dirty.update(int(i) for i in changed)
+
+
+class U8List:
+    """Growable uint8 list (altair participation flags)."""
+
+    __slots__ = ("_a", "_n", "rev", "dirty")
+
+    def __init__(self, values=()):
+        if isinstance(values, np.ndarray):
+            vals = values.astype(np.uint8)
+        else:
+            vals = np.asarray(list(values), dtype=np.uint8)
+        self._n = len(vals)
+        cap = max(16, 1 << max(self._n - 1, 1).bit_length())
+        self._a = np.zeros(cap, dtype=np.uint8)
+        self._a[: self._n] = vals
+        self.rev = 0
+        self.dirty = set()
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [int(v) for v in self._a[: self._n][i]]
+        if i < 0:
+            i += self._n
+        if not 0 <= i < self._n:
+            raise IndexError(i)
+        return int(self._a[i])
+
+    def __setitem__(self, i, v):
+        if i < 0:
+            i += self._n
+        if not 0 <= i < self._n:
+            raise IndexError(i)
+        self._a[i] = v
+        self.rev += 1
+        self.dirty.add(i)
+
+    def append(self, v):
+        if self._n == len(self._a):
+            self._a = np.concatenate([self._a, np.zeros(len(self._a), np.uint8)])
+        self._a[self._n] = v
+        self.dirty.add(self._n)
+        self._n += 1
+        self.rev += 1
+
+    def __iter__(self):
+        for i in range(self._n):
+            yield int(self._a[i])
+
+    def __eq__(self, other):
+        if isinstance(other, U8List):
+            return np.array_equal(self.np, other.np)
+        try:
+            return len(other) == self._n and all(
+                int(a) == int(b) for a, b in zip(self, other)
+            )
+        except TypeError:
+            return NotImplemented
+
+    def ssz_serialize_fast(self):
+        return self.np.tobytes()
+
+    def __repr__(self):
+        return f"U8List({list(self)!r})"
+
+    def __deepcopy__(self, memo):
+        new = U8List.__new__(U8List)
+        new._a = self._a.copy()
+        new._n = self._n
+        new.rev = self.rev
+        new.dirty = set(self.dirty)
+        return new
+
+    @property
+    def np(self):
+        return self._a[: self._n]
+
+    def set_np(self, arr):
+        arr = np.asarray(arr, dtype=np.uint8)
         assert len(arr) == self._n
         changed = np.nonzero(arr != self._a[: self._n])[0]
         if len(changed):
@@ -141,6 +235,9 @@ class U64Vector:
             )
         except TypeError:
             return NotImplemented
+
+    def ssz_serialize_fast(self):
+        return self.np.astype("<u8").tobytes()
 
     def __repr__(self):
         return f"U64Vector({list(self)!r})"
@@ -198,6 +295,9 @@ class RootVector:
             )
         except TypeError:
             return NotImplemented
+
+    def ssz_serialize_fast(self):
+        return self.np.tobytes()
 
     def __repr__(self):
         return f"RootVector(len={len(self._a)})"

@@ -14,6 +14,7 @@ from functools import lru_cache
 
 from .collections import (
     RootVector,
+    U8List,
     U64List,
     U64Vector,
     ValidatorRegistry,
@@ -30,6 +31,7 @@ from ..ssz import (
     Container,
     List,
     Vector,
+    uint8,
     uint64,
 )
 from .containers import (
@@ -96,6 +98,44 @@ class ValidatorList(List):
         return ValidatorRegistry()
 
 
+class U64ListSSZ(List):
+    """List[uint64] whose runtime value is the numpy-backed U64List."""
+
+    def __init__(self, limit):
+        super().__init__(uint64, limit)
+
+    def deserialize(self, data):
+        import numpy as _np
+
+        if len(data) % 8:
+            raise DecodeError("u64 list: length not a multiple of 8")
+        out = U64List(_np.frombuffer(bytes(data), dtype="<u8"))
+        if len(out) > self.limit:
+            raise DecodeError("u64 list over limit")
+        return out
+
+    def default(self):
+        return U64List()
+
+
+class U8ListSSZ(List):
+    """List[uint8] (participation flags) backed by U8List."""
+
+    def __init__(self, limit):
+        super().__init__(uint8, limit)
+
+    def deserialize(self, data):
+        import numpy as _np
+
+        out = U8List(_np.frombuffer(bytes(data), dtype=_np.uint8))
+        if len(out) > self.limit:
+            raise DecodeError("u8 list over limit")
+        return out
+
+    def default(self):
+        return U8List()
+
+
 # Field-value wrappers: assignment into a BeaconState converts plain lists
 # into the numpy-backed collections (idempotent for already-wrapped values).
 _STATE_FIELD_WRAPPERS = {
@@ -106,6 +146,8 @@ _STATE_FIELD_WRAPPERS = {
     "state_roots": lambda v: v if isinstance(v, RootVector) else RootVector(v),
     "randao_mixes": lambda v: v if isinstance(v, RootVector) else RootVector(v),
     "inactivity_scores": lambda v: v if isinstance(v, U64List) else U64List(v),
+    "previous_epoch_participation": lambda v: v if isinstance(v, U8List) else U8List(v),
+    "current_epoch_participation": lambda v: v if isinstance(v, U8List) else U8List(v),
 }
 
 
@@ -185,7 +227,7 @@ def state_types(preset):
             )),
             ("eth1_deposit_index", uint64),
             ("validators", ValidatorList(preset.validator_registry_limit)),
-            ("balances", List(uint64, preset.validator_registry_limit)),
+            ("balances", U64ListSSZ(preset.validator_registry_limit)),
             ("randao_mixes", Vector(Bytes32, preset.epochs_per_historical_vector)),
             ("slashings", Vector(uint64, preset.epochs_per_slashings_vector)),
             ("previous_epoch_attestations", List(
@@ -210,6 +252,103 @@ def state_types(preset):
                 value = w(value)
             object.__setattr__(self, name, value)
 
+    # ---------------------------------------------------------------- altair
+    # (/root/reference/consensus/types/src/{beacon_state,beacon_block}.rs
+    # Altair variants; preset-parameterized sync-committee bounds)
+
+    class SyncCommittee(Container):
+        fields = [
+            ("pubkeys", Vector(Bytes48, preset.sync_committee_size)),
+            ("aggregate_pubkey", Bytes48),
+        ]
+
+    class SyncAggregate(Container):
+        fields = [
+            ("sync_committee_bits", Bitvector(preset.sync_committee_size)),
+            ("sync_committee_signature", Bytes96),
+        ]
+
+    class SyncCommitteeContribution(Container):
+        fields = [
+            ("slot", uint64),
+            ("beacon_block_root", Bytes32),
+            ("subcommittee_index", uint64),
+            ("aggregation_bits", Bitvector(
+                preset.sync_committee_size // preset.sync_committee_subnet_count
+            )),
+            ("signature", Bytes96),
+        ]
+
+    class ContributionAndProof(Container):
+        fields = [
+            ("aggregator_index", uint64),
+            ("contribution", SyncCommitteeContribution),
+            ("selection_proof", Bytes96),
+        ]
+
+    class SignedContributionAndProof(Container):
+        fields = [
+            ("message", ContributionAndProof),
+            ("signature", Bytes96),
+        ]
+
+    class BeaconBlockBodyAltair(Container):
+        fields = BeaconBlockBody.fields + [("sync_aggregate", SyncAggregate)]
+
+    class BeaconBlockAltair(Container):
+        fields = [
+            ("slot", uint64),
+            ("proposer_index", uint64),
+            ("parent_root", Bytes32),
+            ("state_root", Bytes32),
+            ("body", BeaconBlockBodyAltair),
+        ]
+
+    class SignedBeaconBlockAltair(Container):
+        fields = [
+            ("message", BeaconBlockAltair),
+            ("signature", Bytes96),
+        ]
+
+    class BeaconStateAltair(Container):
+        fields = [
+            ("genesis_time", uint64),
+            ("genesis_validators_root", Bytes32),
+            ("slot", uint64),
+            ("fork", Fork),
+            ("latest_block_header", BeaconBlockHeader),
+            ("block_roots", Vector(Bytes32, preset.slots_per_historical_root)),
+            ("state_roots", Vector(Bytes32, preset.slots_per_historical_root)),
+            ("historical_roots", List(Bytes32, preset.historical_roots_limit)),
+            ("eth1_data", Eth1Data),
+            ("eth1_data_votes", List(
+                Eth1Data,
+                preset.slots_per_epoch * preset.epochs_per_eth1_voting_period,
+            )),
+            ("eth1_deposit_index", uint64),
+            ("validators", ValidatorList(preset.validator_registry_limit)),
+            ("balances", U64ListSSZ(preset.validator_registry_limit)),
+            ("randao_mixes", Vector(Bytes32, preset.epochs_per_historical_vector)),
+            ("slashings", Vector(uint64, preset.epochs_per_slashings_vector)),
+            ("previous_epoch_participation", U8ListSSZ(preset.validator_registry_limit)),
+            ("current_epoch_participation", U8ListSSZ(preset.validator_registry_limit)),
+            ("justification_bits", Bitvector(JUSTIFICATION_BITS_LENGTH)),
+            ("previous_justified_checkpoint", Checkpoint),
+            ("current_justified_checkpoint", Checkpoint),
+            ("finalized_checkpoint", Checkpoint),
+            ("inactivity_scores", U64ListSSZ(preset.validator_registry_limit)),
+            ("current_sync_committee", SyncCommittee),
+            ("next_sync_committee", SyncCommittee),
+        ]
+
+        _cached_tree_hash = True
+
+        def __setattr__(self, name, value):
+            w = _STATE_FIELD_WRAPPERS.get(name)
+            if w is not None:
+                value = w(value)
+            object.__setattr__(self, name, value)
+
     ns = type("StateTypes", (), {})
     ns.Attestation = Attestation
     ns.PendingAttestation = PendingAttestation
@@ -222,4 +361,13 @@ def state_types(preset):
     ns.Validator = Validator
     ns.Eth1Data = Eth1Data
     ns.Deposit = Deposit
+    ns.SyncCommittee = SyncCommittee
+    ns.SyncAggregate = SyncAggregate
+    ns.SyncCommitteeContribution = SyncCommitteeContribution
+    ns.ContributionAndProof = ContributionAndProof
+    ns.SignedContributionAndProof = SignedContributionAndProof
+    ns.BeaconBlockBodyAltair = BeaconBlockBodyAltair
+    ns.BeaconBlockAltair = BeaconBlockAltair
+    ns.SignedBeaconBlockAltair = SignedBeaconBlockAltair
+    ns.BeaconStateAltair = BeaconStateAltair
     return ns
