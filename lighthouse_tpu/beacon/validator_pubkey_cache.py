@@ -24,9 +24,10 @@ from ..crypto.tpu import curve as cv
 
 
 class ValidatorPubkeyCache:
-    def __init__(self, path=None):
+    def __init__(self, path=None, validate="device"):
         self._points = []          # affine int G1 points, index = validator index
         self._path = path
+        self._validate = validate  # "device" (batched kernel) | "host" (oracle)
         if path and os.path.exists(path):
             self._load()
 
@@ -47,8 +48,13 @@ class ValidatorPubkeyCache:
         if not compressed_keys:
             return
         pts = [g1_decompress(bytes(k), subgroup_check=False) for k in compressed_keys]
-        dev = cv.g1_from_ints(pts)
-        ok = np.asarray(tb._jit_validate_pk(dev))
+        if self._validate == "device":
+            dev = cv.g1_from_ints(pts)
+            ok = np.asarray(tb._jit_validate_pk(dev))
+        else:
+            from ..crypto.ref.curves import g1_in_subgroup
+
+            ok = np.array([p is not None and g1_in_subgroup(p) for p in pts])
         if not ok.all():
             bad = [i for i, v in enumerate(ok) if not v]
             raise ValueError(f"invalid pubkeys at batch offsets {bad}")

@@ -1,0 +1,65 @@
+"""BLS backend seam with device→host fallback.
+
+Mirror of the reference's compile-time backend selection in
+/root/reference/crypto/bls/src/lib.rs:29-49 (supranational | milagro |
+fake_crypto | ckb-vm behind `define_mod!`), recast as a runtime seam:
+
+  * "tpu"    — the JAX batched kernel (crypto/tpu/bls.py), the product
+  * "oracle" — the pure-python host reference (crypto/ref/bls.py), the
+               milagro-analogue differential oracle
+  * "fake"   — always-true (fake_crypto.rs:29-33), for STF-only tests
+
+A device failure degrades to the oracle instead of taking the node down
+(SURVEY.md §7 hard part 7: "TPU server crash must degrade to blst, or a
+node outage becomes consensus-critical"), counting the event in metrics.
+"""
+
+import logging
+
+from ..utils import metrics
+
+log = logging.getLogger("lighthouse_tpu.crypto")
+
+
+class SignatureVerifier:
+    def __init__(self, backend="tpu", fallback=True):
+        assert backend in ("tpu", "oracle", "fake")
+        self.backend = backend
+        self.fallback = fallback
+
+    def verify_signature_sets(self, sets) -> bool:
+        sets = list(sets)
+        if self.backend == "fake":
+            return True
+        metrics.SIGNATURE_SETS_VERIFIED.inc(len(sets))
+        if self.backend == "tpu":
+            try:
+                from .tpu import bls as tb
+
+                return tb.verify_signature_sets(sets)
+            except Exception as e:  # device/compile failure — degrade
+                if not self.fallback:
+                    raise
+                metrics.DEVICE_FALLBACKS.inc()
+                log.warning("TPU verify failed (%s); falling back to oracle", e)
+        from .ref import bls as RB
+
+        return RB.verify_signature_sets(sets)
+
+    def verify_signature_sets_per_set(self, sets) -> list:
+        sets = list(sets)
+        if self.backend == "fake":
+            return [True] * len(sets)
+        if self.backend == "tpu":
+            try:
+                from .tpu import bls as tb
+
+                return tb.verify_signature_sets_per_set(sets)
+            except Exception as e:
+                if not self.fallback:
+                    raise
+                metrics.DEVICE_FALLBACKS.inc()
+                log.warning("TPU per-set verify failed (%s); oracle fallback", e)
+        from .ref import bls as RB
+
+        return [RB.verify_signature_sets([s]) for s in sets]

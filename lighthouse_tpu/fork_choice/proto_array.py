@@ -90,9 +90,12 @@ class ProtoArrayForkChoice:
 
     def process_attestation(self, validator_index, block_root, target_epoch):
         """fork_choice.rs on_attestation -> VoteTracker next_* update
-        (latest-message-driven: newer target epoch wins)."""
+        (latest-message-driven: newer target epoch wins; the default/empty
+        tracker accepts any epoch, incl. genesis epoch 0 —
+        proto_array_fork_choice.rs `vote == default` case)."""
         vote = self.votes.setdefault(validator_index, VoteTracker())
-        if target_epoch > vote.next_epoch:
+        is_default = not vote.current_root and not vote.next_root
+        if target_epoch > vote.next_epoch or is_default:
             vote.next_root = block_root
             vote.next_epoch = target_epoch
 

@@ -353,59 +353,12 @@ def process_sync_committee_updates(state, preset):
 # ------------------------------------------------------------------ block
 
 
-def per_block_processing(
-    state,
-    signed_block,
-    spec,
-    signature_strategy=phase0.BlockSignatureStrategy.VERIFY_INDIVIDUAL,
-    verify_fn=None,
-    collected_sets=None,
-):
-    """Altair per_block_processing — same strategy seam as phase0."""
-    preset = spec.preset
-    block = signed_block.message
-    verifying = signature_strategy != phase0.BlockSignatureStrategy.NO_VERIFICATION
-    sets = []
-
-    get_pubkey = phase0._registry_pubkey_closure(state)
-
-    if verifying:
-        from ..types.containers import BeaconBlockHeader, SignedBeaconBlockHeader
-
-        header = BeaconBlockHeader(
-            slot=block.slot,
-            proposer_index=block.proposer_index,
-            parent_root=block.parent_root,
-            state_root=block.state_root,
-            body_root=hash_tree_root(block.body),
-        )
-        sets.append(
-            sset.block_proposal_signature_set(
-                get_pubkey,
-                SignedBeaconBlockHeader(message=header, signature=signed_block.signature),
-                state.fork,
-                state.genesis_validators_root,
-                spec,
-            )
-        )
-
-    phase0.process_block_header(state, block, preset)
-    phase0.process_randao(state, block.body, spec, verifying, sets, get_pubkey)
-    phase0.process_eth1_data(state, block.body, preset)
-    process_operations(state, block.body, spec, verifying, sets, get_pubkey)
+def process_sync_aggregate_step(state, body, spec, verifying, sets, get_pubkey):
+    """post-operations hook for the shared block-processing scaffold
+    (phase0._per_block_processing_core)."""
     process_sync_aggregate(
-        state, block.body.sync_aggregate, spec, verifying, sets, get_pubkey
+        state, body.sync_aggregate, spec, verifying, sets, get_pubkey
     )
-
-    if verifying:
-        if collected_sets is not None:
-            collected_sets.extend(sets)
-        else:
-            if verify_fn is None:
-                from ..crypto.ref.bls import verify_signature_sets as verify_fn
-            if not verify_fn(sets):
-                raise phase0.BlockProcessingError("bulk signature verification failed")
-    return state
 
 
 def process_operations(state, body, spec, verifying, sets, get_pubkey):
@@ -610,16 +563,14 @@ def upgrade_to_altair(pre, spec):
         inactivity_scores=np.zeros(len(pre.validators), np.uint64),
     )
 
-    # translate previous-epoch pending attestations into flags
+    # translate previous-epoch pending attestations into flags (spec
+    # translate_participation — asserts surface, nothing is dropped)
     part = post.previous_epoch_participation.np.copy()
     for att in pre.previous_epoch_attestations:
         inclusion_delay = int(att.inclusion_delay)
-        try:
-            flag_indices = get_attestation_participation_flag_indices(
-                post, att.data, inclusion_delay, preset
-            )
-        except AssertionError:
-            continue
+        flag_indices = get_attestation_participation_flag_indices(
+            post, att.data, inclusion_delay, preset
+        )
         idx = phase0._att_indices_cached(pre, att, preset)
         flags = np.uint8(sum(1 << f for f in flag_indices))
         part[idx] |= flags

@@ -80,4 +80,13 @@ def interop_genesis_state(keypairs, genesis_time, spec, eth1_block_hash=b"\x42" 
     )
     validators_type = dict(T.BeaconState.fields)["validators"]
     state.genesis_validators_root = hash_tree_root(validators_type, validators)
+    if spec.altair_fork_epoch == 0:
+        # genesis directly at the altair fork (the reference builds genesis
+        # for the scheduled fork of epoch 0)
+        from .altair import upgrade_to_altair
+
+        state = upgrade_to_altair(state, spec)
+        state.latest_block_header = BeaconBlockHeader(
+            body_root=hash_tree_root(T.BeaconBlockBodyAltair())
+        )
     return state

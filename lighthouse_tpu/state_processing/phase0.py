@@ -128,10 +128,20 @@ def get_total_balance(state, indices):
 
 
 def get_total_active_balance(state, preset):
-    return get_total_balance(
-        state,
-        get_active_validator_indices_np(state, get_current_epoch(state, preset)),
+    """Cached per (epoch, registry rev): recomputed only when the registry
+    mutates — altair block processing asks for this per attestation
+    (the reference keeps it in per-epoch caches)."""
+    reg = state.validators
+    epoch = get_current_epoch(state, preset)
+    key = (epoch, reg.rev, len(reg))
+    hit = getattr(state, "_total_active_balance", None)
+    if hit is not None and hit[0] == key:
+        return hit[1]
+    total = get_total_balance(
+        state, get_active_validator_indices_np(state, epoch)
     )
+    object.__setattr__(state, "_total_active_balance", (key, total))
+    return total
 
 
 def get_block_root_at_slot(state, slot, preset):
@@ -760,22 +770,34 @@ def per_block_processing(
     if hasattr(state, "previous_epoch_participation"):
         from . import altair
 
-        return altair.per_block_processing(
-            state,
-            signed_block,
-            spec,
-            signature_strategy=signature_strategy,
-            verify_fn=verify_fn,
-            collected_sets=collected_sets,
+        return _per_block_processing_core(
+            state, signed_block, spec, signature_strategy, verify_fn,
+            collected_sets,
+            ops_fn=altair.process_operations,
+            post_ops_fn=altair.process_sync_aggregate_step,
         )
+    return _per_block_processing_core(
+        state, signed_block, spec, signature_strategy, verify_fn,
+        collected_sets,
+        ops_fn=process_operations,
+        post_ops_fn=None,
+    )
+
+
+def _per_block_processing_core(
+    state, signed_block, spec, signature_strategy, verify_fn, collected_sets,
+    ops_fn, post_ops_fn,
+):
+    """Fork-independent block-processing scaffold: proposal-set collection,
+    header/randao/eth1, fork-specific operations (`ops_fn`), optional
+    post-operations step (`post_ops_fn` — altair sync aggregate), then the
+    verify/collect tail."""
     preset = spec.preset
     block = signed_block.message
     verifying = signature_strategy != BlockSignatureStrategy.NO_VERIFICATION
     sets = []
 
     get_pubkey = _registry_pubkey_closure(state)
-    fork = state.fork
-    gvr = state.genesis_validators_root
 
     if verifying:
         header = BeaconBlockHeader(
@@ -793,8 +815,8 @@ def per_block_processing(
                 SignedBeaconBlockHeader(
                     message=header, signature=signed_block.signature
                 ),
-                fork,
-                gvr,
+                state.fork,
+                state.genesis_validators_root,
                 spec,
             )
         )
@@ -802,7 +824,9 @@ def per_block_processing(
     process_block_header(state, block, preset)
     process_randao(state, block.body, spec, verifying, sets, get_pubkey)
     process_eth1_data(state, block.body, preset)
-    process_operations(state, block.body, spec, verifying, sets, get_pubkey)
+    ops_fn(state, block.body, spec, verifying, sets, get_pubkey)
+    if post_ops_fn is not None:
+        post_ops_fn(state, block.body, spec, verifying, sets, get_pubkey)
 
     if verifying:
         if collected_sets is not None:

@@ -22,19 +22,24 @@ FAR_FUTURE_EPOCH = 2**64 - 1
 _VALIDATOR_FIXED_SIZE = 121  # 48+32+8+1+8+8+8+8
 
 
-class U64List:
-    """Growable uint64 list (balances, inactivity_scores)."""
+class _TypedList:
+    """Growable numpy-backed list, dtype-parameterized (base for U64List /
+    U8List — one implementation of growth, dirty tracking, SSZ fast paths)."""
+
+    _dtype = None        # set by subclasses
+    _le_dtype = None     # little-endian dtype string for SSZ serialization
 
     __slots__ = ("_a", "_n", "rev", "dirty")
 
     def __init__(self, values=()):
+        dt = type(self)._dtype
         if isinstance(values, np.ndarray):
-            vals = values.astype(np.uint64)
+            vals = values.astype(dt)
         else:
-            vals = np.asarray(list(values), dtype=np.uint64)
+            vals = np.asarray(list(values), dtype=dt)
         self._n = len(vals)
         cap = max(16, 1 << max(self._n - 1, 1).bit_length())
-        self._a = np.zeros(cap, dtype=np.uint64)
+        self._a = np.zeros(cap, dtype=dt)
         self._a[: self._n] = vals
         self.rev = 0
         self.dirty = set()
@@ -63,7 +68,9 @@ class U64List:
 
     def append(self, v):
         if self._n == len(self._a):
-            self._a = np.concatenate([self._a, np.zeros(len(self._a), np.uint64)])
+            self._a = np.concatenate(
+                [self._a, np.zeros(len(self._a), type(self)._dtype)]
+            )
         self._a[self._n] = v
         self.dirty.add(self._n)
         self._n += 1
@@ -74,7 +81,7 @@ class U64List:
             yield int(self._a[i])
 
     def __eq__(self, other):
-        if isinstance(other, U64List):
+        if isinstance(other, type(self)):
             return np.array_equal(self.np, other.np)
         try:
             return len(other) == self._n and all(
@@ -84,10 +91,10 @@ class U64List:
             return NotImplemented
 
     def __repr__(self):
-        return f"U64List({list(self)!r})"
+        return f"{type(self).__name__}({list(self)!r})"
 
     def __deepcopy__(self, memo):
-        new = U64List.__new__(U64List)
+        new = type(self).__new__(type(self))
         new._a = self._a.copy()
         new._n = self._n
         new.rev = self.rev
@@ -95,7 +102,7 @@ class U64List:
         return new
 
     def ssz_serialize_fast(self):
-        return self.np.astype("<u8").tobytes()
+        return self.np.astype(type(self)._le_dtype).tobytes()
 
     # -- vectorized access -------------------------------------------------
     @property
@@ -104,8 +111,8 @@ class U64List:
         return self._a[: self._n]
 
     def set_np(self, arr):
-        """Bulk overwrite from a uint64 array of the same length."""
-        arr = np.asarray(arr, dtype=np.uint64)
+        """Bulk overwrite from a same-length array; dirty-marks changes."""
+        arr = np.asarray(arr, dtype=type(self)._dtype)
         assert len(arr) == self._n
         changed = np.nonzero(arr != self._a[: self._n])[0]
         if len(changed):
@@ -114,92 +121,18 @@ class U64List:
             self.dirty.update(int(i) for i in changed)
 
 
-class U8List:
+class U64List(_TypedList):
+    """Growable uint64 list (balances, inactivity_scores)."""
+
+    _dtype = np.uint64
+    _le_dtype = "<u8"
+
+
+class U8List(_TypedList):
     """Growable uint8 list (altair participation flags)."""
 
-    __slots__ = ("_a", "_n", "rev", "dirty")
-
-    def __init__(self, values=()):
-        if isinstance(values, np.ndarray):
-            vals = values.astype(np.uint8)
-        else:
-            vals = np.asarray(list(values), dtype=np.uint8)
-        self._n = len(vals)
-        cap = max(16, 1 << max(self._n - 1, 1).bit_length())
-        self._a = np.zeros(cap, dtype=np.uint8)
-        self._a[: self._n] = vals
-        self.rev = 0
-        self.dirty = set()
-
-    def __len__(self):
-        return self._n
-
-    def __getitem__(self, i):
-        if isinstance(i, slice):
-            return [int(v) for v in self._a[: self._n][i]]
-        if i < 0:
-            i += self._n
-        if not 0 <= i < self._n:
-            raise IndexError(i)
-        return int(self._a[i])
-
-    def __setitem__(self, i, v):
-        if i < 0:
-            i += self._n
-        if not 0 <= i < self._n:
-            raise IndexError(i)
-        self._a[i] = v
-        self.rev += 1
-        self.dirty.add(i)
-
-    def append(self, v):
-        if self._n == len(self._a):
-            self._a = np.concatenate([self._a, np.zeros(len(self._a), np.uint8)])
-        self._a[self._n] = v
-        self.dirty.add(self._n)
-        self._n += 1
-        self.rev += 1
-
-    def __iter__(self):
-        for i in range(self._n):
-            yield int(self._a[i])
-
-    def __eq__(self, other):
-        if isinstance(other, U8List):
-            return np.array_equal(self.np, other.np)
-        try:
-            return len(other) == self._n and all(
-                int(a) == int(b) for a, b in zip(self, other)
-            )
-        except TypeError:
-            return NotImplemented
-
-    def ssz_serialize_fast(self):
-        return self.np.tobytes()
-
-    def __repr__(self):
-        return f"U8List({list(self)!r})"
-
-    def __deepcopy__(self, memo):
-        new = U8List.__new__(U8List)
-        new._a = self._a.copy()
-        new._n = self._n
-        new.rev = self.rev
-        new.dirty = set(self.dirty)
-        return new
-
-    @property
-    def np(self):
-        return self._a[: self._n]
-
-    def set_np(self, arr):
-        arr = np.asarray(arr, dtype=np.uint8)
-        assert len(arr) == self._n
-        changed = np.nonzero(arr != self._a[: self._n])[0]
-        if len(changed):
-            self._a[: self._n] = arr
-            self.rev += 1
-            self.dirty.update(int(i) for i in changed)
+    _dtype = np.uint8
+    _le_dtype = "|u1"
 
 
 class U64Vector:

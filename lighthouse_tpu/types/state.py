@@ -98,42 +98,46 @@ class ValidatorList(List):
         return ValidatorRegistry()
 
 
-class U64ListSSZ(List):
-    """List[uint64] whose runtime value is the numpy-backed U64List."""
+class _TypedListSSZ(List):
+    """List[basic uint] whose runtime value is a numpy-backed _TypedList
+    subclass — vectorized (de)serialization."""
+
+    _value_cls = None
+    _elem = None
+    _elem_size = 1
 
     def __init__(self, limit):
-        super().__init__(uint64, limit)
+        super().__init__(type(self)._elem, limit)
 
     def deserialize(self, data):
         import numpy as _np
 
-        if len(data) % 8:
-            raise DecodeError("u64 list: length not a multiple of 8")
-        out = U64List(_np.frombuffer(bytes(data), dtype="<u8"))
+        cls = type(self)
+        if len(data) % cls._elem_size:
+            raise DecodeError(
+                f"typed list: length not a multiple of {cls._elem_size}"
+            )
+        out = cls._value_cls(
+            _np.frombuffer(bytes(data), dtype=cls._value_cls._le_dtype)
+        )
         if len(out) > self.limit:
-            raise DecodeError("u64 list over limit")
+            raise DecodeError("typed list over limit")
         return out
 
     def default(self):
-        return U64List()
+        return type(self)._value_cls()
 
 
-class U8ListSSZ(List):
-    """List[uint8] (participation flags) backed by U8List."""
+class U64ListSSZ(_TypedListSSZ):
+    _value_cls = U64List
+    _elem = uint64
+    _elem_size = 8
 
-    def __init__(self, limit):
-        super().__init__(uint8, limit)
 
-    def deserialize(self, data):
-        import numpy as _np
-
-        out = U8List(_np.frombuffer(bytes(data), dtype=_np.uint8))
-        if len(out) > self.limit:
-            raise DecodeError("u8 list over limit")
-        return out
-
-    def default(self):
-        return U8List()
+class U8ListSSZ(_TypedListSSZ):
+    _value_cls = U8List
+    _elem = uint8
+    _elem_size = 1
 
 
 # Field-value wrappers: assignment into a BeaconState converts plain lists

@@ -1,0 +1,1 @@
+"""Cross-cutting utilities (the reference's `common/` crates, SURVEY.md §2.8)."""

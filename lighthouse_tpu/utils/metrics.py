@@ -1,0 +1,153 @@
+"""Process-global metrics registry: counters, gauges, histograms.
+
+Mirror of /root/reference/common/lighthouse_metrics/src/lib.rs (lazy-static
+global prometheus registry, start_timer/stop guards) and the per-crate
+`metrics.rs` convention (e.g. beacon_chain/src/metrics.rs:37
+BLOCK_PROCESSING_TIMES, :248-260 ATTESTATION_PROCESSING_BATCH_* — the
+timers bracketing exactly the code the TPU kernel replaces).
+
+Text exposition follows the Prometheus format so the http_metrics endpoint
+can serve scrapes directly.
+"""
+
+import threading
+import time
+from bisect import bisect_right
+
+
+_REGISTRY = {}
+_LOCK = threading.Lock()
+
+DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class Counter:
+    def __init__(self, name, help=""):
+        self.name, self.help = name, help
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, by=1):
+        with self._lock:
+            self.value += by
+
+    def collect(self):
+        return [f"# TYPE {self.name} counter", f"{self.name} {self.value}"]
+
+
+class Gauge:
+    def __init__(self, name, help=""):
+        self.name, self.help = name, help
+        self.value = 0
+
+    def set(self, v):
+        self.value = v
+
+    def collect(self):
+        return [f"# TYPE {self.name} gauge", f"{self.name} {self.value}"]
+
+
+class Histogram:
+    def __init__(self, name, help="", buckets=DEFAULT_BUCKETS):
+        self.name, self.help = name, help
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v):
+        with self._lock:
+            self.counts[bisect_right(self.buckets, v)] += 1
+            self.sum += v
+            self.count += 1
+
+    def start_timer(self):
+        """Context manager observing elapsed seconds (metrics::start_timer)."""
+        return _Timer(self)
+
+    def collect(self):
+        out = [f"# TYPE {self.name} histogram"]
+        cum = 0
+        for b, c in zip(self.buckets, self.counts):
+            cum += c
+            out.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {self.count}')
+        out.append(f"{self.name}_sum {self.sum}")
+        out.append(f"{self.name}_count {self.count}")
+        return out
+
+
+class _Timer:
+    def __init__(self, hist):
+        self.hist = hist
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self.t0)
+        return False
+
+
+def _register(kind, name, help, **kw):
+    with _LOCK:
+        m = _REGISTRY.get(name)
+        if m is None:
+            m = kind(name, help, **kw)
+            _REGISTRY[name] = m
+        return m
+
+
+def counter(name, help=""):
+    return _register(Counter, name, help)
+
+
+def gauge(name, help=""):
+    return _register(Gauge, name, help)
+
+
+def histogram(name, help="", buckets=DEFAULT_BUCKETS):
+    return _register(Histogram, name, help, buckets=buckets)
+
+
+def gather() -> str:
+    """Prometheus text exposition of every registered metric."""
+    with _LOCK:
+        metrics = list(_REGISTRY.values())
+    lines = []
+    for m in metrics:
+        lines.extend(m.collect())
+    return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------------- well-known metrics
+# (names mirror beacon_chain/src/metrics.rs)
+
+BLOCK_PROCESSING_TIMES = histogram(
+    "beacon_block_processing_seconds", "Full block import latency"
+)
+BLOCK_SIGNATURE_VERIFY_TIMES = histogram(
+    "beacon_block_signature_verify_seconds", "Bulk signature verification"
+)
+ATTESTATION_BATCH_SETUP_TIMES = histogram(
+    "beacon_attestation_processing_batch_setup_seconds",
+    "Gossip attestation batch assembly (indexing, pubkey gather)",
+)
+ATTESTATION_BATCH_VERIFY_TIMES = histogram(
+    "beacon_attestation_processing_batch_verify_seconds",
+    "Gossip attestation batch device verification",
+)
+SIGNATURE_SETS_VERIFIED = counter(
+    "bls_signature_sets_verified_total", "Signature sets through the kernel"
+)
+DEVICE_FALLBACKS = counter(
+    "bls_device_fallback_total", "Kernel failures degraded to host oracle"
+)
+HEAD_RECOMPUTE_TIMES = histogram(
+    "beacon_fork_choice_find_head_seconds", "Fork-choice head recompute"
+)

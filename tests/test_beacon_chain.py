@@ -1,0 +1,186 @@
+"""End-to-end BeaconChain: gossip/signature verification pipelines, fork
+choice integration, attestation batches with poisoning fallback, store
+persistence and restart.
+
+Mirrors /root/reference/beacon_node/beacon_chain/src/block_verification.rs
+and attestation_verification/batch.rs semantics (VERDICT item 4).
+"""
+
+import os
+
+import pytest
+
+from lighthouse_tpu.beacon.chain import AttestationError, BeaconChain, BlockError
+from lighthouse_tpu.beacon.store import FileKV, HotColdStore, MemoryKV
+from lighthouse_tpu.crypto.backend import SignatureVerifier
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.testing.harness import Harness
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+
+SPEC = ChainSpec(preset=MinimalPreset)
+
+
+def _chain_and_harness(n=16, store=None, backend="oracle"):
+    h = Harness(n, SPEC)
+    chain = BeaconChain(
+        h.state.copy(), SPEC, store=store, verifier=SignatureVerifier(backend)
+    )
+    return chain, h
+
+
+def test_block_import_moves_head():
+    chain, h = _chain_and_harness()
+    for _ in range(3):
+        slot = h.state.slot + 1
+        block = h.produce_block(slot)
+        h.process_block(block, strategy="no_verification")
+        chain.on_tick(slot)
+        root = chain.process_block(block)
+        assert chain.head_root == root
+    assert chain.head_state.slot == 3
+
+
+def test_gossip_rejects_bad_proposer_signature():
+    chain, h = _chain_and_harness()
+    block = h.produce_block(1)
+    bad = type(block)(message=block.message, signature=b"\x11" * 96)
+    chain.on_tick(1)
+    with pytest.raises(BlockError):
+        chain.process_block(bad)
+
+
+def test_gossip_rejects_duplicate_proposal():
+    chain, h = _chain_and_harness()
+    block = h.produce_block(1)
+    chain.on_tick(1)
+    chain.process_block(block)
+    with pytest.raises(BlockError, match="duplicate"):
+        chain.verify_block_for_gossip(block)
+
+
+def test_future_block_rejected():
+    chain, h = _chain_and_harness()
+    block = h.produce_block(5)
+    chain.on_tick(1)
+    with pytest.raises(BlockError, match="future"):
+        chain.process_block(block)
+
+
+def test_attestation_batch_verify_and_poisoning_fallback():
+    chain, h = _chain_and_harness()
+    roots = []
+    att_groups = []
+    for _ in range(2):
+        slot = h.state.slot + 1
+        block = h.produce_block(slot)
+        h.process_block(block, strategy="no_verification")
+        chain.on_tick(slot)
+        roots.append(chain.process_block(block))
+        att_groups.append(h.attest_slot(h.state, slot, roots[-1]))
+
+    # distinct committees attest each slot; swap a signature across slots
+    # so the poisoned item is structurally valid but cryptographically wrong
+    poisoned = att_groups[0][0].copy()
+    poisoned.signature = att_groups[1][0].signature
+    batch = [poisoned] + list(att_groups[1])
+
+    chain.on_tick(int(h.state.slot) + 1)
+    results = chain.batch_verify_unaggregated_attestations(batch)
+    assert isinstance(results[0][2], AttestationError), "poisoned att must fail"
+    for att, indexed, err in results[1:]:
+        assert err is None and indexed is not None
+
+    # verified attestations move fork choice at the next slot
+    chain.on_tick(int(h.state.slot) + 2)
+    head = chain.recompute_head()
+    assert head == roots[-1]
+
+
+def test_duplicate_attestation_rejected():
+    chain, h = _chain_and_harness()
+    slot = h.state.slot + 1
+    block = h.produce_block(slot)
+    h.process_block(block, strategy="no_verification")
+    chain.on_tick(slot)
+    root = chain.process_block(block)
+    atts = h.attest_slot(h.state, slot, root)
+    chain.on_tick(slot + 1)
+    chain.batch_verify_unaggregated_attestations([atts[0]])
+    results = chain.batch_verify_unaggregated_attestations([atts[0]])
+    assert isinstance(results[0][2], AttestationError)
+
+
+def test_chain_segment_single_batch():
+    chain, h = _chain_and_harness()
+    blocks = []
+    for _ in range(4):
+        slot = h.state.slot + 1
+        block = h.produce_block(slot)
+        h.process_block(block, strategy="no_verification")
+        blocks.append(block)
+    chain.on_tick(4)
+    roots = chain.process_chain_segment(blocks)
+    assert len(roots) == 4
+    assert chain.head_root == roots[-1]
+
+
+def test_fake_backend_skips_crypto():
+    chain, h = _chain_and_harness(backend="fake")
+    slot = h.state.slot + 1
+    block = h.produce_block(slot)
+    chain.on_tick(slot)
+    root = chain.process_block(block)
+    assert chain.head_root == root
+
+
+def test_hot_cold_store_restart(tmp_path):
+    path = os.path.join(tmp_path, "chain.db")
+    kv = FileKV(path)
+    store = HotColdStore(kv, SPEC)
+    chain, h = _chain_and_harness(store=store)
+    roots = []
+    for _ in range(3):
+        slot = h.state.slot + 1
+        block = h.produce_block(slot)
+        h.process_block(block, strategy="no_verification")
+        chain.on_tick(slot)
+        roots.append(chain.process_block(block))
+    store.put_meta("head_root", chain.head_root.hex())
+    kv.flush()
+    store.close()
+
+    kv2 = FileKV(path)
+    store2 = HotColdStore(kv2, SPEC)
+    assert bytes.fromhex(store2.get_meta("head_root")) == roots[-1]
+    st = store2.get_state(roots[-1])
+    assert st is not None and st.slot == 3
+    blk = store2.get_block(roots[-1])
+    assert hash_tree_root(blk.message) == roots[-1]
+    store2.close()
+
+
+def test_hot_cold_migration_and_reconstruction(tmp_path):
+    kv = FileKV(os.path.join(tmp_path, "hc.db"))
+    store = HotColdStore(kv, SPEC, slots_per_restore_point=4)
+    chain, h = _chain_and_harness(store=store)
+    roots_by_slot = {0: chain.genesis_root}
+    for _ in range(8):
+        slot = h.state.slot + 1
+        block = h.produce_block(slot)
+        h.process_block(block, strategy="no_verification")
+        chain.on_tick(slot)
+        roots_by_slot[int(slot)] = chain.process_block(block)
+
+    # genesis is slot 0 (restore point); migrate everything up to slot 6
+    store.put_state(chain.genesis_root, chain.store.get_state(chain.genesis_root))
+    store.migrate(6, roots_by_slot)
+    assert store.split_slot == 6
+    # hot state below the split is gone
+    assert store.get_state(roots_by_slot[3]) is None
+    # reconstruction replays from the slot-4 restore point
+    st5 = store.state_at_slot(5)
+    assert st5 is not None and int(st5.slot) == 5
+    assert hash_tree_root(st5) == bytes(
+        store.get_block(roots_by_slot[5]).message.state_root
+    )
+    store.close()

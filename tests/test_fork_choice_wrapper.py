@@ -1,0 +1,176 @@
+"""ForkChoice spec-wrapper tests: validity gating, one-slot queuing,
+proposer boost, equivocation — plus declarative scenario vectors in the
+style of the reference's proto_array YAML test definitions
+(/root/reference/consensus/proto_array/src/fork_choice_test_definition/).
+"""
+
+import pytest
+
+from lighthouse_tpu.fork_choice.fork_choice import (
+    ForkChoice,
+    InvalidAttestation,
+    InvalidBlock,
+)
+from lighthouse_tpu.state_processing.genesis import (
+    interop_genesis_state,
+    interop_keypairs,
+)
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+from lighthouse_tpu.types.containers import AttestationData, Checkpoint
+from lighthouse_tpu.types.state import state_types
+
+SPEC = ChainSpec(preset=MinimalPreset)
+T = state_types(MinimalPreset)
+
+
+class _B:
+    """Minimal block stand-in for wrapper-level tests."""
+
+    def __init__(self, slot, parent_root, state_root=b"\x00" * 32):
+        self.slot = slot
+        self.parent_root = parent_root
+        self.state_root = state_root
+
+
+def _fc(n=8):
+    state = interop_genesis_state(interop_keypairs(n), 0, SPEC)
+    root = hash_tree_root(state.latest_block_header)
+    return ForkChoice.from_anchor(state, root, MinimalPreset), state, root
+
+
+def _indexed(slot, root, target_epoch, indices):
+    return T.IndexedAttestation(
+        attesting_indices=list(indices),
+        data=AttestationData(
+            slot=slot,
+            index=0,
+            beacon_block_root=root,
+            source=Checkpoint(),
+            target=Checkpoint(epoch=target_epoch, root=root),
+        ),
+        signature=b"\x00" * 96,
+    )
+
+
+def test_future_block_rejected():
+    fc, state, root = _fc()
+    with pytest.raises(InvalidBlock, match="future"):
+        fc.on_block(0, _B(5, root), b"\x01" * 32, state)
+
+
+def test_unknown_parent_rejected():
+    fc, state, root = _fc()
+    with pytest.raises(InvalidBlock, match="parent"):
+        fc.on_block(1, _B(1, b"\x99" * 32), b"\x01" * 32, state)
+
+
+def test_attestation_for_current_slot_is_queued_until_next():
+    fc, state, root = _fc()
+    state2 = state.copy()
+    state2.slot = 1
+    fc.on_tick(1)
+    fc.on_block(1, _B(1, root), b"\x01" * 32, state2)
+    att = _indexed(1, b"\x01" * 32, 0, [0, 1])
+    fc.on_attestation(1, att)          # slot == current -> queued
+    assert len(fc.queued_attestations) == 1
+    assert not fc.proto.votes           # not applied yet
+    fc.on_tick(2)                       # drains the queue
+    assert not fc.queued_attestations
+    assert 0 in fc.proto.votes and 1 in fc.proto.votes
+
+
+def test_attestation_unknown_block_rejected():
+    fc, state, root = _fc()
+    fc.on_tick(2)
+    att = _indexed(1, b"\x55" * 32, 0, [0])
+    with pytest.raises(InvalidAttestation, match="unknown"):
+        fc.on_attestation(2, att)
+
+
+def test_proposer_boost_applies_and_expires():
+    fc, state, root = _fc()
+    s2 = state.copy(); s2.slot = 1
+    s3 = state.copy(); s3.slot = 1
+    fc.on_tick(1)
+    # two competing blocks at slot 1; the second arrives in-slot and
+    # cannot steal the boost already granted to the first
+    fc.on_block(1, _B(1, root), b"\x01" * 32, s2)
+    assert fc.store.proposer_boost_root == b"\x01" * 32
+    fc.on_block(1, _B(1, root), b"\x02" * 32, s3)
+    assert fc.store.proposer_boost_root == b"\x01" * 32
+    head = fc.get_head()
+    assert head == b"\x01" * 32          # boost breaks the tie
+    fc.on_tick(2)                        # boost expires on the next slot
+    assert fc.store.proposer_boost_root is None
+
+
+def test_equivocating_validator_loses_weight():
+    fc, state, root = _fc()
+    s2 = state.copy(); s2.slot = 1
+    s3 = state.copy(); s3.slot = 1
+    fc.on_tick(1)
+    fc.on_block(1, _B(1, root), b"\x01" * 32, s2)
+    fc.store.proposer_boost_root = None
+    fc.on_block(1, _B(1, root), b"\x02" * 32, s3)
+    fc.on_tick(2)
+    # validators 0-2 vote for block 1; validators 3-4 for block 2
+    fc.on_attestation(2, _indexed(1, b"\x01" * 32, 0, [0, 1, 2]), is_from_block=True)
+    fc.on_attestation(2, _indexed(1, b"\x02" * 32, 0, [3, 4]), is_from_block=True)
+    assert fc.get_head() == b"\x01" * 32
+    # validators 0 and 1 equivocate -> their weight vanishes; 2 < 2 ties,
+    # and root tie-break picks the higher root (0x02 > 0x01)
+    slashing = T.IndexedAttestation(attesting_indices=[0, 1], data=AttestationData(), signature=b"\x00"*96)
+
+    class _Slashing:
+        attestation_1 = slashing
+        attestation_2 = slashing
+
+    fc.on_attester_slashing(_Slashing())
+    assert fc.get_head() == b"\x02" * 32
+
+
+# ------------------------------------------------------- scenario vectors
+
+SCENARIOS = [
+    {
+        "name": "simple_chain_head_is_tip",
+        "blocks": [
+            ("a", "genesis", 1), ("b", "a", 2), ("c", "b", 3),
+        ],
+        "votes": [],
+        "head": "c",
+    },
+    {
+        "name": "heavier_fork_wins",
+        "blocks": [
+            ("a", "genesis", 1), ("b", "genesis", 1), ("c", "a", 2),
+        ],
+        "votes": [(0, "b", 1), (1, "b", 1), (2, "c", 1)],
+        "head": "b",
+    },
+    {
+        "name": "vote_moves_head",
+        "blocks": [("a", "genesis", 1), ("b", "genesis", 1)],
+        "votes": [(0, "a", 1), (1, "a", 1), (2, "b", 1), (3, "b", 1), (4, "b", 1)],
+        "head": "b",
+    },
+]
+
+
+@pytest.mark.parametrize("scenario", SCENARIOS, ids=lambda s: s["name"])
+def test_fork_choice_scenarios(scenario):
+    fc, state, genesis_root = _fc(n=8)
+    roots = {"genesis": genesis_root}
+    for name, parent, slot in scenario["blocks"]:
+        roots[name] = name.encode().ljust(32, b"\x00")
+        s = state.copy()
+        s.slot = slot
+        fc.on_tick(slot)
+        fc.on_block(slot, _B(slot, roots[parent]), roots[name], s)
+    fc.store.proposer_boost_root = None
+    max_slot = max(s for _, _, s in scenario["blocks"])
+    for validator, block, epoch in scenario["votes"]:
+        fc.proto.process_attestation(validator, roots[block], epoch)
+    fc.on_tick(max_slot + 1)
+    assert fc.get_head() == roots[scenario["head"]], scenario["name"]
