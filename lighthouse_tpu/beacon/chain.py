@@ -262,18 +262,7 @@ class BeaconChain:
 
         self.store.put_block(sig_verified.block_root, sig_verified.signed_block)
         self.store.put_state(sig_verified.block_root, post_state)
-
-        # new validators from deposits enter the pubkey cache
-        if len(post_state.validators) > len(self.pubkey_cache):
-            self.pubkey_cache.import_new_pubkeys(
-                [
-                    post_state.validators[i].pubkey
-                    for i in range(
-                        len(self.pubkey_cache), len(post_state.validators)
-                    )
-                ]
-            )
-
+        self._import_new_pubkeys(post_state)
         self.recompute_head()
         self.op_pool.prune(post_state, self.preset)
         return sig_verified.block_root
@@ -318,9 +307,23 @@ class BeaconChain:
             )
             self.store.put_block(block_root, sb)
             self.store.put_state(block_root, post_state)
+            self._import_new_pubkeys(post_state)
             roots.append(block_root)
         self.recompute_head()
         return roots
+
+    def _import_new_pubkeys(self, post_state):
+        """Deposit-created validators enter the pubkey cache (both the
+        gossip-import and segment-import paths)."""
+        if len(post_state.validators) > len(self.pubkey_cache):
+            self.pubkey_cache.import_new_pubkeys(
+                [
+                    post_state.validators[i].pubkey
+                    for i in range(
+                        len(self.pubkey_cache), len(post_state.validators)
+                    )
+                ]
+            )
 
     # ------------------------------------------- gossip attestation batch
 
@@ -457,13 +460,26 @@ class BeaconChain:
             )
             body = T.BeaconBlockBodyAltair(**body_kwargs)
             block_cls = T.BeaconBlockAltair
+            signed_cls = T.SignedBeaconBlockAltair
         else:
             body = T.BeaconBlockBody(**body_kwargs)
             block_cls = T.BeaconBlock
-        return block_cls(
+            signed_cls = T.SignedBeaconBlock
+        block = block_cls(
             slot=slot,
             proposer_index=proposer,
             parent_root=hash_tree_root(state.latest_block_header),
             state_root=bytes(32),
             body=body,
-        ), state
+        )
+        # fill in the post-state root (the reference runs the STF with
+        # NoVerification during production, beacon_chain.rs:4204)
+        tmp = state.copy()
+        phase0.per_block_processing(
+            tmp,
+            signed_cls(message=block),
+            self.spec,
+            signature_strategy=BlockSignatureStrategy.NO_VERIFICATION,
+        )
+        block.state_root = hash_tree_root(tmp)
+        return block, state

@@ -87,8 +87,11 @@ class ForkChoice:
 
     def on_tick(self, slot):
         """fork_choice.rs on_tick: advance time, reset proposer boost at
-        slot boundaries, drain the one-slot attestation queue."""
-        if slot < self.store.current_slot:
+        slot boundaries, drain the one-slot attestation queue.
+
+        Same-slot ticks are no-ops: the boost granted by on_block must
+        survive every head computation within its own slot."""
+        if slot <= self.store.current_slot:
             return
         self.store.current_slot = slot
         # boost only lives for the slot it was granted in
@@ -181,19 +184,8 @@ class ForkChoice:
                 raise InvalidAttestation("future target epoch")
             if target_epoch + 1 < current_epoch:
                 raise InvalidAttestation("target epoch too old")
-            if int(data.slot) >= self.store.current_slot:
-                # attestations influence fork choice from the NEXT slot
-                self.queued_attestations.append(
-                    QueuedAttestation(
-                        slot=int(data.slot),
-                        attesting_indices=list(
-                            indexed_attestation.attesting_indices
-                        ),
-                        block_root=block_root,
-                        target_epoch=target_epoch,
-                    )
-                )
-                return
+        # structural checks run BEFORE queuing: a spec-invalid attestation
+        # must not become a vote just because it arrived in its own slot
         if not self.proto.contains_block(block_root):
             raise InvalidAttestation("unknown beacon block root")
         head_slot = self.proto.nodes[self.proto.indices[block_root]].slot
@@ -201,6 +193,18 @@ class ForkChoice:
             raise InvalidAttestation("attestation for a block newer than its slot")
         if int(data.target.epoch) != int(data.slot) // self.preset.slots_per_epoch:
             raise InvalidAttestation("target epoch does not match slot")
+
+        if not is_from_block and int(data.slot) >= self.store.current_slot:
+            # attestations influence fork choice from the NEXT slot
+            self.queued_attestations.append(
+                QueuedAttestation(
+                    slot=int(data.slot),
+                    attesting_indices=list(indexed_attestation.attesting_indices),
+                    block_root=block_root,
+                    target_epoch=target_epoch,
+                )
+            )
+            return
 
         for v in indexed_attestation.attesting_indices:
             if int(v) not in self.store.equivocating_indices:

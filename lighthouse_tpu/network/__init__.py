@@ -1,0 +1,10 @@
+"""Networking layer (SURVEY.md §2.5 lighthouse_network + network crates).
+
+The reference's libp2p stack (gossipsub + discv5 + req/resp RPC) is
+host-side CPU networking and stays architecturally identical in a TPU
+deployment (SURVEY.md §5.8: "stays on host CPU unchanged").  This package
+provides the same seams — topics, router, peer scoring, req/resp — over
+an in-process bus so multi-node behavior (gossip fan-out, sync, liveness/
+finality) is testable in one process, the way the reference's
+testing/simulator boots N nodes in-process (simulator/src/main.rs:19-24).
+"""

@@ -1,0 +1,125 @@
+"""In-process gossip bus with topics, peer scoring, and req/resp RPC.
+
+Mirror of the seams in /root/reference/beacon_node/lighthouse_network:
+  * `GossipKind` topic enum (types/topics.rs:80) — beacon_block,
+    beacon_aggregate_and_proof, beacon_attestation_{subnet},
+    sync_committee_{subnet}, voluntary_exit, proposer/attester_slashing
+  * gossipsub publish/subscribe fan-out (service/behaviour.rs) — here a
+    synchronous in-memory fan-out with per-peer delivery queues
+  * peer scoring (peer_manager/peerdb/score.rs) — misbehavior decrements,
+    ban threshold
+  * req/resp (rpc/) — BlocksByRange / BlocksByRoot served from a peer's
+    store, the sync path's data source
+"""
+
+from collections import defaultdict, deque
+
+
+class GossipKind:
+    BEACON_BLOCK = "beacon_block"
+    AGGREGATE_AND_PROOF = "beacon_aggregate_and_proof"
+    ATTESTATION = "beacon_attestation"        # + _{subnet}
+    SYNC_COMMITTEE = "sync_committee"          # + _{subnet}
+    VOLUNTARY_EXIT = "voluntary_exit"
+    PROPOSER_SLASHING = "proposer_slashing"
+    ATTESTER_SLASHING = "attester_slashing"
+
+    @staticmethod
+    def attestation_subnet(subnet_id):
+        return f"{GossipKind.ATTESTATION}_{subnet_id}"
+
+
+BAN_THRESHOLD = -100.0
+
+
+class PeerScore:
+    """peerdb/score.rs: additive score with a ban threshold."""
+
+    def __init__(self):
+        self.score = 0.0
+
+    def apply(self, delta):
+        self.score = max(min(self.score + delta, 100.0), -200.0)
+
+    @property
+    def banned(self):
+        return self.score <= BAN_THRESHOLD
+
+
+class GossipBus:
+    """The shared medium: every node registers a handler per topic."""
+
+    def __init__(self):
+        self.subscribers = defaultdict(list)   # topic -> [(peer_id, fn)]
+        self.peers = {}                        # peer_id -> PeerScore
+        self.delivered = 0
+
+    def add_peer(self, peer_id):
+        self.peers.setdefault(peer_id, PeerScore())
+
+    def subscribe(self, peer_id, topic, handler):
+        self.add_peer(peer_id)
+        self.subscribers[topic].append((peer_id, handler))
+
+    def publish(self, from_peer, topic, message):
+        """Fan out to every subscriber except the sender; a handler
+        returning False scores the SENDER down (invalid gossip)."""
+        self.delivered += 1
+        for peer_id, handler in list(self.subscribers[topic]):
+            if peer_id == from_peer:
+                continue
+            if self.peers.get(from_peer) and self.peers[from_peer].banned:
+                continue
+            ok = handler(from_peer, message)
+            if ok is False:
+                self.report(from_peer, -10.0)
+
+    def report(self, peer_id, delta):
+        score = self.peers.get(peer_id)
+        if score is not None:
+            score.apply(delta)
+
+    def banned(self, peer_id):
+        s = self.peers.get(peer_id)
+        return s is not None and s.banned
+
+
+class ReqResp:
+    """BlocksByRange/BlocksByRoot over peers' stores (rpc/protocol.rs)."""
+
+    def __init__(self):
+        self.servers = {}      # peer_id -> (chain provider)
+
+    def register(self, peer_id, chain):
+        self.servers[peer_id] = chain
+
+    def blocks_by_root(self, from_peer, to_peer, roots):
+        chain = self.servers.get(to_peer)
+        if chain is None:
+            return []
+        out = []
+        for r in roots:
+            b = chain.store.get_block(bytes(r))
+            if b is not None:
+                out.append(b)
+        return out
+
+    def blocks_by_range(self, from_peer, to_peer, start_slot, count):
+        """Canonical blocks in [start_slot, start_slot+count) walked back
+        from the serving peer's head."""
+        chain = self.servers.get(to_peer)
+        if chain is None:
+            return []
+        blocks = {}
+        root = chain.head_root
+        while root is not None:
+            b = chain.store.get_block(bytes(root))
+            if b is None:
+                break
+            slot = int(b.message.slot)
+            if slot < start_slot:
+                break
+            if slot < start_slot + count:
+                blocks[slot] = b
+            root = bytes(b.message.parent_root)
+        return [blocks[s] for s in sorted(blocks)]

@@ -1,0 +1,69 @@
+"""Router: gossip events -> BeaconProcessor queues; range sync.
+
+Mirror of /root/reference/beacon_node/network/src/router.rs:234
+(handle_gossip -> WorkEvent) and sync/manager.rs (RangeSync in epoch
+batches, BlockLookups parent lookups).
+"""
+
+import logging
+
+from .gossip import GossipKind
+
+log = logging.getLogger("lighthouse_tpu.router")
+
+
+class Router:
+    def __init__(self, peer_id, chain, processor, bus, reqresp):
+        self.peer_id = peer_id
+        self.chain = chain
+        self.processor = processor
+        self.bus = bus
+        self.reqresp = reqresp
+        bus.subscribe(peer_id, GossipKind.BEACON_BLOCK, self._on_block)
+        bus.subscribe(peer_id, GossipKind.ATTESTATION, self._on_attestation)
+        reqresp.register(peer_id, chain)
+
+    # ------------------------------------------------------- gossip in
+
+    def _on_block(self, from_peer, signed_block):
+        # a full local queue is OUR backpressure, not sender misbehavior —
+        # never return False (the invalid-gossip score signal) for it
+        self.processor.enqueue_block(signed_block)
+
+    def _on_attestation(self, from_peer, attestation):
+        self.processor.enqueue_attestation(attestation)
+
+    # ------------------------------------------------------ gossip out
+
+    def publish_block(self, signed_block):
+        self.bus.publish(self.peer_id, GossipKind.BEACON_BLOCK, signed_block)
+
+    def publish_attestations(self, attestations):
+        for att in attestations:
+            self.bus.publish(self.peer_id, GossipKind.ATTESTATION, att)
+
+    # ------------------------------------------------------- range sync
+
+    def range_sync_from(self, peer_id, batch_epochs=2):
+        """sync/range_sync: pull canonical blocks forward in epoch batches
+        and import each batch as one chain segment (one signature batch —
+        the biggest batches in the client, block_verification.rs:531)."""
+        preset = self.chain.preset
+        batch_slots = batch_epochs * preset.slots_per_epoch
+        imported = 0
+        synced_to = int(self.chain.head_state.slot)
+        while True:
+            start = synced_to + 1
+            blocks = self.reqresp.blocks_by_range(
+                self.peer_id, peer_id, start, batch_slots
+            )
+            blocks = [b for b in blocks if int(b.message.slot) >= start]
+            if not blocks:
+                return imported
+            self.chain.on_tick(int(blocks[-1].message.slot))
+            self.chain.process_chain_segment(blocks)
+            imported += len(blocks)
+            # progress by REQUESTED range, not by head movement: the peer's
+            # fork may be lighter than ours and never become head — the
+            # cursor must still advance or sync would loop forever
+            synced_to = int(blocks[-1].message.slot)

@@ -1,0 +1,124 @@
+"""Multi-node in-process simulator.
+
+Mirror of /root/reference/testing/simulator (simulator/src/main.rs:19-24)
+and node_test_rig: N full nodes — each a BeaconChain + BeaconProcessor +
+Router on a shared gossip bus — plus validator clients holding disjoint
+key shares, driven by a shared manual slot clock.  Checks (checks.rs):
+liveness (every slot has a block) and finality advancement.
+"""
+
+from ..beacon.beacon_processor import BeaconProcessor
+from ..beacon.chain import BeaconChain
+from ..crypto.backend import SignatureVerifier
+from ..network.gossip import GossipBus, ReqResp
+from ..network.router import Router
+from ..state_processing.genesis import interop_genesis_state, interop_keypairs
+from ..types.state import state_types
+from ..utils.slot_clock import ManualSlotClock
+from ..validator_client.client import DirectBeaconNode, ValidatorClient
+from ..validator_client.validator_store import ValidatorStore
+
+
+class GossipingBeaconNode(DirectBeaconNode):
+    """DirectBeaconNode that also fans everything the VC publishes out to
+    the gossip bus — the BN's publish endpoints do exactly this
+    (http_api publish_blocks.rs -> network broadcast)."""
+
+    def __init__(self, chain, router):
+        super().__init__(chain)
+        self.router = router
+
+    def publish_block(self, signed_block):
+        root = super().publish_block(signed_block)
+        self.router.publish_block(signed_block)
+        return root
+
+    def publish_attestations(self, attestations):
+        out = super().publish_attestations(attestations)
+        self.router.publish_attestations(attestations)
+        return out
+
+
+class SimNode:
+    def __init__(self, node_id, genesis_state, spec, bus, reqresp, backend):
+        self.node_id = node_id
+        self.chain = BeaconChain(
+            genesis_state.copy(), spec, verifier=SignatureVerifier(backend)
+        )
+        self.processor = BeaconProcessor(self.chain)
+        self.router = Router(node_id, self.chain, self.processor, bus, reqresp)
+
+
+class Simulator:
+    def __init__(self, n_nodes, n_validators, spec, backend="fake"):
+        self.spec = spec
+        self.preset = spec.preset
+        self.keypairs = interop_keypairs(n_validators)
+        self.genesis_state = interop_genesis_state(self.keypairs, 0, spec)
+        self.clock = ManualSlotClock(
+            genesis_time=0, seconds_per_slot=spec.seconds_per_slot
+        )
+        self.bus = GossipBus()
+        self.reqresp = ReqResp()
+        self.nodes = [
+            SimNode(f"node{i}", self.genesis_state, spec, self.bus, self.reqresp,
+                    backend)
+            for i in range(n_nodes)
+        ]
+        # validators split across nodes (simulator assigns key shares)
+        self.vcs = []
+        share = max(1, n_validators // n_nodes)
+        for i, node in enumerate(self.nodes):
+            store = ValidatorStore(spec)
+            for sk, _pk in self.keypairs[i * share : (i + 1) * share]:
+                store.add_validator(sk)
+            self.vcs.append(
+                ValidatorClient(
+                    store, GossipingBeaconNode(node.chain, node.router), spec
+                )
+            )
+
+    # ------------------------------------------------------------ drive
+
+    def step_slot(self):
+        """One slot: tick every node, run VC duties (which publish through
+        their own node), gossip to the others, drain processors."""
+        self.clock.advance_slot()
+        slot = self.clock.now()
+        for node in self.nodes:
+            node.chain.on_tick(slot)
+        for vc in self.vcs:
+            # the GossipingBeaconNode fans every publish out to the bus
+            vc.act_on_slot(slot)
+        # drain each node's processor (blocks first, one attestation batch)
+        for node in self.nodes:
+            node.processor.process_pending()
+        return slot
+
+    def run_epochs(self, n_epochs):
+        for _ in range(n_epochs * self.preset.slots_per_epoch):
+            self.step_slot()
+
+    # ------------------------------------------------------------ checks
+
+    def check_liveness(self):
+        """checks.rs verify_full_slot_production: heads advance with the
+        clock on every node."""
+        slot = self.clock.now()
+        for node in self.nodes:
+            head_slot = int(node.chain.head_state.slot)
+            assert head_slot >= slot - 1, (
+                f"{node.node_id} head {head_slot} lags clock {slot}"
+            )
+
+    def check_consensus(self):
+        """All nodes agree on the head root."""
+        heads = {node.chain.head_root for node in self.nodes}
+        assert len(heads) == 1, f"nodes diverged: {heads}"
+
+    def check_finality(self, min_epoch):
+        for node in self.nodes:
+            fin = node.chain.head_state.finalized_checkpoint.epoch
+            assert fin >= min_epoch, (
+                f"{node.node_id} finalized {fin} < {min_epoch}"
+            )

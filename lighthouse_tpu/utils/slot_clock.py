@@ -1,0 +1,72 @@
+"""Slot clocks: system wall-clock and manual (testing) variants.
+
+Mirror of /root/reference/common/slot_clock (671 LoC): `SystemTimeSlotClock`
+drives production services off genesis time + seconds-per-slot;
+`ManualSlotClock` (slot_clock/src/manual_slot_clock.rs) is the test
+double that lets harnesses time-travel deterministically.
+"""
+
+import time
+
+
+class SystemSlotClock:
+    def __init__(self, genesis_time, seconds_per_slot):
+        self.genesis_time = genesis_time
+        self.seconds_per_slot = seconds_per_slot
+
+    def now(self):
+        """Current slot, or None before genesis."""
+        t = time.time()
+        if t < self.genesis_time:
+            return None
+        return int(t - self.genesis_time) // self.seconds_per_slot
+
+    def seconds_into_slot(self):
+        t = time.time()
+        if t < self.genesis_time:
+            return None
+        return (t - self.genesis_time) % self.seconds_per_slot
+
+    def duration_to_next_slot(self):
+        t = time.time()
+        if t < self.genesis_time:
+            return self.genesis_time - t
+        return self.seconds_per_slot - (
+            (t - self.genesis_time) % self.seconds_per_slot
+        )
+
+    def start_of(self, slot):
+        return self.genesis_time + slot * self.seconds_per_slot
+
+
+class ManualSlotClock:
+    """TestingSlotClock: the harness advances time explicitly."""
+
+    def __init__(self, genesis_time=0, seconds_per_slot=12, slot=0):
+        self.genesis_time = genesis_time
+        self.seconds_per_slot = seconds_per_slot
+        self._slot = slot
+        self._offset = 0.0
+
+    def now(self):
+        return self._slot
+
+    def set_slot(self, slot):
+        self._slot = int(slot)
+        self._offset = 0.0
+
+    def advance_slot(self, n=1):
+        self._slot += n
+        self._offset = 0.0
+
+    def set_seconds_into_slot(self, s):
+        self._offset = float(s)
+
+    def seconds_into_slot(self):
+        return self._offset
+
+    def duration_to_next_slot(self):
+        return self.seconds_per_slot - self._offset
+
+    def start_of(self, slot):
+        return self.genesis_time + slot * self.seconds_per_slot

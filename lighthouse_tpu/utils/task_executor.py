@@ -1,0 +1,77 @@
+"""Task executor: supervised threads with panic-to-shutdown semantics.
+
+Mirror of /root/reference/common/task_executor/src/lib.rs:124-181 and
+environment/src/lib.rs:420-535: every spawned task is wrapped so an
+uncaught exception in a CRITICAL service fires a shutdown signal into the
+environment instead of zombie-ing the process; non-critical tasks log and
+die alone.  `Environment.block_until_shutdown()` mirrors
+block_until_shutdown_requested.
+"""
+
+import logging
+import threading
+
+log = logging.getLogger("lighthouse_tpu.executor")
+
+
+class ShutdownReason:
+    def __init__(self, reason, failure=False):
+        self.reason = reason
+        self.failure = failure
+
+    def __repr__(self):
+        kind = "Failure" if self.failure else "Success"
+        return f"ShutdownReason::{kind}({self.reason!r})"
+
+
+class TaskExecutor:
+    def __init__(self, shutdown_event=None):
+        self._shutdown = shutdown_event or threading.Event()
+        self._reason = None
+        self._threads = []
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ spawn
+
+    def spawn(self, fn, name, critical=True, daemon=True):
+        """Run `fn(executor)` on a supervised thread.  An exception in a
+        critical task requests shutdown (task_executor panic-catcher)."""
+
+        def runner():
+            try:
+                fn(self)
+            except Exception as e:  # the panic catcher
+                log.exception("task %s crashed", name)
+                if critical:
+                    self.shutdown(f"task {name} crashed: {e}", failure=True)
+
+        t = threading.Thread(target=runner, name=name, daemon=daemon)
+        with self._lock:
+            self._threads.append(t)
+        t.start()
+        return t
+
+    # --------------------------------------------------------- shutdown
+
+    def shutdown(self, reason="requested", failure=False):
+        with self._lock:
+            if self._reason is None:
+                self._reason = ShutdownReason(reason, failure)
+        self._shutdown.set()
+
+    @property
+    def shutting_down(self):
+        return self._shutdown.is_set()
+
+    def sleep_or_shutdown(self, seconds):
+        """Interruptible sleep: returns True if shutdown was requested."""
+        return self._shutdown.wait(timeout=seconds)
+
+    def block_until_shutdown(self, timeout=None):
+        """environment block_until_shutdown_requested."""
+        self._shutdown.wait(timeout=timeout)
+        return self._reason
+
+    def join_all(self, timeout=5.0):
+        for t in self._threads:
+            t.join(timeout=timeout)

@@ -1,0 +1,5 @@
+"""Validator client (SURVEY.md §2.6): duties, attesting, proposing,
+slashing protection — the `lighthouse vc` process of the reference
+(/root/reference/validator_client/src/lib.rs:88), recast as services over
+a slot clock and a beacon-node interface.
+"""

@@ -1,0 +1,248 @@
+"""Validator-client services: duties, attesting, proposing, fallback.
+
+Mirror of /root/reference/validator_client/src/{duties_service,
+attestation_service,block_service,beacon_node_fallback}.rs: each service
+is a loop keyed off the slot clock — proposals at slot start,
+attestations at 1/3 slot, aggregates at 2/3 slot — talking to a beacon
+node through the `BeaconNodeInterface` seam (direct chain handle in
+tests/simulator; the HTTP api client in production) with ordered-failover
+across multiple nodes (beacon_node_fallback.rs).
+"""
+
+import logging
+
+from ..ssz import hash_tree_root
+from ..state_processing import phase0
+from ..types.containers import AttestationData, Checkpoint
+from ..types.state import state_types
+from .slashing_protection import NotSafe
+
+log = logging.getLogger("lighthouse_tpu.vc")
+
+
+class BeaconNodeInterface:
+    """What the VC needs from a BN (the `eth2` typed-client surface)."""
+
+    def head_info(self):
+        raise NotImplementedError
+
+    def duties(self, epoch, pubkeys):
+        raise NotImplementedError
+
+    def attestation_data(self, slot, committee_index):
+        raise NotImplementedError
+
+    def produce_block(self, slot, randao_reveal):
+        raise NotImplementedError
+
+    def publish_block(self, signed_block):
+        raise NotImplementedError
+
+    def publish_attestations(self, attestations):
+        raise NotImplementedError
+
+
+class DirectBeaconNode(BeaconNodeInterface):
+    """In-process BN handle (node_test_rig's LocalBeaconNode)."""
+
+    def __init__(self, chain):
+        self.chain = chain
+
+    def head_info(self):
+        st = self.chain.head_state
+        return {
+            "head_root": self.chain.head_root,
+            "slot": int(st.slot),
+            "fork": st.fork,
+            "genesis_validators_root": bytes(st.genesis_validators_root),
+        }
+
+    def duties(self, epoch, pubkeys):
+        """Proposer + attester duties for an epoch (duties_service.rs)."""
+        chain = self.chain
+        preset = chain.preset
+        state = chain.head_state
+        target = epoch * preset.slots_per_epoch
+        if int(state.slot) < target:
+            state = state.copy()
+            state = phase0.process_slots(state, target, preset, spec=chain.spec)
+        index_by_pk = {}
+        reg = state.validators
+        for i in range(len(reg)):
+            index_by_pk[reg.pubkey[i].tobytes()] = i
+        wanted = {index_by_pk[bytes(pk)]: bytes(pk) for pk in pubkeys
+                  if bytes(pk) in index_by_pk}
+        duties = {"attester": [], "proposer": []}
+        for slot in range(target, target + preset.slots_per_epoch):
+            count = phase0.get_committee_count_per_slot(state, epoch, preset)
+            for index in range(count):
+                committee = phase0.get_beacon_committee(state, slot, index, preset)
+                for pos, vi in enumerate(committee):
+                    if vi in wanted:
+                        duties["attester"].append(
+                            {
+                                "pubkey": wanted[vi],
+                                "validator_index": vi,
+                                "slot": slot,
+                                "committee_index": index,
+                                "committee_position": pos,
+                                "committee_length": len(committee),
+                            }
+                        )
+        # proposer duties need per-slot advance for the proposer seed
+        st2 = state.copy()
+        for slot in range(target, target + preset.slots_per_epoch):
+            if int(st2.slot) < slot:
+                st2 = phase0.process_slots(st2, slot, preset, spec=chain.spec)
+            proposer = phase0.get_beacon_proposer_index(st2, preset)
+            if proposer in wanted:
+                duties["proposer"].append(
+                    {"pubkey": wanted[proposer], "validator_index": proposer,
+                     "slot": slot}
+                )
+        return duties
+
+    def attestation_data(self, slot, committee_index):
+        """produce_unaggregated_attestation (beacon_chain.rs:1555)."""
+        chain = self.chain
+        preset = chain.preset
+        state = chain.head_state
+        if int(state.slot) < slot:
+            state = state.copy()
+            state = phase0.process_slots(state, slot, preset, spec=chain.spec)
+        epoch = slot // preset.slots_per_epoch
+        start_slot = epoch * preset.slots_per_epoch
+        if int(chain.head_state.slot) <= start_slot:
+            target_root = chain.head_root
+        else:
+            target_root = phase0.get_block_root_at_slot(state, start_slot, preset)
+        return AttestationData(
+            slot=slot,
+            index=committee_index,
+            beacon_block_root=chain.head_root,
+            source=state.current_justified_checkpoint,
+            target=Checkpoint(epoch=epoch, root=target_root),
+        )
+
+    def produce_block(self, slot, randao_reveal):
+        block, _ = self.chain.produce_block_on_state(slot, randao_reveal)
+        return block
+
+    def publish_block(self, signed_block):
+        self.chain.on_tick(int(signed_block.message.slot))
+        return self.chain.process_block(signed_block)
+
+    def publish_attestations(self, attestations):
+        return self.chain.batch_verify_unaggregated_attestations(attestations)
+
+
+class BeaconNodeFallback(BeaconNodeInterface):
+    """Ordered multi-node failover (beacon_node_fallback.rs:710)."""
+
+    def __init__(self, nodes):
+        assert nodes
+        self.nodes = list(nodes)
+
+    def _try(self, method, *args, **kw):
+        last = None
+        for node in self.nodes:
+            try:
+                return getattr(node, method)(*args, **kw)
+            except Exception as e:  # try the next BN
+                log.warning("BN call %s failed (%s); trying next", method, e)
+                last = e
+        raise last
+
+    def head_info(self):
+        return self._try("head_info")
+
+    def duties(self, epoch, pubkeys):
+        return self._try("duties", epoch, pubkeys)
+
+    def attestation_data(self, slot, committee_index):
+        return self._try("attestation_data", slot, committee_index)
+
+    def produce_block(self, slot, randao_reveal):
+        return self._try("produce_block", slot, randao_reveal)
+
+    def publish_block(self, signed_block):
+        return self._try("publish_block", signed_block)
+
+    def publish_attestations(self, attestations):
+        return self._try("publish_attestations", attestations)
+
+
+class ValidatorClient:
+    """ProductionValidatorClient (lib.rs:88,116,491): drives one slot of
+    duties at a time — proposals first, then attestations (the simulator
+    calls `act_on_slot` per tick; production wraps it in a clocked loop)."""
+
+    def __init__(self, store, beacon_node, spec):
+        self.store = store
+        self.bn = beacon_node
+        self.spec = spec
+        self.preset = spec.preset
+        self._duties_cache = {}   # epoch -> duties
+
+    def _duties(self, epoch):
+        if epoch not in self._duties_cache:
+            self._duties_cache[epoch] = self.bn.duties(
+                epoch, self.store.voting_pubkeys()
+            )
+            for e in list(self._duties_cache):
+                if e < epoch - 1:
+                    del self._duties_cache[e]
+        return self._duties_cache[epoch]
+
+    def act_on_slot(self, slot):
+        """One slot of work: propose (slot start), attest (1/3 slot)."""
+        epoch = slot // self.preset.slots_per_epoch
+        duties = self._duties(epoch)
+        out = {"proposed": [], "attested": []}
+
+        info = self.bn.head_info()
+        fork, gvr = info["fork"], info["genesis_validators_root"]
+
+        for duty in duties["proposer"]:
+            if duty["slot"] != slot:
+                continue
+            try:
+                reveal = self.store.sign_randao_reveal(
+                    duty["pubkey"], epoch, fork, gvr
+                )
+                block = self.bn.produce_block(slot, reveal)
+                sig = self.store.sign_block(duty["pubkey"], block, fork, gvr)
+                T = state_types(self.preset)
+                signed_cls = (
+                    T.SignedBeaconBlockAltair
+                    if hasattr(block.body, "sync_aggregate")
+                    else T.SignedBeaconBlock
+                )
+                root = self.bn.publish_block(
+                    signed_cls(message=block, signature=sig)
+                )
+                out["proposed"].append((slot, root))
+            except NotSafe as e:
+                log.warning("refusing to propose at %s: %s", slot, e)
+
+        atts = []
+        T = state_types(self.preset)
+        for duty in duties["attester"]:
+            if duty["slot"] != slot:
+                continue
+            try:
+                data = self.bn.attestation_data(slot, duty["committee_index"])
+                sig = self.store.sign_attestation(duty["pubkey"], data, fork, gvr)
+                bits = [0] * duty["committee_length"]
+                bits[duty["committee_position"]] = 1
+                atts.append(
+                    T.Attestation(
+                        aggregation_bits=bits, data=data, signature=sig
+                    )
+                )
+                out["attested"].append((slot, duty["validator_index"]))
+            except NotSafe as e:
+                log.warning("refusing to attest at %s: %s", slot, e)
+        if atts:
+            self.bn.publish_attestations(atts)
+        return out

@@ -1,0 +1,239 @@
+"""Slashing protection database — EIP-3076 on sqlite3.
+
+Mirror of /root/reference/validator_client/slashing_protection/ (rusqlite
+min/max-slot DB + interchange.rs import/export): before any signature, the
+DB enforces
+  * blocks: strictly increasing slot per validator (double-proposal guard)
+  * attestations: source monotonic non-decreasing, target strictly
+    increasing (double + surround vote guard, both directions)
+with the same low-watermark semantics as the interchange spec: signing at
+or below the recorded minima is refused even without an exact conflict.
+
+Import/export uses the EIP-3076 JSON interchange format.
+"""
+
+import json
+import sqlite3
+import threading
+
+
+class NotSafe(Exception):
+    """Refusal to sign (slashing hazard or below watermark)."""
+
+
+class SlashingDatabase:
+    def __init__(self, path=":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        self._conn.executescript(
+            """
+            CREATE TABLE IF NOT EXISTS validators (
+                id INTEGER PRIMARY KEY,
+                pubkey TEXT UNIQUE NOT NULL
+            );
+            CREATE TABLE IF NOT EXISTS signed_blocks (
+                validator_id INTEGER NOT NULL REFERENCES validators(id),
+                slot INTEGER NOT NULL,
+                signing_root TEXT,
+                UNIQUE (validator_id, slot)
+            );
+            CREATE TABLE IF NOT EXISTS signed_attestations (
+                validator_id INTEGER NOT NULL REFERENCES validators(id),
+                source_epoch INTEGER NOT NULL,
+                target_epoch INTEGER NOT NULL,
+                signing_root TEXT,
+                UNIQUE (validator_id, target_epoch)
+            );
+            """
+        )
+        self._conn.commit()
+
+    # ----------------------------------------------------------- helpers
+
+    def _vid(self, pubkey_hex, create=True):
+        row = self._conn.execute(
+            "SELECT id FROM validators WHERE pubkey = ?", (pubkey_hex,)
+        ).fetchone()
+        if row:
+            return row[0]
+        if not create:
+            return None
+        cur = self._conn.execute(
+            "INSERT INTO validators (pubkey) VALUES (?)", (pubkey_hex,)
+        )
+        self._conn.commit()
+        return cur.lastrowid
+
+    def register_validator(self, pubkey: bytes):
+        self._vid(bytes(pubkey).hex())
+
+    # ------------------------------------------------------------ blocks
+
+    def check_and_insert_block_proposal(self, pubkey, slot, signing_root=b""):
+        """Permit iff slot strictly exceeds every previously signed slot
+        (identical signing_root at the same slot is an idempotent re-sign)."""
+        pk = bytes(pubkey).hex()
+        sr = bytes(signing_root).hex()
+        with self._lock:
+            vid = self._vid(pk)
+            row = self._conn.execute(
+                "SELECT MAX(slot) FROM signed_blocks WHERE validator_id = ?",
+                (vid,),
+            ).fetchone()
+            max_slot = row[0]
+            if max_slot is not None and slot <= max_slot:
+                same = self._conn.execute(
+                    "SELECT signing_root FROM signed_blocks "
+                    "WHERE validator_id = ? AND slot = ?",
+                    (vid, slot),
+                ).fetchone()
+                if same and same[0] == sr and slot == max_slot:
+                    return  # re-sign of the identical proposal
+                raise NotSafe(
+                    f"block slot {slot} <= max signed slot {max_slot}"
+                )
+            self._conn.execute(
+                "INSERT OR REPLACE INTO signed_blocks VALUES (?, ?, ?)",
+                (vid, slot, sr),
+            )
+            self._conn.commit()
+
+    # ------------------------------------------------------ attestations
+
+    def check_and_insert_attestation(
+        self, pubkey, source_epoch, target_epoch, signing_root=b""
+    ):
+        """EIP-3076 rules: no double vote, no surround in either
+        direction, source/target watermarks."""
+        if source_epoch > target_epoch:
+            raise NotSafe("source after target")
+        pk = bytes(pubkey).hex()
+        sr = bytes(signing_root).hex()
+        with self._lock:
+            vid = self._vid(pk)
+            # double vote
+            dup = self._conn.execute(
+                "SELECT source_epoch, signing_root FROM signed_attestations "
+                "WHERE validator_id = ? AND target_epoch = ?",
+                (vid, target_epoch),
+            ).fetchone()
+            if dup is not None:
+                if dup[0] == source_epoch and dup[1] == sr:
+                    return  # idempotent re-sign
+                raise NotSafe(f"double vote at target {target_epoch}")
+            # watermarks
+            row = self._conn.execute(
+                "SELECT MIN(source_epoch), MAX(source_epoch), "
+                "MIN(target_epoch), MAX(target_epoch) "
+                "FROM signed_attestations WHERE validator_id = ?",
+                (vid,),
+            ).fetchone()
+            min_src, max_src, min_tgt, max_tgt = row
+            if min_src is not None:
+                if source_epoch < min_src:
+                    raise NotSafe("source below watermark")
+                if target_epoch <= max_tgt and target_epoch < min_tgt:
+                    raise NotSafe("target below watermark")
+            # surrounding: new (s, t) surrounds an existing (s', t') iff
+            # s < s' and t' < t
+            surrounds = self._conn.execute(
+                "SELECT 1 FROM signed_attestations WHERE validator_id = ? "
+                "AND source_epoch > ? AND target_epoch < ?",
+                (vid, source_epoch, target_epoch),
+            ).fetchone()
+            if surrounds:
+                raise NotSafe("attestation surrounds a previous vote")
+            # surrounded: existing (s', t') surrounds new iff s' < s, t < t'
+            surrounded = self._conn.execute(
+                "SELECT 1 FROM signed_attestations WHERE validator_id = ? "
+                "AND source_epoch < ? AND target_epoch > ?",
+                (vid, source_epoch, target_epoch),
+            ).fetchone()
+            if surrounded:
+                raise NotSafe("attestation is surrounded by a previous vote")
+            self._conn.execute(
+                "INSERT INTO signed_attestations VALUES (?, ?, ?, ?)",
+                (vid, source_epoch, target_epoch, sr),
+            )
+            self._conn.commit()
+
+    # ------------------------------------------------------- interchange
+
+    def export_interchange(self, genesis_validators_root: bytes) -> dict:
+        """EIP-3076 JSON export (interchange.rs)."""
+        data = []
+        for vid, pk in self._conn.execute("SELECT id, pubkey FROM validators"):
+            blocks = [
+                {"slot": str(slot), "signing_root": "0x" + sr}
+                for slot, sr in self._conn.execute(
+                    "SELECT slot, signing_root FROM signed_blocks "
+                    "WHERE validator_id = ? ORDER BY slot",
+                    (vid,),
+                )
+            ]
+            atts = [
+                {
+                    "source_epoch": str(s),
+                    "target_epoch": str(t),
+                    "signing_root": "0x" + sr,
+                }
+                for s, t, sr in self._conn.execute(
+                    "SELECT source_epoch, target_epoch, signing_root "
+                    "FROM signed_attestations WHERE validator_id = ? "
+                    "ORDER BY target_epoch",
+                    (vid,),
+                )
+            ]
+            data.append(
+                {
+                    "pubkey": "0x" + pk,
+                    "signed_blocks": blocks,
+                    "signed_attestations": atts,
+                }
+            )
+        return {
+            "metadata": {
+                "interchange_format_version": "5",
+                "genesis_validators_root": "0x"
+                + bytes(genesis_validators_root).hex(),
+            },
+            "data": data,
+        }
+
+    def import_interchange(self, interchange: dict):
+        """Merge an EIP-3076 interchange (minification semantics: keep the
+        maximum watermarks)."""
+        for entry in interchange.get("data", []):
+            pk = entry["pubkey"].removeprefix("0x")
+            with self._lock:
+                vid = self._vid(pk)
+                for b in entry.get("signed_blocks", []):
+                    self._conn.execute(
+                        "INSERT OR IGNORE INTO signed_blocks VALUES (?, ?, ?)",
+                        (
+                            vid,
+                            int(b["slot"]),
+                            b.get("signing_root", "0x").removeprefix("0x"),
+                        ),
+                    )
+                for a in entry.get("signed_attestations", []):
+                    self._conn.execute(
+                        "INSERT OR IGNORE INTO signed_attestations "
+                        "VALUES (?, ?, ?, ?)",
+                        (
+                            vid,
+                            int(a["source_epoch"]),
+                            int(a["target_epoch"]),
+                            a.get("signing_root", "0x").removeprefix("0x"),
+                        ),
+                    )
+                self._conn.commit()
+
+    def export_json(self, genesis_validators_root=b"\x00" * 32) -> str:
+        return json.dumps(self.export_interchange(genesis_validators_root))
+
+    def import_json(self, blob: str):
+        self.import_interchange(json.loads(blob))
+
+    def close(self):
+        self._conn.close()

@@ -1,0 +1,139 @@
+"""ValidatorStore: the signing façade in front of slashing protection.
+
+Mirror of /root/reference/validator_client/src/validator_store.rs: every
+signature flows through here — slashing-protection check first, then the
+signing method (local keystore; the Web3Signer remote path is the same
+seam with an HTTP call).  Doppelganger-protection gates participation
+(doppelganger_service.rs): a validator only signs once its initial
+quiet-watch epochs pass without seeing itself live elsewhere.
+"""
+
+from ..crypto.ref import bls as RB
+from ..crypto.ref.curves import g1_compress, g2_compress
+from ..ssz import hash_tree_root
+from ..types import Domain, compute_signing_root
+from ..state_processing import signature_sets as sset
+from .slashing_protection import NotSafe, SlashingDatabase
+
+
+class DoppelgangerStatus:
+    SIGNING_ENABLED = "signing_enabled"
+    WATCHING = "watching"
+
+
+class ValidatorStore:
+    def __init__(self, spec, slashing_db=None, doppelganger_epochs=0):
+        self.spec = spec
+        self.preset = spec.preset
+        self.slashing_db = slashing_db or SlashingDatabase()
+        self._keys = {}          # pubkey bytes -> secret key int
+        self._doppelganger = {}  # pubkey bytes -> remaining watch epochs
+        self.doppelganger_epochs = doppelganger_epochs
+
+    # ------------------------------------------------------------- keys
+
+    def add_validator(self, sk: int):
+        pk = g1_compress(RB.sk_to_pk(sk))
+        self._keys[pk] = sk
+        self._doppelganger[pk] = self.doppelganger_epochs
+        self.slashing_db.register_validator(pk)
+        return pk
+
+    def voting_pubkeys(self):
+        return list(self._keys)
+
+    # ----------------------------------------------------- doppelganger
+
+    def doppelganger_status(self, pubkey):
+        return (
+            DoppelgangerStatus.SIGNING_ENABLED
+            if self._doppelganger.get(bytes(pubkey), 0) == 0
+            else DoppelgangerStatus.WATCHING
+        )
+
+    def complete_doppelganger_epoch(self, pubkey, saw_live_elsewhere=False):
+        """doppelganger_service.rs epoch tick: abort on detection."""
+        pk = bytes(pubkey)
+        if saw_live_elsewhere:
+            raise NotSafe("doppelganger detected — refusing to ever sign")
+        if self._doppelganger.get(pk, 0) > 0:
+            self._doppelganger[pk] -= 1
+
+    def _require_signable(self, pubkey):
+        pk = bytes(pubkey)
+        if pk not in self._keys:
+            raise KeyError("unknown validator")
+        if self._doppelganger.get(pk, 0) > 0:
+            raise NotSafe("doppelganger watch in progress")
+        return self._keys[pk]
+
+    # ---------------------------------------------------------- signing
+
+    def sign_block(self, pubkey, block, fork, genesis_validators_root):
+        sk = self._require_signable(pubkey)
+        epoch = int(block.slot) // self.preset.slots_per_epoch
+        domain = self.spec.get_domain(
+            Domain.BEACON_PROPOSER, epoch, fork, genesis_validators_root
+        )
+        root = compute_signing_root(block, domain)
+        self.slashing_db.check_and_insert_block_proposal(
+            pubkey, int(block.slot), root
+        )
+        return g2_compress(RB.sign(sk, root))
+
+    def sign_attestation(self, pubkey, data, fork, genesis_validators_root):
+        sk = self._require_signable(pubkey)
+        domain = self.spec.get_domain(
+            Domain.BEACON_ATTESTER,
+            int(data.target.epoch),
+            fork,
+            genesis_validators_root,
+        )
+        root = compute_signing_root(data, domain)
+        self.slashing_db.check_and_insert_attestation(
+            pubkey, int(data.source.epoch), int(data.target.epoch), root
+        )
+        return g2_compress(RB.sign(sk, root))
+
+    def sign_randao_reveal(self, pubkey, epoch, fork, genesis_validators_root):
+        sk = self._require_signable(pubkey)
+        domain = self.spec.get_domain(
+            Domain.RANDAO, epoch, fork, genesis_validators_root
+        )
+        root = sset.compute_signing_root_uint64(epoch, domain)
+        return g2_compress(RB.sign(sk, root))
+
+    def sign_selection_proof(self, pubkey, slot, fork, genesis_validators_root):
+        sk = self._require_signable(pubkey)
+        epoch = int(slot) // self.preset.slots_per_epoch
+        domain = self.spec.get_domain(
+            Domain.SELECTION_PROOF, epoch, fork, genesis_validators_root
+        )
+        root = sset.compute_signing_root_uint64(int(slot), domain)
+        return g2_compress(RB.sign(sk, root))
+
+    def sign_aggregate_and_proof(self, pubkey, agg_and_proof, fork, gvr):
+        sk = self._require_signable(pubkey)
+        epoch = (
+            int(agg_and_proof.aggregate.data.slot) // self.preset.slots_per_epoch
+        )
+        domain = self.spec.get_domain(
+            Domain.AGGREGATE_AND_PROOF, epoch, fork, gvr
+        )
+        root = compute_signing_root(agg_and_proof, domain)
+        return g2_compress(RB.sign(sk, root))
+
+    def sign_sync_committee_message(self, pubkey, slot, block_root, fork, gvr):
+        sk = self._require_signable(pubkey)
+        epoch = int(slot) // self.preset.slots_per_epoch
+        domain = self.spec.get_domain(Domain.SYNC_COMMITTEE, epoch, fork, gvr)
+        root = sset.compute_signing_root_bytes32(block_root, domain)
+        return g2_compress(RB.sign(sk, root))
+
+    def sign_voluntary_exit(self, pubkey, exit_msg, fork, gvr):
+        sk = self._require_signable(pubkey)
+        domain = self.spec.get_domain(
+            Domain.VOLUNTARY_EXIT, int(exit_msg.epoch), fork, gvr
+        )
+        root = compute_signing_root(exit_msg, domain)
+        return g2_compress(RB.sign(sk, root))
