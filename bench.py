@@ -26,9 +26,14 @@ import os
 import sys
 import time
 
-# Do NOT force a platform: the driver runs this on real TPU hardware.
+# Do NOT force a platform by default: the driver runs this on real TPU
+# hardware.  BENCH_PLATFORM overrides in-process (sitecustomize clobbers
+# the JAX_PLATFORMS env var at interpreter startup, so an env var of that
+# name cannot be used for the override).
 import jax
 
+if os.environ.get("BENCH_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
 jax.config.update("jax_compilation_cache_dir", "/tmp/lighthouse_tpu_xla_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 

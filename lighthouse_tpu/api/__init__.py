@@ -1,0 +1,2 @@
+"""HTTP layer: standard Beacon API subset + metrics scrape endpoint
+(SURVEY.md §2.5 http_api/http_metrics; §2.8 eth2 typed client)."""

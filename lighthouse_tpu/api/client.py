@@ -1,0 +1,106 @@
+"""Typed Beacon API client.
+
+Mirror of /root/reference/common/eth2 (4,885 LoC typed HTTP client used by
+the VC, lcli, watch and tests): stdlib urllib against the BeaconApiServer
+routes, returning parsed values.
+"""
+
+import json
+import urllib.request
+from urllib.error import HTTPError, URLError
+
+
+class ApiError(Exception):
+    pass
+
+
+class BeaconApiClient:
+    def __init__(self, base_url, timeout=5.0):
+        self.base = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _get(self, path, params=None):
+        url = self.base + path
+        if params:
+            from urllib.parse import urlencode
+
+            url += "?" + urlencode(params)
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout) as r:
+                body = r.read()
+                return json.loads(body) if body else None
+        except HTTPError as e:
+            raise ApiError(f"{e.code}: {e.read().decode(errors='replace')}")
+        except URLError as e:
+            raise ApiError(str(e))
+
+    def _post(self, path, payload):
+        req = urllib.request.Request(
+            self.base + path,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return json.loads(r.read())
+        except HTTPError as e:
+            raise ApiError(f"{e.code}: {e.read().decode(errors='replace')}")
+        except URLError as e:
+            raise ApiError(str(e))
+
+    # ------------------------------------------------------------- routes
+
+    def health(self):
+        self._get("/eth/v1/node/health")
+        return True
+
+    def version(self):
+        return self._get("/eth/v1/node/version")["data"]["version"]
+
+    def genesis(self):
+        return self._get("/eth/v1/beacon/genesis")["data"]
+
+    def state_root(self, state_id="head"):
+        return bytes.fromhex(
+            self._get(f"/eth/v1/beacon/states/{state_id}/root")["data"][
+                "root"
+            ][2:]
+        )
+
+    def finality_checkpoints(self, state_id="head"):
+        return self._get(
+            f"/eth/v1/beacon/states/{state_id}/finality_checkpoints"
+        )["data"]
+
+    def validator(self, validator_id, state_id="head"):
+        return self._get(
+            f"/eth/v1/beacon/states/{state_id}/validators/{validator_id}"
+        )["data"]
+
+    def header(self, block_id="head"):
+        return self._get(f"/eth/v1/beacon/headers/{block_id}")["data"]
+
+    def block_root(self, block_id="head"):
+        return bytes.fromhex(
+            self._get(f"/eth/v1/beacon/blocks/{block_id}/root")["data"][
+                "root"
+            ][2:]
+        )
+
+    def attester_duties(self, epoch, pubkeys):
+        return self._post(
+            f"/eth/v1/validator/duties/attester/{epoch}",
+            ["0x" + bytes(pk).hex() for pk in pubkeys],
+        )["data"]
+
+    def attestation_data(self, slot, committee_index):
+        return self._get(
+            "/eth/v1/validator/attestation_data",
+            {"slot": slot, "committee_index": committee_index},
+        )["data"]
+
+    def metrics(self):
+        url = self.base + "/metrics"
+        with urllib.request.urlopen(url, timeout=self.timeout) as r:
+            return r.read().decode()

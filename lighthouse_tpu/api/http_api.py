@@ -1,0 +1,357 @@
+"""Beacon HTTP API server.
+
+Mirror of /root/reference/beacon_node/http_api/src/lib.rs:273 (`serve`):
+the standard Beacon API routes the VC and tooling need, over the stdlib
+threading HTTP server (the reference uses warp; the route surface and
+JSON shapes follow the beacon-APIs spec):
+
+  GET  /eth/v1/node/health | /eth/v1/node/version
+  GET  /eth/v1/beacon/genesis
+  GET  /eth/v1/beacon/states/{state_id}/root
+  GET  /eth/v1/beacon/states/{state_id}/finality_checkpoints
+  GET  /eth/v1/beacon/states/{state_id}/validators/{validator_id}
+  GET  /eth/v1/beacon/headers/{block_id}
+  GET  /eth/v1/beacon/blocks/{block_id}/root
+  GET  /eth/v1/validator/duties/proposer/{epoch}
+  POST /eth/v1/validator/duties/attester/{epoch}
+  GET  /eth/v1/validator/attestation_data?slot=&committee_index=
+  GET  /metrics  (http_metrics/src/lib.rs:84 — Prometheus text)
+
+`state_id`/`block_id` resolution: head | finalized | genesis | 0x<root> |
+<slot> (http_api block_id.rs/state_id.rs).
+"""
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..ssz import hash_tree_root
+from ..state_processing import phase0
+from ..utils import metrics
+from ..validator_client.client import DirectBeaconNode
+
+VERSION = "lighthouse_tpu/0.2.0"
+
+
+def _hex(b):
+    return "0x" + bytes(b).hex()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = VERSION
+
+    # quiet the default stderr access log
+    def log_message(self, fmt, *args):
+        pass
+
+    @property
+    def chain(self):
+        return self.server.chain
+
+    @property
+    def bn(self):
+        return self.server.bn
+
+    # ------------------------------------------------------------ plumbing
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _text(self, text, code=200):
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _err(self, code, message):
+        self._json({"code": code, "message": message}, code)
+
+    def _canonical_root_at_slot(self, slot):
+        """Walk the canonical chain back from head to the block at or
+        before `slot` (block_id.rs slot resolution)."""
+        chain = self.chain
+        root = chain.head_root
+        while root is not None:
+            blk = chain.store.get_block(root)
+            if blk is None:
+                return chain.genesis_root if slot == 0 else None
+            if int(blk.message.slot) <= slot:
+                return root
+            root = bytes(blk.message.parent_root)
+        return None
+
+    def _resolve_state(self, state_id):
+        chain = self.chain
+        if state_id == "head":
+            return chain.head_state, chain.head_root
+        if state_id == "genesis":
+            st = chain.store.get_state(chain.genesis_root)
+            return st, chain.genesis_root
+        if state_id == "finalized":
+            root = chain.fork_choice.store.finalized_checkpoint[1]
+            return chain.store.get_state(root), root
+        if state_id.startswith("0x"):
+            root = bytes.fromhex(state_id[2:])
+            return chain.store.get_state(root), root
+        if state_id.isdigit():
+            root = self._canonical_root_at_slot(int(state_id))
+            if root is not None:
+                return chain.store.get_state(root), root
+        return None, None
+
+    def _resolve_block_root(self, block_id):
+        chain = self.chain
+        if block_id == "head":
+            return chain.head_root
+        if block_id == "genesis":
+            return chain.genesis_root
+        if block_id == "finalized":
+            return chain.fork_choice.store.finalized_checkpoint[1]
+        if block_id.startswith("0x"):
+            return bytes.fromhex(block_id[2:])
+        if block_id.isdigit():
+            return self._canonical_root_at_slot(int(block_id))
+        return None
+
+    # -------------------------------------------------------------- routes
+
+    def do_GET(self):
+        url = urlparse(self.path)
+        path, q = url.path.rstrip("/"), parse_qs(url.query)
+        try:
+            return self._route_get(path, q)
+        except Exception as e:  # route errors surface as 500s, not crashes
+            self._err(500, str(e))
+
+    def do_POST(self):
+        url = urlparse(self.path)
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(length) or b"null"
+            try:
+                body = json.loads(raw)
+            except json.JSONDecodeError as e:
+                return self._err(400, f"malformed JSON body: {e}")
+            return self._route_post(url.path.rstrip("/"), body)
+        except Exception as e:
+            self._err(500, str(e))
+
+    def _route_get(self, path, q):
+        chain = self.chain
+        if path == "/eth/v1/node/health":
+            self.send_response(200)
+            self.end_headers()
+            return
+        if path == "/eth/v1/node/version":
+            return self._json({"data": {"version": VERSION}})
+        if path == "/metrics":
+            return self._text(metrics.gather())
+        if path == "/eth/v1/beacon/genesis":
+            st = chain.store.get_state(chain.genesis_root)
+            return self._json(
+                {
+                    "data": {
+                        "genesis_time": str(int(st.genesis_time)),
+                        "genesis_validators_root": _hex(
+                            st.genesis_validators_root
+                        ),
+                        "genesis_fork_version": _hex(
+                            chain.spec.genesis_fork_version
+                        ),
+                    }
+                }
+            )
+
+        m = re.fullmatch(r"/eth/v1/beacon/states/([^/]+)/root", path)
+        if m:
+            st, root = self._resolve_state(m.group(1))
+            if st is None:
+                return self._err(404, "state not found")
+            return self._json({"data": {"root": _hex(hash_tree_root(st))}})
+
+        m = re.fullmatch(
+            r"/eth/v1/beacon/states/([^/]+)/finality_checkpoints", path
+        )
+        if m:
+            st, _ = self._resolve_state(m.group(1))
+            if st is None:
+                return self._err(404, "state not found")
+
+            def ckpt(c):
+                return {"epoch": str(int(c.epoch)), "root": _hex(c.root)}
+
+            return self._json(
+                {
+                    "data": {
+                        "previous_justified": ckpt(
+                            st.previous_justified_checkpoint
+                        ),
+                        "current_justified": ckpt(st.current_justified_checkpoint),
+                        "finalized": ckpt(st.finalized_checkpoint),
+                    }
+                }
+            )
+
+        m = re.fullmatch(
+            r"/eth/v1/beacon/states/([^/]+)/validators/([^/]+)", path
+        )
+        if m:
+            st, _ = self._resolve_state(m.group(1))
+            if st is None:
+                return self._err(404, "state not found")
+            vid = m.group(2)
+            if vid.startswith("0x"):
+                pk = bytes.fromhex(vid[2:])
+                reg = st.validators
+                idx = None
+                for i in range(len(reg)):
+                    if reg.pubkey[i].tobytes() == pk:
+                        idx = i
+                        break
+            elif vid.isdigit():
+                idx = int(vid)
+            else:
+                return self._err(400, f"invalid validator id {vid!r}")
+            if idx is None or not 0 <= idx < len(st.validators):
+                return self._err(404, "validator not found")
+            v = st.validators[idx]
+            return self._json(
+                {
+                    "data": {
+                        "index": str(idx),
+                        "balance": str(st.balances[idx]),
+                        "validator": {
+                            "pubkey": _hex(v.pubkey),
+                            "effective_balance": str(v.effective_balance),
+                            "slashed": bool(v.slashed),
+                            "activation_epoch": str(v.activation_epoch),
+                            "exit_epoch": str(v.exit_epoch),
+                        },
+                    }
+                }
+            )
+
+        m = re.fullmatch(r"/eth/v1/beacon/headers/([^/]+)", path)
+        if m:
+            root = self._resolve_block_root(m.group(1))
+            blk = chain.store.get_block(root) if root else None
+            if blk is None:
+                return self._err(404, "block not found")
+            msg = blk.message
+            return self._json(
+                {
+                    "data": {
+                        "root": _hex(root),
+                        "header": {
+                            "message": {
+                                "slot": str(int(msg.slot)),
+                                "proposer_index": str(int(msg.proposer_index)),
+                                "parent_root": _hex(msg.parent_root),
+                                "state_root": _hex(msg.state_root),
+                                "body_root": _hex(hash_tree_root(msg.body)),
+                            }
+                        },
+                    }
+                }
+            )
+
+        m = re.fullmatch(r"/eth/v1/beacon/blocks/([^/]+)/root", path)
+        if m:
+            root = self._resolve_block_root(m.group(1))
+            if root is None or chain.store.get_block(root) is None:
+                return self._err(404, "block not found")
+            return self._json({"data": {"root": _hex(root)}})
+
+        m = re.fullmatch(r"/eth/v1/validator/duties/proposer/(\d+)", path)
+        if m:
+            duties = self.bn.proposer_duties(int(m.group(1)))
+            return self._json(
+                {
+                    "data": [
+                        {
+                            "pubkey": _hex(d["pubkey"]),
+                            "validator_index": str(d["validator_index"]),
+                            "slot": str(d["slot"]),
+                        }
+                        for d in duties
+                    ]
+                }
+            )
+
+        if path == "/eth/v1/validator/attestation_data":
+            slot = int(q["slot"][0])
+            index = int(q["committee_index"][0])
+            data = self.bn.attestation_data(slot, index)
+            return self._json(
+                {
+                    "data": {
+                        "slot": str(int(data.slot)),
+                        "index": str(int(data.index)),
+                        "beacon_block_root": _hex(data.beacon_block_root),
+                        "source": {
+                            "epoch": str(int(data.source.epoch)),
+                            "root": _hex(data.source.root),
+                        },
+                        "target": {
+                            "epoch": str(int(data.target.epoch)),
+                            "root": _hex(data.target.root),
+                        },
+                    }
+                }
+            )
+        return self._err(404, f"no route {path}")
+
+    def _route_post(self, path, body):
+        m = re.fullmatch(r"/eth/v1/validator/duties/attester/(\d+)", path)
+        if m:
+            pubkeys = [bytes.fromhex(pk.removeprefix("0x")) for pk in body]
+            duties = self.bn.duties(int(m.group(1)), pubkeys)
+            return self._json(
+                {
+                    "data": [
+                        {
+                            "pubkey": _hex(d["pubkey"]),
+                            "validator_index": str(d["validator_index"]),
+                            "slot": str(d["slot"]),
+                            "committee_index": str(d["committee_index"]),
+                            "committee_position": str(d["committee_position"]),
+                            "committee_length": str(d["committee_length"]),
+                        }
+                        for d in duties["attester"]
+                    ]
+                }
+            )
+        return self._err(404, f"no route {path}")
+
+
+class BeaconApiServer:
+    """Owns the listening socket + serving thread (ClientBuilder
+    .http_api_config analogue)."""
+
+    def __init__(self, chain, host="127.0.0.1", port=0):
+        self.chain = chain
+        self.server = ThreadingHTTPServer((host, port), _Handler)
+        self.server.chain = chain
+        self.server.bn = DirectBeaconNode(chain)
+        self.port = self.server.server_address[1]
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, name="http_api", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()

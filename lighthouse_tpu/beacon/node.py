@@ -1,0 +1,134 @@
+"""Beacon node assembly: the ClientBuilder.
+
+Mirror of /root/reference/beacon_node/client/src/builder.rs:57 (ClientBuilder
+chaining store -> chain -> network -> http -> notifier -> timer) and
+client/notifier.rs (periodic status logs): compose a runnable node from a
+genesis or checkpoint state and drive per-slot ticks off the slot clock
+under the supervised TaskExecutor.
+"""
+
+import logging
+
+from ..api.http_api import BeaconApiServer
+from ..crypto.backend import SignatureVerifier
+from ..utils.slot_clock import SystemSlotClock
+from ..utils.task_executor import TaskExecutor
+from .beacon_processor import BeaconProcessor
+from .chain import BeaconChain
+
+log = logging.getLogger("lighthouse_tpu.node")
+
+
+class BeaconNode:
+    """An assembled node: chain + processor + http api + slot timer."""
+
+    def __init__(self, chain, processor, api_server, clock, executor):
+        self.chain = chain
+        self.processor = processor
+        self.api_server = api_server
+        self.clock = clock
+        self.executor = executor
+
+    def start(self):
+        if self.api_server is not None:
+            self.api_server.start()
+        self.executor.spawn(self._timer_loop, "slot_timer")
+        self.executor.spawn(self.processor.run, "beacon_processor")
+        self.executor.spawn(self._notifier_loop, "notifier", critical=False)
+        return self
+
+    def stop(self):
+        self.executor.shutdown("node stop")
+        if self.api_server is not None:
+            self.api_server.stop()
+
+    # ------------------------------------------------------------- loops
+
+    def _timer_loop(self, executor):
+        """timer/src/lib.rs:12-36 per-slot tick.  The wait is capped so a
+        manually-advanced clock (tests, simulator) is noticed promptly."""
+        last = None
+        while not executor.shutting_down:
+            slot = self.clock.now()
+            if slot is not None and slot != last:
+                self.chain.on_tick(slot)
+                last = slot
+            wait = min(self.clock.duration_to_next_slot(), 0.25)
+            if executor.sleep_or_shutdown(max(wait, 0.05)):
+                break
+
+    def _notifier_loop(self, executor):
+        """client/notifier.rs periodic status line."""
+        while not executor.shutting_down:
+            if executor.sleep_or_shutdown(self.clock.seconds_per_slot):
+                break
+            st = self.chain.head_state
+            log.info(
+                "slot %s | head %s (slot %s) | finalized epoch %s | %d validators",
+                self.clock.now(),
+                self.chain.head_root.hex()[:8],
+                int(st.slot),
+                int(st.finalized_checkpoint.epoch),
+                len(st.validators),
+            )
+
+
+class ClientBuilder:
+    def __init__(self, spec):
+        self.spec = spec
+        self._genesis_state = None
+        self._store = None
+        self._backend = "tpu"
+        self._http_port = None
+        self._clock = None
+
+    def genesis_state(self, state):
+        self._genesis_state = state
+        return self
+
+    def checkpoint_state(self, state):
+        """Weak-subjectivity entry (client/src/builder.rs:209-431): seed
+        from a trusted finalized state instead of genesis."""
+        self._genesis_state = state
+        return self
+
+    def disk_store(self, path):
+        from .store import FileKV, HotColdStore
+
+        self._store = HotColdStore(FileKV(path), self.spec)
+        return self
+
+    def memory_store(self):
+        self._store = None
+        return self
+
+    def crypto_backend(self, backend):
+        self._backend = backend
+        return self
+
+    def http_api(self, port=5052):
+        self._http_port = port
+        return self
+
+    def slot_clock(self, clock):
+        self._clock = clock
+        return self
+
+    def build(self) -> BeaconNode:
+        assert self._genesis_state is not None, "a genesis/checkpoint state is required"
+        chain = BeaconChain(
+            self._genesis_state,
+            self.spec,
+            store=self._store,
+            verifier=SignatureVerifier(self._backend),
+        )
+        processor = BeaconProcessor(chain)
+        api_server = (
+            BeaconApiServer(chain, port=self._http_port)
+            if self._http_port is not None
+            else None
+        )
+        clock = self._clock or SystemSlotClock(
+            int(self._genesis_state.genesis_time), self.spec.seconds_per_slot
+        )
+        return BeaconNode(chain, processor, api_server, clock, TaskExecutor())

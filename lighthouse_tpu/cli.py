@@ -1,0 +1,199 @@
+"""CLI multiplexer: `python -m lighthouse_tpu {bn|vc|am|db} ...`.
+
+Mirror of /root/reference/lighthouse/src/main.rs:40 (the fat binary
+dispatching bn|vc|am|boot_node|db) and beacon_node/src/cli.rs +
+common/clap_utils (SURVEY.md §5.6): argparse subcommands, network presets
+(--network mainnet|minimal), TOML-less flag files via --config JSON, and
+--dump-config.
+"""
+
+import argparse
+import json
+import sys
+
+from .types import ChainSpec, MainnetPreset, MinimalPreset
+
+
+def _spec_from_args(args):
+    preset = MinimalPreset if args.network == "minimal" else MainnetPreset
+    kwargs = {}
+    if args.altair_fork_epoch is not None:
+        kwargs["altair_fork_epoch"] = args.altair_fork_epoch
+    return ChainSpec(preset=preset, **kwargs)
+
+
+def _add_common(p):
+    p.add_argument("--network", default="mainnet",
+                   choices=["mainnet", "minimal"])
+    p.add_argument("--altair-fork-epoch", type=int, default=None)
+    p.add_argument("--config", help="JSON flags file (clap_utils flags.rs)")
+    p.add_argument("--dump-config", action="store_true")
+
+
+def build_parser():
+    parser, _ = build_parser_with_subs()
+    return parser
+
+
+def build_parser_with_subs():
+    parser = argparse.ArgumentParser(prog="lighthouse-tpu")
+    parser._subparser_map = {}
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    bn = sub.add_parser("bn", help="beacon node")
+    _add_common(bn)
+    bn.add_argument("--datadir", default="./datadir")
+    bn.add_argument("--http-port", type=int, default=5052)
+    bn.add_argument("--crypto-backend", default="tpu",
+                    choices=["tpu", "oracle", "fake"])
+    bn.add_argument("--interop-validators", type=int, default=0,
+                    help="deterministic interop genesis with N validators")
+    bn.add_argument("--memory-store", action="store_true")
+
+    vc = sub.add_parser("vc", help="validator client")
+    _add_common(vc)
+    vc.add_argument("--beacon-node", default="http://127.0.0.1:5052")
+    vc.add_argument("--keystore-dir", default="./validators")
+    vc.add_argument("--password", default="")
+
+    am = sub.add_parser("am", help="account manager")
+    _add_common(am)
+    am_sub = am.add_subparsers(dest="am_command", required=True)
+    new = am_sub.add_parser("validator-new", help="derive + save keystores")
+    new.add_argument("--seed-hex", required=True)
+    new.add_argument("--count", type=int, default=1)
+    new.add_argument("--out-dir", default="./validators")
+    new.add_argument("--password", required=True)
+    slp = am_sub.add_parser("slashing-protection-export")
+    slp.add_argument("--db", required=True)
+
+    db = sub.add_parser("db", help="database manager")
+    _add_common(db)
+    db_sub = db.add_subparsers(dest="db_command", required=True)
+    insp = db_sub.add_parser("inspect")
+    insp.add_argument("--datadir", default="./datadir")
+    comp = db_sub.add_parser("compact")
+    comp.add_argument("--datadir", default="./datadir")
+    parser._subparser_map.update({"bn": bn, "vc": vc, "am": am, "db": db})
+    return parser, parser._subparser_map
+
+
+def main(argv=None):
+    parser, subs = build_parser_with_subs()
+    args = parser.parse_args(argv)
+    if getattr(args, "config", None):
+        # config-file values become subparser DEFAULTS, then a re-parse
+        # lets explicitly-passed CLI flags win (clap_utils precedence)
+        with open(args.config) as f:
+            cfg = {
+                k.replace("-", "_"): v for k, v in json.load(f).items()
+            }
+        subs[args.command].set_defaults(**cfg)
+        args = parser.parse_args(argv)
+
+    if getattr(args, "dump_config", False):
+        print(json.dumps({k: v for k, v in vars(args).items()
+                          if k not in ("config", "dump_config")},
+                         default=str, indent=1))
+        return 0
+
+    if args.command == "bn":
+        return _run_bn(args)
+    if args.command == "vc":
+        return _run_vc(args)
+    if args.command == "am":
+        return _run_am(args)
+    if args.command == "db":
+        return _run_db(args)
+    return 2
+
+
+def _run_bn(args):
+    import logging
+    import os
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    spec = _spec_from_args(args)
+    from .beacon.node import ClientBuilder
+    from .state_processing.genesis import interop_genesis_state, interop_keypairs
+
+    builder = ClientBuilder(spec).crypto_backend(args.crypto_backend)
+    if args.interop_validators:
+        state = interop_genesis_state(
+            interop_keypairs(args.interop_validators), 0, spec
+        )
+    else:
+        print("no genesis source: use --interop-validators N", file=sys.stderr)
+        return 1
+    builder.genesis_state(state).http_api(args.http_port)
+    if args.memory_store:
+        builder.memory_store()
+    else:
+        os.makedirs(args.datadir, exist_ok=True)
+        builder.disk_store(os.path.join(args.datadir, "chain.db"))
+    node = builder.build().start()
+    print(f"beacon node up — http API on :{node.api_server.port}")
+    reason = node.executor.block_until_shutdown()
+    print(f"shutting down: {reason}")
+    return 1 if (reason and reason.failure) else 0
+
+
+def _run_vc(args):
+    print("vc: connect keystores in", args.keystore_dir, "to", args.beacon_node)
+    # production loop: load keystores, poll duties each slot via the API
+    # client; the in-process path is exercised by testing/simulator.py
+    return 0
+
+
+def _run_am(args):
+    from .crypto import keys
+
+    if args.am_command == "validator-new":
+        seed = bytes.fromhex(args.seed_hex)
+        made = []
+        for i in range(args.count):
+            sk = keys.derive_path(seed, f"m/12381/3600/{i}/0/0")
+            ks = keys.encrypt_keystore(
+                sk, args.password, path=f"m/12381/3600/{i}/0/0", light=True
+            )
+            made.append(keys.save_keystore(ks, args.out_dir))
+        print(json.dumps({"created": made}))
+        return 0
+    if args.am_command == "slashing-protection-export":
+        from .validator_client.slashing_protection import SlashingDatabase
+
+        db = SlashingDatabase(args.db)
+        print(db.export_json())
+        return 0
+    return 2
+
+
+def _run_db(args):
+    import os
+
+    from .beacon.store import FileKV, HotColdStore
+
+    spec = _spec_from_args(args)
+    path = os.path.join(args.datadir, "chain.db")
+    kv = FileKV(path)
+    store = HotColdStore(kv, spec)
+    if args.db_command == "inspect":
+        blocks = len(kv.keys_with_prefix(b"blk:"))
+        hot = len(kv.keys_with_prefix(b"sts:"))
+        cold = len(kv.keys_with_prefix(b"cst:"))
+        print(json.dumps({
+            "split_slot": store.split_slot,
+            "blocks": blocks, "hot_states": hot, "cold_restore_points": cold,
+        }))
+    elif args.db_command == "compact":
+        kv.compact()
+        print(json.dumps({"compacted": path}))
+    store.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

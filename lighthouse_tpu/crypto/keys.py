@@ -1,0 +1,205 @@
+"""EIP-2333 hierarchical key derivation + EIP-2335 keystores + EIP-2334
+paths.
+
+Mirror of /root/reference/crypto/{eth2_key_derivation,eth2_keystore,
+eth2_wallet} (SURVEY.md §2.1): BLS key trees from a seed (HKDF_mod_r,
+Lamport parent->child), password-encrypted keystore JSON (scrypt or
+PBKDF2 + AES-128-CTR + sha256 checksum), and the m/12381/3600/i/0/0
+validator path convention.
+"""
+
+import hashlib
+import hmac
+import json
+import os
+import secrets
+import unicodedata
+import uuid
+
+from .constants import R
+
+_SALT0 = b"BLS-SIG-KEYGEN-SALT-"
+
+
+# ------------------------------------------------------------- HKDF core
+
+
+def _hkdf_extract(salt, ikm):
+    return hmac.new(salt, ikm, hashlib.sha256).digest()
+
+
+def _hkdf_expand(prk, info, length):
+    out = b""
+    t = b""
+    i = 1
+    while len(out) < length:
+        t = hmac.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
+        out += t
+        i += 1
+    return out[:length]
+
+
+def hkdf_mod_r(ikm, key_info=b""):
+    """EIP-2333 hkdf_mod_r — the salt is hashed at the TOP of every loop
+    iteration, so the first extract already uses sha256(SALT0)."""
+    salt = _SALT0
+    sk = 0
+    while sk == 0:
+        salt = hashlib.sha256(salt).digest()
+        prk = _hkdf_extract(salt, ikm + b"\x00")
+        okm = _hkdf_expand(prk, key_info + (48).to_bytes(2, "big"), 48)
+        sk = int.from_bytes(okm, "big") % R
+    return sk
+
+
+def derive_master_sk(seed: bytes) -> int:
+    assert len(seed) >= 32, "seed must be >= 32 bytes"
+    return hkdf_mod_r(seed)
+
+
+def _parent_sk_to_lamport_pk(parent_sk: int, index: int) -> bytes:
+    salt = index.to_bytes(4, "big")
+    ikm = parent_sk.to_bytes(32, "big")
+    lamport_0 = _hkdf_expand(_hkdf_extract(salt, ikm), b"", 255 * 32)
+    not_ikm = bytes(b ^ 0xFF for b in ikm)
+    lamport_1 = _hkdf_expand(_hkdf_extract(salt, not_ikm), b"", 255 * 32)
+    chunks = [
+        hashlib.sha256(lamport_0[i : i + 32]).digest() for i in range(0, 255 * 32, 32)
+    ] + [
+        hashlib.sha256(lamport_1[i : i + 32]).digest() for i in range(0, 255 * 32, 32)
+    ]
+    return hashlib.sha256(b"".join(chunks)).digest()
+
+
+def derive_child_sk(parent_sk: int, index: int) -> int:
+    return hkdf_mod_r(_parent_sk_to_lamport_pk(parent_sk, index))
+
+
+def derive_path(seed: bytes, path: str) -> int:
+    """EIP-2334 path derivation, e.g. 'm/12381/3600/0/0/0'."""
+    parts = path.split("/")
+    assert parts[0] == "m", "path must start at the master node"
+    sk = derive_master_sk(seed)
+    for p in parts[1:]:
+        sk = derive_child_sk(sk, int(p))
+    return sk
+
+
+def validator_keypairs_from_seed(seed: bytes, n: int):
+    """The standard m/12381/3600/i/0/0 voting-key paths."""
+    from .ref import bls as RB
+    from .ref.curves import g1_compress
+
+    out = []
+    for i in range(n):
+        sk = derive_path(seed, f"m/12381/3600/{i}/0/0")
+        out.append((sk, g1_compress(RB.sk_to_pk(sk))))
+    return out
+
+
+# ------------------------------------------------------------ EIP-2335
+
+
+def _aes128ctr(key16: bytes, iv16: bytes, data: bytes) -> bytes:
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+    cipher = Cipher(algorithms.AES(key16), modes.CTR(iv16))
+    enc = cipher.encryptor()
+    return enc.update(data) + enc.finalize()
+
+
+def _scrypt(password: bytes, salt: bytes, n, r, p, dklen):
+    return hashlib.scrypt(password, salt=salt, n=n, r=r, p=p, dklen=dklen,
+                          maxmem=2**31 - 1)
+
+
+def _normalize_password(password: str) -> bytes:
+    """EIP-2335: NFKD-normalize, strip C0/C1 control codes."""
+    norm = unicodedata.normalize("NFKD", password)
+    return "".join(
+        c for c in norm if not (ord(c) < 0x20 or 0x7F <= ord(c) < 0xA0)
+    ).encode()
+
+
+def encrypt_keystore(sk: int, password: str, path="", kdf="scrypt",
+                     light=False) -> dict:
+    """EIP-2335 keystore JSON (eth2_keystore encrypt)."""
+    from .ref import bls as RB
+    from .ref.curves import g1_compress
+
+    secret = sk.to_bytes(32, "big")
+    pw = _normalize_password(password)
+    salt = secrets.token_bytes(32)
+    iv = secrets.token_bytes(16)
+    if kdf == "scrypt":
+        n = 2**14 if light else 2**18
+        kdf_params = {"dklen": 32, "n": n, "r": 8, "p": 1, "salt": salt.hex()}
+        dk = _scrypt(pw, salt, n, 8, 1, 32)
+        kdf_module = {"function": "scrypt", "params": kdf_params, "message": ""}
+    else:
+        c = 2**12 if light else 262144
+        kdf_params = {"dklen": 32, "c": c, "prf": "hmac-sha256",
+                      "salt": salt.hex()}
+        dk = hashlib.pbkdf2_hmac("sha256", pw, salt, c, 32)
+        kdf_module = {"function": "pbkdf2", "params": kdf_params, "message": ""}
+
+    ciphertext = _aes128ctr(dk[:16], iv, secret)
+    checksum = hashlib.sha256(dk[16:32] + ciphertext).hexdigest()
+    pubkey = g1_compress(RB.sk_to_pk(sk)).hex()
+    return {
+        "crypto": {
+            "kdf": kdf_module,
+            "checksum": {"function": "sha256", "params": {},
+                         "message": checksum},
+            "cipher": {
+                "function": "aes-128-ctr",
+                "params": {"iv": iv.hex()},
+                "message": ciphertext.hex(),
+            },
+        },
+        "path": path,
+        "pubkey": pubkey,
+        "uuid": str(uuid.uuid4()),
+        "version": 4,
+    }
+
+
+class KeystoreError(Exception):
+    pass
+
+
+def decrypt_keystore(keystore: dict, password: str) -> int:
+    """EIP-2335 decrypt with checksum verification."""
+    crypto = keystore["crypto"]
+    pw = _normalize_password(password)
+    kdf = crypto["kdf"]
+    params = kdf["params"]
+    salt = bytes.fromhex(params["salt"])
+    if kdf["function"] == "scrypt":
+        dk = _scrypt(pw, salt, params["n"], params["r"], params["p"],
+                     params["dklen"])
+    elif kdf["function"] == "pbkdf2":
+        dk = hashlib.pbkdf2_hmac("sha256", pw, salt, params["c"],
+                                 params["dklen"])
+    else:
+        raise KeystoreError(f"unknown kdf {kdf['function']}")
+    ciphertext = bytes.fromhex(crypto["cipher"]["message"])
+    checksum = hashlib.sha256(dk[16:32] + ciphertext).hexdigest()
+    if checksum != crypto["checksum"]["message"]:
+        raise KeystoreError("wrong password (checksum mismatch)")
+    iv = bytes.fromhex(crypto["cipher"]["params"]["iv"])
+    secret = _aes128ctr(dk[:16], iv, ciphertext)
+    return int.from_bytes(secret, "big")
+
+
+def save_keystore(keystore: dict, directory: str) -> str:
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"keystore-{keystore['uuid']}.json")
+    with open(path, "w") as f:
+        json.dump(keystore, f)
+    return path
+
+
+def load_keystore(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)

@@ -1,0 +1,7 @@
+"""Slasher service (SURVEY.md §2.7 /root/reference/slasher, ~4.1k LoC):
+double-vote and surround-vote detection over batched attestation queues.
+"""
+
+from .slasher import Slasher, SlasherConfig
+
+__all__ = ["Slasher", "SlasherConfig"]

@@ -1,0 +1,142 @@
+"""Double-vote + surround-vote detection.
+
+Mirror of /root/reference/slasher/src/{lib,array,attestation_queue}.rs:
+attestations queue up and are processed in per-epoch batches; surround
+detection answers the two queries
+
+  * new surrounds old:  exists (s', t') with s < s'  and t' < t
+  * old surrounds new:  exists (s', t') with s' < s  and t < t'
+
+over a per-validator {target: source} span map bounded by the pruned
+history window (the reference's chunked on-disk min-max arrays make each
+query O(1) amortized; here the scan is bounded by history_length and the
+~1-vote-per-epoch-per-validator protocol rate).
+
+Double votes are exact: one stored attestation data root per
+(validator, target_epoch).  Proposer equivocation: one block root per
+(proposer, slot).  Detections produce the slashing objects the beacon
+node broadcasts and packs into blocks (slasher/service wiring).
+"""
+
+from collections import defaultdict
+from dataclasses import dataclass
+
+from ..ssz import hash_tree_root
+
+
+@dataclass
+class SlasherConfig:
+    history_length: int = 4096      # epochs of attestation history
+
+
+class Slasher:
+    def __init__(self, config=None):
+        self.config = config or SlasherConfig()
+        self.attestation_queue = []
+        self.block_queue = []
+        # (validator, target_epoch) -> (data_root, indexed_attestation)
+        self.attestations = {}
+        # validator -> {target_epoch: source_epoch}
+        self.spans = defaultdict(dict)
+        # (proposer, slot) -> (block_root, signed_header)
+        self.proposals = {}
+        self.attester_slashings = []
+        self.proposer_slashings = []
+
+    # ------------------------------------------------------------ queues
+
+    def accept_attestation(self, indexed_attestation):
+        """attestation_queue.rs: defer to the next batch."""
+        self.attestation_queue.append(indexed_attestation)
+
+    def accept_block_header(self, signed_header):
+        self.block_queue.append(signed_header)
+
+    def process_queued(self, current_epoch=None):
+        """One batch pass (the reference processes per epoch tick)."""
+        found = []
+        for att in self.attestation_queue:
+            found.extend(self._process_attestation(att))
+        self.attestation_queue.clear()
+        for header in self.block_queue:
+            s = self._process_block_header(header)
+            if s is not None:
+                found.append(s)
+        self.block_queue.clear()
+        if current_epoch is not None:
+            self._prune(current_epoch)
+        return found
+
+    # ------------------------------------------------------- attestations
+
+    def _process_attestation(self, indexed):
+        data = indexed.data
+        source = int(data.source.epoch)
+        target = int(data.target.epoch)
+        data_root = hash_tree_root(data)
+        out = []
+        for v in map(int, indexed.attesting_indices):
+            hit = self.attestations.get((v, target))
+            if hit is not None and hit[0] != data_root:
+                out.append(self._attester_slashing(hit[1], indexed))
+                continue
+            span = self.spans[v]
+            conflict = None
+            for t2, s2 in span.items():
+                # new surrounds old / old surrounds new
+                if (source < s2 and t2 < target) or (s2 < source and target < t2):
+                    conflict = (v, t2)
+                    break
+            if conflict is not None:
+                out.append(
+                    self._attester_slashing(
+                        self.attestations[conflict][1], indexed
+                    )
+                )
+                continue
+            self.attestations[(v, target)] = (data_root, indexed)
+            span[target] = source
+        return out
+
+    def _attester_slashing(self, att1, att2):
+        from ..types.containers import AttesterSlashing
+
+        slashing = AttesterSlashing(attestation_1=att1, attestation_2=att2)
+        self.attester_slashings.append(slashing)
+        return ("attester", slashing)
+
+    # ------------------------------------------------------------ blocks
+
+    def _process_block_header(self, signed_header):
+        h = signed_header.message
+        key = (int(h.proposer_index), int(h.slot))
+        root = hash_tree_root(h)
+        hit = self.proposals.get(key)
+        if hit is None:
+            self.proposals[key] = (root, signed_header)
+            return None
+        if hit[0] == root:
+            return None
+        from ..types.containers import ProposerSlashing
+
+        slashing = ProposerSlashing(
+            signed_header_1=hit[1], signed_header_2=signed_header
+        )
+        self.proposer_slashings.append(slashing)
+        return ("proposer", slashing)
+
+    # ------------------------------------------------------------- prune
+
+    def _prune(self, current_epoch):
+        horizon = current_epoch - self.config.history_length
+        if horizon <= 0:
+            return
+        self.attestations = {
+            k: v for k, v in self.attestations.items() if k[1] >= horizon
+        }
+        for v in list(self.spans):
+            self.spans[v] = {
+                t: s for t, s in self.spans[v].items() if t >= horizon
+            }
+            if not self.spans[v]:
+                del self.spans[v]

@@ -102,6 +102,31 @@ class DirectBeaconNode(BeaconNodeInterface):
                 )
         return duties
 
+    def proposer_duties(self, epoch):
+        """Every slot's proposer for an epoch (the beacon-APIs proposer
+        duties endpoint shape, unfiltered)."""
+        chain = self.chain
+        preset = chain.preset
+        state = chain.head_state
+        target = epoch * preset.slots_per_epoch
+        st = state.copy()
+        if int(st.slot) < target:
+            st = phase0.process_slots(st, target, preset, spec=chain.spec)
+        reg = st.validators
+        out = []
+        for slot in range(target, target + preset.slots_per_epoch):
+            if int(st.slot) < slot:
+                st = phase0.process_slots(st, slot, preset, spec=chain.spec)
+            proposer = phase0.get_beacon_proposer_index(st, preset)
+            out.append(
+                {
+                    "pubkey": reg.pubkey[proposer].tobytes(),
+                    "validator_index": proposer,
+                    "slot": slot,
+                }
+            )
+        return out
+
     def attestation_data(self, slot, committee_index):
         """produce_unaggregated_attestation (beacon_chain.rs:1555)."""
         chain = self.chain

@@ -1,0 +1,82 @@
+"""CLI + ClientBuilder + running node: the `lighthouse bn` analogue
+boots, serves the API, ticks slots, and shuts down cleanly
+(SURVEY.md §2.7 lighthouse bin, §5.6 config system)."""
+
+import json
+import subprocess
+import sys
+import time
+
+from lighthouse_tpu.api.client import BeaconApiClient
+from lighthouse_tpu.beacon.node import ClientBuilder
+from lighthouse_tpu.cli import build_parser, main
+from lighthouse_tpu.state_processing.genesis import (
+    interop_genesis_state,
+    interop_keypairs,
+)
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+SPEC = ChainSpec(preset=MinimalPreset)
+
+
+def test_client_builder_node_lifecycle():
+    state = interop_genesis_state(interop_keypairs(4), 0, SPEC)
+    node = (
+        ClientBuilder(SPEC)
+        .genesis_state(state)
+        .crypto_backend("fake")
+        .memory_store()
+        .http_api(port=0)
+        .slot_clock(ManualSlotClock(seconds_per_slot=SPEC.seconds_per_slot))
+        .build()
+        .start()
+    )
+    try:
+        client = BeaconApiClient(f"http://127.0.0.1:{node.api_server.port}")
+        assert client.health()
+        node.clock.advance_slot()
+        deadline = time.time() + 5
+        while time.time() < deadline and node.chain.current_slot < 1:
+            time.sleep(0.05)
+        assert node.chain.current_slot >= 1, "timer loop ticked the chain"
+    finally:
+        node.stop()
+    reason = node.executor.block_until_shutdown(timeout=1)
+    assert reason is not None and not reason.failure
+
+
+def test_cli_dump_config(capsys):
+    rc = main(["bn", "--network", "minimal", "--interop-validators", "4",
+               "--dump-config"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["network"] == "minimal"
+    assert out["interop_validators"] == 4
+
+
+def test_cli_am_validator_new_and_db_inspect(tmp_path, capsys):
+    rc = main([
+        "am", "validator-new",
+        "--seed-hex", "11" * 32,
+        "--count", "2",
+        "--out-dir", str(tmp_path / "vals"),
+        "--password", "pw",
+    ])
+    assert rc == 0
+    made = json.loads(capsys.readouterr().out)["created"]
+    assert len(made) == 2
+
+    rc = main(["db", "inspect", "--datadir", str(tmp_path)])
+    assert rc == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["blocks"] == 0
+
+
+def test_cli_config_file(tmp_path, capsys):
+    cfg = tmp_path / "flags.json"
+    cfg.write_text(json.dumps({"network": "minimal"}))
+    rc = main(["bn", "--config", str(cfg), "--interop-validators", "2",
+               "--dump-config"])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out)["network"] == "minimal"

@@ -1,0 +1,136 @@
+"""Beacon HTTP API server + typed client roundtrip (SURVEY.md §2.5
+http_api/http_metrics, §2.8 eth2 client)."""
+
+import pytest
+
+from lighthouse_tpu.api.client import ApiError, BeaconApiClient
+from lighthouse_tpu.api.http_api import BeaconApiServer
+from lighthouse_tpu.beacon.chain import BeaconChain
+from lighthouse_tpu.crypto.backend import SignatureVerifier
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.testing.harness import Harness
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+
+SPEC = ChainSpec(preset=MinimalPreset)
+
+
+@pytest.fixture(scope="module")
+def api():
+    h = Harness(8, SPEC)
+    chain = BeaconChain(h.state.copy(), SPEC, verifier=SignatureVerifier("fake"))
+    for _ in range(2):
+        slot = h.state.slot + 1
+        block = h.produce_block(slot)
+        h.process_block(block, strategy="no_verification")
+        chain.on_tick(slot)
+        chain.process_block(block)
+    server = BeaconApiServer(chain).start()
+    client = BeaconApiClient(f"http://127.0.0.1:{server.port}")
+    yield chain, client
+    server.stop()
+
+
+def test_health_and_version(api):
+    chain, client = api
+    assert client.health() is True
+    assert "lighthouse_tpu" in client.version()
+
+
+def test_genesis_info(api):
+    chain, client = api
+    g = client.genesis()
+    assert g["genesis_validators_root"] == "0x" + bytes(
+        chain.head_state.genesis_validators_root
+    ).hex()
+
+
+def test_state_root_and_finality(api):
+    chain, client = api
+    assert client.state_root("head") == hash_tree_root(chain.head_state)
+    fc = client.finality_checkpoints("head")
+    assert fc["finalized"]["epoch"] == "0"
+
+
+def test_validator_lookup_by_index_and_pubkey(api):
+    chain, client = api
+    v = client.validator(0)
+    assert v["index"] == "0"
+    pk = v["validator"]["pubkey"]
+    v2 = client.validator(pk)
+    assert v2["index"] == "0"
+    with pytest.raises(ApiError, match="404"):
+        client.validator(9999)
+
+
+def test_block_header_and_root(api):
+    chain, client = api
+    hdr = client.header("head")
+    assert bytes.fromhex(hdr["root"][2:]) == chain.head_root
+    assert client.block_root("head") == chain.head_root
+
+
+def test_attester_duties_roundtrip(api):
+    chain, client = api
+    pk = bytes.fromhex(client.validator(0)["validator"]["pubkey"][2:])
+    duties = client.attester_duties(0, [pk])
+    assert duties, "validator 0 has a duty in epoch 0"
+    assert duties[0]["pubkey"] == "0x" + pk.hex()
+
+
+def test_attestation_data(api):
+    chain, client = api
+    data = client.attestation_data(2, 0)
+    assert data["slot"] == "2"
+
+
+def test_metrics_scrape(api):
+    chain, client = api
+    text = client.metrics()
+    assert "beacon_block_processing_seconds" in text
+    assert "_bucket" in text
+
+
+def test_numeric_state_and_block_ids(api):
+    chain, client = api
+    assert client.block_root("2") == chain.head_root
+    assert client.state_root("0") is not None
+    hdr = client.header("1")
+    assert hdr["header"]["message"]["slot"] == "1"
+
+
+def test_validator_id_validation(api):
+    chain, client = api
+    with pytest.raises(ApiError, match="400"):
+        client.validator("abc")
+    with pytest.raises(ApiError, match="400"):
+        client.validator("-1")   # negative ids are not valid validator ids
+
+
+def test_proposer_duties_route(api):
+    chain, client = api
+    import json as _json
+    import urllib.request
+
+    url = client.base + "/eth/v1/validator/duties/proposer/0"
+    with urllib.request.urlopen(url, timeout=5) as r:
+        data = _json.loads(r.read())["data"]
+    assert len(data) == SPEC.preset.slots_per_epoch
+    assert all("pubkey" in d for d in data)
+
+
+def test_malformed_post_body_is_400(api):
+    chain, client = api
+    import urllib.request
+    from urllib.error import HTTPError
+
+    req = urllib.request.Request(
+        client.base + "/eth/v1/validator/duties/attester/0",
+        data=b"not-json",
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        urllib.request.urlopen(req, timeout=5)
+        raise AssertionError("expected 400")
+    except HTTPError as e:
+        assert e.code == 400

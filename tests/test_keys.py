@@ -1,0 +1,67 @@
+"""EIP-2333 derivation + EIP-2335 keystore tests.
+
+The EIP-2333 known-answer vector (test case 0 from the EIP) pins the
+derivation against the published spec; keystore tests cover roundtrip,
+wrong-password rejection, and both KDFs.
+"""
+
+import pytest
+
+from lighthouse_tpu.crypto import keys
+
+
+# EIP-2333 published test case: seed from the EIP's test vectors
+EIP2333_SEED = bytes.fromhex(
+    "c55257c360c07c72029aebc1b53c05ed0362ada38ead3e3e9efa3708e5349553"
+    "1f09a6987599d18264c1e1c92f2cf141630c7a3c4ab7c81b2f001698e7463b04"
+)
+EIP2333_MASTER_SK = 6083874454709270928345386274498605044986640685124978867557563392430687146096
+EIP2333_CHILD_INDEX = 0
+EIP2333_CHILD_SK = 20397789859736650942317412262472558107875392172444076792671091975210932703118
+
+
+def test_eip2333_known_answer():
+    master = keys.derive_master_sk(EIP2333_SEED)
+    assert master == EIP2333_MASTER_SK
+    child = keys.derive_child_sk(master, EIP2333_CHILD_INDEX)
+    assert child == EIP2333_CHILD_SK
+
+
+def test_derive_path_and_determinism():
+    seed = b"\x01" * 32
+    sk1 = keys.derive_path(seed, "m/12381/3600/0/0/0")
+    sk2 = keys.derive_path(seed, "m/12381/3600/0/0/0")
+    sk3 = keys.derive_path(seed, "m/12381/3600/1/0/0")
+    assert sk1 == sk2 != sk3
+    assert 0 < sk1 < keys.R
+
+
+def test_validator_keypairs_from_seed():
+    pairs = keys.validator_keypairs_from_seed(b"\x02" * 32, 3)
+    assert len(pairs) == 3
+    assert len({pk for _, pk in pairs}) == 3
+    assert all(len(pk) == 48 for _, pk in pairs)
+
+
+@pytest.mark.parametrize("kdf", ["scrypt", "pbkdf2"])
+def test_keystore_roundtrip(kdf):
+    sk = 123456789012345678901234567890
+    ks = keys.encrypt_keystore(sk, "correct horse", kdf=kdf, light=True)
+    assert ks["version"] == 4
+    assert keys.decrypt_keystore(ks, "correct horse") == sk
+    with pytest.raises(keys.KeystoreError, match="checksum"):
+        keys.decrypt_keystore(ks, "wrong password")
+
+
+def test_keystore_password_normalization():
+    sk = 42
+    # NFKD normalization + control-char stripping (EIP-2335 test behavior)
+    ks = keys.encrypt_keystore(sk, "paÅss", light=True)  # Å angstrom sign
+    assert keys.decrypt_keystore(ks, "paÅss") == sk      # Å composed
+
+
+def test_keystore_file_roundtrip(tmp_path):
+    sk = 7777
+    ks = keys.encrypt_keystore(sk, "pw", light=True)
+    path = keys.save_keystore(ks, str(tmp_path))
+    assert keys.decrypt_keystore(keys.load_keystore(path), "pw") == sk

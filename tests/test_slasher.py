@@ -1,0 +1,95 @@
+"""Slasher: double votes, surround votes (both directions), proposer
+equivocation, pruning (SURVEY.md §2.7)."""
+
+from lighthouse_tpu.slasher import Slasher
+from lighthouse_tpu.types import MinimalPreset
+from lighthouse_tpu.types.containers import (
+    AttestationData,
+    BeaconBlockHeader,
+    Checkpoint,
+    SignedBeaconBlockHeader,
+)
+from lighthouse_tpu.types.state import state_types
+
+T = state_types(MinimalPreset)
+
+
+def _att(indices, source, target, root=b"\x01" * 32):
+    return T.IndexedAttestation(
+        attesting_indices=list(indices),
+        data=AttestationData(
+            slot=target * 8,
+            index=0,
+            beacon_block_root=root,
+            source=Checkpoint(epoch=source),
+            target=Checkpoint(epoch=target, root=root),
+        ),
+        signature=b"\x00" * 96,
+    )
+
+
+def _header(proposer, slot, state_root=b"\x00" * 32):
+    return SignedBeaconBlockHeader(
+        message=BeaconBlockHeader(
+            slot=slot, proposer_index=proposer, state_root=state_root
+        ),
+        signature=b"\x00" * 96,
+    )
+
+
+def test_double_vote_detected():
+    s = Slasher()
+    s.accept_attestation(_att([3], 1, 2, root=b"\x01" * 32))
+    s.accept_attestation(_att([3], 1, 2, root=b"\x02" * 32))
+    found = s.process_queued()
+    assert len(found) == 1 and found[0][0] == "attester"
+
+
+def test_same_vote_not_slashable():
+    s = Slasher()
+    s.accept_attestation(_att([3], 1, 2))
+    s.accept_attestation(_att([3], 1, 2))
+    assert s.process_queued() == []
+
+
+def test_surround_detected_both_directions():
+    s = Slasher()
+    s.accept_attestation(_att([5], 3, 4))
+    s.accept_attestation(_att([5], 2, 5))    # new surrounds old
+    found = s.process_queued()
+    assert len(found) == 1
+
+    s2 = Slasher()
+    s2.accept_attestation(_att([5], 2, 5))
+    s2.accept_attestation(_att([5], 3, 4))   # old surrounds new
+    assert len(s2.process_queued()) == 1
+
+
+def test_disjoint_votes_fine():
+    s = Slasher()
+    for e in range(1, 6):
+        s.accept_attestation(_att([7], e, e + 1))
+    assert s.process_queued() == []
+
+
+def test_proposer_equivocation():
+    s = Slasher()
+    s.accept_block_header(_header(2, 9, state_root=b"\x01" * 32))
+    s.accept_block_header(_header(2, 9, state_root=b"\x02" * 32))
+    found = s.process_queued()
+    assert len(found) == 1 and found[0][0] == "proposer"
+    # same header twice is not equivocation
+    s2 = Slasher()
+    s2.accept_block_header(_header(2, 9))
+    s2.accept_block_header(_header(2, 9))
+    assert s2.process_queued() == []
+
+
+def test_pruning_drops_old_history():
+    s = Slasher()
+    s.accept_attestation(_att([1], 1, 2))
+    s.process_queued()
+    assert (1, 2) in s.attestations
+    s.process_queued(current_epoch=s.config.history_length + 10)
+    assert (1, 2) not in s.attestations
+    assert 1 not in s.spans
