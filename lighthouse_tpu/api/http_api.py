@@ -129,6 +129,9 @@ class _Handler(BaseHTTPRequestHandler):
         path, q = url.path.rstrip("/"), parse_qs(url.query)
         try:
             return self._route_get(path, q)
+        except (ValueError, KeyError) as e:
+            # malformed ids / missing or non-numeric query params
+            self._err(400, f"bad request: {e}")
         except Exception as e:  # route errors surface as 500s, not crashes
             self._err(500, str(e))
 

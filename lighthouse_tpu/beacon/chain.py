@@ -72,9 +72,11 @@ class BeaconChain:
         store=None,
         verifier=None,
         pubkey_cache_path=None,
+        execution_engine=None,
     ):
         self.spec = spec
         self.preset = spec.preset
+        self.execution_engine = execution_engine
         self.verifier = verifier or SignatureVerifier("oracle")
         self.op_pool = OperationPool(spec)
         self.pubkey_cache = ValidatorPubkeyCache(
@@ -117,18 +119,43 @@ class BeaconChain:
         self.head_root = genesis_root
         self.head_state = genesis_state.copy()
 
-        # gossip duplicate filters (observed_{block_producers,attesters}.rs)
+        # gossip duplicate filters (observed_{block_producers,attesters,
+        # aggregates}.rs and sync-committee equivalents)
         self.observed_block_producers = set()   # (slot, proposer)
         self.observed_attesters = set()         # (target_epoch, validator)
+        self.observed_aggregators = set()       # (target_epoch, aggregator)
+        self.observed_sync_contributors = set()  # (slot, validator)
+
+        from .sync_pool import SyncContributionPool
+
+        self.sync_pool = SyncContributionPool(spec)
 
         self.current_slot = int(genesis_state.slot)
 
     # ------------------------------------------------------------- clock
 
     def on_tick(self, slot):
-        """timer/src/lib.rs per_slot_task: advance wall-clock slot."""
+        """timer/src/lib.rs per_slot_task: advance wall-clock slot and
+        prune the bounded gossip caches."""
         self.current_slot = max(self.current_slot, int(slot))
         self.fork_choice.on_tick(self.current_slot)
+        self.sync_pool.prune(self.current_slot)
+        # observed-* filters only matter for current/previous epoch
+        horizon_epoch = self.current_slot // self.preset.slots_per_epoch - 2
+        horizon_slot = self.current_slot - 2 * self.preset.slots_per_epoch
+        if horizon_epoch > 0:
+            self.observed_attesters = {
+                k for k in self.observed_attesters if k[0] >= horizon_epoch
+            }
+            self.observed_aggregators = {
+                k for k in self.observed_aggregators if k[0] >= horizon_epoch
+            }
+            self.observed_sync_contributors = {
+                k for k in self.observed_sync_contributors if k[0] >= horizon_slot
+            }
+            self.observed_block_producers = {
+                k for k in self.observed_block_producers if k[0] >= horizon_slot
+            }
 
     # --------------------------------------------------- block pipeline
 
@@ -225,10 +252,11 @@ class BeaconChain:
                     self.spec,
                     signature_strategy=BlockSignatureStrategy.VERIFY_BULK,
                     collected_sets=sets,
+                    execution_engine=self.execution_engine,
                 )
             except sset.SignatureSetError as e:
                 raise BlockError(f"undecodable signature in block: {e}") from e
-            except AssertionError as e:
+            except (AssertionError, phase0.BlockProcessingError) as e:
                 raise BlockError(f"invalid block: {e}") from e
             if not self.verifier.verify_signature_sets(sets):
                 raise BlockError("bulk signature verification failed")
@@ -291,6 +319,7 @@ class BeaconChain:
                 self.spec,
                 signature_strategy=BlockSignatureStrategy.VERIFY_BULK,
                 collected_sets=sets,
+                execution_engine=self.execution_engine,
             )
             states.append(state.copy())
         with metrics.BLOCK_SIGNATURE_VERIFY_TIMES.start_timer():
@@ -337,10 +366,11 @@ class BeaconChain:
         results = []
         sets = []
         set_owners = []
+        epoch_states = {}
         with metrics.ATTESTATION_BATCH_SETUP_TIMES.start_timer():
             for att in attestations:
                 try:
-                    indexed, s = self._index_and_set(att)
+                    indexed, s = self._index_and_set(att, epoch_states)
                 except AttestationError as e:
                     results.append([att, None, e])
                     continue
@@ -372,27 +402,18 @@ class BeaconChain:
             self.op_pool.insert_attestation(att)
         return [tuple(r) for r in results]
 
-    def _index_and_set(self, att):
+    def _index_and_set(self, att, epoch_states=None):
         """IndexedUnaggregatedAttestation::verify equivalents: committee
         lookup + structural checks + duplicate filter, then the signature
         set (no BLS here)."""
         data = att.data
-        head_state = self.head_state
         target_epoch = int(data.target.epoch)
         current_epoch = self.current_slot // self.preset.slots_per_epoch
         if target_epoch not in (current_epoch, max(current_epoch - 1, 0)):
             raise AttestationError("target epoch not current or previous")
         if not self.fork_choice.contains_block(bytes(data.beacon_block_root)):
             raise AttestationError("unknown head block")
-        state = head_state
-        if target_epoch * self.preset.slots_per_epoch > int(state.slot):
-            state = state.copy()
-            state = phase0.process_slots(
-                state,
-                target_epoch * self.preset.slots_per_epoch,
-                self.preset,
-                spec=self.spec,
-            )
+        state = self._state_for_epoch(target_epoch, epoch_states)
         try:
             indexed = phase0.get_indexed_attestation(state, att, self.preset)
         except AssertionError as e:
@@ -412,6 +433,174 @@ class BeaconChain:
             raise AttestationError(f"undecodable signature: {e}") from e
         return indexed, s
 
+    # ------------------------------------------- gossip aggregate batch
+
+    def batch_verify_aggregated_attestations(self, signed_aggregates):
+        """attestation_verification/batch.rs:31-134: for each
+        SignedAggregateAndProof three sets — selection proof, aggregator
+        signature, aggregate attestation — verified in ONE device batch
+        (<=3N sets), per-set fallback on poisoning."""
+        import hashlib
+
+        results = []
+        sets = []
+        owners = []
+        batch_seen = set()   # same-batch duplicate-aggregator guard
+        epoch_states = {}    # one advanced state per target epoch per batch
+        with metrics.ATTESTATION_BATCH_SETUP_TIMES.start_timer():
+            for sa in signed_aggregates:
+                key = (
+                    int(sa.message.aggregate.data.target.epoch),
+                    int(sa.message.aggregator_index),
+                )
+                try:
+                    if key in batch_seen:
+                        raise AttestationError(
+                            "duplicate aggregator within batch"
+                        )
+                    indexed, triple = self._index_aggregate(sa, epoch_states)
+                except AttestationError as e:
+                    results.append([sa, None, e])
+                    continue
+                batch_seen.add(key)
+                results.append([sa, indexed, None])
+                owners.append((len(results) - 1, len(sets), len(triple)))
+                sets.extend(triple)
+
+        if sets:
+            with metrics.ATTESTATION_BATCH_VERIFY_TIMES.start_timer():
+                ok = self.verifier.verify_signature_sets(sets)
+            if not ok:
+                verdicts = self.verifier.verify_signature_sets_per_set(sets)
+                for owner, start, count in owners:
+                    if not all(verdicts[start : start + count]):
+                        results[owner][1] = None
+                        results[owner][2] = AttestationError("invalid signature")
+
+        for sa, indexed, err in results:
+            if err is not None or indexed is None:
+                continue
+            agg = sa.message
+            self.observed_aggregators.add(
+                (int(agg.aggregate.data.target.epoch), int(agg.aggregator_index))
+            )
+            try:
+                self.fork_choice.on_attestation(self.current_slot, indexed)
+            except InvalidAttestation:
+                pass
+            self.op_pool.insert_attestation(agg.aggregate)
+        return [tuple(r) for r in results]
+
+    def _index_aggregate(self, signed_aggregate, epoch_states=None):
+        """VerifiedAggregatedAttestation checks: aggregator in committee,
+        selection proof makes it an aggregator, duplicate filter, then the
+        three signature sets."""
+        agg = signed_aggregate.message
+        att = agg.aggregate
+        data = att.data
+        target_epoch = int(data.target.epoch)
+        current_epoch = self.current_slot // self.preset.slots_per_epoch
+        if target_epoch not in (current_epoch, max(current_epoch - 1, 0)):
+            raise AttestationError("target epoch not current or previous")
+        if not self.fork_choice.contains_block(bytes(data.beacon_block_root)):
+            raise AttestationError("unknown head block")
+        key = (target_epoch, int(agg.aggregator_index))
+        if key in self.observed_aggregators:
+            raise AttestationError("aggregator already seen this epoch")
+
+        state = self._state_for_epoch(target_epoch, epoch_states)
+        committee = phase0.get_beacon_committee(
+            state, int(data.slot), int(data.index), self.preset
+        )
+        if int(agg.aggregator_index) not in committee:
+            raise AttestationError("aggregator not in committee")
+        if not self._is_aggregator(len(committee), bytes(agg.selection_proof)):
+            raise AttestationError("selection proof does not select aggregator")
+        try:
+            indexed = phase0.get_indexed_attestation(state, att, self.preset)
+        except AssertionError as e:
+            raise AttestationError(f"cannot index: {e}")
+        try:
+            gp = self.pubkey_cache.as_get_pubkey()
+            triple = [
+                sset.signed_aggregate_selection_proof_signature_set(
+                    gp, signed_aggregate, state.fork,
+                    state.genesis_validators_root, self.spec,
+                ),
+                sset.signed_aggregate_signature_set(
+                    gp, signed_aggregate, state.fork,
+                    state.genesis_validators_root, self.spec,
+                ),
+                sset.indexed_attestation_signature_set(
+                    gp, indexed, state.fork,
+                    state.genesis_validators_root, self.spec,
+                ),
+            ]
+        except sset.SignatureSetError as e:
+            raise AttestationError(f"undecodable signature: {e}") from e
+        return indexed, triple
+
+    def _state_for_epoch(self, target_epoch, cache=None):
+        """Head state advanced to the target epoch's start — the expensive
+        epoch transition runs at most ONCE per epoch per batch (batch.rs
+        leans on committee caches for the same reason)."""
+        if cache is not None and target_epoch in cache:
+            return cache[target_epoch]
+        state = self.head_state
+        if target_epoch * self.preset.slots_per_epoch > int(state.slot):
+            state = state.copy()
+            state = phase0.process_slots(
+                state,
+                target_epoch * self.preset.slots_per_epoch,
+                self.preset,
+                spec=self.spec,
+            )
+        if cache is not None:
+            cache[target_epoch] = state
+        return state
+
+    @staticmethod
+    def _is_aggregator(committee_length, selection_proof):
+        """Spec is_aggregator: hash(proof) mod max(1, len/16) == 0."""
+        import hashlib
+
+        modulo = max(1, committee_length // 16)
+        h = hashlib.sha256(selection_proof).digest()
+        return int.from_bytes(h[:8], "little") % modulo == 0
+
+    # ----------------------------------------- sync committee messages
+
+    def verify_sync_committee_message(self, message):
+        """sync_committee_verification.rs: duplicate filter, committee
+        membership, single-pubkey signature check; accepted messages feed
+        the contribution pool."""
+        from ..state_processing import altair
+
+        state = self.head_state
+        if not altair.is_altair_state(state):
+            raise AttestationError("pre-altair state has no sync committee")
+        vi = int(message.validator_index)
+        key = (int(message.slot), vi)
+        if key in self.observed_sync_contributors:
+            raise AttestationError("duplicate sync message")
+        committee_indices = altair.sync_committee_validator_indices(
+            state, self.preset
+        )
+        if vi not in committee_indices:
+            raise AttestationError("not in current sync committee")
+        s = sset.sync_committee_message_set_from_pubkeys(
+            self.pubkey_cache.get(vi),
+            message,
+            state.fork,
+            state.genesis_validators_root,
+            self.spec,
+        )
+        if not self.verifier.verify_signature_sets([s]):
+            raise AttestationError("invalid sync message signature")
+        self.observed_sync_contributors.add(key)
+        self.sync_pool.insert_message(message, committee_indices)
+        return True
+
     # ------------------------------------------------------------- head
 
     def recompute_head(self):
@@ -423,7 +612,24 @@ class BeaconChain:
             state = self.store.get_state(head_root)
             if state is not None:
                 self.head_state = state.copy()
+            # engine fcU on head change (execution_layer forkchoiceUpdated)
+            if self.execution_engine is not None and hasattr(
+                self.head_state, "latest_execution_payload_header"
+            ):
+                self.execution_engine.notify_forkchoice_updated(
+                    bytes(
+                        self.head_state.latest_execution_payload_header.block_hash
+                    ),
+                    bytes(32),
+                )
         return self.head_root
+
+    def on_invalid_execution_payload(self, block_root):
+        """execution-layer invalidation (fork_revert.rs +
+        proto_array InvalidateOne): mark the block and its descendants
+        invalid and re-elect the head."""
+        self.fork_choice.proto.invalidate_block(bytes(block_root))
+        return self.recompute_head()
 
     # ------------------------------------------------------- production
 
@@ -450,14 +656,30 @@ class BeaconChain:
             attester_slashings=att_slashings,
             voluntary_exits=exits,
         )
+        bellatrix = hasattr(state, "latest_execution_payload_header")
+        capella = hasattr(state, "next_withdrawal_index")
         if altair:
-            # empty-participation aggregate with the INFINITY signature is
-            # vacuously valid (signature_sets.rs:611-617); a sync-committee
-            # pool fills in real contributions when present
-            body_kwargs["sync_aggregate"] = T.SyncAggregate(
-                sync_committee_bits=[0] * self.preset.sync_committee_size,
-                sync_committee_signature=bytes([0xC0]) + bytes(95),
+            # sync messages created at slot-1 voted for this block's parent;
+            # the pool returns the vacuously-valid infinity aggregate
+            # (signature_sets.rs:611-617) when no contributions landed
+            parent_root = hash_tree_root(state.latest_block_header)
+            body_kwargs["sync_aggregate"] = self.sync_pool.get_sync_aggregate(
+                slot - 1, parent_root, T
             )
+        if bellatrix:
+            body_kwargs["execution_payload"] = self._production_payload(
+                state, randao_reveal, capella
+            )
+        if capella:
+            body_kwargs["bls_to_execution_changes"] = []
+            body = T.BeaconBlockBodyCapella(**body_kwargs)
+            block_cls, signed_cls = T.BeaconBlockCapella, T.SignedBeaconBlockCapella
+        elif bellatrix:
+            body = T.BeaconBlockBodyBellatrix(**body_kwargs)
+            block_cls, signed_cls = (
+                T.BeaconBlockBellatrix, T.SignedBeaconBlockBellatrix,
+            )
+        elif altair:
             body = T.BeaconBlockBodyAltair(**body_kwargs)
             block_cls = T.BeaconBlockAltair
             signed_cls = T.SignedBeaconBlockAltair
@@ -480,6 +702,15 @@ class BeaconChain:
             signed_cls(message=block),
             self.spec,
             signature_strategy=BlockSignatureStrategy.NO_VERIFICATION,
+            execution_engine=self.execution_engine,
         )
         block.state_root = hash_tree_root(tmp)
         return block, state
+
+    def _production_payload(self, state, randao_reveal, capella):
+        """getPayload through the engine (execution_layer get_payload)."""
+        from ..state_processing import bellatrix as bx
+
+        if self.execution_engine is None:
+            raise BlockError("no execution engine configured for production")
+        return bx.produce_payload(state, self.spec, self.execution_engine, capella)

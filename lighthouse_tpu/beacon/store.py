@@ -171,28 +171,60 @@ _META = b"meta:"
 
 
 class _Codec:
-    """Fork-aware SSZ (de)serialization for blocks and states."""
+    """Fork-aware SSZ (de)serialization for blocks and states (the
+    reference's multi-fork container-enum dispatch, one id byte on disk:
+    0=phase0 1=altair 2=bellatrix 3=capella)."""
 
     def __init__(self, preset):
         self.T = state_types(preset)
+        T = self.T
+        self._block_cls = [
+            T.SignedBeaconBlock,
+            T.SignedBeaconBlockAltair,
+            T.SignedBeaconBlockBellatrix,
+            T.SignedBeaconBlockCapella,
+        ]
+        self._state_cls = [
+            T.BeaconState,
+            T.BeaconStateAltair,
+            T.BeaconStateBellatrix,
+            T.BeaconStateCapella,
+        ]
+
+    @staticmethod
+    def _block_fid(signed_block):
+        body = signed_block.message.body
+        if hasattr(body, "bls_to_execution_changes"):
+            return 3
+        if hasattr(body, "execution_payload"):
+            return 2
+        if hasattr(body, "sync_aggregate"):
+            return 1
+        return 0
+
+    @staticmethod
+    def _state_fid(state):
+        if hasattr(state, "next_withdrawal_index"):
+            return 3
+        if hasattr(state, "latest_execution_payload_header"):
+            return 2
+        if hasattr(state, "previous_epoch_participation"):
+            return 1
+        return 0
 
     def enc_block(self, signed_block):
-        fid = 1 if hasattr(signed_block.message.body, "sync_aggregate") else 0
-        cls = self.T.SignedBeaconBlockAltair if fid else self.T.SignedBeaconBlock
-        return bytes([fid]) + encode(cls, signed_block)
+        fid = self._block_fid(signed_block)
+        return bytes([fid]) + encode(self._block_cls[fid], signed_block)
 
     def dec_block(self, blob):
-        cls = self.T.SignedBeaconBlockAltair if blob[0] else self.T.SignedBeaconBlock
-        return decode(cls, blob[1:])
+        return decode(self._block_cls[blob[0]], blob[1:])
 
     def enc_state(self, state):
-        fid = 1 if hasattr(state, "previous_epoch_participation") else 0
-        cls = self.T.BeaconStateAltair if fid else self.T.BeaconState
-        return bytes([fid]) + encode(cls, state)
+        fid = self._state_fid(state)
+        return bytes([fid]) + encode(self._state_cls[fid], state)
 
     def dec_state(self, blob):
-        cls = self.T.BeaconStateAltair if blob[0] else self.T.BeaconState
-        return decode(cls, blob[1:])
+        return decode(self._state_cls[blob[0]], blob[1:])
 
 
 class MemoryStore:

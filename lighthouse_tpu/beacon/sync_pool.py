@@ -1,0 +1,61 @@
+"""Sync-committee message aggregation pool.
+
+Mirror of the reference's naive sync aggregation + op-pool sync
+contributions (naive_aggregation_pool.rs SyncContribution flavor,
+operation_pool sync_aggregate packing): verified sync-committee messages
+accumulate per (slot, beacon_block_root); block production asks for the
+best SyncAggregate for its parent root.
+"""
+
+from collections import defaultdict
+
+from ..crypto.ref import bls as RB
+from ..crypto.ref.curves import g2_compress, g2_decompress
+
+_INFINITY_SIG = bytes([0xC0]) + bytes(95)
+
+
+class SyncContributionPool:
+    def __init__(self, spec):
+        self.spec = spec
+        self.preset = spec.preset
+        # (slot, block_root) -> {committee_position: signature_bytes}
+        self._messages = defaultdict(dict)
+
+    def insert_message(self, message, committee_indices):
+        """Record one verified SyncCommitteeMessage for every committee
+        position its validator occupies (a validator can hold several)."""
+        vi = int(message.validator_index)
+        key = (int(message.slot), bytes(message.beacon_block_root))
+        for pos, committee_vi in enumerate(committee_indices):
+            if committee_vi == vi:
+                self._messages[key][pos] = bytes(message.signature)
+
+    def get_sync_aggregate(self, slot, block_root, T):
+        """Best aggregate for (slot, root); infinity aggregate if empty."""
+        size = self.preset.sync_committee_size
+        entry = self._messages.get((int(slot), bytes(block_root)), {})
+        bits = [0] * size
+        sigs = []
+        for pos, sig in entry.items():
+            bits[pos] = 1
+            sigs.append(g2_decompress(sig, subgroup_check=False))
+        if not sigs:
+            return T.SyncAggregate(
+                sync_committee_bits=bits,
+                sync_committee_signature=_INFINITY_SIG,
+            )
+        return T.SyncAggregate(
+            sync_committee_bits=bits,
+            sync_committee_signature=g2_compress(RB.aggregate(sigs)),
+        )
+
+    def prune(self, current_slot):
+        self._messages = defaultdict(
+            dict,
+            {
+                k: v
+                for k, v in self._messages.items()
+                if k[0] >= current_slot - 2
+            },
+        )

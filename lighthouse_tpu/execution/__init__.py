@@ -1,0 +1,12 @@
+"""Execution layer (SURVEY.md §2.5 execution_layer, ~8.7k LoC): the
+engine-API seam (newPayload / forkchoiceUpdated / getPayload) and the
+in-memory mock execution engine used by every beacon-chain test
+(/root/reference/beacon_node/execution_layer/src/test_utils/)."""
+
+from .engine import (
+    ExecutionEngine,
+    MockExecutionEngine,
+    PayloadStatus,
+)
+
+__all__ = ["ExecutionEngine", "MockExecutionEngine", "PayloadStatus"]

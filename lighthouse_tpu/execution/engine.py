@@ -1,0 +1,149 @@
+"""Engine API seam + mock execution engine.
+
+Mirror of /root/reference/beacon_node/execution_layer: the engine-API
+client surface (`notify_new_payload` -> newPayload, `notify_forkchoice_
+updated` -> forkchoiceUpdated, `get_payload` -> getPayload; JSON-RPC with
+JWT auth in production) and the test double
+(execution_layer/src/test_utils/ ExecutionBlockGenerator + handle_rpc):
+an in-memory EL chain with consistent parent-hash links whose payloads
+the beacon chain builds on, plus invalid-payload injection for optimistic-
+sync/invalidation tests.
+"""
+
+import hashlib
+from dataclasses import dataclass
+
+
+class PayloadStatus:
+    VALID = "VALID"
+    INVALID = "INVALID"
+    SYNCING = "SYNCING"
+
+
+class ExecutionEngine:
+    """What the beacon chain needs from an EL (engine_api.rs)."""
+
+    def notify_new_payload(self, payload) -> str:
+        raise NotImplementedError
+
+    def notify_forkchoice_updated(self, head_hash, finalized_hash) -> str:
+        raise NotImplementedError
+
+    def get_payload(self, parent_hash, timestamp, prev_randao,
+                    fee_recipient=b"\x00" * 20, withdrawals=None):
+        raise NotImplementedError
+
+
+@dataclass
+class _ElBlock:
+    block_hash: bytes
+    parent_hash: bytes
+    block_number: int
+    timestamp: int
+
+
+class MockExecutionEngine(ExecutionEngine):
+    """ExecutionBlockGenerator: deterministic payload production and
+    validation against the internal chain."""
+
+    TERMINAL_HASH = b"\x00" * 32
+
+    def __init__(self, T, capella=False):
+        self.T = T
+        self.capella = capella
+        genesis = _ElBlock(
+            block_hash=hashlib.sha256(b"el-genesis").digest(),
+            parent_hash=self.TERMINAL_HASH,
+            block_number=0,
+            timestamp=0,
+        )
+        self.blocks = {genesis.block_hash: genesis}
+        self.genesis_hash = genesis.block_hash
+        self.head_hash = genesis.block_hash
+        self.finalized_hash = genesis.block_hash
+        self.invalid_hashes = set()     # injected failures
+        self.syncing = False
+
+    # ------------------------------------------------------------ engine
+
+    def notify_new_payload(self, payload) -> str:
+        if self.syncing:
+            return PayloadStatus.SYNCING
+        block_hash = bytes(payload.block_hash)
+        if block_hash in self.invalid_hashes:
+            return PayloadStatus.INVALID
+        parent = self.blocks.get(bytes(payload.parent_hash))
+        if parent is None:
+            return PayloadStatus.SYNCING    # unknown ancestry: optimistic
+        if int(payload.block_number) != parent.block_number + 1:
+            return PayloadStatus.INVALID
+        if self._hash_payload(payload) != block_hash:
+            return PayloadStatus.INVALID
+        self.blocks[block_hash] = _ElBlock(
+            block_hash=block_hash,
+            parent_hash=bytes(payload.parent_hash),
+            block_number=int(payload.block_number),
+            timestamp=int(payload.timestamp),
+        )
+        return PayloadStatus.VALID
+
+    def notify_forkchoice_updated(self, head_hash, finalized_hash) -> str:
+        if bytes(head_hash) in self.invalid_hashes:
+            return PayloadStatus.INVALID
+        if bytes(head_hash) not in self.blocks:
+            return PayloadStatus.SYNCING
+        self.head_hash = bytes(head_hash)
+        self.finalized_hash = bytes(finalized_hash)
+        return PayloadStatus.VALID
+
+    def get_payload(self, parent_hash, timestamp, prev_randao,
+                    fee_recipient=b"\x00" * 20, withdrawals=None):
+        parent = self.blocks[bytes(parent_hash)]
+        kwargs = dict(
+            parent_hash=bytes(parent_hash),
+            fee_recipient=bytes(fee_recipient),
+            state_root=hashlib.sha256(b"el-state" + bytes(parent_hash)).digest(),
+            receipts_root=bytes(32),
+            logs_bloom=bytes(256),
+            prev_randao=bytes(prev_randao),
+            block_number=parent.block_number + 1,
+            gas_limit=30_000_000,
+            gas_used=21_000,
+            timestamp=int(timestamp),
+            extra_data=b"lighthouse_tpu-mock-el",
+            base_fee_per_gas=7,
+            block_hash=bytes(32),
+            transactions=[],
+        )
+        if self.capella:
+            kwargs["withdrawals"] = list(withdrawals or [])
+            payload = self.T.ExecutionPayloadCapella(**kwargs)
+        else:
+            payload = self.T.ExecutionPayload(**kwargs)
+        payload.block_hash = self._hash_payload(payload)
+        # the EL knows the blocks it built (payload cache) — a later
+        # getPayload on top of this one must find its parent
+        self.blocks[bytes(payload.block_hash)] = _ElBlock(
+            block_hash=bytes(payload.block_hash),
+            parent_hash=bytes(parent_hash),
+            block_number=parent.block_number + 1,
+            timestamp=int(timestamp),
+        )
+        return payload
+
+    # ----------------------------------------------------------- helpers
+
+    def _hash_payload(self, payload):
+        """Stand-in for keccak block-hash verification (block_hash.rs):
+        deterministic over the payload's identity fields."""
+        h = hashlib.sha256()
+        for f in ("parent_hash", "state_root", "prev_randao"):
+            h.update(bytes(getattr(payload, f)))
+        h.update(int(payload.block_number).to_bytes(8, "little"))
+        h.update(int(payload.timestamp).to_bytes(8, "little"))
+        return h.digest()
+
+    # ------------------------------------------------------ test control
+
+    def make_invalid(self, block_hash):
+        self.invalid_hashes.add(bytes(block_hash))

@@ -82,17 +82,22 @@ class Slasher:
                 continue
             span = self.spans[v]
             conflict = None
+            new_surrounds = False
             for t2, s2 in span.items():
-                # new surrounds old / old surrounds new
-                if (source < s2 and t2 < target) or (s2 < source and target < t2):
-                    conflict = (v, t2)
+                if source < s2 and t2 < target:      # new surrounds old
+                    conflict, new_surrounds = (v, t2), True
+                    break
+                if s2 < source and target < t2:      # old surrounds new
+                    conflict, new_surrounds = (v, t2), False
                     break
             if conflict is not None:
-                out.append(
-                    self._attester_slashing(
-                        self.attestations[conflict][1], indexed
-                    )
-                )
+                stored = self.attestations[conflict][1]
+                # is_slashable_attestation_data(d1, d2) requires d1 to
+                # surround d2 — attestation_1 must be the SURROUNDING vote
+                if new_surrounds:
+                    out.append(self._attester_slashing(indexed, stored))
+                else:
+                    out.append(self._attester_slashing(stored, indexed))
                 continue
             self.attestations[(v, target)] = (data_root, indexed)
             span[target] = source

@@ -263,9 +263,14 @@ def process_inactivity_updates(state, preset):
     state.inactivity_scores.set_np(np.maximum(scores, 0).astype(np.uint64))
 
 
-def process_rewards_and_penalties(state, preset):
+def process_rewards_and_penalties(
+    state, preset, inactivity_penalty_quotient=None
+):
     """Vectorized altair flag-based deltas (get_flag_index_deltas +
-    get_inactivity_penalty_deltas)."""
+    get_inactivity_penalty_deltas).  `inactivity_penalty_quotient`
+    overrides the altair constant for bellatrix+ (2^24 vs 3*2^24)."""
+    if inactivity_penalty_quotient is None:
+        inactivity_penalty_quotient = INACTIVITY_PENALTY_QUOTIENT_ALTAIR
     if get_current_epoch(state, preset) == GENESIS_EPOCH:
         return
     prev = get_previous_epoch(state, preset)
@@ -315,7 +320,7 @@ def process_rewards_and_penalties(state, preset):
     tgt_mask[tgt] = True
     lagging = eligible & ~tgt_mask
     scores = state.inactivity_scores.np.astype(np.int64)
-    penalty_denominator = INACTIVITY_SCORE_BIAS * INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+    penalty_denominator = INACTIVITY_SCORE_BIAS * inactivity_penalty_quotient
     penalties[lagging] += (eb[lagging] * scores[lagging]) // penalty_denominator
 
     bal_u = state.balances.np
@@ -361,7 +366,10 @@ def process_sync_aggregate_step(state, body, spec, verifying, sets, get_pubkey):
     )
 
 
-def process_operations(state, body, spec, verifying, sets, get_pubkey):
+def process_operations(
+    state, body, spec, verifying, sets, get_pubkey,
+    slashing_quotient=MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR,
+):
     preset = spec.preset
     expected_deposits = min(
         preset.max_deposits,
@@ -372,12 +380,12 @@ def process_operations(state, body, spec, verifying, sets, get_pubkey):
     for op in body.proposer_slashings:
         phase0.process_proposer_slashing(
             state, op, spec, verifying, sets, get_pubkey,
-            slashing_quotient=MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR,
+            slashing_quotient=slashing_quotient,
         )
     for op in body.attester_slashings:
         phase0.process_attester_slashing(
             state, op, spec, verifying, sets, get_pubkey,
-            slashing_quotient=MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR,
+            slashing_quotient=slashing_quotient,
         )
     for op in body.attestations:
         process_attestation(state, op, spec, verifying, sets, get_pubkey)

@@ -1,0 +1,386 @@
+"""Bellatrix + Capella state transition: execution payloads, withdrawals,
+BLS-to-execution changes.
+
+Mirror of the bellatrix/capella arms of
+/root/reference/consensus/state_processing (per_block_processing.rs
+execution-payload + withdrawals processing, upgrade/{merge,capella}.rs):
+epoch processing is altair's with the bellatrix slashing constants; block
+processing adds `process_execution_payload` (validated through the
+ExecutionEngine seam — the `payload_notifier` of
+block_verification.rs:625) and, for capella, `process_withdrawals` +
+`process_bls_to_execution_change`.
+
+Post-merge only: the transition (terminal-difficulty) edge cases are
+deliberately out of scope — states here are always
+is_merge_transition_complete.
+"""
+
+import numpy as np
+
+from ..ssz import hash_tree_root
+from ..types.state import state_types
+from . import altair, phase0
+from . import signature_sets as sset
+from .phase0 import (
+    EFFECTIVE_BALANCE_INCREMENT,
+    FAR_FUTURE_EPOCH,
+    MAX_EFFECTIVE_BALANCE,
+    get_current_epoch,
+    get_randao_mix,
+)
+
+INACTIVITY_PENALTY_QUOTIENT_BELLATRIX = 2**24
+MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX = 32
+PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX = 3
+
+ETH1_ADDRESS_WITHDRAWAL_PREFIX = b"\x01"
+BLS_WITHDRAWAL_PREFIX = b"\x00"
+MAX_WITHDRAWALS_PER_PAYLOAD = 2**4
+MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP = 2**14
+
+
+def is_bellatrix_state(state):
+    return hasattr(state, "latest_execution_payload_header")
+
+
+def is_capella_state(state):
+    return hasattr(state, "next_withdrawal_index")
+
+
+def is_merge_transition_complete(state):
+    """Spec is_merge_transition_complete: the header is non-default once
+    the first payload landed."""
+    return bytes(state.latest_execution_payload_header.block_hash) != bytes(32)
+
+
+# ------------------------------------------------------------------ epoch
+
+
+def process_epoch(state, preset, spec=None):
+    """Altair's flag-based epoch transition with bellatrix constants."""
+    altair.process_justification_and_finalization(state, preset)
+    altair.process_inactivity_updates(state, preset)
+    process_rewards_and_penalties(state, preset)
+    phase0.process_registry_updates(state, preset, spec=spec)
+    phase0.process_slashings_with_multiplier(
+        state, preset, PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX
+    )
+    phase0.process_final_updates_partial(
+        state, preset, historical_roots=not is_capella_state(state)
+    )
+    process_historical_summaries(state, preset)
+    altair.process_participation_flag_updates(state)
+    altair.process_sync_committee_updates(state, preset)
+
+
+def process_rewards_and_penalties(state, preset):
+    """Altair deltas with the bellatrix inactivity quotient."""
+    altair.process_rewards_and_penalties(
+        state, preset,
+        inactivity_penalty_quotient=INACTIVITY_PENALTY_QUOTIENT_BELLATRIX,
+    )
+
+
+def process_historical_summaries(state, preset):
+    """Capella: HistoricalSummary accumulator replaces historical_roots."""
+    if not is_capella_state(state):
+        return
+    next_epoch = get_current_epoch(state, preset) + 1
+    if next_epoch % (preset.slots_per_historical_root // preset.slots_per_epoch) == 0:
+        T = state_types(preset)
+        from ..ssz.hash import merkleize_np
+
+        summary = T.HistoricalSummary(
+            block_summary_root=merkleize_np(state.block_roots.np),
+            state_summary_root=merkleize_np(state.state_roots.np),
+        )
+        state.historical_summaries.append(summary)
+
+
+# ------------------------------------------------------------------ block
+
+
+def process_operations(state, body, spec, verifying, sets, get_pubkey):
+    altair.process_operations(
+        state, body, spec, verifying, sets, get_pubkey,
+        slashing_quotient=MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX,
+    )
+    if hasattr(body, "bls_to_execution_changes"):
+        for change in body.bls_to_execution_changes:
+            process_bls_to_execution_change(
+                state, change, spec, verifying, sets
+            )
+
+
+def payload_steps(engine):
+    """The spec-ordered pre-randao steps: capella withdrawals, then
+    execution payload (runs between process_block_header and
+    process_randao — payload.prev_randao is therefore the PRE-block mix)."""
+
+    def hook(state, body, spec):
+        if is_capella_state(state):
+            process_withdrawals(state, body.execution_payload, spec.preset)
+        process_execution_payload(state, body, spec, engine)
+
+    return hook
+
+
+def produce_payload(state, spec, engine, capella):
+    """getPayload for block production — shared by BeaconChain production
+    and the test harness so the two can never diverge.
+
+    Must be called on the state ALREADY advanced to the block's slot but
+    before any block processing: prev_randao is the pre-block mix (spec
+    order runs process_execution_payload before process_randao)."""
+    preset = spec.preset
+    epoch = get_current_epoch(state, preset)
+    mix = bytes(get_randao_mix(state, epoch, preset))
+    header_hash = bytes(state.latest_execution_payload_header.block_hash)
+    parent_hash = header_hash if header_hash != bytes(32) else engine.genesis_hash
+    timestamp = int(state.genesis_time) + int(state.slot) * spec.seconds_per_slot
+    withdrawals = get_expected_withdrawals(state, preset) if capella else None
+    return engine.get_payload(parent_hash, timestamp, mix, withdrawals=withdrawals)
+
+
+def process_execution_payload(state, body, spec, engine):
+    """Spec process_execution_payload + the engine notify seam."""
+    preset = spec.preset
+    payload = body.execution_payload
+    header = state.latest_execution_payload_header
+    if is_merge_transition_complete(state):
+        # the transition block's parent is the terminal EL block, not a
+        # previously-seen payload (spec process_execution_payload guard)
+        assert bytes(payload.parent_hash) == bytes(header.block_hash), (
+            "payload parent hash mismatch"
+        )
+    assert bytes(payload.prev_randao) == get_randao_mix(
+        state, get_current_epoch(state, preset), preset
+    ), "payload prev_randao mismatch"
+    expected_time = int(state.genesis_time) + int(state.slot) * spec.seconds_per_slot
+    assert int(payload.timestamp) == expected_time, "payload timestamp mismatch"
+
+    if engine is not None:
+        from ..execution import PayloadStatus
+
+        status = engine.notify_new_payload(payload)
+        if status == PayloadStatus.INVALID:
+            raise phase0.BlockProcessingError("execution payload INVALID")
+        # SYNCING -> optimistic import (handled a layer up)
+
+    T = state_types(preset)
+    common = dict(
+        parent_hash=bytes(payload.parent_hash),
+        fee_recipient=bytes(payload.fee_recipient),
+        state_root=bytes(payload.state_root),
+        receipts_root=bytes(payload.receipts_root),
+        logs_bloom=bytes(payload.logs_bloom),
+        prev_randao=bytes(payload.prev_randao),
+        block_number=int(payload.block_number),
+        gas_limit=int(payload.gas_limit),
+        gas_used=int(payload.gas_used),
+        timestamp=int(payload.timestamp),
+        extra_data=bytes(payload.extra_data),
+        base_fee_per_gas=int(payload.base_fee_per_gas),
+        block_hash=bytes(payload.block_hash),
+    )
+    tx_type = dict(T.ExecutionPayload.fields)["transactions"]
+    transactions_root = hash_tree_root(tx_type, list(payload.transactions))
+    if is_capella_state(state):
+        w_type = dict(T.ExecutionPayloadCapella.fields)["withdrawals"]
+        state.latest_execution_payload_header = T.ExecutionPayloadHeaderCapella(
+            **common,
+            transactions_root=transactions_root,
+            withdrawals_root=hash_tree_root(w_type, list(payload.withdrawals)),
+        )
+    else:
+        state.latest_execution_payload_header = T.ExecutionPayloadHeader(
+            **common, transactions_root=transactions_root
+        )
+
+
+# --------------------------------------------------------------- capella
+
+
+def has_eth1_withdrawal_credential(wc: bytes) -> bool:
+    return wc[:1] == ETH1_ADDRESS_WITHDRAWAL_PREFIX
+
+
+def get_expected_withdrawals(state, preset):
+    """Spec get_expected_withdrawals: sweep from
+    next_withdrawal_validator_index, full for withdrawable-exited, partial
+    above MAX_EFFECTIVE_BALANCE."""
+    T = state_types(preset)
+    epoch = get_current_epoch(state, preset)
+    withdrawal_index = int(state.next_withdrawal_index)
+    validator_index = int(state.next_withdrawal_validator_index)
+    reg = state.validators
+    n = len(reg)
+    out = []
+    for _ in range(min(n, MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)):
+        v = reg[validator_index]
+        balance = state.balances[validator_index]
+        wc = v.withdrawal_credentials
+        if (
+            has_eth1_withdrawal_credential(wc)
+            and v.withdrawable_epoch <= epoch
+            and balance > 0
+        ):
+            out.append(
+                T.Withdrawal(
+                    index=withdrawal_index,
+                    validator_index=validator_index,
+                    address=wc[12:32],
+                    amount=balance,
+                )
+            )
+            withdrawal_index += 1
+        elif (
+            has_eth1_withdrawal_credential(wc)
+            and v.effective_balance == MAX_EFFECTIVE_BALANCE
+            and balance > MAX_EFFECTIVE_BALANCE
+        ):
+            out.append(
+                T.Withdrawal(
+                    index=withdrawal_index,
+                    validator_index=validator_index,
+                    address=wc[12:32],
+                    amount=balance - MAX_EFFECTIVE_BALANCE,
+                )
+            )
+            withdrawal_index += 1
+        if len(out) == MAX_WITHDRAWALS_PER_PAYLOAD:
+            break
+        validator_index = (validator_index + 1) % n
+    return out
+
+
+def process_withdrawals(state, payload, preset):
+    expected = get_expected_withdrawals(state, preset)
+    got = list(payload.withdrawals)
+    assert len(got) == len(expected), "withdrawal count mismatch"
+    for w, e in zip(got, expected):
+        assert w == e, "withdrawal mismatch"
+        phase0.decrease_balance(state, int(w.validator_index), int(w.amount))
+    if expected:
+        state.next_withdrawal_index = int(expected[-1].index) + 1
+    n = len(state.validators)
+    if len(expected) == MAX_WITHDRAWALS_PER_PAYLOAD:
+        state.next_withdrawal_validator_index = (
+            int(expected[-1].validator_index) + 1
+        ) % n
+    else:
+        state.next_withdrawal_validator_index = (
+            int(state.next_withdrawal_validator_index)
+            + MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP
+        ) % n
+
+
+def process_bls_to_execution_change(state, signed_change, spec, verifying, sets):
+    """Spec process_bls_to_execution_change."""
+    import hashlib
+
+    change = signed_change.message
+    v = state.validators[int(change.validator_index)]
+    wc = v.withdrawal_credentials
+    assert wc[:1] == BLS_WITHDRAWAL_PREFIX, "not BLS credentials"
+    assert (
+        wc[1:] == hashlib.sha256(bytes(change.from_bls_pubkey)).digest()[1:]
+    ), "from_bls_pubkey does not match credentials"
+    if verifying:
+        sets.append(
+            sset.bls_execution_change_signature_set(
+                signed_change, state.genesis_validators_root, spec
+            )
+        )
+    v.withdrawal_credentials = (
+        ETH1_ADDRESS_WITHDRAWAL_PREFIX
+        + bytes(11)
+        + bytes(change.to_execution_address)
+    )
+
+
+# ----------------------------------------------------------------- upgrades
+
+
+def upgrade_to_bellatrix(pre, spec):
+    """upgrade/merge.rs: altair state + default payload header."""
+    preset = spec.preset
+    T = state_types(preset)
+    epoch = get_current_epoch(pre, preset)
+    post = T.BeaconStateBellatrix(
+        **_altair_field_values(pre),
+        latest_execution_payload_header=T.ExecutionPayloadHeader(),
+    )
+    post.fork = type(pre.fork)(
+        previous_version=pre.fork.current_version,
+        current_version=spec.bellatrix_fork_version,
+        epoch=epoch,
+    )
+    return post
+
+
+def upgrade_to_capella(pre, spec):
+    """upgrade/capella.rs."""
+    preset = spec.preset
+    T = state_types(preset)
+    epoch = get_current_epoch(pre, preset)
+    hdr = pre.latest_execution_payload_header
+    post = T.BeaconStateCapella(
+        **_altair_field_values(pre),
+        latest_execution_payload_header=T.ExecutionPayloadHeaderCapella(
+            parent_hash=bytes(hdr.parent_hash),
+            fee_recipient=bytes(hdr.fee_recipient),
+            state_root=bytes(hdr.state_root),
+            receipts_root=bytes(hdr.receipts_root),
+            logs_bloom=bytes(hdr.logs_bloom),
+            prev_randao=bytes(hdr.prev_randao),
+            block_number=int(hdr.block_number),
+            gas_limit=int(hdr.gas_limit),
+            gas_used=int(hdr.gas_used),
+            timestamp=int(hdr.timestamp),
+            extra_data=bytes(hdr.extra_data),
+            base_fee_per_gas=int(hdr.base_fee_per_gas),
+            block_hash=bytes(hdr.block_hash),
+            transactions_root=bytes(hdr.transactions_root),
+            withdrawals_root=bytes(32),
+        ),
+        next_withdrawal_index=0,
+        next_withdrawal_validator_index=0,
+        historical_summaries=[],
+    )
+    post.fork = type(pre.fork)(
+        previous_version=pre.fork.current_version,
+        current_version=spec.capella_fork_version,
+        epoch=epoch,
+    )
+    return post
+
+
+def _altair_field_values(pre):
+    """The altair-common field values carried through an upgrade."""
+    return dict(
+        genesis_time=pre.genesis_time,
+        genesis_validators_root=pre.genesis_validators_root,
+        slot=pre.slot,
+        fork=pre.fork,
+        latest_block_header=pre.latest_block_header,
+        block_roots=list(pre.block_roots),
+        state_roots=list(pre.state_roots),
+        historical_roots=list(pre.historical_roots),
+        eth1_data=pre.eth1_data,
+        eth1_data_votes=list(pre.eth1_data_votes),
+        eth1_deposit_index=pre.eth1_deposit_index,
+        validators=pre.validators,
+        balances=pre.balances,
+        randao_mixes=list(pre.randao_mixes),
+        slashings=list(pre.slashings),
+        previous_epoch_participation=pre.previous_epoch_participation,
+        current_epoch_participation=pre.current_epoch_participation,
+        justification_bits=list(pre.justification_bits),
+        previous_justified_checkpoint=pre.previous_justified_checkpoint,
+        current_justified_checkpoint=pre.current_justified_checkpoint,
+        finalized_checkpoint=pre.finalized_checkpoint,
+        inactivity_scores=pre.inactivity_scores,
+        current_sync_committee=pre.current_sync_committee,
+        next_sync_committee=pre.next_sync_committee,
+    )

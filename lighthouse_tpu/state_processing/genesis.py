@@ -81,12 +81,21 @@ def interop_genesis_state(keypairs, genesis_time, spec, eth1_block_hash=b"\x42" 
     validators_type = dict(T.BeaconState.fields)["validators"]
     state.genesis_validators_root = hash_tree_root(validators_type, validators)
     if spec.altair_fork_epoch == 0:
-        # genesis directly at the altair fork (the reference builds genesis
-        # for the scheduled fork of epoch 0)
+        # genesis directly at the scheduled fork of epoch 0 (the reference
+        # builds genesis for the latest active fork)
         from .altair import upgrade_to_altair
 
         state = upgrade_to_altair(state, spec)
+        body_cls = T.BeaconBlockBodyAltair
+        if spec.bellatrix_fork_epoch == 0:
+            from .bellatrix import upgrade_to_bellatrix, upgrade_to_capella
+
+            state = upgrade_to_bellatrix(state, spec)
+            body_cls = T.BeaconBlockBodyBellatrix
+            if spec.capella_fork_epoch == 0:
+                state = upgrade_to_capella(state, spec)
+                body_cls = T.BeaconBlockBodyCapella
         state.latest_block_header = BeaconBlockHeader(
-            body_root=hash_tree_root(T.BeaconBlockBodyAltair())
+            body_root=hash_tree_root(body_cls())
         )
     return state

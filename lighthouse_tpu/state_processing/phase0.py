@@ -353,12 +353,34 @@ def process_slots(state, slot, preset, spec=None):
                 from .altair import upgrade_to_altair
 
                 state = upgrade_to_altair(state, spec)
+            if (
+                spec.bellatrix_fork_epoch is not None
+                and epoch == spec.bellatrix_fork_epoch
+                and hasattr(state, "previous_epoch_participation")
+                and not hasattr(state, "latest_execution_payload_header")
+            ):
+                from .bellatrix import upgrade_to_bellatrix
+
+                state = upgrade_to_bellatrix(state, spec)
+            if (
+                spec.capella_fork_epoch is not None
+                and epoch == spec.capella_fork_epoch
+                and hasattr(state, "latest_execution_payload_header")
+                and not hasattr(state, "next_withdrawal_index")
+            ):
+                from .bellatrix import upgrade_to_capella
+
+                state = upgrade_to_capella(state, spec)
     return state
 
 
 def process_epoch_for_fork(state, preset, spec=None):
     """Fork-dispatching epoch transition (per_epoch_processing.rs:31)."""
-    if hasattr(state, "previous_epoch_participation"):
+    if hasattr(state, "latest_execution_payload_header"):
+        from . import bellatrix
+
+        bellatrix.process_epoch(state, preset, spec=spec)
+    elif hasattr(state, "previous_epoch_participation"):
         from . import altair
 
         altair.process_epoch(state, preset, spec=spec)
@@ -692,9 +714,10 @@ def process_final_updates(state, preset):
     state.current_epoch_attestations = []
 
 
-def process_final_updates_partial(state, preset):
-    """Final updates shared by phase0 and altair (everything except the
-    pending-attestation rotation)."""
+def process_final_updates_partial(state, preset, historical_roots=True):
+    """Final updates shared by phase0/altair/bellatrix (everything except
+    the pending-attestation rotation).  Capella passes
+    historical_roots=False: its accumulator is historical_summaries."""
     current_epoch = get_current_epoch(state, preset)
     next_epoch = current_epoch + 1
     # eth1 data votes reset
@@ -725,8 +748,10 @@ def process_final_updates_partial(state, preset):
     state.randao_mixes[next_epoch % preset.epochs_per_historical_vector] = (
         get_randao_mix(state, current_epoch, preset)
     )
-    # historical roots accumulator
-    if next_epoch % (preset.slots_per_historical_root // preset.slots_per_epoch) == 0:
+    # historical roots accumulator (pre-capella)
+    if historical_roots and next_epoch % (
+        preset.slots_per_historical_root // preset.slots_per_epoch
+    ) == 0:
         T = state_types(preset)
         batch = T.HistoricalBatch(
             block_roots=list(state.block_roots), state_roots=list(state.state_roots)
@@ -756,6 +781,7 @@ def per_block_processing(
     signature_strategy=BlockSignatureStrategy.VERIFY_INDIVIDUAL,
     verify_fn=None,
     collected_sets=None,
+    execution_engine=None,
 ):
     """per_block_processing.rs:95.
 
@@ -767,6 +793,16 @@ def per_block_processing(
 
     Dispatches to the altair arm for altair states.
     """
+    if hasattr(state, "latest_execution_payload_header"):
+        from . import altair, bellatrix
+
+        return _per_block_processing_core(
+            state, signed_block, spec, signature_strategy, verify_fn,
+            collected_sets,
+            ops_fn=bellatrix.process_operations,
+            post_ops_fn=altair.process_sync_aggregate_step,
+            payload_fn=bellatrix.payload_steps(execution_engine),
+        )
     if hasattr(state, "previous_epoch_participation"):
         from . import altair
 
@@ -786,12 +822,12 @@ def per_block_processing(
 
 def _per_block_processing_core(
     state, signed_block, spec, signature_strategy, verify_fn, collected_sets,
-    ops_fn, post_ops_fn,
+    ops_fn, post_ops_fn, payload_fn=None,
 ):
-    """Fork-independent block-processing scaffold: proposal-set collection,
-    header/randao/eth1, fork-specific operations (`ops_fn`), optional
-    post-operations step (`post_ops_fn` — altair sync aggregate), then the
-    verify/collect tail."""
+    """Fork-independent block-processing scaffold in SPEC order:
+    header -> [payload_fn: capella withdrawals + execution payload, which
+    run BEFORE randao] -> randao -> eth1 -> operations (`ops_fn`) ->
+    [post_ops_fn: altair sync aggregate], then the verify/collect tail."""
     preset = spec.preset
     block = signed_block.message
     verifying = signature_strategy != BlockSignatureStrategy.NO_VERIFICATION
@@ -822,6 +858,8 @@ def _per_block_processing_core(
         )
 
     process_block_header(state, block, preset)
+    if payload_fn is not None:
+        payload_fn(state, block.body, spec)
     process_randao(state, block.body, spec, verifying, sets, get_pubkey)
     process_eth1_data(state, block.body, preset)
     ops_fn(state, block.body, spec, verifying, sets, get_pubkey)

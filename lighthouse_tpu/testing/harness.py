@@ -35,6 +35,16 @@ class Harness:
         self.keypairs = interop_keypairs(n_validators)
         self.state = interop_genesis_state(self.keypairs, genesis_time, spec)
         self.blocks = {}  # root -> SignedBeaconBlock
+        self._engines = {}  # fork-aware mock EL instances
+
+    def engine(self, capella=False):
+        """Shared mock execution engine (test_utils mock EL)."""
+        key = bool(capella)
+        if key not in self._engines:
+            from ..execution import MockExecutionEngine
+
+            self._engines[key] = MockExecutionEngine(self.T, capella=capella)
+        return self._engines[key]
 
     # ------------------------------------------------------------- signing
 
@@ -64,6 +74,8 @@ class Harness:
         )
 
         altair = hasattr(state, "previous_epoch_participation")
+        bellatrix = hasattr(state, "latest_execution_payload_header")
+        capella = hasattr(state, "next_withdrawal_index")
         body_kwargs = dict(
             randao_reveal=randao_reveal,
             eth1_data=state.eth1_data,
@@ -71,6 +83,18 @@ class Harness:
         )
         if altair:
             body_kwargs["sync_aggregate"] = self._sync_aggregate(state, slot)
+        if bellatrix:
+            body_kwargs["execution_payload"] = self._execution_payload(
+                state, randao_reveal, capella
+            )
+        if capella:
+            body_kwargs["bls_to_execution_changes"] = []
+            body = self.T.BeaconBlockBodyCapella(**body_kwargs)
+            block_cls, signed_cls = self.T.BeaconBlockCapella, self.T.SignedBeaconBlockCapella
+        elif bellatrix:
+            body = self.T.BeaconBlockBodyBellatrix(**body_kwargs)
+            block_cls, signed_cls = self.T.BeaconBlockBellatrix, self.T.SignedBeaconBlockBellatrix
+        elif altair:
             body = self.T.BeaconBlockBodyAltair(**body_kwargs)
             block_cls, signed_cls = self.T.BeaconBlockAltair, self.T.SignedBeaconBlockAltair
         else:
@@ -98,6 +122,11 @@ class Harness:
         )
         sig = self._sign_root(proposer, compute_signing_root(block, pd))
         return signed_cls(message=block, signature=sig)
+
+    def _execution_payload(self, state, randao_reveal, capella):
+        from ..state_processing import bellatrix as bx
+
+        return bx.produce_payload(state, self.spec, self.engine(capella), capella)
 
     def _sync_aggregate(self, state, slot):
         """Full-participation SyncAggregate signed by the current sync

@@ -24,6 +24,7 @@ from ..ssz import (
     DecodeError,
     Bitvector,
     Boolean,
+    ByteList,
     Bytes32,
     Bytes48,
     Bytes96,
@@ -33,6 +34,7 @@ from ..ssz import (
     Vector,
     uint8,
     uint64,
+    uint256,
 )
 from .containers import (
     AttestationData,
@@ -42,6 +44,7 @@ from .containers import (
     Fork,
     BeaconBlockHeader,
     ProposerSlashing,
+    SignedBLSToExecutionChange,
     SignedVoluntaryExit,
     SyncAggregate,
 )
@@ -299,6 +302,87 @@ def state_types(preset):
     class BeaconBlockBodyAltair(Container):
         fields = BeaconBlockBody.fields + [("sync_aggregate", SyncAggregate)]
 
+    # ------------------------------------------------------- bellatrix
+    # (/root/reference/consensus/types/src/execution_payload.rs)
+
+    MAX_BYTES_PER_TRANSACTION = 2**30
+    MAX_TRANSACTIONS_PER_PAYLOAD = 2**20
+    BYTES_PER_LOGS_BLOOM = 256
+    MAX_EXTRA_DATA_BYTES = 32
+    MAX_WITHDRAWALS_PER_PAYLOAD = 2**4
+
+    _payload_common = [
+        ("parent_hash", Bytes32),
+        ("fee_recipient", ByteVector(20)),
+        ("state_root", Bytes32),
+        ("receipts_root", Bytes32),
+        ("logs_bloom", ByteVector(BYTES_PER_LOGS_BLOOM)),
+        ("prev_randao", Bytes32),
+        ("block_number", uint64),
+        ("gas_limit", uint64),
+        ("gas_used", uint64),
+        ("timestamp", uint64),
+        ("extra_data", ByteList(MAX_EXTRA_DATA_BYTES)),
+        ("base_fee_per_gas", uint256),
+    ]
+
+    class ExecutionPayload(Container):
+        fields = _payload_common + [
+            ("block_hash", Bytes32),
+            ("transactions", List(
+                ByteList(MAX_BYTES_PER_TRANSACTION), MAX_TRANSACTIONS_PER_PAYLOAD
+            )),
+        ]
+
+    class ExecutionPayloadHeader(Container):
+        fields = _payload_common + [
+            ("block_hash", Bytes32),
+            ("transactions_root", Bytes32),
+        ]
+
+    class Withdrawal(Container):
+        fields = [
+            ("index", uint64),
+            ("validator_index", uint64),
+            ("address", ByteVector(20)),
+            ("amount", uint64),
+        ]
+
+    class ExecutionPayloadCapella(Container):
+        fields = _payload_common + [
+            ("block_hash", Bytes32),
+            ("transactions", List(
+                ByteList(MAX_BYTES_PER_TRANSACTION), MAX_TRANSACTIONS_PER_PAYLOAD
+            )),
+            ("withdrawals", List(Withdrawal, MAX_WITHDRAWALS_PER_PAYLOAD)),
+        ]
+
+    class ExecutionPayloadHeaderCapella(Container):
+        fields = _payload_common + [
+            ("block_hash", Bytes32),
+            ("transactions_root", Bytes32),
+            ("withdrawals_root", Bytes32),
+        ]
+
+    class HistoricalSummary(Container):
+        fields = [
+            ("block_summary_root", Bytes32),
+            ("state_summary_root", Bytes32),
+        ]
+
+    class BeaconBlockBodyBellatrix(Container):
+        fields = BeaconBlockBodyAltair.fields + [
+            ("execution_payload", ExecutionPayload)
+        ]
+
+    class BeaconBlockBodyCapella(Container):
+        fields = BeaconBlockBodyAltair.fields + [
+            ("execution_payload", ExecutionPayloadCapella),
+            ("bls_to_execution_changes", List(
+                SignedBLSToExecutionChange, preset.max_bls_to_execution_changes
+            )),
+        ]
+
     class BeaconBlockAltair(Container):
         fields = [
             ("slot", uint64),
@@ -353,6 +437,63 @@ def state_types(preset):
                 value = w(value)
             object.__setattr__(self, name, value)
 
+    class BeaconBlockBellatrix(Container):
+        fields = [
+            ("slot", uint64),
+            ("proposer_index", uint64),
+            ("parent_root", Bytes32),
+            ("state_root", Bytes32),
+            ("body", BeaconBlockBodyBellatrix),
+        ]
+
+    class SignedBeaconBlockBellatrix(Container):
+        fields = [("message", BeaconBlockBellatrix), ("signature", Bytes96)]
+
+    class BeaconBlockCapella(Container):
+        fields = [
+            ("slot", uint64),
+            ("proposer_index", uint64),
+            ("parent_root", Bytes32),
+            ("state_root", Bytes32),
+            ("body", BeaconBlockBodyCapella),
+        ]
+
+    class SignedBeaconBlockCapella(Container):
+        fields = [("message", BeaconBlockCapella), ("signature", Bytes96)]
+
+    _altair_state_fields = BeaconStateAltair.fields
+
+    class BeaconStateBellatrix(Container):
+        fields = _altair_state_fields + [
+            ("latest_execution_payload_header", ExecutionPayloadHeader),
+        ]
+
+        _cached_tree_hash = True
+
+        def __setattr__(self, name, value):
+            w = _STATE_FIELD_WRAPPERS.get(name)
+            if w is not None:
+                value = w(value)
+            object.__setattr__(self, name, value)
+
+    class BeaconStateCapella(Container):
+        fields = _altair_state_fields + [
+            ("latest_execution_payload_header", ExecutionPayloadHeaderCapella),
+            ("next_withdrawal_index", uint64),
+            ("next_withdrawal_validator_index", uint64),
+            ("historical_summaries", List(
+                HistoricalSummary, preset.historical_roots_limit
+            )),
+        ]
+
+        _cached_tree_hash = True
+
+        def __setattr__(self, name, value):
+            w = _STATE_FIELD_WRAPPERS.get(name)
+            if w is not None:
+                value = w(value)
+            object.__setattr__(self, name, value)
+
     ns = type("StateTypes", (), {})
     ns.Attestation = Attestation
     ns.PendingAttestation = PendingAttestation
@@ -374,4 +515,18 @@ def state_types(preset):
     ns.BeaconBlockAltair = BeaconBlockAltair
     ns.SignedBeaconBlockAltair = SignedBeaconBlockAltair
     ns.BeaconStateAltair = BeaconStateAltair
+    ns.ExecutionPayload = ExecutionPayload
+    ns.ExecutionPayloadHeader = ExecutionPayloadHeader
+    ns.ExecutionPayloadCapella = ExecutionPayloadCapella
+    ns.ExecutionPayloadHeaderCapella = ExecutionPayloadHeaderCapella
+    ns.Withdrawal = Withdrawal
+    ns.HistoricalSummary = HistoricalSummary
+    ns.BeaconBlockBodyBellatrix = BeaconBlockBodyBellatrix
+    ns.BeaconBlockBellatrix = BeaconBlockBellatrix
+    ns.SignedBeaconBlockBellatrix = SignedBeaconBlockBellatrix
+    ns.BeaconBlockBodyCapella = BeaconBlockBodyCapella
+    ns.BeaconBlockCapella = BeaconBlockCapella
+    ns.SignedBeaconBlockCapella = SignedBeaconBlockCapella
+    ns.BeaconStateBellatrix = BeaconStateBellatrix
+    ns.BeaconStateCapella = BeaconStateCapella
     return ns

@@ -57,15 +57,47 @@ class DirectBeaconNode(BeaconNodeInterface):
             "genesis_validators_root": bytes(st.genesis_validators_root),
         }
 
+    def _state_at_epoch_start(self, epoch):
+        """A state positioned at the epoch's first slot — from the store's
+        canonical history when the head is already past it (proposer seeds
+        depend on state.slot, so mid-epoch head states give WRONG proposers
+        for earlier slots)."""
+        chain = self.chain
+        preset = chain.preset
+        target = epoch * preset.slots_per_epoch
+        state = chain.head_state
+        if int(state.slot) == target:
+            return state
+        if int(state.slot) < target:
+            state = state.copy()
+            return phase0.process_slots(state, target, preset, spec=chain.spec)
+        # head past the epoch start: walk the canonical chain back to the
+        # last block at or before it and advance its stored post-state
+        root = chain.head_root
+        while root is not None:
+            blk = chain.store.get_block(root)
+            if blk is None:
+                st = chain.store.get_state(root)
+                if st is not None and int(st.slot) <= target:
+                    break
+                return chain.head_state  # genesis fallback
+            if int(blk.message.slot) <= target:
+                break
+            root = bytes(blk.message.parent_root)
+        st = chain.store.get_state(root)
+        if st is None:
+            return chain.head_state
+        if int(st.slot) < target:
+            st = st.copy()
+            st = phase0.process_slots(st, target, preset, spec=chain.spec)
+        return st
+
     def duties(self, epoch, pubkeys):
         """Proposer + attester duties for an epoch (duties_service.rs)."""
         chain = self.chain
         preset = chain.preset
-        state = chain.head_state
         target = epoch * preset.slots_per_epoch
-        if int(state.slot) < target:
-            state = state.copy()
-            state = phase0.process_slots(state, target, preset, spec=chain.spec)
+        state = self._state_at_epoch_start(epoch)
         index_by_pk = {}
         reg = state.validators
         for i in range(len(reg)):
@@ -107,11 +139,8 @@ class DirectBeaconNode(BeaconNodeInterface):
         duties endpoint shape, unfiltered)."""
         chain = self.chain
         preset = chain.preset
-        state = chain.head_state
         target = epoch * preset.slots_per_epoch
-        st = state.copy()
-        if int(st.slot) < target:
-            st = phase0.process_slots(st, target, preset, spec=chain.spec)
+        st = self._state_at_epoch_start(epoch).copy()
         reg = st.validators
         out = []
         for slot in range(target, target + preset.slots_per_epoch):

@@ -1,0 +1,148 @@
+"""Gossip aggregate (SignedAggregateAndProof) batch verification and
+sync-committee message verification + aggregation pool.
+
+Mirrors /root/reference/beacon_node/beacon_chain/src/attestation_verification/
+batch.rs:31-134 (3 sets per aggregate in one batch) and
+sync_committee_verification.rs (SURVEY rows 26-27).
+"""
+
+import pytest
+
+from lighthouse_tpu.beacon.chain import AttestationError, BeaconChain
+from lighthouse_tpu.crypto.backend import SignatureVerifier
+from lighthouse_tpu.crypto.ref import bls as RB
+from lighthouse_tpu.crypto.ref.curves import g1_compress, g2_compress
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.state_processing import altair, phase0
+from lighthouse_tpu.testing.harness import Harness
+from lighthouse_tpu.types import ChainSpec, Domain, MinimalPreset
+from lighthouse_tpu.types.containers import AggregateAndProof, SignedAggregateAndProof
+from lighthouse_tpu.state_processing import signature_sets as sset
+from lighthouse_tpu.validator_client.validator_store import ValidatorStore
+
+SPEC = ChainSpec(preset=MinimalPreset)
+
+
+def _setup():
+    h = Harness(8, SPEC)
+    chain = BeaconChain(h.state.copy(), SPEC, verifier=SignatureVerifier("oracle"))
+    slot = h.state.slot + 1
+    block = h.produce_block(slot)
+    h.process_block(block, strategy="no_verification")
+    chain.on_tick(slot)
+    root = chain.process_block(block)
+    return h, chain, root, slot
+
+
+def _make_aggregate(h, chain, root, slot, tamper=False):
+    """Build a valid SignedAggregateAndProof from a committee attestation,
+    searching committee members for one whose selection proof aggregates."""
+    atts = h.attest_slot(h.state, slot, root)
+    att = atts[0]
+    committee = phase0.get_beacon_committee(h.state, slot, 0, SPEC.preset)
+    store = ValidatorStore(SPEC)
+    fork = h.state.fork
+    gvr = bytes(h.state.genesis_validators_root)
+    for vi in committee:
+        pk = store.add_validator(h.keypairs[vi][0])
+        proof = store.sign_selection_proof(pk, slot, fork, gvr)
+        if BeaconChain._is_aggregator(len(committee), proof):
+            agg = AggregateAndProof(
+                aggregator_index=vi, aggregate=att, selection_proof=proof
+            )
+            sig = store.sign_aggregate_and_proof(pk, agg, fork, gvr)
+            if tamper:
+                sig = b"\x55" + sig[1:]
+            return SignedAggregateAndProof(message=agg, signature=sig)
+    return None
+
+
+def test_aggregate_batch_accepts_valid():
+    h, chain, root, slot = _setup()
+    sa = _make_aggregate(h, chain, root, slot)
+    if sa is None:
+        pytest.skip("no aggregator selected in this committee (proof modulo)")
+    chain.on_tick(slot + 1)
+    results = chain.batch_verify_aggregated_attestations([sa])
+    assert results[0][2] is None, results[0][2]
+    # duplicate aggregator filtered on the second pass
+    results2 = chain.batch_verify_aggregated_attestations([sa])
+    assert isinstance(results2[0][2], AttestationError)
+
+
+def test_aggregate_batch_rejects_tampered():
+    h, chain, root, slot = _setup()
+    sa = _make_aggregate(h, chain, root, slot, tamper=True)
+    if sa is None:
+        pytest.skip("no aggregator selected in this committee (proof modulo)")
+    chain.on_tick(slot + 1)
+    results = chain.batch_verify_aggregated_attestations([sa])
+    assert isinstance(results[0][2], AttestationError)
+
+
+ALTAIR_SPEC = ChainSpec(preset=MinimalPreset, altair_fork_epoch=0)
+
+
+def test_sync_message_feeds_pool_and_next_block():
+    h = Harness(8, ALTAIR_SPEC)
+    chain = BeaconChain(
+        h.state.copy(), ALTAIR_SPEC, verifier=SignatureVerifier("oracle")
+    )
+    assert altair.is_altair_state(chain.head_state)
+    slot = h.state.slot + 1
+    block = h.produce_block(slot)
+    h.process_block(block, strategy="no_verification")
+    chain.on_tick(slot)
+    root = chain.process_block(block)
+
+    # a sync-committee member signs the head root at this slot
+    committee_indices = altair.sync_committee_validator_indices(
+        chain.head_state, ALTAIR_SPEC.preset
+    )
+    vi = committee_indices[0]
+    store = ValidatorStore(ALTAIR_SPEC)
+    pk = store.add_validator(h.keypairs[vi][0])
+    sig = store.sign_sync_committee_message(
+        pk, slot, root, chain.head_state.fork,
+        bytes(chain.head_state.genesis_validators_root),
+    )
+    from lighthouse_tpu.types.containers import SyncCommitteeMessage
+
+    msg = SyncCommitteeMessage(
+        slot=slot, beacon_block_root=root, validator_index=vi, signature=sig
+    )
+    assert chain.verify_sync_committee_message(msg) is True
+    with pytest.raises(AttestationError, match="duplicate"):
+        chain.verify_sync_committee_message(msg)
+
+    # the next produced block carries the participation bit
+    blk, _ = chain.produce_block_on_state(slot + 1)
+    agg = blk.body.sync_aggregate
+    assert any(agg.sync_committee_bits), "pool contribution landed"
+    # and the STF accepts that aggregate (signature verifies)
+    positions = [p for p, ci in enumerate(committee_indices) if ci == vi]
+    for p in positions:
+        assert agg.sync_committee_bits[p] == 1
+
+
+def test_sync_message_rejects_non_member():
+    h = Harness(8, ALTAIR_SPEC)
+    chain = BeaconChain(
+        h.state.copy(), ALTAIR_SPEC, verifier=SignatureVerifier("fake")
+    )
+    from lighthouse_tpu.types.containers import SyncCommitteeMessage
+
+    committee = set(
+        altair.sync_committee_validator_indices(chain.head_state, ALTAIR_SPEC.preset)
+    )
+    outsider = next(i for i in range(8) if i not in committee) if len(
+        committee
+    ) < 8 else None
+    if outsider is None:
+        pytest.skip("every validator is in the sync committee")
+    msg = SyncCommitteeMessage(
+        slot=1, beacon_block_root=chain.head_root, validator_index=outsider,
+        signature=b"\x00" * 96,
+    )
+    with pytest.raises(AttestationError, match="not in current"):
+        chain.verify_sync_committee_message(msg)

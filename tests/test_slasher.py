@@ -53,16 +53,26 @@ def test_same_vote_not_slashable():
 
 
 def test_surround_detected_both_directions():
+    from lighthouse_tpu.state_processing.phase0 import (
+        is_slashable_attestation_data,
+    )
+
     s = Slasher()
     s.accept_attestation(_att([5], 3, 4))
     s.accept_attestation(_att([5], 2, 5))    # new surrounds old
     found = s.process_queued()
     assert len(found) == 1
+    # the emitted slashing must be valid on-chain: attestation_1 surrounds
+    sl = found[0][1]
+    assert is_slashable_attestation_data(sl.attestation_1.data, sl.attestation_2.data)
 
     s2 = Slasher()
     s2.accept_attestation(_att([5], 2, 5))
     s2.accept_attestation(_att([5], 3, 4))   # old surrounds new
-    assert len(s2.process_queued()) == 1
+    found2 = s2.process_queued()
+    assert len(found2) == 1
+    sl2 = found2[0][1]
+    assert is_slashable_attestation_data(sl2.attestation_1.data, sl2.attestation_2.data)
 
 
 def test_disjoint_votes_fine():
