@@ -1,0 +1,102 @@
+// Native bridge client — the FFI surface a Rust/C++ consensus node links
+// against to reach the TPU verification server (SURVEY.md §7 steps 3-4:
+// the `impls/tpu.rs` backend's transport).  Blocking unix-socket IO,
+// length-prefixed frames matching lighthouse_tpu/bridge/__init__.py.
+//
+//   int bridge_connect(const char* path);          // fd or -1
+//   void bridge_close(int fd);
+//   int bridge_verify(fd, cmd, n_sets, counts, sigs, msgs, pks,
+//                     total_pks, out_verdicts);    // overall ok, or <0
+
+#include <cstdint>
+#include <cstring>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+namespace {
+
+bool send_all(int fd, const void* data, size_t len) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  while (len > 0) {
+    ssize_t n = ::send(fd, p, len, 0);
+    if (n <= 0) return false;
+    p += n;
+    len -= size_t(n);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* data, size_t len) {
+  uint8_t* p = static_cast<uint8_t*>(data);
+  while (len > 0) {
+    ssize_t n = ::recv(fd, p, len, 0);
+    if (n <= 0) return false;
+    p += n;
+    len -= size_t(n);
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+int bridge_connect(const char* path) {
+  int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  std::strncpy(addr.sun_path, path, sizeof(addr.sun_path) - 1);
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+void bridge_close(int fd) { ::close(fd); }
+
+// Returns overall verdict (0/1) and fills out_verdicts[n_sets];
+// negative on transport error (caller should fall back to its local
+// crypto backend — a dead TPU server must not be consensus-critical).
+int bridge_verify(int fd, uint8_t cmd, uint32_t n_sets,
+                  const uint32_t* counts, const uint8_t* sigs,
+                  const uint8_t* msgs, const uint8_t* pks,
+                  uint32_t total_pks, uint8_t* out_verdicts) {
+  uint32_t frame_len;
+  if (cmd == 3 /* ping */) {
+    frame_len = 1;
+    if (!send_all(fd, &frame_len, 4)) return -2;
+    if (!send_all(fd, &cmd, 1)) return -2;
+  } else {
+    frame_len = 1 + 4 + 4 * n_sets + 96 * n_sets + 32 * n_sets + 48 * total_pks;
+    if (!send_all(fd, &frame_len, 4)) return -2;
+    if (!send_all(fd, &cmd, 1)) return -2;
+    if (!send_all(fd, &n_sets, 4)) return -2;
+    if (n_sets) {
+      if (!send_all(fd, counts, 4 * n_sets)) return -2;
+      if (!send_all(fd, sigs, 96 * size_t(n_sets))) return -2;
+      if (!send_all(fd, msgs, 32 * size_t(n_sets))) return -2;
+      if (total_pks && !send_all(fd, pks, 48 * size_t(total_pks))) return -2;
+    }
+  }
+
+  uint32_t resp_len;
+  if (!recv_all(fd, &resp_len, 4)) return -3;
+  if (resp_len < 1 || resp_len > 1u + n_sets + 16) return -4;
+  uint8_t overall;
+  if (!recv_all(fd, &overall, 1)) return -3;
+  uint32_t rest = resp_len - 1;
+  if (rest > 0) {
+    if (rest < n_sets) return -4;
+    if (!recv_all(fd, out_verdicts, n_sets)) return -3;
+    // drain any trailing bytes
+    uint8_t sink;
+    for (uint32_t i = n_sets; i < rest; i++) {
+      if (!recv_all(fd, &sink, 1)) return -3;
+    }
+  }
+  return overall;
+}
+}

@@ -127,8 +127,11 @@ class BeaconChain:
         self.observed_sync_contributors = set()  # (slot, validator)
 
         from .sync_pool import SyncContributionPool
+        from .validator_monitor import ValidatorMonitor
 
         self.sync_pool = SyncContributionPool(spec)
+        self.validator_monitor = ValidatorMonitor()
+        self._advanced_head = None   # (head_root, slot, state) pre-advance
 
         self.current_slot = int(genesis_state.slot)
 
@@ -213,6 +216,12 @@ class BeaconChain:
         """Parent post-state advanced to the block's slot
         (cheap_state_advance_to_obtain_committees; here a full advance —
         committee caches make it cheap)."""
+        # the state-advance timer may have pre-advanced exactly this state
+        # (state_advance_timer.rs: epoch processing hidden in the idle tail)
+        adv = self._advanced_head
+        if adv is not None and adv[0] == parent_root and adv[1] == slot:
+            self._advanced_head = None
+            return adv[2].copy()
         parent_state = self.store.get_state(parent_root)
         if parent_state is None:
             raise BlockError("parent state not in store")
@@ -291,6 +300,9 @@ class BeaconChain:
         self.store.put_block(sig_verified.block_root, sig_verified.signed_block)
         self.store.put_state(sig_verified.block_root, post_state)
         self._import_new_pubkeys(post_state)
+        self.validator_monitor.process_imported_block(
+            post_state, sig_verified.signed_block, self.preset
+        )
         self.recompute_head()
         self.op_pool.prune(post_state, self.preset)
         return sig_verified.block_root
@@ -313,14 +325,19 @@ class BeaconChain:
                     state = phase0.process_slots(
                         state, int(sb.message.slot), self.preset, spec=self.spec
                     )
-            phase0.per_block_processing(
-                state,
-                sb,
-                self.spec,
-                signature_strategy=BlockSignatureStrategy.VERIFY_BULK,
-                collected_sets=sets,
-                execution_engine=self.execution_engine,
-            )
+            try:
+                phase0.per_block_processing(
+                    state,
+                    sb,
+                    self.spec,
+                    signature_strategy=BlockSignatureStrategy.VERIFY_BULK,
+                    collected_sets=sets,
+                    execution_engine=self.execution_engine,
+                )
+            except sset.SignatureSetError as e:
+                raise BlockError(f"undecodable signature in segment: {e}") from e
+            except (AssertionError, phase0.BlockProcessingError) as e:
+                raise BlockError(f"invalid block in segment: {e}") from e
             states.append(state.copy())
         with metrics.BLOCK_SIGNATURE_VERIFY_TIMES.start_timer():
             if not self.verifier.verify_signature_sets(sets):
@@ -440,8 +457,6 @@ class BeaconChain:
         SignedAggregateAndProof three sets — selection proof, aggregator
         signature, aggregate attestation — verified in ONE device batch
         (<=3N sets), per-set fallback on poisoning."""
-        import hashlib
-
         results = []
         sets = []
         owners = []

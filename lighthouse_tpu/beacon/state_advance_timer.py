@@ -1,0 +1,63 @@
+"""State-advance timer: pre-emptive head-state advance.
+
+Mirror of /root/reference/beacon_node/beacon_chain/src/
+state_advance_timer.rs: late in each slot, the head state is advanced
+through the upcoming slot (running any epoch transition early) so block
+import at the next slot start skips the expensive part — the epoch
+processing latency is hidden in the idle tail of the previous slot.
+
+The advanced state is cached on the chain; `_state_for_block` consumes it
+when the parent is the head.
+"""
+
+import logging
+
+log = logging.getLogger("lighthouse_tpu.state_advance")
+
+
+class StateAdvanceTimer:
+    def __init__(self, chain, fraction=0.75):
+        self.chain = chain
+        self.fraction = fraction    # run at 3/4 slot (reference timing)
+
+    def advance_head_state(self):
+        """Advance a copy of the head state into the next slot and stash
+        it for the import path."""
+        from ..state_processing import phase0
+
+        chain = self.chain
+        next_slot = chain.current_slot + 1
+        # pair the root and state reads BEFORE the slow advance: if the
+        # head changes mid-advance, the stash still associates this state
+        # with ITS OWN root, and the import path's parent_root match
+        # simply misses — never a wrong-parent hit
+        root = chain.head_root
+        state = chain.head_state.copy()
+        if int(state.slot) >= next_slot:
+            return None
+        state = phase0.process_slots(
+            state, next_slot, chain.preset, spec=chain.spec
+        )
+        chain._advanced_head = (root, next_slot, state)
+        log.debug("pre-advanced head state to slot %d", next_slot)
+        return state
+
+    def run(self, executor, clock):
+        """Service loop: fire at `fraction` of every slot."""
+        last_fired = -1
+        while not executor.shutting_down:
+            slot = clock.now()
+            if (
+                slot is not None
+                and slot != last_fired
+                and clock.seconds_into_slot() >= self.fraction * clock.seconds_per_slot
+            ):
+                try:
+                    self.advance_head_state()
+                except Exception as e:  # advisory only — never fatal
+                    log.warning("state advance failed: %s", e)
+                last_fired = slot
+            if executor.sleep_or_shutdown(
+                min(clock.duration_to_next_slot() / 4, 0.25)
+            ):
+                break

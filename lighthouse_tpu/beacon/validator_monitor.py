@@ -1,0 +1,83 @@
+"""Validator monitor: per-validator observability.
+
+Mirror of /root/reference/beacon_node/beacon_chain/src/validator_monitor.rs
+(:329 registration, :394 auto-registration): track registered validator
+indices through imported blocks and attestations, recording hits/misses
+and inclusion distance, exposed as metrics and queryable summaries.
+"""
+
+import logging
+from collections import defaultdict
+
+from ..utils import metrics
+
+log = logging.getLogger("lighthouse_tpu.validator_monitor")
+
+MONITOR_ATTESTATION_HITS = metrics.counter(
+    "validator_monitor_attestation_included_total",
+    "Attestations by monitored validators included in blocks",
+)
+MONITOR_BLOCKS = metrics.counter(
+    "validator_monitor_block_proposals_total",
+    "Blocks proposed by monitored validators",
+)
+
+
+class ValidatorMonitor:
+    def __init__(self, auto_register=False):
+        self.auto_register = auto_register
+        self.monitored = set()
+        # validator -> {epoch: inclusion_delay}
+        self.attestation_inclusions = defaultdict(dict)
+        self.proposals = defaultdict(list)       # validator -> [slots]
+
+    def register(self, validator_index):
+        self.monitored.add(int(validator_index))
+
+    # ------------------------------------------------------------- hooks
+
+    def process_imported_block(self, state, signed_block, preset):
+        """Called by the chain after import (beacon_chain.rs:3335 region)."""
+        from ..state_processing import phase0
+
+        block = signed_block.message
+        proposer = int(block.proposer_index)
+        if self.auto_register:
+            self.monitored.add(proposer)
+        if proposer in self.monitored:
+            MONITOR_BLOCKS.inc()
+            self.proposals[proposer].append(int(block.slot))
+            log.info("monitored validator %d proposed slot %d", proposer,
+                     block.slot)
+        for att in block.body.attestations:
+            try:
+                idx = phase0.get_attesting_indices_np(
+                    state, att.data, att.aggregation_bits, preset
+                )
+            except Exception:
+                continue
+            delay = int(block.slot) - int(att.data.slot)
+            epoch = int(att.data.target.epoch)
+            for v in idx:
+                v = int(v)
+                if v in self.monitored:
+                    prev = self.attestation_inclusions[v].get(epoch)
+                    if prev is None or delay < prev:
+                        self.attestation_inclusions[v][epoch] = delay
+                        MONITOR_ATTESTATION_HITS.inc()
+
+    # ---------------------------------------------------------- queries
+
+    def summary(self, validator_index, current_epoch=None):
+        v = int(validator_index)
+        inclusions = self.attestation_inclusions.get(v, {})
+        out = {
+            "validator_index": v,
+            "proposals": list(self.proposals.get(v, [])),
+            "attestations_included": len(inclusions),
+            "best_inclusion_delay": min(inclusions.values()) if inclusions else None,
+        }
+        if current_epoch is not None and inclusions:
+            recent = [e for e in inclusions if e >= current_epoch - 2]
+            out["recent_hits"] = len(recent)
+        return out

@@ -1,0 +1,25 @@
+"""Host↔device bridge (SURVEY.md §7 step 3, §5.8 host↔device comm).
+
+A persistent server process owns the JAX/TPU runtime and serves batched
+`verify_signature_sets` over a unix socket; clients (the C++ library in
+csrc/bridge_client.cpp — the consumer a Rust/C++ node embeds — or the
+Python client here) ship flat arrays and get per-set verdicts back.  This
+replaces the reference's in-process rayon fan-out at
+block_signature_verifier.rs:396 with one IPC round-trip per batch, and is
+the seam where a beacon node written in another language attaches to the
+TPU backend.
+
+Wire format (little-endian), one length-prefixed frame each way:
+  request:  u32 frame_len | u8 cmd | u32 n_sets
+            | u32 pubkey_count[n_sets]
+            | signatures   n_sets x 96B (compressed G2)
+            | messages     n_sets x 32B (signing roots)
+            | pubkeys      sum(pubkey_count) x 48B (compressed G1)
+  response: u32 frame_len | u8 overall_ok | u8 verdict[n_sets]
+  cmds: 1 = verify (overall only), 2 = verify_per_set, 3 = ping
+"""
+
+from .client import BridgeClient, BridgeError
+from .server import BridgeServer
+
+__all__ = ["BridgeClient", "BridgeError", "BridgeServer"]

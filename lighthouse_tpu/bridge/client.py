@@ -1,0 +1,180 @@
+"""Bridge clients: the native C++ library binding and a pure-Python twin.
+
+The C++ client (csrc/bridge_client.cpp) is what a Rust/C++ consensus node
+links against — the `impls/tpu.rs` FFI surface of SURVEY.md §7 step 4.
+Loaded here through ctypes both to test it and to give Python callers the
+same code path.  A dead/killed server surfaces as BridgeError so callers
+degrade to their local backend (SURVEY §7 hard part 7).
+"""
+
+import ctypes
+import os
+import socket
+import struct
+import subprocess
+
+from .server import CMD_PING, CMD_VERIFY, CMD_VERIFY_PER_SET
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "..", "native", "libbridge_client.so")
+_CSRC = os.path.join(_HERE, "..", "..", "csrc", "bridge_client.cpp")
+
+
+class BridgeError(Exception):
+    pass
+
+
+def _load_native():
+    stale = not os.path.exists(_SO) or (
+        os.path.exists(_CSRC)
+        and os.path.getmtime(_CSRC) > os.path.getmtime(_SO)
+    )
+    if stale:
+        if not os.path.exists(_CSRC):
+            return None
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _CSRC],
+                check=True, capture_output=True, timeout=120,
+            )
+        except Exception:
+            if not os.path.exists(_SO):
+                return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    lib.bridge_connect.argtypes = [ctypes.c_char_p]
+    lib.bridge_connect.restype = ctypes.c_int
+    lib.bridge_close.argtypes = [ctypes.c_int]
+    lib.bridge_verify.argtypes = [
+        ctypes.c_int,            # fd
+        ctypes.c_uint8,          # cmd
+        ctypes.c_uint32,         # n_sets
+        ctypes.c_void_p,         # u32 counts[n]
+        ctypes.c_void_p,         # sigs 96n
+        ctypes.c_void_p,         # msgs 32n
+        ctypes.c_void_p,         # pks 48*sum
+        ctypes.c_uint32,         # total pubkeys
+        ctypes.c_void_p,         # out verdicts u8[n]
+    ]
+    lib.bridge_verify.restype = ctypes.c_int  # <0 error, else overall ok
+    return lib
+
+
+_native = _load_native()
+HAVE_NATIVE_CLIENT = _native is not None
+
+
+class BridgeClient:
+    """One connection; `native=True` routes through the C++ library."""
+
+    def __init__(self, path, native=None):
+        self.path = path
+        self.native = HAVE_NATIVE_CLIENT if native is None else native
+        if self.native and not HAVE_NATIVE_CLIENT:
+            raise BridgeError("native client library unavailable")
+        if self.native:
+            self._fd = _native.bridge_connect(path.encode())
+            if self._fd < 0:
+                raise BridgeError(f"cannot connect to {path}")
+            self._sock = None
+        else:
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                self._sock.connect(path)
+            except OSError as e:
+                raise BridgeError(f"cannot connect to {path}: {e}") from e
+
+    # ------------------------------------------------------------- calls
+
+    def ping(self):
+        if self.native:
+            out = (ctypes.c_uint8 * 1)()
+            rc = _native.bridge_verify(
+                self._fd, CMD_PING, 0, None, None, None, None, 0, out
+            )
+            if rc < 0:
+                raise BridgeError(f"bridge io error {rc}")
+            return True
+        self._send(struct.pack("<B", CMD_PING))
+        self._recv_payload()
+        return True
+
+    def verify(self, wire_sets, per_set=False):
+        """wire_sets: [(sig96, [pk48...], msg32)] -> (ok, [verdicts])."""
+        import numpy as np
+
+        n = len(wire_sets)
+        counts = np.array([len(pks) for _, pks, _ in wire_sets], dtype="<u4")
+        sigs = b"".join(bytes(s) for s, _, _ in wire_sets)
+        msgs = b"".join(bytes(m) for _, _, m in wire_sets)
+        pks = b"".join(
+            b"".join(bytes(pk) for pk in row) for _, row, _ in wire_sets
+        )
+        cmd = CMD_VERIFY_PER_SET if per_set else CMD_VERIFY
+        if self.native:
+            sig_buf = (ctypes.c_char * len(sigs)).from_buffer_copy(sigs)
+            msg_buf = (ctypes.c_char * len(msgs)).from_buffer_copy(msgs)
+            pk_buf = (ctypes.c_char * max(len(pks), 1)).from_buffer_copy(
+                pks or b"\x00"
+            )
+            cnt_buf = (ctypes.c_char * (4 * n)).from_buffer_copy(
+                counts.tobytes()
+            )
+            out = (ctypes.c_uint8 * max(n, 1))()
+            rc = _native.bridge_verify(
+                self._fd, cmd, n,
+                ctypes.cast(cnt_buf, ctypes.c_void_p),
+                ctypes.cast(sig_buf, ctypes.c_void_p),
+                ctypes.cast(msg_buf, ctypes.c_void_p),
+                ctypes.cast(pk_buf, ctypes.c_void_p),
+                int(counts.sum()),
+                ctypes.cast(out, ctypes.c_void_p),
+            )
+            if rc < 0:
+                raise BridgeError(f"bridge io error {rc}")
+            return bool(rc), [bool(v) for v in out[:n]]
+        frame = (
+            struct.pack("<BI", cmd, n)
+            + counts.tobytes()
+            + sigs
+            + msgs
+            + pks
+        )
+        self._send(frame)
+        payload = self._recv_payload()
+        ok = payload[0] == 1
+        verdicts = [b == 1 for b in payload[1 : 1 + n]]
+        return ok, verdicts
+
+    # ---------------------------------------------------------- plumbing
+
+    def _send(self, frame):
+        try:
+            self._sock.sendall(struct.pack("<I", len(frame)) + frame)
+        except OSError as e:
+            raise BridgeError(f"send failed: {e}") from e
+
+    def _recv_payload(self):
+        try:
+            hdr = self._recv_exact(4)
+            (length,) = struct.unpack("<I", hdr)
+            return self._recv_exact(length)
+        except OSError as e:
+            raise BridgeError(f"recv failed: {e}") from e
+
+    def _recv_exact(self, k):
+        buf = b""
+        while len(buf) < k:
+            chunk = self._sock.recv(k - len(buf))
+            if not chunk:
+                raise BridgeError("server closed connection")
+            buf += chunk
+        return buf
+
+    def close(self):
+        if self.native:
+            _native.bridge_close(self._fd)
+        elif self._sock is not None:
+            self._sock.close()

@@ -1,0 +1,187 @@
+"""Bridge server: the persistent process owning the device runtime."""
+
+import logging
+import os
+import socket
+import struct
+import threading
+
+log = logging.getLogger("lighthouse_tpu.bridge")
+
+CMD_VERIFY = 1
+CMD_VERIFY_PER_SET = 2
+CMD_PING = 3
+
+
+def _recv_exact(conn, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def decode_request(frame):
+    cmd = frame[0]
+    if cmd == CMD_PING:
+        return cmd, []
+    (n_sets,) = struct.unpack_from("<I", frame, 1)
+    off = 5
+    counts = struct.unpack_from(f"<{n_sets}I", frame, off)
+    off += 4 * n_sets
+    sigs = [frame[off + 96 * i : off + 96 * (i + 1)] for i in range(n_sets)]
+    off += 96 * n_sets
+    msgs = [frame[off + 32 * i : off + 32 * (i + 1)] for i in range(n_sets)]
+    off += 32 * n_sets
+    pks = []
+    for c in counts:
+        row = [frame[off + 48 * i : off + 48 * (i + 1)] for i in range(c)]
+        off += 48 * c
+        pks.append(row)
+    return cmd, list(zip(sigs, pks, msgs))
+
+
+class BridgeServer:
+    """Owns the socket + the verification backend.
+
+    `backend` is any object with verify_signature_sets /
+    verify_signature_sets_per_set over wire-format sets (compressed
+    bytes) — by default the device kernel behind the crypto backend seam
+    with oracle fallback (crypto/backend.py).
+    """
+
+    def __init__(self, path, backend=None):
+        self.path = path
+        self.backend = backend or _KernelBackend()
+        if os.path.exists(path):
+            os.unlink(path)
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.bind(path)
+        self.sock.listen(16)
+        self._threads = []
+        self._conns = []
+        self._stop = threading.Event()
+
+    def serve_forever(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                break
+            self._conns.append(conn)
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def start(self):
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return self
+
+    def stop(self):
+        """Tear down like a killed process would: listening socket AND
+        every accepted connection drop."""
+        self._stop.set()
+        try:
+            self.sock.close()
+        finally:
+            for conn in self._conns:
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                    conn.close()
+                except OSError:
+                    pass
+            if os.path.exists(self.path):
+                os.unlink(self.path)
+
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                (frame_len,) = struct.unpack("<I", _recv_exact(conn, 4))
+                frame = _recv_exact(conn, frame_len)
+                cmd, sets = decode_request(frame)
+                if cmd == CMD_PING:
+                    payload = struct.pack("<BB", 1, 0)
+                elif cmd == CMD_VERIFY:
+                    ok = self.backend.verify_wire_sets(sets)
+                    payload = struct.pack("<B", 1 if ok else 0) + bytes(
+                        [1 if ok else 0] * len(sets)
+                    )
+                elif cmd == CMD_VERIFY_PER_SET:
+                    verdicts = self.backend.verify_wire_sets_per_set(sets)
+                    ok = all(verdicts)
+                    payload = struct.pack("<B", 1 if ok else 0) + bytes(
+                        [1 if v else 0 for v in verdicts]
+                    )
+                else:
+                    payload = struct.pack("<B", 0)
+                conn.sendall(struct.pack("<I", len(payload)) + payload)
+        except (ConnectionError, struct.error):
+            pass
+        finally:
+            conn.close()
+
+
+class _KernelBackend:
+    """Wire sets -> decompressed oracle sets -> the backend seam."""
+
+    def __init__(self, backend_name=None):
+        import os as _os
+
+        from ..crypto.backend import SignatureVerifier
+
+        name = backend_name or _os.environ.get("BRIDGE_BACKEND", "tpu")
+        self.verifier = SignatureVerifier(name)
+
+    def _decode(self, sets):
+        from ..crypto.ref.bls import SignatureSet
+        from ..crypto.ref.curves import g1_decompress, g2_decompress
+
+        out = []
+        for sig_b, pk_rows, msg in sets:
+            try:
+                # signature subgroup is re-checked by the batch verifier
+                sig = g2_decompress(bytes(sig_b), subgroup_check=False)
+            except Exception:
+                sig = None
+            pks = []
+            for pk_b in pk_rows:
+                try:
+                    # wire pubkeys are UNTRUSTED (unlike the node's
+                    # import-time-validated pubkey cache): full
+                    # KeyValidate here — subgroup check included
+                    pks.append(g1_decompress(bytes(pk_b), subgroup_check=True))
+                except Exception:
+                    pks.append(None)
+            out.append(SignatureSet(sig, pks, bytes(msg)))
+        return out
+
+    def verify_wire_sets(self, sets):
+        return self.verifier.verify_signature_sets(self._decode(sets))
+
+    def verify_wire_sets_per_set(self, sets):
+        return self.verifier.verify_signature_sets_per_set(self._decode(sets))
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser("lighthouse-tpu-bridge")
+    ap.add_argument("--socket", default="/tmp/lighthouse_tpu_bridge.sock")
+    ap.add_argument("--backend", default="tpu", choices=["tpu", "oracle", "fake"])
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    server = BridgeServer(args.socket, backend=_KernelBackend(args.backend))
+    log.info("bridge serving on %s (backend=%s)", args.socket, args.backend)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -74,7 +74,26 @@ def build_parser_with_subs():
     insp.add_argument("--datadir", default="./datadir")
     comp = db_sub.add_parser("compact")
     comp.add_argument("--datadir", default="./datadir")
-    parser._subparser_map.update({"bn": bn, "vc": vc, "am": am, "db": db})
+
+    lcli = sub.add_parser("lcli", help="dev/bench tools (lcli analogue)")
+    _add_common(lcli)
+    lcli_sub = lcli.add_subparsers(dest="lcli_command", required=True)
+    tb = lcli_sub.add_parser(
+        "transition-blocks",
+        help="block-STF benchmark (lcli/src/transition_blocks.rs)",
+    )
+    tb.add_argument("--runs", type=int, default=3)
+    tb.add_argument("--validators", type=int, default=10000)
+    sks = lcli_sub.add_parser(
+        "skip-slots", help="epoch-processing benchmark (lcli skip-slots)"
+    )
+    sks.add_argument("--runs", type=int, default=3)
+    sks.add_argument("--validators", type=int, default=10000)
+    sks.add_argument("--slots", type=int, default=None)
+
+    parser._subparser_map.update(
+        {"bn": bn, "vc": vc, "am": am, "db": db, "lcli": lcli}
+    )
     return parser, parser._subparser_map
 
 
@@ -105,6 +124,62 @@ def main(argv=None):
         return _run_am(args)
     if args.command == "db":
         return _run_db(args)
+    if args.command == "lcli":
+        return _run_lcli(args)
+    return 2
+
+
+def _run_lcli(args):
+    """lcli transition-blocks / skip-slots: the reference's offline STF
+    benchmark harnesses (lcli/src/transition_blocks.rs:1-63)."""
+    import time
+
+    from .ssz import hash_tree_root
+    from .state_processing import phase0
+    from .testing.scale import make_scaled_state
+
+    spec = _spec_from_args(args)
+    preset = spec.preset
+    state = make_scaled_state(args.validators, spec)
+    hash_tree_root(state)  # prime caches
+
+    if args.lcli_command == "transition-blocks":
+        # replay a full-attestation-load slot `runs` times from the same
+        # pre-state (per-run isolation like --runs N)
+        times = []
+        for _ in range(args.runs):
+            st = state.copy()
+            t0 = time.perf_counter()
+            st = phase0.process_slots(st, int(st.slot) + 1, preset, spec=spec)
+            hash_tree_root(st)
+            times.append(time.perf_counter() - t0)
+        print(json.dumps({
+            "tool": "transition-blocks",
+            "validators": args.validators,
+            "runs": args.runs,
+            "mean_ms": round(sum(times) / len(times) * 1e3, 2),
+            "min_ms": round(min(times) * 1e3, 2),
+        }))
+        return 0
+
+    if args.lcli_command == "skip-slots":
+        slots = args.slots or preset.slots_per_epoch + 1
+        times = []
+        for _ in range(args.runs):
+            st = state.copy()
+            t0 = time.perf_counter()
+            st = phase0.process_slots(st, int(st.slot) + slots, preset, spec=spec)
+            hash_tree_root(st)
+            times.append(time.perf_counter() - t0)
+        print(json.dumps({
+            "tool": "skip-slots",
+            "validators": args.validators,
+            "slots": slots,
+            "runs": args.runs,
+            "mean_ms": round(sum(times) / len(times) * 1e3, 2),
+            "slots_per_sec": round(slots / (sum(times) / len(times)), 2),
+        }))
+        return 0
     return 2
 
 

@@ -23,6 +23,10 @@ class PayloadStatus:
 class ExecutionEngine:
     """What the beacon chain needs from an EL (engine_api.rs)."""
 
+    # hash of the EL block the merge-transition payload builds on (the
+    # terminal block); concrete engines must provide it for production
+    genesis_hash: bytes = None
+
     def notify_new_payload(self, payload) -> str:
         raise NotImplementedError
 

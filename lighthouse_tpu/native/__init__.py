@@ -39,8 +39,18 @@ def _build():
     return _SO
 
 
+def _stale(so, src):
+    return (
+        not os.path.exists(so)
+        or (os.path.exists(src) and os.path.getmtime(src) > os.path.getmtime(so))
+    )
+
+
 def _load():
-    path = _SO if os.path.exists(_SO) else _build()
+    src = os.path.join(_CSRC, "sha256_merkle.cpp")
+    path = _SO if not _stale(_SO, src) else _build()
+    if path is None:
+        path = _SO if os.path.exists(_SO) else None  # stale-but-present fallback
     if path is None:
         return None
     try:

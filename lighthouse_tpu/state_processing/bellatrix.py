@@ -136,7 +136,15 @@ def produce_payload(state, spec, engine, capella):
     epoch = get_current_epoch(state, preset)
     mix = bytes(get_randao_mix(state, epoch, preset))
     header_hash = bytes(state.latest_execution_payload_header.block_hash)
-    parent_hash = header_hash if header_hash != bytes(32) else engine.genesis_hash
+    if header_hash != bytes(32):
+        parent_hash = header_hash
+    else:
+        # merge-transition block: build on the engine's terminal block
+        if engine.genesis_hash is None:
+            raise phase0.BlockProcessingError(
+                "engine provides no terminal block hash for the transition"
+            )
+        parent_hash = engine.genesis_hash
     timestamp = int(state.genesis_time) + int(state.slot) * spec.seconds_per_slot
     withdrawals = get_expected_withdrawals(state, preset) if capella else None
     return engine.get_payload(parent_hash, timestamp, mix, withdrawals=withdrawals)

@@ -1,0 +1,37 @@
+"""System health snapshot (SURVEY.md §2.8 common/system_health, 241 LoC):
+load, memory, disk — from /proc, no external deps."""
+
+import os
+import shutil
+
+
+def observe(datadir="."):
+    out = {}
+    try:
+        la1, la5, la15 = os.getloadavg()
+        out["load_avg"] = {"1m": la1, "5m": la5, "15m": la15}
+    except OSError:
+        pass
+    try:
+        mem = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, v = line.split(":", 1)
+                if k in ("MemTotal", "MemAvailable"):
+                    mem[k] = int(v.strip().split()[0]) * 1024
+        out["memory"] = {
+            "total_bytes": mem.get("MemTotal"),
+            "available_bytes": mem.get("MemAvailable"),
+        }
+    except OSError:
+        pass
+    try:
+        usage = shutil.disk_usage(datadir)
+        out["disk"] = {
+            "total_bytes": usage.total,
+            "free_bytes": usage.free,
+        }
+    except OSError:
+        pass
+    out["cpu_count"] = os.cpu_count()
+    return out

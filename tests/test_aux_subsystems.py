@@ -1,0 +1,91 @@
+"""Aux subsystems: validator monitor, state-advance timer, system health,
+lcli bench tools (SURVEY.md §5.1/§5.5, beacon_chain aux services)."""
+
+import json
+
+from lighthouse_tpu.beacon.chain import BeaconChain
+from lighthouse_tpu.beacon.state_advance_timer import StateAdvanceTimer
+from lighthouse_tpu.cli import main
+from lighthouse_tpu.crypto.backend import SignatureVerifier
+from lighthouse_tpu.testing.harness import Harness
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+from lighthouse_tpu.utils.system_health import observe
+
+SPEC = ChainSpec(preset=MinimalPreset)
+
+
+def _chain_with_blocks(n=2):
+    h = Harness(8, SPEC)
+    chain = BeaconChain(h.state.copy(), SPEC, verifier=SignatureVerifier("fake"))
+    pending = []
+    for _ in range(n):
+        slot = h.state.slot + 1
+        block = h.produce_block(slot, attestations=pending)
+        h.process_block(block, strategy="no_verification")
+        chain.on_tick(slot)
+        root = chain.process_block(block)
+        pending = h.attest_slot(h.state, slot, root)
+    return h, chain, pending
+
+
+def test_validator_monitor_tracks_proposals_and_attestations():
+    h, chain, pending = _chain_with_blocks(3)
+    mon = chain.validator_monitor
+    # hooks fire at import time — register everyone before the next block,
+    # which carries the previous slot's attestations
+    for i in range(8):
+        mon.register(i)
+    slot = h.state.slot + 1
+    block = h.produce_block(slot, attestations=pending)
+    h.process_block(block, strategy="no_verification")
+    chain.on_tick(slot)
+    chain.process_block(block)
+    proposer = int(block.message.proposer_index)
+    s = mon.summary(proposer)
+    assert slot in s["proposals"]
+    # attestations from earlier slots were included in later blocks
+    hit_any = any(
+        mon.summary(i)["attestations_included"] > 0 for i in range(8)
+    )
+    assert hit_any
+
+
+def test_state_advance_timer_preadvances_head():
+    h, chain, _pending = _chain_with_blocks(1)
+    timer = StateAdvanceTimer(chain)
+    advanced = timer.advance_head_state()
+    assert advanced is not None
+    assert int(advanced.slot) == chain.current_slot + 1
+    # the import path consumes the pre-advanced state
+    slot = h.state.slot + 1
+    block = h.produce_block(slot)
+    h.process_block(block, strategy="no_verification")
+    chain.on_tick(slot)
+    root = chain.process_block(block)
+    assert chain.head_root == root
+    assert chain._advanced_head is None, "consumed"
+
+
+def test_system_health_snapshot():
+    h = observe(".")
+    assert h["cpu_count"] >= 1
+    assert h["memory"]["total_bytes"] > 0
+    assert h["disk"]["free_bytes"] > 0
+
+
+def test_lcli_transition_blocks_and_skip_slots(capsys):
+    rc = main([
+        "lcli", "--network", "minimal", "transition-blocks",
+        "--runs", "2", "--validators", "256",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["tool"] == "transition-blocks" and out["mean_ms"] > 0
+
+    rc = main([
+        "lcli", "--network", "minimal", "skip-slots",
+        "--runs", "1", "--validators", "256", "--slots", "9",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["tool"] == "skip-slots" and out["slots_per_sec"] > 0

@@ -1,0 +1,69 @@
+"""Host↔device bridge: server + native C++ client + Python client,
+kill-server fallback semantics (SURVEY.md §7 steps 3-4, hard part 7)."""
+
+import os
+
+import pytest
+
+from lighthouse_tpu.bridge import BridgeClient, BridgeError, BridgeServer
+from lighthouse_tpu.bridge.client import HAVE_NATIVE_CLIENT
+from lighthouse_tpu.bridge.server import _KernelBackend
+from lighthouse_tpu.crypto.ref import bls as RB
+from lighthouse_tpu.crypto.ref.curves import g1_compress, g2_compress
+
+
+def _wire_sets(n=3, poison_last=False):
+    sets = []
+    for i in range(n):
+        sk = 1000 + i
+        pk = g1_compress(RB.sk_to_pk(sk))
+        msg = bytes([i]) * 32
+        sig = g2_compress(RB.sign(sk, msg))
+        sets.append((sig, [pk], msg))
+    if poison_last:
+        sig, pks, _ = sets[-1]
+        sets[-1] = (sig, pks, b"\xff" * 32)
+    return sets
+
+
+@pytest.fixture()
+def server(tmp_path):
+    path = os.path.join(tmp_path, "bridge.sock")
+    srv = BridgeServer(path, backend=_KernelBackend("oracle")).start()
+    yield srv
+    srv.stop()
+
+
+@pytest.mark.parametrize(
+    "native",
+    [False] + ([True] if HAVE_NATIVE_CLIENT else []),
+    ids=["python"] + (["c++"] if HAVE_NATIVE_CLIENT else []),
+)
+def test_bridge_verify_roundtrip(server, native):
+    client = BridgeClient(server.path, native=native)
+    assert client.ping()
+    ok, verdicts = client.verify(_wire_sets(3))
+    assert ok is True and verdicts == [True, True, True]
+    ok, verdicts = client.verify(_wire_sets(3, poison_last=True))
+    assert ok is False
+    # per-set fallback isolates the poisoned set in ONE extra pass
+    ok, verdicts = client.verify(_wire_sets(3, poison_last=True), per_set=True)
+    assert verdicts == [True, True, False]
+    client.close()
+
+
+def test_native_client_built():
+    assert HAVE_NATIVE_CLIENT, "C++ bridge client must compile on this image"
+
+
+def test_dead_server_raises_bridge_error(tmp_path, server):
+    client = BridgeClient(server.path, native=False)
+    assert client.ping()
+    server.stop()   # the kill -9 scenario
+    with pytest.raises(BridgeError):
+        for _ in range(3):   # first send may land in the OS buffer
+            client.verify(_wire_sets(1))
+    client.close()
+    # reconnecting to a gone socket also surfaces cleanly
+    with pytest.raises(BridgeError):
+        BridgeClient(server.path, native=False)
