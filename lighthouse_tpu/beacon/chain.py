@@ -116,8 +116,9 @@ class BeaconChain:
 
         self.store = store if store is not None else MemoryStore()
         self.store.put_state(genesis_root, genesis_state)
-        self.head_root = genesis_root
-        self.head_state = genesis_state.copy()
+        # (root, state) as ONE tuple: readers (state-advance timer, other
+        # threads) snapshot both atomically via self._head
+        self._head = (genesis_root, genesis_state.copy())
 
         # gossip duplicate filters (observed_{block_producers,attesters,
         # aggregates}.rs and sync-committee equivalents)
@@ -126,14 +127,29 @@ class BeaconChain:
         self.observed_aggregators = set()       # (target_epoch, aggregator)
         self.observed_sync_contributors = set()  # (slot, validator)
 
+        from .events import EventBroadcaster
         from .sync_pool import SyncContributionPool
         from .validator_monitor import ValidatorMonitor
 
         self.sync_pool = SyncContributionPool(spec)
         self.validator_monitor = ValidatorMonitor()
+        self.events = EventBroadcaster()
         self._advanced_head = None   # (head_root, slot, state) pre-advance
 
         self.current_slot = int(genesis_state.slot)
+
+    # head accessors: one tuple read keeps (root, state) consistent under
+    # concurrent recompute_head (canonical_head.rs's lock, done GIL-atomic)
+    @property
+    def head_root(self):
+        return self._head[0]
+
+    @property
+    def head_state(self):
+        return self._head[1]
+
+    def head_snapshot(self):
+        return self._head
 
     # ------------------------------------------------------------- clock
 
@@ -302,6 +318,15 @@ class BeaconChain:
         self._import_new_pubkeys(post_state)
         self.validator_monitor.process_imported_block(
             post_state, sig_verified.signed_block, self.preset
+        )
+        from .events import EventKind
+
+        self.events.publish(
+            EventKind.BLOCK,
+            {
+                "slot": int(block.slot),
+                "block": sig_verified.block_root.hex(),
+            },
         )
         self.recompute_head()
         self.op_pool.prune(post_state, self.preset)
@@ -623,18 +648,35 @@ class BeaconChain:
         with metrics.HEAD_RECOMPUTE_TIMES.start_timer():
             head_root = self.fork_choice.get_head(self.current_slot)
         if head_root != self.head_root:
-            self.head_root = head_root
+            from .events import EventKind
+
+            old_root = self.head_root
             state = self.store.get_state(head_root)
-            if state is not None:
-                self.head_state = state.copy()
+            if state is None:
+                # a head whose state is gone is a store invariant breach;
+                # keep the old consistent (root, state) pair rather than
+                # pairing a new root with a stale state
+                log.error(
+                    "fork choice elected %s but its state is not in the "
+                    "store; keeping previous head", head_root.hex()
+                )
+                return self.head_root
+            new_state = state.copy()
+            self._head = (head_root, new_state)
+            self.events.publish(
+                EventKind.HEAD,
+                {
+                    "slot": int(new_state.slot),
+                    "block": head_root.hex(),
+                    "previous": old_root.hex(),
+                },
+            )
             # engine fcU on head change (execution_layer forkchoiceUpdated)
             if self.execution_engine is not None and hasattr(
-                self.head_state, "latest_execution_payload_header"
+                new_state, "latest_execution_payload_header"
             ):
                 self.execution_engine.notify_forkchoice_updated(
-                    bytes(
-                        self.head_state.latest_execution_payload_header.block_hash
-                    ),
+                    bytes(new_state.latest_execution_payload_header.block_hash),
                     bytes(32),
                 )
         return self.head_root

@@ -1,0 +1,47 @@
+"""Chain event fan-out (SSE feed + head-event broadcast).
+
+Mirror of /root/reference/beacon_node/beacon_chain/src/events.rs (the
+SSE stream http_api serves) and common/oneshot_broadcast (head-event
+fan-out): subscribers get every event after their subscription point;
+`EventKind` names follow the beacon-APIs SSE topics.
+"""
+
+import json
+import queue
+import threading
+
+
+class EventKind:
+    HEAD = "head"
+    BLOCK = "block"
+    ATTESTATION = "attestation"
+    FINALIZED_CHECKPOINT = "finalized_checkpoint"
+    CHAIN_REORG = "chain_reorg"
+
+
+class EventBroadcaster:
+    def __init__(self, max_queue=1024):
+        self._subs = []
+        self._lock = threading.Lock()
+        self.max_queue = max_queue
+
+    def subscribe(self, kinds=None):
+        """Returns a Queue of (kind, payload) events."""
+        q = queue.Queue(maxsize=self.max_queue)
+        with self._lock:
+            self._subs.append((q, set(kinds) if kinds else None))
+        return q
+
+    def publish(self, kind, payload):
+        with self._lock:
+            subs = list(self._subs)
+        for q, kinds in subs:
+            if kinds is not None and kind not in kinds:
+                continue
+            try:
+                q.put_nowait((kind, payload))
+            except queue.Full:
+                pass  # slow consumer: drop (SSE semantics)
+
+    def sse_frame(self, kind, payload) -> bytes:
+        return f"event: {kind}\ndata: {json.dumps(payload)}\n\n".encode()

@@ -27,12 +27,11 @@ class StateAdvanceTimer:
 
         chain = self.chain
         next_slot = chain.current_slot + 1
-        # pair the root and state reads BEFORE the slow advance: if the
-        # head changes mid-advance, the stash still associates this state
-        # with ITS OWN root, and the import path's parent_root match
-        # simply misses — never a wrong-parent hit
-        root = chain.head_root
-        state = chain.head_state.copy()
+        # one atomic snapshot: (root, state) can never be mismatched even
+        # if recompute_head runs concurrently — a later head change only
+        # makes the stash MISS in _state_for_block, never hit wrong
+        root, state = chain.head_snapshot()
+        state = state.copy()
         if int(state.slot) >= next_slot:
             return None
         state = phase0.process_slots(

@@ -62,9 +62,13 @@ class ValidatorMonitor:
                 v = int(v)
                 if v in self.monitored:
                     prev = self.attestation_inclusions[v].get(epoch)
-                    if prev is None or delay < prev:
-                        self.attestation_inclusions[v][epoch] = delay
+                    if prev is None:
+                        # one logical inclusion per (validator, epoch);
+                        # later sightings only improve the recorded delay
                         MONITOR_ATTESTATION_HITS.inc()
+                        self.attestation_inclusions[v][epoch] = delay
+                    elif delay < prev:
+                        self.attestation_inclusions[v][epoch] = delay
 
     # ---------------------------------------------------------- queries
 

@@ -24,6 +24,23 @@ class BridgeError(Exception):
     pass
 
 
+_native_cache = [False, None]   # (loaded?, lib)
+
+
+def _get_native():
+    """Lazy load: the (possibly slow) g++ build runs on first USE, not at
+    package import."""
+    if _native_cache[0]:
+        return _native_cache[1]
+    _native_cache[0] = True
+    _native_cache[1] = _load_native()
+    return _native_cache[1]
+
+
+def have_native_client():
+    return _get_native() is not None
+
+
 def _load_native():
     stale = not os.path.exists(_SO) or (
         os.path.exists(_CSRC)
@@ -62,20 +79,16 @@ def _load_native():
     return lib
 
 
-_native = _load_native()
-HAVE_NATIVE_CLIENT = _native is not None
-
-
 class BridgeClient:
     """One connection; `native=True` routes through the C++ library."""
 
     def __init__(self, path, native=None):
         self.path = path
-        self.native = HAVE_NATIVE_CLIENT if native is None else native
-        if self.native and not HAVE_NATIVE_CLIENT:
+        self.native = have_native_client() if native is None else native
+        if self.native and not have_native_client():
             raise BridgeError("native client library unavailable")
         if self.native:
-            self._fd = _native.bridge_connect(path.encode())
+            self._fd = _get_native().bridge_connect(path.encode())
             if self._fd < 0:
                 raise BridgeError(f"cannot connect to {path}")
             self._sock = None
@@ -91,7 +104,7 @@ class BridgeClient:
     def ping(self):
         if self.native:
             out = (ctypes.c_uint8 * 1)()
-            rc = _native.bridge_verify(
+            rc = _get_native().bridge_verify(
                 self._fd, CMD_PING, 0, None, None, None, None, 0, out
             )
             if rc < 0:
@@ -123,7 +136,7 @@ class BridgeClient:
                 counts.tobytes()
             )
             out = (ctypes.c_uint8 * max(n, 1))()
-            rc = _native.bridge_verify(
+            rc = _get_native().bridge_verify(
                 self._fd, cmd, n,
                 ctypes.cast(cnt_buf, ctypes.c_void_p),
                 ctypes.cast(sig_buf, ctypes.c_void_p),
@@ -175,6 +188,6 @@ class BridgeClient:
 
     def close(self):
         if self.native:
-            _native.bridge_close(self._fd)
+            _get_native().bridge_close(self._fd)
         elif self._sock is not None:
             self._sock.close()

@@ -144,18 +144,30 @@ def _run_lcli(args):
     hash_tree_root(state)  # prime caches
 
     if args.lcli_command == "transition-blocks":
-        # replay a full-attestation-load slot `runs` times from the same
-        # pre-state (per-run isolation like --runs N)
+        # a REAL per_block_processing per run: a full-attestation-load
+        # block (every committee of the previous slot) applied to the same
+        # pre-state with NoVerification, mirroring transition_blocks.rs
+        from .ssz import hash_tree_root as _htr
+        from .testing.scale import build_full_block
+
+        pre = phase0.process_slots(
+            state.copy(), int(state.slot) + 1, preset, spec=spec
+        )
+        signed = build_full_block(pre, spec)
         times = []
         for _ in range(args.runs):
-            st = state.copy()
+            st = pre.copy()
             t0 = time.perf_counter()
-            st = phase0.process_slots(st, int(st.slot) + 1, preset, spec=spec)
-            hash_tree_root(st)
+            phase0.per_block_processing(
+                st, signed, spec,
+                signature_strategy=phase0.BlockSignatureStrategy.NO_VERIFICATION,
+            )
+            _htr(st)
             times.append(time.perf_counter() - t0)
         print(json.dumps({
             "tool": "transition-blocks",
             "validators": args.validators,
+            "attestations": len(signed.message.body.attestations),
             "runs": args.runs,
             "mean_ms": round(sum(times) / len(times) * 1e3, 2),
             "min_ms": round(min(times) * 1e3, 2),

@@ -1,0 +1,8 @@
+"""Eth1 layer (SURVEY.md §2.5 eth1, ~3.7k LoC): deposit-contract log
+ingestion, the incremental deposit Merkle tree, deposit proofs, eth1-data
+voting, and eth1-driven genesis."""
+
+from .deposit_tree import DepositTree
+from .service import Eth1Cache, MockEth1Chain, get_eth1_vote
+
+__all__ = ["DepositTree", "Eth1Cache", "MockEth1Chain", "get_eth1_vote"]

@@ -1,0 +1,72 @@
+"""Incremental deposit Merkle tree (depth 32) + branch proofs.
+
+Mirror of the deposit-contract tree the reference maintains in
+/root/reference/beacon_node/eth1/src/deposit_cache.rs: append-only
+incremental Merkleization (the deposit contract's own algorithm), proof
+generation for `Deposit.proof` (33 nodes: branch + length mix-in), and
+the `deposit_root` the chain checks proofs against
+(state_processing process_deposit's verify_merkle_branch).
+"""
+
+import hashlib
+
+from ..ssz import hash_tree_root
+from ..ssz.hash import ZERO_HASHES
+
+DEPOSIT_CONTRACT_TREE_DEPTH = 32
+
+
+def _sha(x):
+    return hashlib.sha256(x).digest()
+
+
+class DepositTree:
+    """Append-only incremental Merkle tree: O(depth) per append, O(n)
+    memory for proofs over all historical leaves."""
+
+    def __init__(self):
+        self.leaves = []          # DepositData tree-hash roots
+
+    def push(self, deposit_data):
+        self.leaves.append(hash_tree_root(deposit_data))
+
+    def __len__(self):
+        return len(self.leaves)
+
+    def root(self, count=None):
+        """deposit_root over the first `count` leaves (mix_in_length)."""
+        count = len(self.leaves) if count is None else count
+        layer = list(self.leaves[:count])
+        for d in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            nxt = []
+            for i in range(0, len(layer) - len(layer) % 2, 2):
+                nxt.append(_sha(layer[i] + layer[i + 1]))
+            if len(layer) % 2:
+                nxt.append(_sha(layer[-1] + ZERO_HASHES[d]))
+            layer = nxt or [ZERO_HASHES[d + 1]]
+        return _sha(layer[0] + count.to_bytes(32, "little"))
+
+    def proof(self, index, count=None):
+        """The 33-element branch for leaf `index` within the tree of
+        `count` leaves: 32 sibling nodes + the little-endian count word
+        (what `Deposit.proof` carries and _verify_merkle_branch walks)."""
+        count = len(self.leaves) if count is None else count
+        assert 0 <= index < count
+        branch = []
+        layer = list(self.leaves[:count])
+        idx = index
+        for d in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            sibling = idx ^ 1
+            if sibling < len(layer):
+                branch.append(layer[sibling])
+            else:
+                branch.append(ZERO_HASHES[d])
+            nxt = []
+            for i in range(0, len(layer) - len(layer) % 2, 2):
+                nxt.append(_sha(layer[i] + layer[i + 1]))
+            if len(layer) % 2:
+                nxt.append(_sha(layer[-1] + ZERO_HASHES[d]))
+            layer = nxt or [ZERO_HASHES[d + 1]]
+            idx //= 2
+        branch.append(count.to_bytes(32, "little"))
+        return branch

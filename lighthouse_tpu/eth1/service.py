@@ -1,0 +1,188 @@
+"""Eth1 cache + voting + eth1-driven genesis.
+
+Mirror of /root/reference/beacon_node/eth1/src/{service,deposit_cache,
+block_cache}.rs and genesis/src/eth1_genesis_service.rs: an eth1 block
+cache fed by a (mock) chain, the deposit cache answering "deposits with
+proofs for range [a, b)", the spec's `get_eth1_vote` majority/fallback
+rule, and `initialize_beacon_state_from_eth1`.
+"""
+
+import hashlib
+from dataclasses import dataclass, field
+
+from ..ssz import hash_tree_root
+from ..state_processing import phase0
+from ..types.containers import DepositData, DepositMessage
+from ..types.state import state_types
+from .deposit_tree import DepositTree
+
+ETH1_FOLLOW_DISTANCE = 2048
+SECONDS_PER_ETH1_BLOCK = 14
+
+
+@dataclass
+class Eth1Block:
+    number: int
+    hash: bytes
+    timestamp: int
+    deposit_count: int
+    deposit_root: bytes = b""
+
+
+class MockEth1Chain:
+    """Deterministic eth1 chain for tests (eth1_test_rig's ganache role)."""
+
+    def __init__(self, genesis_timestamp=0, seconds_per_block=SECONDS_PER_ETH1_BLOCK):
+        self.blocks = []
+        self.tree = DepositTree()
+        self.deposits = []        # DepositData in log order
+        self.seconds_per_block = seconds_per_block
+        self._mine(genesis_timestamp)
+
+    def _mine(self, timestamp=None):
+        n = len(self.blocks)
+        ts = (
+            timestamp
+            if timestamp is not None
+            else self.blocks[-1].timestamp + self.seconds_per_block
+        )
+        blk = Eth1Block(
+            number=n,
+            hash=hashlib.sha256(f"eth1-{n}".encode()).digest(),
+            timestamp=ts,
+            deposit_count=len(self.deposits),
+            deposit_root=self.tree.root(),
+        )
+        self.blocks.append(blk)
+        return blk
+
+    def mine_blocks(self, k=1):
+        for _ in range(k):
+            self._mine()
+        return self.blocks[-1]
+
+    def submit_deposit(self, deposit_data):
+        """A validator deposit lands in the NEXT mined block's log range."""
+        self.deposits.append(deposit_data)
+        self.tree.push(deposit_data)
+
+
+class Eth1Cache:
+    """The node-side cache: follows the eth1 chain at a distance, serves
+    deposits-with-proofs and candidate eth1 votes."""
+
+    def __init__(self, chain, follow_distance=8):
+        self.chain = chain
+        self.follow_distance = follow_distance
+
+    def head_block(self):
+        idx = max(0, len(self.chain.blocks) - 1 - self.follow_distance)
+        return self.chain.blocks[idx]
+
+    def deposits_for_range(self, start_index, end_index, T):
+        """Deposit objects with proofs valid against deposit_root at
+        `end_index` (what block production packs for
+        state.eth1_deposit_index..eth1_data.deposit_count)."""
+        out = []
+        for i in range(start_index, end_index):
+            proof = self.chain.tree.proof(i, count=end_index)
+            out.append(
+                T.Deposit(proof=proof, data=self.chain.deposits[i])
+            )
+        return out
+
+    def eth1_data_for_block(self, block):
+        from ..types.state import state_types as _st
+
+        return {
+            "deposit_root": self.chain.tree.root(block.deposit_count),
+            "deposit_count": block.deposit_count,
+            "block_hash": block.hash,
+        }
+
+
+def get_eth1_vote(state, cache, preset):
+    """Spec get_eth1_vote: majority among in-period votes over valid
+    candidates; fall back to the followed head's eth1 data."""
+    T = state_types(preset)
+    period_votes = list(state.eth1_data_votes)
+    candidate = cache.eth1_data_for_block(cache.head_block())
+    default = T.Eth1Data(**candidate)
+    counts = {}
+    for v in period_votes:
+        key = (bytes(v.deposit_root), int(v.deposit_count), bytes(v.block_hash))
+        # never vote below the chain's recorded deposit count
+        if int(v.deposit_count) < int(state.eth1_data.deposit_count):
+            continue
+        counts[key] = counts.get(key, 0) + 1
+    if counts:
+        best = max(counts.items(), key=lambda kv: (kv[1], kv[0]))
+        key = best[0]
+        return T.Eth1Data(
+            deposit_root=key[0], deposit_count=key[1], block_hash=key[2]
+        )
+    return default
+
+
+def make_deposit_data(sk, amount, spec, withdrawal_credentials=None):
+    """A fully-signed DepositData (proof-of-possession over the
+    deposit-message domain; signature_sets.rs deposit rules)."""
+    from ..crypto.ref import bls as RB
+    from ..crypto.ref.curves import g1_compress, g2_compress
+    from ..state_processing.signature_sets import deposit_pubkey_signature_message
+    from ..types import Domain, compute_domain, compute_signing_root
+
+    pk = g1_compress(RB.sk_to_pk(sk))
+    wc = withdrawal_credentials or (
+        b"\x00" + hashlib.sha256(pk).digest()[1:]
+    )
+    msg = DepositMessage(
+        pubkey=pk, withdrawal_credentials=wc, amount=amount
+    )
+    domain = compute_domain(
+        Domain.DEPOSIT, spec.genesis_fork_version, b"\x00" * 32
+    )
+    root = compute_signing_root(msg, domain)
+    sig = g2_compress(RB.sign(sk, root))
+    return DepositData(
+        pubkey=pk, withdrawal_credentials=wc, amount=amount, signature=sig
+    )
+
+
+def initialize_beacon_state_from_eth1(eth1_block, deposits, spec, T=None):
+    """Spec initialize_beacon_state_from_eth1 (genesis/src/
+    eth1_genesis_service.rs): apply every genesis deposit through the
+    deposit STF, then activate the funded validators."""
+    from ..types.containers import BeaconBlockHeader, Fork
+
+    preset = spec.preset
+    T = T or state_types(preset)
+    state = T.BeaconState(
+        genesis_time=eth1_block.timestamp + 1200,  # GENESIS_DELAY-ish
+        fork=Fork(
+            previous_version=spec.genesis_fork_version,
+            current_version=spec.genesis_fork_version,
+            epoch=0,
+        ),
+        latest_block_header=BeaconBlockHeader(
+            body_root=hash_tree_root(T.BeaconBlockBody())
+        ),
+        eth1_data=T.Eth1Data(
+            deposit_root=eth1_block.deposit_root,
+            deposit_count=eth1_block.deposit_count,
+            block_hash=eth1_block.hash,
+        ),
+        randao_mixes=[eth1_block.hash] * preset.epochs_per_historical_vector,
+    )
+    for deposit in deposits:
+        phase0.process_deposit(state, deposit, spec)
+    # genesis activations: funded validators go live at epoch 0
+    for i, v in enumerate(state.validators):
+        if v.effective_balance == phase0.MAX_EFFECTIVE_BALANCE:
+            v.activation_eligibility_epoch = 0
+            v.activation_epoch = 0
+    validators_type = dict(T.BeaconState.fields)["validators"]
+    state.genesis_validators_root = hash_tree_root(
+        validators_type, state.validators
+    )
+    return state

@@ -260,14 +260,20 @@ class ForkChoice:
     # ------------------------------------------------------------ helpers
 
     def _ancestor_at_slot(self, root, slot):
-        """Walk parents until the first node at or below `slot`."""
+        """Walk parents until the first node at or below `slot`.
+
+        A checkpoint-synced store has no history below its anchor: when
+        the walk reaches the parentless anchor node, the anchor IS the
+        deepest known ancestor (proto_array is_descendant semantics —
+        everything connected to the anchor descends from it)."""
         idx = self.proto.indices.get(root)
+        node = None
         while idx is not None:
             node = self.proto.nodes[idx]
             if node.slot <= slot:
                 return node.root
             idx = node.parent
-        return None
+        return node.root if node is not None else None
 
     def contains_block(self, root):
         return self.proto.contains_block(root)

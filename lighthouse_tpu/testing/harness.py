@@ -56,7 +56,7 @@ class Harness:
 
     # ------------------------------------------------------- block producer
 
-    def produce_block(self, slot, attestations=()):
+    def produce_block(self, slot, attestations=(), deposits=()):
         """Build a valid signed block at `slot` on the current state
         (phase0 or altair body depending on the state's fork)."""
         spec, preset = self.spec, self.preset
@@ -80,6 +80,7 @@ class Harness:
             randao_reveal=randao_reveal,
             eth1_data=state.eth1_data,
             attestations=list(attestations),
+            deposits=list(deposits),
         )
         if altair:
             body_kwargs["sync_aggregate"] = self._sync_aggregate(state, slot)

@@ -78,6 +78,58 @@ def make_scaled_state(n_validators, spec, epoch=4, participation=0.99, seed=0):
     return state
 
 
+def build_full_block(state, spec, participation=0.99, seed=1):
+    """An unsigned full-load block for the state's current slot: one
+    attestation per committee of the previous slot, full bits — the
+    transition-blocks benchmark payload (no valid signatures; apply with
+    NoVerification)."""
+    preset = spec.preset
+    T = state_types(preset)
+    rng = np.random.default_rng(seed)
+    slot = int(state.slot)
+    att_slot = slot - 1
+    cache = committees_for_epoch(state, att_slot // preset.slots_per_epoch, preset)
+    target_epoch = att_slot // preset.slots_per_epoch
+    target_root = phase0.get_block_root(state, target_epoch, preset)
+    source = (
+        state.current_justified_checkpoint
+        if target_epoch == phase0.get_current_epoch(state, preset)
+        else state.previous_justified_checkpoint
+    )
+    atts = []
+    for index in range(cache.committees_per_slot):
+        committee = cache.committee(att_slot, index)
+        bits = (rng.random(len(committee)) < participation).astype(int).tolist()
+        if not any(bits):
+            bits[0] = 1
+        atts.append(
+            T.Attestation(
+                aggregation_bits=bits,
+                data=AttestationData(
+                    slot=att_slot,
+                    index=index,
+                    beacon_block_root=phase0.get_block_root_at_slot(
+                        state, att_slot, preset
+                    ),
+                    source=source,
+                    target=Checkpoint(epoch=target_epoch, root=target_root),
+                ),
+                signature=b"\x00" * 96,
+            )
+        )
+    block = T.BeaconBlock(
+        slot=slot,
+        proposer_index=phase0.get_beacon_proposer_index(state, preset),
+        parent_root=phase0.hash_tree_root(state.latest_block_header),
+        state_root=bytes(32),
+        body=T.BeaconBlockBody(
+            eth1_data=state.eth1_data,
+            attestations=atts[: preset.max_attestations],
+        ),
+    )
+    return T.SignedBeaconBlock(message=block, signature=b"\x00" * 96)
+
+
 def fill_epoch_attestations(state, epoch, spec, participation, rng, target="previous"):
     """Append PendingAttestations covering every committee of `epoch`."""
     preset = spec.preset

@@ -1,0 +1,7 @@
+"""Chain watcher (SURVEY.md §2.7 `watch`, ~6.4k LoC): an external
+monitoring process polling a beacon node and recording per-slot/per-epoch
+analytics into sqlite (the reference uses postgres/diesel)."""
+
+from .updater import WatchDB, WatchUpdater
+
+__all__ = ["WatchDB", "WatchUpdater"]

@@ -1,0 +1,95 @@
+"""Watch updater: poll a BN, persist canonical slots + finality to sqlite.
+
+Mirror of /root/reference/watch (updater polling `canonical_slots`,
+block packing/rewards tables; watch/README.md:1-9): the updater walks new
+canonical blocks since its high-water mark through the Beacon API client
+(or a DirectBeaconNode) and records them; queries serve the analytics
+HTTP surface of the reference.
+"""
+
+import sqlite3
+import threading
+
+
+class WatchDB:
+    def __init__(self, path=":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        self._conn.executescript(
+            """
+            CREATE TABLE IF NOT EXISTS canonical_slots (
+                slot INTEGER PRIMARY KEY,
+                root TEXT NOT NULL,
+                proposer INTEGER,
+                attestation_count INTEGER
+            );
+            CREATE TABLE IF NOT EXISTS finality (
+                epoch INTEGER PRIMARY KEY,
+                finalized_root TEXT NOT NULL
+            );
+            """
+        )
+
+    def record_slot(self, slot, root, proposer, attestation_count):
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO canonical_slots VALUES (?, ?, ?, ?)",
+                (slot, root.hex(), proposer, attestation_count),
+            )
+            self._conn.commit()
+
+    def record_finality(self, epoch, root):
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO finality VALUES (?, ?)",
+                (epoch, root.hex()),
+            )
+            self._conn.commit()
+
+    def highest_slot(self):
+        row = self._conn.execute(
+            "SELECT MAX(slot) FROM canonical_slots"
+        ).fetchone()
+        return row[0] if row[0] is not None else -1
+
+    def slots(self):
+        return list(
+            self._conn.execute(
+                "SELECT slot, root, proposer, attestation_count "
+                "FROM canonical_slots ORDER BY slot"
+            )
+        )
+
+    def close(self):
+        self._conn.close()
+
+
+class WatchUpdater:
+    """One poll cycle = walk canonical blocks above the high-water mark."""
+
+    def __init__(self, chain, db=None):
+        self.chain = chain
+        self.db = db or WatchDB()
+
+    def poll(self):
+        chain = self.chain
+        seen_up_to = self.db.highest_slot()
+        new = []
+        root = chain.head_root
+        while root is not None:
+            blk = chain.store.get_block(root)
+            if blk is None or int(blk.message.slot) <= seen_up_to:
+                break
+            new.append((root, blk))
+            root = bytes(blk.message.parent_root)
+        for root, blk in reversed(new):
+            self.db.record_slot(
+                int(blk.message.slot),
+                root,
+                int(blk.message.proposer_index),
+                len(blk.message.body.attestations),
+            )
+        fin_epoch, fin_root = chain.fork_choice.store.finalized_checkpoint
+        if fin_epoch > 0:
+            self.db.record_finality(fin_epoch, fin_root)
+        return len(new)

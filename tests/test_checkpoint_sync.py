@@ -1,0 +1,98 @@
+"""Checkpoint sync (weak subjectivity) + backwards backfill.
+
+Mirrors /root/reference/beacon_node/client/src/builder.rs:209-431
+(weak_subjectivity_state entry) and network/src/sync BackFillSync
+(SURVEY.md §5.4)."""
+
+import pytest
+
+from lighthouse_tpu.beacon.beacon_processor import BeaconProcessor
+from lighthouse_tpu.beacon.chain import BeaconChain
+from lighthouse_tpu.crypto.backend import SignatureVerifier
+from lighthouse_tpu.network.gossip import GossipBus, ReqResp
+from lighthouse_tpu.network.router import Router
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.testing.harness import Harness
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+
+SPEC = ChainSpec(preset=MinimalPreset)
+
+
+def _synced_node(n_slots=6):
+    """A full node with history, serving req/resp."""
+    h = Harness(8, SPEC)
+    chain = BeaconChain(h.state.copy(), SPEC, verifier=SignatureVerifier("oracle"))
+    for _ in range(n_slots):
+        slot = h.state.slot + 1
+        block = h.produce_block(slot)
+        h.process_block(block, strategy="no_verification")
+        chain.on_tick(slot)
+        chain.process_block(block)
+    return h, chain
+
+
+def test_checkpoint_sync_then_backfill():
+    h, full_chain = _synced_node(6)
+    bus, reqresp = GossipBus(), ReqResp()
+    full_router = Router(
+        "full", full_chain, BeaconProcessor(full_chain), bus, reqresp
+    )
+
+    # checkpoint node boots from the FULL node's current head state — the
+    # trusted finalized state of builder.rs:209 — with no history
+    checkpoint_state = full_chain.head_state.copy()
+    cp_chain = BeaconChain(
+        checkpoint_state, SPEC, verifier=SignatureVerifier("oracle")
+    )
+    cp_router = Router(
+        "cp", cp_chain, BeaconProcessor(cp_chain), bus, reqresp
+    )
+    assert cp_chain.store.get_block(full_chain.head_root) is None
+
+    # forward: new blocks continue from the checkpoint via gossip
+    slot = h.state.slot + 1
+    block = h.produce_block(slot)
+    h.process_block(block, strategy="no_verification")
+    for chain in (full_chain, cp_chain):
+        chain.on_tick(slot)
+    full_chain.process_block(block)
+    root = cp_chain.process_block(block)
+    assert cp_chain.head_root == root
+
+    # backwards: history fills from the serving peer with one signature
+    # batch per epoch-batch, linked to the anchor
+    n = cp_router.backfill_from("full")
+    assert n == 6
+    # every historical block is now retrievable locally
+    r = full_chain.head_root
+    while True:
+        b = cp_chain.store.get_block(r)
+        assert b is not None
+        if int(b.message.slot) <= 1:
+            break
+        r = bytes(b.message.parent_root)
+
+
+def test_backfill_rejects_unlinked_history():
+    h, full_chain = _synced_node(4)
+    bus, reqresp = GossipBus(), ReqResp()
+    Router("full", full_chain, BeaconProcessor(full_chain), bus, reqresp)
+
+    cp_chain = BeaconChain(
+        full_chain.head_state.copy(), SPEC, verifier=SignatureVerifier("fake")
+    )
+    cp_router = Router("cp", cp_chain, BeaconProcessor(cp_chain), bus, reqresp)
+
+    # tamper with the served history: swap one block for a forged one
+    victim_root = None
+    r = full_chain.head_root
+    for _ in range(2):
+        b = full_chain.store.get_block(r)
+        r = bytes(b.message.parent_root)
+    victim = full_chain.store.get_block(r)
+    forged = type(victim)(message=victim.message, signature=b"\x11" * 96)
+    forged.message.state_root = b"\x66" * 32
+    full_chain.store.put_block(r, forged)
+
+    with pytest.raises(ValueError, match="link"):
+        cp_router.backfill_from("full")

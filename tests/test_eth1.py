@@ -1,0 +1,112 @@
+"""Eth1 layer: deposit tree proofs against the STF verifier, deposit
+ingestion through blocks, eth1 voting, eth1-driven genesis (SURVEY rows
+21/37)."""
+
+from lighthouse_tpu.eth1 import DepositTree, Eth1Cache, MockEth1Chain, get_eth1_vote
+from lighthouse_tpu.eth1.service import (
+    initialize_beacon_state_from_eth1,
+    make_deposit_data,
+)
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.state_processing import phase0
+from lighthouse_tpu.state_processing.phase0 import _verify_merkle_branch
+from lighthouse_tpu.testing.harness import Harness
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+from lighthouse_tpu.types.state import state_types
+
+SPEC = ChainSpec(preset=MinimalPreset)
+T = state_types(MinimalPreset)
+
+
+def test_deposit_tree_proofs_verify():
+    tree = DepositTree()
+    datas = [make_deposit_data(100 + i, 32 * 10**9, SPEC) for i in range(5)]
+    for d in datas:
+        tree.push(d)
+    root = tree.root()
+    for i, d in enumerate(datas):
+        branch = tree.proof(i)
+        assert _verify_merkle_branch(
+            hash_tree_root(d), branch, 32 + 1, i, root
+        ), i
+    # proofs against a historical count too
+    root3 = tree.root(3)
+    branch = tree.proof(1, count=3)
+    assert _verify_merkle_branch(hash_tree_root(datas[1]), branch, 33, 1, root3)
+
+
+def test_deposit_flows_through_block_and_activates():
+    h = Harness(8, SPEC)
+    eth1 = MockEth1Chain()
+    # pre-credit the existing 8 validators as already-deposited? No — the
+    # chain's deposit index starts at 8 from interop genesis; seed the
+    # mock tree with 8 placeholder deposits matching those
+    for i in range(8):
+        eth1.submit_deposit(make_deposit_data(h.keypairs[i][0], 32 * 10**9, SPEC))
+    new_sk = 999331
+    eth1.submit_deposit(make_deposit_data(new_sk, 32 * 10**9, SPEC))
+    eth1.mine_blocks(1)
+    cache = Eth1Cache(eth1, follow_distance=0)
+
+    # the block must carry the pending deposit: set eth1_data to the vote
+    blk_eth1 = cache.eth1_data_for_block(cache.head_block())
+    h.state.eth1_data = T.Eth1Data(**blk_eth1)
+    deposits = cache.deposits_for_range(8, 9, T)
+
+    slot = h.state.slot + 1
+    block = h.produce_block(slot, deposits=deposits)
+    h.process_block(block, strategy="no_verification")
+    assert len(h.state.validators) == 9
+    assert h.state.eth1_deposit_index == 9
+
+
+def test_eth1_vote_majority_and_fallback():
+    h = Harness(8, SPEC)
+    eth1 = MockEth1Chain()
+    for i in range(8):
+        eth1.submit_deposit(make_deposit_data(h.keypairs[i][0], 32 * 10**9, SPEC))
+    eth1.mine_blocks(3)
+    cache = Eth1Cache(eth1, follow_distance=0)
+    # no votes yet: fallback to head
+    v = get_eth1_vote(h.state, cache, SPEC.preset)
+    assert bytes(v.block_hash) == cache.head_block().hash
+    # majority wins
+    winner = T.Eth1Data(
+        deposit_root=b"\x01" * 32, deposit_count=9, block_hash=b"\x02" * 32
+    )
+    h.state.eth1_data_votes = [winner, winner, v]
+    v2 = get_eth1_vote(h.state, cache, SPEC.preset)
+    assert bytes(v2.block_hash) == b"\x02" * 32
+    # votes below the recorded deposit count never win
+    h.state.eth1_data = T.Eth1Data(deposit_count=50)
+    v3 = get_eth1_vote(h.state, cache, SPEC.preset)
+    assert bytes(v3.block_hash) == cache.head_block().hash
+
+
+def test_eth1_genesis():
+    eth1 = MockEth1Chain(genesis_timestamp=1000)
+    sks = [5551, 5552, 5553, 5554]
+    for sk in sks:
+        eth1.submit_deposit(make_deposit_data(sk, 32 * 10**9, SPEC))
+    blk = eth1.mine_blocks(1)
+    deposits = Eth1Cache(eth1, follow_distance=0).deposits_for_range(
+        0, len(sks), T
+    )
+    state = initialize_beacon_state_from_eth1(
+        Eth1Cache(eth1, follow_distance=0).head_block(), deposits, SPEC
+    )
+    assert len(state.validators) == 4
+    assert all(
+        state.validators[i].activation_epoch == 0 for i in range(4)
+    )
+    assert int(state.eth1_deposit_index) == 4
+    # invalid-signature deposits are no-ops, not errors
+    bad = make_deposit_data(7777, 32 * 10**9, SPEC)
+    bad.signature = b"\xc0" + bytes(95)
+    eth1.submit_deposit(bad)
+    blk2 = eth1.mine_blocks(1)
+    deposits2 = Eth1Cache(eth1, follow_distance=0).deposits_for_range(0, 5, T)
+    state2 = initialize_beacon_state_from_eth1(
+        Eth1Cache(eth1, follow_distance=0).head_block(), deposits2, SPEC
+    )
+    assert len(state2.validators) == 4, "bad-PoP deposit skipped"

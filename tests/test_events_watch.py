@@ -1,0 +1,55 @@
+"""Chain event stream + watch analytics (SURVEY §5.5, §2.7 watch)."""
+
+from lighthouse_tpu.beacon.chain import BeaconChain
+from lighthouse_tpu.beacon.events import EventKind
+from lighthouse_tpu.crypto.backend import SignatureVerifier
+from lighthouse_tpu.testing.harness import Harness
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+from lighthouse_tpu.watch import WatchDB, WatchUpdater
+
+SPEC = ChainSpec(preset=MinimalPreset)
+
+
+def _grow(chain, h, n):
+    roots = []
+    for _ in range(n):
+        slot = h.state.slot + 1
+        block = h.produce_block(slot)
+        h.process_block(block, strategy="no_verification")
+        chain.on_tick(slot)
+        roots.append(chain.process_block(block))
+    return roots
+
+
+def test_event_stream_reports_blocks_and_head():
+    h = Harness(8, SPEC)
+    chain = BeaconChain(h.state.copy(), SPEC, verifier=SignatureVerifier("fake"))
+    q = chain.events.subscribe()
+    roots = _grow(chain, h, 2)
+    kinds = []
+    while not q.empty():
+        kind, payload = q.get_nowait()
+        kinds.append(kind)
+        if kind == EventKind.BLOCK:
+            assert bytes.fromhex(payload["block"]) in roots
+    assert kinds.count(EventKind.BLOCK) == 2
+    assert kinds.count(EventKind.HEAD) == 2
+    # filtered subscription only sees heads
+    q2 = chain.events.subscribe(kinds=[EventKind.HEAD])
+    _grow(chain, h, 1)
+    seen = [q2.get_nowait()[0] for _ in range(q2.qsize())]
+    assert seen == [EventKind.HEAD]
+
+
+def test_watch_updater_records_canonical_slots():
+    h = Harness(8, SPEC)
+    chain = BeaconChain(h.state.copy(), SPEC, verifier=SignatureVerifier("fake"))
+    updater = WatchUpdater(chain)
+    _grow(chain, h, 3)
+    assert updater.poll() == 3
+    assert updater.poll() == 0, "idempotent on the high-water mark"
+    _grow(chain, h, 1)
+    assert updater.poll() == 1
+    rows = updater.db.slots()
+    assert [r[0] for r in rows] == [1, 2, 3, 4]
+    assert all(r[2] is not None for r in rows)
