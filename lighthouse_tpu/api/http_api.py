@@ -290,6 +290,33 @@ class _Handler(BaseHTTPRequestHandler):
                 }
             )
 
+        if path == "/eth/v1/events":
+            # beacon-APIs SSE stream (events.rs); streams until the client
+            # disconnects
+            topics = q.get("topics", ["head", "block"])
+            if isinstance(topics, list) and len(topics) == 1:
+                topics = topics[0].split(",")
+            sub = chain.events.subscribe(kinds=topics)
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+            import queue as _queue
+
+            try:
+                while True:
+                    try:
+                        kind, payload = sub.get(timeout=1.0)
+                    except _queue.Empty:
+                        self.wfile.write(b": keepalive\n\n")
+                        self.wfile.flush()
+                        continue
+                    self.wfile.write(chain.events.sse_frame(kind, payload))
+                    self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                return
+            finally:
+                chain.events.unsubscribe(sub)
         if path == "/eth/v1/validator/attestation_data":
             slot = int(q["slot"][0])
             index = int(q["committee_index"][0])

@@ -681,6 +681,127 @@ class BeaconChain:
                 )
         return self.head_root
 
+    # -------------------------------------------------------- persistence
+
+    def persist(self):
+        """PersistedBeaconChain + PersistedForkChoice + PersistedOperationPool
+        (beacon_chain/src/persisted_*.rs, operation_pool/persistence.rs):
+        everything needed to resume after restart goes into store meta."""
+        if not hasattr(self.store, "put_meta"):
+            return False
+        self.store.put_meta("persisted_op_pool", self.op_pool.snapshot())
+        fc = self.fork_choice
+        nodes = [
+            {
+                "root": n.root.hex(),
+                "parent": n.parent,
+                "justified_epoch": n.justified_epoch,
+                "finalized_epoch": n.finalized_epoch,
+                "slot": n.slot,
+                "weight": n.weight,
+                "best_child": n.best_child,
+                "best_descendant": n.best_descendant,
+                "invalid": n.invalid,
+            }
+            for n in fc.proto.nodes
+        ]
+        votes = {
+            str(v): {
+                "current_root": t.current_root.hex(),
+                "next_root": t.next_root.hex(),
+                "next_epoch": t.next_epoch,
+            }
+            for v, t in fc.proto.votes.items()
+        }
+        payload = {
+            "head_root": self.head_root.hex(),
+            "genesis_root": self.genesis_root.hex(),
+            "current_slot": self.current_slot,
+            "justified": [
+                fc.store.justified_checkpoint[0],
+                fc.store.justified_checkpoint[1].hex(),
+            ],
+            "finalized": [
+                fc.store.finalized_checkpoint[0],
+                fc.store.finalized_checkpoint[1].hex(),
+            ],
+            "justified_balances": {
+                str(k): v for k, v in fc.store.justified_balances.items()
+            },
+            "equivocating": sorted(fc.store.equivocating_indices),
+            "proto_nodes": nodes,
+            "votes": votes,
+        }
+        self.store.put_meta("persisted_chain", payload)
+        if hasattr(self.store.kv, "flush"):
+            self.store.kv.flush()
+        return True
+
+    @classmethod
+    def from_store(cls, store, spec, verifier=None, execution_engine=None):
+        """Resume a chain from a persisted store (builder.rs resume path)."""
+        from ..fork_choice.proto_array import ProtoNode, VoteTracker
+
+        payload = store.get_meta("persisted_chain")
+        if payload is None:
+            raise ValueError("store holds no persisted chain")
+        genesis_root = bytes.fromhex(payload["genesis_root"])
+        anchor_state = store.get_state(genesis_root)
+        head_root = bytes.fromhex(payload["head_root"])
+        head_state = store.get_state(head_root)
+        if anchor_state is None:
+            anchor_state = head_state
+        chain = cls(
+            anchor_state, spec, store=store, verifier=verifier,
+            execution_engine=execution_engine,
+        )
+        fc = chain.fork_choice
+        fc.store.current_slot = payload["current_slot"]
+        fc.store.justified_checkpoint = (
+            payload["justified"][0], bytes.fromhex(payload["justified"][1])
+        )
+        fc.store.finalized_checkpoint = (
+            payload["finalized"][0], bytes.fromhex(payload["finalized"][1])
+        )
+        fc.store.justified_balances = {
+            int(k): v for k, v in payload["justified_balances"].items()
+        }
+        fc.store.equivocating_indices = set(payload["equivocating"])
+        fc.proto.nodes = [
+            ProtoNode(
+                root=bytes.fromhex(n["root"]),
+                parent=n["parent"],
+                justified_epoch=n["justified_epoch"],
+                finalized_epoch=n["finalized_epoch"],
+                slot=n["slot"],
+                weight=n["weight"],
+                best_child=n["best_child"],
+                best_descendant=n["best_descendant"],
+                invalid=n["invalid"],
+            )
+            for n in payload["proto_nodes"]
+        ]
+        fc.proto.indices = {n.root: i for i, n in enumerate(fc.proto.nodes)}
+        fc.proto.votes = {
+            int(v): VoteTracker(
+                current_root=bytes.fromhex(t["current_root"]),
+                next_root=bytes.fromhex(t["next_root"]),
+                next_epoch=t["next_epoch"],
+            )
+            for v, t in payload["votes"].items()
+        }
+        fc.proto.justified_epoch = payload["justified"][0]
+        fc.proto.finalized_epoch = payload["finalized"][0]
+        chain.current_slot = payload["current_slot"]
+        if head_state is not None:
+            chain._head = (head_root, head_state.copy())
+            # deposit-created validators since genesis re-enter the cache
+            chain._import_new_pubkeys(head_state)
+        pool = store.get_meta("persisted_op_pool")
+        if pool is not None:
+            chain.op_pool.restore(pool)
+        return chain
+
     def on_invalid_execution_payload(self, block_root):
         """execution-layer invalidation (fork_revert.rs +
         proto_array InvalidateOne): mark the block and its descendants

@@ -26,11 +26,17 @@ class EventBroadcaster:
         self.max_queue = max_queue
 
     def subscribe(self, kinds=None):
-        """Returns a Queue of (kind, payload) events."""
+        """Returns a Queue of (kind, payload) events.  Callers MUST
+        `unsubscribe(q)` when done (the SSE handler does on disconnect) or
+        the queue leaks and publish() keeps filling it."""
         q = queue.Queue(maxsize=self.max_queue)
         with self._lock:
             self._subs.append((q, set(kinds) if kinds else None))
         return q
+
+    def unsubscribe(self, q):
+        with self._lock:
+            self._subs = [(s, k) for s, k in self._subs if s is not q]
 
     def publish(self, kind, payload):
         with self._lock:

@@ -24,17 +24,22 @@ class BridgeError(Exception):
     pass
 
 
+import threading as _threading
+
 _native_cache = [False, None]   # (loaded?, lib)
+_native_lock = _threading.Lock()
 
 
 def _get_native():
     """Lazy load: the (possibly slow) g++ build runs on first USE, not at
-    package import."""
-    if _native_cache[0]:
+    package import — serialized so concurrent first users can't race two
+    compilers onto the same output path."""
+    with _native_lock:
+        if _native_cache[0]:
+            return _native_cache[1]
+        _native_cache[0] = True
+        _native_cache[1] = _load_native()
         return _native_cache[1]
-    _native_cache[0] = True
-    _native_cache[1] = _load_native()
-    return _native_cache[1]
 
 
 def have_native_client():
@@ -50,10 +55,14 @@ def _load_native():
         if not os.path.exists(_CSRC):
             return None
         try:
+            # build to a temp path then atomic-rename: a crashed build can
+            # never leave a half-written library behind
+            tmp = _SO + ".build"
             subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _CSRC],
+                ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _CSRC],
                 check=True, capture_output=True, timeout=120,
             )
+            os.replace(tmp, _SO)
         except Exception:
             if not os.path.exists(_SO):
                 return None

@@ -92,25 +92,40 @@ class Eth1Cache:
         return out
 
     def eth1_data_for_block(self, block):
-        from ..types.state import state_types as _st
-
         return {
             "deposit_root": self.chain.tree.root(block.deposit_count),
             "deposit_count": block.deposit_count,
             "block_hash": block.hash,
         }
 
+    def candidate_eth1_data(self, max_candidates=1024):
+        """The valid vote targets: eth1 data of followed-range blocks
+        (the spec's candidate-block window)."""
+        end = max(0, len(self.chain.blocks) - self.follow_distance)
+        out = set()
+        for blk in self.chain.blocks[max(0, end - max_candidates) : end + 1]:
+            d = self.eth1_data_for_block(blk)
+            out.add(
+                (bytes(d["deposit_root"]), int(d["deposit_count"]),
+                 bytes(d["block_hash"]))
+            )
+        return out
+
 
 def get_eth1_vote(state, cache, preset):
-    """Spec get_eth1_vote: majority among in-period votes over valid
-    candidates; fall back to the followed head's eth1 data."""
+    """Spec get_eth1_vote: majority among in-period votes over KNOWN
+    candidate eth1 blocks; fall back to the followed head's eth1 data.
+    Votes for fabricated eth1 data are never adopted — an unknown
+    deposit_root would make deposit proofs unverifiable."""
     T = state_types(preset)
     period_votes = list(state.eth1_data_votes)
-    candidate = cache.eth1_data_for_block(cache.head_block())
-    default = T.Eth1Data(**candidate)
+    default = T.Eth1Data(**cache.eth1_data_for_block(cache.head_block()))
+    candidates = cache.candidate_eth1_data()
     counts = {}
     for v in period_votes:
         key = (bytes(v.deposit_root), int(v.deposit_count), bytes(v.block_hash))
+        if key not in candidates:
+            continue
         # never vote below the chain's recorded deposit count
         if int(v.deposit_count) < int(state.eth1_data.deposit_count):
             continue

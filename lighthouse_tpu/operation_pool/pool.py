@@ -140,6 +140,64 @@ class OperationPool:
         ][: preset.max_voluntary_exits]
         return proposer_slashings, attester_slashings, exits
 
+    def snapshot(self):
+        """SSZ-hex snapshot of every pooled op (persistence.rs
+        PersistedOperationPool)."""
+        from ..ssz import encode
+        from ..types.containers import (
+            AttesterSlashing,
+            ProposerSlashing,
+            SignedVoluntaryExit,
+        )
+
+        atts = []
+        for entries in self.attestations.values():
+            for e in entries:
+                atts.append(encode(type(e["att"]), e["att"]).hex())
+        return {
+            "attestations": atts,
+            "proposer_slashings": {
+                str(i): encode(ProposerSlashing, s).hex()
+                for i, s in self.proposer_slashings.items()
+            },
+            "attester_slashings": [
+                encode(type(s), s).hex() for s in self.attester_slashings
+            ],
+            "voluntary_exits": {
+                str(i): encode(SignedVoluntaryExit, e).hex()
+                for i, e in self.voluntary_exits.items()
+            },
+        }
+
+    def restore(self, snap):
+        from ..ssz import decode
+        from ..types.containers import (
+            AttesterSlashing,
+            ProposerSlashing,
+            SignedVoluntaryExit,
+        )
+        from ..types.state import state_types
+
+        T = state_types(self.spec.preset)
+        for blob in snap.get("attestations", []):
+            att = decode(T.Attestation, bytes.fromhex(blob))
+            key = hash_tree_root(att.data)
+            self.attestations[key].append(
+                {"bits": list(att.aggregation_bits), "att": att}
+            )
+        for i, blob in snap.get("proposer_slashings", {}).items():
+            self.proposer_slashings[int(i)] = decode(
+                ProposerSlashing, bytes.fromhex(blob)
+            )
+        for blob in snap.get("attester_slashings", []):
+            self.attester_slashings.append(
+                decode(AttesterSlashing, bytes.fromhex(blob))
+            )
+        for i, blob in snap.get("voluntary_exits", {}).items():
+            self.voluntary_exits[int(i)] = decode(
+                SignedVoluntaryExit, bytes.fromhex(blob)
+            )
+
     def prune(self, state, preset):
         """Drop operations that can no longer be included (persistence.rs
         prune_all semantics)."""

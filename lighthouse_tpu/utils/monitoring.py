@@ -1,0 +1,58 @@
+"""Remote monitoring push (common/monitoring_api, 605 LoC): periodically
+POST a process/health/metrics snapshot to a remote endpoint (the
+beaconcha.in-style client stats protocol the reference implements)."""
+
+import json
+import logging
+import urllib.request
+
+from . import metrics as metrics_mod
+from .sensitive_url import SensitiveUrl
+from .system_health import observe
+
+log = logging.getLogger("lighthouse_tpu.monitoring")
+
+
+def gather_snapshot(chain=None, process="beaconnode"):
+    """monitoring_api/src/gather.rs: the pushed JSON body."""
+    body = {
+        "version": 1,
+        "process": process,
+        "system": observe(),
+    }
+    if chain is not None:
+        st = chain.head_state
+        body["beacon"] = {
+            "head_slot": int(st.slot),
+            "finalized_epoch": int(st.finalized_checkpoint.epoch),
+            "validators": len(st.validators),
+        }
+    return body
+
+
+class MonitoringService:
+    def __init__(self, endpoint, chain=None, period=60.0):
+        self.endpoint = SensitiveUrl(endpoint)
+        self.chain = chain
+        self.period = period
+
+    def push_once(self):
+        body = json.dumps(gather_snapshot(self.chain)).encode()
+        req = urllib.request.Request(
+            self.endpoint.full,
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return r.status
+        except Exception as e:
+            log.warning("monitoring push to %s failed: %s", self.endpoint, e)
+            return None
+
+    def run(self, executor):
+        while not executor.shutting_down:
+            self.push_once()
+            if executor.sleep_or_shutdown(self.period):
+                break

@@ -1,0 +1,31 @@
+"""Credential-redacting URL wrapper (common/sensitive_url): URLs carrying
+userinfo or API-key-looking path segments never reach logs verbatim."""
+
+from urllib.parse import urlparse, urlunparse
+
+
+class SensitiveUrl:
+    def __init__(self, url: str):
+        self.full = url
+        p = urlparse(url)
+        netloc = p.hostname or ""
+        if p.port:
+            netloc += f":{p.port}"
+        if p.username:
+            netloc = "***@" + netloc
+        # long hex-ish path segments look like API keys — redact them
+        parts = []
+        for seg in p.path.split("/"):
+            if len(seg) >= 16 and all(c in "0123456789abcdefABCDEF-_" for c in seg):
+                parts.append("***")
+            else:
+                parts.append(seg)
+        self.redacted = urlunparse(
+            (p.scheme, netloc, "/".join(parts), "", "", "")
+        )
+
+    def __str__(self):
+        return self.redacted
+
+    def __repr__(self):
+        return f"SensitiveUrl({self.redacted})"

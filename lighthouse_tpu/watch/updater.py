@@ -65,20 +65,33 @@ class WatchDB:
 
 
 class WatchUpdater:
-    """One poll cycle = walk canonical blocks above the high-water mark."""
+    """One poll cycle = walk canonical blocks back to the first slot whose
+    recorded root still matches (reorg-aware high-water mark)."""
 
     def __init__(self, chain, db=None):
         self.chain = chain
         self.db = db or WatchDB()
 
+    def _recorded_root(self, slot):
+        row = self.db._conn.execute(
+            "SELECT root FROM canonical_slots WHERE slot = ?", (slot,)
+        ).fetchone()
+        return bytes.fromhex(row[0]) if row else None
+
     def poll(self):
         chain = self.chain
-        seen_up_to = self.db.highest_slot()
         new = []
         root = chain.head_root
         while root is not None:
             blk = chain.store.get_block(root)
-            if blk is None or int(blk.message.slot) <= seen_up_to:
+            if blk is None:
+                break
+            slot = int(blk.message.slot)
+            # reorg-aware stop: only stop at a slot whose RECORDED root
+            # matches this canonical block — a mismatch means the table
+            # holds an orphan and the walk must continue rewriting
+            recorded = self._recorded_root(slot)
+            if recorded == root:
                 break
             new.append((root, blk))
             root = bytes(blk.message.parent_root)

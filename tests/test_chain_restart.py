@@ -1,0 +1,55 @@
+"""Chain persistence + restart: fork choice, votes, head, and continued
+operation resume from the store (SURVEY §5.4 node-restart resume)."""
+
+import os
+
+from lighthouse_tpu.beacon.chain import BeaconChain
+from lighthouse_tpu.beacon.store import FileKV, HotColdStore
+from lighthouse_tpu.crypto.backend import SignatureVerifier
+from lighthouse_tpu.testing.harness import Harness
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+
+SPEC = ChainSpec(preset=MinimalPreset)
+
+
+def test_chain_persists_and_resumes(tmp_path):
+    path = os.path.join(tmp_path, "node.db")
+    store = HotColdStore(FileKV(path), SPEC)
+    h = Harness(8, SPEC)
+    chain = BeaconChain(
+        h.state.copy(), SPEC, store=store, verifier=SignatureVerifier("fake")
+    )
+    for _ in range(3):
+        slot = h.state.slot + 1
+        block = h.produce_block(slot)
+        h.process_block(block, strategy="no_verification")
+        chain.on_tick(slot)
+        root = chain.process_block(block)
+        atts = h.attest_slot(h.state, slot, root)
+        chain.on_tick(slot)
+        chain.batch_verify_unaggregated_attestations(atts)
+    old_head = chain.head_root
+    old_votes = dict(chain.fork_choice.proto.votes)
+    assert chain.persist()
+    store.close()
+
+    # ---- restart
+    store2 = HotColdStore(FileKV(path), SPEC)
+    chain2 = BeaconChain.from_store(
+        store2, SPEC, verifier=SignatureVerifier("fake")
+    )
+    assert chain2.head_root == old_head
+    assert int(chain2.head_state.slot) == 3
+    assert set(chain2.fork_choice.proto.votes) == set(old_votes)
+    assert len(chain2.fork_choice.proto.nodes) == len(
+        chain.fork_choice.proto.nodes
+    )
+
+    # the resumed chain keeps importing
+    slot = h.state.slot + 1
+    block = h.produce_block(slot)
+    h.process_block(block, strategy="no_verification")
+    chain2.on_tick(slot)
+    root = chain2.process_block(block)
+    assert chain2.head_root == root
+    store2.close()

@@ -70,17 +70,24 @@ def test_eth1_vote_majority_and_fallback():
     # no votes yet: fallback to head
     v = get_eth1_vote(h.state, cache, SPEC.preset)
     assert bytes(v.block_hash) == cache.head_block().hash
-    # majority wins
-    winner = T.Eth1Data(
+    # a majority over a REAL candidate block wins
+    older = T.Eth1Data(**cache.eth1_data_for_block(eth1.blocks[1]))
+    h.state.eth1_data_votes = [older, older, v]
+    v2 = get_eth1_vote(h.state, cache, SPEC.preset)
+    assert bytes(v2.block_hash) == eth1.blocks[1].hash
+    # a majority for FABRICATED eth1 data is never adopted (candidate
+    # filter — an unknown deposit_root would break deposit proofs)
+    forged = T.Eth1Data(
         deposit_root=b"\x01" * 32, deposit_count=9, block_hash=b"\x02" * 32
     )
-    h.state.eth1_data_votes = [winner, winner, v]
-    v2 = get_eth1_vote(h.state, cache, SPEC.preset)
-    assert bytes(v2.block_hash) == b"\x02" * 32
-    # votes below the recorded deposit count never win
-    h.state.eth1_data = T.Eth1Data(deposit_count=50)
+    h.state.eth1_data_votes = [forged, forged, forged]
     v3 = get_eth1_vote(h.state, cache, SPEC.preset)
     assert bytes(v3.block_hash) == cache.head_block().hash
+    # votes below the recorded deposit count never win
+    h.state.eth1_data = T.Eth1Data(deposit_count=50)
+    h.state.eth1_data_votes = [older, older]
+    v4 = get_eth1_vote(h.state, cache, SPEC.preset)
+    assert bytes(v4.block_hash) == cache.head_block().hash
 
 
 def test_eth1_genesis():

@@ -134,3 +134,31 @@ def test_malformed_post_body_is_400(api):
         raise AssertionError("expected 400")
     except HTTPError as e:
         assert e.code == 400
+
+
+def test_sse_event_stream(api):
+    import threading
+    import urllib.request
+
+    chain, client = api
+    frames = []
+
+    def consume():
+        req = urllib.request.Request(client.base + "/eth/v1/events?topics=block")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            buf = b""
+            while len(frames) < 1:
+                buf += r.read1(4096)
+                while b"\n\n" in buf:
+                    frame, buf = buf.split(b"\n\n", 1)
+                    if frame.startswith(b"event:"):
+                        frames.append(frame)
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    import time
+
+    time.sleep(0.3)   # subscription established
+    chain.events.publish("block", {"slot": 99, "block": "ab"})
+    t.join(timeout=10)
+    assert frames and b"event: block" in frames[0]
