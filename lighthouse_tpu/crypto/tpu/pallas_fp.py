@@ -1,0 +1,157 @@
+"""Experimental Pallas kernel: fused Montgomery multiplication.
+
+The default `fp.mont_mul` is a chain of XLA ops (three `_mul_cols` GEMMs,
+redundant folds, one carry scan); XLA fuses much of it, but every stage
+still round-trips intermediates at the fusion boundaries.  This kernel
+runs the WHOLE SOS Montgomery multiply — both limb-product contractions,
+the Montgomery-quotient contraction, the redundant folds, and the final
+carry propagation — as ONE `pallas_call` per batch tile: operands land in
+VMEM once, the three contractions hit the MXU back-to-back, and only the
+reduced result returns to HBM (pallas_guide.md: HBM->VMEM->compute).
+
+Status: correctness-verified in interpreter mode (differential vs
+`fp.mont_mul` in tests/test_pallas_fp.py); opt-in on hardware via
+`fp_backend="pallas"` plumbing until profiled — the f32 exactness
+argument is identical to fp.py's (products < 2^16, column sums < 2^24).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import fp
+
+NLIMB = fp.NLIMB      # 48
+LB = fp.LB            # 8
+MASK = int(fp.MASK)
+
+# contraction matrices as f32 constants (antidiagonal gather, fp._DIAG_MAT)
+_DIAG96 = fp._diag_mat()                  # (96, 2304)
+_DIAG48 = fp._diag_mat()[:NLIMB]          # (48, 2304)
+_NPRIME_F = fp.NPRIME_LIMBS.astype(np.float32)
+_P_F = fp.P_LIMBS.astype(np.float32)
+_P_U = fp.P_LIMBS.astype(np.uint32)
+
+TILE = 256  # batch elements per grid step
+
+
+def _mont_mul_kernel(a_ref, b_ref, d96_ref, d48_ref, np_ref, p_ref, out_ref):
+    """One tile: a, b (48, TILE) u32 fully-reduced -> out (48, TILE) u32."""
+    af = a_ref[:].astype(jnp.float32)          # (48, T)
+    bf = b_ref[:].astype(jnp.float32)
+    d96 = d96_ref[:]
+    d48 = d48_ref[:]
+
+    def cols96(x, y):
+        prods = (x[:, None, :] * y[None, :, :]).reshape(NLIMB * NLIMB, -1)
+        return jax.lax.dot(
+            d96, prods, precision=lax.Precision.HIGHEST
+        )                                       # (96, T) f32, exact < 2^24
+
+    def cols48(x, y):
+        prods = (x[:, None, :] * y[None, :, :]).reshape(NLIMB * NLIMB, -1)
+        return jax.lax.dot(
+            d48, prods, precision=lax.Precision.HIGHEST
+        )
+
+    def fold3_fold(cols_u, n_out):
+        """fp._fold3 then fp._fold: redundant carry folds, limbs <= 257."""
+        b0 = cols_u & MASK
+        b1 = (cols_u >> LB) & MASK
+        b2 = cols_u >> (2 * LB)
+        z1 = jnp.zeros((1,) + cols_u.shape[1:], jnp.uint32)
+        z2 = jnp.zeros((2,) + cols_u.shape[1:], jnp.uint32)
+        s1 = jnp.concatenate([z1, b1[: n_out - 1]], axis=0)
+        s2 = jnp.concatenate([z2, b2[: n_out - 2]], axis=0)
+        f = b0[:n_out] + s1 + s2
+        lo = f & MASK
+        hi = f >> LB
+        sh = jnp.concatenate([z1, hi[: n_out - 1]], axis=0)
+        return lo[:n_out] + sh
+
+    cols_t = cols96(af, bf).astype(jnp.uint32)            # t columns
+    t_red = fold3_fold(cols_t, NLIMB)                     # t mod R, redundant
+    np_f = np_ref[:].astype(jnp.float32)[:, None]
+    m_red = fold3_fold(
+        cols48(t_red.astype(jnp.float32), jnp.broadcast_to(np_f, af.shape))
+        .astype(jnp.uint32),
+        NLIMB,
+    )
+    p_f = p_ref[:].astype(jnp.float32)[:, None]
+    u = (
+        cols96(m_red.astype(jnp.float32), jnp.broadcast_to(p_f, af.shape))
+        .astype(jnp.uint32)
+        + cols_t
+    )                                                     # (96, T) < 2^23
+
+    # carry propagation over all 96 columns; keep the high 48 limbs
+    T = u.shape[1]
+
+    def carry_step(carry, col):
+        t = col + carry
+        return t >> LB, t & MASK
+
+    carry, limbs = lax.scan(carry_step, jnp.zeros((T,), jnp.uint32), u)
+    hi = limbs[NLIMB:]                                    # (48, T) = u / R
+
+    # conditional subtract p (result < 1.22p)
+    p_u = p_ref[:][:, None]
+
+    def sub_step(borrow, ab):
+        ai, pi = ab
+        need = pi + borrow
+        d = (ai - need) & MASK
+        return jnp.where(ai < need, jnp.uint32(1), jnp.uint32(0)), d
+
+    borrow, diff = lax.scan(
+        sub_step,
+        jnp.zeros((T,), jnp.uint32),
+        (hi, jnp.broadcast_to(p_u, hi.shape)),
+    )
+    out_ref[:] = jnp.where(borrow[None, :] == 0, diff, hi)
+
+
+def mont_mul_pallas(a, b, interpret=False):
+    """Drop-in fused `fp.mont_mul` — one pallas_call per TILE-wide slab.
+
+    a, b: (48, B) uint32 fully-reduced Montgomery operands.
+    """
+    from jax.experimental import pallas as pl
+
+    orig_shape = a.shape
+    bshape = orig_shape[1:]
+    a2 = a.reshape(NLIMB, -1)
+    b2 = jnp.broadcast_to(b, orig_shape).reshape(NLIMB, -1)
+    n = a2.shape[1]
+    pad = (-n) % TILE
+    if pad:
+        a2 = jnp.pad(a2, ((0, 0), (0, pad)))
+        b2 = jnp.pad(b2, ((0, 0), (0, pad)))
+    total = a2.shape[1]
+
+    out = pl.pallas_call(
+        _mont_mul_kernel,
+        out_shape=jax.ShapeDtypeStruct((NLIMB, total), jnp.uint32),
+        grid=(total // TILE,),
+        in_specs=[
+            pl.BlockSpec((NLIMB, TILE), lambda i: (0, i)),
+            pl.BlockSpec((NLIMB, TILE), lambda i: (0, i)),
+            pl.BlockSpec((2 * NLIMB, NLIMB * NLIMB), lambda i: (0, 0)),
+            pl.BlockSpec((NLIMB, NLIMB * NLIMB), lambda i: (0, 0)),
+            pl.BlockSpec((NLIMB,), lambda i: (0,)),
+            pl.BlockSpec((NLIMB,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((NLIMB, TILE), lambda i: (0, i)),
+        interpret=interpret,
+    )(
+        a2,
+        b2,
+        jnp.asarray(_DIAG96),
+        jnp.asarray(_DIAG48),
+        jnp.asarray(fp.NPRIME_LIMBS),
+        jnp.asarray(_P_U),
+    )
+    if pad:
+        out = out[:, :n]
+    return out.reshape(orig_shape)
