@@ -88,6 +88,9 @@ class BeaconApiClient:
             ][2:]
         )
 
+    def proposer_duties(self, epoch):
+        return self._get(f"/eth/v1/validator/duties/proposer/{epoch}")["data"]
+
     def attester_duties(self, epoch, pubkeys):
         return self._post(
             f"/eth/v1/validator/duties/attester/{epoch}",
@@ -99,6 +102,20 @@ class BeaconApiClient:
             "/eth/v1/validator/attestation_data",
             {"slot": slot, "committee_index": committee_index},
         )["data"]
+
+    def publish_block_ssz(self, ssz_hex_with_fork_id):
+        return self._post(
+            "/eth/v1/beacon/blocks", {"ssz": ssz_hex_with_fork_id}
+        )["data"]
+
+    def publish_attestations_ssz(self, ssz_hex_list):
+        return self._post("/eth/v1/beacon/pool/attestations", ssz_hex_list)
+
+    def produce_block_ssz(self, slot, randao_reveal):
+        return self._post(
+            f"/eth/v2/validator/blocks/{slot}",
+            {"randao_reveal": "0x" + bytes(randao_reveal).hex()},
+        )
 
     def metrics(self):
         url = self.base + "/metrics"

@@ -247,24 +247,34 @@ class _Handler(BaseHTTPRequestHandler):
         if m:
             root = self._resolve_block_root(m.group(1))
             blk = chain.store.get_block(root) if root else None
-            if blk is None:
-                return self._err(404, "block not found")
-            msg = blk.message
-            return self._json(
-                {
-                    "data": {
-                        "root": _hex(root),
-                        "header": {
-                            "message": {
-                                "slot": str(int(msg.slot)),
-                                "proposer_index": str(int(msg.proposer_index)),
-                                "parent_root": _hex(msg.parent_root),
-                                "state_root": _hex(msg.state_root),
-                                "body_root": _hex(hash_tree_root(msg.body)),
-                            }
-                        },
-                    }
+            if blk is not None:
+                msg = blk.message
+                header = {
+                    "slot": str(int(msg.slot)),
+                    "proposer_index": str(int(msg.proposer_index)),
+                    "parent_root": _hex(msg.parent_root),
+                    "state_root": _hex(msg.state_root),
+                    "body_root": _hex(hash_tree_root(msg.body)),
                 }
+            else:
+                # checkpoint/genesis anchors exist only as states — serve
+                # the state's latest_block_header (block_id.rs anchor case)
+                st = chain.store.get_state(root) if root else None
+                if st is None:
+                    return self._err(404, "block not found")
+                hdr = st.latest_block_header
+                state_root = bytes(hdr.state_root)
+                if state_root == bytes(32):
+                    state_root = hash_tree_root(st)
+                header = {
+                    "slot": str(int(hdr.slot)),
+                    "proposer_index": str(int(hdr.proposer_index)),
+                    "parent_root": _hex(hdr.parent_root),
+                    "state_root": _hex(state_root),
+                    "body_root": _hex(hdr.body_root),
+                }
+            return self._json(
+                {"data": {"root": _hex(root), "header": {"message": header}}}
             )
 
         m = re.fullmatch(r"/eth/v1/beacon/blocks/([^/]+)/root", path)
@@ -341,6 +351,68 @@ class _Handler(BaseHTTPRequestHandler):
         return self._err(404, f"no route {path}")
 
     def _route_post(self, path, body):
+        chain = self.chain
+        if path == "/eth/v1/beacon/blocks":
+            # publish_blocks.rs: decode, import, gossip (in-process bus
+            # handled by the node wiring; import is the consensus part)
+            from ..beacon.chain import BlockError
+            from ..beacon.store import _Codec
+
+            codec = _Codec(chain.preset)
+            signed = codec.dec_block(bytes.fromhex(body["ssz"].removeprefix("0x")))
+            # NEVER tick the clock from an unauthenticated publish — a
+            # future-slot block must be rejected, not adopted as "now"
+            # (the slot clock is the timer loop's job)
+            try:
+                root = chain.process_block(signed)
+            except BlockError as e:
+                return self._err(400, f"block rejected: {e}")
+            return self._json({"data": {"root": _hex(root)}})
+
+        if path == "/eth/v1/beacon/pool/attestations":
+            from ..ssz import decode as _dec
+            from ..types.state import state_types
+
+            T = state_types(chain.preset)
+            atts = [
+                _dec(T.Attestation, bytes.fromhex(blob.removeprefix("0x")))
+                for blob in body
+            ]
+            results = chain.batch_verify_unaggregated_attestations(atts)
+            failures = [
+                {"index": i, "message": str(err)}
+                for i, (_, _, err) in enumerate(results)
+                if err is not None
+            ]
+            if failures:
+                return self._json(
+                    {"code": 400, "message": "some attestations failed",
+                     "failures": failures},
+                    400,
+                )
+            return self._json({"data": None})
+
+        m = re.fullmatch(r"/eth/v2/validator/blocks/(\d+)", path)
+        if m:
+            # produce an unsigned block (validator/blocks endpoint); the
+            # randao reveal arrives in the body
+            from ..beacon.store import _Codec
+
+            slot = int(m.group(1))
+            reveal = bytes.fromhex(body["randao_reveal"].removeprefix("0x"))
+            block, _ = chain.produce_block_on_state(slot, reveal)
+            codec = _Codec(chain.preset)
+            version = codec.fork_name_for_body(block.body)
+            cls = codec.unsigned_block_cls(version)
+            from ..ssz import encode as _enc
+
+            return self._json(
+                {
+                    "version": version,
+                    "data": {"ssz": "0x" + _enc(cls, block).hex()},
+                }
+            )
+
         m = re.fullmatch(r"/eth/v1/validator/duties/attester/(\d+)", path)
         if m:
             pubkeys = [bytes.fromhex(pk.removeprefix("0x")) for pk in body]

@@ -608,6 +608,46 @@ class BeaconChain:
         h = hashlib.sha256(selection_proof).digest()
         return int.from_bytes(h[:8], "little") % modulo == 0
 
+    # ------------------------------------------------ gossip operations
+
+    def verify_and_pool_operation(self, op):
+        """Gossip slashings/exits/BLS-changes: signature-verify into a
+        SigVerifiedOp (verify_operation.rs), then pool — block production
+        never re-verifies pooled ops."""
+        from ..state_processing import verify_operation as vo
+        from ..types.containers import (
+            AttesterSlashing,
+            ProposerSlashing,
+            SignedBLSToExecutionChange,
+            SignedVoluntaryExit,
+        )
+
+        state = self.head_state
+        if isinstance(op, ProposerSlashing):
+            verified = vo.verify_proposer_slashing(
+                op, state, self.spec, self.verifier
+            )
+            self.op_pool.insert_proposer_slashing(verified.op)
+        elif isinstance(op, AttesterSlashing) or hasattr(op, "attestation_1"):
+            verified = vo.verify_attester_slashing(
+                op, state, self.spec, self.verifier
+            )
+            self.op_pool.insert_attester_slashing(verified.op)
+            self.fork_choice.on_attester_slashing(verified.op)
+        elif isinstance(op, SignedVoluntaryExit):
+            verified = vo.verify_voluntary_exit(
+                op, state, self.spec, self.verifier
+            )
+            self.op_pool.insert_voluntary_exit(verified.op)
+        elif isinstance(op, SignedBLSToExecutionChange):
+            verified = vo.verify_bls_to_execution_change(
+                op, state, self.spec, self.verifier
+            )
+            self.op_pool.insert_bls_to_execution_change(verified.op)
+        else:
+            raise AttestationError(f"unknown operation {type(op).__name__}")
+        return verified
+
     # ----------------------------------------- sync committee messages
 
     def verify_sync_committee_message(self, message):
@@ -849,7 +889,9 @@ class BeaconChain:
                 state, randao_reveal, capella
             )
         if capella:
-            body_kwargs["bls_to_execution_changes"] = []
+            body_kwargs["bls_to_execution_changes"] = (
+                self.op_pool.get_bls_to_execution_changes(state, self.preset)
+            )
             body = T.BeaconBlockBodyCapella(**body_kwargs)
             block_cls, signed_cls = T.BeaconBlockCapella, T.SignedBeaconBlockCapella
         elif bellatrix:

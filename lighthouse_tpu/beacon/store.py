@@ -191,9 +191,12 @@ class _Codec:
             T.BeaconStateCapella,
         ]
 
+    FORK_NAMES = ["phase0", "altair", "bellatrix", "capella"]
+
     @staticmethod
-    def _block_fid(signed_block):
-        body = signed_block.message.body
+    def body_fid(body):
+        """The single fork-dispatch rule — every layer (store, http,
+        client) derives names/classes from this."""
         if hasattr(body, "bls_to_execution_changes"):
             return 3
         if hasattr(body, "execution_payload"):
@@ -201,6 +204,22 @@ class _Codec:
         if hasattr(body, "sync_aggregate"):
             return 1
         return 0
+
+    @classmethod
+    def _block_fid(cls, signed_block):
+        return cls.body_fid(signed_block.message.body)
+
+    def fork_name_for_body(self, body):
+        return self.FORK_NAMES[self.body_fid(body)]
+
+    def unsigned_block_cls(self, fork_name):
+        T = self.T
+        return {
+            "phase0": T.BeaconBlock,
+            "altair": T.BeaconBlockAltair,
+            "bellatrix": T.BeaconBlockBellatrix,
+            "capella": T.BeaconBlockCapella,
+        }[fork_name]
 
     @staticmethod
     def _state_fid(state):

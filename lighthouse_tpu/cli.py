@@ -15,16 +15,20 @@ from .types import ChainSpec, MainnetPreset, MinimalPreset
 
 
 def _spec_from_args(args):
-    preset = MinimalPreset if args.network == "minimal" else MainnetPreset
     kwargs = {}
     if args.altair_fork_epoch is not None:
         kwargs["altair_fork_epoch"] = args.altair_fork_epoch
+    if args.network == "gnosis":
+        from .types.spec import gnosis_spec
+
+        return gnosis_spec(**kwargs)
+    preset = MinimalPreset if args.network == "minimal" else MainnetPreset
     return ChainSpec(preset=preset, **kwargs)
 
 
 def _add_common(p):
     p.add_argument("--network", default="mainnet",
-                   choices=["mainnet", "minimal"])
+                   choices=["mainnet", "minimal", "gnosis"])
     p.add_argument("--altair-fork-epoch", type=int, default=None)
     p.add_argument("--config", help="JSON flags file (clap_utils flags.rs)")
     p.add_argument("--dump-config", action="store_true")
@@ -229,10 +233,73 @@ def _run_bn(args):
 
 
 def _run_vc(args):
-    print("vc: connect keystores in", args.keystore_dir, "to", args.beacon_node)
-    # production loop: load keystores, poll duties each slot via the API
-    # client; the in-process path is exercised by testing/simulator.py
-    return 0
+    """The `lighthouse vc` process: unlock keystores, attach to a BN over
+    the Beacon API, run duties on the slot clock
+    (validator_client/src/lib.rs:491 start_service)."""
+    import glob
+    import logging
+    import os
+    import time
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    spec = _spec_from_args(args)
+    from .api.client import BeaconApiClient
+    from .crypto import keys
+    from .utils.slot_clock import SystemSlotClock
+    from .validator_client.client import HttpBeaconNode, ValidatorClient
+    from .validator_client.slashing_protection import SlashingDatabase
+    from .validator_client.validator_store import ValidatorStore
+
+    api = BeaconApiClient(args.beacon_node)
+    genesis = api.genesis()
+    bn = HttpBeaconNode(api, spec.preset).set_spec(spec)
+    db_path = os.path.join(args.keystore_dir, "slashing_protection.sqlite")
+    store = ValidatorStore(spec, slashing_db=SlashingDatabase(db_path))
+    n = 0
+    for path in sorted(glob.glob(os.path.join(args.keystore_dir, "keystore-*.json"))):
+        ks = keys.load_keystore(path)
+        store.add_validator(keys.decrypt_keystore(ks, args.password))
+        n += 1
+    if n == 0:
+        print("no keystores found in", args.keystore_dir, file=sys.stderr)
+        return 1
+    print(f"vc: {n} validators attached to {args.beacon_node}")
+    vc = ValidatorClient(store, bn, spec)
+    clock = SystemSlotClock(int(genesis["genesis_time"]), spec.seconds_per_slot)
+    last_proposed = last_attested = None
+    try:
+        while True:
+            slot = clock.now()
+            if slot is not None:
+                # proposals at slot start; attestations at 1/3 slot so the
+                # slot's block has time to arrive (attestation_service.rs)
+                try:
+                    if slot != last_proposed:
+                        out = vc.act_on_slot(slot, phase="propose")
+                        if out["proposed"]:
+                            print(f"slot {slot}: proposed {len(out['proposed'])}")
+                        last_proposed = slot
+                    if (
+                        slot != last_attested
+                        and clock.seconds_into_slot() >= spec.seconds_per_slot / 3
+                    ):
+                        out = vc.act_on_slot(slot, phase="attest")
+                        if out["attested"]:
+                            print(f"slot {slot}: attested {len(out['attested'])}")
+                        last_attested = slot
+                except Exception as e:  # transient BN errors never kill the VC
+                    print(f"slot {slot}: duty error ({e}); retrying next slot",
+                          file=sys.stderr)
+            time.sleep(
+                min(max(clock.duration_to_next_slot(), 0.2), 1.0)
+                if slot is not None and slot == last_attested
+                else 0.2
+            )
+    except KeyboardInterrupt:
+        return 0
 
 
 def _run_am(args):

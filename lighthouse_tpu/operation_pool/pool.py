@@ -29,6 +29,7 @@ class OperationPool:
         self.proposer_slashings = {}      # proposer index -> slashing
         self.attester_slashings = []
         self.voluntary_exits = {}         # validator index -> signed exit
+        self.bls_to_execution_changes = {}  # validator index -> signed change
 
     # ---------------------------------------------------------- insertion
 
@@ -67,6 +68,23 @@ class OperationPool:
 
     def insert_voluntary_exit(self, signed_exit):
         self.voluntary_exits[signed_exit.message.validator_index] = signed_exit
+
+    def insert_bls_to_execution_change(self, signed_change):
+        self.bls_to_execution_changes[
+            signed_change.message.validator_index
+        ] = signed_change
+
+    def get_bls_to_execution_changes(self, state, preset):
+        """Changes still applicable (credentials still BLS-prefixed)."""
+        out = []
+        for i, c in self.bls_to_execution_changes.items():
+            if i < len(state.validators) and bytes(
+                state.validators[i].withdrawal_credentials
+            )[:1] == b"\x00":
+                out.append(c)
+            if len(out) == preset.max_bls_to_execution_changes:
+                break
+        return out
 
     # ---------------------------------------------------------- extraction
 

@@ -1,0 +1,95 @@
+"""SigVerifiedOp: signature-verified wrappers for pool operations.
+
+Mirror of /root/reference/consensus/state_processing/src/verify_operation.rs:
+gossip-verified slashings/exits/BLS-changes carry proof of verification
+into the op pool — the pool only ever holds `SigVerifiedOp`s, so block
+production never re-verifies them (the type IS the proof, like the block
+pipeline's typestates).
+"""
+
+from . import signature_sets as sset
+
+
+class SigVerifiedOp:
+    """Wrapper proving the contained operation's signatures verified
+    against a given (fork, genesis_validators_root)."""
+
+    __slots__ = ("op", "fork_version", "_verified")
+
+    def __init__(self, op, fork_version):
+        self.op = op
+        self.fork_version = bytes(fork_version)
+        self._verified = True
+
+    def __repr__(self):
+        return f"SigVerifiedOp({type(self.op).__name__})"
+
+
+class OpVerificationError(Exception):
+    pass
+
+
+def _verify(sets, verifier):
+    if verifier is None:
+        from ..crypto.ref.bls import verify_signature_sets as v
+
+        return v(sets)
+    return verifier.verify_signature_sets(sets)
+
+
+def verify_proposer_slashing(slashing, state, spec, verifier=None):
+    """verify_operation.rs VerifyOperation for ProposerSlashing."""
+    from .phase0 import _registry_pubkey_closure
+
+    gp = _registry_pubkey_closure(state)
+    try:
+        sets = sset.proposer_slashing_signature_sets(
+            gp, slashing, state.fork, state.genesis_validators_root, spec
+        )
+    except sset.SignatureSetError as e:
+        raise OpVerificationError(str(e)) from e
+    if not _verify(sets, verifier):
+        raise OpVerificationError("proposer slashing signatures invalid")
+    return SigVerifiedOp(slashing, state.fork.current_version)
+
+
+def verify_attester_slashing(slashing, state, spec, verifier=None):
+    from .phase0 import _registry_pubkey_closure
+
+    gp = _registry_pubkey_closure(state)
+    try:
+        sets = sset.attester_slashing_signature_sets(
+            gp, slashing, state.fork, state.genesis_validators_root, spec
+        )
+    except sset.SignatureSetError as e:
+        raise OpVerificationError(str(e)) from e
+    if not _verify(sets, verifier):
+        raise OpVerificationError("attester slashing signatures invalid")
+    return SigVerifiedOp(slashing, state.fork.current_version)
+
+
+def verify_voluntary_exit(signed_exit, state, spec, verifier=None):
+    from .phase0 import _registry_pubkey_closure
+
+    gp = _registry_pubkey_closure(state)
+    try:
+        s = sset.exit_signature_set(
+            gp, signed_exit, state.fork, state.genesis_validators_root, spec
+        )
+    except sset.SignatureSetError as e:
+        raise OpVerificationError(str(e)) from e
+    if not _verify([s], verifier):
+        raise OpVerificationError("exit signature invalid")
+    return SigVerifiedOp(signed_exit, state.fork.current_version)
+
+
+def verify_bls_to_execution_change(signed_change, state, spec, verifier=None):
+    try:
+        s = sset.bls_execution_change_signature_set(
+            signed_change, state.genesis_validators_root, spec
+        )
+    except sset.SignatureSetError as e:
+        raise OpVerificationError(str(e)) from e
+    if not _verify([s], verifier):
+        raise OpVerificationError("BLS-to-execution-change signature invalid")
+    return SigVerifiedOp(signed_change, state.fork.current_version)

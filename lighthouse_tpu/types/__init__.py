@@ -34,6 +34,7 @@ from .containers import (
 )
 from .spec import (
     ChainSpec,
+    GnosisPreset,
     MainnetPreset,
     MinimalPreset,
     Domain,
@@ -52,7 +53,7 @@ __all__ = [
     "SignedBLSToExecutionChange", "SignedContributionAndProof",
     "SignedVoluntaryExit", "SigningData", "SyncAggregate",
     "SyncCommitteeContribution", "SyncCommitteeMessage", "VoluntaryExit",
-    "ChainSpec", "MainnetPreset", "MinimalPreset", "Domain",
+    "ChainSpec", "GnosisPreset", "MainnetPreset", "MinimalPreset", "Domain",
     "compute_domain", "compute_epoch_at_slot", "compute_fork_data_root",
     "compute_signing_root",
 ]

@@ -76,6 +76,24 @@ MainnetPreset = Preset(
     epochs_per_eth1_voting_period=64,
 )
 
+# Gnosis chain: mainnet-shaped with a faster clock (gnosis feature in the
+# reference's eth_spec.rs:345 GnosisEthSpec)
+GnosisPreset = Preset(
+    name="gnosis",
+    slots_per_epoch=16,
+    max_validators_per_committee=2048,
+    sync_committee_size=512,
+    epochs_per_sync_committee_period=512,
+    max_committees_per_slot=64,
+    target_committee_size=128,
+    validator_registry_limit=2**40,
+    slots_per_historical_root=8192,
+    epochs_per_historical_vector=65536,
+    epochs_per_slashings_vector=8192,
+    historical_roots_limit=2**24,
+    epochs_per_eth1_voting_period=64,
+)
+
 MinimalPreset = Preset(
     name="minimal",
     slots_per_epoch=8,
@@ -151,6 +169,21 @@ class ChainSpec:
             fork.previous_version if epoch < fork.epoch else fork.current_version
         )
         return compute_domain(domain_type, fork_version, genesis_validators_root)
+
+
+def gnosis_spec(**overrides):
+    """Gnosis chain runtime constants: 5-second slots and the 0x...64
+    fork-version family (the reference's gnosis network config)."""
+    kwargs = dict(
+        preset=GnosisPreset,
+        genesis_fork_version=b"\x00\x00\x00\x64",
+        altair_fork_version=b"\x01\x00\x00\x64",
+        bellatrix_fork_version=b"\x02\x00\x00\x64",
+        capella_fork_version=b"\x03\x00\x00\x64",
+        seconds_per_slot=5,
+    )
+    kwargs.update(overrides)
+    return ChainSpec(**kwargs)
 
 
 def compute_epoch_at_slot(slot, preset=MainnetPreset):

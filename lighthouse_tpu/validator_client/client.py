@@ -190,6 +190,119 @@ class DirectBeaconNode(BeaconNodeInterface):
         return self.chain.batch_verify_unaggregated_attestations(attestations)
 
 
+class HttpBeaconNode(BeaconNodeInterface):
+    """The VC's production transport: a remote BN over the Beacon API
+    (the reference's `eth2` typed client inside duties/block/attestation
+    services).  SSZ payloads travel hex-encoded with the store codec's
+    1-byte fork id on signed blocks."""
+
+    def __init__(self, api_client, preset):
+        from ..beacon.store import _Codec
+
+        self.api = api_client
+        self.preset = preset
+        self.codec = _Codec(preset)
+
+    def head_info(self):
+        g = self.api.genesis()
+        hdr = self.api.header("head")
+        return {
+            "head_root": bytes.fromhex(hdr["root"][2:]),
+            "slot": int(hdr["header"]["message"]["slot"]),
+            "fork": self._fork_at_head(int(hdr["header"]["message"]["slot"])),
+            "genesis_validators_root": bytes.fromhex(
+                g["genesis_validators_root"][2:]
+            ),
+        }
+
+    def _fork_at_head(self, slot):
+        # the VC constructs domains from the schedule (spec is shared)
+        from ..types import ChainSpec
+
+        spec = getattr(self, "_spec", None)
+        if spec is None:
+            spec = ChainSpec(preset=self.preset)
+        return spec.fork_at_epoch(slot // self.preset.slots_per_epoch)
+
+    def set_spec(self, spec):
+        self._spec = spec
+        return self
+
+    def duties(self, epoch, pubkeys):
+        att = self.api.attester_duties(epoch, pubkeys)
+        duties = {
+            "attester": [
+                {
+                    "pubkey": bytes.fromhex(d["pubkey"][2:]),
+                    "validator_index": int(d["validator_index"]),
+                    "slot": int(d["slot"]),
+                    "committee_index": int(d["committee_index"]),
+                    "committee_position": int(d["committee_position"]),
+                    "committee_length": int(d["committee_length"]),
+                }
+                for d in att
+            ],
+            "proposer": [],
+        }
+        wanted = {bytes(pk) for pk in pubkeys}
+        for d in self.api.proposer_duties(epoch):
+            pk = bytes.fromhex(d["pubkey"][2:])
+            if pk in wanted:
+                duties["proposer"].append(
+                    {
+                        "pubkey": pk,
+                        "validator_index": int(d["validator_index"]),
+                        "slot": int(d["slot"]),
+                    }
+                )
+        return duties
+
+    def attestation_data(self, slot, committee_index):
+        from ..types.containers import AttestationData, Checkpoint
+
+        d = self.api.attestation_data(slot, committee_index)
+        return AttestationData(
+            slot=int(d["slot"]),
+            index=int(d["index"]),
+            beacon_block_root=bytes.fromhex(d["beacon_block_root"][2:]),
+            source=Checkpoint(
+                epoch=int(d["source"]["epoch"]),
+                root=bytes.fromhex(d["source"]["root"][2:]),
+            ),
+            target=Checkpoint(
+                epoch=int(d["target"]["epoch"]),
+                root=bytes.fromhex(d["target"]["root"][2:]),
+            ),
+        )
+
+    def produce_block(self, slot, randao_reveal):
+        from ..ssz import decode
+
+        resp = self.api.produce_block_ssz(slot, randao_reveal)
+        T = self.codec.T
+        cls = {
+            "phase0": T.BeaconBlock,
+            "altair": T.BeaconBlockAltair,
+            "bellatrix": T.BeaconBlockBellatrix,
+            "capella": T.BeaconBlockCapella,
+        }[resp["version"]]
+        return decode(cls, bytes.fromhex(resp["data"]["ssz"][2:]))
+
+    def publish_block(self, signed_block):
+        out = self.api.publish_block_ssz(
+            "0x" + self.codec.enc_block(signed_block).hex()
+        )
+        return bytes.fromhex(out["root"][2:])
+
+    def publish_attestations(self, attestations):
+        from ..ssz import encode
+
+        T = self.codec.T
+        return self.api.publish_attestations_ssz(
+            ["0x" + encode(T.Attestation, a).hex() for a in attestations]
+        )
+
+
 class BeaconNodeFallback(BeaconNodeInterface):
     """Ordered multi-node failover (beacon_node_fallback.rs:710)."""
 
@@ -248,14 +361,19 @@ class ValidatorClient:
                     del self._duties_cache[e]
         return self._duties_cache[epoch]
 
-    def act_on_slot(self, slot):
-        """One slot of work: propose (slot start), attest (1/3 slot)."""
+    def act_on_slot(self, slot, phase="all"):
+        """One slot of work.  `phase`: "propose" (slot start), "attest"
+        (1/3 slot — after the slot's block had time to arrive), or "all"
+        (tests/simulator, where block import is synchronous)."""
         epoch = slot // self.preset.slots_per_epoch
         duties = self._duties(epoch)
         out = {"proposed": [], "attested": []}
 
         info = self.bn.head_info()
         fork, gvr = info["fork"], info["genesis_validators_root"]
+
+        if phase == "attest":
+            return self._attest(slot, duties, fork, gvr, out)
 
         for duty in duties["proposer"]:
             if duty["slot"] != slot:
@@ -279,6 +397,11 @@ class ValidatorClient:
             except NotSafe as e:
                 log.warning("refusing to propose at %s: %s", slot, e)
 
+        if phase == "propose":
+            return out
+        return self._attest(slot, duties, fork, gvr, out)
+
+    def _attest(self, slot, duties, fork, gvr, out):
         atts = []
         T = state_types(self.preset)
         for duty in duties["attester"]:

@@ -1,0 +1,49 @@
+"""SigVerifiedOp gating for pooled operations (verify_operation.rs)."""
+
+import pytest
+
+from lighthouse_tpu.beacon.chain import BeaconChain
+from lighthouse_tpu.crypto.backend import SignatureVerifier
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.state_processing import verify_operation as vo
+from lighthouse_tpu.testing.harness import Harness
+from lighthouse_tpu.types import ChainSpec, Domain, MinimalPreset, compute_signing_root
+from lighthouse_tpu.types.containers import (
+    SignedVoluntaryExit,
+    VoluntaryExit,
+)
+
+SPEC = ChainSpec(preset=MinimalPreset, shard_committee_period=0)
+
+
+def _signed_exit(h, validator_index, epoch=0):
+    msg = VoluntaryExit(epoch=epoch, validator_index=validator_index)
+    fork = h.state.fork
+    gvr = bytes(h.state.genesis_validators_root)
+    domain = SPEC.get_domain(Domain.VOLUNTARY_EXIT, epoch, fork, gvr)
+    from lighthouse_tpu.crypto.ref import bls as RB
+    from lighthouse_tpu.crypto.ref.curves import g2_compress
+
+    sig = g2_compress(
+        RB.sign(h.keypairs[validator_index][0], compute_signing_root(msg, domain))
+    )
+    return SignedVoluntaryExit(message=msg, signature=sig)
+
+
+def test_valid_exit_pools_and_invalid_rejected():
+    h = Harness(8, SPEC)
+    chain = BeaconChain(h.state.copy(), SPEC, verifier=SignatureVerifier("oracle"))
+    good = _signed_exit(h, 3)
+    verified = chain.verify_and_pool_operation(good)
+    assert isinstance(verified, vo.SigVerifiedOp)
+    assert 3 in chain.op_pool.voluntary_exits
+
+    bad = _signed_exit(h, 4)
+    bad.signature = good.signature  # wrong signer
+    with pytest.raises(vo.OpVerificationError):
+        chain.verify_and_pool_operation(bad)
+    assert 4 not in chain.op_pool.voluntary_exits
+
+    # pooled ops land in produced blocks without re-verification
+    block, _ = chain.produce_block_on_state(1)
+    assert len(block.body.voluntary_exits) == 1
