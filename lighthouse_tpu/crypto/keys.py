@@ -192,6 +192,45 @@ def decrypt_keystore(keystore: dict, password: str) -> int:
     return int.from_bytes(secret, "big")
 
 
+# ------------------------------------------------------------ EIP-2386
+
+
+def create_wallet(name: str, password: str, seed: bytes = None) -> dict:
+    """EIP-2386 hierarchical-deterministic wallet (eth2_wallet): the seed
+    is itself keystore-encrypted; `nextaccount` tracks derivation."""
+    seed = seed or secrets.token_bytes(32)
+    sk_like = int.from_bytes(seed, "big")
+    crypto = encrypt_keystore(sk_like, password, light=True)["crypto"]
+    return {
+        "crypto": crypto,
+        "name": name,
+        "nextaccount": 0,
+        "type": "hierarchical deterministic",
+        "uuid": str(uuid.uuid4()),
+        "version": 1,
+    }
+
+
+def wallet_seed(wallet: dict, password: str) -> bytes:
+    crypto = wallet["crypto"]
+    ks = {"crypto": crypto}
+    return decrypt_keystore(ks, password).to_bytes(32, "big")
+
+
+def wallet_next_validator(wallet: dict, wallet_password: str,
+                          keystore_password: str):
+    """Derive the next validator keystore from the wallet and advance
+    `nextaccount` (eth2_wallet_manager's create_validator flow)."""
+    seed = wallet_seed(wallet, wallet_password)
+    i = wallet["nextaccount"]
+    sk = derive_path(seed, f"m/12381/3600/{i}/0/0")
+    ks = encrypt_keystore(
+        sk, keystore_password, path=f"m/12381/3600/{i}/0/0", light=True
+    )
+    wallet["nextaccount"] = i + 1
+    return ks
+
+
 def save_keystore(keystore: dict, directory: str) -> str:
     os.makedirs(directory, exist_ok=True)
     path = os.path.join(directory, f"keystore-{keystore['uuid']}.json")

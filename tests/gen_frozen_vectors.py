@@ -1,0 +1,78 @@
+"""Generator for the frozen state-transition vectors.
+
+The analogue of /root/reference/testing/state_transition_vectors
+(main.rs:1-30): build deterministic chains with the harness and FREEZE the
+per-slot state roots into tests/vectors/state_transition.json.  Committed
+vectors pin the STF across refactors/rounds — any semantic drift shows up
+as a root mismatch in test_frozen_vectors.py, independent of the code
+that produced them.
+
+Regenerate (after an INTENTIONAL consensus change only):
+    python tests/gen_frozen_vectors.py
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from lighthouse_tpu.ssz import hash_tree_root  # noqa: E402
+from lighthouse_tpu.testing.harness import Harness  # noqa: E402
+from lighthouse_tpu.types import ChainSpec, MinimalPreset  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(__file__), "vectors", "state_transition.json")
+
+SCENARIOS = {
+    # 12 slots of fully-attested phase0 chain
+    "phase0_attested": dict(spec=ChainSpec(preset=MinimalPreset), slots=12),
+    # crosses the altair fork at epoch 1 with sync aggregates
+    "altair_fork_crossing": dict(
+        spec=ChainSpec(preset=MinimalPreset, altair_fork_epoch=1), slots=12
+    ),
+    # bellatrix+capella genesis with payloads and withdrawals machinery
+    "capella_payloads": dict(
+        spec=ChainSpec(
+            preset=MinimalPreset,
+            altair_fork_epoch=0,
+            bellatrix_fork_epoch=0,
+            capella_fork_epoch=0,
+        ),
+        slots=6,
+    ),
+}
+
+
+def run_scenario(spec, slots):
+    h = Harness(8, spec)
+    roots = [hash_tree_root(h.state).hex()]
+    pending = []
+    for _ in range(slots):
+        slot = h.state.slot + 1
+        block = h.produce_block(slot, attestations=pending)
+        h.process_block(block, strategy="no_verification")
+        pending = h.attest_slot(h.state, slot, hash_tree_root(block.message))
+        roots.append(hash_tree_root(h.state).hex())
+    return {
+        "slots": slots,
+        "state_roots": roots,
+        "final_balances_root": hash_tree_root(
+            type(h.state).fields and dict(type(h.state).fields)["balances"],
+            h.state.balances,
+        ).hex(),
+    }
+
+
+def main():
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    out = {}
+    for name, cfg in SCENARIOS.items():
+        print("generating", name)
+        out[name] = run_scenario(cfg["spec"], cfg["slots"])
+    with open(OUT, "w") as f:
+        json.dump(out, f, indent=1)
+    print("wrote", OUT)
+
+
+if __name__ == "__main__":
+    main()

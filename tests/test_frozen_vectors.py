@@ -1,0 +1,31 @@
+"""Frozen state-transition vectors: the committed JSON pins every slot's
+state root across three fork scenarios — refactors cannot silently change
+consensus semantics (testing/state_transition_vectors analogue)."""
+
+import json
+import os
+
+import pytest
+
+from tests.gen_frozen_vectors import OUT, SCENARIOS, run_scenario
+
+
+@pytest.fixture(scope="module")
+def frozen():
+    if not os.path.exists(OUT):
+        pytest.skip("vectors not generated yet (tests/gen_frozen_vectors.py)")
+    with open(OUT) as f:
+        return json.load(f)
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_frozen_state_roots(frozen, name):
+    cfg = SCENARIOS[name]
+    got = run_scenario(cfg["spec"], cfg["slots"])
+    want = frozen[name]
+    assert got["state_roots"] == want["state_roots"], (
+        f"{name}: state roots diverged from the frozen vectors — if this "
+        "was an intentional consensus change, regenerate with "
+        "tests/gen_frozen_vectors.py"
+    )
+    assert got["final_balances_root"] == want["final_balances_root"]

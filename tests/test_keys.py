@@ -65,3 +65,18 @@ def test_keystore_file_roundtrip(tmp_path):
     ks = keys.encrypt_keystore(sk, "pw", light=True)
     path = keys.save_keystore(ks, str(tmp_path))
     assert keys.decrypt_keystore(keys.load_keystore(path), "pw") == sk
+
+
+def test_wallet_roundtrip_and_derivation():
+    w = keys.create_wallet("testwallet", "wpass", seed=b"\x07" * 32)
+    assert w["nextaccount"] == 0
+    assert keys.wallet_seed(w, "wpass") == b"\x07" * 32
+    with pytest.raises(keys.KeystoreError):
+        keys.wallet_seed(w, "wrong")
+    ks0 = keys.wallet_next_validator(w, "wpass", "kpass")
+    ks1 = keys.wallet_next_validator(w, "wpass", "kpass")
+    assert w["nextaccount"] == 2
+    assert ks0["pubkey"] != ks1["pubkey"]
+    # derivation is the standard path: matches direct EIP-2334 derivation
+    sk0 = keys.decrypt_keystore(ks0, "kpass")
+    assert sk0 == keys.derive_path(b"\x07" * 32, "m/12381/3600/0/0/0")
