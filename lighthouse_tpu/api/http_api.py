@@ -300,6 +300,24 @@ class _Handler(BaseHTTPRequestHandler):
                 }
             )
 
+        if path == "/lighthouse/liveness":
+            # the doppelganger-service probe: was each validator index seen
+            # attesting (gossip or blocks) in the given epoch?
+            epoch = int(q["epoch"][0])
+            ids = [int(i) for i in q["indices"][0].split(",") if i]
+            seen = {
+                v for (e, v) in chain.observed_attesters if e == epoch
+            }
+            return self._json(
+                {
+                    "data": [
+                        {"index": str(i), "epoch": str(epoch),
+                         "is_live": i in seen}
+                        for i in ids
+                    ]
+                }
+            )
+
         if path == "/eth/v1/events":
             # beacon-APIs SSE stream (events.rs); streams until the client
             # disconnects

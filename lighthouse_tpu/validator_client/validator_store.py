@@ -21,6 +21,43 @@ class DoppelgangerStatus:
     WATCHING = "watching"
 
 
+class DoppelgangerService:
+    """doppelganger_service.rs: during each watch epoch, probe the BN's
+    liveness endpoint for our keys; any sighting means another instance is
+    signing with them — refuse to EVER sign (abort beats slashing)."""
+
+    def __init__(self, store, api_client, validator_indices_by_pubkey):
+        self.store = store
+        self.api = api_client
+        self.index_of = dict(validator_indices_by_pubkey)
+
+    def complete_epoch(self, epoch):
+        """Run once per epoch while any validator is still watching."""
+        watching = [
+            pk for pk in self.store.voting_pubkeys()
+            if self.store.doppelganger_status(pk)
+            == DoppelgangerStatus.WATCHING
+        ]
+        if not watching:
+            return True
+        indices = ",".join(str(self.index_of[pk]) for pk in watching)
+        import json
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"{self.api.base}/lighthouse/liveness?epoch={epoch}"
+            f"&indices={indices}",
+            timeout=self.api.timeout,
+        ) as r:
+            results = json.loads(r.read())["data"]
+        live = {int(d["index"]) for d in results if d["is_live"]}
+        for pk in watching:
+            self.store.complete_doppelganger_epoch(
+                pk, saw_live_elsewhere=self.index_of[pk] in live
+            )
+        return False
+
+
 class ValidatorStore:
     def __init__(self, spec, slashing_db=None, doppelganger_epochs=0):
         self.spec = spec

@@ -163,3 +163,35 @@ def test_beacon_node_fallback_fails_over():
     chain = BeaconChain(h.state.copy(), SPEC, verifier=SignatureVerifier("fake"))
     fb = BeaconNodeFallback([Dead(), DirectBeaconNode(chain)])
     assert fb.head_info()["slot"] == 0
+
+
+def test_doppelganger_service_detects_liveness():
+    from lighthouse_tpu.api.client import BeaconApiClient
+    from lighthouse_tpu.api.http_api import BeaconApiServer
+    from lighthouse_tpu.crypto.ref.curves import g1_compress
+    from lighthouse_tpu.crypto.ref import bls as RB
+    from lighthouse_tpu.validator_client.validator_store import (
+        DoppelgangerService,
+        DoppelgangerStatus,
+    )
+
+    h = Harness(8, SPEC)
+    chain = BeaconChain(h.state.copy(), SPEC, verifier=SignatureVerifier("fake"))
+    server = BeaconApiServer(chain).start()
+    try:
+        api = BeaconApiClient(f"http://127.0.0.1:{server.port}")
+        store = ValidatorStore(SPEC, doppelganger_epochs=2)
+        pk3 = store.add_validator(h.keypairs[3][0])
+        svc = DoppelgangerService(store, api, {pk3: 3})
+        assert store.doppelganger_status(pk3) == DoppelgangerStatus.WATCHING
+
+        # quiet epoch: watch count decrements
+        svc.complete_epoch(0)
+        assert store.doppelganger_status(pk3) == DoppelgangerStatus.WATCHING
+
+        # another instance attests with our key -> permanent refusal
+        chain.observed_attesters.add((1, 3))
+        with pytest.raises(NotSafe, match="doppelganger"):
+            svc.complete_epoch(1)
+    finally:
+        server.stop()
