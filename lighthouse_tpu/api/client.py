@@ -111,6 +111,15 @@ class BeaconApiClient:
     def publish_attestations_ssz(self, ssz_hex_list):
         return self._post("/eth/v1/beacon/pool/attestations", ssz_hex_list)
 
+    def get_aggregate_ssz(self, data_root):
+        return self._get(
+            "/eth/v1/validator/aggregate_attestation",
+            {"attestation_data_root": "0x" + bytes(data_root).hex()},
+        )["data"]
+
+    def publish_aggregates_ssz(self, ssz_hex_list):
+        return self._post("/eth/v1/validator/aggregate_and_proofs", ssz_hex_list)
+
     def produce_block_ssz(self, slot, randao_reveal):
         return self._post(
             f"/eth/v2/validator/blocks/{slot}",

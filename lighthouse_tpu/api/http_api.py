@@ -300,6 +300,21 @@ class _Handler(BaseHTTPRequestHandler):
                 }
             )
 
+        if path == "/eth/v1/validator/aggregate_attestation":
+            from ..ssz import encode as _enc
+            from ..types.state import state_types
+
+            T = state_types(chain.preset)
+            data_root = bytes.fromhex(
+                q["attestation_data_root"][0].removeprefix("0x")
+            )
+            agg = chain.op_pool.get_aggregate(data_root)
+            if agg is None:
+                return self._err(404, "no aggregate for that data root")
+            return self._json(
+                {"data": {"ssz": "0x" + _enc(T.Attestation, agg).hex()}}
+            )
+
         if path == "/lighthouse/liveness":
             # the doppelganger-service probe: was each validator index seen
             # attesting (gossip or blocks) in the given epoch?
@@ -368,6 +383,26 @@ class _Handler(BaseHTTPRequestHandler):
             )
         return self._err(404, f"no route {path}")
 
+    def _decode_verify_publish(self, body, cls, verify_fn, fail_msg):
+        """Shared publish shape: SSZ-hex list -> batch verify -> per-item
+        failures as 400, else 200."""
+        from ..ssz import decode as _dec
+
+        items = [
+            _dec(cls, bytes.fromhex(blob.removeprefix("0x"))) for blob in body
+        ]
+        results = verify_fn(items)
+        failures = [
+            {"index": i, "message": str(err)}
+            for i, (_, _, err) in enumerate(results)
+            if err is not None
+        ]
+        if failures:
+            return self._json(
+                {"code": 400, "message": fail_msg, "failures": failures}, 400
+            )
+        return self._json({"data": None})
+
     def _route_post(self, path, body):
         chain = self.chain
         if path == "/eth/v1/beacon/blocks":
@@ -388,27 +423,23 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json({"data": {"root": _hex(root)}})
 
         if path == "/eth/v1/beacon/pool/attestations":
-            from ..ssz import decode as _dec
             from ..types.state import state_types
 
             T = state_types(chain.preset)
-            atts = [
-                _dec(T.Attestation, bytes.fromhex(blob.removeprefix("0x")))
-                for blob in body
-            ]
-            results = chain.batch_verify_unaggregated_attestations(atts)
-            failures = [
-                {"index": i, "message": str(err)}
-                for i, (_, _, err) in enumerate(results)
-                if err is not None
-            ]
-            if failures:
-                return self._json(
-                    {"code": 400, "message": "some attestations failed",
-                     "failures": failures},
-                    400,
-                )
-            return self._json({"data": None})
+            return self._decode_verify_publish(
+                body, T.Attestation,
+                chain.batch_verify_unaggregated_attestations,
+                "some attestations failed",
+            )
+
+        if path == "/eth/v1/validator/aggregate_and_proofs":
+            from ..types.containers import SignedAggregateAndProof
+
+            return self._decode_verify_publish(
+                body, SignedAggregateAndProof,
+                chain.batch_verify_aggregated_attestations,
+                "some aggregates failed",
+            )
 
         m = re.fullmatch(r"/eth/v2/validator/blocks/(\d+)", path)
         if m:

@@ -269,33 +269,36 @@ def _run_vc(args):
     print(f"vc: {n} validators attached to {args.beacon_node}")
     vc = ValidatorClient(store, bn, spec)
     clock = SystemSlotClock(int(genesis["genesis_time"]), spec.seconds_per_slot)
-    last_proposed = last_attested = None
+    last = {"propose": None, "attest": None, "aggregate": None}
     try:
         while True:
             slot = clock.now()
             if slot is not None:
-                # proposals at slot start; attestations at 1/3 slot so the
-                # slot's block has time to arrive (attestation_service.rs)
+                # proposals at slot start; attestations at 1/3 slot (the
+                # slot's block has time to arrive); aggregates at 2/3 slot
+                # (attestation_service.rs timings)
+                into = clock.seconds_into_slot()
+                third = spec.seconds_per_slot / 3
                 try:
-                    if slot != last_proposed:
-                        out = vc.act_on_slot(slot, phase="propose")
-                        if out["proposed"]:
-                            print(f"slot {slot}: proposed {len(out['proposed'])}")
-                        last_proposed = slot
-                    if (
-                        slot != last_attested
-                        and clock.seconds_into_slot() >= spec.seconds_per_slot / 3
+                    for phase, when in (
+                        ("propose", 0), ("attest", third), ("aggregate", 2 * third)
                     ):
-                        out = vc.act_on_slot(slot, phase="attest")
-                        if out["attested"]:
-                            print(f"slot {slot}: attested {len(out['attested'])}")
-                        last_attested = slot
+                        if slot != last[phase] and into >= when:
+                            out = vc.act_on_slot(slot, phase=phase)
+                            done = (
+                                out.get("proposed")
+                                or out.get("attested")
+                                or out.get("aggregated")
+                            )
+                            if done:
+                                print(f"slot {slot}: {phase} x{len(done)}")
+                            last[phase] = slot
                 except Exception as e:  # transient BN errors never kill the VC
                     print(f"slot {slot}: duty error ({e}); retrying next slot",
                           file=sys.stderr)
             time.sleep(
                 min(max(clock.duration_to_next_slot(), 0.2), 1.0)
-                if slot is not None and slot == last_attested
+                if slot is not None and slot == last["aggregate"]
                 else 0.2
             )
     except KeyboardInterrupt:

@@ -88,6 +88,18 @@ class OperationPool:
 
     # ---------------------------------------------------------- extraction
 
+    def get_aggregate(self, data_root):
+        """Best (most-participated) aggregate for an attestation-data root
+        — the naive_aggregation_pool read the VC's aggregation duty uses
+        (GET /eth/v1/validator/aggregate_attestation)."""
+        entries = self.attestations.get(bytes(data_root), [])
+        if not entries:
+            return None
+        best = max(entries, key=lambda e: sum(e["bits"]))
+        # copy: the pool keeps merging into the live entry (two-field
+        # mutation) while API threads encode/re-insert the returned object
+        return best["att"].copy()
+
     def get_attestations(self, state, preset):
         """Weighted max-cover packing (lib.rs get_attestations + AttMaxCover):
         cover = attesting validators not yet covered, weighted by base

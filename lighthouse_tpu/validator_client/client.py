@@ -26,6 +26,12 @@ class BeaconNodeInterface:
     def head_info(self):
         raise NotImplementedError
 
+    def get_aggregate(self, data_root):
+        raise NotImplementedError
+
+    def publish_aggregates(self, signed_aggregates):
+        raise NotImplementedError
+
     def duties(self, epoch, pubkeys):
         raise NotImplementedError
 
@@ -189,6 +195,12 @@ class DirectBeaconNode(BeaconNodeInterface):
     def publish_attestations(self, attestations):
         return self.chain.batch_verify_unaggregated_attestations(attestations)
 
+    def get_aggregate(self, data_root):
+        return self.chain.op_pool.get_aggregate(data_root)
+
+    def publish_aggregates(self, signed_aggregates):
+        return self.chain.batch_verify_aggregated_attestations(signed_aggregates)
+
 
 class HttpBeaconNode(BeaconNodeInterface):
     """The VC's production transport: a remote BN over the Beacon API
@@ -302,6 +314,28 @@ class HttpBeaconNode(BeaconNodeInterface):
             ["0x" + encode(T.Attestation, a).hex() for a in attestations]
         )
 
+    def get_aggregate(self, data_root):
+        from ..api.client import ApiError
+        from ..ssz import decode
+
+        try:
+            resp = self.api.get_aggregate_ssz(data_root)
+        except ApiError as e:
+            if str(e).startswith("404"):
+                return None      # genuinely no aggregate for this root
+            raise                # outages must surface, not skip duties
+        return decode(self.codec.T.Attestation,
+                      bytes.fromhex(resp["ssz"][2:]))
+
+    def publish_aggregates(self, signed_aggregates):
+        from ..ssz import encode
+        from ..types.containers import SignedAggregateAndProof
+
+        return self.api.publish_aggregates_ssz(
+            ["0x" + encode(SignedAggregateAndProof, a).hex()
+             for a in signed_aggregates]
+        )
+
 
 class BeaconNodeFallback(BeaconNodeInterface):
     """Ordered multi-node failover (beacon_node_fallback.rs:710)."""
@@ -337,6 +371,12 @@ class BeaconNodeFallback(BeaconNodeInterface):
 
     def publish_attestations(self, attestations):
         return self._try("publish_attestations", attestations)
+
+    def get_aggregate(self, data_root):
+        return self._try("get_aggregate", data_root)
+
+    def publish_aggregates(self, signed_aggregates):
+        return self._try("publish_aggregates", signed_aggregates)
 
 
 class ValidatorClient:
@@ -374,6 +414,8 @@ class ValidatorClient:
 
         if phase == "attest":
             return self._attest(slot, duties, fork, gvr, out)
+        if phase == "aggregate":
+            return self._aggregate(slot, duties, fork, gvr, out)
 
         for duty in duties["proposer"]:
             if duty["slot"] != slot:
@@ -400,6 +442,54 @@ class ValidatorClient:
         if phase == "propose":
             return out
         return self._attest(slot, duties, fork, gvr, out)
+
+    def _aggregate(self, slot, duties, fork, gvr, out):
+        """2/3-slot aggregation duty (attestation_service.rs): committee
+        members whose selection proof selects them fetch the pooled
+        aggregate and broadcast a SignedAggregateAndProof."""
+        from ..beacon.chain import BeaconChain
+        from ..ssz import hash_tree_root as _htr
+        from ..types.containers import AggregateAndProof, SignedAggregateAndProof
+
+        out.setdefault("aggregated", [])
+        signed_aggs = []
+        data_by_committee = {}   # one fetch per committee at the 2/3 mark
+        for duty in duties["attester"]:
+            if duty["slot"] != slot:
+                continue
+            try:
+                proof = self.store.sign_selection_proof(
+                    duty["pubkey"], slot, fork, gvr
+                )
+                if not BeaconChain._is_aggregator(
+                    duty["committee_length"], proof
+                ):
+                    continue
+                ci = duty["committee_index"]
+                if ci not in data_by_committee:
+                    d = self.bn.attestation_data(slot, ci)
+                    data_by_committee[ci] = (d, _htr(d))
+                data, data_root = data_by_committee[ci]
+                agg = self.bn.get_aggregate(data_root)
+                if agg is None:
+                    continue
+                msg = AggregateAndProof(
+                    aggregator_index=duty["validator_index"],
+                    aggregate=agg,
+                    selection_proof=proof,
+                )
+                sig = self.store.sign_aggregate_and_proof(
+                    duty["pubkey"], msg, fork, gvr
+                )
+                signed_aggs.append(
+                    SignedAggregateAndProof(message=msg, signature=sig)
+                )
+                out["aggregated"].append((slot, duty["validator_index"]))
+            except NotSafe as e:
+                log.warning("refusing to aggregate at %s: %s", slot, e)
+        if signed_aggs:
+            self.bn.publish_aggregates(signed_aggs)
+        return out
 
     def _attest(self, slot, duties, fork, gvr, out):
         atts = []

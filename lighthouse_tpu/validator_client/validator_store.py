@@ -32,7 +32,9 @@ class DoppelgangerService:
         self.index_of = dict(validator_indices_by_pubkey)
 
     def complete_epoch(self, epoch):
-        """Run once per epoch while any validator is still watching."""
+        """Run once per epoch while any validator is still watching.
+        Detections are recorded for EVERY watched validator before the
+        error is raised — a caught exception cannot resurrect signing."""
         watching = [
             pk for pk in self.store.voting_pubkeys()
             if self.store.doppelganger_status(pk)
@@ -41,19 +43,22 @@ class DoppelgangerService:
         if not watching:
             return True
         indices = ",".join(str(self.index_of[pk]) for pk in watching)
-        import json
-        import urllib.request
-
-        with urllib.request.urlopen(
-            f"{self.api.base}/lighthouse/liveness?epoch={epoch}"
-            f"&indices={indices}",
-            timeout=self.api.timeout,
-        ) as r:
-            results = json.loads(r.read())["data"]
+        results = self.api._get(
+            "/lighthouse/liveness", {"epoch": epoch, "indices": indices}
+        )["data"]
         live = {int(d["index"]) for d in results if d["is_live"]}
+        detected = []
         for pk in watching:
-            self.store.complete_doppelganger_epoch(
-                pk, saw_live_elsewhere=self.index_of[pk] in live
+            try:
+                self.store.complete_doppelganger_epoch(
+                    pk, saw_live_elsewhere=self.index_of[pk] in live
+                )
+            except NotSafe:
+                detected.append(pk)
+        if detected:
+            raise NotSafe(
+                f"doppelganger detected for {len(detected)} validator(s) — "
+                "signing permanently disabled for them"
             )
         return False
 
@@ -88,10 +93,15 @@ class ValidatorStore:
             else DoppelgangerStatus.WATCHING
         )
 
+    _DETECTED = -1   # permanent-refusal sentinel
+
     def complete_doppelganger_epoch(self, pubkey, saw_live_elsewhere=False):
-        """doppelganger_service.rs epoch tick: abort on detection."""
+        """doppelganger_service.rs epoch tick.  Detection is RECORDED
+        before raising: the ban survives callers that catch the error and
+        never counts down."""
         pk = bytes(pubkey)
         if saw_live_elsewhere:
+            self._doppelganger[pk] = self._DETECTED
             raise NotSafe("doppelganger detected — refusing to ever sign")
         if self._doppelganger.get(pk, 0) > 0:
             self._doppelganger[pk] -= 1
@@ -100,7 +110,10 @@ class ValidatorStore:
         pk = bytes(pubkey)
         if pk not in self._keys:
             raise KeyError("unknown validator")
-        if self._doppelganger.get(pk, 0) > 0:
+        count = self._doppelganger.get(pk, 0)
+        if count == self._DETECTED:
+            raise NotSafe("doppelganger detected — signing permanently disabled")
+        if count > 0:
             raise NotSafe("doppelganger watch in progress")
         return self._keys[pk]
 

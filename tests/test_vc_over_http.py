@@ -38,3 +38,26 @@ def test_vc_drives_node_over_http():
         # the signatures were REAL (oracle backend verified them)
     finally:
         server.stop()
+
+
+def test_vc_aggregation_duty_over_http():
+    h = Harness(8, SPEC)
+    chain = BeaconChain(h.state.copy(), SPEC, verifier=SignatureVerifier("oracle"))
+    server = BeaconApiServer(chain).start()
+    try:
+        api = BeaconApiClient(f"http://127.0.0.1:{server.port}")
+        bn = HttpBeaconNode(api, SPEC.preset).set_spec(SPEC)
+        store = ValidatorStore(SPEC)
+        for i in range(8):
+            store.add_validator(h.keypairs[i][0])
+        vc = ValidatorClient(store, bn, SPEC)
+
+        chain.on_tick(1)
+        vc.act_on_slot(1, phase="propose")
+        vc.act_on_slot(1, phase="attest")
+        out = vc.act_on_slot(1, phase="aggregate")
+        # minimal committees are tiny, so every member aggregates
+        assert out["aggregated"], "someone held the aggregation duty"
+        assert chain.observed_aggregators, "aggregates verified and recorded"
+    finally:
+        server.stop()
