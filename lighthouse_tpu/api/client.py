@@ -120,6 +120,15 @@ class BeaconApiClient:
     def publish_aggregates_ssz(self, ssz_hex_list):
         return self._post("/eth/v1/validator/aggregate_and_proofs", ssz_hex_list)
 
+    def sync_duties(self, epoch, pubkeys):
+        return self._post(
+            f"/eth/v1/validator/duties/sync/{epoch}",
+            ["0x" + bytes(pk).hex() for pk in pubkeys],
+        )["data"]
+
+    def publish_sync_messages_ssz(self, ssz_hex_list):
+        return self._post("/eth/v1/beacon/pool/sync_committees", ssz_hex_list)
+
     def produce_block_ssz(self, slot, randao_reveal):
         return self._post(
             f"/eth/v2/validator/blocks/{slot}",

@@ -393,9 +393,9 @@ class _Handler(BaseHTTPRequestHandler):
         ]
         results = verify_fn(items)
         failures = [
-            {"index": i, "message": str(err)}
-            for i, (_, _, err) in enumerate(results)
-            if err is not None
+            {"index": i, "message": str(r[-1])}
+            for i, r in enumerate(results)
+            if r[-1] is not None
         ]
         if failures:
             return self._json(
@@ -460,6 +460,32 @@ class _Handler(BaseHTTPRequestHandler):
                     "version": version,
                     "data": {"ssz": "0x" + _enc(cls, block).hex()},
                 }
+            )
+
+        m = re.fullmatch(r"/eth/v1/validator/duties/sync/(\d+)", path)
+        if m:
+            pubkeys = [bytes.fromhex(pk.removeprefix("0x")) for pk in body]
+            duties = self.bn.sync_duties(int(m.group(1)), pubkeys)
+            return self._json(
+                {
+                    "data": [
+                        {
+                            "pubkey": _hex(d["pubkey"]),
+                            "validator_index": str(d["validator_index"]),
+                            "positions": [str(p) for p in d["positions"]],
+                        }
+                        for d in duties
+                    ]
+                }
+            )
+
+        if path == "/eth/v1/beacon/pool/sync_committees":
+            from ..types.containers import SyncCommitteeMessage
+
+            return self._decode_verify_publish(
+                body, SyncCommitteeMessage,
+                chain.batch_verify_sync_messages,
+                "some sync messages failed",
             )
 
         m = re.fullmatch(r"/eth/v1/validator/duties/attester/(\d+)", path)

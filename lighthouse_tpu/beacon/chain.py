@@ -126,6 +126,7 @@ class BeaconChain:
         self.observed_attesters = set()         # (target_epoch, validator)
         self.observed_aggregators = set()       # (target_epoch, aggregator)
         self.observed_sync_contributors = set()  # (slot, validator)
+        self.observed_sync_aggregators = set()  # (slot, aggregator, subnet)
 
         from .events import EventBroadcaster
         from .sync_pool import SyncContributionPool
@@ -171,6 +172,9 @@ class BeaconChain:
             }
             self.observed_sync_contributors = {
                 k for k in self.observed_sync_contributors if k[0] >= horizon_slot
+            }
+            self.observed_sync_aggregators = {
+                k for k in self.observed_sync_aggregators if k[0] >= horizon_slot
             }
             self.observed_block_producers = {
                 k for k in self.observed_block_producers if k[0] >= horizon_slot
@@ -680,6 +684,147 @@ class BeaconChain:
         self.observed_sync_contributors.add(key)
         self.sync_pool.insert_message(message, committee_indices)
         return True
+
+    def batch_verify_sync_messages(self, messages):
+        """All gossip sync messages of a tick in ONE device batch
+        (sync_committee_verification.rs batch flavor); per-set fallback on
+        poisoning.  Returns [(message, error|None)]."""
+        from ..state_processing import altair
+
+        state = self.head_state
+        results = []
+        sets = []
+        owners = []
+        if not altair.is_altair_state(state):
+            return [
+                (m, AttestationError("pre-altair state has no sync committee"))
+                for m in messages
+            ]
+        committee_indices = altair.sync_committee_validator_indices(
+            state, self.preset
+        )
+        member_set = set(committee_indices)
+        for m in messages:
+            vi = int(m.validator_index)
+            key = (int(m.slot), vi)
+            if key in self.observed_sync_contributors:
+                results.append([m, AttestationError("duplicate sync message")])
+                continue
+            if vi not in member_set:
+                results.append(
+                    [m, AttestationError("not in current sync committee")]
+                )
+                continue
+            try:
+                s = sset.sync_committee_message_set_from_pubkeys(
+                    self.pubkey_cache.get(vi), m, state.fork,
+                    state.genesis_validators_root, self.spec,
+                )
+            except sset.SignatureSetError as e:
+                results.append([m, AttestationError(f"undecodable: {e}")])
+                continue
+            results.append([m, None])
+            owners.append(len(results) - 1)
+            sets.append(s)
+        if sets:
+            ok = self.verifier.verify_signature_sets(sets)
+            if not ok:
+                verdicts = self.verifier.verify_signature_sets_per_set(sets)
+                for owner, good in zip(owners, verdicts):
+                    if not good:
+                        results[owner][1] = AttestationError("invalid signature")
+        for m, err in results:
+            if err is None:
+                self.observed_sync_contributors.add(
+                    (int(m.slot), int(m.validator_index))
+                )
+                self.sync_pool.insert_message(m, committee_indices)
+        return [tuple(r) for r in results]
+
+    def verify_sync_contribution(self, signed_contribution):
+        """sync_committee_verification.rs: the 3-set aggregator batch —
+        selection proof (SyncAggregatorSelectionData), aggregator
+        signature over ContributionAndProof, and the contribution itself
+        against the subcommittee's participant pubkeys — verified in ONE
+        device call (:549-618)."""
+        from ..state_processing import altair
+
+        state = self.head_state
+        if not altair.is_altair_state(state):
+            raise AttestationError("pre-altair state has no sync committee")
+        msg = signed_contribution.message
+        contribution = msg.contribution
+        sub_index = int(contribution.subcommittee_index)
+        if sub_index >= self.preset.sync_committee_subnet_count:
+            raise AttestationError("bad subcommittee index")
+        key = (int(contribution.slot), int(msg.aggregator_index), sub_index)
+        if key in self.observed_sync_aggregators:
+            raise AttestationError("sync aggregator already seen")
+        committee_indices = altair.sync_committee_validator_indices(
+            state, self.preset
+        )
+        sub_size = (
+            self.preset.sync_committee_size
+            // self.preset.sync_committee_subnet_count
+        )
+        subcommittee = committee_indices[
+            sub_index * sub_size : (sub_index + 1) * sub_size
+        ]
+        if int(msg.aggregator_index) not in subcommittee:
+            raise AttestationError("aggregator not in subcommittee")
+        if not self._is_sync_aggregator(bytes(msg.selection_proof)):
+            raise AttestationError("selection proof does not select aggregator")
+        participants = [
+            self.pubkey_cache.get(vi)
+            for vi, bit in zip(subcommittee, contribution.aggregation_bits)
+            if bit
+        ]
+        if not participants:
+            raise AttestationError("empty contribution")
+        gp = self.pubkey_cache.as_get_pubkey()
+        try:
+            sets = [
+                sset.signed_sync_aggregate_selection_proof_signature_set(
+                    gp, signed_contribution, state.fork,
+                    state.genesis_validators_root, self.spec,
+                ),
+                sset.signed_sync_aggregate_signature_set(
+                    gp, signed_contribution, state.fork,
+                    state.genesis_validators_root, self.spec,
+                ),
+                sset.sync_committee_contribution_signature_set_from_pubkeys(
+                    participants, contribution, state.fork,
+                    state.genesis_validators_root, self.spec,
+                ),
+            ]
+        except sset.SignatureSetError as e:
+            raise AttestationError(f"undecodable signature: {e}") from e
+        if not self.verifier.verify_signature_sets(sets):
+            raise AttestationError("sync contribution verification failed")
+        self.observed_sync_aggregators.add(key)
+        # fold the contribution into the block-production pool at its
+        # subcommittee's global position base
+        self.sync_pool.insert_contribution(
+            int(contribution.slot),
+            bytes(contribution.beacon_block_root),
+            contribution,
+            sub_index * sub_size,
+        )
+        return True
+
+    def _is_sync_aggregator(self, selection_proof):
+        """Spec is_sync_committee_aggregator: modulus over subcommittee
+        size / TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE (=16)."""
+        import hashlib
+
+        modulo = max(
+            1,
+            self.preset.sync_committee_size
+            // self.preset.sync_committee_subnet_count
+            // 16,
+        )
+        h = hashlib.sha256(bytes(selection_proof)).digest()
+        return int.from_bytes(h[:8], "little") % modulo == 0
 
     # ------------------------------------------------------------- head
 

@@ -1,15 +1,22 @@
-"""Sync-committee message aggregation pool.
+"""Sync-committee aggregation pool.
 
 Mirror of the reference's naive sync aggregation + op-pool sync
 contributions (naive_aggregation_pool.rs SyncContribution flavor,
 operation_pool sync_aggregate packing): verified sync-committee messages
-accumulate per (slot, beacon_block_root); block production asks for the
-best SyncAggregate for its parent root.
+and subcommittee contributions accumulate per (slot, beacon_block_root)
+as {position-set, signature-point} entries; block production greedily
+merges disjoint entries into the best SyncAggregate for its parent root.
+
+A validator occupying k committee positions contributes its signature
+once per position (the verifier lists the pubkey once PER SET BIT — spec
+process_sync_aggregate); storing single messages per-position keeps them
+composable around monolithic contributions in the merge.
 """
 
 from collections import defaultdict
 
 from ..crypto.ref import bls as RB
+from ..crypto.ref import curves as C
 from ..crypto.ref.curves import g2_compress, g2_decompress
 
 _INFINITY_SIG = bytes([0xC0]) + bytes(95)
@@ -19,43 +26,83 @@ class SyncContributionPool:
     def __init__(self, spec):
         self.spec = spec
         self.preset = spec.preset
-        # (slot, block_root) -> {committee_position: signature_bytes}
-        self._messages = defaultdict(dict)
+        # (slot, block_root) -> [{"positions": frozenset, "sig": point}]
+        self._entries = defaultdict(list)
+
+    # ---------------------------------------------------------- insertion
 
     def insert_message(self, message, committee_indices):
-        """Record one verified SyncCommitteeMessage for every committee
-        position its validator occupies (a validator can hold several)."""
+        """One verified SyncCommitteeMessage: ONE ENTRY PER POSITION the
+        validator occupies (each with the plain signature) — single
+        positions compose losslessly around monolithic contributions in
+        the greedy merge."""
         vi = int(message.validator_index)
+        sig = g2_decompress(bytes(message.signature), subgroup_check=False)
         key = (int(message.slot), bytes(message.beacon_block_root))
-        for pos, committee_vi in enumerate(committee_indices):
-            if committee_vi == vi:
-                self._messages[key][pos] = bytes(message.signature)
+        for pos, cvi in enumerate(committee_indices):
+            if cvi == vi:
+                self._push(key, frozenset([pos]), sig)
+
+    def insert_contribution(self, slot, block_root, contribution, base):
+        """A verified subcommittee contribution: positions are the set
+        bits offset by the subcommittee base; the signature is already the
+        participants' aggregate."""
+        positions = frozenset(
+            base + i
+            for i, bit in enumerate(contribution.aggregation_bits)
+            if bit
+        )
+        if not positions:
+            return
+        self._push(
+            (int(slot), bytes(block_root)),
+            positions,
+            g2_decompress(
+                bytes(contribution.signature), subgroup_check=False
+            ),
+        )
+
+    def _push(self, key, positions, sig):
+        entries = self._entries[key]
+        for e in entries:
+            if e["positions"] == positions:
+                return  # duplicate coverage
+        entries.append({"positions": positions, "sig": sig})
+
+    # --------------------------------------------------------- extraction
 
     def get_sync_aggregate(self, slot, block_root, T):
-        """Best aggregate for (slot, root); infinity aggregate if empty."""
+        """Greedy disjoint merge (largest coverage first); infinity
+        aggregate when nothing landed."""
         size = self.preset.sync_committee_size
-        entry = self._messages.get((int(slot), bytes(block_root)), {})
-        bits = [0] * size
-        sigs = []
-        for pos, sig in entry.items():
-            bits[pos] = 1
-            sigs.append(g2_decompress(sig, subgroup_check=False))
-        if not sigs:
+        entries = sorted(
+            self._entries.get((int(slot), bytes(block_root)), []),
+            key=lambda e: -len(e["positions"]),
+        )
+        covered = set()
+        agg = None
+        for e in entries:
+            if e["positions"] & covered:
+                continue
+            covered |= e["positions"]
+            agg = e["sig"] if agg is None else C.g2_add(agg, e["sig"])
+        bits = [1 if i in covered else 0 for i in range(size)]
+        if agg is None:
             return T.SyncAggregate(
                 sync_committee_bits=bits,
                 sync_committee_signature=_INFINITY_SIG,
             )
         return T.SyncAggregate(
             sync_committee_bits=bits,
-            sync_committee_signature=g2_compress(RB.aggregate(sigs)),
+            sync_committee_signature=g2_compress(agg),
         )
 
     def prune(self, current_slot):
-        self._messages = defaultdict(
-            dict,
+        self._entries = defaultdict(
+            list,
             {
                 k: v
-                for k, v in self._messages.items()
+                for k, v in self._entries.items()
                 if k[0] >= current_slot - 2
             },
         )

@@ -178,27 +178,27 @@ def get_next_sync_committee(state, preset):
     return T.SyncCommittee(pubkeys=pubkeys, aggregate_pubkey=aggregate)
 
 
-def sync_committee_validator_indices(state, preset):
-    """Map current sync-committee pubkeys back to validator indices.
+def sync_committee_validator_indices(state, preset, committee=None):
+    """Map a sync committee's pubkeys back to validator indices
+    (default: the CURRENT committee).
 
-    Cached on the state keyed by the committee object (constant for a whole
-    sync-committee period — the reference's sync-committee cache); the
-    registry is scanned once per period via a pubkey->index dict, not per
-    block."""
-    cached = getattr(state, "_sync_committee_indices", None)
-    if cached is not None and cached[0] is state.current_sync_committee:
-        return cached[1]
+    Cached on the state keyed by the committee object (constant for a
+    whole period — the reference's sync-committee cache); the registry
+    pk->index scan runs once per distinct committee, not per call."""
+    committee = committee if committee is not None else state.current_sync_committee
+    cache = getattr(state, "_sync_committee_indices", None)
+    if cache is None:
+        cache = []
+        object.__setattr__(state, "_sync_committee_indices", cache)
+    for obj, out in cache:
+        if obj is committee:
+            return out
     reg = state.validators
     n = len(reg)
-    pk_to_index = {
-        reg.pubkey[i].tobytes(): i for i in range(n)
-    }
-    out = [
-        pk_to_index[bytes(pk)] for pk in state.current_sync_committee.pubkeys
-    ]
-    object.__setattr__(
-        state, "_sync_committee_indices", (state.current_sync_committee, out)
-    )
+    pk_to_index = {reg.pubkey[i].tobytes(): i for i in range(n)}
+    out = [pk_to_index[bytes(pk)] for pk in committee.pubkeys]
+    cache.append((committee, out))
+    del cache[:-2]   # at most current + next
     return out
 
 

@@ -32,6 +32,12 @@ class BeaconNodeInterface:
     def publish_aggregates(self, signed_aggregates):
         raise NotImplementedError
 
+    def sync_duties(self, epoch, pubkeys):
+        raise NotImplementedError
+
+    def publish_sync_messages(self, messages):
+        raise NotImplementedError
+
     def duties(self, epoch, pubkeys):
         raise NotImplementedError
 
@@ -201,6 +207,52 @@ class DirectBeaconNode(BeaconNodeInterface):
     def publish_aggregates(self, signed_aggregates):
         return self.chain.batch_verify_aggregated_attestations(signed_aggregates)
 
+    def sync_duties(self, epoch, pubkeys):
+        """Sync-committee membership for `pubkeys` in the PERIOD holding
+        `epoch` (duties/sync/{epoch}): the head state answers for its own
+        period via current_sync_committee and the next period via
+        next_sync_committee; anything else is unknown ([])."""
+        from ..state_processing import altair, phase0 as _p0
+
+        chain = self.chain
+        state = chain.head_state
+        if not altair.is_altair_state(state):
+            return []
+        per = chain.preset.epochs_per_sync_committee_period
+        head_period = _p0.get_current_epoch(state, chain.preset) // per
+        period = epoch // per
+        if period == head_period:
+            committee = state.current_sync_committee
+        elif period == head_period + 1:
+            committee = state.next_sync_committee
+        else:
+            return []
+        committee_indices = altair.sync_committee_validator_indices(
+            state, chain.preset, committee
+        )
+        positions_of = {}
+        for p, cvi in enumerate(committee_indices):
+            positions_of.setdefault(cvi, []).append(p)
+        # the cached committee map gives vi; match requested pubkeys via
+        # the registry rows of committee members only (no full scan)
+        reg = state.validators
+        pk_of = {
+            vi: reg.pubkey[vi].tobytes() for vi in positions_of
+        }
+        wanted = {bytes(pk) for pk in pubkeys}
+        out = []
+        for vi, positions in positions_of.items():
+            pk = pk_of[vi]
+            if pk in wanted:
+                out.append(
+                    {"pubkey": pk, "validator_index": vi,
+                     "positions": positions}
+                )
+        return out
+
+    def publish_sync_messages(self, messages):
+        return self.chain.batch_verify_sync_messages(messages)
+
 
 class HttpBeaconNode(BeaconNodeInterface):
     """The VC's production transport: a remote BN over the Beacon API
@@ -336,6 +388,24 @@ class HttpBeaconNode(BeaconNodeInterface):
              for a in signed_aggregates]
         )
 
+    def sync_duties(self, epoch, pubkeys):
+        return [
+            {
+                "pubkey": bytes.fromhex(d["pubkey"][2:]),
+                "validator_index": int(d["validator_index"]),
+                "positions": [int(p) for p in d["positions"]],
+            }
+            for d in self.api.sync_duties(epoch, pubkeys)
+        ]
+
+    def publish_sync_messages(self, messages):
+        from ..ssz import encode
+        from ..types.containers import SyncCommitteeMessage
+
+        return self.api.publish_sync_messages_ssz(
+            ["0x" + encode(SyncCommitteeMessage, m).hex() for m in messages]
+        )
+
 
 class BeaconNodeFallback(BeaconNodeInterface):
     """Ordered multi-node failover (beacon_node_fallback.rs:710)."""
@@ -377,6 +447,12 @@ class BeaconNodeFallback(BeaconNodeInterface):
 
     def publish_aggregates(self, signed_aggregates):
         return self._try("publish_aggregates", signed_aggregates)
+
+    def sync_duties(self, epoch, pubkeys):
+        return self._try("sync_duties", epoch, pubkeys)
+
+    def publish_sync_messages(self, messages):
+        return self._try("publish_sync_messages", messages)
 
 
 class ValidatorClient:
@@ -512,4 +588,49 @@ class ValidatorClient:
                 log.warning("refusing to attest at %s: %s", slot, e)
         if atts:
             self.bn.publish_attestations(atts)
+        self._sync_messages(slot, fork, gvr, out)
+        return out
+
+    def _sync_messages(self, slot, fork, gvr, out):
+        """Sync-committee message duty (same 1/3-slot timing as
+        attestations — sync_committee_service.rs).  Duties are cached per
+        sync-committee period."""
+        from ..types.containers import SyncCommitteeMessage
+
+        out.setdefault("sync_messages", [])
+        epoch = slot // self.preset.slots_per_epoch
+        period = epoch // self.preset.epochs_per_sync_committee_period
+        cache = getattr(self, "_sync_duty_cache", None)
+        if cache is not None and cache[0] == period:
+            duties = cache[1]
+        else:
+            try:
+                duties = self.bn.sync_duties(
+                    epoch, self.store.voting_pubkeys()
+                )
+            except NotImplementedError:
+                return out
+            self._sync_duty_cache = (period, duties)
+        if not duties:
+            return out
+        head = self.bn.head_info()
+        msgs = []
+        for duty in duties:
+            try:
+                sig = self.store.sign_sync_committee_message(
+                    duty["pubkey"], slot, head["head_root"], fork, gvr
+                )
+                msgs.append(
+                    SyncCommitteeMessage(
+                        slot=slot,
+                        beacon_block_root=head["head_root"],
+                        validator_index=duty["validator_index"],
+                        signature=sig,
+                    )
+                )
+                out["sync_messages"].append((slot, duty["validator_index"]))
+            except NotSafe as e:
+                log.warning("refusing sync message at %s: %s", slot, e)
+        if msgs:
+            self.bn.publish_sync_messages(msgs)
         return out

@@ -180,6 +180,28 @@ class ValidatorStore:
         root = sset.compute_signing_root_bytes32(block_root, domain)
         return g2_compress(RB.sign(sk, root))
 
+    def sign_sync_selection_proof(self, pubkey, slot, subcommittee_index,
+                                  fork, gvr):
+        from ..types.containers import SyncAggregatorSelectionData
+
+        sk = self._require_signable(pubkey)
+        epoch = int(slot) // self.preset.slots_per_epoch
+        domain = self.spec.get_domain(
+            Domain.SYNC_COMMITTEE_SELECTION_PROOF, epoch, fork, gvr
+        )
+        data = SyncAggregatorSelectionData(
+            slot=slot, subcommittee_index=subcommittee_index
+        )
+        return g2_compress(RB.sign(sk, compute_signing_root(data, domain)))
+
+    def sign_contribution_and_proof(self, pubkey, msg, fork, gvr):
+        sk = self._require_signable(pubkey)
+        epoch = int(msg.contribution.slot) // self.preset.slots_per_epoch
+        domain = self.spec.get_domain(
+            Domain.CONTRIBUTION_AND_PROOF, epoch, fork, gvr
+        )
+        return g2_compress(RB.sign(sk, compute_signing_root(msg, domain)))
+
     def sign_voluntary_exit(self, pubkey, exit_msg, fork, gvr):
         sk = self._require_signable(pubkey)
         domain = self.spec.get_domain(

@@ -146,3 +146,107 @@ def test_sync_message_rejects_non_member():
     )
     with pytest.raises(AttestationError, match="not in current"):
         chain.verify_sync_committee_message(msg)
+
+
+def test_sync_contribution_verification_and_pool_merge():
+    """ContributionAndProof 3-set verification feeds block production
+    (sync_committee_verification.rs:549-618)."""
+    from lighthouse_tpu.types.containers import (
+        ContributionAndProof,
+        SignedContributionAndProof,
+    )
+    from lighthouse_tpu.types.state import state_types
+
+    h = Harness(8, ALTAIR_SPEC)
+    chain = BeaconChain(
+        h.state.copy(), ALTAIR_SPEC, verifier=SignatureVerifier("oracle")
+    )
+    T = state_types(ALTAIR_SPEC.preset)
+    preset = ALTAIR_SPEC.preset
+    slot = h.state.slot + 1
+    block = h.produce_block(slot)
+    h.process_block(block, strategy="no_verification")
+    chain.on_tick(slot)
+    root = chain.process_block(block)
+
+    committee_indices = altair.sync_committee_validator_indices(
+        chain.head_state, preset
+    )
+    sub_size = preset.sync_committee_size // preset.sync_committee_subnet_count
+    store = ValidatorStore(ALTAIR_SPEC)
+    pks = {}
+    for vi in set(committee_indices):
+        pks[vi] = store.add_validator(h.keypairs[vi][0])
+    fork = chain.head_state.fork
+    gvr = bytes(chain.head_state.genesis_validators_root)
+
+    # find an aggregator in subcommittee 0 and build its contribution
+    made = None
+    for pos in range(sub_size):
+        vi = committee_indices[pos]
+        proof = store.sign_sync_selection_proof(pks[vi], slot, 0, fork, gvr)
+        if not chain._is_sync_aggregator(proof):
+            continue
+        # participants: every subcommittee position signs the head root
+        from lighthouse_tpu.crypto.ref import bls as RB
+        from lighthouse_tpu.crypto.ref import curves as C
+        from lighthouse_tpu.crypto.ref.curves import g2_compress
+
+        sigs = []
+        bits = [1] * sub_size
+        for p in range(sub_size):
+            pvi = committee_indices[p]
+            sig_b = store.sign_sync_committee_message(
+                pks[pvi], slot, root, fork, gvr
+            )
+            from lighthouse_tpu.crypto.ref.curves import g2_decompress
+
+            sigs.append(g2_decompress(sig_b, subgroup_check=False))
+        contribution = T.SyncCommitteeContribution(
+            slot=slot,
+            beacon_block_root=root,
+            subcommittee_index=0,
+            aggregation_bits=bits,
+            signature=g2_compress(RB.aggregate(sigs)),
+        )
+        msg = ContributionAndProof(
+            aggregator_index=vi, contribution=contribution,
+            selection_proof=proof,
+        )
+        sig = store.sign_contribution_and_proof(pks[vi], msg, fork, gvr)
+        made = SignedContributionAndProof(message=msg, signature=sig)
+        break
+    if made is None:
+        pytest.skip("no sync aggregator selected in subcommittee 0")
+
+    assert chain.verify_sync_contribution(made) is True
+    with pytest.raises(AttestationError, match="already seen"):
+        chain.verify_sync_contribution(made)
+
+    # the contribution's participation lands in the next produced block
+    blk, _ = chain.produce_block_on_state(slot + 1)
+    agg = blk.body.sync_aggregate
+    assert sum(agg.sync_committee_bits) >= sub_size
+    # and the STF accepts that aggregate end-to-end
+    signed = h.produce_block(slot + 1)
+    # (harness produces its own full aggregate; the pool-built one is
+    # checked by verifying the produced block's aggregate verifies)
+    from lighthouse_tpu.state_processing import signature_sets as sset
+
+    prev_root = root
+    s = sset.sync_aggregate_signature_set(
+        [
+            chain.pubkey_cache.get(committee_indices[p])
+            for p in range(preset.sync_committee_size)
+            if agg.sync_committee_bits[p]
+        ],
+        agg,
+        slot,
+        prev_root,
+        chain.head_state.fork,
+        gvr,
+        ALTAIR_SPEC,
+    )
+    from lighthouse_tpu.crypto.ref.bls import verify_signature_sets
+
+    assert s is None or verify_signature_sets([s]) is True

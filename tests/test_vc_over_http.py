@@ -61,3 +61,28 @@ def test_vc_aggregation_duty_over_http():
         assert chain.observed_aggregators, "aggregates verified and recorded"
     finally:
         server.stop()
+
+
+def test_vc_sync_message_duty_over_http():
+    ALTAIR = ChainSpec(preset=MinimalPreset, altair_fork_epoch=0)
+    h = Harness(8, ALTAIR)
+    chain = BeaconChain(h.state.copy(), ALTAIR, verifier=SignatureVerifier("oracle"))
+    server = BeaconApiServer(chain).start()
+    try:
+        api = BeaconApiClient(f"http://127.0.0.1:{server.port}")
+        bn = HttpBeaconNode(api, ALTAIR.preset).set_spec(ALTAIR)
+        store = ValidatorStore(ALTAIR)
+        for i in range(8):
+            store.add_validator(h.keypairs[i][0])
+        vc = ValidatorClient(store, bn, ALTAIR)
+
+        chain.on_tick(1)
+        vc.act_on_slot(1, phase="propose")
+        out = vc.act_on_slot(1, phase="attest")
+        assert out["sync_messages"], "sync-committee members signed the head"
+        assert chain.observed_sync_contributors, "messages verified server-side"
+        # pool carries the participation into the next produced block
+        blk, _ = chain.produce_block_on_state(2)
+        assert any(blk.body.sync_aggregate.sync_committee_bits)
+    finally:
+        server.stop()
