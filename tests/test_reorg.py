@@ -1,0 +1,101 @@
+"""End-to-end reorg: competing same-slot blocks, vote-driven head switch,
+watch-table rewrite, payload invalidation revert (fork_revert semantics)."""
+
+import copy
+
+from lighthouse_tpu.beacon.chain import BeaconChain
+from lighthouse_tpu.crypto.backend import SignatureVerifier
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.testing.harness import Harness
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+from lighthouse_tpu.watch import WatchUpdater
+
+SPEC = ChainSpec(preset=MinimalPreset)
+
+
+def _fork_harness(h):
+    """An independent copy of the harness whose chain diverges."""
+    h2 = Harness(8, SPEC)
+    h2.state = copy.deepcopy(h.state)
+    return h2
+
+
+def test_vote_driven_reorg_and_watch_rewrite():
+    h = Harness(8, SPEC)
+    chain = BeaconChain(h.state.copy(), SPEC, verifier=SignatureVerifier("fake"))
+    updater = WatchUpdater(chain)
+
+    # common history: slot 1
+    b1 = h.produce_block(1)
+    h.process_block(b1, strategy="no_verification")
+    chain.on_tick(1)
+    chain.process_block(b1)
+    updater.poll()
+
+    # competing branches off slot 1: A extends at slot 2 (main line);
+    # the fork SKIPS slot 2 and proposes B at slot 3 (different proposer,
+    # so the equivocation filter doesn't apply)
+    h_fork = _fork_harness(h)
+    block_a = h.produce_block(2)
+    block_b = h_fork.produce_block(3)
+    assert bytes(block_a.message.parent_root) == bytes(
+        block_b.message.parent_root
+    )
+
+    chain.on_tick(2)
+    root_a = chain.process_block(block_a)
+    assert chain.head_root == root_a
+    h.process_block(block_a, strategy="no_verification")
+    updater.poll()
+    assert updater.db.slots()[-1][1] == root_a.hex()
+
+    chain.on_tick(3)
+    root_b = chain.process_block(block_b)
+    h_fork.process_block(block_b, strategy="no_verification")
+
+    # the whole slot-3 committee votes the B branch; from slot 4 the head
+    # reorgs onto the fork
+    atts = h_fork.attest_slot(h_fork.state, 3, root_b)
+    chain.batch_verify_unaggregated_attestations(atts)
+    chain.on_tick(4)
+    head = chain.recompute_head()
+    assert head == root_b, "votes flipped the head to the fork"
+
+    # the watch table records the fork's canonical line
+    updater.poll()
+    rows = {slot: root for slot, root, _, _ in updater.db.slots()}
+    assert rows[3] == root_b.hex()
+    # slot 2 is EMPTY on the new canonical chain: the orphan row persists
+    # there (canonical_slots only stores slots that have blocks; the
+    # reference's updater reconciles these lazily too)
+    assert rows[2] == root_a.hex()
+
+
+def test_invalid_payload_reverts_head():
+    from lighthouse_tpu.execution import MockExecutionEngine
+    from lighthouse_tpu.types.state import state_types
+
+    BSPEC = ChainSpec(
+        preset=MinimalPreset, altair_fork_epoch=0, bellatrix_fork_epoch=0
+    )
+    T = state_types(MinimalPreset)
+    h = Harness(8, BSPEC)
+    engine = MockExecutionEngine(T)
+    chain = BeaconChain(
+        h.state.copy(), BSPEC, verifier=SignatureVerifier("fake"),
+        execution_engine=engine,
+    )
+    roots = []
+    for _ in range(2):
+        slot = h.state.slot + 1
+        block = h.produce_block(slot)
+        h.process_block(block, strategy="no_verification")
+        chain.on_tick(slot)
+        roots.append(chain.process_block(block))
+    assert chain.head_root == roots[-1]
+
+    # the EL later reports the head block's payload invalid (otb-style
+    # re-check): fork choice reverts to the last valid ancestor
+    head = chain.recompute_head()
+    new_head = chain.on_invalid_execution_payload(head)
+    assert new_head == roots[0], "head reverted to the valid parent"
