@@ -129,6 +129,21 @@ class BeaconApiClient:
     def publish_sync_messages_ssz(self, ssz_hex_list):
         return self._post("/eth/v1/beacon/pool/sync_committees", ssz_hex_list)
 
+    def sync_contribution_ssz(self, slot, subcommittee_index, block_root):
+        return self._get(
+            "/eth/v1/validator/sync_committee_contribution",
+            {
+                "slot": slot,
+                "subcommittee_index": subcommittee_index,
+                "beacon_block_root": "0x" + bytes(block_root).hex(),
+            },
+        )["data"]
+
+    def publish_contributions_ssz(self, ssz_hex_list):
+        return self._post(
+            "/eth/v1/validator/contribution_and_proofs", ssz_hex_list
+        )
+
     def produce_block_ssz(self, slot, randao_reveal):
         return self._post(
             f"/eth/v2/validator/blocks/{slot}",

@@ -315,6 +315,28 @@ class _Handler(BaseHTTPRequestHandler):
                 {"data": {"ssz": "0x" + _enc(T.Attestation, agg).hex()}}
             )
 
+        if path == "/eth/v1/validator/sync_committee_contribution":
+            from ..ssz import encode as _enc
+            from ..types.state import state_types
+
+            T = state_types(chain.preset)
+            slot = int(q["slot"][0])
+            sub_index = int(q["subcommittee_index"][0])
+            root = bytes.fromhex(
+                q["beacon_block_root"][0].removeprefix("0x")
+            )
+            contrib = chain.sync_pool.get_contribution(slot, root, sub_index, T)
+            if contrib is None:
+                return self._err(404, "no contribution for that subcommittee")
+            return self._json(
+                {
+                    "data": {
+                        "ssz": "0x"
+                        + _enc(T.SyncCommitteeContribution, contrib).hex()
+                    }
+                }
+            )
+
         if path == "/lighthouse/liveness":
             # the doppelganger-service probe: was each validator index seen
             # attesting (gossip or blocks) in the given epoch?
@@ -486,6 +508,16 @@ class _Handler(BaseHTTPRequestHandler):
                 body, SyncCommitteeMessage,
                 chain.batch_verify_sync_messages,
                 "some sync messages failed",
+            )
+
+        if path == "/eth/v1/validator/contribution_and_proofs":
+            from ..types.state import state_types
+
+            T = state_types(chain.preset)
+            return self._decode_verify_publish(
+                body, T.SignedContributionAndProof,
+                chain.batch_verify_sync_contributions,
+                "some contributions failed",
             )
 
         m = re.fullmatch(r"/eth/v1/validator/duties/attester/(\d+)", path)

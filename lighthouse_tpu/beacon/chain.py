@@ -741,17 +741,11 @@ class BeaconChain:
                 self.sync_pool.insert_message(m, committee_indices)
         return [tuple(r) for r in results]
 
-    def verify_sync_contribution(self, signed_contribution):
-        """sync_committee_verification.rs: the 3-set aggregator batch —
-        selection proof (SyncAggregatorSelectionData), aggregator
-        signature over ContributionAndProof, and the contribution itself
-        against the subcommittee's participant pubkeys — verified in ONE
-        device call (:549-618)."""
-        from ..state_processing import altair
-
-        state = self.head_state
-        if not altair.is_altair_state(state):
-            raise AttestationError("pre-altair state has no sync committee")
+    def _sync_contribution_checks(self, signed_contribution, state,
+                                  committee_indices):
+        """Structural/membership/selection gates for one signed
+        contribution.  Returns (sets, observed_key, pool_insert_args);
+        raises AttestationError on any reject."""
         msg = signed_contribution.message
         contribution = msg.contribution
         sub_index = int(contribution.subcommittee_index)
@@ -760,19 +754,15 @@ class BeaconChain:
         key = (int(contribution.slot), int(msg.aggregator_index), sub_index)
         if key in self.observed_sync_aggregators:
             raise AttestationError("sync aggregator already seen")
-        committee_indices = altair.sync_committee_validator_indices(
-            state, self.preset
-        )
-        sub_size = (
-            self.preset.sync_committee_size
-            // self.preset.sync_committee_subnet_count
-        )
+        sub_size = self.preset.sync_subcommittee_size
         subcommittee = committee_indices[
             sub_index * sub_size : (sub_index + 1) * sub_size
         ]
         if int(msg.aggregator_index) not in subcommittee:
             raise AttestationError("aggregator not in subcommittee")
-        if not self._is_sync_aggregator(bytes(msg.selection_proof)):
+        if not self._is_sync_aggregator(
+            self.preset, bytes(msg.selection_proof)
+        ):
             raise AttestationError("selection proof does not select aggregator")
         participants = [
             self.pubkey_cache.get(vi)
@@ -799,30 +789,92 @@ class BeaconChain:
             ]
         except sset.SignatureSetError as e:
             raise AttestationError(f"undecodable signature: {e}") from e
-        if not self.verifier.verify_signature_sets(sets):
-            raise AttestationError("sync contribution verification failed")
-        self.observed_sync_aggregators.add(key)
-        # fold the contribution into the block-production pool at its
-        # subcommittee's global position base
-        self.sync_pool.insert_contribution(
+        insert_args = (
             int(contribution.slot),
             bytes(contribution.beacon_block_root),
             contribution,
             sub_index * sub_size,
         )
+        return sets, key, insert_args
+
+    def verify_sync_contribution(self, signed_contribution):
+        """sync_committee_verification.rs: the 3-set aggregator batch —
+        selection proof (SyncAggregatorSelectionData), aggregator
+        signature over ContributionAndProof, and the contribution itself
+        against the subcommittee's participant pubkeys — verified in ONE
+        device call (:549-618)."""
+        from ..state_processing import altair
+
+        state = self.head_state
+        if not altair.is_altair_state(state):
+            raise AttestationError("pre-altair state has no sync committee")
+        committee_indices = altair.sync_committee_validator_indices(
+            state, self.preset
+        )
+        sets, key, insert_args = self._sync_contribution_checks(
+            signed_contribution, state, committee_indices
+        )
+        if not self.verifier.verify_signature_sets(sets):
+            raise AttestationError("sync contribution verification failed")
+        self.observed_sync_aggregators.add(key)
+        # fold the contribution into the block-production pool at its
+        # subcommittee's global position base
+        self.sync_pool.insert_contribution(*insert_args)
         return True
 
-    def _is_sync_aggregator(self, selection_proof):
+    def batch_verify_sync_contributions(self, signed_contributions):
+        """All ContributionAndProof publishes of a tick in ONE device
+        batch (each item is itself a 3-set group); per-item fallback when
+        the batch is poisoned.  Returns [(signed, error|None)]."""
+        from ..state_processing import altair
+
+        state = self.head_state
+        if not altair.is_altair_state(state):
+            return [
+                (c, AttestationError("pre-altair state has no sync committee"))
+                for c in signed_contributions
+            ]
+        committee_indices = altair.sync_committee_validator_indices(
+            state, self.preset
+        )
+        results = []
+        groups = []   # (owner result index, sets, observed key, insert args)
+        seen_in_batch = set()
+        for sc in signed_contributions:
+            try:
+                sets, key, insert_args = self._sync_contribution_checks(
+                    sc, state, committee_indices
+                )
+                if key in seen_in_batch:
+                    raise AttestationError("sync aggregator already seen")
+            except AttestationError as e:
+                results.append([sc, e])
+                continue
+            seen_in_batch.add(key)
+            results.append([sc, None])
+            groups.append((len(results) - 1, sets, key, insert_args))
+        if groups:
+            all_sets = [s for _, sets, _, _ in groups for s in sets]
+            if not self.verifier.verify_signature_sets(all_sets):
+                for owner, sets, _, _ in groups:
+                    if not self.verifier.verify_signature_sets(sets):
+                        results[owner][1] = AttestationError(
+                            "sync contribution verification failed"
+                        )
+            for owner, _, key, insert_args in groups:
+                if results[owner][1] is None:
+                    self.observed_sync_aggregators.add(key)
+                    self.sync_pool.insert_contribution(*insert_args)
+        return [tuple(r) for r in results]
+
+    @staticmethod
+    def _is_sync_aggregator(preset, selection_proof):
         """Spec is_sync_committee_aggregator: modulus over subcommittee
-        size / TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE (=16)."""
+        size / TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE (=16).  Static so
+        the VC's contribution duty shares the exact selection rule."""
         import hashlib
 
-        modulo = max(
-            1,
-            self.preset.sync_committee_size
-            // self.preset.sync_committee_subnet_count
-            // 16,
-        )
+        modulo = max(1, preset.sync_subcommittee_size // 16)
         h = hashlib.sha256(bytes(selection_proof)).digest()
         return int.from_bytes(h[:8], "little") % modulo == 0
 

@@ -71,21 +71,25 @@ class SyncContributionPool:
 
     # --------------------------------------------------------- extraction
 
-    def get_sync_aggregate(self, slot, block_root, T):
-        """Greedy disjoint merge (largest coverage first); infinity
-        aggregate when nothing landed."""
-        size = self.preset.sync_committee_size
-        entries = sorted(
-            self._entries.get((int(slot), bytes(block_root)), []),
-            key=lambda e: -len(e["positions"]),
-        )
+    @staticmethod
+    def _greedy_merge(entries):
+        """Greedy disjoint merge (largest coverage first) -> (covered
+        position set, aggregate point | None)."""
         covered = set()
         agg = None
-        for e in entries:
+        for e in sorted(entries, key=lambda e: -len(e["positions"])):
             if e["positions"] & covered:
                 continue
             covered |= e["positions"]
             agg = e["sig"] if agg is None else C.g2_add(agg, e["sig"])
+        return covered, agg
+
+    def get_sync_aggregate(self, slot, block_root, T):
+        """Best whole-committee aggregate; infinity when nothing landed."""
+        size = self.preset.sync_committee_size
+        covered, agg = self._greedy_merge(
+            self._entries.get((int(slot), bytes(block_root)), [])
+        )
         bits = [1 if i in covered else 0 for i in range(size)]
         if agg is None:
             return T.SyncAggregate(
@@ -95,6 +99,32 @@ class SyncContributionPool:
         return T.SyncAggregate(
             sync_committee_bits=bits,
             sync_committee_signature=g2_compress(agg),
+        )
+
+    def get_contribution(self, slot, block_root, subcommittee_index, T):
+        """Pooled per-subcommittee contribution for the VC's 2/3-slot
+        aggregation duty (the sync_committee_contribution endpoint —
+        sync_committee_service.rs aggregation phase): greedy disjoint
+        merge of the entries lying fully inside the subcommittee's
+        position range; None when nothing landed there."""
+        sub_size = self.preset.sync_subcommittee_size
+        base = int(subcommittee_index) * sub_size
+        in_range = range(base, base + sub_size)
+        covered, agg = self._greedy_merge(
+            e
+            for e in self._entries.get((int(slot), bytes(block_root)), [])
+            if all(p in in_range for p in e["positions"])
+        )
+        if agg is None:
+            return None
+        return T.SyncCommitteeContribution(
+            slot=int(slot),
+            beacon_block_root=bytes(block_root),
+            subcommittee_index=int(subcommittee_index),
+            aggregation_bits=[
+                1 if base + i in covered else 0 for i in range(sub_size)
+            ],
+            signature=g2_compress(agg),
         )
 
     def prune(self, current_slot):

@@ -59,6 +59,13 @@ class Preset:
     max_bls_to_execution_changes: int = 16
     sync_committee_subnet_count: int = 4
 
+    @property
+    def sync_subcommittee_size(self) -> int:
+        """Positions per sync subnet (spec SYNC_COMMITTEE_SIZE /
+        SYNC_COMMITTEE_SUBNET_COUNT) — the one place the subcommittee
+        boundary arithmetic lives."""
+        return self.sync_committee_size // self.sync_committee_subnet_count
+
 
 MainnetPreset = Preset(
     name="mainnet",

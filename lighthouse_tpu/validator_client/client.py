@@ -38,6 +38,12 @@ class BeaconNodeInterface:
     def publish_sync_messages(self, messages):
         raise NotImplementedError
 
+    def get_sync_contribution(self, slot, subcommittee_index, beacon_block_root):
+        raise NotImplementedError
+
+    def publish_contributions(self, signed_contributions):
+        raise NotImplementedError
+
     def duties(self, epoch, pubkeys):
         raise NotImplementedError
 
@@ -253,6 +259,17 @@ class DirectBeaconNode(BeaconNodeInterface):
     def publish_sync_messages(self, messages):
         return self.chain.batch_verify_sync_messages(messages)
 
+    def get_sync_contribution(self, slot, subcommittee_index, beacon_block_root):
+        from ..types.state import state_types
+
+        return self.chain.sync_pool.get_contribution(
+            slot, beacon_block_root, subcommittee_index,
+            state_types(self.chain.preset),
+        )
+
+    def publish_contributions(self, signed_contributions):
+        return self.chain.batch_verify_sync_contributions(signed_contributions)
+
 
 class HttpBeaconNode(BeaconNodeInterface):
     """The VC's production transport: a remote BN over the Beacon API
@@ -406,6 +423,34 @@ class HttpBeaconNode(BeaconNodeInterface):
             ["0x" + encode(SyncCommitteeMessage, m).hex() for m in messages]
         )
 
+    def get_sync_contribution(self, slot, subcommittee_index, beacon_block_root):
+        from ..api.client import ApiError
+        from ..ssz import decode
+        from ..types.state import state_types
+
+        T = state_types(self.preset)
+        try:
+            resp = self.api.sync_contribution_ssz(
+                slot, subcommittee_index, beacon_block_root
+            )
+        except ApiError as e:
+            if str(e).startswith("404"):
+                return None      # nothing pooled for this subcommittee
+            raise                # outages must surface, not skip duties
+        return decode(
+            T.SyncCommitteeContribution, bytes.fromhex(resp["ssz"][2:])
+        )
+
+    def publish_contributions(self, signed_contributions):
+        from ..ssz import encode
+        from ..types.state import state_types
+
+        T = state_types(self.preset)
+        return self.api.publish_contributions_ssz(
+            ["0x" + encode(T.SignedContributionAndProof, c).hex()
+             for c in signed_contributions]
+        )
+
 
 class BeaconNodeFallback(BeaconNodeInterface):
     """Ordered multi-node failover (beacon_node_fallback.rs:710)."""
@@ -453,6 +498,15 @@ class BeaconNodeFallback(BeaconNodeInterface):
 
     def publish_sync_messages(self, messages):
         return self._try("publish_sync_messages", messages)
+
+    def get_sync_contribution(self, slot, subcommittee_index, beacon_block_root):
+        return self._try(
+            "get_sync_contribution", slot, subcommittee_index,
+            beacon_block_root,
+        )
+
+    def publish_contributions(self, signed_contributions):
+        return self._try("publish_contributions", signed_contributions)
 
 
 class ValidatorClient:
@@ -565,6 +619,71 @@ class ValidatorClient:
                 log.warning("refusing to aggregate at %s: %s", slot, e)
         if signed_aggs:
             self.bn.publish_aggregates(signed_aggs)
+        return self._sync_contributions(slot, fork, gvr, out)
+
+    def _sync_contributions(self, slot, fork, gvr, out):
+        """2/3-slot sync aggregation duty (sync_committee_service.rs
+        aggregation phase): committee members whose
+        SyncAggregatorSelectionData proof selects them fetch their
+        subcommittee's pooled contribution and broadcast a
+        SignedContributionAndProof."""
+        from ..beacon.chain import BeaconChain
+        from ..types.state import state_types
+
+        out.setdefault("sync_contributions", [])
+        duties = self._get_sync_duties(slot)
+        if not duties:
+            return out
+        T = state_types(self.preset)
+        sub_size = self.preset.sync_subcommittee_size
+        # aggregate over the root members actually signed at 1/3 slot —
+        # a head change between 1/3 and 2/3 must not strand the pooled
+        # contribution under the old root (sync_committee_service.rs
+        # passes the message-phase block root through)
+        signed_at = getattr(self, "_sync_signed_root", None)
+        head_root = signed_at[1] if signed_at and signed_at[0] == slot else None
+        fetch_head = head_root is None   # fall back to the current head
+        signed = []
+        contribution_by_sub = {}   # one fetch per subcommittee
+        for duty in duties:
+            for sub in sorted({p // sub_size for p in duty["positions"]}):
+                try:
+                    proof = self.store.sign_sync_selection_proof(
+                        duty["pubkey"], slot, sub, fork, gvr
+                    )
+                    if not BeaconChain._is_sync_aggregator(
+                        self.preset, proof
+                    ):
+                        continue
+                    if head_root is None and fetch_head:
+                        head_root = self.bn.head_info()["head_root"]
+                    if sub not in contribution_by_sub:
+                        contribution_by_sub[sub] = self.bn.get_sync_contribution(
+                            slot, sub, head_root
+                        )
+                    contribution = contribution_by_sub[sub]
+                    if contribution is None:
+                        continue
+                    msg = T.ContributionAndProof(
+                        aggregator_index=duty["validator_index"],
+                        contribution=contribution,
+                        selection_proof=proof,
+                    )
+                    sig = self.store.sign_contribution_and_proof(
+                        duty["pubkey"], msg, fork, gvr
+                    )
+                    signed.append(
+                        T.SignedContributionAndProof(message=msg, signature=sig)
+                    )
+                    out["sync_contributions"].append(
+                        (slot, duty["validator_index"], sub)
+                    )
+                except NotSafe as e:
+                    log.warning(
+                        "refusing sync contribution at %s: %s", slot, e
+                    )
+        if signed:
+            self.bn.publish_contributions(signed)
         return out
 
     def _attest(self, slot, duties, fork, gvr, out):
@@ -591,6 +710,21 @@ class ValidatorClient:
         self._sync_messages(slot, fork, gvr, out)
         return out
 
+    def _get_sync_duties(self, slot):
+        """Sync duties cached per sync-committee period (the membership
+        only changes at period boundaries — duties_service/sync.rs)."""
+        epoch = slot // self.preset.slots_per_epoch
+        period = epoch // self.preset.epochs_per_sync_committee_period
+        cache = getattr(self, "_sync_duty_cache", None)
+        if cache is not None and cache[0] == period:
+            return cache[1]
+        try:
+            duties = self.bn.sync_duties(epoch, self.store.voting_pubkeys())
+        except NotImplementedError:
+            return []
+        self._sync_duty_cache = (period, duties)
+        return duties
+
     def _sync_messages(self, slot, fork, gvr, out):
         """Sync-committee message duty (same 1/3-slot timing as
         attestations — sync_committee_service.rs).  Duties are cached per
@@ -598,22 +732,13 @@ class ValidatorClient:
         from ..types.containers import SyncCommitteeMessage
 
         out.setdefault("sync_messages", [])
-        epoch = slot // self.preset.slots_per_epoch
-        period = epoch // self.preset.epochs_per_sync_committee_period
-        cache = getattr(self, "_sync_duty_cache", None)
-        if cache is not None and cache[0] == period:
-            duties = cache[1]
-        else:
-            try:
-                duties = self.bn.sync_duties(
-                    epoch, self.store.voting_pubkeys()
-                )
-            except NotImplementedError:
-                return out
-            self._sync_duty_cache = (period, duties)
+        duties = self._get_sync_duties(slot)
         if not duties:
             return out
         head = self.bn.head_info()
+        # remembered for the 2/3-slot contribution phase: aggregate over
+        # the root we signed, not whatever the head becomes later
+        self._sync_signed_root = (slot, head["head_root"])
         msgs = []
         for duty in duties:
             try:

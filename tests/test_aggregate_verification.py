@@ -185,7 +185,7 @@ def test_sync_contribution_verification_and_pool_merge():
     for pos in range(sub_size):
         vi = committee_indices[pos]
         proof = store.sign_sync_selection_proof(pks[vi], slot, 0, fork, gvr)
-        if not chain._is_sync_aggregator(proof):
+        if not chain._is_sync_aggregator(chain.preset, proof):
             continue
         # participants: every subcommittee position signs the head root
         from lighthouse_tpu.crypto.ref import bls as RB

@@ -63,6 +63,37 @@ def test_vc_aggregation_duty_over_http():
         server.stop()
 
 
+def test_vc_sync_contribution_duty_over_http():
+    """The complete sync-committee story over HTTP: members sign the head
+    at 1/3 slot, selected aggregators fetch their subcommittee's pooled
+    contribution at 2/3 slot and publish SignedContributionAndProofs,
+    which the BN verifies (3-set batches) and folds back into the pool."""
+    ALTAIR = ChainSpec(preset=MinimalPreset, altair_fork_epoch=0)
+    h = Harness(8, ALTAIR)
+    chain = BeaconChain(h.state.copy(), ALTAIR, verifier=SignatureVerifier("oracle"))
+    server = BeaconApiServer(chain).start()
+    try:
+        # two validators keep the oracle-backend pairing count small;
+        # contribution batches are 3 sets per item
+        api = BeaconApiClient(f"http://127.0.0.1:{server.port}", timeout=180.0)
+        bn = HttpBeaconNode(api, ALTAIR.preset).set_spec(ALTAIR)
+        store = ValidatorStore(ALTAIR)
+        for i in range(2):
+            store.add_validator(h.keypairs[i][0])
+        vc = ValidatorClient(store, bn, ALTAIR)
+
+        chain.on_tick(1)
+        out = vc.act_on_slot(1, phase="attest")
+        assert out["sync_messages"], "sync members signed the head"
+        out = vc.act_on_slot(1, phase="aggregate")
+        # minimal subcommittees are 8-wide => modulo 1 => every member
+        # with a duty is selected as sync aggregator
+        assert out["sync_contributions"], "a sync aggregator contributed"
+        assert chain.observed_sync_aggregators, "BN verified the contributions"
+    finally:
+        server.stop()
+
+
 def test_vc_sync_message_duty_over_http():
     ALTAIR = ChainSpec(preset=MinimalPreset, altair_fork_epoch=0)
     h = Harness(8, ALTAIR)
