@@ -1,0 +1,188 @@
+"""Pure-Python snappy block format (compress + decompress).
+
+The reference's wire protocols are ssz_snappy (gossip uses raw snappy
+blocks — /root/reference/beacon_node/lighthouse_network/src/types/
+pubsub.rs; req/resp chunks are snappy too, rpc/codec/).  No snappy C
+binding is available in this image, so the block format is implemented
+here: a full decompressor and a greedy hash-match compressor (the same
+strategy as snappy's C fast path — 4-byte hash table, emit literal runs
+between matches, extend matches byte-wise).
+
+Block format: uvarint uncompressed length, then tagged elements —
+  tag&3 == 0: literal. len-1 in tag>>2 when <60; 60..63 mean 1..4
+              little-endian extra length bytes follow.
+  tag&3 == 1: copy, 1-byte offset: len = ((tag>>2)&7)+4,
+              offset = ((tag>>5)<<8) | next_byte.
+  tag&3 == 2: copy, 2-byte LE offset: len = (tag>>2)+1.
+  tag&3 == 3: copy, 4-byte LE offset: len = (tag>>2)+1.
+Copies may overlap forward (LZ77 run-length behavior).
+"""
+
+
+class SnappyError(ValueError):
+    pass
+
+
+def uvarint_encode(n):
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def uvarint_decode(buf, pos):
+    shift = 0
+    result = 0
+    while True:
+        if pos >= len(buf):
+            raise SnappyError("truncated varint")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise SnappyError("varint too long")
+
+
+def _emit_literal(out, data, start, end):
+    n = end - start
+    if n == 0:
+        return
+    if n <= 60:
+        out.append((n - 1) << 2)
+    elif n <= 0x100:
+        out.append(60 << 2)
+        out.append(n - 1)
+    elif n <= 0x10000:
+        out.append(61 << 2)
+        out += (n - 1).to_bytes(2, "little")
+    elif n <= 0x1000000:
+        out.append(62 << 2)
+        out += (n - 1).to_bytes(3, "little")
+    else:
+        out.append(63 << 2)
+        out += (n - 1).to_bytes(4, "little")
+    out += data[start:end]
+
+
+def _emit_copy(out, offset, length):
+    # prefer the 2-byte-offset form (len 1..64, offset < 65536); split
+    # long matches into <=64-byte copies
+    while length > 0:
+        n = min(length, 64)
+        if length - n in (1, 2, 3):
+            # leave >=4 for the final copy so every piece is encodable
+            n = length - 4
+        if 4 <= n <= 11 and offset < 2048:
+            out.append(1 | ((n - 4) << 2) | ((offset >> 8) << 5))
+            out.append(offset & 0xFF)
+        else:
+            out.append(2 | ((n - 1) << 2))
+            out += offset.to_bytes(2, "little")
+        length -= n
+
+
+def compress(data):
+    data = bytes(data)
+    n = len(data)
+    out = bytearray(uvarint_encode(n))
+    if n == 0:
+        return bytes(out)
+    if n < 4:
+        _emit_literal(out, data, 0, n)
+        return bytes(out)
+    table = {}
+    pos = 0
+    lit_start = 0
+    limit = n - 3
+    while pos < limit:
+        key = data[pos : pos + 4]
+        cand = table.get(key)
+        table[key] = pos
+        if cand is not None and pos - cand < 0x10000:
+            # extend the match forward
+            length = 4
+            while (
+                pos + length < n
+                and data[cand + length] == data[pos + length]
+                and length < 0x10000
+            ):
+                length += 1
+            _emit_literal(out, data, lit_start, pos)
+            _emit_copy(out, pos - cand, length)
+            pos += length
+            lit_start = pos
+        else:
+            pos += 1
+    _emit_literal(out, data, lit_start, n)
+    return bytes(out)
+
+
+def decompress(data):
+    data = bytes(data)
+    ulen, pos = uvarint_decode(data, 0)
+    if ulen > (1 << 32):
+        raise SnappyError("unreasonable uncompressed length")
+    out = bytearray()
+    n = len(data)
+    while pos < n:
+        if len(out) > ulen:
+            # bound memory to the declared size: reject amplification
+            # attacks inside the loop, not after materializing them
+            raise SnappyError("output exceeds declared length")
+        tag = data[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == 0:
+            length = tag >> 2
+            if length >= 60:
+                extra = length - 59
+                if pos + extra > n:
+                    raise SnappyError("truncated literal length")
+                length = int.from_bytes(data[pos : pos + extra], "little")
+                pos += extra
+            length += 1
+            if pos + length > n:
+                raise SnappyError("truncated literal")
+            out += data[pos : pos + length]
+            pos += length
+            continue
+        if kind == 1:
+            if pos >= n:
+                raise SnappyError("truncated copy-1")
+            length = ((tag >> 2) & 0x7) + 4
+            offset = ((tag >> 5) << 8) | data[pos]
+            pos += 1
+        elif kind == 2:
+            if pos + 2 > n:
+                raise SnappyError("truncated copy-2")
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos : pos + 2], "little")
+            pos += 2
+        else:
+            if pos + 4 > n:
+                raise SnappyError("truncated copy-4")
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos : pos + 4], "little")
+            pos += 4
+        if offset == 0 or offset > len(out):
+            raise SnappyError("copy offset out of range")
+        # overlapping copies must be materialized byte-by-byte
+        start = len(out) - offset
+        if offset >= length:
+            out += out[start : start + length]
+        else:
+            for i in range(length):
+                out.append(out[start + i])
+    if len(out) != ulen:
+        raise SnappyError(
+            f"decompressed length {len(out)} != declared {ulen}"
+        )
+    return bytes(out)

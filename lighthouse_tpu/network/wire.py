@@ -1,0 +1,690 @@
+"""Real TCP wire protocol: gossip + req/resp over sockets.
+
+The host-side transport the in-process `GossipBus` stands in for during
+tests.  Mirror of /root/reference/beacon_node/lighthouse_network/src/:
+
+  * rpc/protocol.rs — Status / Goodbye / Ping / MetaData /
+    BlocksByRange / BlocksByRoot request-response protocols, ssz_snappy
+    encoded (rpc/codec/base.rs)
+  * types/pubsub.rs — gossip messages travel as snappy(SSZ) with the
+    topic naming of types/topics.rs
+  * the gossipsub layer (service/behaviour) — replaced by flood
+    publishing with a seen-message-id cache: every message is delivered
+    at most once per node and re-flooded to subscribed peers, which
+    gives multi-hop propagation without the mesh bookkeeping
+  * peer_manager/ — handshake gating (fork digest must match), additive
+    scoring with ban-driven disconnects, goodbye reason codes
+
+Framing (single-stream TCP instead of libp2p multistream): every frame
+is  uvarint(len) || type:u8 || body.  Request bodies and gossip payloads
+are snappy block format (network/snappy.py — no C binding in image).
+
+Wire vs in-process: `WireNode.bus_view()` / `reqresp_view()` expose the
+exact `GossipBus` / `ReqResp` surfaces, so `Router`, `BeaconProcessor`
+and the simulator run unchanged over real sockets.
+"""
+
+import hashlib
+import logging
+import socket
+import struct
+import threading
+import time
+from collections import OrderedDict
+
+from ..ssz import Bytes4, Bytes32, Container, decode, encode, uint64
+from ..types.spec import compute_fork_data_root
+from . import snappy
+from .gossip import GossipKind, PeerScore
+
+log = logging.getLogger("lighthouse_tpu.wire")
+
+# frame types
+HELLO = 1
+SUBSCRIBE = 2
+UNSUBSCRIBE = 3
+PUBLISH = 4
+REQUEST = 5
+RESPONSE = 6
+GOODBYE_FRAME = 7
+PING = 8
+PONG = 9
+
+# req/resp methods (rpc/protocol.rs Protocol enum)
+M_STATUS = 0
+M_GOODBYE = 1
+M_BLOCKS_BY_RANGE = 2
+M_BLOCKS_BY_ROOT = 3
+M_PING = 4
+M_METADATA = 5
+
+# response result codes (rpc/methods.rs RPCResponseErrorCode)
+R_SUCCESS = 0
+R_INVALID_REQUEST = 1
+R_SERVER_ERROR = 2
+R_RESOURCE_UNAVAILABLE = 3
+
+# goodbye reasons (rpc/methods.rs GoodbyeReason)
+GB_CLIENT_SHUTDOWN = 1
+GB_IRRELEVANT_NETWORK = 2
+GB_FAULT = 3
+GB_BANNED = 4
+
+SEEN_CACHE_SIZE = 4096
+MAX_FRAME = 1 << 24
+
+
+class StatusMessage(Container):
+    """rpc Status v1 (rpc/methods.rs StatusMessage)."""
+
+    fields = [
+        ("fork_digest", Bytes4),
+        ("finalized_root", Bytes32),
+        ("finalized_epoch", uint64),
+        ("head_root", Bytes32),
+        ("head_slot", uint64),
+    ]
+
+
+class BlocksByRangeRequest(Container):
+    fields = [("start_slot", uint64), ("count", uint64), ("step", uint64)]
+
+
+class MetaData(Container):
+    """metadata v1: sequence number + attnets (as a u64 mask here)."""
+
+    fields = [("seq_number", uint64), ("attnets", uint64)]
+
+
+class WireError(Exception):
+    pass
+
+
+_uvarint = snappy.uvarint_encode
+
+
+def _read_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def _read_uvarint(sock):
+    shift = 0
+    result = 0
+    while True:
+        b = _read_exact(sock, 1)[0]
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result
+        shift += 7
+        if shift > 35:
+            raise WireError("frame length varint too long")
+
+
+class GossipCodec:
+    """topic prefix -> SSZ encode/decode of the gossip payloads
+    (types/pubsub.rs PubsubMessage::decode)."""
+
+    def __init__(self, preset):
+        from ..beacon.store import _Codec
+        from ..types.containers import (
+            AttesterSlashing,
+            ProposerSlashing,
+            SignedAggregateAndProof,
+            SignedVoluntaryExit,
+            SyncCommitteeMessage,
+        )
+        from ..types.state import state_types
+
+        T = state_types(preset)
+        self._block_codec = _Codec(preset)
+        self._by_prefix = [
+            # longest prefixes first: beacon_attestation_{subnet} etc.
+            (GossipKind.AGGREGATE_AND_PROOF, SignedAggregateAndProof),
+            ("sync_committee_contribution_and_proof",
+             T.SignedContributionAndProof),
+            (GossipKind.ATTESTATION, T.Attestation),
+            (GossipKind.SYNC_COMMITTEE, SyncCommitteeMessage),
+            (GossipKind.VOLUNTARY_EXIT, SignedVoluntaryExit),
+            (GossipKind.PROPOSER_SLASHING, ProposerSlashing),
+            (GossipKind.ATTESTER_SLASHING, AttesterSlashing),
+        ]
+
+    def encode(self, topic, message):
+        if topic.startswith(GossipKind.BEACON_BLOCK):
+            return self._block_codec.enc_block(message)
+        for prefix, cls in self._by_prefix:
+            if topic.startswith(prefix):
+                return encode(cls, message)
+        raise WireError(f"no codec for topic {topic}")
+
+    def decode(self, topic, payload):
+        if topic.startswith(GossipKind.BEACON_BLOCK):
+            return self._block_codec.dec_block(payload)
+        for prefix, cls in self._by_prefix:
+            if topic.startswith(prefix):
+                return decode(cls, payload)
+        raise WireError(f"no codec for topic {topic}")
+
+
+class _Peer:
+    """One live connection: writer lock + reader thread + score."""
+
+    def __init__(self, node, sock, addr):
+        self.node = node
+        self.sock = sock
+        self.addr = addr
+        self.peer_id = None          # learned from HELLO
+        self.sent_hello = False      # did WE already send our HELLO?
+        self.topics = set()          # topics the REMOTE subscribed to
+        self.score = PeerScore()
+        self.status = None           # remote StatusMessage
+        self.metadata_seq = 0
+        self._wlock = threading.Lock()
+        self._alive = True
+
+    def send_frame(self, ftype, body):
+        frame = bytes([ftype]) + body
+        try:
+            with self._wlock:
+                self.sock.sendall(_uvarint(len(frame)) + frame)
+        except OSError as e:
+            raise ConnectionError(str(e)) from e
+
+    def close(self):
+        self._alive = False
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class WireNode:
+    """One network identity: a listening socket, dialed/accepted peers,
+    topic handlers, and a req/resp client+server."""
+
+    def __init__(self, chain=None, port=0, peer_id=None, attnets=0):
+        self.chain = chain
+        self.peer_id = peer_id or hashlib.sha256(
+            struct.pack("dQ", time.time(), id(self))
+        ).hexdigest()[:16]
+        self.attnets = attnets
+        self.metadata_seq = 1
+        self.handlers = {}             # topic -> handler(from_peer, obj)
+        self.peers = {}                # peer_id -> _Peer
+        self.banned_ids = set()
+        self._seen = OrderedDict()     # message id -> None (gossip dedup)
+        self._seen_lock = threading.Lock()
+        self._req_id = 0
+        self._pending = {}             # req_id -> [event, result, code]
+        self._lock = threading.Lock()
+        self.codec = None
+        if chain is not None:
+            self.codec = GossipCodec(chain.preset)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", port))
+        self._listener.listen(32)
+        self.port = self._listener.getsockname()[1]
+        self._stopped = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+        self._accept_thread.start()
+
+    # ------------------------------------------------------------ status
+
+    def local_status(self):
+        """Status built from the attached chain (the handshake payload —
+        router.rs on_status)."""
+        if self.chain is None:
+            return StatusMessage(
+                fork_digest=bytes(4), finalized_root=bytes(32),
+                finalized_epoch=0, head_root=bytes(32), head_slot=0,
+            )
+        chain = self.chain
+        st = chain.head_state
+        epoch, root = chain.fork_choice.store.finalized_checkpoint
+        digest = compute_fork_data_root(
+            bytes(st.fork.current_version),
+            bytes(st.genesis_validators_root),
+        )[:4]
+        return StatusMessage(
+            fork_digest=digest,
+            finalized_root=bytes(root),
+            finalized_epoch=int(epoch),
+            head_root=chain.head_root,
+            head_slot=int(st.slot),
+        )
+
+    def _hello_body(self):
+        pid = self.peer_id.encode()
+        return bytes([len(pid)]) + pid + encode(
+            StatusMessage, self.local_status()
+        )
+
+    # ------------------------------------------------------- connections
+
+    def dial(self, host, port, timeout=10.0):
+        """Connect, exchange HELLOs, and (re)announce subscriptions.
+        Returns the remote peer id."""
+        sock = socket.create_connection((host, port), timeout=timeout)
+        sock.settimeout(None)
+        peer = _Peer(self, sock, (host, port))
+        peer.sent_hello = True
+        peer.send_frame(HELLO, self._hello_body())
+        # the reader thread completes the handshake on the HELLO reply
+        t = threading.Thread(
+            target=self._reader_loop, args=(peer,), daemon=True
+        )
+        t.start()
+        deadline = time.time() + timeout
+        while peer.peer_id is None and peer._alive:
+            if time.time() > deadline:
+                peer.close()
+                raise WireError("handshake timeout")
+            time.sleep(0.005)
+        if not peer._alive:
+            raise WireError("handshake rejected (fork digest mismatch?)")
+        for topic in self.handlers:
+            peer.send_frame(SUBSCRIBE, topic.encode())
+        # one status round-trip as a barrier: the reply is ordered after
+        # the remote's SUBSCRIBE frames on the stream, so when it lands
+        # their subscriptions are processed and publish() won't race
+        self.request_status(peer.peer_id)
+        return peer.peer_id
+
+    def _accept_loop(self):
+        while not self._stopped:
+            try:
+                sock, addr = self._listener.accept()
+            except OSError:
+                return
+            peer = _Peer(self, sock, addr)
+            threading.Thread(
+                target=self._reader_loop, args=(peer,), daemon=True
+            ).start()
+
+    def _register_peer(self, peer, hello_body):
+        n = hello_body[0]
+        peer_id = hello_body[1 : 1 + n].decode()
+        status = decode(StatusMessage, hello_body[1 + n :])
+        ours = self.local_status()
+        if bytes(status.fork_digest) != bytes(ours.fork_digest):
+            # irrelevant network: refuse the handshake
+            peer.send_frame(
+                GOODBYE_FRAME, struct.pack("<Q", GB_IRRELEVANT_NETWORK)
+            )
+            peer.close()
+            return False
+        if peer_id in self.banned_ids:
+            peer.send_frame(GOODBYE_FRAME, struct.pack("<Q", GB_BANNED))
+            peer.close()
+            return False
+        peer.peer_id = peer_id
+        peer.status = status
+        existing = self.peers.get(peer_id)
+        self.peers[peer_id] = peer
+        if existing is not None and existing is not peer:
+            existing.close()
+        return True
+
+    def _reader_loop(self, peer):
+        try:
+            while peer._alive:
+                length = _read_uvarint(peer.sock)
+                if length == 0 or length > MAX_FRAME:
+                    raise WireError(f"bad frame length {length}")
+                frame = _read_exact(peer.sock, length)
+                ftype, body = frame[0], frame[1:]
+                if peer.peer_id is None:
+                    if ftype != HELLO:
+                        raise WireError("first frame must be HELLO")
+                    if not self._register_peer(peer, body):
+                        return
+                    if not peer.sent_hello:
+                        peer.sent_hello = True
+                        peer.send_frame(HELLO, self._hello_body())
+                        for topic in self.handlers:
+                            peer.send_frame(SUBSCRIBE, topic.encode())
+                    continue
+                self._dispatch(peer, ftype, body)
+        except Exception as e:
+            # any malformed frame is peer fault (struct/unicode/snappy/
+            # index errors included) — drop the connection, never the node
+            if peer._alive and not self._stopped:
+                log.debug("peer %s dropped: %s", peer.peer_id, e)
+        finally:
+            peer.close()
+            if self.peers.get(peer.peer_id) is peer:
+                del self.peers[peer.peer_id]
+            # fail anything still waiting on this peer
+            with self._lock:
+                for rec in self._pending.values():
+                    if rec[3] is peer and not rec[0].is_set():
+                        rec[2] = R_SERVER_ERROR
+                        rec[0].set()
+
+    # --------------------------------------------------------- dispatch
+
+    def _dispatch(self, peer, ftype, body):
+        if ftype == SUBSCRIBE:
+            peer.topics.add(body.decode())
+        elif ftype == UNSUBSCRIBE:
+            peer.topics.discard(body.decode())
+        elif ftype == PUBLISH:
+            self._on_publish(peer, body)
+        elif ftype == REQUEST:
+            self._on_request(peer, body)
+        elif ftype == RESPONSE:
+            self._on_response(peer, body)
+        elif ftype == PING:
+            peer.metadata_seq = struct.unpack("<Q", body)[0]
+            peer.send_frame(PONG, struct.pack("<Q", self.metadata_seq))
+        elif ftype == PONG:
+            peer.metadata_seq = struct.unpack("<Q", body)[0]
+        elif ftype == GOODBYE_FRAME:
+            peer.close()
+        else:
+            raise WireError(f"unknown frame type {ftype}")
+
+    # ----------------------------------------------------------- gossip
+
+    def subscribe(self, topic, handler):
+        """handler(from_peer_id, decoded_message) -> False scores the
+        sender down (invalid gossip)."""
+        self.handlers[topic] = handler
+        for peer in list(self.peers.values()):
+            try:
+                peer.send_frame(SUBSCRIBE, topic.encode())
+            except ConnectionError:
+                pass
+
+    def _mark_seen(self, mid):
+        """Record a message id; False when already seen.  Trims the cache
+        to SEEN_CACHE_SIZE."""
+        with self._seen_lock:
+            if mid in self._seen:
+                return False
+            self._seen[mid] = None
+            while len(self._seen) > SEEN_CACHE_SIZE:
+                self._seen.popitem(last=False)
+            return True
+
+    def publish(self, topic, message):
+        payload = self.codec.encode(topic, message)
+        mid = hashlib.sha256(topic.encode() + payload).digest()[:20]
+        self._mark_seen(mid)
+        self._flood(topic, mid, snappy.compress(payload), exclude=None)
+
+    def _flood(self, topic, mid, compressed, exclude):
+        t = topic.encode()
+        body = (
+            bytes([len(t)]) + t + mid + compressed
+        )
+        for peer in list(self.peers.values()):
+            if peer is exclude:
+                continue
+            # deliver only to peers subscribed to the topic's prefix
+            # (subnet topics announce their prefix subscription)
+            if not any(topic.startswith(s) for s in peer.topics):
+                continue
+            try:
+                peer.send_frame(PUBLISH, body)
+            except ConnectionError:
+                pass
+
+    def _on_publish(self, peer, body):
+        tlen = body[0]
+        topic = body[1 : 1 + tlen].decode()
+        mid = body[1 + tlen : 21 + tlen]
+        compressed = body[21 + tlen :]
+        with self._seen_lock:
+            if mid in self._seen:
+                return
+        try:
+            payload = snappy.decompress(compressed)
+            expect = hashlib.sha256(topic.encode() + payload).digest()[:20]
+            if expect != mid:
+                raise WireError("message id mismatch")
+            message = self.codec.decode(topic, payload)
+        except Exception:
+            # do NOT mark seen: a peer flooding garbage under a real
+            # message's id must not censor the honest copy
+            self._score(peer, -10.0)
+            return
+        if not self._mark_seen(mid):
+            return   # a concurrent reader won the race
+        # longest prefix wins: "sync_committee_contribution_and_proof"
+        # must not fall through to the "sync_committee" subnet handler
+        handler = None
+        for sub in sorted(self.handlers, key=len, reverse=True):
+            if topic.startswith(sub):
+                handler = self.handlers[sub]
+                break
+        if handler is not None:
+            ok = handler(peer.peer_id, message)
+            if ok is False:
+                self._score(peer, -10.0)
+                return        # invalid gossip is NOT re-flooded
+        # flood onward (at-most-once per node via the seen cache)
+        self._flood(topic, mid, compressed, exclude=peer)
+
+    def _score(self, peer, delta):
+        peer.score.apply(delta)
+        if peer.score.banned:
+            self.banned_ids.add(peer.peer_id)
+            try:
+                peer.send_frame(GOODBYE_FRAME, struct.pack("<Q", GB_BANNED))
+            except ConnectionError:
+                pass
+            peer.close()
+
+    # --------------------------------------------------------- req/resp
+
+    def _request(self, peer_id, method, req_body, timeout=30.0):
+        peer = self.peers.get(peer_id)
+        if peer is None:
+            raise WireError(f"not connected to {peer_id}")
+        with self._lock:
+            self._req_id += 1
+            rid = self._req_id
+            rec = [threading.Event(), None, None, peer]
+            self._pending[rid] = rec
+        try:
+            peer.send_frame(
+                REQUEST,
+                struct.pack("<IB", rid, method) + snappy.compress(req_body),
+            )
+            if not rec[0].wait(timeout):
+                raise WireError(f"request {method} timed out")
+            if rec[2] != R_SUCCESS:
+                raise WireError(f"request {method} failed: code {rec[2]}")
+            return rec[1]
+        finally:
+            with self._lock:
+                self._pending.pop(rid, None)
+
+    def _on_request(self, peer, body):
+        rid, method = struct.unpack("<IB", body[:5])
+        if method == M_GOODBYE:
+            # goodbye expects no response (rpc/methods.rs); just hang up
+            peer.close()
+            return
+        try:
+            req = snappy.decompress(body[5:])
+            chunks = self._serve(peer, method, req)
+            code = R_SUCCESS
+        except WireError:
+            chunks, code = [], R_INVALID_REQUEST
+        except Exception:
+            chunks, code = [], R_SERVER_ERROR
+        out = bytearray(struct.pack("<IBI", rid, code, len(chunks)))
+        for c in chunks:
+            cc = snappy.compress(c)
+            out += _uvarint(len(cc)) + cc
+        peer.send_frame(RESPONSE, bytes(out))
+
+    def _on_response(self, peer, body):
+        rid, code, n = struct.unpack("<IBI", body[:9])
+        pos = 9
+        chunks = []
+        for _ in range(n):
+            # chunk lengths are uvarints inside the frame body
+            clen, pos = snappy.uvarint_decode(body, pos)
+            chunks.append(snappy.decompress(body[pos : pos + clen]))
+            pos += clen
+        with self._lock:
+            rec = self._pending.get(rid)
+        if rec is not None:
+            rec[1], rec[2] = chunks, code
+            rec[0].set()
+
+    def _serve(self, peer, method, req):
+        """Server side of the rpc protocols (router.rs on_rpc_request)."""
+        if method == M_STATUS:
+            return [encode(StatusMessage, self.local_status())]
+        if method == M_PING or method == M_METADATA:
+            return [
+                encode(
+                    MetaData,
+                    MetaData(seq_number=self.metadata_seq,
+                             attnets=self.attnets),
+                )
+            ]
+        if self.chain is None:
+            raise WireError("no chain attached")
+        if method == M_BLOCKS_BY_ROOT:
+            if len(req) % 32:
+                raise WireError("bad roots length")
+            roots = [req[i : i + 32] for i in range(0, len(req), 32)]
+            out = []
+            for r in roots:
+                b = self.chain.store.get_block(r)
+                if b is not None:
+                    out.append(self.codec._block_codec.enc_block(b))
+            return out
+        if method == M_BLOCKS_BY_RANGE:
+            r = decode(BlocksByRangeRequest, req)
+            start, count = int(r.start_slot), int(r.count)
+            if count > 1024:
+                raise WireError("count too large")
+            blocks = {}
+            root = self.chain.head_root
+            while root is not None:
+                b = self.chain.store.get_block(bytes(root))
+                if b is None:
+                    break
+                slot = int(b.message.slot)
+                if slot < start:
+                    break
+                if slot < start + count:
+                    blocks[slot] = b
+                root = bytes(b.message.parent_root)
+            return [
+                self.codec._block_codec.enc_block(blocks[s])
+                for s in sorted(blocks)
+            ]
+        raise WireError(f"unknown method {method}")
+
+    # ------------------------------------------------- rpc client calls
+
+    def request_status(self, peer_id):
+        chunks = self._request(peer_id, M_STATUS, b"")
+        return decode(StatusMessage, chunks[0])
+
+    def request_metadata(self, peer_id):
+        chunks = self._request(peer_id, M_METADATA, b"")
+        return decode(MetaData, chunks[0])
+
+    def request_blocks_by_root(self, peer_id, roots):
+        chunks = self._request(
+            peer_id, M_BLOCKS_BY_ROOT, b"".join(bytes(r) for r in roots)
+        )
+        return [self.codec._block_codec.dec_block(c) for c in chunks]
+
+    def request_blocks_by_range(self, peer_id, start_slot, count, step=1):
+        req = encode(
+            BlocksByRangeRequest,
+            BlocksByRangeRequest(start_slot=start_slot, count=count,
+                                 step=step),
+        )
+        chunks = self._request(peer_id, M_BLOCKS_BY_RANGE, req)
+        return [self.codec._block_codec.dec_block(c) for c in chunks]
+
+    def goodbye(self, peer_id, reason=GB_CLIENT_SHUTDOWN):
+        peer = self.peers.get(peer_id)
+        if peer is not None:
+            try:
+                peer.send_frame(GOODBYE_FRAME, struct.pack("<Q", reason))
+            except ConnectionError:
+                pass
+            peer.close()
+
+    def stop(self):
+        self._stopped = True
+        for peer in list(self.peers.values()):
+            try:
+                peer.send_frame(
+                    GOODBYE_FRAME, struct.pack("<Q", GB_CLIENT_SHUTDOWN)
+                )
+            except ConnectionError:
+                pass
+            peer.close()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    # ------------------------------------- in-process seam compatibility
+
+    def bus_view(self):
+        """A `GossipBus`-shaped facade so Router/simulator code runs
+        unchanged over the wire."""
+        return _BusView(self)
+
+    def reqresp_view(self):
+        return _ReqRespView(self)
+
+
+class _BusView:
+    def __init__(self, node):
+        self.node = node
+
+    def add_peer(self, peer_id):
+        pass
+
+    def subscribe(self, peer_id, topic, handler):
+        self.node.subscribe(topic, handler)
+
+    def publish(self, from_peer, topic, message):
+        self.node.publish(topic, message)
+
+    def report(self, peer_id, delta):
+        peer = self.node.peers.get(peer_id)
+        if peer is not None:
+            self.node._score(peer, delta)
+
+    def banned(self, peer_id):
+        return peer_id in self.node.banned_ids
+
+
+class _ReqRespView:
+    def __init__(self, node):
+        self.node = node
+
+    def register(self, peer_id, chain):
+        self.node.chain = chain
+        if self.node.codec is None:
+            self.node.codec = GossipCodec(chain.preset)
+
+    def blocks_by_root(self, from_peer, to_peer, roots):
+        return self.node.request_blocks_by_root(to_peer, roots)
+
+    def blocks_by_range(self, from_peer, to_peer, start_slot, count):
+        return self.node.request_blocks_by_range(to_peer, start_slot, count)
