@@ -442,6 +442,10 @@ class _Handler(BaseHTTPRequestHandler):
                 root = chain.process_block(signed)
             except BlockError as e:
                 return self._err(400, f"block rejected: {e}")
+            router = getattr(self.server, "router", None)
+            if router is not None:
+                # publish_blocks.rs: an imported API block is gossiped on
+                router.publish_block(signed)
             return self._json({"data": {"root": _hex(root)}})
 
         if path == "/eth/v1/beacon/pool/attestations":
@@ -551,8 +555,18 @@ class BeaconApiServer:
         self.server = ThreadingHTTPServer((host, port), _Handler)
         self.server.chain = chain
         self.server.bn = DirectBeaconNode(chain)
+        self.server.router = None
         self.port = self.server.server_address[1]
         self._thread = None
+
+    @property
+    def router(self):
+        return self.server.router
+
+    @router.setter
+    def router(self, router):
+        # node wiring: API block publishes gossip onward over the wire
+        self.server.router = router
 
     def start(self):
         self._thread = threading.Thread(

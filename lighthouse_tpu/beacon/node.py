@@ -20,14 +20,19 @@ log = logging.getLogger("lighthouse_tpu.node")
 
 
 class BeaconNode:
-    """An assembled node: chain + processor + http api + slot timer."""
+    """An assembled node: chain + processor + http api + wire network +
+    slot timer."""
 
-    def __init__(self, chain, processor, api_server, clock, executor):
+    def __init__(self, chain, processor, api_server, clock, executor,
+                 wire=None, router=None, dial=()):
         self.chain = chain
         self.processor = processor
         self.api_server = api_server
         self.clock = clock
         self.executor = executor
+        self.wire = wire
+        self.router = router
+        self._dial = list(dial)
 
     def start(self):
         if self.api_server is not None:
@@ -35,10 +40,14 @@ class BeaconNode:
         self.executor.spawn(self._timer_loop, "slot_timer")
         self.executor.spawn(self.processor.run, "beacon_processor")
         self.executor.spawn(self._notifier_loop, "notifier", critical=False)
+        if self.wire is not None and self._dial:
+            self.executor.spawn(self._dial_loop, "dialer", critical=False)
         return self
 
     def stop(self):
         self.executor.shutdown("node stop")
+        if self.wire is not None:
+            self.wire.stop()
         if self.api_server is not None:
             self.api_server.stop()
 
@@ -55,6 +64,34 @@ class BeaconNode:
                 last = slot
             wait = min(self.clock.duration_to_next_slot(), 0.25)
             if executor.sleep_or_shutdown(max(wait, 0.05)):
+                break
+
+    def _dial_loop(self, executor):
+        """Connect the static peers (the reference's --boot-nodes /
+        trusted peers), then range-sync from whoever is ahead — the
+        startup half of sync/manager.rs."""
+        pending = list(self._dial)
+        attempts = 0
+        while pending and attempts < 30 and not executor.shutting_down:
+            attempts += 1
+            still = []
+            for host, port in pending:
+                try:
+                    pid = self.wire.dial(host, port)
+                except Exception as e:
+                    log.debug("dial %s:%s failed (%s)", host, port, e)
+                    still.append((host, port))
+                    continue
+                log.info("connected to %s (%s:%s)", pid, host, port)
+                try:
+                    status = self.wire.request_status(pid)
+                    if int(status.head_slot) > int(self.chain.head_state.slot):
+                        n = self.router.range_sync_from(pid)
+                        log.info("range-synced %d blocks from %s", n, pid)
+                except Exception as e:
+                    log.warning("initial sync from %s failed: %s", pid, e)
+            pending = still
+            if pending and executor.sleep_or_shutdown(1.0):
                 break
 
     def _notifier_loop(self, executor):
@@ -81,6 +118,8 @@ class ClientBuilder:
         self._backend = "tpu"
         self._http_port = None
         self._clock = None
+        self._net_port = None
+        self._dial = []
 
     def genesis_state(self, state):
         self._genesis_state = state
@@ -114,6 +153,13 @@ class ClientBuilder:
         self._clock = clock
         return self
 
+    def network(self, port=0, dial=()):
+        """Enable the TCP wire (lighthouse_network's role): listen on
+        `port` and connect the static `dial` peers at startup."""
+        self._net_port = port
+        self._dial = list(dial)
+        return self
+
     def build(self) -> BeaconNode:
         assert self._genesis_state is not None, "a genesis/checkpoint state is required"
         chain = BeaconChain(
@@ -131,4 +177,20 @@ class ClientBuilder:
         clock = self._clock or SystemSlotClock(
             int(self._genesis_state.genesis_time), self.spec.seconds_per_slot
         )
-        return BeaconNode(chain, processor, api_server, clock, TaskExecutor())
+        wire = router = None
+        if self._net_port is not None:
+            from ..network.router import Router
+            from ..network.wire import WireNode
+
+            wire = WireNode(chain, port=self._net_port)
+            router = Router(
+                wire.peer_id, chain, processor,
+                wire.bus_view(), wire.reqresp_view(),
+            )
+            if api_server is not None:
+                # API block publishes gossip onward (publish_blocks.rs)
+                api_server.router = router
+        return BeaconNode(
+            chain, processor, api_server, clock, TaskExecutor(),
+            wire=wire, router=router, dial=self._dial,
+        )

@@ -53,6 +53,10 @@ def build_parser_with_subs():
     bn.add_argument("--interop-validators", type=int, default=0,
                     help="deterministic interop genesis with N validators")
     bn.add_argument("--memory-store", action="store_true")
+    bn.add_argument("--listen-port", type=int, default=None,
+                    help="TCP wire port (0 = ephemeral); omit to disable networking")
+    bn.add_argument("--dial", action="append", default=[],
+                    metavar="HOST:PORT", help="static peer to connect (repeatable)")
 
     vc = sub.add_parser("vc", help="validator client")
     _add_common(vc)
@@ -220,13 +224,21 @@ def _run_bn(args):
         print("no genesis source: use --interop-validators N", file=sys.stderr)
         return 1
     builder.genesis_state(state).http_api(args.http_port)
+    if args.listen_port is not None or args.dial:
+        # --dial alone still means "network on" (ephemeral listen port)
+        dial = []
+        for hp in args.dial:
+            host, _, port = hp.rpartition(":")
+            dial.append((host or "127.0.0.1", int(port)))
+        builder.network(port=args.listen_port or 0, dial=dial)
     if args.memory_store:
         builder.memory_store()
     else:
         os.makedirs(args.datadir, exist_ok=True)
         builder.disk_store(os.path.join(args.datadir, "chain.db"))
     node = builder.build().start()
-    print(f"beacon node up — http API on :{node.api_server.port}")
+    wire_note = f", wire on :{node.wire.port}" if node.wire else ""
+    print(f"beacon node up — http API on :{node.api_server.port}{wire_note}")
     reason = node.executor.block_until_shutdown()
     print(f"shutting down: {reason}")
     return 1 if (reason and reason.failure) else 0

@@ -178,6 +178,11 @@ class _Peer:
     def __init__(self, node, sock, addr):
         self.node = node
         self.sock = sock
+        # bounded sends only (recv stays blocking for the reader thread)
+        sock.setsockopt(
+            socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+            struct.pack("ll", int(self.SEND_TIMEOUT), 0),
+        )
         self.addr = addr
         self.peer_id = None          # learned from HELLO
         self.sent_hello = False      # did WE already send our HELLO?
@@ -188,12 +193,17 @@ class _Peer:
         self._wlock = threading.Lock()
         self._alive = True
 
+    SEND_TIMEOUT = 20.0
+
     def send_frame(self, ftype, body):
         frame = bytes([ftype]) + body
         try:
             with self._wlock:
                 self.sock.sendall(_uvarint(len(frame)) + frame)
         except OSError as e:
+            # includes the SO_SNDTIMEO expiry: a peer that stopped reading
+            # must be DROPPED, not allowed to wedge the sending thread
+            self.close()
             raise ConnectionError(str(e)) from e
 
     def close(self):
@@ -419,7 +429,8 @@ class WireNode:
     def publish(self, topic, message):
         payload = self.codec.encode(topic, message)
         mid = hashlib.sha256(topic.encode() + payload).digest()[:20]
-        self._mark_seen(mid)
+        if not self._mark_seen(mid):
+            return   # already flooded (e.g. re-publish of gossiped block)
         self._flood(topic, mid, snappy.compress(payload), exclude=None)
 
     def _flood(self, topic, mid, compressed, exclude):
@@ -524,11 +535,21 @@ class WireNode:
             chunks, code = [], R_INVALID_REQUEST
         except Exception:
             chunks, code = [], R_SERVER_ERROR
-        out = bytearray(struct.pack("<IBI", rid, code, len(chunks)))
+        # cap the response under MAX_FRAME: a partial range/root response
+        # is legal (the sync cursor advances and re-requests the rest) —
+        # an oversized frame would just get the connection dropped
+        budget = MAX_FRAME // 2
+        body = bytearray()
+        sent = 0
         for c in chunks:
             cc = snappy.compress(c)
-            out += _uvarint(len(cc)) + cc
-        peer.send_frame(RESPONSE, bytes(out))
+            piece = _uvarint(len(cc)) + cc
+            if sent and len(body) + len(piece) > budget:
+                break
+            body += piece
+            sent += 1
+        out = struct.pack("<IBI", rid, code, sent) + bytes(body)
+        peer.send_frame(RESPONSE, out)
 
     def _on_response(self, peer, body):
         rid, code, n = struct.unpack("<IBI", body[:9])
@@ -541,7 +562,9 @@ class WireNode:
             pos += clen
         with self._lock:
             rec = self._pending.get(rid)
-        if rec is not None:
+        # only the peer the request went to may answer it — another peer
+        # guessing the (sequential) rid must not complete or poison it
+        if rec is not None and rec[3] is peer:
             rec[1], rec[2] = chunks, code
             rec[0].set()
 

@@ -46,6 +46,71 @@ def test_client_builder_node_lifecycle():
     assert reason is not None and not reason.failure
 
 
+def test_networked_nodes_sync_and_gossip():
+    """Two built nodes over the TCP wire: B dials A, range-syncs A's
+    existing chain, then follows new blocks published to A's HTTP API via
+    gossip — the two-process `lighthouse bn --dial` topology in-process."""
+    from lighthouse_tpu.beacon.store import _Codec
+    from lighthouse_tpu.testing.harness import Harness
+
+    h = Harness(8, SPEC)
+
+    def build_node(dial=()):
+        return (
+            ClientBuilder(SPEC)
+            .genesis_state(h.state.copy() if not dial else
+                           interop_genesis_state(interop_keypairs(8), 0, SPEC))
+            .crypto_backend("fake")
+            .memory_store()
+            .http_api(port=0)
+            .network(port=0, dial=dial)
+            .slot_clock(ManualSlotClock(seconds_per_slot=SPEC.seconds_per_slot))
+            .build()
+            .start()
+        )
+
+    def wait(cond, timeout=10.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if cond():
+                return True
+            time.sleep(0.05)
+        return False
+
+    node_a = build_node()
+    codec = _Codec(SPEC.preset)
+    client_a = BeaconApiClient(f"http://127.0.0.1:{node_a.api_server.port}")
+    node_b = None
+    try:
+        # A imports block 1 via its HTTP API (pre-existing history)
+        blk1 = h.produce_block(1)
+        h.process_block(blk1, strategy="no_verification")
+        node_a.clock.advance_slot()
+        assert wait(lambda: node_a.chain.current_slot >= 1)
+        client_a.publish_block_ssz("0x" + codec.enc_block(blk1).hex())
+        assert int(node_a.chain.head_state.slot) == 1
+
+        # B boots, dials A, range-syncs the existing block
+        node_b = build_node(dial=[("127.0.0.1", node_a.wire.port)])
+        node_b.clock.advance_slot()
+        assert wait(lambda: node_b.chain.head_root == node_a.chain.head_root)
+
+        # a NEW block published to A's API reaches B via gossip
+        blk2 = h.produce_block(2)
+        h.process_block(blk2, strategy="no_verification")
+        node_a.clock.advance_slot()
+        node_b.clock.advance_slot()
+        assert wait(lambda: node_a.chain.current_slot >= 2
+                    and node_b.chain.current_slot >= 2)
+        client_a.publish_block_ssz("0x" + codec.enc_block(blk2).hex())
+        assert wait(lambda: int(node_b.chain.head_state.slot) == 2)
+        assert node_b.chain.head_root == node_a.chain.head_root
+    finally:
+        node_a.stop()
+        if node_b is not None:
+            node_b.stop()
+
+
 def test_cli_dump_config(capsys):
     rc = main(["bn", "--network", "minimal", "--interop-validators", "4",
                "--dump-config"])
