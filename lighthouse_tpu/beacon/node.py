@@ -84,7 +84,11 @@ class BeaconNode:
                     continue
                 log.info("connected to %s (%s:%s)", pid, host, port)
                 try:
-                    status = self.wire.request_status(pid)
+                    # the handshake already stored the remote's status
+                    peer = self.wire.peers.get(pid)
+                    status = (peer.status if peer is not None and
+                              peer.status is not None
+                              else self.wire.request_status(pid))
                     if int(status.head_slot) > int(self.chain.head_state.slot):
                         n = self.router.range_sync_from(pid)
                         log.info("range-synced %d blocks from %s", n, pid)

@@ -228,7 +228,10 @@ def _run_bn(args):
         # --dial alone still means "network on" (ephemeral listen port)
         dial = []
         for hp in args.dial:
-            host, _, port = hp.rpartition(":")
+            host, sep, port = hp.rpartition(":")
+            if not sep or not port.isdigit():
+                print(f"--dial expects HOST:PORT, got {hp!r}", file=sys.stderr)
+                return 1
             dial.append((host or "127.0.0.1", int(port)))
         builder.network(port=args.listen_port or 0, dial=dial)
     if args.memory_store:

@@ -63,6 +63,7 @@ R_SUCCESS = 0
 R_INVALID_REQUEST = 1
 R_SERVER_ERROR = 2
 R_RESOURCE_UNAVAILABLE = 3
+R_PARTIAL = 4   # truncated under the frame cap; re-request the rest
 
 # goodbye reasons (rpc/methods.rs GoodbyeReason)
 GB_CLIENT_SHUTDOWN = 1
@@ -514,9 +515,9 @@ class WireNode:
             )
             if not rec[0].wait(timeout):
                 raise WireError(f"request {method} timed out")
-            if rec[2] != R_SUCCESS:
+            if rec[2] not in (R_SUCCESS, R_PARTIAL):
                 raise WireError(f"request {method} failed: code {rec[2]}")
-            return rec[1]
+            return rec[1], rec[2]
         finally:
             with self._lock:
                 self._pending.pop(rid, None)
@@ -535,8 +536,8 @@ class WireNode:
             chunks, code = [], R_INVALID_REQUEST
         except Exception:
             chunks, code = [], R_SERVER_ERROR
-        # cap the response under MAX_FRAME: a partial range/root response
-        # is legal (the sync cursor advances and re-requests the rest) —
+        # cap the response under MAX_FRAME: a truncated response is
+        # flagged R_PARTIAL so the client re-requests the remainder —
         # an oversized frame would just get the connection dropped
         budget = MAX_FRAME // 2
         body = bytearray()
@@ -548,6 +549,8 @@ class WireNode:
                 break
             body += piece
             sent += 1
+        if code == R_SUCCESS and sent < len(chunks):
+            code = R_PARTIAL
         out = struct.pack("<IBI", rid, code, sent) + bytes(body)
         peer.send_frame(RESPONSE, out)
 
@@ -618,27 +621,51 @@ class WireNode:
     # ------------------------------------------------- rpc client calls
 
     def request_status(self, peer_id):
-        chunks = self._request(peer_id, M_STATUS, b"")
+        chunks, _ = self._request(peer_id, M_STATUS, b"")
         return decode(StatusMessage, chunks[0])
 
     def request_metadata(self, peer_id):
-        chunks = self._request(peer_id, M_METADATA, b"")
+        chunks, _ = self._request(peer_id, M_METADATA, b"")
         return decode(MetaData, chunks[0])
 
     def request_blocks_by_root(self, peer_id, roots):
-        chunks = self._request(
-            peer_id, M_BLOCKS_BY_ROOT, b"".join(bytes(r) for r in roots)
-        )
-        return [self.codec._block_codec.dec_block(c) for c in chunks]
+        from ..ssz import hash_tree_root
+
+        remaining = [bytes(r) for r in roots]
+        out = []
+        while remaining:
+            chunks, code = self._request(
+                peer_id, M_BLOCKS_BY_ROOT, b"".join(remaining)
+            )
+            blocks = [self.codec._block_codec.dec_block(c) for c in chunks]
+            out.extend(blocks)
+            if code != R_PARTIAL:
+                break
+            if not blocks:
+                raise WireError("partial by-root response with no blocks")
+            got = {hash_tree_root(b.message) for b in blocks}
+            remaining = [r for r in remaining if r not in got]
+        return out
 
     def request_blocks_by_range(self, peer_id, start_slot, count, step=1):
-        req = encode(
-            BlocksByRangeRequest,
-            BlocksByRangeRequest(start_slot=start_slot, count=count,
-                                 step=step),
-        )
-        chunks = self._request(peer_id, M_BLOCKS_BY_RANGE, req)
-        return [self.codec._block_codec.dec_block(c) for c in chunks]
+        end = int(start_slot) + int(count)
+        cursor = int(start_slot)
+        out = []
+        while cursor < end:
+            req = encode(
+                BlocksByRangeRequest,
+                BlocksByRangeRequest(start_slot=cursor, count=end - cursor,
+                                     step=step),
+            )
+            chunks, code = self._request(peer_id, M_BLOCKS_BY_RANGE, req)
+            blocks = [self.codec._block_codec.dec_block(c) for c in chunks]
+            out.extend(blocks)
+            if code != R_PARTIAL:
+                break
+            if not blocks:
+                raise WireError("partial by-range response with no blocks")
+            cursor = int(blocks[-1].message.slot) + 1
+        return out
 
     def goodbye(self, peer_id, reason=GB_CLIENT_SHUTDOWN):
         peer = self.peers.get(peer_id)

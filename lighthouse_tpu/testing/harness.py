@@ -56,7 +56,9 @@ class Harness:
 
     # ------------------------------------------------------- block producer
 
-    def produce_block(self, slot, attestations=(), deposits=()):
+    def produce_block(self, slot, attestations=(), deposits=(),
+                      proposer_slashings=(), attester_slashings=(),
+                      voluntary_exits=()):
         """Build a valid signed block at `slot` on the current state
         (phase0 or altair body depending on the state's fork)."""
         spec, preset = self.spec, self.preset
@@ -81,6 +83,9 @@ class Harness:
             eth1_data=state.eth1_data,
             attestations=list(attestations),
             deposits=list(deposits),
+            proposer_slashings=list(proposer_slashings),
+            attester_slashings=list(attester_slashings),
+            voluntary_exits=list(voluntary_exits),
         )
         if altair:
             body_kwargs["sync_aggregate"] = self._sync_aggregate(state, slot)
@@ -123,6 +128,93 @@ class Harness:
         )
         sig = self._sign_root(proposer, compute_signing_root(block, pd))
         return signed_cls(message=block, signature=sig)
+
+    # ---------------------------------------------------- operation makers
+
+    def make_proposer_slashing(self, validator_index, slot=None):
+        """Two conflicting signed headers by the same proposer at one slot
+        (test_utils.rs make_proposer_slashing)."""
+        from ..types.containers import (
+            BeaconBlockHeader,
+            ProposerSlashing,
+            SignedBeaconBlockHeader,
+        )
+
+        state = self.state
+        slot = int(state.slot) if slot is None else int(slot)
+        epoch = compute_epoch_at_slot(slot, self.preset)
+        domain = self.spec.get_domain(
+            Domain.BEACON_PROPOSER, epoch, state.fork,
+            state.genesis_validators_root,
+        )
+
+        def header(body_root):
+            h = BeaconBlockHeader(
+                slot=slot,
+                proposer_index=validator_index,
+                parent_root=b"\x11" * 32,
+                state_root=b"\x22" * 32,
+                body_root=body_root,
+            )
+            sig = self._sign_root(
+                validator_index, compute_signing_root(h, domain)
+            )
+            return SignedBeaconBlockHeader(message=h, signature=sig)
+
+        return ProposerSlashing(
+            signed_header_1=header(b"\x33" * 32),
+            signed_header_2=header(b"\x44" * 32),
+        )
+
+    def make_attester_slashing(self, validator_indices, target_epoch=0):
+        """A double vote: two IndexedAttestations with the same target but
+        different head roots, signed by `validator_indices`."""
+        from ..types.containers import AttesterSlashing, IndexedAttestation
+
+        state = self.state
+        domain = self.spec.get_domain(
+            Domain.BEACON_ATTESTER, target_epoch, state.fork,
+            state.genesis_validators_root,
+        )
+        indices = sorted(int(i) for i in validator_indices)
+
+        def indexed(head_root):
+            data = AttestationData(
+                slot=target_epoch * self.preset.slots_per_epoch,
+                index=0,
+                beacon_block_root=head_root,
+                source=Checkpoint(epoch=0, root=bytes(32)),
+                target=Checkpoint(epoch=target_epoch, root=b"\x55" * 32),
+            )
+            root = compute_signing_root(data, domain)
+            sigs = [RB.sign(self._sk(i), root) for i in indices]
+            return IndexedAttestation(
+                attesting_indices=indices,
+                data=data,
+                signature=g2_compress(RB.aggregate(sigs)),
+            )
+
+        return AttesterSlashing(
+            attestation_1=indexed(b"\x66" * 32),
+            attestation_2=indexed(b"\x77" * 32),
+        )
+
+    def make_voluntary_exit(self, validator_index, epoch=None):
+        from ..types.containers import SignedVoluntaryExit, VoluntaryExit
+
+        state = self.state
+        epoch = (
+            get_current_epoch(state, self.preset) if epoch is None else epoch
+        )
+        exit_msg = VoluntaryExit(epoch=epoch, validator_index=validator_index)
+        domain = self.spec.get_domain(
+            Domain.VOLUNTARY_EXIT, epoch, state.fork,
+            state.genesis_validators_root,
+        )
+        sig = self._sign_root(
+            validator_index, compute_signing_root(exit_msg, domain)
+        )
+        return SignedVoluntaryExit(message=exit_msg, signature=sig)
 
     def _execution_payload(self, state, randao_reveal, capella):
         from ..state_processing import bellatrix as bx

@@ -23,6 +23,37 @@ from lighthouse_tpu.types import ChainSpec, MinimalPreset  # noqa: E402
 
 OUT = os.path.join(os.path.dirname(__file__), "vectors", "state_transition.json")
 
+def _ops_schedule(h):
+    """Slashings + an exit land mid-chain; the epoch boundary then applies
+    slashing penalties and the exit queue (per_epoch registry updates)."""
+    return {
+        2: {"proposer_slashings": [h.make_proposer_slashing(7, slot=1)]},
+        3: {"attester_slashings": [h.make_attester_slashing([6])]},
+        4: {"voluntary_exits": [h.make_voluntary_exit(5)]},
+    }
+
+
+def _deposit_schedule(h):
+    """A 9th validator onboards via a real deposit-tree proof (the
+    eth1 cache's deposits_for_range path)."""
+    from lighthouse_tpu.eth1 import Eth1Cache, MockEth1Chain
+    from lighthouse_tpu.eth1.service import make_deposit_data
+
+    spec = h.spec
+    eth1 = MockEth1Chain()
+    for i in range(8):
+        eth1.submit_deposit(
+            make_deposit_data(h.keypairs[i][0], 32 * 10**9, spec)
+        )
+    eth1.submit_deposit(make_deposit_data(999331, 32 * 10**9, spec))
+    eth1.mine_blocks(1)
+    cache = Eth1Cache(eth1, follow_distance=0)
+    h.state.eth1_data = h.T.Eth1Data(
+        **cache.eth1_data_for_block(cache.head_block())
+    )
+    return {1: {"deposits": cache.deposits_for_range(8, 9, h.T)}}
+
+
 SCENARIOS = {
     # 12 slots of fully-attested phase0 chain
     "phase0_attested": dict(spec=ChainSpec(preset=MinimalPreset), slots=12),
@@ -40,16 +71,59 @@ SCENARIOS = {
         ),
         slots=6,
     ),
+    # in-chain operations: both slashing flavors + a voluntary exit
+    # (shard_committee_period=0 makes genesis validators exit-eligible)
+    "phase0_slashings_and_exit": dict(
+        spec=ChainSpec(preset=MinimalPreset, shard_committee_period=0),
+        slots=10,
+        ops=_ops_schedule,
+    ),
+    # a deposit with a real merkle proof onboards validator #8
+    "phase0_deposit_onboarding": dict(
+        spec=ChainSpec(preset=MinimalPreset),
+        slots=4,
+        ops=_deposit_schedule,
+    ),
 }
 
 
-def run_scenario(spec, slots):
+def run_scenario(spec, slots, ops=None):
+    from lighthouse_tpu.state_processing.phase0 import (
+        get_beacon_proposer_index,
+        process_slots,
+    )
+
     h = Harness(8, spec)
+    schedule = ops(h) if ops is not None else {}
     roots = [hash_tree_root(h.state).hex()]
     pending = []
+    slashed_present = False   # the proposer peek only matters after one
     for _ in range(slots):
-        slot = h.state.slot + 1
-        block = h.produce_block(slot, attestations=pending)
+        slot = int(h.state.slot) + 1
+        if slashed_present:
+            st = h.state.copy()
+            st = process_slots(st, slot, spec.preset, spec=spec)
+            if st.validators[
+                get_beacon_proposer_index(st, spec.preset)
+            ].slashed:
+                # a slashed proposer cannot propose: the slot stays empty
+                # (exactly what a live network does after a slashing)
+                assert slot not in schedule, (
+                    f"ops scheduled for skipped slot {slot} would be "
+                    "silently dropped — move them in the scenario"
+                )
+                h.state = st
+                pending = []
+                roots.append(hash_tree_root(h.state).hex())
+                continue
+        ops_here = schedule.get(slot, {})
+        if ops_here.get("proposer_slashings") or ops_here.get(
+            "attester_slashings"
+        ):
+            slashed_present = True
+        block = h.produce_block(
+            slot, attestations=pending, **ops_here
+        )
         h.process_block(block, strategy="no_verification")
         pending = h.attest_slot(h.state, slot, hash_tree_root(block.message))
         roots.append(hash_tree_root(h.state).hex())
@@ -68,7 +142,7 @@ def main():
     out = {}
     for name, cfg in SCENARIOS.items():
         print("generating", name)
-        out[name] = run_scenario(cfg["spec"], cfg["slots"])
+        out[name] = run_scenario(cfg["spec"], cfg["slots"], cfg.get("ops"))
     with open(OUT, "w") as f:
         json.dump(out, f, indent=1)
     print("wrote", OUT)

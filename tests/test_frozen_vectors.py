@@ -21,7 +21,7 @@ def frozen():
 @pytest.mark.parametrize("name", sorted(SCENARIOS))
 def test_frozen_state_roots(frozen, name):
     cfg = SCENARIOS[name]
-    got = run_scenario(cfg["spec"], cfg["slots"])
+    got = run_scenario(cfg["spec"], cfg["slots"], cfg.get("ops"))
     want = frozen[name]
     assert got["state_roots"] == want["state_roots"], (
         f"{name}: state roots diverged from the frozen vectors — if this "

@@ -137,6 +137,36 @@ def test_blocks_by_range_and_root_over_wire():
 # ------------------------------------------------------------- gossip
 
 
+def test_partial_responses_reassembled(monkeypatch):
+    """Oversized responses are truncated server-side under the frame cap
+    and flagged R_PARTIAL; the client re-requests the remainder so both
+    range sync and backfill see complete batches."""
+    from lighthouse_tpu.network import wire as wire_mod
+
+    _, c1 = _make_chain(3)
+    _, c2 = _make_chain(0)
+    n1, n2 = WireNode(c1), WireNode(c2)
+    try:
+        n2.dial("127.0.0.1", n1.port)
+        # shrink the frame budget so every response carries ~one block
+        monkeypatch.setattr(wire_mod, "MAX_FRAME", 2048)
+        blocks = n2.request_blocks_by_range(n1.peer_id, 1, 10)
+        assert [int(b.message.slot) for b in blocks] == [1, 2, 3]
+        roots = []
+        root = c1.head_root
+        while root is not None:
+            b = c1.store.get_block(root)
+            if b is None:
+                break
+            roots.append(root)
+            root = bytes(b.message.parent_root)
+        by_root = n2.request_blocks_by_root(n1.peer_id, roots)
+        assert len(by_root) == 3
+    finally:
+        n1.stop()
+        n2.stop()
+
+
 def test_gossip_flood_multi_hop_with_dedup():
     """A -> B -> C line topology: C receives A's block via B's re-flood;
     nobody sees it twice."""
