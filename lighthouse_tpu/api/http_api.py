@@ -337,6 +337,87 @@ class _Handler(BaseHTTPRequestHandler):
                 }
             )
 
+        m = re.fullmatch(r"/eth/v1/beacon/light_client/bootstrap/(0x[0-9a-f]+)", path)
+        if m:
+            from ..light_client import (
+                LightClientError,
+                bootstrap_from_state,
+                light_client_types,
+            )
+            from ..ssz import encode as _enc
+
+            root = bytes.fromhex(m.group(1)[2:])
+            state = chain.store.get_state(root)
+            if state is None:
+                return self._err(404, "unknown block root")
+            try:
+                boot = bootstrap_from_state(state, chain.preset)
+            except LightClientError as e:
+                return self._err(400, str(e))
+            LT = light_client_types(chain.preset)
+            return self._json(
+                {"data": {"ssz": "0x" + _enc(LT.LightClientBootstrap, boot).hex()}}
+            )
+
+        if path == "/eth/v1/beacon/light_client/updates":
+            from ..light_client import light_client_types
+            from ..ssz import encode as _enc
+
+            srv = chain.light_client_server
+            if srv is None:
+                return self._json({"data": []})
+            start = int(q["start_period"][0])
+            count = min(int(q.get("count", ["1"])[0]), 128)
+            LT = light_client_types(chain.preset)
+            return self._json(
+                {
+                    "data": [
+                        {"ssz": "0x" + _enc(LT.LightClientUpdate, u).hex()}
+                        for u in srv.updates_range(start, count)
+                    ]
+                }
+            )
+
+        if path == "/eth/v1/beacon/light_client/finality_update":
+            from ..light_client import light_client_types
+            from ..ssz import encode as _enc
+
+            srv = chain.light_client_server
+            if srv is None or srv.latest_finality_update is None:
+                return self._err(404, "no finality update available")
+            LT = light_client_types(chain.preset)
+            return self._json(
+                {
+                    "data": {
+                        "ssz": "0x"
+                        + _enc(
+                            LT.LightClientFinalityUpdate,
+                            srv.latest_finality_update,
+                        ).hex()
+                    }
+                }
+            )
+
+        if path == "/eth/v1/beacon/light_client/optimistic_update":
+            from ..light_client import light_client_types
+            from ..ssz import encode as _enc
+
+            srv = chain.light_client_server
+            if srv is None or srv.latest_optimistic_update is None:
+                return self._err(404, "no optimistic update available")
+            LT = light_client_types(chain.preset)
+            return self._json(
+                {
+                    "data": {
+                        "ssz": "0x"
+                        + _enc(
+                            LT.LightClientOptimisticUpdate,
+                            srv.latest_optimistic_update,
+                        ).hex()
+                    }
+                }
+            )
+
         if path == "/lighthouse/liveness":
             # the doppelganger-service probe: was each validator index seen
             # attesting (gossip or blocks) in the given epoch?

@@ -135,6 +135,8 @@ class BeaconChain:
         self.sync_pool = SyncContributionPool(spec)
         self.validator_monitor = ValidatorMonitor()
         self.events = EventBroadcaster()
+        self.light_client_server = None   # created on first altair import
+        self.slasher = None               # attached via attach_slasher()
         self._advanced_head = None   # (head_root, slot, state) pre-advance
 
         self.current_slot = int(genesis_state.slot)
@@ -160,6 +162,7 @@ class BeaconChain:
         self.current_slot = max(self.current_slot, int(slot))
         self.fork_choice.on_tick(self.current_slot)
         self.sync_pool.prune(self.current_slot)
+        self._slasher_tick()
         # observed-* filters only matter for current/previous epoch
         horizon_epoch = self.current_slot // self.preset.slots_per_epoch - 2
         horizon_slot = self.current_slot - 2 * self.preset.slots_per_epoch
@@ -194,6 +197,10 @@ class BeaconChain:
             raise BlockError("unknown parent block")
         key = (slot, int(block.proposer_index))
         if key in self.observed_block_producers:
+            # the slasher wants BOTH headers of an equivocation; the
+            # slashing it builds is signature-verified before pooling, so
+            # a forged duplicate only wastes a queue slot
+            self._slasher_accept_header(signed_block)
             raise BlockError("duplicate proposal (equivocation?)")
 
         pre_state = self._state_for_block(parent_root, slot)
@@ -229,8 +236,44 @@ class BeaconChain:
             raise BlockError("invalid proposer signature")
 
         self.observed_block_producers.add(key)
+        self._slasher_accept_header(signed_block)
         block_root = hash_tree_root(block)
         return GossipVerifiedBlock(signed_block, block_root, pre_state)
+
+    # -------------------------------------------------- slasher service
+
+    def attach_slasher(self, slasher):
+        """slasher/service: observed attestations and block headers feed
+        the detector; detections drain into the op pool on ticks."""
+        self.slasher = slasher
+        return self
+
+    def _slasher_accept_header(self, signed_block):
+        if self.slasher is None:
+            return
+        from ..types.containers import SignedBeaconBlockHeader, block_to_header
+
+        self.slasher.accept_block_header(
+            SignedBeaconBlockHeader(
+                message=block_to_header(signed_block.message),
+                signature=signed_block.signature,
+            )
+        )
+
+    def _slasher_tick(self):
+        """Drain the detector (slasher/src/service.rs batch tick): every
+        detection is signature-verified and pooled like a gossip slashing
+        — block production then packs it via the op pool."""
+        if self.slasher is None:
+            return
+        from ..state_processing.verify_operation import OpVerificationError
+
+        epoch = self.current_slot // self.preset.slots_per_epoch
+        for kind, slashing in self.slasher.process_queued(epoch):
+            try:
+                self.verify_and_pool_operation(slashing)
+            except (AttestationError, OpVerificationError) as e:
+                log.warning("slasher %s detection rejected: %s", kind, e)
 
     def _state_for_block(self, parent_root, slot):
         """Parent post-state advanced to the block's slot
@@ -311,6 +354,8 @@ class BeaconChain:
                 indexed = phase0.get_indexed_attestation(
                     post_state, att, self.preset
                 )
+                if self.slasher is not None:
+                    self.slasher.accept_attestation(indexed)
                 self.fork_choice.on_attestation(
                     self.current_slot, indexed, is_from_block=True
                 )
@@ -319,6 +364,8 @@ class BeaconChain:
 
         self.store.put_block(sig_verified.block_root, sig_verified.signed_block)
         self.store.put_state(sig_verified.block_root, post_state)
+        if hasattr(block.body, "sync_aggregate"):
+            self._serve_light_clients(block)
         self._import_new_pubkeys(post_state)
         self.validator_monitor.process_imported_block(
             post_state, sig_verified.signed_block, self.preset
@@ -335,6 +382,34 @@ class BeaconChain:
         self.recompute_head()
         self.op_pool.prune(post_state, self.preset)
         return sig_verified.block_root
+
+    def _serve_light_clients(self, block):
+        """Feed the light-client server on import: the block's
+        sync_aggregate signs its PARENT (the attested header), so updates
+        are built from the parent's stored post-state
+        (light_client_server role of beacon_chain.rs)."""
+        from ..light_client import LightClientServer
+        from ..types.containers import block_to_header
+
+        attested_state = self.store.get_state(bytes(block.parent_root))
+        if attested_state is None or not hasattr(
+            attested_state, "current_sync_committee"
+        ):
+            return
+        if self.light_client_server is None:
+            self.light_client_server = LightClientServer(self.spec)
+        finalized_header = None
+        fin_root = bytes(attested_state.finalized_checkpoint.root)
+        if fin_root != bytes(32):
+            fb = self.store.get_block(fin_root)
+            if fb is not None:
+                finalized_header = block_to_header(fb.message)
+        self.light_client_server.on_imported_block(
+            attested_state,
+            block.body.sync_aggregate,
+            int(block.slot),
+            finalized_header,
+        )
 
     def process_chain_segment(self, blocks):
         """beacon_chain.rs:2507 process_chain_segment +
@@ -382,6 +457,24 @@ class BeaconChain:
             )
             self.store.put_block(block_root, sb)
             self.store.put_state(block_root, post_state)
+            # synced blocks feed the same observers as gossip imports:
+            # producer filter, slasher, light clients
+            self.observed_block_producers.add(
+                (int(sb.message.slot), int(sb.message.proposer_index))
+            )
+            self._slasher_accept_header(sb)
+            if self.slasher is not None:
+                for att in sb.message.body.attestations:
+                    try:
+                        self.slasher.accept_attestation(
+                            phase0.get_indexed_attestation(
+                                post_state, att, self.preset
+                            )
+                        )
+                    except AssertionError:
+                        pass
+            if hasattr(sb.message.body, "sync_aggregate"):
+                self._serve_light_clients(sb.message)
             self._import_new_pubkeys(post_state)
             roots.append(block_root)
         self.recompute_head()
@@ -445,6 +538,8 @@ class BeaconChain:
                 self.fork_choice.on_attestation(self.current_slot, indexed)
             except InvalidAttestation:
                 pass
+            if self.slasher is not None:
+                self.slasher.accept_attestation(indexed)
             self.op_pool.insert_attestation(att)
         return [tuple(r) for r in results]
 
@@ -532,6 +627,8 @@ class BeaconChain:
                 self.fork_choice.on_attestation(self.current_slot, indexed)
             except InvalidAttestation:
                 pass
+            if self.slasher is not None:
+                self.slasher.accept_attestation(indexed)
             self.op_pool.insert_attestation(agg.aggregate)
         return [tuple(r) for r in results]
 

@@ -124,6 +124,7 @@ class ClientBuilder:
         self._clock = None
         self._net_port = None
         self._dial = []
+        self._slasher = False
 
     def genesis_state(self, state):
         self._genesis_state = state
@@ -164,6 +165,11 @@ class ClientBuilder:
         self._dial = list(dial)
         return self
 
+    def slasher(self, enabled=True):
+        """Attach the slashing detector (the --slasher flag)."""
+        self._slasher = enabled
+        return self
+
     def build(self) -> BeaconNode:
         assert self._genesis_state is not None, "a genesis/checkpoint state is required"
         chain = BeaconChain(
@@ -172,6 +178,10 @@ class ClientBuilder:
             store=self._store,
             verifier=SignatureVerifier(self._backend),
         )
+        if self._slasher:
+            from ..slasher import Slasher
+
+            chain.attach_slasher(Slasher())
         processor = BeaconProcessor(chain)
         api_server = (
             BeaconApiServer(chain, port=self._http_port)

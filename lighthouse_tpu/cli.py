@@ -53,6 +53,8 @@ def build_parser_with_subs():
     bn.add_argument("--interop-validators", type=int, default=0,
                     help="deterministic interop genesis with N validators")
     bn.add_argument("--memory-store", action="store_true")
+    bn.add_argument("--slasher", action="store_true",
+                    help="attach the slashing detector to this node")
     bn.add_argument("--listen-port", type=int, default=None,
                     help="TCP wire port (0 = ephemeral); omit to disable networking")
     bn.add_argument("--dial", action="append", default=[],
@@ -224,6 +226,8 @@ def _run_bn(args):
         print("no genesis source: use --interop-validators N", file=sys.stderr)
         return 1
     builder.genesis_state(state).http_api(args.http_port)
+    if args.slasher:
+        builder.slasher()
     if args.listen_port is not None or args.dial:
         # --dial alone still means "network on" (ephemeral listen port)
         dial = []

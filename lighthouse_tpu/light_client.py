@@ -1,0 +1,425 @@
+"""Altair light-client protocol: types, server, and verifying client.
+
+Mirror of the reference's light-client surface:
+  * types — /root/reference/consensus/types/src/light_client_bootstrap.rs,
+    light_client_update.rs, light_client_finality_update.rs,
+    light_client_optimistic_update.rs (the Altair revision: headers are
+    plain BeaconBlockHeaders)
+  * verification — /root/reference/beacon_node/beacon_chain/src/
+    light_client_finality_update_verification.rs and
+    light_client_optimistic_update_verification.rs
+  * serving — the http_api light_client routes, fed by a per-period
+    best-update cache maintained on block import
+
+Proof shape (light_client_update.rs:11-21): generalized indices over the
+post-Altair BeaconState — CURRENT_SYNC_COMMITTEE_INDEX = 54,
+NEXT_SYNC_COMMITTEE_INDEX = 55 (field leaves 22/23 of the 32-leaf state
+tree, proof len 5) and FINALIZED_ROOT_INDEX = 105 (checkpoint.root one
+level below field leaf 20, proof len 6).
+
+The verifying client (`LightClientStore.process_update`) holds only
+headers + sync committees: it checks the merkle branches against the
+attested header's state root and the sync-aggregate BLS signature via
+the pluggable `SignatureVerifier` (device batch path included) — no
+BeaconState access, the whole point of the protocol.
+"""
+
+from .ssz import (
+    Bytes32,
+    Container,
+    Vector,
+    hash_tree_root,
+    merkle_branch,
+    uint64,
+    verify_merkle_branch,
+)
+from .state_processing import signature_sets as sset
+from .types.containers import BeaconBlockHeader
+
+FINALIZED_ROOT_INDEX = 105
+CURRENT_SYNC_COMMITTEE_INDEX = 54
+NEXT_SYNC_COMMITTEE_INDEX = 55
+FINALIZED_ROOT_PROOF_LEN = 6
+SYNC_COMMITTEE_PROOF_LEN = 5
+MIN_SYNC_COMMITTEE_PARTICIPANTS = 1
+
+_STATE_TREE_LEAVES = 32           # post-altair states have <= 28 fields
+_FINALIZED_FIELD = 20             # finalized_checkpoint's field index
+_CURRENT_SC_FIELD = 22
+_NEXT_SC_FIELD = 23
+
+
+class LightClientError(Exception):
+    pass
+
+
+# ------------------------------------------------------------------ types
+
+
+def light_client_types(preset):
+    """Per-preset light-client containers (sync-committee size bound)."""
+    from .types.state import state_types
+
+    T = state_types(preset)
+
+    class LightClientBootstrap(Container):
+        fields = [
+            ("header", BeaconBlockHeader),
+            ("current_sync_committee", T.SyncCommittee),
+            ("current_sync_committee_branch",
+             Vector(Bytes32, SYNC_COMMITTEE_PROOF_LEN)),
+        ]
+
+    class LightClientUpdate(Container):
+        fields = [
+            ("attested_header", BeaconBlockHeader),
+            ("next_sync_committee", T.SyncCommittee),
+            ("next_sync_committee_branch",
+             Vector(Bytes32, SYNC_COMMITTEE_PROOF_LEN)),
+            ("finalized_header", BeaconBlockHeader),
+            ("finality_branch", Vector(Bytes32, FINALIZED_ROOT_PROOF_LEN)),
+            ("sync_aggregate", T.SyncAggregate),
+            ("signature_slot", uint64),
+        ]
+
+    class LightClientFinalityUpdate(Container):
+        fields = [
+            ("attested_header", BeaconBlockHeader),
+            ("finalized_header", BeaconBlockHeader),
+            ("finality_branch", Vector(Bytes32, FINALIZED_ROOT_PROOF_LEN)),
+            ("sync_aggregate", T.SyncAggregate),
+            ("signature_slot", uint64),
+        ]
+
+    class LightClientOptimisticUpdate(Container):
+        fields = [
+            ("attested_header", BeaconBlockHeader),
+            ("sync_aggregate", T.SyncAggregate),
+            ("signature_slot", uint64),
+        ]
+
+    class _NS:
+        pass
+
+    ns = _NS()
+    ns.SyncCommittee = T.SyncCommittee
+    ns.SyncAggregate = T.SyncAggregate
+    ns.LightClientBootstrap = LightClientBootstrap
+    ns.LightClientUpdate = LightClientUpdate
+    ns.LightClientFinalityUpdate = LightClientFinalityUpdate
+    ns.LightClientOptimisticUpdate = LightClientOptimisticUpdate
+    return ns
+
+
+# ----------------------------------------------------------------- proofs
+
+
+def state_field_leaves(state):
+    """hash_tree_root of every state field — the 32-leaf state tree.
+    Rides the incremental hasher's per-field caches when the state type
+    has them (every BeaconState does)."""
+    if getattr(type(state), "_cached_tree_hash", False):
+        from .ssz.cached import cached_field_roots
+
+        return cached_field_roots(state)
+    return [
+        hash_tree_root(t, getattr(state, n)) for n, t in type(state).fields
+    ]
+
+
+def sync_committee_branch(state, next_committee=False):
+    leaves = state_field_leaves(state)
+    field = _NEXT_SC_FIELD if next_committee else _CURRENT_SC_FIELD
+    return merkle_branch(leaves, _STATE_TREE_LEAVES, field)
+
+
+def finality_branch(state):
+    """Branch for finalized_checkpoint.root: the checkpoint-internal
+    sibling (epoch leaf) then the state-tree path of field 20."""
+    leaves = state_field_leaves(state)
+    epoch_leaf = int(state.finalized_checkpoint.epoch).to_bytes(32, "little")
+    return [epoch_leaf] + merkle_branch(
+        leaves, _STATE_TREE_LEAVES, _FINALIZED_FIELD
+    )
+
+
+def block_header_of(state):
+    """The state's latest block header with its state-root hole filled —
+    the canonical header the proofs anchor to."""
+    hdr = state.latest_block_header
+    out = BeaconBlockHeader(
+        slot=int(hdr.slot),
+        proposer_index=int(hdr.proposer_index),
+        parent_root=bytes(hdr.parent_root),
+        state_root=bytes(hdr.state_root),
+        body_root=bytes(hdr.body_root),
+    )
+    if bytes(out.state_root) == bytes(32):
+        out.state_root = hash_tree_root(state)
+    return out
+
+
+def bootstrap_from_state(state, preset):
+    """LightClientBootstrap::from_beacon_state."""
+    if not hasattr(state, "current_sync_committee"):
+        raise LightClientError("pre-altair state cannot serve light clients")
+    LT = light_client_types(preset)
+    return LT.LightClientBootstrap(
+        header=block_header_of(state),
+        current_sync_committee=state.current_sync_committee,
+        current_sync_committee_branch=sync_committee_branch(state),
+    )
+
+
+# ----------------------------------------------------------------- server
+
+
+class LightClientServer:
+    """Update production on block import (the beacon chain's light-client
+    serving half): tracks the latest finality/optimistic updates and the
+    best LightClientUpdate per sync-committee period (is_better_update:
+    more participation wins)."""
+
+    def __init__(self, spec):
+        self.spec = spec
+        self.preset = spec.preset
+        self.LT = light_client_types(spec.preset)
+        self.latest_finality_update = None
+        self.latest_optimistic_update = None
+        self.best_updates = {}        # period -> LightClientUpdate
+
+    def on_imported_block(self, attested_state, sync_aggregate,
+                          signature_slot, finalized_header=None):
+        """Called after importing a block whose `sync_aggregate` signs the
+        parent (`attested_state`'s header).  `finalized_header` is the
+        header of the attested state's finalized checkpoint block when the
+        chain has it (required for finality updates)."""
+        if not hasattr(attested_state, "current_sync_committee"):
+            return
+        participation = sum(sync_aggregate.sync_committee_bits)
+        if participation < MIN_SYNC_COMMITTEE_PARTICIPANTS:
+            return
+        attested_header = block_header_of(attested_state)
+        LT = self.LT
+        # one pass over the state tree serves every proof below
+        leaves = state_field_leaves(attested_state)
+        fin = finalized_header
+        fin_branch = None
+        if fin is not None:
+            epoch_leaf = int(
+                attested_state.finalized_checkpoint.epoch
+            ).to_bytes(32, "little")
+            fin_branch = [epoch_leaf] + merkle_branch(
+                leaves, _STATE_TREE_LEAVES, _FINALIZED_FIELD
+            )
+
+        self.latest_optimistic_update = LT.LightClientOptimisticUpdate(
+            attested_header=attested_header,
+            sync_aggregate=sync_aggregate,
+            signature_slot=signature_slot,
+        )
+        if fin is not None:
+            self.latest_finality_update = LT.LightClientFinalityUpdate(
+                attested_header=attested_header,
+                finalized_header=fin,
+                finality_branch=fin_branch,
+                sync_aggregate=sync_aggregate,
+                signature_slot=signature_slot,
+            )
+        # the full update (with next_sync_committee) competes per period
+        period = (
+            int(attested_header.slot)
+            // self.preset.slots_per_epoch
+            // self.preset.epochs_per_sync_committee_period
+        )
+        update = LT.LightClientUpdate(
+            attested_header=attested_header,
+            next_sync_committee=attested_state.next_sync_committee,
+            next_sync_committee_branch=merkle_branch(
+                leaves, _STATE_TREE_LEAVES, _NEXT_SC_FIELD
+            ),
+            finalized_header=fin or BeaconBlockHeader(),
+            finality_branch=(
+                fin_branch
+                if fin is not None
+                else [bytes(32)] * FINALIZED_ROOT_PROOF_LEN
+            ),
+            sync_aggregate=sync_aggregate,
+            signature_slot=signature_slot,
+        )
+        best = self.best_updates.get(period)
+        if best is None or self._better(update, best):
+            self.best_updates[period] = update
+
+    @staticmethod
+    def _better(a, b):
+        """is_better_update, reduced to its dominant terms: finality
+        presence then participation count."""
+        a_fin = any(bytes(r) != bytes(32) for r in a.finality_branch)
+        b_fin = any(bytes(r) != bytes(32) for r in b.finality_branch)
+        if a_fin != b_fin:
+            return a_fin
+        return (
+            sum(a.sync_aggregate.sync_committee_bits)
+            > sum(b.sync_aggregate.sync_committee_bits)
+        )
+
+    def updates_range(self, start_period, count):
+        return [
+            self.best_updates[p]
+            for p in range(start_period, start_period + count)
+            if p in self.best_updates
+        ]
+
+
+# ----------------------------------------------------------------- client
+
+
+class LightClientStore:
+    """The verifying follower (spec LightClientStore semantics over the
+    reference's verification rules): initialize from a trusted bootstrap,
+    then advance on updates with only headers, committees, and proofs."""
+
+    def __init__(self, trusted_block_root, bootstrap, spec, verifier):
+        self.spec = spec
+        self.preset = spec.preset
+        self.verifier = verifier
+        header_root = hash_tree_root(bootstrap.header)
+        if bytes(header_root) != bytes(trusted_block_root):
+            raise LightClientError("bootstrap header != trusted root")
+        if not verify_merkle_branch(
+            hash_tree_root(bootstrap.current_sync_committee),
+            bootstrap.current_sync_committee_branch,
+            SYNC_COMMITTEE_PROOF_LEN,
+            CURRENT_SYNC_COMMITTEE_INDEX - (1 << SYNC_COMMITTEE_PROOF_LEN),
+            bootstrap.header.state_root,
+        ):
+            raise LightClientError("invalid current_sync_committee branch")
+        self.finalized_header = bootstrap.header
+        self.optimistic_header = bootstrap.header
+        self.current_sync_committee = bootstrap.current_sync_committee
+        self.next_sync_committee = None
+        self.genesis_validators_root = None   # set via follow()
+
+    # -- helpers
+
+    def _period_of(self, slot):
+        return (
+            int(slot)
+            // self.preset.slots_per_epoch
+            // self.preset.epochs_per_sync_committee_period
+        )
+
+    def _committee_for(self, signature_slot):
+        period = self._period_of(int(signature_slot) - 1)
+        stored = self._period_of(int(self.finalized_header.slot))
+        if period == stored:
+            return self.current_sync_committee
+        if period == stored + 1 and self.next_sync_committee is not None:
+            return self.next_sync_committee
+        raise LightClientError(
+            f"no committee known for signature period {period}"
+        )
+
+    def _verify_sync_aggregate(self, attested_header, sync_aggregate,
+                               signature_slot, gvr):
+        from .crypto.ref.curves import g1_decompress
+
+        committee = self._committee_for(signature_slot)
+        bits = list(sync_aggregate.sync_committee_bits)
+        if sum(bits) < MIN_SYNC_COMMITTEE_PARTICIPANTS:
+            raise LightClientError("insufficient participation")
+        # committee pubkeys are proven by the state branch, so they were
+        # validated at deposit time — decompress without subgroup checks
+        pubkeys = [
+            g1_decompress(bytes(pk), subgroup_check=False)
+            for pk, bit in zip(committee.pubkeys, bits)
+            if bit
+        ]
+        prev_slot = max(int(signature_slot), 1) - 1
+        fork = self.spec.fork_at_epoch(
+            prev_slot // self.preset.slots_per_epoch
+        )
+        s = sset.sync_aggregate_signature_set(
+            pubkeys, sync_aggregate, prev_slot,
+            hash_tree_root(attested_header), fork, gvr, self.spec,
+        )
+        if s is not None and not self.verifier.verify_signature_sets([s]):
+            raise LightClientError("invalid sync aggregate signature")
+
+    # -- update processing
+
+    def process_update(self, update, genesis_validators_root):
+        """validate_light_client_update + apply: check proofs against the
+        ATTESTED header's state root, check the signature, then advance
+        optimistic/finalized heads and rotate committees."""
+        attested = update.attested_header
+        if int(update.signature_slot) <= int(attested.slot):
+            raise LightClientError("signature slot not after attested slot")
+        self._verify_sync_aggregate(
+            attested, update.sync_aggregate, update.signature_slot,
+            genesis_validators_root,
+        )
+
+        has_finality = hasattr(update, "finality_branch") and any(
+            bytes(r) != bytes(32) for r in update.finality_branch
+        )
+        if has_finality:
+            if not verify_merkle_branch(
+                hash_tree_root(update.finalized_header),
+                update.finality_branch,
+                FINALIZED_ROOT_PROOF_LEN,
+                FINALIZED_ROOT_INDEX - (1 << FINALIZED_ROOT_PROOF_LEN),
+                attested.state_root,
+            ):
+                raise LightClientError("invalid finality branch")
+
+        if hasattr(update, "next_sync_committee"):
+            if not verify_merkle_branch(
+                hash_tree_root(update.next_sync_committee),
+                update.next_sync_committee_branch,
+                SYNC_COMMITTEE_PROOF_LEN,
+                NEXT_SYNC_COMMITTEE_INDEX - (1 << SYNC_COMMITTEE_PROOF_LEN),
+                attested.state_root,
+            ):
+                raise LightClientError("invalid next_sync_committee branch")
+            att_period = self._period_of(int(attested.slot))
+            stored = self._period_of(int(self.finalized_header.slot))
+            if att_period == stored:
+                self.next_sync_committee = update.next_sync_committee
+
+        # apply
+        if int(attested.slot) > int(self.optimistic_header.slot):
+            self.optimistic_header = attested
+        if has_finality and int(update.finalized_header.slot) > int(
+            self.finalized_header.slot
+        ):
+            old_period = self._period_of(int(self.finalized_header.slot))
+            new_period = self._period_of(int(update.finalized_header.slot))
+            if new_period == old_period + 1:
+                if self.next_sync_committee is None:
+                    raise LightClientError(
+                        "cannot cross periods without next committee"
+                    )
+                self.current_sync_committee = self.next_sync_committee
+                self.next_sync_committee = (
+                    update.next_sync_committee
+                    if hasattr(update, "next_sync_committee")
+                    else None
+                )
+            self.finalized_header = update.finalized_header
+        return True
+
+    def process_optimistic_update(self, update, genesis_validators_root):
+        """light_client_optimistic_update_verification.rs: signature-only
+        advance of the optimistic head."""
+        attested = update.attested_header
+        if int(update.signature_slot) <= int(attested.slot):
+            raise LightClientError("signature slot not after attested slot")
+        self._verify_sync_aggregate(
+            attested, update.sync_aggregate, update.signature_slot,
+            genesis_validators_root,
+        )
+        if int(attested.slot) > int(self.optimistic_header.slot):
+            self.optimistic_header = attested
+        return True

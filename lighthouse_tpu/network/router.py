@@ -52,7 +52,7 @@ class Router:
         backfilled history is store-only)."""
         from ..ssz import hash_tree_root
         from ..state_processing import signature_sets as sset
-        from ..types.containers import BeaconBlockHeader, SignedBeaconBlockHeader
+        from ..types.containers import SignedBeaconBlockHeader, block_to_header
 
         chain = self.chain
         anchor_state = chain.store.get_state(chain.genesis_root)
@@ -62,13 +62,7 @@ class Router:
         gp = chain.pubkey_cache.as_get_pubkey()
 
         def proposal_set(b):
-            hdr = BeaconBlockHeader(
-                slot=b.message.slot,
-                proposer_index=b.message.proposer_index,
-                parent_root=b.message.parent_root,
-                state_root=b.message.state_root,
-                body_root=hash_tree_root(b.message.body),
-            )
+            hdr = block_to_header(b.message)
             # the domain must match the block's OWN era, not the anchor's
             # fork (a capella anchor backfilling phase0 history would
             # otherwise verify with the wrong fork version)

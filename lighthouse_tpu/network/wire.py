@@ -641,10 +641,13 @@ class WireNode:
             out.extend(blocks)
             if code != R_PARTIAL:
                 break
-            if not blocks:
-                raise WireError("partial by-root response with no blocks")
             got = {hash_tree_root(b.message) for b in blocks}
-            remaining = [r for r in remaining if r not in got]
+            still = [r for r in remaining if r not in got]
+            if len(still) == len(remaining):
+                # a partial response MUST make progress — anything else is
+                # a misbehaving peer, not a reason to spin forever
+                raise WireError("partial by-root response made no progress")
+            remaining = still
         return out
 
     def request_blocks_by_range(self, peer_id, start_slot, count, step=1):
@@ -662,9 +665,10 @@ class WireNode:
             out.extend(blocks)
             if code != R_PARTIAL:
                 break
-            if not blocks:
-                raise WireError("partial by-range response with no blocks")
-            cursor = int(blocks[-1].message.slot) + 1
+            advanced = int(blocks[-1].message.slot) + 1 if blocks else cursor
+            if advanced <= cursor:
+                raise WireError("partial by-range response made no progress")
+            cursor = advanced
         return out
 
     def goodbye(self, peer_id, reason=GB_CLIENT_SHUTDOWN):

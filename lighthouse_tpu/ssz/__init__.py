@@ -38,7 +38,7 @@ from .core import (
     Bytes48,
     Bytes96,
 )
-from .hash import hash_tree_root
+from .hash import hash_tree_root, merkle_branch, verify_merkle_branch
 
 __all__ = [
     "Boolean", "DecodeError", "ByteList", "ByteVector", "Bitlist", "Bitvector", "Container",

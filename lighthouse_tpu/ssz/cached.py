@@ -117,7 +117,9 @@ class StateHasher:
         self.elem_roots = {}    # id(elem) -> (elem, root), for container lists
         self.vleaves = None     # validator leaf-root array
 
-    def root(self, state) -> bytes:
+    def field_roots(self, state) -> list:
+        """Every field's hash_tree_root, through the per-field caches —
+        also the state-tree leaves light-client proofs are built from."""
         cls = type(state)
         field_roots = []
         for name, typ in cls.fields:
@@ -136,6 +138,10 @@ class StateHasher:
                 self.revs[name] = (value, getattr(value, "rev", None))
                 self.roots[name] = root
             field_roots.append(root)
+        return field_roots
+
+    def root(self, state) -> bytes:
+        field_roots = self.field_roots(state)
         return merkleize(field_roots, len(field_roots))
 
     # -- per-field strategies ---------------------------------------------
@@ -205,10 +211,19 @@ class StateHasher:
         return c
 
 
-def cached_state_root(state) -> bytes:
-    """hash_tree_root(state) through the instance-attached StateHasher."""
+def _hasher_of(state) -> StateHasher:
     h = getattr(state, "_tree_hasher", None)
     if h is None:
         h = StateHasher()
         object.__setattr__(state, "_tree_hasher", h)
-    return h.root(state)
+    return h
+
+
+def cached_state_root(state) -> bytes:
+    """hash_tree_root(state) through the instance-attached StateHasher."""
+    return _hasher_of(state).root(state)
+
+
+def cached_field_roots(state) -> list:
+    """Per-field roots through the instance-attached StateHasher."""
+    return _hasher_of(state).field_roots(state)

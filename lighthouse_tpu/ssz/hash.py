@@ -100,6 +100,38 @@ def mix_in_length(root, length):
     return _sha256(root + int(length).to_bytes(32, "little"))
 
 
+def merkle_branch(chunks, limit, index):
+    """Sibling path (bottom-up) proving `chunks[index]` inside
+    `merkleize(chunks, limit)` — the proof-generation half of
+    consensus/merkle_proof (verification lives in phase0's
+    `_verify_merkle_branch`)."""
+    depth = max(limit - 1, 0).bit_length()
+    layer = list(chunks)
+    branch = []
+    for d in range(depth):
+        sib = index ^ 1
+        branch.append(layer[sib] if sib < len(layer) else ZERO_HASHES[d])
+        nxt = []
+        for i in range(0, len(layer), 2):
+            left = layer[i]
+            right = layer[i + 1] if i + 1 < len(layer) else ZERO_HASHES[d]
+            nxt.append(_sha256(left + right))
+        layer = nxt
+        index >>= 1
+    return branch
+
+
+def verify_merkle_branch(leaf, branch, depth, index, root):
+    """Spec is_valid_merkle_branch."""
+    value = bytes(leaf)
+    for i in range(depth):
+        if (index >> i) & 1:
+            value = _sha256(bytes(branch[i]) + value)
+        else:
+            value = _sha256(value + bytes(branch[i]))
+    return value == bytes(root)
+
+
 def _chunk_count(typ):
     """Leaf-count limit for merkleization, per the SSZ spec."""
     if isinstance(typ, (core.Uint, core.Boolean)):

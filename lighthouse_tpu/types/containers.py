@@ -91,6 +91,21 @@ class BeaconBlockHeader(Container):
     ]
 
 
+def block_to_header(block):
+    """A block's BeaconBlockHeader (block root preimage) — the one
+    construction shared by gossip verification, the slasher feed, and
+    light-client serving."""
+    from ..ssz import hash_tree_root
+
+    return BeaconBlockHeader(
+        slot=int(block.slot),
+        proposer_index=int(block.proposer_index),
+        parent_root=bytes(block.parent_root),
+        state_root=bytes(block.state_root),
+        body_root=hash_tree_root(block.body),
+    )
+
+
 class SignedBeaconBlockHeader(Container):
     fields = [
         ("message", BeaconBlockHeader),

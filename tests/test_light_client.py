@@ -1,0 +1,224 @@
+"""Light-client protocol: proofs, server updates on import, verifying
+follower, HTTP routes.
+
+Mirrors /root/reference/consensus/types/src/light_client_*.rs and
+beacon_node/beacon_chain/src/light_client_*_verification.rs.
+"""
+
+import pytest
+
+from lighthouse_tpu.beacon.chain import BeaconChain
+from lighthouse_tpu.crypto.backend import SignatureVerifier
+from lighthouse_tpu.light_client import (
+    LightClientError,
+    LightClientStore,
+    bootstrap_from_state,
+    finality_branch,
+    light_client_types,
+    sync_committee_branch,
+    FINALIZED_ROOT_INDEX,
+    FINALIZED_ROOT_PROOF_LEN,
+    CURRENT_SYNC_COMMITTEE_INDEX,
+    SYNC_COMMITTEE_PROOF_LEN,
+)
+from lighthouse_tpu.ssz import hash_tree_root, verify_merkle_branch
+from lighthouse_tpu.testing.harness import Harness
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+
+ALTAIR = ChainSpec(preset=MinimalPreset, altair_fork_epoch=0)
+
+
+def _attested_chain(n_slots, verifier="fake"):
+    h = Harness(8, ALTAIR)
+    chain = BeaconChain(
+        h.state.copy(), ALTAIR, verifier=SignatureVerifier(verifier)
+    )
+    pending = []
+    for slot in range(1, n_slots + 1):
+        blk = h.produce_block(slot, attestations=pending)
+        h.process_block(blk, strategy="no_verification")
+        chain.on_tick(slot)
+        chain.process_block(blk)
+        pending = h.attest_slot(h.state, slot, hash_tree_root(blk.message))
+    return h, chain
+
+
+# ------------------------------------------------------------- proofs
+
+
+def test_sync_committee_and_finality_branches_verify():
+    h = Harness(8, ALTAIR)
+    state = h.state
+    root = hash_tree_root(state)
+    assert verify_merkle_branch(
+        hash_tree_root(state.current_sync_committee),
+        sync_committee_branch(state),
+        SYNC_COMMITTEE_PROOF_LEN,
+        CURRENT_SYNC_COMMITTEE_INDEX - (1 << SYNC_COMMITTEE_PROOF_LEN),
+        root,
+    )
+    assert verify_merkle_branch(
+        bytes(state.finalized_checkpoint.root),
+        finality_branch(state),
+        FINALIZED_ROOT_PROOF_LEN,
+        FINALIZED_ROOT_INDEX - (1 << FINALIZED_ROOT_PROOF_LEN),
+        root,
+    )
+
+
+def test_bootstrap_rejects_wrong_root_and_bad_branch():
+    h = Harness(8, ALTAIR)
+    boot = bootstrap_from_state(h.state, ALTAIR.preset)
+    root = hash_tree_root(boot.header)
+    # good
+    LightClientStore(root, boot, ALTAIR, SignatureVerifier("fake"))
+    # wrong trusted root
+    with pytest.raises(LightClientError, match="trusted root"):
+        LightClientStore(b"\x01" * 32, boot, ALTAIR, SignatureVerifier("fake"))
+    # corrupted branch
+    branch = list(boot.current_sync_committee_branch)
+    branch[0] = b"\x02" * 32
+    boot.current_sync_committee_branch = branch
+    with pytest.raises(LightClientError, match="branch"):
+        LightClientStore(root, boot, ALTAIR, SignatureVerifier("fake"))
+
+
+# ----------------------------------------------- server + follower e2e
+
+
+@pytest.fixture(scope="module")
+def finalized_chain():
+    # 33 slots: finality lands in the state at the slot-32 boundary, so
+    # the slot-33 block's PARENT is the first attested state carrying it
+    return _attested_chain(33)
+
+
+def test_light_client_follows_chain_to_finality(finalized_chain):
+    """Fully-attested chain: the follower tracks the head via optimistic
+    updates and reaches finality via the finality update, holding only
+    headers + committees + proofs."""
+    h, chain = finalized_chain
+    srv = chain.light_client_server
+    assert srv is not None
+    assert srv.latest_optimistic_update is not None
+    assert srv.latest_finality_update is not None
+
+    genesis_state = chain.store.get_state(chain.genesis_root)
+    boot = bootstrap_from_state(genesis_state, ALTAIR.preset)
+    store = LightClientStore(
+        hash_tree_root(boot.header), boot, ALTAIR, SignatureVerifier("fake")
+    )
+    gvr = bytes(genesis_state.genesis_validators_root)
+
+    opt = srv.latest_optimistic_update
+    store.process_optimistic_update(opt, gvr)
+    assert int(store.optimistic_header.slot) >= 30
+
+    fin = srv.latest_finality_update
+    store.process_update(fin, gvr)
+    assert int(store.finalized_header.slot) > 0
+    assert int(chain.head_state.finalized_checkpoint.epoch) >= 2
+
+    # the best-update cache serves the current period with a real
+    # next-committee proof
+    updates = srv.updates_range(0, 1)
+    assert len(updates) == 1
+    store2 = LightClientStore(
+        hash_tree_root(boot.header), boot, ALTAIR, SignatureVerifier("fake")
+    )
+    store2.process_update(updates[0], gvr)
+    assert store2.next_sync_committee is not None
+
+
+def test_light_client_real_signature_verification():
+    """One optimistic update verified with the ORACLE backend: the sync
+    aggregate signature is genuinely checked, and a flipped bit breaks
+    it."""
+    h, chain = _attested_chain(2)
+    srv = chain.light_client_server
+    opt = srv.latest_optimistic_update
+    genesis_state = chain.store.get_state(chain.genesis_root)
+    boot = bootstrap_from_state(genesis_state, ALTAIR.preset)
+    gvr = bytes(genesis_state.genesis_validators_root)
+
+    store = LightClientStore(
+        hash_tree_root(boot.header), boot, ALTAIR, SignatureVerifier("oracle")
+    )
+    assert store.process_optimistic_update(opt, gvr) is True
+
+    # flip one participation bit: pubkey set no longer matches the sig
+    bits = list(opt.sync_aggregate.sync_committee_bits)
+    flip = bits.index(1)
+    bits[flip] = 0
+    opt.sync_aggregate.sync_committee_bits = bits
+    store2 = LightClientStore(
+        hash_tree_root(boot.header), boot, ALTAIR, SignatureVerifier("oracle")
+    )
+    with pytest.raises(LightClientError, match="signature"):
+        store2.process_optimistic_update(opt, gvr)
+
+
+def test_tampered_finality_branch_rejected(finalized_chain):
+    h, chain = finalized_chain
+    srv = chain.light_client_server
+    import copy
+
+    fin = copy.deepcopy(srv.latest_finality_update)
+    genesis_state = chain.store.get_state(chain.genesis_root)
+    boot = bootstrap_from_state(genesis_state, ALTAIR.preset)
+    store = LightClientStore(
+        hash_tree_root(boot.header), boot, ALTAIR, SignatureVerifier("fake")
+    )
+    branch = list(fin.finality_branch)
+    branch[2] = b"\xee" * 32
+    fin.finality_branch = branch
+    with pytest.raises(LightClientError, match="finality branch"):
+        store.process_update(
+            fin, bytes(genesis_state.genesis_validators_root)
+        )
+
+
+# --------------------------------------------------------- HTTP routes
+
+
+def test_light_client_http_routes():
+    from lighthouse_tpu.api.client import BeaconApiClient
+    from lighthouse_tpu.api.http_api import BeaconApiServer
+    from lighthouse_tpu.ssz import decode
+
+    h, chain = _attested_chain(8)
+    server = BeaconApiServer(chain).start()
+    try:
+        api = BeaconApiClient(f"http://127.0.0.1:{server.port}", timeout=30.0)
+        LT = light_client_types(ALTAIR.preset)
+
+        boot_resp = api._get(
+            f"/eth/v1/beacon/light_client/bootstrap/0x{chain.genesis_root.hex()}",
+            {},
+        )["data"]
+        boot = decode(
+            LT.LightClientBootstrap, bytes.fromhex(boot_resp["ssz"][2:])
+        )
+        store = LightClientStore(
+            hash_tree_root(boot.header), boot, ALTAIR,
+            SignatureVerifier("fake"),
+        )
+
+        opt_resp = api._get(
+            "/eth/v1/beacon/light_client/optimistic_update", {}
+        )["data"]
+        opt = decode(
+            LT.LightClientOptimisticUpdate, bytes.fromhex(opt_resp["ssz"][2:])
+        )
+        gvr = bytes(chain.head_state.genesis_validators_root)
+        store.process_optimistic_update(opt, gvr)
+        assert int(store.optimistic_header.slot) >= 6
+
+        upd_resp = api._get(
+            "/eth/v1/beacon/light_client/updates",
+            {"start_period": 0, "count": 2},
+        )["data"]
+        assert len(upd_resp) == 1
+        decode(LT.LightClientUpdate, bytes.fromhex(upd_resp[0]["ssz"][2:]))
+    finally:
+        server.stop()

@@ -1,0 +1,90 @@
+"""Slasher service wiring: observed equivocations drain into the op
+pool and land in produced blocks (slasher/src/service.rs role over the
+chain's gossip/import feeds)."""
+
+import pytest
+
+from lighthouse_tpu.beacon.chain import BeaconChain, BlockError
+from lighthouse_tpu.crypto.backend import SignatureVerifier
+from lighthouse_tpu.slasher import Slasher
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.testing.harness import Harness
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+
+SPEC = ChainSpec(preset=MinimalPreset)
+
+
+def test_proposer_equivocation_detected_pooled_and_packed():
+    """Two different blocks for one slot: the second is rejected at
+    gossip but both headers reach the slasher; the detection is
+    signature-verified (oracle), pooled, and packed into the next
+    produced block, which slashes the proposer on import."""
+    h = Harness(8, SPEC)
+    chain = BeaconChain(
+        h.state.copy(), SPEC, verifier=SignatureVerifier("oracle")
+    ).attach_slasher(Slasher())
+
+    blk_a = h.produce_block(1)
+    atts = h.attest_slot(h.state, 0, chain.genesis_root)
+    blk_b = h.produce_block(1, attestations=atts[:1])
+    assert hash_tree_root(blk_a.message) != hash_tree_root(blk_b.message)
+
+    h.process_block(blk_a, strategy="no_verification")
+    chain.on_tick(1)
+    chain.process_block(blk_a)
+    proposer = int(blk_a.message.proposer_index)
+
+    with pytest.raises(BlockError, match="duplicate"):
+        chain.process_block(blk_b)
+
+    # tick drains the slasher: detection verified + pooled
+    chain.on_tick(2)
+    assert len(chain.op_pool.proposer_slashings) == 1
+
+    # block production packs it; import slashes the proposer
+    blk2 = h.produce_block(
+        2, proposer_slashings=chain.op_pool.get_slashings_and_exits(
+            h.state, SPEC.preset
+        )[0],
+    )
+    h.process_block(blk2, strategy="no_verification")
+    chain.on_tick(2)
+    chain.process_block(blk2)
+    assert bool(chain.head_state.validators[proposer].slashed)
+
+
+def test_attester_double_vote_detected_and_pooled():
+    """Conflicting attestations fed through the slasher queue produce a
+    verified AttesterSlashing in the pool."""
+    h = Harness(8, SPEC)
+    chain = BeaconChain(
+        h.state.copy(), SPEC, verifier=SignatureVerifier("oracle")
+    ).attach_slasher(Slasher())
+
+    slashing = h.make_attester_slashing([3], target_epoch=0)
+    chain.slasher.accept_attestation(slashing.attestation_1)
+    chain.slasher.accept_attestation(slashing.attestation_2)
+    chain.on_tick(1)
+    assert len(chain.op_pool.attester_slashings) == 1
+
+
+def test_forged_equivocation_rejected_not_pooled():
+    """A duplicate block with a FORGED signature feeds the slasher, but
+    the resulting slashing fails verification and never pools."""
+    h = Harness(8, SPEC)
+    chain = BeaconChain(
+        h.state.copy(), SPEC, verifier=SignatureVerifier("oracle")
+    ).attach_slasher(Slasher())
+
+    blk_a = h.produce_block(1)
+    atts = h.attest_slot(h.state, 0, chain.genesis_root)
+    blk_b = h.produce_block(1, attestations=atts[:1])
+    h.process_block(blk_a, strategy="no_verification")
+    chain.on_tick(1)
+    chain.process_block(blk_a)
+
+    forged = type(blk_b)(message=blk_b.message, signature=b"\xc0" + bytes(95))
+    with pytest.raises(BlockError):
+        chain.process_block(forged)
+    chain.on_tick(2)
+    assert len(chain.op_pool.proposer_slashings) == 0
