@@ -150,6 +150,17 @@ class BeaconApiClient:
             {"randao_reveal": "0x" + bytes(randao_reveal).hex()},
         )
 
+    def produce_blinded_block_ssz(self, slot, randao_reveal):
+        return self._post(
+            f"/eth/v1/validator/blinded_blocks/{slot}",
+            {"randao_reveal": "0x" + bytes(randao_reveal).hex()},
+        )
+
+    def publish_blinded_block_ssz(self, ssz_hex_with_fork_id):
+        return self._post(
+            "/eth/v1/beacon/blinded_blocks", {"ssz": ssz_hex_with_fork_id}
+        )["data"]
+
     def metrics(self):
         url = self.base + "/metrics"
         with urllib.request.urlopen(url, timeout=self.timeout) as r:

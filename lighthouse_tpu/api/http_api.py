@@ -569,6 +569,53 @@ class _Handler(BaseHTTPRequestHandler):
                 }
             )
 
+        m = re.fullmatch(r"/eth/v1/validator/blinded_blocks/(\d+)", path)
+        if m:
+            # builder-path production; `blinded: false` signals the local
+            # fallback produced a FULL block (builder down / bad bid)
+            from ..beacon.store import _Codec
+            from ..ssz import encode as _enc
+
+            slot = int(m.group(1))
+            reveal = bytes.fromhex(body["randao_reveal"].removeprefix("0x"))
+            block, _, blinded = chain.produce_blinded_block_on_state(
+                slot, reveal
+            )
+            codec = _Codec(chain.preset)
+            version = codec.fork_name_for_body(block.body)
+            cls = (
+                codec.unsigned_blinded_cls(version)
+                if blinded
+                else codec.unsigned_block_cls(version)
+            )
+            return self._json(
+                {
+                    "version": version,
+                    "blinded": blinded,
+                    "data": {"ssz": "0x" + _enc(cls, block).hex()},
+                }
+            )
+
+        if path == "/eth/v1/beacon/blinded_blocks":
+            from ..beacon.chain import BlockError
+            from ..beacon.store import _Codec
+
+            codec = _Codec(chain.preset)
+            signed = codec.dec_blinded(
+                bytes.fromhex(body["ssz"].removeprefix("0x"))
+            )
+            try:
+                root = chain.process_blinded_block(signed)
+            except BlockError as e:
+                return self._err(400, f"blinded block rejected: {e}")
+            router = getattr(self.server, "router", None)
+            if router is not None:
+                # the unblinded full block is what gossips on
+                full = chain.store.get_block(root)
+                if full is not None:
+                    router.publish_block(full)
+            return self._json({"data": {"root": _hex(root)}})
+
         m = re.fullmatch(r"/eth/v1/validator/duties/sync/(\d+)", path)
         if m:
             pubkeys = [bytes.fromhex(pk.removeprefix("0x")) for pk in body]

@@ -137,6 +137,7 @@ class BeaconChain:
         self.events = EventBroadcaster()
         self.light_client_server = None   # created on first altair import
         self.slasher = None               # attached via attach_slasher()
+        self.builder = None               # attached via attach_builder()
         self._advanced_head = None   # (head_root, slot, state) pre-advance
 
         self.current_slot = int(genesis_state.slot)
@@ -246,6 +247,12 @@ class BeaconChain:
         """slasher/service: observed attestations and block headers feed
         the detector; detections drain into the op pool on ticks."""
         self.slasher = slasher
+        return self
+
+    def attach_builder(self, builder):
+        """External block builder (MEV relay seam — execution_layer's
+        builder client); enables the blinded proposal path."""
+        self.builder = builder
         return self
 
     def _slasher_accept_header(self, signed_block):
@@ -1145,9 +1152,9 @@ class BeaconChain:
 
     # ------------------------------------------------------- production
 
-    def produce_block_on_state(self, slot, randao_reveal=b"\x00" * 96):
-        """beacon_chain.rs:4204 produce_block_on_state: op-pool packing over
-        the head state (unsigned; the VC signs)."""
+    def _production_parts(self, slot, randao_reveal):
+        """Shared production scaffolding: advanced state, proposer, and
+        the payload-less body kwargs (op-pool packing)."""
         from ..types.state import state_types
 
         T = state_types(self.preset)
@@ -1168,7 +1175,6 @@ class BeaconChain:
             attester_slashings=att_slashings,
             voluntary_exits=exits,
         )
-        bellatrix = hasattr(state, "latest_execution_payload_header")
         capella = hasattr(state, "next_withdrawal_index")
         if altair:
             # sync messages created at slot-1 voted for this block's parent;
@@ -1178,29 +1184,14 @@ class BeaconChain:
             body_kwargs["sync_aggregate"] = self.sync_pool.get_sync_aggregate(
                 slot - 1, parent_root, T
             )
-        if bellatrix:
-            body_kwargs["execution_payload"] = self._production_payload(
-                state, randao_reveal, capella
-            )
         if capella:
             body_kwargs["bls_to_execution_changes"] = (
                 self.op_pool.get_bls_to_execution_changes(state, self.preset)
             )
-            body = T.BeaconBlockBodyCapella(**body_kwargs)
-            block_cls, signed_cls = T.BeaconBlockCapella, T.SignedBeaconBlockCapella
-        elif bellatrix:
-            body = T.BeaconBlockBodyBellatrix(**body_kwargs)
-            block_cls, signed_cls = (
-                T.BeaconBlockBellatrix, T.SignedBeaconBlockBellatrix,
-            )
-        elif altair:
-            body = T.BeaconBlockBodyAltair(**body_kwargs)
-            block_cls = T.BeaconBlockAltair
-            signed_cls = T.SignedBeaconBlockAltair
-        else:
-            body = T.BeaconBlockBody(**body_kwargs)
-            block_cls = T.BeaconBlock
-            signed_cls = T.SignedBeaconBlock
+        return T, state, proposer, body_kwargs
+
+    def _finish_block(self, T, state, proposer, slot, body, block_cls,
+                      signed_cls):
         block = block_cls(
             slot=slot,
             proposer_index=proposer,
@@ -1220,6 +1211,162 @@ class BeaconChain:
         )
         block.state_root = hash_tree_root(tmp)
         return block, state
+
+    def _finish_full_block(self, T, state, proposer, slot, body_kwargs,
+                           randao_reveal):
+        """Local production tail: attach the engine payload and pick the
+        fork's containers (shared by normal production and the builder
+        fallback so neither redoes the parts)."""
+        altair = hasattr(state, "previous_epoch_participation")
+        bellatrix = hasattr(state, "latest_execution_payload_header")
+        capella = hasattr(state, "next_withdrawal_index")
+        if bellatrix:
+            body_kwargs["execution_payload"] = self._production_payload(
+                state, randao_reveal, capella
+            )
+        if capella:
+            body = T.BeaconBlockBodyCapella(**body_kwargs)
+            block_cls, signed_cls = T.BeaconBlockCapella, T.SignedBeaconBlockCapella
+        elif bellatrix:
+            body = T.BeaconBlockBodyBellatrix(**body_kwargs)
+            block_cls, signed_cls = (
+                T.BeaconBlockBellatrix, T.SignedBeaconBlockBellatrix,
+            )
+        elif altair:
+            body = T.BeaconBlockBodyAltair(**body_kwargs)
+            block_cls = T.BeaconBlockAltair
+            signed_cls = T.SignedBeaconBlockAltair
+        else:
+            body = T.BeaconBlockBody(**body_kwargs)
+            block_cls = T.BeaconBlock
+            signed_cls = T.SignedBeaconBlock
+        return self._finish_block(
+            T, state, proposer, slot, body, block_cls, signed_cls
+        )
+
+    def produce_block_on_state(self, slot, randao_reveal=b"\x00" * 96):
+        """beacon_chain.rs:4204 produce_block_on_state: op-pool packing over
+        the head state (unsigned; the VC signs)."""
+        T, state, proposer, body_kwargs = self._production_parts(
+            slot, randao_reveal
+        )
+        return self._finish_full_block(
+            T, state, proposer, slot, body_kwargs, randao_reveal
+        )
+
+    def produce_blinded_block_on_state(self, slot, randao_reveal=b"\x00" * 96):
+        """Builder-path production (beacon_chain.rs get_payload
+        BlindedPayload flavor): ask the attached builder for a header,
+        gate the bid, and assemble a BLINDED block over it.  ANY builder
+        failure — no builder, pre-merge state, bad bid, or a bid whose
+        header fails the STF — falls back to LOCAL production over the
+        same already-packed parts (execution_layer's builder fallback);
+        the caller checks the returned `blinded` flag."""
+        from ..execution.builder import BuilderError, verify_bid
+        from ..state_processing.bellatrix import production_parent_hash
+
+        T, state, proposer, body_kwargs = self._production_parts(
+            slot, randao_reveal
+        )
+        bellatrix = hasattr(state, "latest_execution_payload_header")
+        capella = hasattr(state, "next_withdrawal_index")
+        if self.builder is not None and bellatrix:
+            try:
+                parent_hash = production_parent_hash(
+                    state, self.execution_engine
+                )
+                signed_bid = self.builder.get_header(
+                    slot, parent_hash,
+                    state.validators.pubkey[proposer].tobytes(),
+                )
+                bid = verify_bid(
+                    signed_bid, self.spec, self.verifier, parent_hash
+                )
+                blinded_kwargs = dict(body_kwargs)
+                blinded_kwargs["execution_payload_header"] = bid.header
+                if capella:
+                    body = T.BeaconBlockBodyBlindedCapella(**blinded_kwargs)
+                    block_cls = T.BlindedBeaconBlockCapella
+                    signed_cls = T.SignedBlindedBeaconBlockCapella
+                else:
+                    body = T.BeaconBlockBodyBlindedBellatrix(**blinded_kwargs)
+                    block_cls = T.BlindedBeaconBlockBellatrix
+                    signed_cls = T.SignedBlindedBeaconBlockBellatrix
+                block, st = self._finish_block(
+                    T, state, proposer, slot, body, block_cls, signed_cls
+                )
+                return block, st, True
+            except (
+                BuilderError,
+                AssertionError,
+                phase0.BlockProcessingError,
+            ) as e:
+                log.warning("builder path failed (%s); producing locally", e)
+        block, st = self._finish_full_block(
+            T, state, proposer, slot, body_kwargs, randao_reveal
+        )
+        return block, st, False
+
+    def process_blinded_block(self, signed_blinded):
+        """Unblind + import (publish_blocks.rs blinded flavor): submit to
+        the builder, check the revealed payload against the committed
+        header, substitute it into a FULL block (same root — so the
+        proposer's signature carries over), and run the normal import."""
+        from ..execution.builder import BuilderError, payload_to_header
+        from ..types.state import state_types
+
+        if self.builder is None:
+            raise BlockError("no builder attached")
+        T = state_types(self.preset)
+        try:
+            payload = self.builder.submit_blinded_block(signed_blinded)
+        except BuilderError as e:
+            raise BlockError(f"builder reveal failed: {e}") from e
+        header = signed_blinded.message.body.execution_payload_header
+        if hash_tree_root(payload_to_header(payload, T)) != hash_tree_root(
+            header
+        ):
+            raise BlockError("builder payload does not match committed header")
+        blinded_body = signed_blinded.message.body
+        capella = hasattr(blinded_body, "bls_to_execution_changes")
+        body_kwargs = dict(
+            randao_reveal=blinded_body.randao_reveal,
+            eth1_data=blinded_body.eth1_data,
+            proposer_slashings=list(blinded_body.proposer_slashings),
+            attester_slashings=list(blinded_body.attester_slashings),
+            attestations=list(blinded_body.attestations),
+            deposits=list(blinded_body.deposits),
+            voluntary_exits=list(blinded_body.voluntary_exits),
+            sync_aggregate=blinded_body.sync_aggregate,
+            execution_payload=payload,
+        )
+        if capella:
+            body_kwargs["bls_to_execution_changes"] = list(
+                blinded_body.bls_to_execution_changes
+            )
+            body = T.BeaconBlockBodyCapella(**body_kwargs)
+            block_cls, signed_cls = (
+                T.BeaconBlockCapella, T.SignedBeaconBlockCapella,
+            )
+        else:
+            body = T.BeaconBlockBodyBellatrix(**body_kwargs)
+            block_cls, signed_cls = (
+                T.BeaconBlockBellatrix, T.SignedBeaconBlockBellatrix,
+            )
+        m = signed_blinded.message
+        full = signed_cls(
+            message=block_cls(
+                slot=int(m.slot),
+                proposer_index=int(m.proposer_index),
+                parent_root=bytes(m.parent_root),
+                state_root=bytes(m.state_root),
+                body=body,
+            ),
+            signature=signed_blinded.signature,
+        )
+        if hash_tree_root(full.message) != hash_tree_root(m):
+            raise BlockError("unblinded block root diverged")
+        return self.process_block(full)
 
     def _production_payload(self, state, randao_reveal, capella):
         """getPayload through the engine (execution_layer get_payload)."""

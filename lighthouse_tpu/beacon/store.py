@@ -196,10 +196,13 @@ class _Codec:
     @staticmethod
     def body_fid(body):
         """The single fork-dispatch rule — every layer (store, http,
-        client) derives names/classes from this."""
+        client) derives names/classes from this.  Blinded bodies (payload
+        HEADER in place of the payload) map to the same fork id."""
         if hasattr(body, "bls_to_execution_changes"):
             return 3
-        if hasattr(body, "execution_payload"):
+        if hasattr(body, "execution_payload") or hasattr(
+            body, "execution_payload_header"
+        ):
             return 2
         if hasattr(body, "sync_aggregate"):
             return 1
@@ -221,6 +224,25 @@ class _Codec:
             "capella": T.BeaconBlockCapella,
         }[fork_name]
 
+    def unsigned_blinded_cls(self, fork_name):
+        T = self.T
+        return {
+            "bellatrix": T.BlindedBeaconBlockBellatrix,
+            "capella": T.BlindedBeaconBlockCapella,
+        }[fork_name]
+
+    def signed_cls_for_body(self, body):
+        """Signed container for a (possibly blinded) block body — fork
+        picked by body_fid, the single dispatch rule."""
+        fid = self.body_fid(body)
+        if hasattr(body, "execution_payload_header"):
+            T = self.T
+            return {
+                2: T.SignedBlindedBeaconBlockBellatrix,
+                3: T.SignedBlindedBeaconBlockCapella,
+            }[fid]
+        return self._block_cls[fid]
+
     @staticmethod
     def _state_fid(state):
         if hasattr(state, "next_withdrawal_index"):
@@ -237,6 +259,19 @@ class _Codec:
 
     def dec_block(self, blob):
         return decode(self._block_cls[blob[0]], blob[1:])
+
+    def enc_blinded(self, signed_blinded):
+        fid = self._block_fid(signed_blinded)
+        cls = self.signed_cls_for_body(signed_blinded.message.body)
+        return bytes([fid]) + encode(cls, signed_blinded)
+
+    def dec_blinded(self, blob):
+        T = self.T
+        cls = {
+            2: T.SignedBlindedBeaconBlockBellatrix,
+            3: T.SignedBlindedBeaconBlockCapella,
+        }[blob[0]]
+        return decode(cls, blob[1:])
 
     def enc_state(self, state):
         fid = self._state_fid(state)

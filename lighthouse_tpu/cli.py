@@ -63,6 +63,8 @@ def build_parser_with_subs():
     vc = sub.add_parser("vc", help="validator client")
     _add_common(vc)
     vc.add_argument("--beacon-node", default="http://127.0.0.1:5052")
+    vc.add_argument("--builder-proposals", action="store_true",
+                    help="propose blinded blocks through the BN's builder")
     vc.add_argument("--keystore-dir", default="./validators")
     vc.add_argument("--password", default="")
 
@@ -286,7 +288,9 @@ def _run_vc(args):
         print("no keystores found in", args.keystore_dir, file=sys.stderr)
         return 1
     print(f"vc: {n} validators attached to {args.beacon_node}")
-    vc = ValidatorClient(store, bn, spec)
+    vc = ValidatorClient(
+        store, bn, spec, builder_proposals=args.builder_proposals
+    )
     clock = SystemSlotClock(int(genesis["genesis_time"]), spec.seconds_per_slot)
     last = {"propose": None, "attest": None, "aggregate": None}
     try:

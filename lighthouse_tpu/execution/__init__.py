@@ -3,10 +3,22 @@ engine-API seam (newPayload / forkchoiceUpdated / getPayload) and the
 in-memory mock execution engine used by every beacon-chain test
 (/root/reference/beacon_node/execution_layer/src/test_utils/)."""
 
+from .builder import (
+    BuilderClient,
+    BuilderError,
+    MockBuilder,
+    builder_domain,
+    payload_to_header,
+    verify_bid,
+)
 from .engine import (
     ExecutionEngine,
     MockExecutionEngine,
     PayloadStatus,
 )
 
-__all__ = ["ExecutionEngine", "MockExecutionEngine", "PayloadStatus"]
+__all__ = [
+    "BuilderClient", "BuilderError", "MockBuilder", "builder_domain",
+    "payload_to_header", "verify_bid",
+    "ExecutionEngine", "MockExecutionEngine", "PayloadStatus",
+]

@@ -1,0 +1,159 @@
+"""External block builder (MEV relay) seam + mock builder.
+
+Mirror of the reference's builder path:
+  * /root/reference/consensus/types/src/builder_bid.rs — SignedBuilderBid
+  * /root/reference/beacon_node/execution_layer/src/lib.rs
+    get_payload_header / post_builder_blinded_blocks — the BN-side client
+  * /root/reference/beacon_node/execution_layer/src/test_utils/
+    mock_builder.rs — the in-process builder every test drives
+
+Flow (builder-specs): the BN asks the builder for a header (a bid), the
+proposer signs a BLINDED block over that header (same root as the full
+block — SSZ header/payload root equality), the BN submits the signed
+blinded block back and the builder reveals the full payload, which the
+BN verifies against the committed header before unblinding + importing.
+
+Bids are BLS-signed over the APPLICATION_BUILDER domain
+(compute_domain(0x00000001, genesis_fork_version, ZERO_ROOT)) — chain
+agnostic of gvr by design (application_domain.rs).
+"""
+
+from ..crypto.ref import bls as RB
+from ..crypto.ref.curves import g1_compress, g1_decompress, g2_compress
+from ..ssz import hash_tree_root
+from ..state_processing.signature_sets import SignatureSet, _sig
+from ..types.spec import Domain, compute_domain, compute_signing_root
+from ..types.state import state_types
+
+
+class BuilderError(Exception):
+    pass
+
+
+def builder_domain(spec):
+    return compute_domain(
+        Domain.APPLICATION_BUILDER, spec.genesis_fork_version, bytes(32)
+    )
+
+
+def payload_to_header(payload, T):
+    """ExecutionPayload(Capella) -> its header; roots equal by SSZ."""
+    capella = hasattr(payload, "withdrawals")
+    common = dict(
+        parent_hash=bytes(payload.parent_hash),
+        fee_recipient=bytes(payload.fee_recipient),
+        state_root=bytes(payload.state_root),
+        receipts_root=bytes(payload.receipts_root),
+        logs_bloom=bytes(payload.logs_bloom),
+        prev_randao=bytes(payload.prev_randao),
+        block_number=int(payload.block_number),
+        gas_limit=int(payload.gas_limit),
+        gas_used=int(payload.gas_used),
+        timestamp=int(payload.timestamp),
+        extra_data=bytes(payload.extra_data),
+        base_fee_per_gas=int(payload.base_fee_per_gas),
+        block_hash=bytes(payload.block_hash),
+    )
+    if capella:
+        w_type = dict(T.ExecutionPayloadCapella.fields)["withdrawals"]
+        tx_type = dict(T.ExecutionPayloadCapella.fields)["transactions"]
+        return T.ExecutionPayloadHeaderCapella(
+            **common,
+            transactions_root=hash_tree_root(
+                tx_type, list(payload.transactions)
+            ),
+            withdrawals_root=hash_tree_root(w_type, list(payload.withdrawals)),
+        )
+    tx_type = dict(T.ExecutionPayload.fields)["transactions"]
+    return T.ExecutionPayloadHeader(
+        **common,
+        transactions_root=hash_tree_root(tx_type, list(payload.transactions)),
+    )
+
+
+class BuilderClient:
+    """What the BN needs from a relay (builder_client.rs surface)."""
+
+    def get_header(self, slot, parent_hash, proposer_pubkey):
+        """-> Signed builder bid for the slot, or raise BuilderError."""
+        raise NotImplementedError
+
+    def submit_blinded_block(self, signed_blinded_block):
+        """-> the full ExecutionPayload matching the committed header."""
+        raise NotImplementedError
+
+
+class MockBuilder(BuilderClient):
+    """mock_builder.rs: runs its own payload construction against the
+    node's (mock) execution engine, serves signed bids, and reveals
+    payloads on submission.  `chain` supplies the head state the payload
+    must build on (the real relay tracks the chain itself)."""
+
+    def __init__(self, spec, chain, sk=0x4242424242):
+        self.spec = spec
+        self.chain = chain
+        self.sk = sk
+        self.pubkey = g1_compress(RB.sk_to_pk(sk))
+        self.payloads = {}      # header root -> full payload
+        self.value = 10**9      # wei-denominated bid value (mock constant)
+        self.submissions = 0    # blinded blocks revealed (test observability)
+
+    def get_header(self, slot, parent_hash, proposer_pubkey):
+        from ..state_processing import bellatrix as bx
+        from ..state_processing import phase0
+
+        chain = self.chain
+        preset = chain.preset
+        T = state_types(preset)
+        state = chain.head_state.copy()
+        if int(state.slot) < slot:
+            state = phase0.process_slots(state, slot, preset, spec=self.spec)
+        if bx.production_parent_hash(
+            state, chain.execution_engine
+        ) != bytes(parent_hash):
+            raise BuilderError("unknown parent hash")
+        capella = hasattr(state, "next_withdrawal_index")
+        payload = bx.produce_payload(
+            state, self.spec, chain.execution_engine, capella
+        )
+        header = payload_to_header(payload, T)
+        self.payloads[hash_tree_root(header)] = payload
+        bid_cls = T.BuilderBidCapella if capella else T.BuilderBidBellatrix
+        signed_cls = (
+            T.SignedBuilderBidCapella
+            if capella
+            else T.SignedBuilderBidBellatrix
+        )
+        bid = bid_cls(header=header, value=self.value, pubkey=self.pubkey)
+        root = compute_signing_root(bid, builder_domain(self.spec))
+        return signed_cls(
+            message=bid, signature=g2_compress(RB.sign(self.sk, root))
+        )
+
+    def submit_blinded_block(self, signed_blinded_block):
+        header = signed_blinded_block.message.body.execution_payload_header
+        payload = self.payloads.get(hash_tree_root(header))
+        if payload is None:
+            raise BuilderError("no payload for that header")
+        self.submissions += 1
+        return payload
+
+
+def verify_bid(signed_bid, spec, verifier, parent_hash=None):
+    """BN-side bid gating (execution_layer lib.rs get_payload_header
+    checks): builder signature over APPLICATION_BUILDER, and the header
+    must extend our head payload."""
+    bid = signed_bid.message
+    if parent_hash is not None and bytes(bid.header.parent_hash) != bytes(
+        parent_hash
+    ):
+        raise BuilderError("bid does not build on our head")
+    try:
+        pk = g1_decompress(bytes(bid.pubkey))
+        root = compute_signing_root(bid, builder_domain(spec))
+        s = SignatureSet(_sig(bytes(signed_bid.signature)), [pk], root)
+    except Exception as e:
+        raise BuilderError(f"undecodable bid: {e}") from e
+    if not verifier.verify_signature_sets([s]):
+        raise BuilderError("invalid builder bid signature")
+    return bid

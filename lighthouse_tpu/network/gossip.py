@@ -32,6 +32,13 @@ class GossipKind:
 BAN_THRESHOLD = -100.0
 
 
+def topic_matches(published, subscribed):
+    """Exact topic or subnet-family match: 'beacon_attestation' covers
+    'beacon_attestation_12', but 'beacon_attestation_1' must NOT
+    (digit-ambiguous startswith would)."""
+    return published == subscribed or published.startswith(subscribed + "_")
+
+
 class PeerScore:
     """peerdb/score.rs: additive score with a ban threshold."""
 
@@ -63,16 +70,21 @@ class GossipBus:
 
     def publish(self, from_peer, topic, message):
         """Fan out to every subscriber except the sender; a handler
-        returning False scores the SENDER down (invalid gossip)."""
+        returning False scores the SENDER down (invalid gossip).
+        Prefix-matched like the TCP wire: a "beacon_attestation"
+        subscription receives every "beacon_attestation_{subnet}"."""
         self.delivered += 1
-        for peer_id, handler in list(self.subscribers[topic]):
-            if peer_id == from_peer:
+        for sub_topic, subs in list(self.subscribers.items()):
+            if not topic_matches(topic, sub_topic):
                 continue
-            if self.peers.get(from_peer) and self.peers[from_peer].banned:
-                continue
-            ok = handler(from_peer, message)
-            if ok is False:
-                self.report(from_peer, -10.0)
+            for peer_id, handler in list(subs):
+                if peer_id == from_peer:
+                    continue
+                if self.peers.get(from_peer) and self.peers[from_peer].banned:
+                    continue
+                ok = handler(from_peer, message)
+                if ok is False:
+                    self.report(from_peer, -10.0)
 
     def report(self, peer_id, delta):
         score = self.peers.get(peer_id)

@@ -39,8 +39,22 @@ class Router:
         self.bus.publish(self.peer_id, GossipKind.BEACON_BLOCK, signed_block)
 
     def publish_attestations(self, attestations):
+        """Unaggregated attestations ride their computed subnet topic
+        (subnet_id.rs compute_subnet_for_attestation); subscribers of the
+        plain prefix still receive every subnet."""
+        from ..state_processing.phase0 import compute_subnet_for_attestation
+
+        state = self.chain.head_state
         for att in attestations:
-            self.bus.publish(self.peer_id, GossipKind.ATTESTATION, att)
+            try:
+                subnet = compute_subnet_for_attestation(
+                    state, int(att.data.slot), int(att.data.index),
+                    self.chain.preset,
+                )
+                topic = GossipKind.attestation_subnet(subnet)
+            except Exception:
+                topic = GossipKind.ATTESTATION
+            self.bus.publish(self.peer_id, topic, att)
 
     # ------------------------------------------------------- range sync
 

@@ -439,12 +439,14 @@ class WireNode:
         body = (
             bytes([len(t)]) + t + mid + compressed
         )
+        from .gossip import topic_matches
+
         for peer in list(self.peers.values()):
             if peer is exclude:
                 continue
-            # deliver only to peers subscribed to the topic's prefix
+            # deliver only to peers subscribed to the topic's family
             # (subnet topics announce their prefix subscription)
-            if not any(topic.startswith(s) for s in peer.topics):
+            if not any(topic_matches(topic, s) for s in peer.topics):
                 continue
             try:
                 peer.send_frame(PUBLISH, body)
@@ -472,11 +474,13 @@ class WireNode:
             return
         if not self._mark_seen(mid):
             return   # a concurrent reader won the race
-        # longest prefix wins: "sync_committee_contribution_and_proof"
+        from .gossip import topic_matches
+
+        # longest match wins: "sync_committee_contribution_and_proof"
         # must not fall through to the "sync_committee" subnet handler
         handler = None
         for sub in sorted(self.handlers, key=len, reverse=True):
-            if topic.startswith(sub):
+            if topic_matches(topic, sub):
                 handler = self.handlers[sub]
                 break
         if handler is not None:

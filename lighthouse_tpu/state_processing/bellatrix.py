@@ -118,11 +118,32 @@ def payload_steps(engine):
     process_randao — payload.prev_randao is therefore the PRE-block mix)."""
 
     def hook(state, body, spec):
+        blinded = hasattr(body, "execution_payload_header")
+        payload = (
+            body.execution_payload_header
+            if blinded
+            else body.execution_payload
+        )
         if is_capella_state(state):
-            process_withdrawals(state, body.execution_payload, spec.preset)
+            process_withdrawals(state, payload, spec.preset)
         process_execution_payload(state, body, spec, engine)
 
     return hook
+
+
+def production_parent_hash(state, engine):
+    """The EL block a new payload must build on: the state's last payload
+    hash, or the engine's terminal block for the merge-transition block.
+    Shared by local production and the builder path so bid gating can
+    never disagree with what produce_payload would do."""
+    header_hash = bytes(state.latest_execution_payload_header.block_hash)
+    if header_hash != bytes(32):
+        return header_hash
+    if engine is None or engine.genesis_hash is None:
+        raise phase0.BlockProcessingError(
+            "engine provides no terminal block hash for the transition"
+        )
+    return engine.genesis_hash
 
 
 def produce_payload(state, spec, engine, capella):
@@ -135,25 +156,25 @@ def produce_payload(state, spec, engine, capella):
     preset = spec.preset
     epoch = get_current_epoch(state, preset)
     mix = bytes(get_randao_mix(state, epoch, preset))
-    header_hash = bytes(state.latest_execution_payload_header.block_hash)
-    if header_hash != bytes(32):
-        parent_hash = header_hash
-    else:
-        # merge-transition block: build on the engine's terminal block
-        if engine.genesis_hash is None:
-            raise phase0.BlockProcessingError(
-                "engine provides no terminal block hash for the transition"
-            )
-        parent_hash = engine.genesis_hash
+    parent_hash = production_parent_hash(state, engine)
     timestamp = int(state.genesis_time) + int(state.slot) * spec.seconds_per_slot
     withdrawals = get_expected_withdrawals(state, preset) if capella else None
     return engine.get_payload(parent_hash, timestamp, mix, withdrawals=withdrawals)
 
 
 def process_execution_payload(state, body, spec, engine):
-    """Spec process_execution_payload + the engine notify seam."""
+    """Spec process_execution_payload + the engine notify seam.
+
+    Accepts blinded bodies too (execution_payload_header instead of
+    execution_payload — the reference's AbstractExecPayload dispatch):
+    header fields carry the same checks; transactions/withdrawals roots
+    are taken as-is and the engine is NOT notified (nothing to execute —
+    the builder reveals the payload at unblinding)."""
     preset = spec.preset
-    payload = body.execution_payload
+    blinded = hasattr(body, "execution_payload_header")
+    payload = (
+        body.execution_payload_header if blinded else body.execution_payload
+    )
     header = state.latest_execution_payload_header
     if is_merge_transition_complete(state):
         # the transition block's parent is the terminal EL block, not a
@@ -167,7 +188,7 @@ def process_execution_payload(state, body, spec, engine):
     expected_time = int(state.genesis_time) + int(state.slot) * spec.seconds_per_slot
     assert int(payload.timestamp) == expected_time, "payload timestamp mismatch"
 
-    if engine is not None:
+    if engine is not None and not blinded:
         from ..execution import PayloadStatus
 
         status = engine.notify_new_payload(payload)
@@ -191,14 +212,23 @@ def process_execution_payload(state, body, spec, engine):
         base_fee_per_gas=int(payload.base_fee_per_gas),
         block_hash=bytes(payload.block_hash),
     )
-    tx_type = dict(T.ExecutionPayload.fields)["transactions"]
-    transactions_root = hash_tree_root(tx_type, list(payload.transactions))
+    if blinded:
+        transactions_root = bytes(payload.transactions_root)
+    else:
+        tx_type = dict(T.ExecutionPayload.fields)["transactions"]
+        transactions_root = hash_tree_root(tx_type, list(payload.transactions))
     if is_capella_state(state):
-        w_type = dict(T.ExecutionPayloadCapella.fields)["withdrawals"]
+        if blinded:
+            withdrawals_root = bytes(payload.withdrawals_root)
+        else:
+            w_type = dict(T.ExecutionPayloadCapella.fields)["withdrawals"]
+            withdrawals_root = hash_tree_root(
+                w_type, list(payload.withdrawals)
+            )
         state.latest_execution_payload_header = T.ExecutionPayloadHeaderCapella(
             **common,
             transactions_root=transactions_root,
-            withdrawals_root=hash_tree_root(w_type, list(payload.withdrawals)),
+            withdrawals_root=withdrawals_root,
         )
     else:
         state.latest_execution_payload_header = T.ExecutionPayloadHeader(
@@ -263,12 +293,26 @@ def get_expected_withdrawals(state, preset):
 
 
 def process_withdrawals(state, payload, preset):
+    """Spec process_withdrawals; for a blinded payload HEADER the expected
+    list is checked against its withdrawals_root instead of element-wise
+    (capella.rs process_withdrawals for BlindedPayload)."""
     expected = get_expected_withdrawals(state, preset)
-    got = list(payload.withdrawals)
-    assert len(got) == len(expected), "withdrawal count mismatch"
-    for w, e in zip(got, expected):
-        assert w == e, "withdrawal mismatch"
-        phase0.decrease_balance(state, int(w.validator_index), int(w.amount))
+    if hasattr(payload, "withdrawals_root"):
+        T = state_types(preset)
+        w_type = dict(T.ExecutionPayloadCapella.fields)["withdrawals"]
+        assert bytes(payload.withdrawals_root) == hash_tree_root(
+            w_type, expected
+        ), "withdrawals root mismatch"
+        for e in expected:
+            phase0.decrease_balance(
+                state, int(e.validator_index), int(e.amount)
+            )
+    else:
+        got = list(payload.withdrawals)
+        assert len(got) == len(expected), "withdrawal count mismatch"
+        for w, e in zip(got, expected):
+            assert w == e, "withdrawal mismatch"
+            phase0.decrease_balance(state, int(w.validator_index), int(w.amount))
     if expected:
         state.next_withdrawal_index = int(expected[-1].index) + 1
     n = len(state.validators)

@@ -208,6 +208,21 @@ def get_committee_count_per_slot(state, epoch, preset):
     )
 
 
+ATTESTATION_SUBNET_COUNT = 64
+
+
+def compute_subnet_for_attestation(state, slot, committee_index, preset):
+    """Spec compute_subnet_for_attestation — the gossip subnet an
+    unaggregated attestation belongs on (subnet_id.rs)."""
+    epoch = int(slot) // preset.slots_per_epoch
+    committees_per_slot = get_committee_count_per_slot(state, epoch, preset)
+    slots_since_epoch_start = int(slot) % preset.slots_per_epoch
+    committees_since_epoch_start = committees_per_slot * slots_since_epoch_start
+    return (
+        committees_since_epoch_start + int(committee_index)
+    ) % ATTESTATION_SUBNET_COUNT
+
+
 def get_beacon_committee(state, slot, index, preset):
     """O(1) slice of the per-epoch committee cache (ONE shuffle per epoch —
     the reference's shuffling_cache; round 1 re-shuffled per call)."""

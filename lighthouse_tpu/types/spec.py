@@ -28,6 +28,9 @@ class Domain:
     SYNC_COMMITTEE_SELECTION_PROOF = 8
     CONTRIBUTION_AND_PROOF = 9
     BLS_TO_EXECUTION_CHANGE = 10
+    # builder-specs application domain 0x00000001 (little-endian int form
+    # for Domain.to_bytes; application_domain.rs)
+    APPLICATION_BUILDER = 0x01000000
 
     @staticmethod
     def to_bytes(domain_type: int) -> bytes:

@@ -383,6 +383,24 @@ def state_types(preset):
             )),
         ]
 
+    # Blinded bodies (builder path): the payload HEADER replaces the
+    # payload.  hash_tree_root(header) == hash_tree_root(payload) by SSZ
+    # construction, so the blinded block root — and hence the proposer's
+    # signature — is identical to the full block's
+    # (consensus/types beacon_block_body.rs BlindedPayload).
+    class BeaconBlockBodyBlindedBellatrix(Container):
+        fields = BeaconBlockBodyAltair.fields + [
+            ("execution_payload_header", ExecutionPayloadHeader)
+        ]
+
+    class BeaconBlockBodyBlindedCapella(Container):
+        fields = BeaconBlockBodyAltair.fields + [
+            ("execution_payload_header", ExecutionPayloadHeaderCapella),
+            ("bls_to_execution_changes", List(
+                SignedBLSToExecutionChange, preset.max_bls_to_execution_changes
+            )),
+        ]
+
     class BeaconBlockAltair(Container):
         fields = [
             ("slot", uint64),
@@ -461,6 +479,56 @@ def state_types(preset):
     class SignedBeaconBlockCapella(Container):
         fields = [("message", BeaconBlockCapella), ("signature", Bytes96)]
 
+    class BlindedBeaconBlockBellatrix(Container):
+        fields = [
+            ("slot", uint64),
+            ("proposer_index", uint64),
+            ("parent_root", Bytes32),
+            ("state_root", Bytes32),
+            ("body", BeaconBlockBodyBlindedBellatrix),
+        ]
+
+    class SignedBlindedBeaconBlockBellatrix(Container):
+        fields = [
+            ("message", BlindedBeaconBlockBellatrix), ("signature", Bytes96)
+        ]
+
+    class BlindedBeaconBlockCapella(Container):
+        fields = [
+            ("slot", uint64),
+            ("proposer_index", uint64),
+            ("parent_root", Bytes32),
+            ("state_root", Bytes32),
+            ("body", BeaconBlockBodyBlindedCapella),
+        ]
+
+    class SignedBlindedBeaconBlockCapella(Container):
+        fields = [
+            ("message", BlindedBeaconBlockCapella), ("signature", Bytes96)
+        ]
+
+    # builder_bid.rs: the relay's offer — a payload header plus its value,
+    # signed by the builder's key over the APPLICATION_BUILDER domain
+    class BuilderBidBellatrix(Container):
+        fields = [
+            ("header", ExecutionPayloadHeader),
+            ("value", uint256),
+            ("pubkey", Bytes48),
+        ]
+
+    class SignedBuilderBidBellatrix(Container):
+        fields = [("message", BuilderBidBellatrix), ("signature", Bytes96)]
+
+    class BuilderBidCapella(Container):
+        fields = [
+            ("header", ExecutionPayloadHeaderCapella),
+            ("value", uint256),
+            ("pubkey", Bytes48),
+        ]
+
+    class SignedBuilderBidCapella(Container):
+        fields = [("message", BuilderBidCapella), ("signature", Bytes96)]
+
     _altair_state_fields = BeaconStateAltair.fields
 
     class BeaconStateBellatrix(Container):
@@ -527,6 +595,16 @@ def state_types(preset):
     ns.BeaconBlockBodyCapella = BeaconBlockBodyCapella
     ns.BeaconBlockCapella = BeaconBlockCapella
     ns.SignedBeaconBlockCapella = SignedBeaconBlockCapella
+    ns.BeaconBlockBodyBlindedBellatrix = BeaconBlockBodyBlindedBellatrix
+    ns.BeaconBlockBodyBlindedCapella = BeaconBlockBodyBlindedCapella
+    ns.BlindedBeaconBlockBellatrix = BlindedBeaconBlockBellatrix
+    ns.SignedBlindedBeaconBlockBellatrix = SignedBlindedBeaconBlockBellatrix
+    ns.BlindedBeaconBlockCapella = BlindedBeaconBlockCapella
+    ns.SignedBlindedBeaconBlockCapella = SignedBlindedBeaconBlockCapella
+    ns.BuilderBidBellatrix = BuilderBidBellatrix
+    ns.SignedBuilderBidBellatrix = SignedBuilderBidBellatrix
+    ns.BuilderBidCapella = BuilderBidCapella
+    ns.SignedBuilderBidCapella = SignedBuilderBidCapella
     ns.BeaconStateBellatrix = BeaconStateBellatrix
     ns.BeaconStateCapella = BeaconStateCapella
     return ns

@@ -56,6 +56,13 @@ class BeaconNodeInterface:
     def publish_block(self, signed_block):
         raise NotImplementedError
 
+    def produce_blinded_block(self, slot, randao_reveal):
+        """-> (block, blinded: bool) — False means local fallback."""
+        raise NotImplementedError
+
+    def publish_blinded_block(self, signed_blinded_block):
+        raise NotImplementedError
+
     def publish_attestations(self, attestations):
         raise NotImplementedError
 
@@ -203,6 +210,16 @@ class DirectBeaconNode(BeaconNodeInterface):
     def publish_block(self, signed_block):
         self.chain.on_tick(int(signed_block.message.slot))
         return self.chain.process_block(signed_block)
+
+    def produce_blinded_block(self, slot, randao_reveal):
+        block, _, blinded = self.chain.produce_blinded_block_on_state(
+            slot, randao_reveal
+        )
+        return block, blinded
+
+    def publish_blinded_block(self, signed_blinded_block):
+        self.chain.on_tick(int(signed_blinded_block.message.slot))
+        return self.chain.process_blinded_block(signed_blinded_block)
 
     def publish_attestations(self, attestations):
         return self.chain.batch_verify_unaggregated_attestations(attestations)
@@ -375,6 +392,24 @@ class HttpBeaconNode(BeaconNodeInterface):
         )
         return bytes.fromhex(out["root"][2:])
 
+    def produce_blinded_block(self, slot, randao_reveal):
+        from ..ssz import decode
+
+        resp = self.api.produce_blinded_block_ssz(slot, randao_reveal)
+        blinded = bool(resp.get("blinded", True))
+        cls = (
+            self.codec.unsigned_blinded_cls(resp["version"])
+            if blinded
+            else self.codec.unsigned_block_cls(resp["version"])
+        )
+        return decode(cls, bytes.fromhex(resp["data"]["ssz"][2:])), blinded
+
+    def publish_blinded_block(self, signed_blinded_block):
+        out = self.api.publish_blinded_block_ssz(
+            "0x" + self.codec.enc_blinded(signed_blinded_block).hex()
+        )
+        return bytes.fromhex(out["root"][2:])
+
     def publish_attestations(self, attestations):
         from ..ssz import encode
 
@@ -484,6 +519,12 @@ class BeaconNodeFallback(BeaconNodeInterface):
     def publish_block(self, signed_block):
         return self._try("publish_block", signed_block)
 
+    def produce_blinded_block(self, slot, randao_reveal):
+        return self._try("produce_blinded_block", slot, randao_reveal)
+
+    def publish_blinded_block(self, signed_blinded_block):
+        return self._try("publish_blinded_block", signed_blinded_block)
+
     def publish_attestations(self, attestations):
         return self._try("publish_attestations", attestations)
 
@@ -514,12 +555,21 @@ class ValidatorClient:
     duties at a time — proposals first, then attestations (the simulator
     calls `act_on_slot` per tick; production wraps it in a clocked loop)."""
 
-    def __init__(self, store, beacon_node, spec):
+    def __init__(self, store, beacon_node, spec, builder_proposals=False):
         self.store = store
         self.bn = beacon_node
         self.spec = spec
         self.preset = spec.preset
+        self.builder_proposals = builder_proposals   # --builder-proposals
         self._duties_cache = {}   # epoch -> duties
+
+    def _signed_cls_for(self, block):
+        """The signed container matching a produced (possibly blinded)
+        block's fork — delegated to the store codec's single
+        fork-dispatch rule."""
+        from ..beacon.store import _Codec
+
+        return _Codec(self.preset).signed_cls_for_body(block.body)
 
     def _duties(self, epoch):
         if epoch not in self._duties_cache:
@@ -554,17 +604,21 @@ class ValidatorClient:
                 reveal = self.store.sign_randao_reveal(
                     duty["pubkey"], epoch, fork, gvr
                 )
-                block = self.bn.produce_block(slot, reveal)
+                blinded = False
+                if self.builder_proposals:
+                    block, blinded = self.bn.produce_blinded_block(
+                        slot, reveal
+                    )
+                else:
+                    block = self.bn.produce_block(slot, reveal)
                 sig = self.store.sign_block(duty["pubkey"], block, fork, gvr)
-                T = state_types(self.preset)
-                signed_cls = (
-                    T.SignedBeaconBlockAltair
-                    if hasattr(block.body, "sync_aggregate")
-                    else T.SignedBeaconBlock
+                signed = self._signed_cls_for(block)(
+                    message=block, signature=sig
                 )
-                root = self.bn.publish_block(
-                    signed_cls(message=block, signature=sig)
-                )
+                if blinded:
+                    root = self.bn.publish_blinded_block(signed)
+                else:
+                    root = self.bn.publish_block(signed)
                 out["proposed"].append((slot, root))
             except NotSafe as e:
                 log.warning("refusing to propose at %s: %s", slot, e)

@@ -1,0 +1,163 @@
+"""Builder (MEV relay) path: bids, blinded production, unblinding,
+fallback (builder_bid.rs, execution_layer mock_builder.rs, the VC's
+--builder-proposals flow)."""
+
+import pytest
+
+from lighthouse_tpu.beacon.chain import BeaconChain
+from lighthouse_tpu.crypto.backend import SignatureVerifier
+from lighthouse_tpu.execution import (
+    BuilderError,
+    MockBuilder,
+    MockExecutionEngine,
+    payload_to_header,
+    verify_bid,
+)
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.testing.harness import Harness
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+from lighthouse_tpu.types.state import state_types
+from lighthouse_tpu.validator_client.client import (
+    DirectBeaconNode,
+    ValidatorClient,
+)
+from lighthouse_tpu.validator_client.validator_store import ValidatorStore
+
+CAPELLA = ChainSpec(
+    preset=MinimalPreset,
+    altair_fork_epoch=0,
+    bellatrix_fork_epoch=0,
+    capella_fork_epoch=0,
+)
+T = state_types(MinimalPreset)
+
+
+def _chain_with_builder(verifier="fake"):
+    h = Harness(8, CAPELLA)
+    engine = MockExecutionEngine(T, capella=True)
+    chain = BeaconChain(
+        h.state.copy(), CAPELLA,
+        verifier=SignatureVerifier(verifier),
+        execution_engine=engine,
+    )
+    builder = MockBuilder(CAPELLA, chain)
+    chain.attach_builder(builder)
+    return h, chain, builder
+
+
+def test_payload_header_root_equality():
+    """The blinded trick: header and payload share a hash_tree_root, so
+    a blinded block's root (and signature) equals the full block's."""
+    h, chain, builder = _chain_with_builder()
+    block, _ = chain.produce_block_on_state(1)
+    payload = block.body.execution_payload
+    header = payload_to_header(payload, T)
+    assert hash_tree_root(
+        T.ExecutionPayloadCapella, payload
+    ) == hash_tree_root(T.ExecutionPayloadHeaderCapella, header)
+
+
+def test_blinded_proposal_end_to_end():
+    """VC with builder_proposals: blinded produce -> sign -> unblind via
+    the builder -> import; the canonical block carries the builder's
+    payload."""
+    h, chain, builder = _chain_with_builder()
+    store = ValidatorStore(CAPELLA)
+    for i in range(8):
+        store.add_validator(h.keypairs[i][0])
+    vc = ValidatorClient(
+        store, DirectBeaconNode(chain), CAPELLA, builder_proposals=True
+    )
+    chain.on_tick(1)
+    out = vc.act_on_slot(1, phase="propose")
+    assert out["proposed"], "the blinded proposal imported"
+    assert int(chain.head_state.slot) == 1
+    imported = chain.store.get_block(chain.head_root)
+    assert hasattr(imported.message.body, "execution_payload"), "full block stored"
+    # the builder actually revealed (this was NOT the local fallback)
+    assert builder.submissions == 1, "builder unblinded exactly one block"
+    revealed = list(builder.payloads.values())[0]
+    assert bytes(
+        imported.message.body.execution_payload.block_hash
+    ) == bytes(revealed.block_hash)
+
+
+def test_builder_failure_falls_back_to_local():
+    h, chain, builder = _chain_with_builder()
+
+    def broken(*a, **k):
+        raise BuilderError("relay down")
+
+    builder.get_header = broken
+    chain.on_tick(1)
+    block, _, blinded = chain.produce_blinded_block_on_state(1)
+    assert blinded is False
+    assert hasattr(block.body, "execution_payload"), "local full block"
+
+
+def test_bad_bid_signature_falls_back(monkeypatch):
+    """A bid signed by the wrong key fails verify_bid (oracle) and local
+    production takes over."""
+    h, chain, builder = _chain_with_builder(verifier="oracle")
+    builder.sk = 0x1234    # key no longer matches builder.pubkey
+    chain.on_tick(1)
+    block, _, blinded = chain.produce_blinded_block_on_state(1)
+    assert blinded is False
+    assert hasattr(block.body, "execution_payload")
+
+
+def test_bid_verification_rules():
+    from lighthouse_tpu.state_processing.bellatrix import production_parent_hash
+
+    h, chain, builder = _chain_with_builder(verifier="oracle")
+    parent_hash = production_parent_hash(
+        chain.head_state, chain.execution_engine
+    )
+    bid = builder.get_header(1, parent_hash, b"\xaa" * 48)
+    assert verify_bid(bid, CAPELLA, chain.verifier, parent_hash)
+    with pytest.raises(BuilderError, match="head"):
+        verify_bid(bid, CAPELLA, chain.verifier, b"\x55" * 32)
+
+
+def test_unblinding_rejects_substituted_payload():
+    """A builder revealing a payload that doesn't match the committed
+    header is caught before import."""
+    h, chain, builder = _chain_with_builder()
+    chain.on_tick(1)
+    block, _, blinded = chain.produce_blinded_block_on_state(1)
+    assert blinded
+    sig_cls = T.SignedBlindedBeaconBlockCapella
+    signed = sig_cls(message=block, signature=b"\xc0" + bytes(95))
+
+    other = list(builder.payloads.values())[0]
+    import copy
+
+    tampered = copy.deepcopy(other)
+    tampered.block_number = int(other.block_number) + 1
+    builder.payloads = {k: tampered for k in builder.payloads}
+    from lighthouse_tpu.beacon.chain import BlockError
+
+    with pytest.raises(BlockError, match="committed header"):
+        chain.process_blinded_block(signed)
+
+
+def test_blinded_proposal_over_http():
+    from lighthouse_tpu.api.client import BeaconApiClient
+    from lighthouse_tpu.api.http_api import BeaconApiServer
+    from lighthouse_tpu.validator_client.client import HttpBeaconNode
+
+    h, chain, builder = _chain_with_builder()
+    server = BeaconApiServer(chain).start()
+    try:
+        api = BeaconApiClient(f"http://127.0.0.1:{server.port}", timeout=60.0)
+        bn = HttpBeaconNode(api, CAPELLA.preset).set_spec(CAPELLA)
+        store = ValidatorStore(CAPELLA)
+        for i in range(8):
+            store.add_validator(h.keypairs[i][0])
+        vc = ValidatorClient(store, bn, CAPELLA, builder_proposals=True)
+        chain.on_tick(1)
+        out = vc.act_on_slot(1, phase="propose")
+        assert out["proposed"], "blinded proposal over the Beacon API"
+        assert int(chain.head_state.slot) == 1
+    finally:
+        server.stop()

@@ -285,6 +285,36 @@ def test_block_gossip_moves_remote_head():
         n_follow.stop()
 
 
+def test_attestation_gossip_rides_subnet_topics():
+    """Unaggregated attestations publish on beacon_attestation_{subnet}
+    (compute_subnet_for_attestation); both exact-subnet and prefix
+    subscribers receive them."""
+    from lighthouse_tpu.beacon.beacon_processor import BeaconProcessor
+    from lighthouse_tpu.network.gossip import GossipBus, ReqResp
+    from lighthouse_tpu.network.router import Router
+    from lighthouse_tpu.state_processing.phase0 import (
+        ATTESTATION_SUBNET_COUNT,
+        compute_subnet_for_attestation,
+    )
+
+    h, chain = _make_chain(1)
+    atts = h.attest_slot(h.state, 1, chain.head_root)
+    subnet = compute_subnet_for_attestation(
+        chain.head_state, 1, int(atts[0].data.index), chain.preset
+    )
+    assert 0 <= subnet < ATTESTATION_SUBNET_COUNT
+
+    bus, rr = GossipBus(), ReqResp()
+    exact, prefix = [], []
+    bus.subscribe("n2", GossipKind.attestation_subnet(subnet),
+                  lambda p, m: exact.append(m))
+    bus.subscribe("n3", GossipKind.ATTESTATION, lambda p, m: prefix.append(m))
+    router = Router("n1", chain, BeaconProcessor(chain), bus, rr)
+    router.publish_attestations(atts[:1])
+    assert len(exact) == 1, "exact-subnet subscriber got the attestation"
+    assert len(prefix) == 1, "prefix subscriber got it too"
+
+
 def test_goodbye_disconnects():
     _, c1 = _make_chain(0)
     _, c2 = _make_chain(0)
