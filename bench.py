@@ -154,6 +154,64 @@ def config5():
          seconds=round(dt, 3), slots_per_sec=round(slots / dt, 2))
 
 
+def config_kernels():
+    """mont_mul candidate shoot-out: f32-HIGHEST GEMM vs int32 einsum vs
+    the fused Pallas kernel, one jit each on a wide batch — so a single
+    bench run on real hardware picks the winner (ROUND2_NOTES item 2)."""
+    import numpy as np
+
+    from lighthouse_tpu.crypto.tpu import fp
+
+    B = int(os.environ.get("BENCH_KERNEL_BATCH", "4096"))
+    iters = int(os.environ.get("BENCH_KERNEL_ITERS", "20"))
+    rng = np.random.default_rng(3)
+    # random fully-reduced field elements (host ints -> limbs)
+    a_ints = [int.from_bytes(rng.bytes(47), "little") for _ in range(B)]
+    b_ints = [int.from_bytes(rng.bytes(47), "little") for _ in range(B)]
+    a = jax.numpy.asarray(fp.ints_to_array(a_ints))
+    b = jax.numpy.asarray(fp.ints_to_array(b_ints))
+    r_inv = pow(fp.R_INT, -1, fp.P)
+    expect0 = (a_ints[0] * b_ints[0] * r_inv) % fp.P
+
+    out = {}
+
+    def run(name, make_fn):
+        try:
+            f = jax.jit(make_fn())
+            res = f(a, b)
+            res.block_until_ready()
+            got0 = fp.limbs_to_int(np.asarray(res[:, 0]))
+            ok = got0 == expect0
+            t0 = time.time()
+            for _ in range(iters):
+                res = f(a, b)
+            res.block_until_ready()
+            dt = (time.time() - t0) / iters
+            out[name] = {
+                "exact": bool(ok),
+                "mont_muls_per_sec": round(B / dt, 1),
+            }
+        except Exception as e:  # a candidate failing must not kill bench
+            out[name] = {"error": str(e)[:200]}
+
+    old = fp._mul_cols
+    try:
+        fp._mul_cols = fp._mul_cols_f32
+        run("f32_highest", lambda: lambda x, y: fp.mont_mul(x, y))
+        fp._mul_cols = fp._mul_cols_int32
+        run("int32_einsum", lambda: lambda x, y: fp.mont_mul(x, y))
+    finally:
+        fp._mul_cols = old
+
+    def pallas_fn():
+        from lighthouse_tpu.crypto.tpu import pallas_fp
+
+        return lambda x, y: pallas_fp.mont_mul_pallas(x, y)
+
+    run("pallas_fused", pallas_fn)
+    note("kernel_candidates", batch=B, **out)
+
+
 def main():
     primary = None
     # config 2 first: the guaranteed-green primary (round-1 shape)
@@ -163,7 +221,7 @@ def main():
         print(json.dumps({"error": f"config2: {e}"}))
         sys.exit(1)
 
-    for fn in (config3, config1, config4, config5):
+    for fn in (config3, config1, config4, config5, config_kernels):
         if _left() < 120:
             note("skipped_remaining", reason="budget", left_s=round(_left(), 1))
             break

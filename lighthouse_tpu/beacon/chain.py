@@ -1152,7 +1152,7 @@ class BeaconChain:
 
     # ------------------------------------------------------- production
 
-    def _production_parts(self, slot, randao_reveal):
+    def _production_parts(self, slot, randao_reveal, graffiti=None):
         """Shared production scaffolding: advanced state, proposer, and
         the payload-less body kwargs (op-pool packing)."""
         from ..types.state import state_types
@@ -1175,6 +1175,8 @@ class BeaconChain:
             attester_slashings=att_slashings,
             voluntary_exits=exits,
         )
+        if graffiti is not None:
+            body_kwargs["graffiti"] = bytes(graffiti).ljust(32, b"\x00")[:32]
         capella = hasattr(state, "next_withdrawal_index")
         if altair:
             # sync messages created at slot-1 voted for this block's parent;
@@ -1244,17 +1246,19 @@ class BeaconChain:
             T, state, proposer, slot, body, block_cls, signed_cls
         )
 
-    def produce_block_on_state(self, slot, randao_reveal=b"\x00" * 96):
+    def produce_block_on_state(self, slot, randao_reveal=b"\x00" * 96,
+                               graffiti=None):
         """beacon_chain.rs:4204 produce_block_on_state: op-pool packing over
         the head state (unsigned; the VC signs)."""
         T, state, proposer, body_kwargs = self._production_parts(
-            slot, randao_reveal
+            slot, randao_reveal, graffiti
         )
         return self._finish_full_block(
             T, state, proposer, slot, body_kwargs, randao_reveal
         )
 
-    def produce_blinded_block_on_state(self, slot, randao_reveal=b"\x00" * 96):
+    def produce_blinded_block_on_state(self, slot, randao_reveal=b"\x00" * 96,
+                                       graffiti=None):
         """Builder-path production (beacon_chain.rs get_payload
         BlindedPayload flavor): ask the attached builder for a header,
         gate the bid, and assemble a BLINDED block over it.  ANY builder
@@ -1266,7 +1270,7 @@ class BeaconChain:
         from ..state_processing.bellatrix import production_parent_hash
 
         T, state, proposer, body_kwargs = self._production_parts(
-            slot, randao_reveal
+            slot, randao_reveal, graffiti
         )
         bellatrix = hasattr(state, "latest_execution_payload_header")
         capella = hasattr(state, "next_withdrawal_index")
@@ -1329,21 +1333,15 @@ class BeaconChain:
             raise BlockError("builder payload does not match committed header")
         blinded_body = signed_blinded.message.body
         capella = hasattr(blinded_body, "bls_to_execution_changes")
-        body_kwargs = dict(
-            randao_reveal=blinded_body.randao_reveal,
-            eth1_data=blinded_body.eth1_data,
-            proposer_slashings=list(blinded_body.proposer_slashings),
-            attester_slashings=list(blinded_body.attester_slashings),
-            attestations=list(blinded_body.attestations),
-            deposits=list(blinded_body.deposits),
-            voluntary_exits=list(blinded_body.voluntary_exits),
-            sync_aggregate=blinded_body.sync_aggregate,
-            execution_payload=payload,
-        )
+        # field-driven copy: EVERY body field carries over (graffiti
+        # included) — only the header is swapped for the revealed payload
+        body_kwargs = {
+            name: getattr(blinded_body, name)
+            for name, _ in type(blinded_body).fields
+            if name != "execution_payload_header"
+        }
+        body_kwargs["execution_payload"] = payload
         if capella:
-            body_kwargs["bls_to_execution_changes"] = list(
-                blinded_body.bls_to_execution_changes
-            )
             body = T.BeaconBlockBodyCapella(**body_kwargs)
             block_cls, signed_cls = (
                 T.BeaconBlockCapella, T.SignedBeaconBlockCapella,

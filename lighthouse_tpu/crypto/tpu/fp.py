@@ -149,7 +149,7 @@ def _diag_mat():
 _DIAG_MAT = _diag_mat()
 
 
-def _mul_cols(a, b, n_out=2 * NLIMB):
+def _mul_cols_f32(a, b, n_out=2 * NLIMB):
     """Column sums of the schoolbook product a*b — one f32 GEMM.
 
     a, b: (NLIMB, *batch) with 8-bit limbs.  Products (< 2^16) and column
@@ -174,6 +174,43 @@ def _mul_cols(a, b, n_out=2 * NLIMB):
         precision=lax.Precision.HIGHEST,
     )
     return cols.astype(U32)
+
+
+_DIAG_MAT_I32 = None
+
+
+def _mul_cols_int32(a, b, n_out=2 * NLIMB):
+    """Integer-dot candidate for the same column sums: products and sums
+    stay < 2^23, exact in int32 by construction.  Whether XLA lowers the
+    integer contraction onto the MXU (and beats the 6-pass f32 HIGHEST
+    emulation) is a measurement, not a given — bench.py's
+    kernel-candidates section answers it per backend."""
+    global _DIAG_MAT_I32
+    if _DIAG_MAT_I32 is None:
+        _DIAG_MAT_I32 = _DIAG_MAT.astype(np.int32)
+    bshape = _bshape(a, b)
+    ai = a.astype(jnp.int32)
+    bi = b.astype(jnp.int32)
+    prods = (ai[:, None] * bi[None, :]).reshape((NLIMB * NLIMB,) + bshape)
+    cols = jnp.einsum(
+        "ks,s...->k...",
+        jnp.asarray(_DIAG_MAT_I32[:n_out]),
+        prods,
+        preferred_element_type=jnp.int32,
+    )
+    return cols.astype(U32)
+
+
+# the active column-sum implementation: LTPU_MULCOLS=int32 switches the
+# whole kernel stack (towers/curves/pairing all flow through mont_mul);
+# the differential test suite passes under either setting.
+import os as _os
+
+_mul_cols = (
+    _mul_cols_int32
+    if _os.environ.get("LTPU_MULCOLS") == "int32"
+    else _mul_cols_f32
+)
 
 
 def _add_limbs(a, b):

@@ -36,39 +36,9 @@ def builder_domain(spec):
     )
 
 
-def payload_to_header(payload, T):
-    """ExecutionPayload(Capella) -> its header; roots equal by SSZ."""
-    capella = hasattr(payload, "withdrawals")
-    common = dict(
-        parent_hash=bytes(payload.parent_hash),
-        fee_recipient=bytes(payload.fee_recipient),
-        state_root=bytes(payload.state_root),
-        receipts_root=bytes(payload.receipts_root),
-        logs_bloom=bytes(payload.logs_bloom),
-        prev_randao=bytes(payload.prev_randao),
-        block_number=int(payload.block_number),
-        gas_limit=int(payload.gas_limit),
-        gas_used=int(payload.gas_used),
-        timestamp=int(payload.timestamp),
-        extra_data=bytes(payload.extra_data),
-        base_fee_per_gas=int(payload.base_fee_per_gas),
-        block_hash=bytes(payload.block_hash),
-    )
-    if capella:
-        w_type = dict(T.ExecutionPayloadCapella.fields)["withdrawals"]
-        tx_type = dict(T.ExecutionPayloadCapella.fields)["transactions"]
-        return T.ExecutionPayloadHeaderCapella(
-            **common,
-            transactions_root=hash_tree_root(
-                tx_type, list(payload.transactions)
-            ),
-            withdrawals_root=hash_tree_root(w_type, list(payload.withdrawals)),
-        )
-    tx_type = dict(T.ExecutionPayload.fields)["transactions"]
-    return T.ExecutionPayloadHeader(
-        **common,
-        transactions_root=hash_tree_root(tx_type, list(payload.transactions)),
-    )
+# THE payload->header mapping lives beside the STF; re-exported here for
+# the builder-facing API surface
+from ..state_processing.bellatrix import payload_to_header  # noqa: F401,E402
 
 
 class BuilderClient:

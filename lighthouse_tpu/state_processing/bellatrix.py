@@ -131,6 +131,29 @@ def payload_steps(engine):
     return hook
 
 
+def payload_to_header(payload, T):
+    """ExecutionPayload(Capella) -> its header (equal hash_tree_root by
+    SSZ construction).  Field-driven: a future fork's extra fields flow
+    through automatically.  THE one payload->header mapping — the STF and
+    the builder's unblinding gate both use it."""
+    capella = hasattr(payload, "withdrawals")
+    src = T.ExecutionPayloadCapella if capella else T.ExecutionPayload
+    hdr_cls = (
+        T.ExecutionPayloadHeaderCapella if capella else T.ExecutionPayloadHeader
+    )
+    kwargs = {}
+    for name, _typ in hdr_cls.fields:
+        if name == "transactions_root":
+            tx_type = dict(src.fields)["transactions"]
+            kwargs[name] = hash_tree_root(tx_type, list(payload.transactions))
+        elif name == "withdrawals_root":
+            w_type = dict(src.fields)["withdrawals"]
+            kwargs[name] = hash_tree_root(w_type, list(payload.withdrawals))
+        else:
+            kwargs[name] = getattr(payload, name)
+    return hdr_cls(**kwargs)
+
+
 def production_parent_hash(state, engine):
     """The EL block a new payload must build on: the state's last payload
     hash, or the engine's terminal block for the merge-transition block.
@@ -197,43 +220,19 @@ def process_execution_payload(state, body, spec, engine):
         # SYNCING -> optimistic import (handled a layer up)
 
     T = state_types(preset)
-    common = dict(
-        parent_hash=bytes(payload.parent_hash),
-        fee_recipient=bytes(payload.fee_recipient),
-        state_root=bytes(payload.state_root),
-        receipts_root=bytes(payload.receipts_root),
-        logs_bloom=bytes(payload.logs_bloom),
-        prev_randao=bytes(payload.prev_randao),
-        block_number=int(payload.block_number),
-        gas_limit=int(payload.gas_limit),
-        gas_used=int(payload.gas_used),
-        timestamp=int(payload.timestamp),
-        extra_data=bytes(payload.extra_data),
-        base_fee_per_gas=int(payload.base_fee_per_gas),
-        block_hash=bytes(payload.block_hash),
-    )
     if blinded:
-        transactions_root = bytes(payload.transactions_root)
-    else:
-        tx_type = dict(T.ExecutionPayload.fields)["transactions"]
-        transactions_root = hash_tree_root(tx_type, list(payload.transactions))
-    if is_capella_state(state):
-        if blinded:
-            withdrawals_root = bytes(payload.withdrawals_root)
-        else:
-            w_type = dict(T.ExecutionPayloadCapella.fields)["withdrawals"]
-            withdrawals_root = hash_tree_root(
-                w_type, list(payload.withdrawals)
-            )
-        state.latest_execution_payload_header = T.ExecutionPayloadHeaderCapella(
-            **common,
-            transactions_root=transactions_root,
-            withdrawals_root=withdrawals_root,
+        # the committed header becomes the state's latest header verbatim
+        # (fresh instance: stored states must not alias the block body)
+        cls = (
+            T.ExecutionPayloadHeaderCapella
+            if is_capella_state(state)
+            else T.ExecutionPayloadHeader
+        )
+        state.latest_execution_payload_header = cls(
+            **{name: getattr(payload, name) for name, _ in cls.fields}
         )
     else:
-        state.latest_execution_payload_header = T.ExecutionPayloadHeader(
-            **common, transactions_root=transactions_root
-        )
+        state.latest_execution_payload_header = payload_to_header(payload, T)
 
 
 # --------------------------------------------------------------- capella

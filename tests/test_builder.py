@@ -119,6 +119,36 @@ def test_bid_verification_rules():
         verify_bid(bid, CAPELLA, chain.verifier, b"\x55" * 32)
 
 
+def test_unblinding_preserves_graffiti():
+    """Every body field survives the blinded->full reconstruction —
+    graffiti is the canary (it is not STF-processed, so only the root
+    comparison catches a dropped field)."""
+    from lighthouse_tpu.state_processing import phase0
+
+    h, chain, builder = _chain_with_builder()
+    chain.on_tick(1)
+    st = chain.head_state
+    adv = st.copy()
+    adv = phase0.process_slots(adv, 1, CAPELLA.preset, spec=CAPELLA)
+    proposer = phase0.get_beacon_proposer_index(adv, CAPELLA.preset)
+    store = ValidatorStore(CAPELLA)
+    pk = store.add_validator(h.keypairs[proposer][0])
+    fork, gvr = st.fork, bytes(st.genesis_validators_root)
+    reveal = store.sign_randao_reveal(pk, 0, fork, gvr)
+
+    block, _, blinded = chain.produce_blinded_block_on_state(
+        1, reveal, graffiti=b"lighthouse-tpu graffiti test"
+    )
+    assert blinded
+    sig = store.sign_block(pk, block, fork, gvr)
+    signed = T.SignedBlindedBeaconBlockCapella(message=block, signature=sig)
+    root = chain.process_blinded_block(signed)
+    imported = chain.store.get_block(root)
+    assert bytes(imported.message.body.graffiti).startswith(
+        b"lighthouse-tpu"
+    )
+
+
 def test_unblinding_rejects_substituted_payload():
     """A builder revealing a payload that doesn't match the committed
     header is caught before import."""
