@@ -417,6 +417,10 @@ class BeaconChain:
             int(block.slot),
             finalized_header,
         )
+        # node wiring can gossip the fresh updates onward
+        cb = getattr(self, "on_light_client_update", None)
+        if cb is not None:
+            cb(self.light_client_server)
 
     def process_chain_segment(self, blocks):
         """beacon_chain.rs:2507 process_chain_segment +

@@ -40,7 +40,7 @@ class BeaconNode:
         self.executor.spawn(self._timer_loop, "slot_timer")
         self.executor.spawn(self.processor.run, "beacon_processor")
         self.executor.spawn(self._notifier_loop, "notifier", critical=False)
-        if self.wire is not None and self._dial:
+        if self.wire is not None:
             self.executor.spawn(self._dial_loop, "dialer", critical=False)
         return self
 
@@ -96,6 +96,16 @@ class BeaconNode:
                     log.warning("initial sync from %s failed: %s", pid, e)
             pending = still
             if pending and executor.sleep_or_shutdown(1.0):
+                break
+        # then keep meshing through peer exchange PERIODICALLY — addresses
+        # learned after startup (late joiners) must get dialed too
+        while not executor.shutting_down:
+            try:
+                for pid in self.wire.discover():
+                    log.info("discovered peer %s", pid)
+            except Exception as e:
+                log.debug("discovery pass failed: %s", e)
+            if executor.sleep_or_shutdown(15.0):
                 break
 
     def _notifier_loop(self, executor):
@@ -204,6 +214,25 @@ class ClientBuilder:
             if api_server is not None:
                 # API block publishes gossip onward (publish_blocks.rs)
                 api_server.router = router
+
+            def _publish_light_client(server, _wire=wire):
+                # gossip the light_client_{finality,optimistic}_update
+                # topics (types/topics.rs); the seen-cache dedups repeats
+                try:
+                    if server.latest_optimistic_update is not None:
+                        _wire.publish(
+                            "light_client_optimistic_update",
+                            server.latest_optimistic_update,
+                        )
+                    if server.latest_finality_update is not None:
+                        _wire.publish(
+                            "light_client_finality_update",
+                            server.latest_finality_update,
+                        )
+                except Exception as e:
+                    log.debug("light-client gossip failed: %s", e)
+
+            chain.on_light_client_update = _publish_light_client
         return BeaconNode(
             chain, processor, api_server, clock, TaskExecutor(),
             wire=wire, router=router, dial=self._dial,

@@ -56,8 +56,14 @@ class LightClientError(Exception):
 # ------------------------------------------------------------------ types
 
 
+from functools import lru_cache
+
+
+@lru_cache(maxsize=None)
 def light_client_types(preset):
-    """Per-preset light-client containers (sync-committee size bound)."""
+    """Per-preset light-client containers (sync-committee size bound).
+    Memoized like state_types: callers across modules must share ONE
+    class identity per preset (isinstance, jit caches)."""
     from .types.state import state_types
 
     T = state_types(preset)

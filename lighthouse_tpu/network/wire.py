@@ -49,6 +49,7 @@ RESPONSE = 6
 GOODBYE_FRAME = 7
 PING = 8
 PONG = 9
+PEERS = 10     # peer exchange: "host:port" listen addresses, \n-joined
 
 # req/resp methods (rpc/protocol.rs Protocol enum)
 M_STATUS = 0
@@ -142,13 +143,18 @@ class GossipCodec:
         )
         from ..types.state import state_types
 
+        from ..light_client import light_client_types
+
         T = state_types(preset)
+        LT = light_client_types(preset)
         self._block_codec = _Codec(preset)
         self._by_prefix = [
             # longest prefixes first: beacon_attestation_{subnet} etc.
             (GossipKind.AGGREGATE_AND_PROOF, SignedAggregateAndProof),
             ("sync_committee_contribution_and_proof",
              T.SignedContributionAndProof),
+            ("light_client_finality_update", LT.LightClientFinalityUpdate),
+            ("light_client_optimistic_update", LT.LightClientOptimisticUpdate),
             (GossipKind.ATTESTATION, T.Attestation),
             (GossipKind.SYNC_COMMITTEE, SyncCommitteeMessage),
             (GossipKind.VOLUNTARY_EXIT, SignedVoluntaryExit),
@@ -173,6 +179,19 @@ class GossipCodec:
         raise WireError(f"no codec for topic {topic}")
 
 
+def _addrs_to_bytes(addrs):
+    return "\n".join(f"{h}:{p}" for h, p in addrs).encode()
+
+
+def _bytes_to_addrs(blob):
+    out = []
+    for line in blob.decode().splitlines():
+        host, _, port = line.rpartition(":")
+        if host and port.isdigit():
+            out.append((host, int(port)))
+    return out
+
+
 class _Peer:
     """One live connection: writer lock + reader thread + score."""
 
@@ -187,6 +206,7 @@ class _Peer:
         self.addr = addr
         self.peer_id = None          # learned from HELLO
         self.sent_hello = False      # did WE already send our HELLO?
+        self.listen_addr = None      # remote's announced (host, port)
         self.topics = set()          # topics the REMOTE subscribed to
         self.score = PeerScore()
         self.status = None           # remote StatusMessage
@@ -228,6 +248,7 @@ class WireNode:
         self.metadata_seq = 1
         self.handlers = {}             # topic -> handler(from_peer, obj)
         self.peers = {}                # peer_id -> _Peer
+        self.known_addrs = set()       # peer-exchanged listen addresses
         self.banned_ids = set()
         self._seen = OrderedDict()     # message id -> None (gossip dedup)
         self._seen_lock = threading.Lock()
@@ -275,8 +296,13 @@ class WireNode:
 
     def _hello_body(self):
         pid = self.peer_id.encode()
-        return bytes([len(pid)]) + pid + encode(
-            StatusMessage, self.local_status()
+        return (
+            bytes([len(pid)])
+            + pid
+            + encode(StatusMessage, self.local_status())
+            # announced listen port (connections come from ephemeral
+            # ports, so peer exchange needs the dialable one)
+            + struct.pack("<H", self.port)
         )
 
     # ------------------------------------------------------- connections
@@ -324,7 +350,10 @@ class WireNode:
     def _register_peer(self, peer, hello_body):
         n = hello_body[0]
         peer_id = hello_body[1 : 1 + n].decode()
-        status = decode(StatusMessage, hello_body[1 + n :])
+        # the 2-byte listen port rides the fixed tail so StatusMessage can
+        # grow fields without desynchronizing this split
+        status = decode(StatusMessage, hello_body[1 + n : -2])
+        listen_port = struct.unpack("<H", hello_body[-2:])[0]
         ours = self.local_status()
         if bytes(status.fork_digest) != bytes(ours.fork_digest):
             # irrelevant network: refuse the handshake
@@ -339,11 +368,34 @@ class WireNode:
             return False
         peer.peer_id = peer_id
         peer.status = status
+        peer.listen_addr = (peer.addr[0], listen_port)
         existing = self.peers.get(peer_id)
         self.peers[peer_id] = peer
         if existing is not None and existing is not peer:
             existing.close()
+        self.known_addrs.add(peer.listen_addr)
         return True
+
+    def _exchange_peers(self, peer):
+        """Peer exchange (the discovery stand-in for discv5, which is
+        host-side UDP): tell the newcomer about everyone else, and
+        everyone else about the newcomer.  Runs AFTER our HELLO reply —
+        a PEERS frame must never be a connection's first frame."""
+        snapshot = [p for p in list(self.peers.values()) if p is not peer]
+        others = [
+            p.listen_addr for p in snapshot if p.listen_addr is not None
+        ]
+        if others:
+            try:
+                peer.send_frame(PEERS, _addrs_to_bytes(others))
+            except ConnectionError:
+                return
+        announce = _addrs_to_bytes([peer.listen_addr])
+        for p in snapshot:
+            try:
+                p.send_frame(PEERS, announce)
+            except ConnectionError:
+                continue   # one dead peer must not hide the newcomer
 
     def _reader_loop(self, peer):
         try:
@@ -363,6 +415,7 @@ class WireNode:
                         peer.send_frame(HELLO, self._hello_body())
                         for topic in self.handlers:
                             peer.send_frame(SUBSCRIBE, topic.encode())
+                    self._exchange_peers(peer)
                     continue
                 self._dispatch(peer, ftype, body)
         except Exception as e:
@@ -399,6 +452,11 @@ class WireNode:
             peer.send_frame(PONG, struct.pack("<Q", self.metadata_seq))
         elif ftype == PONG:
             peer.metadata_seq = struct.unpack("<Q", body)[0]
+        elif ftype == PEERS:
+            for addr in _bytes_to_addrs(body):
+                if len(self.known_addrs) >= 1024:
+                    break   # bounded: a PEERS flood can't grow it forever
+                self.known_addrs.add(addr)
         elif ftype == GOODBYE_FRAME:
             peer.close()
         else:
@@ -674,6 +732,28 @@ class WireNode:
                 raise WireError("partial by-range response made no progress")
             cursor = advanced
         return out
+
+    def discover(self, max_peers=16, max_dials=8):
+        """Dial exchanged addresses we are not yet connected to
+        (peer_manager's discovery-driven dialing, over PEX instead of
+        discv5).  Bounded per pass: unvalidated addresses must not be
+        able to wedge the caller.  Returns newly connected peer ids."""
+        connected_addrs = {
+            p.listen_addr for p in list(self.peers.values())
+        } | {("127.0.0.1", self.port)}
+        new = []
+        attempts = 0
+        for addr in sorted(set(self.known_addrs) - connected_addrs):
+            if len(self.peers) >= max_peers or attempts >= max_dials:
+                break
+            if addr == ("127.0.0.1", self.port):
+                continue
+            attempts += 1
+            try:
+                new.append(self.dial(*addr, timeout=3.0))
+            except (WireError, OSError) as e:
+                log.debug("discovery dial %s failed: %s", addr, e)
+        return new
 
     def goodbye(self, peer_id, reason=GB_CLIENT_SHUTDOWN):
         peer = self.peers.get(peer_id)

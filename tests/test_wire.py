@@ -315,6 +315,75 @@ def test_attestation_gossip_rides_subnet_topics():
     assert len(prefix) == 1, "prefix subscriber got it too"
 
 
+def test_peer_exchange_discovery_meshes():
+    """A and C both dial only B; peer exchange lets them learn each
+    other's listen address and discover() completes the triangle."""
+    _, ca = _make_chain(0)
+    _, cb = _make_chain(0)
+    _, cc = _make_chain(0)
+    na, nb, nc = WireNode(ca), WireNode(cb), WireNode(cc)
+    try:
+        na.dial("127.0.0.1", nb.port)
+        nc.dial("127.0.0.1", nb.port)
+        assert _wait(lambda: ("127.0.0.1", nc.port) in na.known_addrs)
+        new = na.discover()
+        assert nc.peer_id in new
+        assert _wait(lambda: na.peer_id in nc.peers)
+    finally:
+        na.stop()
+        nb.stop()
+        nc.stop()
+
+
+def test_light_client_updates_gossip_over_wire():
+    """An altair chain imports a block; the node hook publishes the
+    optimistic update on its gossip topic and a follower node receives
+    and decodes it."""
+    from lighthouse_tpu.light_client import light_client_types
+
+    ALTAIR = ChainSpec(preset=MinimalPreset, altair_fork_epoch=0)
+    h = Harness(8, ALTAIR)
+    chain = BeaconChain(
+        h.state.copy(), ALTAIR, verifier=SignatureVerifier("fake")
+    )
+    _, follower_chain = _make_chain(0)
+    n_server = WireNode(chain)
+    # follower must share the fork digest to handshake
+    f2 = BeaconChain(
+        Harness(8, ALTAIR).state.copy(), ALTAIR,
+        verifier=SignatureVerifier("fake"),
+    )
+    n_follow = WireNode(f2)
+    got = []
+    try:
+        n_follow.subscribe(
+            "light_client_optimistic_update", lambda pid, m: got.append(m)
+        )
+        n_follow.dial("127.0.0.1", n_server.port)
+
+        def publish(server, _wire=n_server):
+            if server.latest_optimistic_update is not None:
+                _wire.publish(
+                    "light_client_optimistic_update",
+                    server.latest_optimistic_update,
+                )
+
+        chain.on_light_client_update = publish
+        for slot in (1, 2):
+            blk = h.produce_block(slot)
+            h.process_block(blk, strategy="no_verification")
+            chain.on_tick(slot)
+            chain.process_block(blk)
+        assert _wait(
+            lambda: got and int(got[-1].attested_header.slot) >= 1
+        ), "both optimistic updates arrived via gossip"
+        LT = light_client_types(ALTAIR.preset)
+        assert isinstance(got[-1], LT.LightClientOptimisticUpdate)
+    finally:
+        n_server.stop()
+        n_follow.stop()
+
+
 def test_goodbye_disconnects():
     _, c1 = _make_chain(0)
     _, c2 = _make_chain(0)
