@@ -24,12 +24,13 @@ JSON shapes follow the beacon-APIs spec):
 import json
 import re
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..ssz import hash_tree_root
 from ..state_processing import phase0
 from ..utils import metrics
+from ..utils.http import JsonHandler
 from ..validator_client.client import DirectBeaconNode
 
 VERSION = "lighthouse_tpu/0.2.0"
@@ -39,12 +40,8 @@ def _hex(b):
     return "0x" + bytes(b).hex()
 
 
-class _Handler(BaseHTTPRequestHandler):
+class _Handler(JsonHandler):
     server_version = VERSION
-
-    # quiet the default stderr access log
-    def log_message(self, fmt, *args):
-        pass
 
     @property
     def chain(self):
@@ -56,14 +53,6 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------ plumbing
 
-    def _json(self, obj, code=200):
-        body = json.dumps(obj).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
     def _text(self, text, code=200):
         body = text.encode()
         self.send_response(code)
@@ -71,9 +60,6 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
-
-    def _err(self, code, message):
-        self._json({"code": code, "message": message}, code)
 
     def _canonical_root_at_slot(self, slot):
         """Walk the canonical chain back from head to the block at or

@@ -65,6 +65,9 @@ def build_parser_with_subs():
     vc.add_argument("--beacon-node", default="http://127.0.0.1:5052")
     vc.add_argument("--builder-proposals", action="store_true",
                     help="propose blinded blocks through the BN's builder")
+    vc.add_argument("--http-port", type=int, default=None,
+                    help="serve the keymanager API on this port (token in "
+                         "<keystore-dir>/api-token.txt)")
     vc.add_argument("--keystore-dir", default="./validators")
     vc.add_argument("--password", default="")
 
@@ -282,7 +285,14 @@ def _run_vc(args):
     n = 0
     for path in sorted(glob.glob(os.path.join(args.keystore_dir, "keystore-*.json"))):
         ks = keys.load_keystore(path)
-        store.add_validator(keys.decrypt_keystore(ks, args.password))
+        # API-imported keystores carry their own password file
+        pass_file = path[: -len(".json")] + ".pass"
+        if os.path.exists(pass_file):
+            with open(pass_file) as f:
+                pw = f.read()
+        else:
+            pw = args.password
+        store.add_validator(keys.decrypt_keystore(ks, pw))
         n += 1
     if n == 0:
         print("no keystores found in", args.keystore_dir, file=sys.stderr)
@@ -292,6 +302,23 @@ def _run_vc(args):
         store, bn, spec, builder_proposals=args.builder_proposals
     )
     clock = SystemSlotClock(int(genesis["genesis_time"]), spec.seconds_per_slot)
+    api_server = None
+    if args.http_port is not None:
+        from .validator_client.http_api import ValidatorApiServer
+
+        api_server = ValidatorApiServer(
+            store, spec,
+            genesis_validators_root=bytes.fromhex(
+                genesis["genesis_validators_root"][2:]
+            ),
+            port=args.http_port,
+            token_path=os.path.join(args.keystore_dir, "api-token.txt"),
+            keystore_dir=args.keystore_dir,
+            current_epoch_fn=lambda: (clock.now() or 0)
+            // spec.preset.slots_per_epoch,
+        ).start()
+        print(f"vc: keymanager API on :{api_server.port} "
+              f"(token in {args.keystore_dir}/api-token.txt)")
     last = {"propose": None, "attest": None, "aggregate": None}
     try:
         while True:
@@ -326,6 +353,9 @@ def _run_vc(args):
             )
     except KeyboardInterrupt:
         return 0
+    finally:
+        if api_server is not None:
+            api_server.stop()
 
 
 def _run_am(args):

@@ -249,6 +249,7 @@ class WireNode:
         self.handlers = {}             # topic -> handler(from_peer, obj)
         self.peers = {}                # peer_id -> _Peer
         self.known_addrs = set()       # peer-exchanged listen addresses
+        self._addr_fails = {}          # addr -> consecutive dial failures
         self.banned_ids = set()
         self._seen = OrderedDict()     # message id -> None (gossip dedup)
         self._seen_lock = threading.Lock()
@@ -751,8 +752,15 @@ class WireNode:
             attempts += 1
             try:
                 new.append(self.dial(*addr, timeout=3.0))
+                self._addr_fails.pop(addr, None)
             except (WireError, OSError) as e:
                 log.debug("discovery dial %s failed: %s", addr, e)
+                fails = self._addr_fails.get(addr, 0) + 1
+                self._addr_fails[addr] = fails
+                if fails >= 3:
+                    # stale address: stop paying 3s per pass for it
+                    self.known_addrs.discard(addr)
+                    del self._addr_fails[addr]
         return new
 
     def goodbye(self, peer_id, reason=GB_CLIENT_SHUTDOWN):

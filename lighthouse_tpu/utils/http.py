@@ -1,0 +1,23 @@
+"""Shared HTTP handler plumbing for the BN and VC API servers."""
+
+import json
+from http.server import BaseHTTPRequestHandler
+
+
+class JsonHandler(BaseHTTPRequestHandler):
+    """JSON response/error envelope used by every API handler."""
+
+    # quiet the default stderr access log
+    def log_message(self, fmt, *args):
+        pass
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _err(self, code, message):
+        self._json({"code": code, "message": message}, code)

@@ -1,0 +1,277 @@
+"""Validator-client HTTP API: the keymanager surface + lighthouse extras.
+
+Mirror of /root/reference/validator_client/src/http_api/ — `mod.rs`
+routes, `api_secret.rs` bearer-token auth, `keystores.rs` (the standard
+keymanager API: list/import/delete keystores with slashing-protection
+interchange), and `create_signed_voluntary_exit.rs`.
+
+Every route requires `Authorization: Bearer <token>`; the token is
+generated once and written next to the keystores (api-token.txt), the
+reference's exact operator workflow.
+"""
+
+import json
+import os
+import secrets
+import threading
+from http.server import ThreadingHTTPServer
+
+from ..crypto.keys import KeystoreError, decrypt_keystore
+from ..types.containers import VoluntaryExit
+from ..utils.http import JsonHandler
+
+VERSION = "lighthouse_tpu-vc/0.2.0"
+
+
+class _Handler(JsonHandler):
+    server_version = VERSION
+
+    def _authed(self):
+        got = self.headers.get("Authorization", "")
+        want = f"Bearer {self.server.token}"
+        # compare as bytes: a non-ASCII header must 401, not TypeError
+        if not secrets.compare_digest(
+            got.encode("utf-8", "surrogateescape"), want.encode()
+        ):
+            self._err(401, "invalid or missing api token")
+            return False
+        return True
+
+    def _body(self):
+        length = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(length) or b"null"
+        return json.loads(raw)
+
+    # ------------------------------------------------------------ routes
+
+    def do_GET(self):
+        if not self._authed():
+            return
+        path = self.path.split("?")[0].rstrip("/")
+        store = self.server.store
+        if path == "/eth/v1/keystores":
+            return self._json(
+                {
+                    "data": [
+                        {
+                            "validating_pubkey": "0x" + pk.hex(),
+                            "derivation_path": "",
+                            "readonly": False,
+                        }
+                        for pk in store.voting_pubkeys()
+                    ]
+                }
+            )
+        if path == "/lighthouse/validators":
+            return self._json(
+                {
+                    "data": [
+                        {
+                            "voting_pubkey": "0x" + pk.hex(),
+                            "enabled": True,
+                            "doppelganger_watching": str(
+                                store.doppelganger_status(pk)
+                            ),
+                        }
+                        for pk in store.voting_pubkeys()
+                    ]
+                }
+            )
+        if path == "/lighthouse/health":
+            return self._json({"data": {"status": "ok"}})
+        return self._err(404, f"no route {path}")
+
+    def do_POST(self):
+        if not self._authed():
+            return
+        path = self.path.split("?")[0].rstrip("/")
+        store = self.server.store
+        try:
+            body = self._body()
+        except json.JSONDecodeError as e:
+            return self._err(400, f"malformed JSON: {e}")
+
+        if path == "/eth/v1/keystores":
+            keystores = body.get("keystores", [])
+            passwords = body.get("passwords", [])
+            if len(keystores) != len(passwords):
+                return self._err(400, "keystores/passwords length mismatch")
+            interchange = body.get("slashing_protection")
+            if interchange:
+                try:
+                    store.slashing_db.import_interchange(
+                        json.loads(interchange)
+                        if isinstance(interchange, str)
+                        else interchange
+                    )
+                except Exception as e:
+                    return self._err(400, f"bad slashing_protection: {e}")
+            statuses = []
+            existing = set(store.voting_pubkeys())
+            for blob, pw in zip(keystores, passwords):
+                try:
+                    ks = json.loads(blob) if isinstance(blob, str) else blob
+                    sk = decrypt_keystore(ks, pw)
+                    pk = store.add_validator(sk)
+                    status = "duplicate" if pk in existing else "imported"
+                    if status == "imported":
+                        # persist so a VC restart keeps serving the key
+                        # (initialized_validators.rs writes keystore+pass)
+                        self.server.persist_keystore(pk, ks, pw)
+                    statuses.append({"status": status})
+                    existing.add(pk)
+                except (KeystoreError, ValueError, KeyError) as e:
+                    statuses.append({"status": "error", "message": str(e)})
+            return self._json({"data": statuses})
+
+        m = path.removeprefix("/eth/v1/validator/")
+        if m != path and m.endswith("/voluntary_exit"):
+            # create_signed_voluntary_exit.rs: sign an exit NOW for an
+            # attached key (published separately via the BN)
+            pk_hex = m[: -len("/voluntary_exit")]
+            try:
+                pk = bytes.fromhex(pk_hex.removeprefix("0x"))
+            except ValueError:
+                return self._err(400, "bad pubkey")
+            if pk not in set(store.voting_pubkeys()):
+                return self._err(404, "unknown validator")
+            epoch = int((body or {}).get("epoch", self.server.current_epoch()))
+            exit_msg = VoluntaryExit(
+                epoch=epoch,
+                validator_index=int((body or {}).get("validator_index", 0)),
+            )
+            sig = store.sign_voluntary_exit(
+                pk, exit_msg, self.server.fork_at(epoch),
+                self.server.genesis_validators_root,
+            )
+            return self._json(
+                {
+                    "data": {
+                        "message": {
+                            "epoch": str(epoch),
+                            "validator_index": str(
+                                int(exit_msg.validator_index)
+                            ),
+                        },
+                        "signature": "0x" + bytes(sig).hex(),
+                    }
+                }
+            )
+        return self._err(404, f"no route {path}")
+
+    def do_DELETE(self):
+        if not self._authed():
+            return
+        path = self.path.split("?")[0].rstrip("/")
+        store = self.server.store
+        if path == "/eth/v1/keystores":
+            try:
+                body = self._body()
+            except json.JSONDecodeError as e:
+                return self._err(400, f"malformed JSON: {e}")
+            statuses = []
+            for pk_hex in body.get("pubkeys", []):
+                try:
+                    pk = bytes.fromhex(pk_hex.removeprefix("0x"))
+                except ValueError:
+                    statuses.append({"status": "error", "message": "bad hex"})
+                    continue
+                deleted = store.remove_validator(pk)
+                if deleted:
+                    # a restart must NOT resurrect a deleted key — the
+                    # operator may have moved it to another VC
+                    # (double-signing risk); disable it on disk too
+                    self.server.disable_keystore(pk)
+                statuses.append(
+                    {"status": "deleted" if deleted else "not_found"}
+                )
+            # the keymanager spec returns the interchange so history
+            # travels WITH the keys to the next VC
+            export = store.slashing_db.export_interchange(
+                self.server.genesis_validators_root
+            )
+            return self._json(
+                {
+                    "data": statuses,
+                    "slashing_protection": json.dumps(export),
+                }
+            )
+        return self._err(404, f"no route {path}")
+
+
+class ValidatorApiServer:
+    """Owns the socket, the bearer token, the keystore directory and the
+    chain context needed for exit signing."""
+
+    def __init__(self, store, spec, genesis_validators_root=b"\x00" * 32,
+                 host="127.0.0.1", port=0, token_path=None,
+                 current_epoch_fn=None, keystore_dir=None):
+        self.store = store
+        self.spec = spec
+        self.keystore_dir = keystore_dir
+        self.server = ThreadingHTTPServer((host, port), _Handler)
+        self.server.store = store
+        self.server.genesis_validators_root = bytes(genesis_validators_root)
+        self.server.fork_at = lambda epoch: spec.fork_at_epoch(epoch)
+        self.server.current_epoch = current_epoch_fn or (lambda: 0)
+        self.server.persist_keystore = self._persist_keystore
+        self.server.disable_keystore = self._disable_keystore
+        token = secrets.token_hex(32)
+        if token_path:
+            # persist for operator tooling (api_secret.rs api-token.txt)
+            existing = None
+            if os.path.exists(token_path):
+                with open(token_path) as f:
+                    existing = f.read().strip() or None
+            if existing:
+                token = existing
+            else:
+                with open(token_path, "w") as f:
+                    f.write(token)
+                os.chmod(token_path, 0o600)
+        self.token = token
+        self.server.token = token
+        self.port = self.server.server_address[1]
+        self._thread = None
+
+    def _persist_keystore(self, pubkey, keystore, password):
+        """API-imported keys survive restarts: keystore + password file
+        (0600) land beside the CLI-loaded ones."""
+        if self.keystore_dir is None:
+            return
+        os.makedirs(self.keystore_dir, exist_ok=True)
+        base = os.path.join(self.keystore_dir, f"keystore-km-{pubkey.hex()}")
+        with open(base + ".json", "w") as f:
+            json.dump(keystore, f)
+        pass_path = base + ".pass"
+        with open(pass_path, "w") as f:
+            f.write(password)
+        os.chmod(pass_path, 0o600)
+
+    def _disable_keystore(self, pubkey):
+        """Deleted keys must not resurrect on restart: rename any
+        on-disk keystore holding this pubkey to *.deleted."""
+        if self.keystore_dir is None:
+            return
+        import glob
+
+        pk_hex = pubkey.hex()
+        for path in glob.glob(os.path.join(self.keystore_dir, "keystore-*.json")):
+            try:
+                with open(path) as f:
+                    ks = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            if ks.get("pubkey", "").removeprefix("0x") == pk_hex:
+                os.replace(path, path + ".deleted")
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, name="vc_http_api", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()

@@ -81,6 +81,17 @@ class ValidatorStore:
         self.slashing_db.register_validator(pk)
         return pk
 
+    def remove_validator(self, pubkey: bytes) -> bool:
+        """Keymanager DELETE: the key stops signing immediately; its
+        slashing-protection history stays in the db for the interchange
+        export (initialized_validators.rs delete semantics)."""
+        pk = bytes(pubkey)
+        if pk not in self._keys:
+            return False
+        del self._keys[pk]
+        self._doppelganger.pop(pk, None)
+        return True
+
     def voting_pubkeys(self):
         return list(self._keys)
 

@@ -1,0 +1,186 @@
+"""VC HTTP API (keymanager): token auth, keystore import/list/delete
+with slashing-protection interchange, signed voluntary exits.
+
+Mirror of /root/reference/validator_client/src/http_api/ (api_secret.rs
+bearer auth, keystores.rs, create_signed_voluntary_exit.rs).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.crypto import keys
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+from lighthouse_tpu.validator_client.http_api import ValidatorApiServer
+from lighthouse_tpu.validator_client.validator_store import ValidatorStore
+
+SPEC = ChainSpec(preset=MinimalPreset)
+GVR = b"\x42" * 32
+
+
+def _call(server, method, path, body=None, token=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}",
+        data=json.dumps(body).encode() if body is not None else None,
+        method=method,
+    )
+    req.add_header("Content-Type", "application/json")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.load(r)
+
+
+@pytest.fixture()
+def server(tmp_path):
+    store = ValidatorStore(SPEC)
+    srv = ValidatorApiServer(
+        store, SPEC, genesis_validators_root=GVR,
+        token_path=str(tmp_path / "api-token.txt"),
+    ).start()
+    yield srv
+    srv.stop()
+
+
+def test_token_auth_required(server):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _call(server, "GET", "/eth/v1/keystores")
+    assert e.value.code == 401
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _call(server, "GET", "/eth/v1/keystores", token="wrong")
+    assert e.value.code == 401
+    out = _call(server, "GET", "/eth/v1/keystores", token=server.token)
+    assert out["data"] == []
+
+
+def test_token_persisted(tmp_path):
+    path = tmp_path / "api-token.txt"
+    store = ValidatorStore(SPEC)
+    s1 = ValidatorApiServer(store, SPEC, token_path=str(path))
+    s2 = ValidatorApiServer(store, SPEC, token_path=str(path))
+    assert s1.token == s2.token == path.read_text().strip()
+
+
+def test_keystore_import_list_delete_roundtrip(server):
+    sk = 987654321
+    ks = keys.encrypt_keystore(sk, "hunter2", kdf="pbkdf2")
+    out = _call(
+        server, "POST", "/eth/v1/keystores",
+        {"keystores": [json.dumps(ks)], "passwords": ["hunter2"]},
+        token=server.token,
+    )
+    assert out["data"] == [{"status": "imported"}]
+
+    listed = _call(server, "GET", "/eth/v1/keystores", token=server.token)
+    assert len(listed["data"]) == 1
+    pk_hex = listed["data"][0]["validating_pubkey"]
+
+    # a second import of the same key is a duplicate, not an error
+    out = _call(
+        server, "POST", "/eth/v1/keystores",
+        {"keystores": [json.dumps(ks)], "passwords": ["hunter2"]},
+        token=server.token,
+    )
+    assert out["data"] == [{"status": "duplicate"}]
+
+    # wrong password -> per-item error, request still 200
+    out = _call(
+        server, "POST", "/eth/v1/keystores",
+        {"keystores": [json.dumps(ks)], "passwords": ["nope"]},
+        token=server.token,
+    )
+    assert out["data"][0]["status"] == "error"
+
+    # sign something so the interchange export has history
+    server.store.slashing_db.check_and_insert_block_proposal(
+        bytes.fromhex(pk_hex[2:]), 7, b"\x01" * 32
+    )
+    out = _call(
+        server, "DELETE", "/eth/v1/keystores",
+        {"pubkeys": [pk_hex]}, token=server.token,
+    )
+    assert out["data"] == [{"status": "deleted"}]
+    interchange = json.loads(out["slashing_protection"])
+    assert any(
+        d["pubkey"] == pk_hex for d in interchange["data"]
+    ), "history travels with the deleted key"
+    assert _call(
+        server, "GET", "/eth/v1/keystores", token=server.token
+    )["data"] == []
+    # deleting again reports not_found
+    out = _call(
+        server, "DELETE", "/eth/v1/keystores",
+        {"pubkeys": [pk_hex]}, token=server.token,
+    )
+    assert out["data"] == [{"status": "not_found"}]
+
+
+def test_import_and_delete_persist_across_restart(tmp_path):
+    """API-imported keys survive a VC restart; DELETEd keys do not
+    resurrect (the double-signing hazard)."""
+    import glob
+    import os
+
+    kdir = tmp_path / "validators"
+    store = ValidatorStore(SPEC)
+    srv = ValidatorApiServer(
+        store, SPEC, token_path=str(tmp_path / "tok"), keystore_dir=str(kdir)
+    ).start()
+    try:
+        ks1 = keys.encrypt_keystore(111222333, "pw1", kdf="pbkdf2")
+        ks2 = keys.encrypt_keystore(444555666, "pw2", kdf="pbkdf2")
+        _call(
+            srv, "POST", "/eth/v1/keystores",
+            {"keystores": [json.dumps(ks1), json.dumps(ks2)],
+             "passwords": ["pw1", "pw2"]},
+            token=srv.token,
+        )
+        listed = _call(srv, "GET", "/eth/v1/keystores", token=srv.token)
+        pk1, pk2 = [d["validating_pubkey"] for d in listed["data"]]
+        _call(srv, "DELETE", "/eth/v1/keystores", {"pubkeys": [pk1]},
+              token=srv.token)
+    finally:
+        srv.stop()
+
+    # "restart": reload the directory exactly as the CLI does
+    reloaded = ValidatorStore(SPEC)
+    for path in sorted(glob.glob(str(kdir / "keystore-*.json"))):
+        ks = keys.load_keystore(path)
+        with open(path[: -len(".json")] + ".pass") as f:
+            pw = f.read()
+        reloaded.add_validator(keys.decrypt_keystore(ks, pw))
+    pks = {"0x" + pk.hex() for pk in reloaded.voting_pubkeys()}
+    assert pk2 in pks, "imported key survived the restart"
+    assert pk1 not in pks, "deleted key stayed deleted"
+    assert any(p.endswith(".deleted") for p in os.listdir(kdir))
+
+
+def test_signed_voluntary_exit(server):
+    from lighthouse_tpu.crypto.ref import bls as RB
+    from lighthouse_tpu.crypto.ref.curves import g1_decompress, g2_decompress
+    from lighthouse_tpu.types import Domain, compute_signing_root
+    from lighthouse_tpu.types.containers import VoluntaryExit
+
+    sk = 13371337
+    pk = server.store.add_validator(sk)
+    out = _call(
+        server, "POST", f"/eth/v1/validator/0x{pk.hex()}/voluntary_exit",
+        {"epoch": 3, "validator_index": 5}, token=server.token,
+    )
+    msg = out["data"]["message"]
+    assert msg == {"epoch": "3", "validator_index": "5"}
+    # the signature verifies over the real exit domain
+    exit_msg = VoluntaryExit(epoch=3, validator_index=5)
+    domain = SPEC.get_domain(
+        Domain.VOLUNTARY_EXIT, 3, SPEC.fork_at_epoch(3), GVR
+    )
+    root = compute_signing_root(exit_msg, domain)
+    sig = g2_decompress(bytes.fromhex(out["data"]["signature"][2:]))
+    assert RB.verify(g1_decompress(pk), root, sig)
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _call(server, "POST", "/eth/v1/validator/0xdeadbeef/voluntary_exit",
+              {}, token=server.token)
+    assert e.value.code in (400, 404)

@@ -346,7 +346,6 @@ def test_light_client_updates_gossip_over_wire():
     chain = BeaconChain(
         h.state.copy(), ALTAIR, verifier=SignatureVerifier("fake")
     )
-    _, follower_chain = _make_chain(0)
     n_server = WireNode(chain)
     # follower must share the fork digest to handshake
     f2 = BeaconChain(
