@@ -139,6 +139,18 @@ class BeaconApiClient:
             },
         )["data"]
 
+    def prepare_beacon_proposer(self, preparations):
+        return self._post(
+            "/eth/v1/validator/prepare_beacon_proposer",
+            [
+                {
+                    "validator_index": str(p["validator_index"]),
+                    "fee_recipient": "0x" + bytes(p["fee_recipient"]).hex(),
+                }
+                for p in preparations
+            ],
+        )
+
     def publish_contributions_ssz(self, ssz_hex_list):
         return self._post(
             "/eth/v1/validator/contribution_and_proofs", ssz_hex_list

@@ -619,6 +619,20 @@ class _Handler(JsonHandler):
                 }
             )
 
+        if path == "/eth/v1/validator/prepare_beacon_proposer":
+            n = chain.prepare_proposers(
+                [
+                    {
+                        "validator_index": int(p["validator_index"]),
+                        "fee_recipient": bytes.fromhex(
+                            p["fee_recipient"].removeprefix("0x")
+                        ),
+                    }
+                    for p in body
+                ]
+            )
+            return self._json({"data": {"prepared": n}})
+
         if path == "/eth/v1/beacon/pool/sync_committees":
             from ..types.containers import SyncCommitteeMessage
 

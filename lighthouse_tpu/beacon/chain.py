@@ -138,6 +138,7 @@ class BeaconChain:
         self.light_client_server = None   # created on first altair import
         self.slasher = None               # attached via attach_slasher()
         self.builder = None               # attached via attach_builder()
+        self.proposer_preparations = {}   # validator index -> fee recipient
         self._advanced_head = None   # (head_root, slot, state) pre-advance
 
         self.current_slot = int(genesis_state.slot)
@@ -1371,9 +1372,28 @@ class BeaconChain:
         return self.process_block(full)
 
     def _production_payload(self, state, randao_reveal, capella):
-        """getPayload through the engine (execution_layer get_payload)."""
+        """getPayload through the engine (execution_layer get_payload);
+        the slot proposer's prepared fee recipient rides along
+        (beacon_proposer_cache / proposer_prep_data)."""
         from ..state_processing import bellatrix as bx
 
         if self.execution_engine is None:
             raise BlockError("no execution engine configured for production")
-        return bx.produce_payload(state, self.spec, self.execution_engine, capella)
+        proposer = phase0.get_beacon_proposer_index(state, self.preset)
+        fee_recipient = self.proposer_preparations.get(
+            proposer, b"\x00" * 20
+        )
+        return bx.produce_payload(
+            state, self.spec, self.execution_engine, capella,
+            fee_recipient=fee_recipient,
+        )
+
+    def prepare_proposers(self, preparations):
+        """prepare_beacon_proposer (validator/register endpoint family):
+        remember each validator's fee recipient for payload production
+        (preparation_service.rs -> execution_layer proposer prep)."""
+        for prep in preparations:
+            self.proposer_preparations[int(prep["validator_index"])] = bytes(
+                prep["fee_recipient"]
+            )
+        return len(self.proposer_preparations)

@@ -68,6 +68,9 @@ def build_parser_with_subs():
     vc.add_argument("--http-port", type=int, default=None,
                     help="serve the keymanager API on this port (token in "
                          "<keystore-dir>/api-token.txt)")
+    vc.add_argument("--suggested-fee-recipient", default=None,
+                    metavar="0xADDR",
+                    help="execution address credited by produced payloads")
     vc.add_argument("--keystore-dir", default="./validators")
     vc.add_argument("--password", default="")
 
@@ -298,8 +301,18 @@ def _run_vc(args):
         print("no keystores found in", args.keystore_dir, file=sys.stderr)
         return 1
     print(f"vc: {n} validators attached to {args.beacon_node}")
+    fee_recipient = None
+    if args.suggested_fee_recipient:
+        fee_recipient = bytes.fromhex(
+            args.suggested_fee_recipient.removeprefix("0x")
+        )
+        if len(fee_recipient) != 20:
+            print("--suggested-fee-recipient must be a 20-byte address",
+                  file=sys.stderr)
+            return 1
     vc = ValidatorClient(
-        store, bn, spec, builder_proposals=args.builder_proposals
+        store, bn, spec, builder_proposals=args.builder_proposals,
+        fee_recipient=fee_recipient,
     )
     clock = SystemSlotClock(int(genesis["genesis_time"]), spec.seconds_per_slot)
     api_server = None

@@ -83,8 +83,15 @@ class MockBuilder(BuilderClient):
         ) != bytes(parent_hash):
             raise BuilderError("unknown parent hash")
         capella = hasattr(state, "next_withdrawal_index")
+        # honor the proposer's prepared fee recipient like local
+        # production does (a real relay takes it from the registration)
+        proposer = phase0.get_beacon_proposer_index(state, preset)
+        fee_recipient = chain.proposer_preparations.get(
+            proposer, b"\x00" * 20
+        )
         payload = bx.produce_payload(
-            state, self.spec, chain.execution_engine, capella
+            state, self.spec, chain.execution_engine, capella,
+            fee_recipient=fee_recipient,
         )
         header = payload_to_header(payload, T)
         self.payloads[hash_tree_root(header)] = payload

@@ -169,7 +169,7 @@ def production_parent_hash(state, engine):
     return engine.genesis_hash
 
 
-def produce_payload(state, spec, engine, capella):
+def produce_payload(state, spec, engine, capella, fee_recipient=b"\x00" * 20):
     """getPayload for block production — shared by BeaconChain production
     and the test harness so the two can never diverge.
 
@@ -182,7 +182,10 @@ def produce_payload(state, spec, engine, capella):
     parent_hash = production_parent_hash(state, engine)
     timestamp = int(state.genesis_time) + int(state.slot) * spec.seconds_per_slot
     withdrawals = get_expected_withdrawals(state, preset) if capella else None
-    return engine.get_payload(parent_hash, timestamp, mix, withdrawals=withdrawals)
+    return engine.get_payload(
+        parent_hash, timestamp, mix,
+        fee_recipient=fee_recipient, withdrawals=withdrawals,
+    )
 
 
 def process_execution_payload(state, body, spec, engine):

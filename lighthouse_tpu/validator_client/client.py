@@ -44,6 +44,9 @@ class BeaconNodeInterface:
     def publish_contributions(self, signed_contributions):
         raise NotImplementedError
 
+    def prepare_proposers(self, preparations):
+        raise NotImplementedError
+
     def duties(self, epoch, pubkeys):
         raise NotImplementedError
 
@@ -287,6 +290,9 @@ class DirectBeaconNode(BeaconNodeInterface):
     def publish_contributions(self, signed_contributions):
         return self.chain.batch_verify_sync_contributions(signed_contributions)
 
+    def prepare_proposers(self, preparations):
+        return self.chain.prepare_proposers(preparations)
+
 
 class HttpBeaconNode(BeaconNodeInterface):
     """The VC's production transport: a remote BN over the Beacon API
@@ -486,6 +492,9 @@ class HttpBeaconNode(BeaconNodeInterface):
              for c in signed_contributions]
         )
 
+    def prepare_proposers(self, preparations):
+        return self.api.prepare_beacon_proposer(preparations)
+
 
 class BeaconNodeFallback(BeaconNodeInterface):
     """Ordered multi-node failover (beacon_node_fallback.rs:710)."""
@@ -549,18 +558,24 @@ class BeaconNodeFallback(BeaconNodeInterface):
     def publish_contributions(self, signed_contributions):
         return self._try("publish_contributions", signed_contributions)
 
+    def prepare_proposers(self, preparations):
+        return self._try("prepare_proposers", preparations)
+
 
 class ValidatorClient:
     """ProductionValidatorClient (lib.rs:88,116,491): drives one slot of
     duties at a time — proposals first, then attestations (the simulator
     calls `act_on_slot` per tick; production wraps it in a clocked loop)."""
 
-    def __init__(self, store, beacon_node, spec, builder_proposals=False):
+    def __init__(self, store, beacon_node, spec, builder_proposals=False,
+                 fee_recipient=None):
         self.store = store
         self.bn = beacon_node
         self.spec = spec
         self.preset = spec.preset
         self.builder_proposals = builder_proposals   # --builder-proposals
+        self.fee_recipient = fee_recipient           # --suggested-fee-recipient
+        self._prepared_epoch = None
         self._duties_cache = {}   # epoch -> duties
 
     def _signed_cls_for(self, block):
@@ -587,6 +602,7 @@ class ValidatorClient:
         (tests/simulator, where block import is synchronous)."""
         epoch = slot // self.preset.slots_per_epoch
         duties = self._duties(epoch)
+        self._prepare_proposers(epoch, duties)
         out = {"proposed": [], "attested": []}
 
         info = self.bn.head_info()
@@ -763,6 +779,32 @@ class ValidatorClient:
             self.bn.publish_attestations(atts)
         self._sync_messages(slot, fork, gvr, out)
         return out
+
+    def _prepare_proposers(self, epoch, duties):
+        """preparation_service.rs: once per epoch, tell the BN our
+        validators' fee recipient so payload production credits them."""
+        if self.fee_recipient is None or self._prepared_epoch == epoch:
+            return
+        seen = set()
+        preps = []
+        for d in duties["attester"]:
+            vi = d["validator_index"]
+            if vi in seen:
+                continue
+            seen.add(vi)
+            preps.append(
+                {"validator_index": vi, "fee_recipient": self.fee_recipient}
+            )
+        if not preps:
+            return
+        try:
+            self.bn.prepare_proposers(preps)
+        except Exception as e:
+            # fire-and-forget (preparation_service.rs): a BN that lacks
+            # or fails the route must never block proposals/attestations;
+            # retry next epoch
+            log.warning("proposer preparation failed: %s", e)
+        self._prepared_epoch = epoch
 
     def _get_sync_duties(self, slot):
         """Sync duties cached per sync-committee period (the membership

@@ -23,6 +23,13 @@ from ..utils.http import JsonHandler
 VERSION = "lighthouse_tpu-vc/0.2.0"
 
 
+def _write_private(path, content):
+    """Create-or-truncate with 0600 from the first byte."""
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
+        f.write(content)
+
+
 class _Handler(JsonHandler):
     server_version = VERSION
 
@@ -135,10 +142,14 @@ class _Handler(JsonHandler):
                 return self._err(400, "bad pubkey")
             if pk not in set(store.voting_pubkeys()):
                 return self._err(404, "unknown validator")
-            epoch = int((body or {}).get("epoch", self.server.current_epoch()))
+            if not body or "validator_index" not in body:
+                # a signed exit with the wrong index can never validate —
+                # refuse rather than silently sign index 0
+                return self._err(400, "validator_index is required")
+            epoch = int(body.get("epoch", self.server.current_epoch()))
             exit_msg = VoluntaryExit(
                 epoch=epoch,
-                validator_index=int((body or {}).get("validator_index", 0)),
+                validator_index=int(body["validator_index"]),
             )
             sig = store.sign_voluntary_exit(
                 pk, exit_msg, self.server.fork_at(epoch),
@@ -186,10 +197,19 @@ class _Handler(JsonHandler):
                     {"status": "deleted" if deleted else "not_found"}
                 )
             # the keymanager spec returns the interchange so history
-            # travels WITH the keys to the next VC
+            # travels WITH the keys — for the DELETED pubkeys only
+            # (active keys' history must not leak out of this VC)
+            deleted_pks = {
+                "0x" + bytes.fromhex(h.removeprefix("0x")).hex()
+                for h, st in zip(body.get("pubkeys", []), statuses)
+                if st["status"] == "deleted"
+            }
             export = store.slashing_db.export_interchange(
                 self.server.genesis_validators_root
             )
+            export["data"] = [
+                d for d in export["data"] if d["pubkey"] in deleted_pks
+            ]
             return self._json(
                 {
                     "data": statuses,
@@ -226,9 +246,7 @@ class ValidatorApiServer:
             if existing:
                 token = existing
             else:
-                with open(token_path, "w") as f:
-                    f.write(token)
-                os.chmod(token_path, 0o600)
+                _write_private(token_path, token)
         self.token = token
         self.server.token = token
         self.port = self.server.server_address[1]
@@ -236,26 +254,29 @@ class ValidatorApiServer:
 
     def _persist_keystore(self, pubkey, keystore, password):
         """API-imported keys survive restarts: keystore + password file
-        (0600) land beside the CLI-loaded ones."""
+        land beside the CLI-loaded ones, created 0600 from the first
+        byte (no chmod-after-write window)."""
         if self.keystore_dir is None:
             return
         os.makedirs(self.keystore_dir, exist_ok=True)
         base = os.path.join(self.keystore_dir, f"keystore-km-{pubkey.hex()}")
-        with open(base + ".json", "w") as f:
-            json.dump(keystore, f)
-        pass_path = base + ".pass"
-        with open(pass_path, "w") as f:
-            f.write(password)
-        os.chmod(pass_path, 0o600)
+        _write_private(base + ".json", json.dumps(keystore))
+        _write_private(base + ".pass", password)
 
     def _disable_keystore(self, pubkey):
-        """Deleted keys must not resurrect on restart: rename any
-        on-disk keystore holding this pubkey to *.deleted."""
+        """Deleted keys must not resurrect on restart.  API-imported
+        files are named by pubkey (no reliance on the OPTIONAL EIP-2335
+        pubkey field); CLI-made ones always carry the field."""
         if self.keystore_dir is None:
             return
         import glob
 
         pk_hex = pubkey.hex()
+        km_path = os.path.join(
+            self.keystore_dir, f"keystore-km-{pk_hex}.json"
+        )
+        if os.path.exists(km_path):
+            os.replace(km_path, km_path + ".deleted")
         for path in glob.glob(os.path.join(self.keystore_dir, "keystore-*.json")):
             try:
                 with open(path) as f:

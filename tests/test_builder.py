@@ -171,6 +171,54 @@ def test_unblinding_rejects_substituted_payload():
         chain.process_blinded_block(signed)
 
 
+@pytest.mark.parametrize("via_builder", [False, True])
+def test_fee_recipient_preparation_flows_into_payload(via_builder):
+    """preparation_service: the VC's suggested fee recipient reaches the
+    BN per epoch and payload production credits it — on BOTH the local
+    and the builder path."""
+    h, chain, builder = _chain_with_builder()
+    store = ValidatorStore(CAPELLA)
+    for i in range(8):
+        store.add_validator(h.keypairs[i][0])
+    addr = bytes.fromhex("aa" * 20)
+    vc = ValidatorClient(
+        store, DirectBeaconNode(chain), CAPELLA, fee_recipient=addr,
+        builder_proposals=via_builder,
+    )
+    chain.on_tick(1)
+    out = vc.act_on_slot(1, phase="propose")
+    assert out["proposed"]
+    assert len(chain.proposer_preparations) == 8
+    assert builder.submissions == (1 if via_builder else 0)
+    imported = chain.store.get_block(chain.head_root)
+    assert bytes(
+        imported.message.body.execution_payload.fee_recipient
+    ) == addr
+
+
+def test_fee_recipient_preparation_over_http():
+    from lighthouse_tpu.api.client import BeaconApiClient
+    from lighthouse_tpu.api.http_api import BeaconApiServer
+    from lighthouse_tpu.validator_client.client import HttpBeaconNode
+
+    h, chain, _ = _chain_with_builder()
+    server = BeaconApiServer(chain).start()
+    try:
+        api = BeaconApiClient(f"http://127.0.0.1:{server.port}", timeout=60.0)
+        bn = HttpBeaconNode(api, CAPELLA.preset).set_spec(CAPELLA)
+        store = ValidatorStore(CAPELLA)
+        for i in range(8):
+            store.add_validator(h.keypairs[i][0])
+        addr = bytes.fromhex("bb" * 20)
+        vc = ValidatorClient(store, bn, CAPELLA, fee_recipient=addr)
+        chain.on_tick(1)
+        vc.act_on_slot(1, phase="attest")   # any duty pass prepares
+        assert chain.proposer_preparations
+        assert set(chain.proposer_preparations.values()) == {addr}
+    finally:
+        server.stop()
+
+
 def test_blinded_proposal_over_http():
     from lighthouse_tpu.api.client import BeaconApiClient
     from lighthouse_tpu.api.http_api import BeaconApiServer
