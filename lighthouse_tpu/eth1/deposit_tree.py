@@ -1,4 +1,5 @@
-"""Incremental deposit Merkle tree (depth 32) + branch proofs.
+"""Incremental deposit Merkle tree (depth 32) + branch proofs +
+EIP-4881 snapshots.
 
 Mirror of the deposit-contract tree the reference maintains in
 /root/reference/beacon_node/eth1/src/deposit_cache.rs: append-only
@@ -6,9 +7,17 @@ incremental Merkleization (the deposit contract's own algorithm), proof
 generation for `Deposit.proof` (33 nodes: branch + length mix-in), and
 the `deposit_root` the chain checks proofs against
 (state_processing process_deposit's verify_merkle_branch).
+
+Snapshots mirror /root/reference/consensus/types/src/
+deposit_tree_snapshot.rs (EIP-4881): the finalized prefix of the tree
+collapses into its maximal-complete-subtree roots, so a checkpoint-
+synced node resumes the tree without replaying historical deposit logs
+— proofs remain generatable for every UNfinalized deposit, which is
+exactly the set a post-checkpoint block can still include.
 """
 
 import hashlib
+from dataclasses import dataclass, field
 
 from ..ssz import hash_tree_root
 from ..ssz.hash import ZERO_HASHES
@@ -18,6 +27,30 @@ DEPOSIT_CONTRACT_TREE_DEPTH = 32
 
 def _sha(x):
     return hashlib.sha256(x).digest()
+
+
+@dataclass
+class DepositTreeSnapshot:
+    """deposit_tree_snapshot.rs DepositTreeSnapshot."""
+
+    finalized: list = field(default_factory=list)   # subtree roots, L->R
+    deposit_root: bytes = b"\x00" * 32
+    deposit_count: int = 0
+    execution_block_hash: bytes = b"\x00" * 32
+    execution_block_height: int = 0
+
+
+def _finalized_subtrees(count):
+    """(height, index) of the maximal complete subtrees covering
+    [0, count), left to right — one per set bit of `count`."""
+    out = []
+    pos = 0
+    for height in reversed(range(DEPOSIT_CONTRACT_TREE_DEPTH + 1)):
+        size = 1 << height
+        if count & size:
+            out.append((height, pos // size))
+            pos += size
+    return out
 
 
 class DepositTree:
@@ -67,6 +100,107 @@ class DepositTree:
             if len(layer) % 2:
                 nxt.append(_sha(layer[-1] + ZERO_HASHES[d]))
             layer = nxt or [ZERO_HASHES[d + 1]]
+            idx //= 2
+        branch.append(count.to_bytes(32, "little"))
+        return branch
+
+    # ------------------------------------------------- EIP-4881 snapshot
+
+    def _node(self, height, index, count):
+        """Root of the subtree of 2^height leaves starting at
+        index*2^height, within a tree of `count` leaves."""
+        start = index << height
+        if start >= count:
+            return ZERO_HASHES[height]
+        if height == 0:
+            return self.leaves[start]
+        left = self._node(height - 1, 2 * index, count)
+        right = self._node(height - 1, 2 * index + 1, count)
+        return _sha(left + right)
+
+    def snapshot(self, count=None, execution_block_hash=b"\x00" * 32,
+                 execution_block_height=0) -> DepositTreeSnapshot:
+        """Collapse the first `count` deposits into their finalized
+        subtree roots (DepositTree::get_snapshot)."""
+        count = len(self.leaves) if count is None else count
+        finalized = [
+            self._node(h, i, count) for h, i in _finalized_subtrees(count)
+        ]
+        return DepositTreeSnapshot(
+            finalized=finalized,
+            deposit_root=self.root(count),
+            deposit_count=count,
+            execution_block_hash=bytes(execution_block_hash),
+            execution_block_height=int(execution_block_height),
+        )
+
+
+class SnapshotDepositTree:
+    """A deposit tree resumed from an EIP-4881 snapshot: the finalized
+    prefix exists only as subtree roots; appended deposits get full
+    proofs (DepositTree::from_snapshot + push_leaf in the reference)."""
+
+    def __init__(self, snapshot: DepositTreeSnapshot):
+        self.fin_count = int(snapshot.deposit_count)
+        subtrees = _finalized_subtrees(self.fin_count)
+        if len(subtrees) != len(snapshot.finalized):
+            raise ValueError("snapshot finalized length mismatch")
+        self._fin = {
+            (h, i): root
+            for (h, i), root in zip(subtrees, snapshot.finalized)
+        }
+        self.tail = []      # leaf hashes appended after the snapshot
+        if self.root(self.fin_count) != bytes(snapshot.deposit_root):
+            raise ValueError("snapshot deposit_root mismatch")
+
+    def __len__(self):
+        return self.fin_count + len(self.tail)
+
+    def push(self, deposit_data):
+        self.tail.append(hash_tree_root(deposit_data))
+
+    def _node(self, height, index, count):
+        hit = self._fin.get((height, index))
+        if hit is not None:
+            return hit
+        start = index << height
+        if start >= count:
+            return ZERO_HASHES[height]
+        if height == 0:
+            # never reached for finalized leaves: any aligned subtree
+            # fully inside [0, fin_count) on a query path is exactly one
+            # of the stored maximal subtrees (decomposition property)
+            if start < self.fin_count:
+                raise ValueError(
+                    f"leaf {start} is finalized — no proof possible"
+                )
+            return self.tail[start - self.fin_count]
+        left = self._node(height - 1, 2 * index, count)
+        right = self._node(height - 1, 2 * index + 1, count)
+        return _sha(left + right)
+
+    def root(self, count=None):
+        count = len(self) if count is None else count
+        if count < self.fin_count:
+            # stored subtree hits ignore `count`, so a pre-finalization
+            # root would be silently WRONG — refuse instead
+            raise ValueError(
+                f"cannot compute root at count {count} < finalized "
+                f"{self.fin_count}"
+            )
+        top = self._node(DEPOSIT_CONTRACT_TREE_DEPTH, 0, count)
+        return _sha(top + count.to_bytes(32, "little"))
+
+    def proof(self, index, count=None):
+        """Branch for an UNfinalized leaf (index >= fin_count)."""
+        count = len(self) if count is None else count
+        assert self.fin_count <= index < count, (
+            "proofs only exist for unfinalized deposits"
+        )
+        branch = []
+        idx = index
+        for d in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            branch.append(self._node(d, idx ^ 1, count))
             idx //= 2
         branch.append(count.to_bytes(32, "little"))
         return branch

@@ -40,19 +40,33 @@ class GossipingBeaconNode(DirectBeaconNode):
 
 
 class SimNode:
-    def __init__(self, node_id, genesis_state, spec, bus, reqresp, backend):
+    def __init__(self, node_id, genesis_state, spec, bus, reqresp, backend,
+                 transport="bus"):
         self.node_id = node_id
         self.chain = BeaconChain(
             genesis_state.copy(), spec, verifier=SignatureVerifier(backend)
         )
         self.processor = BeaconProcessor(self.chain)
+        if transport == "wire":
+            from ..network.wire import WireNode
+
+            self.wire = WireNode(self.chain, peer_id=node_id)
+            bus, reqresp = self.wire.bus_view(), self.wire.reqresp_view()
+        else:
+            self.wire = None
         self.router = Router(node_id, self.chain, self.processor, bus, reqresp)
 
 
 class Simulator:
-    def __init__(self, n_nodes, n_validators, spec, backend="fake"):
+    """transport="bus" runs on the in-process fan-out; transport="wire"
+    gives every node a real WireNode (TCP sockets, snappy frames) and
+    meshes them — the same Router/VC code paths either way."""
+
+    def __init__(self, n_nodes, n_validators, spec, backend="fake",
+                 transport="bus"):
         self.spec = spec
         self.preset = spec.preset
+        self.transport = transport
         self.keypairs = interop_keypairs(n_validators)
         self.genesis_state = interop_genesis_state(self.keypairs, 0, spec)
         self.clock = ManualSlotClock(
@@ -61,10 +75,20 @@ class Simulator:
         self.bus = GossipBus()
         self.reqresp = ReqResp()
         self.nodes = [
-            SimNode(f"node{i}", self.genesis_state, spec, self.bus, self.reqresp,
-                    backend)
+            SimNode(f"node{i}", self.genesis_state, spec, self.bus,
+                    self.reqresp, backend, transport=transport)
             for i in range(n_nodes)
         ]
+        if transport == "wire":
+            # full mesh: everyone dials everyone with a lower index; on
+            # failure the already-listening nodes must not leak threads
+            try:
+                for i, node in enumerate(self.nodes):
+                    for other in self.nodes[:i]:
+                        node.wire.dial("127.0.0.1", other.wire.port)
+            except Exception:
+                self.stop()
+                raise
         # validators split across nodes (simulator assigns key shares)
         self.vcs = []
         share = max(1, n_validators // n_nodes)
@@ -91,9 +115,38 @@ class Simulator:
             # the GossipingBeaconNode fans every publish out to the bus
             vc.act_on_slot(slot)
         # drain each node's processor (blocks first, one attestation batch)
-        for node in self.nodes:
-            node.processor.process_pending()
+        self._drain()
         return slot
+
+    def _drain(self):
+        if self.transport != "wire":
+            for node in self.nodes:
+                node.processor.process_pending()
+            return
+        # sockets deliver asynchronously: drain until every queue stays
+        # empty for a couple of consecutive passes
+        import time
+
+        idle = 0
+        deadline = time.time() + 10.0
+        while idle < 3:
+            if time.time() > deadline:
+                # a silent give-up would surface later as a bogus
+                # consensus divergence — fail HERE, diagnosably
+                raise RuntimeError(
+                    "wire drain deadline exceeded with work still queued"
+                )
+            handled = sum(n.processor.process_pending() for n in self.nodes)
+            if handled == 0:
+                idle += 1
+                time.sleep(0.02)
+            else:
+                idle = 0
+
+    def stop(self):
+        for node in self.nodes:
+            if node.wire is not None:
+                node.wire.stop()
 
     def run_epochs(self, n_epochs):
         for _ in range(n_epochs * self.preset.slots_per_epoch):

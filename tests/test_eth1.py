@@ -2,6 +2,8 @@
 ingestion through blocks, eth1 voting, eth1-driven genesis (SURVEY rows
 21/37)."""
 
+import pytest
+
 from lighthouse_tpu.eth1 import DepositTree, Eth1Cache, MockEth1Chain, get_eth1_vote
 from lighthouse_tpu.eth1.service import (
     initialize_beacon_state_from_eth1,
@@ -117,3 +119,67 @@ def test_eth1_genesis():
         Eth1Cache(eth1, follow_distance=0).head_block(), deposits2, SPEC
     )
     assert len(state2.validators) == 4, "bad-PoP deposit skipped"
+
+
+# ----------------------------------------------------- EIP-4881 snapshots
+
+
+def test_deposit_tree_snapshot_resume_roundtrip():
+    """A tree resumed from a snapshot produces the same roots and valid
+    proofs for every unfinalized deposit, across many split points
+    (deposit_tree_snapshot.rs semantics)."""
+    from lighthouse_tpu.eth1.deposit_tree import (
+        DepositTree,
+        SnapshotDepositTree,
+    )
+    from lighthouse_tpu.eth1.service import make_deposit_data
+
+    datas = [make_deposit_data(4000 + i, 32 * 10**9, SPEC) for i in range(9)]
+    full = DepositTree()
+    for d in datas:
+        full.push(d)
+
+    for fin in (1, 2, 3, 5, 6, 8):
+        snap = full.snapshot(fin)
+        assert snap.deposit_count == fin
+        resumed = SnapshotDepositTree(snap)
+        for d in datas[fin:]:
+            resumed.push(d)
+        assert len(resumed) == len(full)
+        # identical roots at every count from fin..n
+        for count in range(fin, len(datas) + 1):
+            assert resumed.root(count) == full.root(count), (fin, count)
+        # identical, verifying proofs for every unfinalized deposit
+        from lighthouse_tpu.ssz import hash_tree_root
+        from lighthouse_tpu.state_processing.phase0 import _verify_merkle_branch
+
+        for idx in range(fin, len(datas)):
+            p1 = full.proof(idx)
+            p2 = resumed.proof(idx)
+            assert p1 == p2, (fin, idx)
+            leaf = hash_tree_root(datas[idx])
+            assert _verify_merkle_branch(
+                leaf, p2, 33, idx, full.root()
+            ), (fin, idx)
+
+
+def test_snapshot_rejects_tampering_and_finalized_proofs():
+    from lighthouse_tpu.eth1.deposit_tree import (
+        DepositTree,
+        SnapshotDepositTree,
+    )
+    from lighthouse_tpu.eth1.service import make_deposit_data
+
+    full = DepositTree()
+    for i in range(5):
+        full.push(make_deposit_data(5000 + i, 32 * 10**9, SPEC))
+    snap = full.snapshot(4)
+    snap.finalized[0] = b"\xee" * 32
+    with pytest.raises(ValueError, match="deposit_root"):
+        SnapshotDepositTree(snap)
+
+    good = SnapshotDepositTree(full.snapshot(4))
+    with pytest.raises(AssertionError):
+        good.proof(2)   # finalized leaves have no proofs
+    with pytest.raises(ValueError, match="finalized"):
+        good.root(2)    # pre-finalization roots would be wrong — refused
