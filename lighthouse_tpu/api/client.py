@@ -156,17 +156,17 @@ class BeaconApiClient:
             "/eth/v1/validator/contribution_and_proofs", ssz_hex_list
         )
 
-    def produce_block_ssz(self, slot, randao_reveal):
-        return self._post(
-            f"/eth/v2/validator/blocks/{slot}",
-            {"randao_reveal": "0x" + bytes(randao_reveal).hex()},
-        )
+    def produce_block_ssz(self, slot, randao_reveal, graffiti=None):
+        body = {"randao_reveal": "0x" + bytes(randao_reveal).hex()}
+        if graffiti:
+            body["graffiti"] = "0x" + bytes(graffiti).hex()
+        return self._post(f"/eth/v2/validator/blocks/{slot}", body)
 
-    def produce_blinded_block_ssz(self, slot, randao_reveal):
-        return self._post(
-            f"/eth/v1/validator/blinded_blocks/{slot}",
-            {"randao_reveal": "0x" + bytes(randao_reveal).hex()},
-        )
+    def produce_blinded_block_ssz(self, slot, randao_reveal, graffiti=None):
+        body = {"randao_reveal": "0x" + bytes(randao_reveal).hex()}
+        if graffiti:
+            body["graffiti"] = "0x" + bytes(graffiti).hex()
+        return self._post(f"/eth/v1/validator/blinded_blocks/{slot}", body)
 
     def publish_blinded_block_ssz(self, ssz_hex_with_fork_id):
         return self._post(

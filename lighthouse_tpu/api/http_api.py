@@ -542,7 +542,14 @@ class _Handler(JsonHandler):
 
             slot = int(m.group(1))
             reveal = bytes.fromhex(body["randao_reveal"].removeprefix("0x"))
-            block, _ = chain.produce_block_on_state(slot, reveal)
+            graffiti = (
+                bytes.fromhex(body["graffiti"].removeprefix("0x"))
+                if body.get("graffiti")
+                else None
+            )
+            block, _ = chain.produce_block_on_state(
+                slot, reveal, graffiti=graffiti
+            )
             codec = _Codec(chain.preset)
             version = codec.fork_name_for_body(block.body)
             cls = codec.unsigned_block_cls(version)
@@ -564,8 +571,13 @@ class _Handler(JsonHandler):
 
             slot = int(m.group(1))
             reveal = bytes.fromhex(body["randao_reveal"].removeprefix("0x"))
+            graffiti = (
+                bytes.fromhex(body["graffiti"].removeprefix("0x"))
+                if body.get("graffiti")
+                else None
+            )
             block, _, blinded = chain.produce_blinded_block_on_state(
-                slot, reveal
+                slot, reveal, graffiti=graffiti
             )
             codec = _Codec(chain.preset)
             version = codec.fork_name_for_body(block.body)

@@ -50,6 +50,9 @@ def build_parser_with_subs():
     bn.add_argument("--http-port", type=int, default=5052)
     bn.add_argument("--crypto-backend", default="tpu",
                     choices=["tpu", "oracle", "fake"])
+    bn.add_argument("--genesis-time", type=int, default=None,
+                    help="interop genesis timestamp (default: now — a "
+                         "live clock must not start billions of slots in)")
     bn.add_argument("--interop-validators", type=int, default=0,
                     help="deterministic interop genesis with N validators")
     bn.add_argument("--memory-store", action="store_true")
@@ -71,6 +74,8 @@ def build_parser_with_subs():
     vc.add_argument("--suggested-fee-recipient", default=None,
                     metavar="0xADDR",
                     help="execution address credited by produced payloads")
+    vc.add_argument("--graffiti", default=None,
+                    help="utf-8 graffiti stamped into proposed blocks")
     vc.add_argument("--keystore-dir", default="./validators")
     vc.add_argument("--password", default="")
 
@@ -227,8 +232,15 @@ def _run_bn(args):
 
     builder = ClientBuilder(spec).crypto_backend(args.crypto_backend)
     if args.interop_validators:
+        import time as _time
+
+        genesis_time = (
+            args.genesis_time
+            if args.genesis_time is not None
+            else int(_time.time())
+        )
         state = interop_genesis_state(
-            interop_keypairs(args.interop_validators), 0, spec
+            interop_keypairs(args.interop_validators), genesis_time, spec
         )
     else:
         print("no genesis source: use --interop-validators N", file=sys.stderr)
@@ -310,9 +322,15 @@ def _run_vc(args):
             print("--suggested-fee-recipient must be a 20-byte address",
                   file=sys.stderr)
             return 1
+    graffiti = None
+    if args.graffiti is not None:
+        raw = args.graffiti.encode()[:32]
+        # never stamp a split multi-byte character into every block
+        raw = raw.decode("utf-8", "ignore").encode()
+        graffiti = raw.ljust(32, b"\x00")
     vc = ValidatorClient(
         store, bn, spec, builder_proposals=args.builder_proposals,
-        fee_recipient=fee_recipient,
+        fee_recipient=fee_recipient, graffiti=graffiti,
     )
     clock = SystemSlotClock(int(genesis["genesis_time"]), spec.seconds_per_slot)
     api_server = None

@@ -74,21 +74,24 @@ class Simulator:
         )
         self.bus = GossipBus()
         self.reqresp = ReqResp()
-        self.nodes = [
-            SimNode(f"node{i}", self.genesis_state, spec, self.bus,
-                    self.reqresp, backend, transport=transport)
-            for i in range(n_nodes)
-        ]
-        if transport == "wire":
-            # full mesh: everyone dials everyone with a lower index; on
-            # failure the already-listening nodes must not leak threads
-            try:
+        # build + mesh under one guard: a failure mid-way (socket bind,
+        # handshake) must stop every already-listening node, not leak
+        # accept/reader threads into the rest of the process
+        self.nodes = []
+        try:
+            for i in range(n_nodes):
+                self.nodes.append(
+                    SimNode(f"node{i}", self.genesis_state, spec, self.bus,
+                            self.reqresp, backend, transport=transport)
+                )
+            if transport == "wire":
+                # full mesh: everyone dials everyone with a lower index
                 for i, node in enumerate(self.nodes):
                     for other in self.nodes[:i]:
                         node.wire.dial("127.0.0.1", other.wire.port)
-            except Exception:
-                self.stop()
-                raise
+        except Exception:
+            self.stop()
+            raise
         # validators split across nodes (simulator assigns key shares)
         self.vcs = []
         share = max(1, n_validators // n_nodes)
@@ -127,9 +130,12 @@ class Simulator:
         # empty for a couple of consecutive passes
         import time
 
+        # a ~250ms continuous quiet period before declaring quiescence:
+        # frames may still be in TCP buffers / reader threads when the
+        # processor queues momentarily empty
         idle = 0
         deadline = time.time() + 10.0
-        while idle < 3:
+        while idle < 8:
             if time.time() > deadline:
                 # a silent give-up would surface later as a bogus
                 # consensus divergence — fail HERE, diagnosably
@@ -139,7 +145,7 @@ class Simulator:
             handled = sum(n.processor.process_pending() for n in self.nodes)
             if handled == 0:
                 idle += 1
-                time.sleep(0.02)
+                time.sleep(0.03)
             else:
                 idle = 0
 

@@ -53,13 +53,13 @@ class BeaconNodeInterface:
     def attestation_data(self, slot, committee_index):
         raise NotImplementedError
 
-    def produce_block(self, slot, randao_reveal):
+    def produce_block(self, slot, randao_reveal, graffiti=None):
         raise NotImplementedError
 
     def publish_block(self, signed_block):
         raise NotImplementedError
 
-    def produce_blinded_block(self, slot, randao_reveal):
+    def produce_blinded_block(self, slot, randao_reveal, graffiti=None):
         """-> (block, blinded: bool) — False means local fallback."""
         raise NotImplementedError
 
@@ -206,17 +206,19 @@ class DirectBeaconNode(BeaconNodeInterface):
             target=Checkpoint(epoch=epoch, root=target_root),
         )
 
-    def produce_block(self, slot, randao_reveal):
-        block, _ = self.chain.produce_block_on_state(slot, randao_reveal)
+    def produce_block(self, slot, randao_reveal, graffiti=None):
+        block, _ = self.chain.produce_block_on_state(
+            slot, randao_reveal, graffiti=graffiti
+        )
         return block
 
     def publish_block(self, signed_block):
         self.chain.on_tick(int(signed_block.message.slot))
         return self.chain.process_block(signed_block)
 
-    def produce_blinded_block(self, slot, randao_reveal):
+    def produce_blinded_block(self, slot, randao_reveal, graffiti=None):
         block, _, blinded = self.chain.produce_blinded_block_on_state(
-            slot, randao_reveal
+            slot, randao_reveal, graffiti=graffiti
         )
         return block, blinded
 
@@ -379,10 +381,10 @@ class HttpBeaconNode(BeaconNodeInterface):
             ),
         )
 
-    def produce_block(self, slot, randao_reveal):
+    def produce_block(self, slot, randao_reveal, graffiti=None):
         from ..ssz import decode
 
-        resp = self.api.produce_block_ssz(slot, randao_reveal)
+        resp = self.api.produce_block_ssz(slot, randao_reveal, graffiti)
         T = self.codec.T
         cls = {
             "phase0": T.BeaconBlock,
@@ -398,10 +400,12 @@ class HttpBeaconNode(BeaconNodeInterface):
         )
         return bytes.fromhex(out["root"][2:])
 
-    def produce_blinded_block(self, slot, randao_reveal):
+    def produce_blinded_block(self, slot, randao_reveal, graffiti=None):
         from ..ssz import decode
 
-        resp = self.api.produce_blinded_block_ssz(slot, randao_reveal)
+        resp = self.api.produce_blinded_block_ssz(
+            slot, randao_reveal, graffiti
+        )
         blinded = bool(resp.get("blinded", True))
         cls = (
             self.codec.unsigned_blinded_cls(resp["version"])
@@ -522,14 +526,16 @@ class BeaconNodeFallback(BeaconNodeInterface):
     def attestation_data(self, slot, committee_index):
         return self._try("attestation_data", slot, committee_index)
 
-    def produce_block(self, slot, randao_reveal):
-        return self._try("produce_block", slot, randao_reveal)
+    def produce_block(self, slot, randao_reveal, graffiti=None):
+        return self._try("produce_block", slot, randao_reveal, graffiti)
 
     def publish_block(self, signed_block):
         return self._try("publish_block", signed_block)
 
-    def produce_blinded_block(self, slot, randao_reveal):
-        return self._try("produce_blinded_block", slot, randao_reveal)
+    def produce_blinded_block(self, slot, randao_reveal, graffiti=None):
+        return self._try(
+            "produce_blinded_block", slot, randao_reveal, graffiti
+        )
 
     def publish_blinded_block(self, signed_blinded_block):
         return self._try("publish_blinded_block", signed_blinded_block)
@@ -568,13 +574,14 @@ class ValidatorClient:
     calls `act_on_slot` per tick; production wraps it in a clocked loop)."""
 
     def __init__(self, store, beacon_node, spec, builder_proposals=False,
-                 fee_recipient=None):
+                 fee_recipient=None, graffiti=None):
         self.store = store
         self.bn = beacon_node
         self.spec = spec
         self.preset = spec.preset
         self.builder_proposals = builder_proposals   # --builder-proposals
         self.fee_recipient = fee_recipient           # --suggested-fee-recipient
+        self.graffiti = graffiti                     # --graffiti
         self._prepared_epoch = None
         self._duties_cache = {}   # epoch -> duties
 
@@ -623,10 +630,12 @@ class ValidatorClient:
                 blinded = False
                 if self.builder_proposals:
                     block, blinded = self.bn.produce_blinded_block(
-                        slot, reveal
+                        slot, reveal, graffiti=self.graffiti
                     )
                 else:
-                    block = self.bn.produce_block(slot, reveal)
+                    block = self.bn.produce_block(
+                        slot, reveal, graffiti=self.graffiti
+                    )
                 sig = self.store.sign_block(duty["pubkey"], block, fork, gvr)
                 signed = self._signed_cls_for(block)(
                     message=block, signature=sig

@@ -40,6 +40,27 @@ def test_vc_drives_node_over_http():
         server.stop()
 
 
+def test_vc_graffiti_lands_in_proposed_block():
+    h = Harness(8, SPEC)
+    chain = BeaconChain(h.state.copy(), SPEC, verifier=SignatureVerifier("oracle"))
+    server = BeaconApiServer(chain).start()
+    try:
+        api = BeaconApiClient(f"http://127.0.0.1:{server.port}", timeout=60.0)
+        bn = HttpBeaconNode(api, SPEC.preset).set_spec(SPEC)
+        store = ValidatorStore(SPEC)
+        for i in range(8):
+            store.add_validator(h.keypairs[i][0])
+        tag = b"graffiti-test".ljust(32, b"\x00")
+        vc = ValidatorClient(store, bn, SPEC, graffiti=tag)
+        chain.on_tick(1)
+        out = vc.act_on_slot(1, phase="propose")
+        assert out["proposed"]
+        blk = chain.store.get_block(chain.head_root)
+        assert bytes(blk.message.body.graffiti) == tag
+    finally:
+        server.stop()
+
+
 def test_vc_aggregation_duty_over_http():
     h = Harness(8, SPEC)
     chain = BeaconChain(h.state.copy(), SPEC, verifier=SignatureVerifier("oracle"))
