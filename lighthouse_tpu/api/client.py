@@ -156,17 +156,24 @@ class BeaconApiClient:
             "/eth/v1/validator/contribution_and_proofs", ssz_hex_list
         )
 
-    def produce_block_ssz(self, slot, randao_reveal, graffiti=None):
+    @staticmethod
+    def _produce_body(randao_reveal, graffiti):
         body = {"randao_reveal": "0x" + bytes(randao_reveal).hex()}
         if graffiti:
             body["graffiti"] = "0x" + bytes(graffiti).hex()
-        return self._post(f"/eth/v2/validator/blocks/{slot}", body)
+        return body
+
+    def produce_block_ssz(self, slot, randao_reveal, graffiti=None):
+        return self._post(
+            f"/eth/v2/validator/blocks/{slot}",
+            self._produce_body(randao_reveal, graffiti),
+        )
 
     def produce_blinded_block_ssz(self, slot, randao_reveal, graffiti=None):
-        body = {"randao_reveal": "0x" + bytes(randao_reveal).hex()}
-        if graffiti:
-            body["graffiti"] = "0x" + bytes(graffiti).hex()
-        return self._post(f"/eth/v1/validator/blinded_blocks/{slot}", body)
+        return self._post(
+            f"/eth/v1/validator/blinded_blocks/{slot}",
+            self._produce_body(randao_reveal, graffiti),
+        )
 
     def publish_blinded_block_ssz(self, ssz_hex_with_fork_id):
         return self._post(

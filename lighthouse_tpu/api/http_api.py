@@ -40,6 +40,11 @@ def _hex(b):
     return "0x" + bytes(b).hex()
 
 
+def _graffiti_from(body):
+    g = body.get("graffiti")
+    return bytes.fromhex(g.removeprefix("0x")) if g else None
+
+
 class _Handler(JsonHandler):
     server_version = VERSION
 
@@ -542,11 +547,7 @@ class _Handler(JsonHandler):
 
             slot = int(m.group(1))
             reveal = bytes.fromhex(body["randao_reveal"].removeprefix("0x"))
-            graffiti = (
-                bytes.fromhex(body["graffiti"].removeprefix("0x"))
-                if body.get("graffiti")
-                else None
-            )
+            graffiti = _graffiti_from(body)
             block, _ = chain.produce_block_on_state(
                 slot, reveal, graffiti=graffiti
             )
@@ -571,11 +572,7 @@ class _Handler(JsonHandler):
 
             slot = int(m.group(1))
             reveal = bytes.fromhex(body["randao_reveal"].removeprefix("0x"))
-            graffiti = (
-                bytes.fromhex(body["graffiti"].removeprefix("0x"))
-                if body.get("graffiti")
-                else None
-            )
+            graffiti = _graffiti_from(body)
             block, _, blinded = chain.produce_blinded_block_on_state(
                 slot, reveal, graffiti=graffiti
             )

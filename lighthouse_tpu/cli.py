@@ -63,6 +63,9 @@ def build_parser_with_subs():
     bn.add_argument("--dial", action="append", default=[],
                     metavar="HOST:PORT", help="static peer to connect (repeatable)")
 
+    boot = sub.add_parser("boot-node", help="chainless peer-exchange node")
+    boot.add_argument("--listen-port", type=int, default=9100)
+
     vc = sub.add_parser("vc", help="validator client")
     _add_common(vc)
     vc.add_argument("--beacon-node", default="http://127.0.0.1:5052")
@@ -141,6 +144,8 @@ def main(argv=None):
 
     if args.command == "bn":
         return _run_bn(args)
+    if args.command == "boot-node":
+        return _run_boot_node(args)
     if args.command == "vc":
         return _run_vc(args)
     if args.command == "am":
@@ -239,6 +244,16 @@ def _run_bn(args):
             if args.genesis_time is not None
             else int(_time.time())
         )
+        if args.genesis_time is None and args.dial:
+            # divergent interop genesis states still pass the fork-digest
+            # handshake (it excludes genesis_time) and then silently
+            # never agree — make the foot-gun loud
+            print(
+                "warning: --dial without --genesis-time: every node must "
+                "be started with the SAME --genesis-time to share a "
+                "genesis state",
+                file=sys.stderr,
+            )
         state = interop_genesis_state(
             interop_keypairs(args.interop_validators), genesis_time, spec
         )
@@ -269,6 +284,24 @@ def _run_bn(args):
     reason = node.executor.block_until_shutdown()
     print(f"shutting down: {reason}")
     return 1 if (reason and reason.failure) else 0
+
+
+def _run_boot_node(args):
+    """The boot_node binary's role: a chainless rendezvous that accepts
+    any fork (mirroring the dialer's digest) and serves peer exchange so
+    fresh nodes can find the mesh."""
+    import time
+
+    from .network.wire import WireNode
+
+    node = WireNode(None, port=args.listen_port, accept_any_fork=True)
+    print(f"boot node up — wire on :{node.port} (peer exchange only)")
+    try:
+        while True:
+            time.sleep(5)
+    except KeyboardInterrupt:
+        node.stop()
+        return 0
 
 
 def _run_vc(args):

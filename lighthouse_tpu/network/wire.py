@@ -239,8 +239,13 @@ class WireNode:
     """One network identity: a listening socket, dialed/accepted peers,
     topic handlers, and a req/resp client+server."""
 
-    def __init__(self, chain=None, port=0, peer_id=None, attnets=0):
+    def __init__(self, chain=None, port=0, peer_id=None, attnets=0,
+                 accept_any_fork=False):
         self.chain = chain
+        # boot-node mode (the reference's boot_node binary over discv5):
+        # no chain, no gossip interest — just handshake + peer exchange,
+        # so the fork-digest gate must not apply
+        self.accept_any_fork = accept_any_fork
         self.peer_id = peer_id or hashlib.sha256(
             struct.pack("dQ", time.time(), id(self))
         ).hexdigest()[:16]
@@ -295,12 +300,18 @@ class WireNode:
             head_slot=int(st.slot),
         )
 
-    def _hello_body(self):
+    def _hello_body(self, mirror_digest=None):
         pid = self.peer_id.encode()
+        status = self.local_status()
+        if mirror_digest is not None:
+            # chameleon reply for boot-node mode: a chainless node has no
+            # fork of its own, so it answers with the dialer's digest and
+            # passes THEIR gate
+            status.fork_digest = bytes(mirror_digest)
         return (
             bytes([len(pid)])
             + pid
-            + encode(StatusMessage, self.local_status())
+            + encode(StatusMessage, status)
             # announced listen port (connections come from ephemeral
             # ports, so peer exchange needs the dialable one)
             + struct.pack("<H", self.port)
@@ -356,7 +367,9 @@ class WireNode:
         status = decode(StatusMessage, hello_body[1 + n : -2])
         listen_port = struct.unpack("<H", hello_body[-2:])[0]
         ours = self.local_status()
-        if bytes(status.fork_digest) != bytes(ours.fork_digest):
+        if not self.accept_any_fork and bytes(status.fork_digest) != bytes(
+            ours.fork_digest
+        ):
             # irrelevant network: refuse the handshake
             peer.send_frame(
                 GOODBYE_FRAME, struct.pack("<Q", GB_IRRELEVANT_NETWORK)
@@ -413,7 +426,14 @@ class WireNode:
                         return
                     if not peer.sent_hello:
                         peer.sent_hello = True
-                        peer.send_frame(HELLO, self._hello_body())
+                        peer.send_frame(
+                            HELLO,
+                            self._hello_body(
+                                mirror_digest=bytes(peer.status.fork_digest)
+                                if self.accept_any_fork
+                                else None
+                            ),
+                        )
                         for topic in self.handlers:
                             peer.send_frame(SUBSCRIBE, topic.encode())
                     self._exchange_peers(peer)

@@ -335,6 +335,26 @@ def test_peer_exchange_discovery_meshes():
         nc.stop()
 
 
+def test_boot_node_rendezvous():
+    """Two nodes that know only the chainless boot node find each other
+    through its peer exchange (the boot_node binary's role)."""
+    boot = WireNode(None, accept_any_fork=True)
+    _, ca = _make_chain(0)
+    _, cb = _make_chain(0)
+    na, nb = WireNode(ca), WireNode(cb)
+    try:
+        na.dial("127.0.0.1", boot.port)
+        nb.dial("127.0.0.1", boot.port)
+        assert _wait(lambda: ("127.0.0.1", na.port) in nb.known_addrs)
+        new = nb.discover()
+        assert na.peer_id in new
+        assert _wait(lambda: nb.peer_id in na.peers)
+    finally:
+        boot.stop()
+        na.stop()
+        nb.stop()
+
+
 def test_light_client_updates_gossip_over_wire():
     """An altair chain imports a block; the node hook publishes the
     optimistic update on its gossip topic and a follower node receives
