@@ -30,10 +30,53 @@ import time
 # hardware.  BENCH_PLATFORM overrides in-process (sitecustomize clobbers
 # the JAX_PLATFORMS env var at interpreter startup, so an env var of that
 # name cannot be used for the override).
-import jax
 
-if os.environ.get("BENCH_PLATFORM"):
-    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+def _preflight_device():
+    """The axon tunnel has died mid-run twice (hangs, then refuses
+    remote_compile) — probe it in a SUBPROCESS with a hard timeout so a
+    sick device degrades this run to a clearly-labeled CPU measurement
+    instead of a 55-minute hang and rc=1."""
+    import subprocess
+    import sys
+
+    if os.environ.get("BENCH_PLATFORM"):
+        return os.environ["BENCH_PLATFORM"], "forced by BENCH_PLATFORM"
+    probe = (
+        "import jax\n"
+        "x = jax.jit(lambda v: v * 2 + 1)(jax.numpy.ones((128, 128)))\n"
+        "x.block_until_ready()\n"
+        "print(jax.devices()[0].platform)\n"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", probe],
+            capture_output=True,
+            text=True,
+            timeout=float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "300")),
+        )
+        if out.returncode == 0:
+            platform = out.stdout.strip().splitlines()[-1]
+            return None, f"device ok ({platform})"
+        return "cpu", f"device probe failed rc={out.returncode}: " + (
+            out.stderr.strip()[-200:] or "no stderr"
+        )
+    except subprocess.TimeoutExpired:
+        return "cpu", "device probe HUNG (tunnel dead?) — cpu fallback"
+
+
+_FORCED_PLATFORM, _PLATFORM_NOTE = _preflight_device()
+if _FORCED_PLATFORM == "cpu" and not os.environ.get("BENCH_PLATFORM"):
+    # evidence-of-life shapes: CPU compile times for the big pairing
+    # batches would blow any reasonable budget
+    os.environ.setdefault("BENCH_SETS", "32")
+    os.environ.setdefault("BENCH_SETS3", "256")
+    os.environ.setdefault("BENCH_SYNC_SLOTS", "2")
+
+import jax  # noqa: E402
+
+if _FORCED_PLATFORM:
+    jax.config.update("jax_platforms", _FORCED_PLATFORM)
 jax.config.update("jax_compilation_cache_dir", "/tmp/lighthouse_tpu_xla_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
@@ -213,6 +256,7 @@ def config_kernels():
 
 
 def main():
+    note("platform", platform=jax.devices()[0].platform, note=_PLATFORM_NOTE)
     primary = None
     # config 2 first: the guaranteed-green primary (round-1 shape)
     try:
@@ -247,6 +291,7 @@ def main():
                 "value": round(primary, 2),
                 "unit": "sets/s",
                 "vs_baseline": round(primary / BASELINE_SETS_PER_SEC, 4),
+                "platform": jax.devices()[0].platform,
             }
         )
     )

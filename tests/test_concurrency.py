@@ -1,0 +1,173 @@
+"""Concurrency discipline: the chain, HTTP API, wire gossip and
+processor drain running in parallel threads must neither deadlock nor
+corrupt shared state (SURVEY §5.2 — the reference leans on Rust's
+Send/Sync; here the shared structures are exercised under real
+threads).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+from lighthouse_tpu.api.http_api import BeaconApiServer
+from lighthouse_tpu.beacon.beacon_processor import BeaconProcessor
+from lighthouse_tpu.beacon.chain import BeaconChain
+from lighthouse_tpu.crypto.backend import SignatureVerifier
+from lighthouse_tpu.network.wire import WireNode
+from lighthouse_tpu.network.router import Router
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.testing.harness import Harness
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+
+SPEC = ChainSpec(preset=MinimalPreset)
+
+
+def test_api_gossip_and_import_run_concurrently():
+    """Producer imports blocks while an API reader hammers head/state
+    routes and a follower node receives everything over the wire; all
+    threads finish, no exceptions, heads agree."""
+    h = Harness(8, SPEC)
+    chain = BeaconChain(h.state.copy(), SPEC, verifier=SignatureVerifier("fake"))
+    server = BeaconApiServer(chain).start()
+    n_prod = WireNode(chain)
+    follower = BeaconChain(
+        Harness(8, SPEC).state.copy(), SPEC,
+        verifier=SignatureVerifier("fake"),
+    )
+    n_follow = WireNode(follower)
+    processor = BeaconProcessor(follower)
+    Router(n_follow.peer_id, follower, processor,
+           n_follow.bus_view(), n_follow.reqresp_view())
+    n_prod.dial("127.0.0.1", n_follow.port)
+
+    N_SLOTS = 8
+    errors = []
+    stop = threading.Event()
+
+    def reader():
+        # hammer the API the whole time the writer mutates the chain
+        url = f"http://127.0.0.1:{server.port}"
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(
+                    url + "/eth/v1/beacon/headers/head", timeout=5
+                ) as r:
+                    json.load(r)
+                with urllib.request.urlopen(
+                    url + "/eth/v1/beacon/states/head/root", timeout=5
+                ) as r:
+                    json.load(r)
+            except Exception as e:  # noqa: BLE001 — collect, don't die
+                errors.append(("reader", e))
+                return
+
+    def drainer():
+        while not stop.is_set():
+            try:
+                processor.process_pending()
+                time.sleep(0.005)
+            except Exception as e:  # noqa: BLE001
+                errors.append(("drainer", e))
+                return
+
+    threads = [
+        threading.Thread(target=reader, daemon=True) for _ in range(3)
+    ] + [threading.Thread(target=drainer, daemon=True)]
+    for t in threads:
+        t.start()
+    try:
+        pending = []
+        for slot in range(1, N_SLOTS + 1):
+            blk = h.produce_block(slot, attestations=pending)
+            h.process_block(blk, strategy="no_verification")
+            chain.on_tick(slot)
+            follower.on_tick(slot)   # wall clocks tick on every node
+            chain.process_block(blk)
+            n_prod.publish("beacon_block", blk)
+            pending = h.attest_slot(h.state, slot, hash_tree_root(blk.message))
+        # let gossip settle, then stop the background load
+        deadline = time.time() + 10
+        while time.time() < deadline and int(
+            follower.head_state.slot
+        ) < N_SLOTS:
+            follower.on_tick(N_SLOTS)
+            processor.process_pending()
+            time.sleep(0.02)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        server.stop()
+        n_prod.stop()
+        n_follow.stop()
+
+    assert not errors, f"background threads failed: {errors[:3]}"
+    assert all(not t.is_alive() for t in threads), "a thread deadlocked"
+    assert int(chain.head_state.slot) == N_SLOTS
+    assert follower.head_root == chain.head_root, "follower tracked the head"
+
+
+def test_concurrent_keymanager_mutations_stay_consistent():
+    """Parallel keystore imports and deletes through the VC API leave the
+    store in a consistent state (no torn reads, no lost keys)."""
+    from lighthouse_tpu.crypto import keys as K
+    from lighthouse_tpu.validator_client.http_api import ValidatorApiServer
+    from lighthouse_tpu.validator_client.validator_store import ValidatorStore
+
+    store = ValidatorStore(SPEC)
+    srv = ValidatorApiServer(store, SPEC).start()
+    keystores = [
+        (K.encrypt_keystore(9_000_000 + i, "pw", kdf="pbkdf2"), "pw")
+        for i in range(6)
+    ]
+    errors = []
+
+    def call(method, path, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}{path}",
+            data=json.dumps(body).encode(), method=method,
+        )
+        req.add_header("Authorization", f"Bearer {srv.token}")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.load(r)
+
+    def importer(ks, pw):
+        try:
+            call("POST", "/eth/v1/keystores",
+                 {"keystores": [json.dumps(ks)], "passwords": [pw]})
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    try:
+        threads = [
+            threading.Thread(target=importer, args=kp) for kp in keystores
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors
+        assert len(store.voting_pubkeys()) == 6
+
+        # concurrent deletes of disjoint keys
+        pks = ["0x" + pk.hex() for pk in store.voting_pubkeys()]
+
+        def deleter(p):
+            try:
+                call("DELETE", "/eth/v1/keystores", {"pubkeys": [p]})
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=deleter, args=(p,)) for p in pks[:3]
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors, errors[:3]
+        assert all(not t.is_alive() for t in threads), "a DELETE hung"
+        assert len(store.voting_pubkeys()) == 3
+    finally:
+        srv.stop()
