@@ -409,6 +409,19 @@ class _Handler(JsonHandler):
                 }
             )
 
+        m = re.fullmatch(r"/eth/v1/beacon/rewards/blocks/([^/]+)", path)
+        if m:
+            from ..beacon.rewards import RewardsError, block_rewards
+
+            root = self._resolve_block_root(m.group(1))
+            if root is None:
+                return self._err(404, "unknown block")
+            try:
+                data = block_rewards(chain, root)
+            except RewardsError as e:
+                return self._err(404, str(e))
+            return self._json({"data": data})
+
         if path == "/lighthouse/liveness":
             # the doppelganger-service probe: was each validator index seen
             # attesting (gossip or blocks) in the given epoch?
@@ -627,6 +640,33 @@ class _Handler(JsonHandler):
                     ]
                 }
             )
+
+        m = re.fullmatch(r"/eth/v1/beacon/rewards/attestations/(\d+)", path)
+        if m:
+            from ..beacon.rewards import RewardsError, attestation_rewards
+
+            try:
+                data = attestation_rewards(
+                    chain, int(m.group(1)), validator_ids=body or None
+                )
+            except RewardsError as e:
+                return self._err(404, str(e))
+            return self._json({"data": data})
+
+        m = re.fullmatch(r"/eth/v1/beacon/rewards/sync_committee/([^/]+)", path)
+        if m:
+            from ..beacon.rewards import RewardsError, sync_committee_rewards
+
+            root = self._resolve_block_root(m.group(1))
+            if root is None:
+                return self._err(404, "unknown block")
+            try:
+                data = sync_committee_rewards(
+                    chain, root, validator_ids=body or None
+                )
+            except RewardsError as e:
+                return self._err(404, str(e))
+            return self._json({"data": data})
 
         if path == "/eth/v1/validator/prepare_beacon_proposer":
             n = chain.prepare_proposers(

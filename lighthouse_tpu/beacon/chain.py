@@ -283,6 +283,43 @@ class BeaconChain:
             except (AttestationError, OpVerificationError) as e:
                 log.warning("slasher %s detection rejected: %s", kind, e)
 
+    def state_at_slot(self, slot):
+        """The canonical state advanced to `slot`: the last canonical
+        block at or before it, its stored post-state, process_slots the
+        rest (state_id.rs slot resolution for rewards/duties)."""
+        slot = int(slot)
+        head_slot = int(self.head_state.slot)
+        if head_slot == slot:
+            return self.head_state.copy()
+        if head_slot < slot:
+            state = self.head_state.copy()
+            return phase0.process_slots(state, slot, self.preset, spec=self.spec)
+        root = self.head_root
+        while root is not None:
+            blk = self.store.get_block(bytes(root))
+            if blk is None:
+                break
+            if int(blk.message.slot) <= slot:
+                break
+            root = bytes(blk.message.parent_root)
+        state = self.store.get_state(bytes(root)) if root is not None else None
+        if state is None and hasattr(self.store, "state_at_slot"):
+            # pruned from hot storage: cold restore-point reconstruction
+            state = self.store.state_at_slot(slot)
+            if state is not None:
+                state = state.copy()
+                if int(state.slot) < slot:
+                    state = phase0.process_slots(
+                        state, slot, self.preset, spec=self.spec
+                    )
+                return state
+        if state is None:
+            raise BlockError(f"no canonical state at or before slot {slot}")
+        state = state.copy()
+        if int(state.slot) < slot:
+            state = phase0.process_slots(state, slot, self.preset, spec=self.spec)
+        return state
+
     def _state_for_block(self, parent_root, slot):
         """Parent post-state advanced to the block's slot
         (cheap_state_advance_to_obtain_committees; here a full advance —

@@ -1,0 +1,269 @@
+"""Reward computation APIs.
+
+Mirror of /root/reference/beacon_node/beacon_chain/src/
+{attestation_rewards.rs, block_reward.rs, beacon_block_reward.rs,
+sync_committee_rewards.rs}: the beacon-API rewards endpoints — per-epoch
+attestation deltas (ideal + actual, by component), per-block proposer
+reward breakdowns, and per-participant sync-committee rewards.
+
+All three reuse the SAME code the state transition runs (the vectorized
+delta computation, the sync-aggregate formulas, a replay balance diff),
+so the reported numbers can never drift from the applied ones.
+"""
+
+import numpy as np
+
+from ..ssz import hash_tree_root
+from ..state_processing import altair, phase0
+from ..state_processing.altair import (
+    EFFECTIVE_BALANCE_INCREMENT,
+    PROPOSER_WEIGHT,
+    SYNC_REWARD_WEIGHT,
+    WEIGHT_DENOMINATOR,
+    get_base_reward_per_increment,
+)
+from ..state_processing.phase0 import get_total_active_balance
+
+
+class RewardsError(Exception):
+    pass
+
+
+def _resolve_ids(state, validator_ids):
+    """Beacon-API validator ids: decimal indices OR hex pubkeys."""
+    if not validator_ids:
+        return None
+    out = []
+    reg = state.validators
+    by_pk = None
+    for v in validator_ids:
+        s = str(v)
+        if s.startswith("0x") or (len(s) == 96 and not s.isdigit()):
+            if by_pk is None:
+                by_pk = {
+                    reg.pubkey[i].tobytes(): i for i in range(len(reg))
+                }
+            try:
+                idx = by_pk.get(bytes.fromhex(s.removeprefix("0x")))
+            except ValueError as e:
+                raise RewardsError(f"bad validator id {s!r}") from e
+            if idx is not None:
+                out.append(idx)
+        else:
+            try:
+                out.append(int(s))
+            except ValueError as e:
+                raise RewardsError(f"bad validator id {s!r}") from e
+    return out
+
+
+def attestation_rewards(chain, epoch, validator_ids=None):
+    """attestation_rewards.rs standard_attestation_rewards: the deltas
+    for attestations OF `epoch`, as applied at the end of epoch+1.
+    Returns {"ideal_rewards": [...], "total_rewards": [...]} in the
+    beacon-API shape (values in Gwei, penalties negative)."""
+    preset = chain.preset
+    # a state in epoch+1 (previous_epoch == epoch), advanced to its
+    # LAST slot so every attestation of `epoch` has been weighed in
+    last_slot = (epoch + 2) * preset.slots_per_epoch - 1
+    if last_slot > int(chain.head_state.slot):
+        # the inclusion window isn't over: rewards would be speculative
+        # (and a huge epoch would advance slots unboundedly — DoS)
+        raise RewardsError(
+            f"epoch {epoch} rewards not final until slot {last_slot}"
+        )
+    state = chain.state_at_slot(last_slot)
+    if not altair.is_altair_state(state):
+        raise RewardsError("attestation rewards require an altair+ state")
+    if altair.get_previous_epoch(state, preset) != epoch:
+        raise RewardsError(f"state does not cover epoch {epoch}")
+    quotient = None
+    if hasattr(state, "latest_execution_payload_header"):
+        from ..state_processing.bellatrix import (
+            INACTIVITY_PENALTY_QUOTIENT_BELLATRIX,
+        )
+
+        quotient = INACTIVITY_PENALTY_QUOTIENT_BELLATRIX
+    d = altair.compute_attestation_deltas(state, preset, quotient)
+
+    n = len(state.validators)
+    ids = _resolve_ids(state, validator_ids)
+    if ids is None:
+        ids = list(range(n))
+    total_rewards = [
+        {
+            "validator_index": str(i),
+            "head": str(int(d["head"][i])),
+            "target": str(int(d["target"][i])),
+            "source": str(int(d["source"][i])),
+            "inactivity": str(int(d["inactivity"][i])),
+        }
+        for i in ids
+        if 0 <= i < n and d["eligible"][i]
+    ]
+
+    # ideal rewards per effective-balance increment tier (what a
+    # perfectly-timely validator of that balance would have earned)
+    total_balance = get_total_active_balance(state, preset)
+    brpi = get_base_reward_per_increment(state, preset, total_balance)
+    total_increments = total_balance // EFFECTIVE_BALANCE_INCREMENT
+    finality_delay = epoch - int(state.finalized_checkpoint.epoch)
+    in_leak = finality_delay > altair.MIN_EPOCHS_TO_INACTIVITY_PENALTY
+    flag_weights = dict(
+        zip(("source", "target", "head"),
+            [w for _, w in altair.PARTICIPATION_FLAG_WEIGHTS])
+    )
+    participating = {}
+    for name, flag in (
+        ("source", altair.TIMELY_SOURCE_FLAG_INDEX),
+        ("target", altair.TIMELY_TARGET_FLAG_INDEX),
+        ("head", altair.TIMELY_HEAD_FLAG_INDEX),
+    ):
+        unslashed = altair.get_unslashed_participating_indices_np(
+            state, flag, epoch, preset
+        )
+        participating[name] = (
+            altair.get_total_balance(state, unslashed)
+            // EFFECTIVE_BALANCE_INCREMENT
+        )
+    ideal = []
+    max_eb = int(np.max(state.validators.effective_balance[:n])) if n else 0
+    for increments in range(1, max_eb // EFFECTIVE_BALANCE_INCREMENT + 1):
+        base = increments * brpi
+        row = {"effective_balance": str(increments * EFFECTIVE_BALANCE_INCREMENT)}
+        for name in ("source", "target", "head"):
+            if in_leak:
+                row[name] = "0"
+            else:
+                row[name] = str(
+                    int(base)
+                    * flag_weights[name]
+                    * int(participating[name])
+                    // (int(total_increments) * WEIGHT_DENOMINATOR)
+                )
+        ideal.append(row)
+    return {"ideal_rewards": ideal, "total_rewards": total_rewards}
+
+
+def sync_committee_rewards(chain, block_root, validator_ids=None):
+    """sync_committee_rewards.rs: the per-participant deltas the given
+    block's sync aggregate applied."""
+    block = chain.store.get_block(bytes(block_root))
+    if block is None:
+        raise RewardsError("unknown block")
+    body = block.message.body
+    if not hasattr(body, "sync_aggregate"):
+        raise RewardsError("pre-altair block has no sync aggregate")
+    pre_state = chain.store.get_state(bytes(block.message.parent_root))
+    if pre_state is None:
+        raise RewardsError("parent state unavailable")
+    state = pre_state.copy()
+    slot = int(block.message.slot)
+    if int(state.slot) < slot:
+        state = phase0.process_slots(state, slot, chain.preset, spec=chain.spec)
+    participant_reward, _ = _sync_reward_amounts(state, chain.preset)
+    committee_indices = altair.sync_committee_validator_indices(
+        state, chain.preset
+    )
+    resolved = _resolve_ids(state, validator_ids)
+    wanted = None if resolved is None else set(resolved)
+    # a validator can hold several committee positions: aggregate per
+    # validator (sync_committee_rewards.rs accumulates in a balance map)
+    totals = {}
+    for vi, bit in zip(committee_indices, body.sync_aggregate.sync_committee_bits):
+        if wanted is not None and vi not in wanted:
+            continue
+        totals[vi] = totals.get(vi, 0) + (
+            participant_reward if bit else -participant_reward
+        )
+    return [
+        {"validator_index": str(vi), "reward": str(r)}
+        for vi, r in sorted(totals.items())
+    ]
+
+
+def _sync_reward_amounts(state, preset):
+    total_balance = get_total_active_balance(state, preset)
+    brpi = get_base_reward_per_increment(state, preset, total_balance)
+    total_increments = total_balance // EFFECTIVE_BALANCE_INCREMENT
+    total_base_rewards = brpi * total_increments
+    max_participant_rewards = (
+        total_base_rewards * SYNC_REWARD_WEIGHT
+        // WEIGHT_DENOMINATOR
+        // preset.slots_per_epoch
+    )
+    participant_reward = int(
+        max_participant_rewards // preset.sync_committee_size
+    )
+    proposer_reward = int(
+        participant_reward
+        * PROPOSER_WEIGHT
+        // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+    )
+    return participant_reward, proposer_reward
+
+
+def block_rewards(chain, block_root):
+    """block_reward.rs / beacon_block_reward.rs: the proposer's reward
+    for one block, by replaying it on the parent state and diffing the
+    proposer balance — the exact amounts the STF credited — plus a
+    component breakdown."""
+    block = chain.store.get_block(bytes(block_root))
+    if block is None:
+        raise RewardsError("unknown block")
+    pre_state = chain.store.get_state(bytes(block.message.parent_root))
+    if pre_state is None:
+        raise RewardsError("parent state unavailable")
+    preset = chain.preset
+    slot = int(block.message.slot)
+    state = pre_state.copy()
+    if int(state.slot) < slot:
+        state = phase0.process_slots(state, slot, preset, spec=chain.spec)
+    proposer = int(block.message.proposer_index)
+    pre_balance = int(state.balances[proposer])
+
+    # components computable without instrumentation
+    body = block.message.body
+    sync_component = 0
+    if hasattr(body, "sync_aggregate"):
+        _, proposer_reward = _sync_reward_amounts(state, preset)
+        sync_component = proposer_reward * int(
+            sum(body.sync_aggregate.sync_committee_bits)
+        )
+    slashing_component = 0
+    for ps in body.proposer_slashings:
+        offender = int(ps.signed_header_1.message.proposer_index)
+        slashing_component += (
+            int(state.validators[offender].effective_balance)
+            // phase0.WHISTLEBLOWER_REWARD_QUOTIENT
+        )
+    newly_slashed = set()
+    for asl in body.attester_slashings:
+        a1 = {int(i) for i in asl.attestation_1.attesting_indices}
+        a2 = {int(i) for i in asl.attestation_2.attesting_indices}
+        for vi in sorted(a1 & a2):
+            if vi in newly_slashed:
+                continue   # the STF slashes (and pays) only once
+            v = state.validators[vi]
+            if phase0.is_slashable_validator(
+                v, phase0.get_current_epoch(state, preset)
+            ):
+                newly_slashed.add(vi)
+                slashing_component += (
+                    int(v.effective_balance)
+                    // phase0.WHISTLEBLOWER_REWARD_QUOTIENT
+                )
+
+    phase0.per_block_processing(
+        state, block, chain.spec,
+        signature_strategy=phase0.BlockSignatureStrategy.NO_VERIFICATION,
+        execution_engine=None,
+    )
+    total = int(state.balances[proposer]) - pre_balance
+    return {
+        "proposer_index": str(proposer),
+        "total": str(total),
+        "attestations": str(total - sync_component - slashing_component),
+        "sync_aggregate": str(sync_component),
+        "proposer_slashings_and_attester_slashings": str(slashing_component),
+    }

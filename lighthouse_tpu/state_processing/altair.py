@@ -263,16 +263,18 @@ def process_inactivity_updates(state, preset):
     state.inactivity_scores.set_np(np.maximum(scores, 0).astype(np.uint64))
 
 
-def process_rewards_and_penalties(
-    state, preset, inactivity_penalty_quotient=None
-):
+def compute_attestation_deltas(state, preset, inactivity_penalty_quotient=None):
     """Vectorized altair flag-based deltas (get_flag_index_deltas +
-    get_inactivity_penalty_deltas).  `inactivity_penalty_quotient`
-    overrides the altair constant for bellatrix+ (2^24 vs 3*2^24)."""
+    get_inactivity_penalty_deltas), returned as COMPONENT arrays — the
+    epoch transition applies the sum; the rewards API
+    (attestation_rewards.rs) reports the parts.
+
+    Returns a dict of int64 arrays keyed "source"/"target"/"head"
+    (signed: reward or -penalty per flag), "inactivity" (<= 0),
+    "rewards"/"penalties" (the totals the transition applies), plus
+    "eligible" (bool) and "base_reward"."""
     if inactivity_penalty_quotient is None:
         inactivity_penalty_quotient = INACTIVITY_PENALTY_QUOTIENT_ALTAIR
-    if get_current_epoch(state, preset) == GENESIS_EPOCH:
-        return
     prev = get_previous_epoch(state, preset)
     reg = state.validators
     n = len(reg)
@@ -292,6 +294,12 @@ def process_rewards_and_penalties(
     rewards = np.zeros(n, dtype=np.int64)
     penalties = np.zeros(n, dtype=np.int64)
     total_increments = total_balance // EFFECTIVE_BALANCE_INCREMENT
+    flag_names = {
+        TIMELY_SOURCE_FLAG_INDEX: "source",
+        TIMELY_TARGET_FLAG_INDEX: "target",
+        TIMELY_HEAD_FLAG_INDEX: "head",
+    }
+    components = {}
 
     for flag_index, weight in PARTICIPATION_FLAG_WEIGHTS:
         unslashed = get_unslashed_participating_indices_np(
@@ -301,16 +309,21 @@ def process_rewards_and_penalties(
         in_set[unslashed] = True
         attesting = eligible & in_set
         missing = eligible & ~in_set
+        comp = np.zeros(n, dtype=np.int64)
         if not in_leak:
             # spec get_total_balance floors at one increment
             participating_increments = (
                 get_total_balance(state, unslashed) // EFFECTIVE_BALANCE_INCREMENT
             )
-            rewards[attesting] += (
+            comp[attesting] += (
                 base_reward[attesting] * weight * participating_increments
             ) // (total_increments * WEIGHT_DENOMINATOR)
+            rewards[attesting] += comp[attesting]
         if flag_index != TIMELY_HEAD_FLAG_INDEX:
-            penalties[missing] += base_reward[missing] * weight // WEIGHT_DENOMINATOR
+            miss = base_reward[missing] * weight // WEIGHT_DENOMINATOR
+            penalties[missing] += miss
+            comp[missing] -= miss
+        components[flag_names[flag_index]] = comp
 
     # inactivity penalties (score-scaled, always applied to non-target)
     tgt = get_unslashed_participating_indices_np(
@@ -321,7 +334,28 @@ def process_rewards_and_penalties(
     lagging = eligible & ~tgt_mask
     scores = state.inactivity_scores.np.astype(np.int64)
     penalty_denominator = INACTIVITY_SCORE_BIAS * inactivity_penalty_quotient
-    penalties[lagging] += (eb[lagging] * scores[lagging]) // penalty_denominator
+    inactivity = np.zeros(n, dtype=np.int64)
+    inactivity[lagging] -= (
+        eb[lagging] * scores[lagging]
+    ) // penalty_denominator
+    penalties[lagging] += -inactivity[lagging]
+
+    components.update(
+        rewards=rewards, penalties=penalties, inactivity=inactivity,
+        eligible=eligible, base_reward=base_reward,
+    )
+    return components
+
+
+def process_rewards_and_penalties(
+    state, preset, inactivity_penalty_quotient=None
+):
+    """Apply the flag deltas at the epoch boundary."""
+    if get_current_epoch(state, preset) == GENESIS_EPOCH:
+        return
+    d = compute_attestation_deltas(state, preset, inactivity_penalty_quotient)
+    rewards, penalties = d["rewards"], d["penalties"]
+    n = len(state.validators)
 
     bal_u = state.balances.np
     if len(bal_u) and int(bal_u.max()) >= 2**62:
