@@ -41,9 +41,13 @@ def expand_message_xmd(msg, dst, len_in_bytes):
     l_i_b = len_in_bytes.to_bytes(2, "big")
     b0 = hashlib.sha256(z_pad + msg + l_i_b + b"\x00" + dst_prime).digest()
     b = [hashlib.sha256(b0 + b"\x01" + dst_prime).digest()]
+    b0_int = int.from_bytes(b0, "big")
     for i in range(2, ell + 1):
-        prev = b[-1]
-        xored = bytes(x ^ y for x, y in zip(b0, prev))
+        # one 256-bit int XOR instead of a per-byte generator (hot on
+        # the 2048-message gossip-batch prep path)
+        xored = (b0_int ^ int.from_bytes(b[-1], "big")).to_bytes(
+            _B_IN_BYTES, "big"
+        )
         b.append(hashlib.sha256(xored + bytes([i]) + dst_prime).digest())
     return b"".join(b)[:len_in_bytes]
 

@@ -373,7 +373,7 @@ def g1_from_ints(pts):
     xs = [0 if p is None else p[0] for p in pts]
     ys = [1 if p is None else p[1] for p in pts]
     zs = [0 if p is None else 1 for p in pts]
-    dev = lambda v: fp.to_mont(jnp.asarray(fp.ints_to_array(v)))
+    dev = lambda v: fp.to_mont_jit(jnp.asarray(fp.ints_to_array(v)))
     return (dev(xs), dev(ys), dev(zs))
 
 
@@ -392,7 +392,7 @@ def g2_from_ints(pts):
     ys0 = [1 if p is None else p[1][0] for p in pts]
     ys1 = [0 if p is None else p[1][1] for p in pts]
     zs = [0 if p is None else 1 for p in pts]
-    dev = lambda v: fp.to_mont(jnp.asarray(fp.ints_to_array(v)))
+    dev = lambda v: fp.to_mont_jit(jnp.asarray(fp.ints_to_array(v)))
     return ((dev(xs0), dev(xs1)), (dev(ys0), dev(ys1)), (dev(zs), dev([0] * len(pts))))
 
 

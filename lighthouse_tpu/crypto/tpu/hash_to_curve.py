@@ -224,8 +224,8 @@ def hash_to_field_host(msgs, dst=DST_POP):
     """Host: list of byte-strings -> two batched device Fp2 elements."""
     us = [hash_to_field_fp2(m, 2, dst) for m in msgs]
     def dev(vals):
-        c0 = fp.to_mont(jnp.asarray(fp.ints_to_array([v[0] for v in vals])))
-        c1 = fp.to_mont(jnp.asarray(fp.ints_to_array([v[1] for v in vals])))
+        c0 = fp.to_mont_jit(jnp.asarray(fp.ints_to_array([v[0] for v in vals])))
+        c1 = fp.to_mont_jit(jnp.asarray(fp.ints_to_array([v[1] for v in vals])))
         return (c0, c1)
     return dev([u[0] for u in us]), dev([u[1] for u in us])
 
