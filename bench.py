@@ -252,6 +252,38 @@ def config_kernels():
         return lambda x, y: pallas_fp.mont_mul_pallas(x, y)
 
     run("pallas_fused", pallas_fn)
+
+    # device G2 decompression vs host python (platform-dependent winner:
+    # host wins on CPU, the batched pow scans target the MXU)
+    try:
+        import random
+
+        from lighthouse_tpu.crypto.ref import bls as RB
+        from lighthouse_tpu.crypto.ref import curves as C
+        from lighthouse_tpu.crypto.tpu import decompress as dc
+
+        rng2 = random.Random(5)
+        nblob = min(B, 256)
+        blobs = [
+            C.g2_compress(RB.sign(rng2.randrange(1, 2**200), bytes([i % 256]) * 32))
+            for i in range(nblob)
+        ]
+        t0 = time.time()
+        for bb in blobs:
+            C.g2_decompress(bb, subgroup_check=False)
+        host_dt = time.time() - t0
+        dc.g2_decompress_batch(blobs)
+        t0 = time.time()
+        _, okm = dc.g2_decompress_batch(blobs)
+        dev_dt = time.time() - t0
+        out["g2_decompress"] = {
+            "batch": nblob,
+            "all_valid": bool(okm.all()),
+            "host_sigs_per_sec": round(nblob / host_dt, 1),
+            "device_sigs_per_sec": round(nblob / dev_dt, 1),
+        }
+    except Exception as e:
+        out["g2_decompress"] = {"error": str(e)[:200]}
     note("kernel_candidates", batch=B, **out)
 
 
