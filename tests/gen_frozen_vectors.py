@@ -19,7 +19,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from lighthouse_tpu.ssz import hash_tree_root  # noqa: E402
 from lighthouse_tpu.testing.harness import Harness  # noqa: E402
-from lighthouse_tpu.types import ChainSpec, MinimalPreset  # noqa: E402
+from lighthouse_tpu.types import ChainSpec, MainnetPreset, MinimalPreset  # noqa: E402
 
 OUT = os.path.join(os.path.dirname(__file__), "vectors", "state_transition.json")
 
@@ -84,16 +84,25 @@ SCENARIOS = {
         slots=4,
         ops=_deposit_schedule,
     ),
+    # mainnet-preset shapes (32-slot epochs, 512-wide sync committees,
+    # 8192-deep vectors) exercise different SSZ bounds than minimal;
+    # slow lane: 64 pure-python validator keys
+    "mainnet_altair_small": dict(
+        spec=ChainSpec(preset=MainnetPreset, altair_fork_epoch=0),
+        slots=5,
+        n_validators=64,
+        slow=True,
+    ),
 }
 
 
-def run_scenario(spec, slots, ops=None):
+def run_scenario(spec, slots, ops=None, n_validators=8):
     from lighthouse_tpu.state_processing.phase0 import (
         get_beacon_proposer_index,
         process_slots,
     )
 
-    h = Harness(8, spec)
+    h = Harness(n_validators, spec)
     schedule = ops(h) if ops is not None else {}
     roots = [hash_tree_root(h.state).hex()]
     pending = []
@@ -137,12 +146,21 @@ def run_scenario(spec, slots, ops=None):
     }
 
 
+def run_from_cfg(cfg):
+    """THE one cfg->run mapping (the test reuses it: regenerating with
+    different parameters than the checker would be a silent drift)."""
+    return run_scenario(
+        cfg["spec"], cfg["slots"], cfg.get("ops"),
+        cfg.get("n_validators", 8),
+    )
+
+
 def main():
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     out = {}
     for name, cfg in SCENARIOS.items():
         print("generating", name)
-        out[name] = run_scenario(cfg["spec"], cfg["slots"], cfg.get("ops"))
+        out[name] = run_from_cfg(cfg)
     with open(OUT, "w") as f:
         json.dump(out, f, indent=1)
     print("wrote", OUT)

@@ -7,7 +7,7 @@ import os
 
 import pytest
 
-from tests.gen_frozen_vectors import OUT, SCENARIOS, run_scenario
+from tests.gen_frozen_vectors import OUT, SCENARIOS, run_from_cfg
 
 
 @pytest.fixture(scope="module")
@@ -18,10 +18,18 @@ def frozen():
         return json.load(f)
 
 
-@pytest.mark.parametrize("name", sorted(SCENARIOS))
+@pytest.mark.parametrize(
+    "name",
+    [
+        pytest.param(n, marks=pytest.mark.slow)
+        if SCENARIOS[n].get("slow")
+        else n
+        for n in sorted(SCENARIOS)
+    ],
+)
 def test_frozen_state_roots(frozen, name):
     cfg = SCENARIOS[name]
-    got = run_scenario(cfg["spec"], cfg["slots"], cfg.get("ops"))
+    got = run_from_cfg(cfg)
     want = frozen[name]
     assert got["state_roots"] == want["state_roots"], (
         f"{name}: state roots diverged from the frozen vectors — if this "
