@@ -103,6 +103,9 @@ class BeaconApiClient:
             {"slot": slot, "committee_index": committee_index},
         )["data"]
 
+    def block_ssz(self, block_id):
+        return self._get(f"/eth/v2/beacon/blocks/{block_id}", {})
+
     def publish_block_ssz(self, ssz_hex_with_fork_id):
         return self._post(
             "/eth/v1/beacon/blocks", {"ssz": ssz_hex_with_fork_id}

@@ -275,6 +275,24 @@ class _Handler(JsonHandler):
                 return self._err(404, "block not found")
             return self._json({"data": {"root": _hex(root)}})
 
+        m = re.fullmatch(r"/eth/v2/beacon/blocks/([^/]+)", path)
+        if m:
+            # full signed block, ssz-hex with the store codec's fork id
+            # (the v2 block route sync tooling and explorers pull)
+            from ..beacon.store import _Codec
+
+            root = self._resolve_block_root(m.group(1))
+            blk = chain.store.get_block(root) if root is not None else None
+            if blk is None:
+                return self._err(404, "block not found")
+            codec = _Codec(chain.preset)
+            return self._json(
+                {
+                    "version": codec.fork_name_for_body(blk.message.body),
+                    "data": {"ssz": "0x" + codec.enc_block(blk).hex()},
+                }
+            )
+
         m = re.fullmatch(r"/eth/v1/validator/duties/proposer/(\d+)", path)
         if m:
             duties = self.bn.proposer_duties(int(m.group(1)))

@@ -101,29 +101,52 @@ class BridgeServer:
     def _serve_conn(self, conn):
         try:
             while True:
-                (frame_len,) = struct.unpack("<I", _recv_exact(conn, 4))
-                frame = _recv_exact(conn, frame_len)
-                cmd, sets = decode_request(frame)
-                if cmd == CMD_PING:
-                    payload = struct.pack("<BB", 1, 0)
-                elif cmd == CMD_VERIFY:
-                    ok = self.backend.verify_wire_sets(sets)
-                    payload = struct.pack("<B", 1 if ok else 0) + bytes(
-                        [1 if ok else 0] * len(sets)
-                    )
-                elif cmd == CMD_VERIFY_PER_SET:
-                    verdicts = self.backend.verify_wire_sets_per_set(sets)
-                    ok = all(verdicts)
-                    payload = struct.pack("<B", 1 if ok else 0) + bytes(
-                        [1 if v else 0 for v in verdicts]
-                    )
-                else:
+                # socket I/O: a hangup (or the EBADF a concurrent stop()
+                # induces) quietly ends THIS connection
+                try:
+                    (frame_len,) = struct.unpack("<I", _recv_exact(conn, 4))
+                    frame = _recv_exact(conn, frame_len)
+                except (OSError, struct.error):
+                    return
+                try:
+                    cmd, sets = decode_request(frame)
+                except (ValueError, struct.error, IndexError):
+                    # malformed frame: error reply, keep serving
                     payload = struct.pack("<B", 0)
-                conn.sendall(struct.pack("<I", len(payload)) + payload)
-        except (ConnectionError, struct.error):
-            pass
+                else:
+                    try:
+                        if cmd == CMD_PING:
+                            payload = struct.pack("<BB", 1, 0)
+                        elif cmd == CMD_VERIFY:
+                            ok = self.backend.verify_wire_sets(sets)
+                            payload = struct.pack(
+                                "<B", 1 if ok else 0
+                            ) + bytes([1 if ok else 0] * len(sets))
+                        elif cmd == CMD_VERIFY_PER_SET:
+                            verdicts = self.backend.verify_wire_sets_per_set(
+                                sets
+                            )
+                            ok = all(verdicts)
+                            payload = struct.pack(
+                                "<B", 1 if ok else 0
+                            ) + bytes([1 if v else 0 for v in verdicts])
+                        else:
+                            payload = struct.pack("<B", 0)
+                    except Exception:
+                        # a backend failure is a SERVER bug — log it
+                        # loudly, answer with an error byte (never a
+                        # silent disconnect the client can't diagnose)
+                        log.exception("bridge backend failed on cmd %s", cmd)
+                        payload = struct.pack("<B", 0)
+                try:
+                    conn.sendall(struct.pack("<I", len(payload)) + payload)
+                except OSError:
+                    return
         finally:
-            conn.close()
+            try:
+                conn.close()
+            except OSError:
+                pass
 
 
 class _KernelBackend:

@@ -69,6 +69,20 @@ def test_block_header_and_root(api):
     assert client.block_root("head") == chain.head_root
 
 
+def test_full_block_by_id(api):
+    from lighthouse_tpu.beacon.store import _Codec
+    from lighthouse_tpu.ssz import hash_tree_root
+
+    chain, client = api
+    resp = client.block_ssz("head")
+    codec = _Codec(chain.preset)
+    blk = codec.dec_block(bytes.fromhex(resp["data"]["ssz"][2:]))
+    assert hash_tree_root(blk.message) == chain.head_root
+    assert resp["version"] == codec.fork_name_for_body(blk.message.body)
+    with pytest.raises(ApiError, match="404"):
+        client.block_ssz("0x" + "77" * 32)
+
+
 def test_attester_duties_roundtrip(api):
     chain, client = api
     pk = bytes.fromhex(client.validator(0)["validator"]["pubkey"][2:])
