@@ -317,7 +317,11 @@ class LightClientStore:
         )
 
     def _committee_for(self, signature_slot):
-        period = self._period_of(int(signature_slot) - 1)
+        # compute_sync_committee_period_at_slot uses the signature slot
+        # itself: at the first slot of a new period the aggregate is
+        # already signed by the freshly-rotated committee.  (Only the
+        # fork/domain lookup uses signature_slot - 1.)
+        period = self._period_of(int(signature_slot))
         stored = self._period_of(int(self.finalized_header.slot))
         if period == stored:
             return self.current_sync_committee

@@ -35,8 +35,15 @@ BAN_THRESHOLD = -100.0
 def topic_matches(published, subscribed):
     """Exact topic or subnet-family match: 'beacon_attestation' covers
     'beacon_attestation_12', but 'beacon_attestation_1' must NOT
-    (digit-ambiguous startswith would)."""
-    return published == subscribed or published.startswith(subscribed + "_")
+    (digit-ambiguous startswith would).  The suffix after '_' must be
+    numeric so a family subscription only matches real subnet topics —
+    not sibling topics that merely share the prefix (e.g.
+    'sync_committee' must not swallow
+    'sync_committee_contribution_and_proof')."""
+    if published == subscribed:
+        return True
+    prefix = subscribed + "_"
+    return published.startswith(prefix) and published[len(prefix):].isdigit()
 
 
 class PeerScore:

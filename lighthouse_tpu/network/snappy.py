@@ -128,7 +128,8 @@ def compress(data):
 def decompress(data):
     data = bytes(data)
     ulen, pos = uvarint_decode(data, 0)
-    if ulen > (1 << 32):
+    if ulen >= (1 << 32):
+        # the snappy format caps the uncompressed length at 2**32 - 1
         raise SnappyError("unreasonable uncompressed length")
     out = bytearray()
     n = len(data)
