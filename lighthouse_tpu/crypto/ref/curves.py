@@ -56,14 +56,83 @@ def g1_double(pt):
 def g1_mul(pt, k):
     if k < 0:
         return g1_mul(g1_neg(pt), -k)
-    out = None
-    add = pt
+    return _jac_mul(
+        pt, k, 1,
+        lambda a: (a * a) % P,
+        lambda a, b: (a * b) % P,
+        lambda a, b: (a + b) % P,
+        lambda a, b: (a - b) % P,
+        lambda a: a == 0,
+        F.fp_inv,
+        lambda a, b: a == b,
+    )
+
+
+def _jac_mul(pt, k, one, sqr, mul, addf, subf, is_zero, inv, eq):
+    """Jacobian double-and-add: ONE field inversion total (the affine
+    ladder paid one Fermat inversion PER ADD — ~256 per signature, the
+    measured bottleneck of harness signing and vector generation).
+    Deterministic: bit-identical results to the affine ladder."""
+    if pt is None or k == 0:
+        return None
+
+    def jdouble(P):
+        X, Y, Z = P
+        A = sqr(X)
+        B = sqr(Y)
+        C = sqr(B)
+        t = subf(subf(sqr(addf(X, B)), A), C)
+        D = addf(t, t)
+        E = addf(addf(A, A), A)
+        X3 = subf(sqr(E), addf(D, D))
+        C4 = addf(addf(C, C), addf(C, C))
+        Y3 = subf(mul(E, subf(D, X3)), addf(C4, C4))
+        YZ = mul(Y, Z)
+        return (X3, Y3, addf(YZ, YZ))
+
+    def jadd(P, Q):
+        X1, Y1, Z1 = P
+        X2, Y2, Z2 = Q
+        Z1Z1 = sqr(Z1)
+        Z2Z2 = sqr(Z2)
+        U1 = mul(X1, Z2Z2)
+        U2 = mul(X2, Z1Z1)
+        S1 = mul(mul(Y1, Z2), Z2Z2)
+        S2 = mul(mul(Y2, Z1), Z1Z1)
+        if eq(U1, U2):
+            if not eq(S1, S2):
+                return None
+            return jdouble(P)
+        H = subf(U2, U1)
+        HH = addf(H, H)
+        I = sqr(HH)
+        J = mul(H, I)
+        rr = subf(S2, S1)
+        r = addf(rr, rr)
+        V = mul(U1, I)
+        X3 = subf(subf(sqr(r), J), addf(V, V))
+        SJ = mul(S1, J)
+        Y3 = subf(mul(r, subf(V, X3)), addf(SJ, SJ))
+        ZZH = mul(mul(Z1, Z2), H)
+        return (X3, Y3, addf(ZZH, ZZH))
+
+    acc = None
+    add = (pt[0], pt[1], one)
+    k = int(k)
     while k > 0:
         if k & 1:
-            out = g1_add(out, add)
-        add = g1_add(add, add)
+            acc = add if acc is None else jadd(acc, add)
         k >>= 1
-    return out
+        if k:
+            add = jdouble(add)
+    if acc is None:
+        return None
+    X, Y, Z = acc
+    if is_zero(Z):
+        return None
+    zi = inv(Z)
+    zi2 = sqr(zi)
+    return (mul(X, zi2), mul(Y, mul(zi, zi2)))
 
 
 def g1_in_subgroup(pt):
@@ -117,14 +186,11 @@ def g2_double(pt):
 def g2_mul(pt, k):
     if k < 0:
         return g2_mul(g2_neg(pt), -k)
-    out = None
-    add = pt
-    while k > 0:
-        if k & 1:
-            out = g2_add(out, add)
-        add = g2_add(add, add)
-        k >>= 1
-    return out
+    return _jac_mul(
+        pt, k, F.F2_ONE,
+        F.f2_sqr, F.f2_mul, F.f2_add, F.f2_sub,
+        F.f2_is_zero, F.f2_inv, F.f2_eq,
+    )
 
 
 # psi: the untwist-Frobenius-twist endomorphism on E'.
