@@ -1,0 +1,198 @@
+"""Generate frozen BLS batch-verify known-answer + negative vectors.
+
+Zero-egress stand-in for the EF bls12-381-tests / consensus-spec-tests BLS
+suites (the reference runs them via
+/root/reference/testing/ef_tests/src/cases/bls_batch_verify.rs:25-67 and
+Makefile:124-129).  Inputs are pinned as compressed point encodings →
+expected booleans, generated ONCE from the host oracle and committed; both
+backends (oracle and TPU kernel) must then reproduce the pinned verdicts
+forever.  Regenerate ONLY for intentional semantic changes:
+
+    python tests/gen_bls_vectors.py
+"""
+
+import json
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from lighthouse_tpu.crypto.ref import bls as RB  # noqa: E402
+from lighthouse_tpu.crypto.ref import curves as C
+
+OUT = os.path.join(os.path.dirname(__file__), "vectors", "bls_batch_verify.json")
+
+INF_G1 = C.g1_compress(None).hex()
+INF_G2 = C.g2_compress(None).hex()
+
+
+def _find_non_subgroup_g2():
+    """A point on E'(Fp2) outside the r-torsion: valid compressed encoding,
+    must be rejected by the subgroup check (both backends)."""
+    for seed in range(1, 1000):
+        blob = bytearray(96)
+        blob[0] = 0x80
+        blob[-1] = seed
+        try:
+            pt = C.g2_decompress(bytes(blob), subgroup_check=False)
+        except ValueError:
+            continue
+        if pt is not None and not C.g2_in_subgroup(pt):
+            return bytes(blob)
+    raise RuntimeError("no non-subgroup point found")
+
+
+def _set(sig_hex, pk_hexes, msg):
+    return {"signature": sig_hex, "pubkeys": pk_hexes, "message": msg.hex()}
+
+
+def main():
+    rng = random.Random(0xB15)
+    sks = [rng.randrange(1, RB.R) for _ in range(8)]
+    pks = [RB.sk_to_pk(sk) for sk in sks]
+    pk_hex = [C.g1_compress(p).hex() for p in pks]
+    msgs = [bytes([i]) * 32 for i in range(8)]
+
+    def sig_of(i, j, msg=None):
+        """aggregate signature of signers i..j-1 over msg (default msgs[i])."""
+        m = msgs[i] if msg is None else msg
+        return C.g2_compress(RB.aggregate([RB.sign(sk, m) for sk in sks[i:j]])).hex()
+
+    non_sub = _find_non_subgroup_g2().hex()
+
+    cases = []
+
+    def case(name, sets, expect, per_set=None, note=""):
+        cases.append(
+            {
+                "name": name,
+                "sets": sets,
+                "expect": expect,
+                "per_set": per_set if per_set is not None else [expect] * len(sets),
+                "note": note,
+            }
+        )
+
+    # -- positive
+    case(
+        "valid_single",
+        [_set(sig_of(0, 1), [pk_hex[0]], msgs[0])],
+        True,
+    )
+    case(
+        "valid_batch_ragged",
+        [
+            _set(sig_of(0, 1), [pk_hex[0]], msgs[0]),
+            _set(sig_of(0, 2, msgs[1]), pk_hex[0:2], msgs[1]),
+            _set(sig_of(0, 4, msgs[2]), pk_hex[0:4], msgs[2]),
+            _set(sig_of(3, 4), [pk_hex[3]], msgs[3]),
+        ],
+        True,
+        note="ragged pubkey counts 1/2/4/1 exercise the padding bucket",
+    )
+    case(
+        "valid_duplicate_pubkeys",
+        [_set(sig_of(0, 1), [pk_hex[0]], msgs[0]),
+         _set(
+            C.g2_compress(
+                RB.aggregate([RB.sign(sks[1], msgs[1]), RB.sign(sks[1], msgs[1])])
+            ).hex(),
+            [pk_hex[1], pk_hex[1]],
+            msgs[1],
+        )],
+        True,
+        note="same key twice: aggregate of two identical sigs (point doubling path)",
+    )
+    # -- negative: wrong statements over valid points
+    case(
+        "wrong_message",
+        [_set(sig_of(0, 1), [pk_hex[0]], msgs[1])],
+        False,
+    )
+    case(
+        "wrong_pubkey",
+        [_set(sig_of(0, 1), [pk_hex[1]], msgs[0])],
+        False,
+    )
+    case(
+        "mixed_validity_batch",
+        [
+            _set(sig_of(0, 1), [pk_hex[0]], msgs[0]),
+            _set(sig_of(1, 2), [pk_hex[1]], msgs[2]),  # signed msgs[1], claims msgs[2]
+            _set(sig_of(2, 3), [pk_hex[2]], msgs[2]),
+        ],
+        False,
+        per_set=[True, False, True],
+        note="one poisoned set fails the batch; per-set isolates it",
+    )
+    case(
+        "aggregate_one_wrong_signer",
+        [_set(
+            C.g2_compress(
+                RB.aggregate([RB.sign(sks[0], msgs[0]), RB.sign(sks[1], msgs[7])])
+            ).hex(),
+            pk_hex[0:2],
+            msgs[0],
+        )],
+        False,
+        note="aggregate where one signer signed a different message",
+    )
+    # -- negative: structural rejections
+    case(
+        "infinity_pubkey",
+        [_set(sig_of(0, 2, msgs[0]), [pk_hex[0], INF_G1], msgs[0])],
+        False,
+        note="generic_public_key.rs:70-72 infinity-pubkey rejection",
+    )
+    case(
+        "infinity_signature",
+        [_set(INF_G2, [pk_hex[0]], msgs[0])],
+        False,
+        note="infinity sig always rejected at the BLS layer; the zero-bits "
+        "sync-aggregate special case (signature_sets.rs:611-617) is handled "
+        "ABOVE this layer by constructing no set at all",
+    )
+    case(
+        "infinity_signature_infinity_pubkey",
+        [_set(INF_G2, [INF_G1], msgs[0])],
+        False,
+    )
+    case(
+        "non_subgroup_signature",
+        [_set(non_sub, [pk_hex[0]], msgs[0])],
+        False,
+        note="on-curve, out-of-subgroup G2: must fail the subgroup gate",
+    )
+    case(
+        "non_subgroup_poisons_batch",
+        [
+            _set(sig_of(0, 1), [pk_hex[0]], msgs[0]),
+            _set(non_sub, [pk_hex[1]], msgs[1]),
+        ],
+        False,
+        per_set=[True, False],
+    )
+    case("empty_batch", [], False, per_set=[])
+    case(
+        "empty_pubkeys",
+        [{"signature": sig_of(0, 1), "pubkeys": [], "message": msgs[0].hex()}],
+        False,
+    )
+
+    with open(OUT, "w") as f:
+        json.dump(
+            {
+                "description": "frozen BLS batch-verify known-answer vectors "
+                "(oracle-generated; EF bls_batch_verify stand-in)",
+                "dst": "BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_",
+                "cases": cases,
+            },
+            f,
+            indent=1,
+        )
+    print(f"wrote {len(cases)} cases -> {OUT}")
+
+
+if __name__ == "__main__":
+    main()

@@ -84,6 +84,40 @@ SCENARIOS = {
         slots=4,
         ops=_deposit_schedule,
     ),
+    # six unattested epochs push finality_delay past
+    # MIN_EPOCHS_TO_INACTIVITY_PENALTY: pins the inactivity-leak deltas
+    # and the recovery epoch after attestations resume
+    "phase0_inactivity_leak": dict(
+        spec=ChainSpec(preset=MinimalPreset),
+        slots=7 * MinimalPreset.slots_per_epoch,
+        no_attest_until=6 * MinimalPreset.slots_per_epoch,
+    ),
+    # altair leak rules differ (inactivity_scores, no leak rewards): a
+    # proposer slashing lands MID-LEAK (altair slashing quotients)
+    "altair_leak_with_slashing": dict(
+        spec=ChainSpec(preset=MinimalPreset, altair_fork_epoch=0),
+        slots=6 * MinimalPreset.slots_per_epoch,
+        no_attest_until=5 * MinimalPreset.slots_per_epoch,
+        ops=lambda h: {
+            3 * MinimalPreset.slots_per_epoch
+            + 2: {"proposer_slashings": [h.make_proposer_slashing(7, slot=1)]}
+        },
+    ),
+    # crosses the sync-committee rotation (epochs_per_sync_committee_period)
+    # with full aggregates: pins next_sync_committee promotion
+    "altair_sync_period_boundary": dict(
+        spec=ChainSpec(preset=MinimalPreset, altair_fork_epoch=0),
+        slots=MinimalPreset.epochs_per_sync_committee_period
+        * MinimalPreset.slots_per_epoch
+        + 4,
+    ),
+    # gaps in the chain: blocks skip slots 3,4 and 9 (proposer-absent
+    # slots); pins empty-slot state advance + attestation gap handling
+    "phase0_skipped_slots": dict(
+        spec=ChainSpec(preset=MinimalPreset),
+        slots=12,
+        skip_slots=(3, 4, 9),
+    ),
     # mainnet-preset shapes (32-slot epochs, 512-wide sync committees,
     # 8192-deep vectors) exercise different SSZ bounds than minimal;
     # slow lane: 64 pure-python validator keys
@@ -96,7 +130,8 @@ SCENARIOS = {
 }
 
 
-def run_scenario(spec, slots, ops=None, n_validators=8):
+def run_scenario(spec, slots, ops=None, n_validators=8, skip_slots=(),
+                 no_attest_until=0):
     from lighthouse_tpu.state_processing.phase0 import (
         get_beacon_proposer_index,
         process_slots,
@@ -104,11 +139,20 @@ def run_scenario(spec, slots, ops=None, n_validators=8):
 
     h = Harness(n_validators, spec)
     schedule = ops(h) if ops is not None else {}
+    skip_slots = set(skip_slots)
     roots = [hash_tree_root(h.state).hex()]
     pending = []
     slashed_present = False   # the proposer peek only matters after one
     for _ in range(slots):
         slot = int(h.state.slot) + 1
+        if slot in skip_slots:
+            # empty slot: per-slot processing only (skipped-slot path)
+            h.state = process_slots(
+                h.state.copy(), slot, spec.preset, spec=spec
+            )
+            pending = []
+            roots.append(hash_tree_root(h.state).hex())
+            continue
         if slashed_present:
             st = h.state.copy()
             st = process_slots(st, slot, spec.preset, spec=spec)
@@ -134,7 +178,11 @@ def run_scenario(spec, slots, ops=None, n_validators=8):
             slot, attestations=pending, **ops_here
         )
         h.process_block(block, strategy="no_verification")
-        pending = h.attest_slot(h.state, slot, hash_tree_root(block.message))
+        pending = (
+            []
+            if slot < no_attest_until
+            else h.attest_slot(h.state, slot, hash_tree_root(block.message))
+        )
         roots.append(hash_tree_root(h.state).hex())
     return {
         "slots": slots,
@@ -151,7 +199,8 @@ def run_from_cfg(cfg):
     different parameters than the checker would be a silent drift)."""
     return run_scenario(
         cfg["spec"], cfg["slots"], cfg.get("ops"),
-        cfg.get("n_validators", 8),
+        cfg.get("n_validators", 8), cfg.get("skip_slots", ()),
+        cfg.get("no_attest_until", 0),
     )
 
 
