@@ -1,0 +1,342 @@
+"""Noise-XX transport encryption: X25519 + ChaCha20-Poly1305 + HKDF-SHA256.
+
+Role mirror of libp2p's noise security protocol, which encrypts every
+reference connection (/root/reference/beacon_node/lighthouse_network/
+Cargo.toml:8 `noise` feature; the rust-libp2p noise upgrade).  Implements
+the Noise framework's XX handshake pattern:
+
+    -> e
+    <- e, ee, s, es
+    -> s, se
+
+with the spec's SymmetricState (ck/h chaining via HKDF-SHA256, MixHash /
+MixKey) and CipherState (ChaCha20-Poly1305, little-endian counter nonces).
+Both sides end with independent tx/rx cipher states and each other's
+authenticated static public key (the transport identity).
+
+Primitives are implemented from their RFCs on stdlib + numpy only (no
+crypto wheels in the image): X25519 per RFC 7748 (integer Montgomery
+ladder), ChaCha20 per RFC 8439 vectorized across blocks with numpy u32
+lanes, Poly1305 per RFC 8439 (Horner over 2^130 - 5 with python ints).
+"""
+
+import hashlib
+import hmac
+import os
+import struct
+
+import numpy as np
+
+# ------------------------------------------------------------------ X25519
+
+P25519 = 2**255 - 19
+A24 = 121665
+
+
+def _decode_u(u: bytes) -> int:
+    x = int.from_bytes(u, "little")
+    return x & ((1 << 255) - 1)
+
+
+def _decode_scalar(k: bytes) -> int:
+    x = bytearray(k)
+    x[0] &= 248
+    x[31] &= 127
+    x[31] |= 64
+    return int.from_bytes(bytes(x), "little")
+
+
+def x25519(k: bytes, u: bytes) -> bytes:
+    """RFC 7748 scalar multiplication (constant structure; host-side
+    handshake crypto, not performance-critical)."""
+    k_int = _decode_scalar(k)
+    x1 = _decode_u(u)
+    x2, z2, x3, z3 = 1, 0, x1, 1
+    swap = 0
+    for t in range(254, -1, -1):
+        k_t = (k_int >> t) & 1
+        swap ^= k_t
+        if swap:
+            x2, x3 = x3, x2
+            z2, z3 = z3, z2
+        swap = k_t
+        a = (x2 + z2) % P25519
+        aa = (a * a) % P25519
+        b = (x2 - z2) % P25519
+        bb = (b * b) % P25519
+        e = (aa - bb) % P25519
+        c = (x3 + z3) % P25519
+        d = (x3 - z3) % P25519
+        da = (d * a) % P25519
+        cb = (c * b) % P25519
+        x3 = (da + cb) % P25519
+        x3 = (x3 * x3) % P25519
+        z3 = (da - cb) % P25519
+        z3 = (z3 * z3 * x1) % P25519
+        x2 = (aa * bb) % P25519
+        z2 = (e * (aa + A24 * e)) % P25519
+    if swap:
+        x2, x3 = x3, x2
+        z2, z3 = z3, z2
+    out = (x2 * pow(z2, P25519 - 2, P25519)) % P25519
+    return out.to_bytes(32, "little")
+
+
+X25519_BASE = (9).to_bytes(32, "little")
+
+
+def keypair(seed=None):
+    sk = seed if seed is not None else os.urandom(32)
+    return sk, x25519(sk, X25519_BASE)
+
+
+# ---------------------------------------------------------------- ChaCha20
+
+_SIGMA = np.frombuffer(b"expand 32-byte k", dtype="<u4").copy()
+
+
+def _chacha_block_states(key: bytes, counter: int, nonce: bytes, nblocks: int):
+    """Initial states for `nblocks` consecutive counters: (16, n) u32."""
+    st = np.empty((16, nblocks), dtype=np.uint32)
+    st[0:4] = _SIGMA[:, None]
+    st[4:12] = np.frombuffer(key, dtype="<u4")[:, None]
+    st[12] = (counter + np.arange(nblocks, dtype=np.uint64)).astype(np.uint32)
+    st[13:16] = np.frombuffer(nonce, dtype="<u4")[:, None]
+    return st
+
+
+def _rotl(x, n):
+    return (x << np.uint32(n)) | (x >> np.uint32(32 - n))
+
+
+def _quarter(st, a, b, c, d):
+    st[a] += st[b]; st[d] = _rotl(st[d] ^ st[a], 16)
+    st[c] += st[d]; st[b] = _rotl(st[b] ^ st[c], 12)
+    st[a] += st[b]; st[d] = _rotl(st[d] ^ st[a], 8)
+    st[c] += st[d]; st[b] = _rotl(st[b] ^ st[c], 7)
+
+
+def chacha20_stream(key: bytes, counter: int, nonce: bytes, n: int) -> bytes:
+    """Keystream of n bytes — all blocks in parallel numpy lanes."""
+    nblocks = (n + 63) // 64
+    init = _chacha_block_states(key, counter, nonce, nblocks)
+    st = init.copy()
+    with np.errstate(over="ignore"):
+        for _ in range(10):
+            _quarter(st, 0, 4, 8, 12)
+            _quarter(st, 1, 5, 9, 13)
+            _quarter(st, 2, 6, 10, 14)
+            _quarter(st, 3, 7, 11, 15)
+            _quarter(st, 0, 5, 10, 15)
+            _quarter(st, 1, 6, 11, 12)
+            _quarter(st, 2, 7, 8, 13)
+            _quarter(st, 3, 4, 9, 14)
+        st += init
+    return st.T.astype("<u4").tobytes()[:n]
+
+
+def _poly1305(key32: bytes, msg: bytes) -> bytes:
+    """RFC 8439 one-time MAC."""
+    r = int.from_bytes(key32[:16], "little") & 0x0FFFFFFC0FFFFFFC0FFFFFFC0FFFFFFF
+    s = int.from_bytes(key32[16:], "little")
+    p = (1 << 130) - 5
+    acc = 0
+    for i in range(0, len(msg), 16):
+        blk = msg[i : i + 16]
+        n = int.from_bytes(blk, "little") + (1 << (8 * len(blk)))
+        acc = ((acc + n) * r) % p
+    return ((acc + s) & ((1 << 128) - 1)).to_bytes(16, "little")
+
+
+def _pad16(b: bytes) -> bytes:
+    return b"\x00" * ((16 - len(b) % 16) % 16)
+
+
+def aead_encrypt(key: bytes, nonce12: bytes, plaintext: bytes, ad: bytes) -> bytes:
+    otk = chacha20_stream(key, 0, nonce12, 32)
+    ct = bytes(
+        a ^ b for a, b in zip(plaintext, chacha20_stream(key, 1, nonce12, len(plaintext)))
+    ) if len(plaintext) < 1024 else (
+        np.frombuffer(plaintext, np.uint8)
+        ^ np.frombuffer(chacha20_stream(key, 1, nonce12, len(plaintext)), np.uint8)
+    ).tobytes()
+    mac_data = (
+        ad + _pad16(ad) + ct + _pad16(ct)
+        + struct.pack("<QQ", len(ad), len(ct))
+    )
+    return ct + _poly1305(otk, mac_data)
+
+
+class DecryptError(Exception):
+    pass
+
+
+def aead_decrypt(key: bytes, nonce12: bytes, ciphertext: bytes, ad: bytes) -> bytes:
+    if len(ciphertext) < 16:
+        raise DecryptError("short ciphertext")
+    ct, tag = ciphertext[:-16], ciphertext[-16:]
+    otk = chacha20_stream(key, 0, nonce12, 32)
+    mac_data = (
+        ad + _pad16(ad) + ct + _pad16(ct)
+        + struct.pack("<QQ", len(ad), len(ct))
+    )
+    if not hmac.compare_digest(_poly1305(otk, mac_data), tag):
+        raise DecryptError("bad tag")
+    if len(ct) < 1024:
+        return bytes(a ^ b for a, b in zip(ct, chacha20_stream(key, 1, nonce12, len(ct))))
+    return (
+        np.frombuffer(ct, np.uint8)
+        ^ np.frombuffer(chacha20_stream(key, 1, nonce12, len(ct)), np.uint8)
+    ).tobytes()
+
+
+# ---------------------------------------------------- Noise state machines
+
+
+def _hkdf2(ck: bytes, ikm: bytes):
+    prk = hmac.new(ck, ikm, hashlib.sha256).digest()
+    t1 = hmac.new(prk, b"\x01", hashlib.sha256).digest()
+    t2 = hmac.new(prk, t1 + b"\x02", hashlib.sha256).digest()
+    return t1, t2
+
+
+class CipherState:
+    def __init__(self, key=None):
+        self.key = key
+        self.n = 0
+
+    def _nonce(self):
+        return b"\x00" * 4 + struct.pack("<Q", self.n)
+
+    def encrypt(self, plaintext, ad=b""):
+        if self.key is None:
+            return plaintext
+        out = aead_encrypt(self.key, self._nonce(), plaintext, ad)
+        self.n += 1
+        return out
+
+    def decrypt(self, ciphertext, ad=b""):
+        if self.key is None:
+            return ciphertext
+        out = aead_decrypt(self.key, self._nonce(), ciphertext, ad)
+        self.n += 1
+        return out
+
+
+_PROTOCOL_NAME = b"Noise_XX_25519_ChaChaPoly_SHA256"
+
+
+class SymmetricState:
+    def __init__(self):
+        self.h = hashlib.sha256(_PROTOCOL_NAME).digest() if len(
+            _PROTOCOL_NAME
+        ) > 32 else _PROTOCOL_NAME + b"\x00" * (32 - len(_PROTOCOL_NAME))
+        self.ck = self.h
+        self.cipher = CipherState()
+
+    def mix_hash(self, data: bytes):
+        self.h = hashlib.sha256(self.h + data).digest()
+
+    def mix_key(self, ikm: bytes):
+        self.ck, temp_k = _hkdf2(self.ck, ikm)
+        self.cipher = CipherState(temp_k)
+
+    def encrypt_and_hash(self, plaintext: bytes) -> bytes:
+        out = self.cipher.encrypt(plaintext, ad=self.h)
+        self.mix_hash(out)
+        return out
+
+    def decrypt_and_hash(self, ciphertext: bytes) -> bytes:
+        out = self.cipher.decrypt(ciphertext, ad=self.h)
+        self.mix_hash(ciphertext)
+        return out
+
+    def split(self):
+        k1, k2 = _hkdf2(self.ck, b"")
+        return CipherState(k1), CipherState(k2)
+
+
+class HandshakeError(Exception):
+    pass
+
+
+class NoiseXX:
+    """One XX handshake endpoint.  Drive with write_message()/
+    read_message() alternately (initiator writes first); after message 3
+    `split()` yields (tx, rx) CipherStates and `remote_static` holds the
+    peer's authenticated identity key."""
+
+    def __init__(self, initiator: bool, static_sk: bytes = None):
+        self.initiator = initiator
+        self.s_sk, self.s_pk = keypair(static_sk)
+        self.e_sk = None
+        self.e_pk = None
+        self.remote_e = None
+        self.remote_static = None
+        self.ss = SymmetricState()
+        self.ss.mix_hash(b"")           # no prologue
+        self._msg = 0
+
+    # -- message 1: -> e
+    # -- message 2: <- e, ee, s, es
+    # -- message 3: -> s, se
+
+    def write_message(self, payload: bytes = b"") -> bytes:
+        msg = self._msg
+        self._msg += 1
+        if msg == 0:
+            if not self.initiator:
+                raise HandshakeError("responder cannot write message 1")
+            self.e_sk, self.e_pk = keypair()
+            self.ss.mix_hash(self.e_pk)
+            return self.e_pk + self.ss.encrypt_and_hash(payload)
+        if msg == 1:
+            if self.initiator:
+                raise HandshakeError("initiator cannot write message 2")
+            self.e_sk, self.e_pk = keypair()
+            self.ss.mix_hash(self.e_pk)
+            self.ss.mix_key(x25519(self.e_sk, self.remote_e))        # ee
+            enc_s = self.ss.encrypt_and_hash(self.s_pk)
+            self.ss.mix_key(x25519(self.s_sk, self.remote_e))        # es
+            return self.e_pk + enc_s + self.ss.encrypt_and_hash(payload)
+        if msg == 2:
+            if not self.initiator:
+                raise HandshakeError("responder cannot write message 3")
+            enc_s = self.ss.encrypt_and_hash(self.s_pk)
+            self.ss.mix_key(x25519(self.s_sk, self.remote_e))        # se
+            return enc_s + self.ss.encrypt_and_hash(payload)
+        raise HandshakeError("handshake complete")
+
+    def read_message(self, data: bytes) -> bytes:
+        msg = self._msg
+        self._msg += 1
+        try:
+            if msg == 0:
+                if self.initiator:
+                    raise HandshakeError("initiator cannot read message 1")
+                self.remote_e = data[:32]
+                self.ss.mix_hash(self.remote_e)
+                return self.ss.decrypt_and_hash(data[32:])
+            if msg == 1:
+                if not self.initiator:
+                    raise HandshakeError("responder cannot read message 2")
+                self.remote_e = data[:32]
+                self.ss.mix_hash(self.remote_e)
+                self.ss.mix_key(x25519(self.e_sk, self.remote_e))    # ee
+                self.remote_static = self.ss.decrypt_and_hash(data[32:80])
+                self.ss.mix_key(x25519(self.e_sk, self.remote_static))  # es
+                return self.ss.decrypt_and_hash(data[80:])
+            if msg == 2:
+                if self.initiator:
+                    raise HandshakeError("initiator cannot read message 3")
+                self.remote_static = self.ss.decrypt_and_hash(data[:48])
+                self.ss.mix_key(x25519(self.e_sk, self.remote_static))  # se
+                return self.ss.decrypt_and_hash(data[48:])
+        except DecryptError as e:
+            raise HandshakeError(f"handshake decrypt failed: {e}") from e
+        raise HandshakeError("handshake complete")
+
+    def split(self):
+        """(tx, rx) transport ciphers; initiator sends with the first."""
+        c1, c2 = self.ss.split()
+        return (c1, c2) if self.initiator else (c2, c1)

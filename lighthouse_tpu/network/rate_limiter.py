@@ -1,0 +1,104 @@
+"""Per-peer per-protocol request rate limiting (token buckets).
+
+Role mirror of /root/reference/beacon_node/lighthouse_network/src/rpc/
+rate_limiter.rs (server side) and self_limiter.rs (own outbound): each
+(peer, protocol) pair owns a token bucket with a protocol-specific quota;
+a request that exceeds it is answered with RESOURCE_UNAVAILABLE and the
+peer is scored down — sustained spam walks the score into a ban.  Block
+requests are charged by *block count*, not request count, so one giant
+BlocksByRange costs what 64 small ones do (rate_limiter.rs Quota
+semantics).
+
+Buckets refill continuously (classic token bucket ≡ the GCRA the
+reference uses, same steady-state rate, same burst bound) and idle
+buckets are pruned so a peer churn storm cannot grow memory unboundedly.
+"""
+
+import threading
+import time
+
+
+class Quota:
+    """max_tokens per period_s, burstable to max_tokens."""
+
+    __slots__ = ("max_tokens", "period_s")
+
+    def __init__(self, max_tokens, period_s):
+        self.max_tokens = float(max_tokens)
+        self.period_s = float(period_s)
+
+    @property
+    def rate(self):
+        return self.max_tokens / self.period_s
+
+
+# default quota table — the role of the reference's RPCRateLimiterBuilder
+# defaults (rpc/mod.rs): small fixed budgets for control messages, count-
+# charged budgets for block downloads
+DEFAULT_QUOTAS = {
+    "status": Quota(5, 15.0),
+    "goodbye": Quota(1, 10.0),
+    "ping": Quota(2, 10.0),
+    "metadata": Quota(2, 5.0),
+    "blocks_by_range": Quota(1024, 10.0),   # tokens = blocks requested
+    "blocks_by_root": Quota(128, 10.0),     # tokens = roots requested
+    "gossip_publish": Quota(200, 10.0),     # frames; flood-control
+}
+
+
+class RateLimited(Exception):
+    def __init__(self, key, wait_s):
+        super().__init__(f"rate limited on {key} (retry in {wait_s:.2f}s)")
+        self.key = key
+        self.wait_s = wait_s
+
+
+class RateLimiter:
+    def __init__(self, quotas=None, clock=time.monotonic, max_idle_s=120.0):
+        self.quotas = dict(DEFAULT_QUOTAS if quotas is None else quotas)
+        self._clock = clock
+        self._buckets = {}       # (peer_id, key) -> [tokens, last_refill]
+        self._lock = threading.Lock()
+        self._max_idle_s = max_idle_s
+        self._last_prune = clock()
+
+    def check(self, peer_id, key, tokens=1):
+        """Charge `tokens` against (peer_id, key); raise RateLimited if the
+        bucket cannot cover them.  Unknown keys are unlimited (mirrors the
+        reference: only configured protocols are limited)."""
+        quota = self.quotas.get(key)
+        if quota is None:
+            return
+        if tokens > quota.max_tokens:
+            # a single request larger than the whole bucket can never
+            # succeed — reject immediately (rate_limiter.rs too-large case)
+            raise RateLimited(key, float("inf"))
+        now = self._clock()
+        with self._lock:
+            bucket = self._buckets.get((peer_id, key))
+            if bucket is None:
+                bucket = [quota.max_tokens, now]
+                self._buckets[(peer_id, key)] = bucket
+            level, last = bucket
+            level = min(quota.max_tokens, level + (now - last) * quota.rate)
+            if level < tokens:
+                bucket[0], bucket[1] = level, now
+                raise RateLimited(key, (tokens - level) / quota.rate)
+            bucket[0], bucket[1] = level - tokens, now
+            if now - self._last_prune > self._max_idle_s:
+                self._prune(now)
+
+    def _prune(self, now):
+        self._last_prune = now
+        dead = [
+            k
+            for k, (_, last) in self._buckets.items()
+            if now - last > self._max_idle_s
+        ]
+        for k in dead:
+            del self._buckets[k]
+
+    def forget(self, peer_id):
+        with self._lock:
+            for k in [k for k in self._buckets if k[0] == peer_id]:
+                del self._buckets[k]

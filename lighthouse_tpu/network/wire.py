@@ -36,6 +36,8 @@ from ..ssz import Bytes4, Bytes32, Container, decode, encode, uint64
 from ..types.spec import compute_fork_data_root
 from . import snappy
 from .gossip import GossipKind, PeerScore
+from .gossip import topic_matches as _tm
+from .rate_limiter import RateLimited, RateLimiter
 
 log = logging.getLogger("lighthouse_tpu.wire")
 
@@ -50,6 +52,14 @@ GOODBYE_FRAME = 7
 PING = 8
 PONG = 9
 PEERS = 10     # peer exchange: "host:port" listen addresses, \n-joined
+GRAFT = 11     # gossipsub mesh: add me to your mesh for <topic>
+PRUNE = 12     # gossipsub mesh: drop me from your mesh for <topic>
+
+# mesh degree bounds (gossipsub D / D_lo / D_hi; service/gossipsub defaults)
+MESH_D = 6
+MESH_D_LO = 4
+MESH_D_HI = 12
+HEARTBEAT_S = 0.7
 
 # req/resp methods (rpc/protocol.rs Protocol enum)
 M_STATUS = 0
@@ -100,6 +110,13 @@ class MetaData(Container):
 
 class WireError(Exception):
     pass
+
+
+class PeerRateLimited(WireError):
+    """The remote answered R_RESOURCE_UNAVAILABLE: we are over its rate
+    quota.  Honest clients back off and retry (self_limiter.rs role) —
+    treating this like a hard failure would abort startup range-sync the
+    moment imports outpace the server's refill rate."""
 
 
 _uvarint = snappy.uvarint_encode
@@ -213,6 +230,8 @@ class _Peer:
         self.metadata_seq = 0
         self._wlock = threading.Lock()
         self._alive = True
+        self.tx = None               # CipherState after noise handshake
+        self.rx = None
 
     SEND_TIMEOUT = 20.0
 
@@ -220,12 +239,19 @@ class _Peer:
         frame = bytes([ftype]) + body
         try:
             with self._wlock:
+                if self.tx is not None:
+                    frame = self.tx.encrypt(frame)
                 self.sock.sendall(_uvarint(len(frame)) + frame)
         except OSError as e:
             # includes the SO_SNDTIMEO expiry: a peer that stopped reading
             # must be DROPPED, not allowed to wedge the sending thread
             self.close()
             raise ConnectionError(str(e)) from e
+
+    def send_raw(self, payload):
+        """Plaintext uvarint frame — handshake messages only."""
+        with self._wlock:
+            self.sock.sendall(_uvarint(len(payload)) + payload)
 
     def close(self):
         self._alive = False
@@ -240,8 +266,18 @@ class WireNode:
     topic handlers, and a req/resp client+server."""
 
     def __init__(self, chain=None, port=0, peer_id=None, attnets=0,
-                 accept_any_fork=False):
+                 accept_any_fork=False, quotas=None, encrypt=False,
+                 static_sk=None):
         self.chain = chain
+        # per-peer per-protocol token buckets (rpc/rate_limiter.rs role);
+        # quotas=None -> DEFAULT_QUOTAS, {} -> unlimited (tests)
+        self.limiter = RateLimiter(quotas)
+        # noise transport security (libp2p noise role): when on, EVERY
+        # connection runs the XX handshake before any protocol frame and
+        # all frames ride ChaCha20-Poly1305; a plaintext peer cannot talk
+        # to an encrypted node at all
+        self.encrypt = encrypt
+        self._static_sk = static_sk
         # boot-node mode (the reference's boot_node binary over discv5):
         # no chain, no gossip interest — just handshake + peer exchange,
         # so the fork-digest gate must not apply
@@ -270,10 +306,20 @@ class WireNode:
         self._listener.listen(32)
         self.port = self._listener.getsockname()[1]
         self._stopped = False
+        # gossipsub-style mesh: topic -> set of peer_ids we forward to
+        # (degree-bounded; replaces flood-to-all — the role of the
+        # reference's gossipsub mesh with graft/prune + heartbeat,
+        # service/gossipsub_scoring_parameters.rs neighborhood)
+        self.mesh = {}
+        self.forward_counts = {}       # mid -> peers forwarded to (stats)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True
         )
         self._accept_thread.start()
+        self._heartbeat_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True
+        )
+        self._heartbeat_thread.start()
 
     # ------------------------------------------------------------ status
 
@@ -325,6 +371,8 @@ class WireNode:
         sock = socket.create_connection((host, port), timeout=timeout)
         sock.settimeout(None)
         peer = _Peer(self, sock, (host, port))
+        if self.encrypt:
+            self._noise_handshake(peer, initiator=True)
         peer.sent_hello = True
         peer.send_frame(HELLO, self._hello_body())
         # the reader thread completes the handshake on the HELLO reply
@@ -411,13 +459,48 @@ class WireNode:
             except ConnectionError:
                 continue   # one dead peer must not hide the newcomer
 
+    def _noise_handshake(self, peer, initiator):
+        """Run the noise XX handshake over raw uvarint frames; all later
+        frames on this connection ride the split cipher states (libp2p
+        noise upgrade role)."""
+        from .noise import HandshakeError, NoiseXX
+
+        hs = NoiseXX(initiator, static_sk=self._static_sk)
+
+        def recv_raw():
+            n = _read_uvarint(peer.sock)
+            if n == 0 or n > 4096:
+                raise WireError(f"bad handshake frame length {n}")
+            return _read_exact(peer.sock, n)
+
+        try:
+            if initiator:
+                peer.send_raw(hs.write_message())
+                hs.read_message(recv_raw())
+                peer.send_raw(hs.write_message())
+            else:
+                hs.read_message(recv_raw())
+                peer.send_raw(hs.write_message())
+                hs.read_message(recv_raw())
+        except HandshakeError as e:
+            raise WireError(f"noise handshake failed: {e}") from e
+        peer.tx, peer.rx = hs.split()
+        peer.noise_static = hs.remote_static
+
     def _reader_loop(self, peer):
         try:
+            if self.encrypt and peer.rx is None:
+                # inbound connection: responder side of the handshake
+                self._noise_handshake(peer, initiator=False)
             while peer._alive:
                 length = _read_uvarint(peer.sock)
                 if length == 0 or length > MAX_FRAME:
                     raise WireError(f"bad frame length {length}")
                 frame = _read_exact(peer.sock, length)
+                if peer.rx is not None:
+                    frame = peer.rx.decrypt(frame)
+                    if not frame:
+                        raise WireError("empty frame")
                 ftype, body = frame[0], frame[1:]
                 if peer.peer_id is None:
                     if ftype != HELLO:
@@ -448,6 +531,7 @@ class WireNode:
             peer.close()
             if self.peers.get(peer.peer_id) is peer:
                 del self.peers[peer.peer_id]
+                self.limiter.forget(peer.peer_id)
             # fail anything still waiting on this peer
             with self._lock:
                 for rec in self._pending.values():
@@ -478,6 +562,20 @@ class WireNode:
                 if len(self.known_addrs) >= 1024:
                     break   # bounded: a PEERS flood can't grow it forever
                 self.known_addrs.add(addr)
+        elif ftype == GRAFT:
+            topic = body.decode()
+            # accept the graft only for topics we serve; else prune back
+            if any(
+                _tm(topic, sub) for sub in self.handlers
+            ) or topic in self.mesh:
+                self.mesh.setdefault(topic, set()).add(peer.peer_id)
+            else:
+                peer.send_frame(PRUNE, body)
+        elif ftype == PRUNE:
+            topic = body.decode()
+            members = self.mesh.get(topic)
+            if members is not None:
+                members.discard(peer.peer_id)
         elif ftype == GOODBYE_FRAME:
             peer.close()
         else:
@@ -513,26 +611,92 @@ class WireNode:
             return   # already flooded (e.g. re-publish of gossiped block)
         self._flood(topic, mid, snappy.compress(payload), exclude=None)
 
+    def _mesh_candidates(self, topic):
+        """Peers whose subscriptions cover `topic` (subnet families too)."""
+        return [
+            p for p in self.peers.values()
+            if any(_tm(topic, s) for s in p.topics)
+        ]
+
+    def _heartbeat_loop(self):
+        import random as _random
+
+        while not self._stopped:
+            time.sleep(HEARTBEAT_S)
+            try:
+                self._heartbeat(_random)
+            except Exception:
+                pass
+
+    def _heartbeat(self, _random):
+        """gossipsub heartbeat: keep every active topic's mesh degree in
+        [D_lo, D_hi], grafting random eligible peers in and pruning the
+        lowest-scored members out."""
+        for topic in list(self.mesh):
+            members = self.mesh[topic]
+            cands = {p.peer_id: p for p in self._mesh_candidates(topic)}
+            # drop vanished peers
+            members &= set(cands)
+            if len(members) < MESH_D_LO:
+                pool = [pid for pid in cands if pid not in members]
+                _random.shuffle(pool)
+                for pid in pool[: MESH_D - len(members)]:
+                    members.add(pid)
+                    try:
+                        cands[pid].send_frame(GRAFT, topic.encode())
+                    except ConnectionError:
+                        members.discard(pid)
+            elif len(members) > MESH_D_HI:
+                ranked = sorted(
+                    members, key=lambda pid: cands[pid].score.score
+                )
+                for pid in ranked[: len(members) - MESH_D]:
+                    members.discard(pid)
+                    try:
+                        cands[pid].send_frame(PRUNE, topic.encode())
+                    except ConnectionError:
+                        pass
+
+    def _mesh_for(self, topic):
+        """The forwarding set for one message: current mesh members, or
+        (mesh still forming / too few peers) every subscribed peer — the
+        flood fallback keeps small meshes fully connected."""
+        members = self.mesh.get(topic)
+        cands = self._mesh_candidates(topic)
+        if members is None:
+            members = self.mesh.setdefault(topic, set())
+        live = [p for p in cands if p.peer_id in members]
+        if len(live) >= MESH_D_LO or len(live) == len(cands):
+            return live
+        return cands
+
     def _flood(self, topic, mid, compressed, exclude):
         t = topic.encode()
         body = (
             bytes([len(t)]) + t + mid + compressed
         )
-        from .gossip import topic_matches
-
-        for peer in list(self.peers.values()):
+        targets = self._mesh_for(topic)
+        sent = 0
+        for peer in targets:
             if peer is exclude:
-                continue
-            # deliver only to peers subscribed to the topic's family
-            # (subnet topics announce their prefix subscription)
-            if not any(topic_matches(topic, s) for s in peer.topics):
                 continue
             try:
                 peer.send_frame(PUBLISH, body)
+                sent += 1
             except ConnectionError:
                 pass
+        self.forward_counts[bytes(mid)] = sent
+        while len(self.forward_counts) > SEEN_CACHE_SIZE:
+            self.forward_counts.pop(next(iter(self.forward_counts)))
 
     def _on_publish(self, peer, body):
+        try:
+            self.limiter.check(peer.peer_id, "gossip_publish")
+        except RateLimited:
+            # flood control: drop without processing; sustained spam
+            # walks the score into a ban
+            self._score(peer, -2.0)
+            return
         tlen = body[0]
         topic = body[1 : 1 + tlen].decode()
         mid = body[1 + tlen : 21 + tlen]
@@ -582,6 +746,25 @@ class WireNode:
 
     # --------------------------------------------------------- req/resp
 
+    # block-download requests retry through the remote's refill window
+    # instead of failing sync (self_limiter.rs pacing role): backoff
+    # doubles from 2 s and the attempts span one full 10 s default window
+    RATE_RETRIES = 3
+    RATE_BACKOFF_S = 2.0
+
+    def _request_paced(self, peer_id, method, req_body, timeout=30.0):
+        """_request, but PeerRateLimited sleeps out the remote's token
+        refill and retries before giving up."""
+        backoff = self.RATE_BACKOFF_S
+        for attempt in range(self.RATE_RETRIES + 1):
+            try:
+                return self._request(peer_id, method, req_body, timeout)
+            except PeerRateLimited:
+                if attempt == self.RATE_RETRIES:
+                    raise
+                time.sleep(backoff)
+                backoff *= 2
+
     def _request(self, peer_id, method, req_body, timeout=30.0):
         peer = self.peers.get(peer_id)
         if peer is None:
@@ -598,6 +781,8 @@ class WireNode:
             )
             if not rec[0].wait(timeout):
                 raise WireError(f"request {method} timed out")
+            if rec[2] == R_RESOURCE_UNAVAILABLE:
+                raise PeerRateLimited(f"request {method}: peer over-quota")
             if rec[2] not in (R_SUCCESS, R_PARTIAL):
                 raise WireError(f"request {method} failed: code {rec[2]}")
             return rec[1], rec[2]
@@ -613,8 +798,20 @@ class WireNode:
             return
         try:
             req = snappy.decompress(body[5:])
-            chunks = self._serve(peer, method, req)
+            # parse once; both quota charging and serving need the request
+            parsed = (
+                decode(BlocksByRangeRequest, req)
+                if method == M_BLOCKS_BY_RANGE
+                else None
+            )
+            self._charge_quota(peer, method, req, parsed)
+            chunks = self._serve(peer, method, req, parsed)
             code = R_SUCCESS
+        except RateLimited:
+            # rpc/rate_limiter.rs: over-quota requests get an error
+            # response, and the sender bleeds score toward a ban
+            chunks, code = [], R_RESOURCE_UNAVAILABLE
+            self._score(peer, -5.0)
         except WireError:
             chunks, code = [], R_INVALID_REQUEST
         except Exception:
@@ -637,6 +834,28 @@ class WireNode:
         out = struct.pack("<IBI", rid, code, sent) + bytes(body)
         peer.send_frame(RESPONSE, out)
 
+    _QUOTA_KEYS = {
+        M_STATUS: "status",
+        M_PING: "ping",
+        M_METADATA: "metadata",
+        M_BLOCKS_BY_RANGE: "blocks_by_range",
+        M_BLOCKS_BY_ROOT: "blocks_by_root",
+    }
+
+    def _charge_quota(self, peer, method, req, parsed=None):
+        """Block downloads are charged by block/root COUNT (one giant
+        BlocksByRange costs what many small ones do), control methods by
+        request."""
+        key = self._QUOTA_KEYS.get(method)
+        if key is None:
+            return
+        tokens = 1
+        if method == M_BLOCKS_BY_RANGE:
+            tokens = max(1, int(parsed.count))
+        elif method == M_BLOCKS_BY_ROOT:
+            tokens = max(1, len(req) // 32)
+        self.limiter.check(peer.peer_id, key, tokens)
+
     def _on_response(self, peer, body):
         rid, code, n = struct.unpack("<IBI", body[:9])
         pos = 9
@@ -654,7 +873,7 @@ class WireNode:
             rec[1], rec[2] = chunks, code
             rec[0].set()
 
-    def _serve(self, peer, method, req):
+    def _serve(self, peer, method, req, parsed=None):
         """Server side of the rpc protocols (router.rs on_rpc_request)."""
         if method == M_STATUS:
             return [encode(StatusMessage, self.local_status())]
@@ -679,10 +898,14 @@ class WireNode:
                     out.append(self.codec._block_codec.enc_block(b))
             return out
         if method == M_BLOCKS_BY_RANGE:
-            r = decode(BlocksByRangeRequest, req)
+            r = parsed if parsed is not None else decode(BlocksByRangeRequest, req)
             start, count = int(r.start_slot), int(r.count)
             if count > 1024:
                 raise WireError("count too large")
+            if int(r.step) != 1:
+                # the spec deprecated step to 1; answering as if step==1
+                # would hand the peer blocks at slots it did not ask for
+                raise WireError("step != 1 deprecated")
             blocks = {}
             root = self.chain.head_root
             while root is not None:
@@ -717,7 +940,7 @@ class WireNode:
         remaining = [bytes(r) for r in roots]
         out = []
         while remaining:
-            chunks, code = self._request(
+            chunks, code = self._request_paced(
                 peer_id, M_BLOCKS_BY_ROOT, b"".join(remaining)
             )
             blocks = [self.codec._block_codec.dec_block(c) for c in chunks]
@@ -743,7 +966,7 @@ class WireNode:
                 BlocksByRangeRequest(start_slot=cursor, count=end - cursor,
                                      step=step),
             )
-            chunks, code = self._request(peer_id, M_BLOCKS_BY_RANGE, req)
+            chunks, code = self._request_paced(peer_id, M_BLOCKS_BY_RANGE, req)
             blocks = [self.codec._block_codec.dec_block(c) for c in chunks]
             out.extend(blocks)
             if code != R_PARTIAL:

@@ -1,0 +1,106 @@
+"""Degree-bounded mesh gossip (gossipsub role): graft/prune + heartbeat.
+
+Role mirror of the reference's gossipsub mesh
+(/root/reference/beacon_node/lighthouse_network/src/service/
+gossipsub_scoring_parameters.rs neighborhoods): after the mesh forms,
+each node forwards each message to at most D_hi peers — not to every
+subscribed peer — while every node still receives every message.
+"""
+
+import time
+
+from lighthouse_tpu.network.wire import (
+    MESH_D_HI,
+    MESH_D_LO,
+    WireNode,
+)
+
+from tests.test_wire import _make_chain, _wait
+
+N_NODES = 16
+
+
+def test_mesh_bounds_forwarding_while_delivering_everywhere():
+    _, chain = _make_chain(8)
+    nodes = [WireNode(chain, quotas={}) for _ in range(N_NODES)]
+    received = [[] for _ in range(N_NODES)]
+    for i, n in enumerate(nodes):
+        n.subscribe(
+            "beacon_block",
+            (lambda idx: lambda pid, msg: received[idx].append(msg) or True)(i),
+        )
+    try:
+        # full clique
+        for i in range(N_NODES):
+            for j in range(i + 1, N_NODES):
+                nodes[i].dial("127.0.0.1", nodes[j].port)
+        # prime the mesh state, then let a few heartbeats graft
+        blocks, root = [], chain.head_root
+        while root is not None and len(blocks) < 8:
+            b = chain.store.get_block(bytes(root))
+            if b is None or int(b.message.slot) == 0:
+                break
+            blocks.append(b)
+            root = bytes(b.message.parent_root)
+        assert len(blocks) == 8
+        nodes[0].publish("beacon_block", blocks[0])
+        # every node EXCEPT the publisher receives (no self-delivery)
+        assert _wait(
+            lambda: all(len(r) >= 1 for r in received[1:]), timeout=10
+        ), [len(r) for r in received]
+        time.sleep(3.0)   # ~4 heartbeats: meshes converge to degree D
+
+        # meshes formed: bounded degree on every node
+        for n in nodes:
+            members = n.mesh.get("beacon_block", set())
+            assert len(members) <= MESH_D_HI + 1, (n.peer_id, len(members))
+
+        # only post-convergence traffic counts toward the degree bound
+        # (the very first publish legitimately flood-bootstraps the mesh)
+        for n in nodes:
+            n.forward_counts.clear()
+        for blk in blocks[1:6]:
+            nodes[0].publish("beacon_block", blk)
+        assert _wait(
+            lambda: all(len(r) >= 6 for r in received[1:]), timeout=10
+        ), [len(r) for r in received]
+
+        # forward-count assertion: with 15 candidate peers each, a
+        # flooding node would forward to 15; the mesh caps it at D_hi
+        capped = 0
+        for n in nodes:
+            for mid, sent in n.forward_counts.items():
+                assert sent <= MESH_D_HI + 1, (n.peer_id, sent)
+                capped += 1
+        assert capped > 0, "no forwards recorded"
+        # at least SOME node forwarded to fewer peers than a flood would
+        assert any(
+            sent < N_NODES - 1
+            for n in nodes
+            for sent in n.forward_counts.values()
+        )
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_graft_rejected_for_unserved_topic():
+    _, chain = _make_chain()
+    a = WireNode(chain, quotas={})
+    b = WireNode(chain, quotas={})
+    a.subscribe("beacon_block", lambda pid, msg: True)
+    try:
+        pid_b = a.dial("127.0.0.1", b.port)
+        peer_b = a.peers[pid_b]
+        from lighthouse_tpu.network.wire import GRAFT
+
+        peer_b.send_frame(GRAFT, b"some_unknown_topic")
+        # b must NOT adopt the topic; it prunes back instead
+        assert not _wait(
+            lambda: "some_unknown_topic" in b.mesh
+            and b.mesh["some_unknown_topic"],
+            timeout=1.0,
+        )
+    finally:
+        a.stop()
+        b.stop()

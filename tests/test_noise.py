@@ -1,0 +1,120 @@
+"""Noise transport security: RFC-vector primitives + encrypted wire.
+
+Role mirror of libp2p noise (/root/reference/beacon_node/
+lighthouse_network/Cargo.toml:8): every frame between encrypted nodes
+rides X25519-agreed ChaCha20-Poly1305; plaintext peers cannot connect.
+"""
+
+import time
+
+import pytest
+
+from lighthouse_tpu.network.noise import (
+    DecryptError,
+    NoiseXX,
+    aead_decrypt,
+    aead_encrypt,
+    chacha20_stream,
+    x25519,
+)
+from lighthouse_tpu.network.wire import WireError, WireNode
+
+from tests.test_wire import _make_chain, _wait
+
+
+def test_x25519_rfc7748_vectors():
+    k = bytes.fromhex(
+        "a546e36bf0527c9d3b16154b82465edd62144c0ac1fc5a18506a2244ba449ac4"
+    )
+    u = bytes.fromhex(
+        "e6db6867583030db3594c1a424b15f7c726624ec26b3353b10a903a6d0ab1c4c"
+    )
+    assert x25519(k, u) == bytes.fromhex(
+        "c3da55379de9c6908e94ea4df28d084f32eccf03491c71f754b4075577a28552"
+    )
+    # symmetry: DH(a, B) == DH(b, A)
+    from lighthouse_tpu.network.noise import keypair
+
+    a_sk, a_pk = keypair(b"\x11" * 32)
+    b_sk, b_pk = keypair(b"\x22" * 32)
+    assert x25519(a_sk, b_pk) == x25519(b_sk, a_pk)
+
+
+def test_chacha20poly1305_rfc8439_vector():
+    pt = (
+        b"Ladies and Gentlemen of the class of '99: If I could offer you "
+        b"only one tip for the future, sunscreen would be it."
+    )
+    key = bytes.fromhex(
+        "808182838485868788898a8b8c8d8e8f909192939495969798999a9b9c9d9e9f"
+    )
+    nonce = bytes.fromhex("070000004041424344454647")
+    aad = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+    sealed = aead_encrypt(key, nonce, pt, aad)
+    assert sealed[-16:] == bytes.fromhex("1ae10b594f09e26a7e902ecbd0600691")
+    assert aead_decrypt(key, nonce, sealed, aad) == pt
+    with pytest.raises(DecryptError):
+        aead_decrypt(key, nonce, sealed[:-1] + bytes([sealed[-1] ^ 1]), aad)
+    with pytest.raises(DecryptError):
+        aead_decrypt(key, nonce, sealed, aad + b"x")
+
+
+def test_noise_xx_handshake_authenticates_statics():
+    ini, res = NoiseXX(True), NoiseXX(False)
+    res.read_message(ini.write_message())
+    ini.read_message(res.write_message())
+    res.read_message(ini.write_message())
+    assert ini.remote_static == res.s_pk
+    assert res.remote_static == ini.s_pk
+    itx, irx = ini.split()
+    rtx, rrx = res.split()
+    for i in range(3):   # nonce sequencing both directions
+        msg = f"frame {i}".encode()
+        assert rrx.decrypt(itx.encrypt(msg)) == msg
+        assert irx.decrypt(rtx.encrypt(msg)) == msg
+    # replay/tamper is rejected
+    c = itx.encrypt(b"x")
+    with pytest.raises(DecryptError):
+        rrx.decrypt(c[:-1] + bytes([c[-1] ^ 1]))
+
+
+def test_encrypted_wire_gossip_and_rpc():
+    _, chain = _make_chain(3)
+    a = WireNode(chain, encrypt=True, quotas={})
+    b = WireNode(chain, encrypt=True, quotas={})
+    got = []
+    b.subscribe("beacon_block", lambda pid, msg: got.append(msg) or True)
+    try:
+        bid = a.dial("127.0.0.1", b.port)
+        # req/resp over the encrypted stream
+        st = a.request_status(bid)
+        assert int(st.head_slot) == 3
+        blocks = a.request_blocks_by_range(bid, 1, 3)
+        assert len(blocks) == 3
+        # gossip over the encrypted stream
+        _wait(lambda: any(
+            "beacon_block" in p.topics for p in a.peers.values()
+        ))
+        blk = chain.store.get_block(chain.head_root)
+        a.publish("beacon_block", blk)
+        assert _wait(lambda: len(got) == 1)
+        # the remote's authenticated noise identity is pinned on the peer
+        peer = next(iter(a.peers.values()))
+        assert peer.noise_static is not None and len(peer.noise_static) == 32
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_plaintext_peer_rejected_by_encrypted_node():
+    _, chain = _make_chain()
+    server = WireNode(chain, encrypt=True)
+    client = WireNode(chain)          # plaintext
+    try:
+        with pytest.raises(WireError):
+            client.dial("127.0.0.1", server.port)
+        time.sleep(0.2)
+        assert not server.peers, "no session may form without the handshake"
+    finally:
+        client.stop()
+        server.stop()

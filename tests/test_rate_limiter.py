@@ -1,0 +1,174 @@
+"""Req/resp rate limiting and gossip flood control.
+
+Role mirror of /root/reference/beacon_node/lighthouse_network/src/rpc/
+rate_limiter.rs: per-peer per-protocol token buckets, block requests
+charged by count, over-quota requests answered with RESOURCE_UNAVAILABLE,
+and sustained spam walking the sender's score into a ban.
+"""
+
+import time
+
+import pytest
+
+from lighthouse_tpu.network.rate_limiter import (
+    Quota,
+    RateLimited,
+    RateLimiter,
+)
+from lighthouse_tpu.network.wire import WireError, WireNode
+
+from tests.test_wire import _make_chain, _wait
+
+
+# ------------------------------------------------------------- unit
+
+
+def test_bucket_burst_then_refill():
+    clock = [0.0]
+    rl = RateLimiter({"status": Quota(3, 9.0)}, clock=lambda: clock[0])
+    for _ in range(3):
+        rl.check("p1", "status")
+    with pytest.raises(RateLimited):
+        rl.check("p1", "status")
+    clock[0] += 3.0   # one token refilled (rate = 1/3s)
+    rl.check("p1", "status")
+    with pytest.raises(RateLimited):
+        rl.check("p1", "status")
+
+
+def test_buckets_are_per_peer_and_per_key():
+    clock = [0.0]
+    rl = RateLimiter(
+        {"status": Quota(1, 10.0), "ping": Quota(1, 10.0)},
+        clock=lambda: clock[0],
+    )
+    rl.check("p1", "status")
+    rl.check("p2", "status")     # other peer unaffected
+    rl.check("p1", "ping")       # other protocol unaffected
+    with pytest.raises(RateLimited):
+        rl.check("p1", "status")
+
+
+def test_count_charging_and_oversize_rejection():
+    clock = [0.0]
+    rl = RateLimiter({"blocks_by_range": Quota(64, 10.0)}, clock=lambda: clock[0])
+    rl.check("p1", "blocks_by_range", tokens=60)
+    with pytest.raises(RateLimited):
+        rl.check("p1", "blocks_by_range", tokens=10)
+    # a request bigger than the whole bucket can NEVER succeed
+    with pytest.raises(RateLimited):
+        rl.check("p2", "blocks_by_range", tokens=65)
+
+
+def test_unknown_key_unlimited_and_forget():
+    rl = RateLimiter({"status": Quota(1, 10.0)})
+    for _ in range(100):
+        rl.check("p1", "unlimited_thing")
+    rl.check("p1", "status")
+    rl.forget("p1")
+    rl.check("p1", "status")   # fresh bucket after forget
+
+
+# ------------------------------------------------------ wire integration
+
+
+def test_spamming_peer_throttled_then_banned():
+    """6th status request inside the window is refused; sustained spam
+    walks the spammer into a ban (score -5 per violation, ban at -100)."""
+    _, chain = _make_chain()
+    server = WireNode(chain)
+    client = WireNode(chain, quotas={})    # client side unlimited
+    try:
+        sid = client.dial("127.0.0.1", server.port)
+        # the dial-time startup sync already spent one status token
+        for _ in range(4):
+            client.request_status(sid)
+        with pytest.raises(WireError, match="over-quota"):
+            client.request_status(sid)
+        # keep spamming: the server scores us down 5 per violation and
+        # bans at -100 → the connection drops
+        for _ in range(30):
+            try:
+                client.request_status(sid)
+            except WireError:
+                if client.peer_id not in server.peers:
+                    break
+        assert _wait(lambda: client.peer_id not in server.peers)
+        assert client.peer_id in server.banned_ids
+    finally:
+        client.stop()
+        server.stop()
+
+
+def test_blocks_by_range_charged_by_count():
+    _, chain = _make_chain(4)
+    server = WireNode(
+        chain, quotas={"blocks_by_range": Quota(8, 10.0)}
+    )
+    client = WireNode(chain, quotas={})
+    client.RATE_RETRIES = 0     # observe the raw rejection, no pacing
+    try:
+        sid = client.dial("127.0.0.1", server.port)
+        client.request_blocks_by_range(sid, 1, 4)   # 4 tokens
+        client.request_blocks_by_range(sid, 1, 4)   # 8 tokens: at the cap
+        with pytest.raises(WireError, match="over-quota"):
+            client.request_blocks_by_range(sid, 1, 4)
+    finally:
+        client.stop()
+        server.stop()
+
+
+def test_blocks_by_range_paces_through_quota():
+    """An honest syncing client is PACED by the server's quota, not
+    failed: the over-quota request backs off through the refill window
+    and then succeeds (self_limiter.rs role — the alternative is startup
+    range-sync aborting whenever imports outpace the server's refill)."""
+    _, chain = _make_chain(4)
+    server = WireNode(chain, quotas={"blocks_by_range": Quota(8, 2.0)})
+    client = WireNode(chain, quotas={})
+    client.RATE_BACKOFF_S = 1.0   # one backoff covers half the window
+    try:
+        sid = client.dial("127.0.0.1", server.port)
+        client.request_blocks_by_range(sid, 1, 4)   # 4 tokens
+        client.request_blocks_by_range(sid, 1, 4)   # 8: bucket empty
+        t0 = time.time()
+        blocks = client.request_blocks_by_range(sid, 1, 4)
+        assert time.time() - t0 >= 0.9, "must have waited out the refill"
+        assert len(blocks) == 4
+    finally:
+        client.stop()
+        server.stop()
+
+
+def test_gossip_publish_flood_dropped():
+    """A peer publishing past the gossip quota gets its frames dropped
+    (handler never sees them) and bleeds score."""
+    _, chain = _make_chain(8)
+    server = WireNode(chain, quotas={"gossip_publish": Quota(3, 30.0)})
+    client = WireNode(chain, quotas={})
+    got = []
+    server.subscribe("beacon_block", lambda pid, msg: got.append(msg) or True)
+    try:
+        client.dial("127.0.0.1", server.port)
+        _wait(lambda: any(
+            "beacon_block" in p.topics for p in client.peers.values()
+        ))
+        # 8 DISTINCT messages (distinct mids dodge the seen-cache dedup)
+        blocks, root = [], chain.head_root
+        while root is not None and len(blocks) < 8:
+            b = chain.store.get_block(bytes(root))
+            if b is None or int(b.message.slot) == 0:
+                break
+            blocks.append(b)
+            root = bytes(b.message.parent_root)
+        assert len(blocks) == 8
+        for b in blocks:
+            client.publish("beacon_block", b)
+        time.sleep(0.5)
+        assert len(got) <= 3, f"flood got through: {len(got)}"
+        spammer = next(iter(server.peers.values()), None)
+        if spammer is not None:
+            assert spammer.score.score < 0
+    finally:
+        client.stop()
+        server.stop()
