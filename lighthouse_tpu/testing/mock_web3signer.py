@@ -1,0 +1,101 @@
+"""Mock Web3Signer server for tests and local development.
+
+Plays the remote half of the Web3Signer signing protocol
+(/root/reference/validator_client/src/signing_method.rs:80;
+the reference tests against a dockerised Web3Signer in
+validator_client/src/signing_method/web3signer.rs tests — this is the
+zero-dependency stand-in).  Holds secret keys, answers:
+
+    GET  /upcheck                     -> "OK"
+    GET  /api/v1/eth2/publicKeys      -> ["0x..", ...]
+    POST /api/v1/eth2/sign/0x{pk}     -> {"signature": "0x.."}
+
+Optionally enforces its own minimal slashing policy (Web3Signer ships with
+one): refuses to sign two different BLOCK_V2 roots for the same key — an
+independent second line of defense the tests exercise.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..crypto.ref import bls as RB
+from ..crypto.ref.curves import g1_compress, g2_compress
+
+
+class MockWeb3Signer:
+    def __init__(self, sks, host="127.0.0.1", port=0, enforce_policy=False):
+        self._sks = {g1_compress(RB.sk_to_pk(sk)): sk for sk in sks}
+        self._seen_block_roots = {}
+        self._lock = threading.Lock()
+        self.enforce_policy = enforce_policy
+        self.requests = []          # (pubkey, type, signing_root) audit log
+        signer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code, body, ctype="application/json"):
+                data = body.encode() if isinstance(body, str) else body
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/upcheck":
+                    return self._reply(200, "OK", "text/plain")
+                if self.path == "/api/v1/eth2/publicKeys":
+                    keys = ["0x" + pk.hex() for pk in signer._sks]
+                    return self._reply(200, json.dumps(keys))
+                self._reply(404, json.dumps({"error": "not found"}))
+
+            def do_POST(self):
+                prefix = "/api/v1/eth2/sign/"
+                if not self.path.startswith(prefix):
+                    return self._reply(404, json.dumps({"error": "not found"}))
+                try:
+                    pk = bytes.fromhex(self.path[len(prefix):].removeprefix("0x"))
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n).decode())
+                    root = bytes.fromhex(body["signing_root"].removeprefix("0x"))
+                    msg_type = body.get("type", "")
+                except (ValueError, KeyError, json.JSONDecodeError):
+                    return self._reply(400, json.dumps({"error": "bad request"}))
+                sk = signer._sks.get(pk)
+                if sk is None:
+                    return self._reply(404, json.dumps({"error": "unknown key"}))
+                with signer._lock:
+                    signer.requests.append((pk, msg_type, root))
+                    if signer.enforce_policy and msg_type == "BLOCK_V2":
+                        slot_roots = signer._seen_block_roots.setdefault(pk, set())
+                        if root not in slot_roots and slot_roots:
+                            return self._reply(
+                                412, json.dumps({"error": "slashing policy"})
+                            )
+                        slot_roots.add(root)
+                sig = g2_compress(RB.sign(sk, root))
+                self._reply(200, json.dumps({"signature": "0x" + sig.hex()}))
+
+        self.server = ThreadingHTTPServer((host, port), _Handler)
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+
+    @property
+    def url(self):
+        h, p = self.server.server_address[:2]
+        return f"http://{h}:{p}"
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+    def pubkeys(self):
+        return list(self._sks)

@@ -1,0 +1,115 @@
+"""Signing methods: local keystore and Web3Signer-style remote signing.
+
+Mirror of /root/reference/validator_client/src/signing_method.rs: the
+ValidatorStore computes the signing root and enforces slashing protection,
+then hands the root to a SigningMethod — either an in-process secret key
+(`LocalKeystore`) or an HTTP call to a remote signer holding the key
+(`Web3Signer`, signing_method.rs:80).  The remote wire format follows the
+Web3Signer ETH2 API: POST /api/v1/eth2/sign/{pubkey} with a JSON body
+carrying the message type, fork info and the signing root; the response is
+{"signature": "0x..."} (or a bare hex body).
+"""
+
+import json
+import urllib.request
+from urllib.error import HTTPError, URLError
+
+from ..crypto.ref import bls as RB
+from ..crypto.ref.curves import g1_compress, g2_compress
+
+
+class SigningError(Exception):
+    pass
+
+
+class MessageType:
+    """Web3Signer request `type` discriminants (signing_method.rs SignableMessage)."""
+
+    BLOCK_V2 = "BLOCK_V2"
+    ATTESTATION = "ATTESTATION"
+    RANDAO_REVEAL = "RANDAO_REVEAL"
+    AGGREGATION_SLOT = "AGGREGATION_SLOT"
+    AGGREGATE_AND_PROOF = "AGGREGATE_AND_PROOF"
+    SYNC_COMMITTEE_MESSAGE = "SYNC_COMMITTEE_MESSAGE"
+    SYNC_COMMITTEE_SELECTION_PROOF = "SYNC_COMMITTEE_SELECTION_PROOF"
+    SYNC_COMMITTEE_CONTRIBUTION_AND_PROOF = "SYNC_COMMITTEE_CONTRIBUTION_AND_PROOF"
+    VOLUNTARY_EXIT = "VOLUNTARY_EXIT"
+    VALIDATOR_REGISTRATION = "VALIDATOR_REGISTRATION"
+
+
+class LocalKeystore:
+    """In-process signing with a decrypted keystore secret key."""
+
+    kind = "local"
+
+    def __init__(self, sk: int):
+        self._sk = sk
+        self.pubkey = g1_compress(RB.sk_to_pk(sk))
+
+    def sign(self, signing_root: bytes, msg_type: str, fork_info=None) -> bytes:
+        return g2_compress(RB.sign(self._sk, signing_root))
+
+
+class Web3Signer:
+    """Remote signing over HTTP (signing_method.rs:80 Web3Signer variant).
+
+    The secret key never enters this process: the request carries only the
+    signing root (plus type/fork metadata for the signer's own policy
+    checks), and the response carries the compressed signature.
+    """
+
+    kind = "web3signer"
+
+    def __init__(self, pubkey: bytes, url: str, timeout: float = 5.0):
+        self.pubkey = bytes(pubkey)
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def sign(self, signing_root: bytes, msg_type: str, fork_info=None) -> bytes:
+        body = {"type": msg_type, "signing_root": "0x" + signing_root.hex()}
+        if fork_info is not None:
+            fork, gvr = fork_info
+            body["fork_info"] = {
+                "fork": {
+                    "previous_version": "0x" + bytes(fork.previous_version).hex(),
+                    "current_version": "0x" + bytes(fork.current_version).hex(),
+                    "epoch": str(int(fork.epoch)),
+                },
+                "genesis_validators_root": "0x" + bytes(gvr).hex(),
+            }
+        req = urllib.request.Request(
+            f"{self.url}/api/v1/eth2/sign/0x{self.pubkey.hex()}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                raw = r.read().decode()
+        except HTTPError as e:
+            raise SigningError(
+                f"web3signer refused ({e.code}): {e.read()[:200].decode(errors='replace')}"
+            ) from e
+        except URLError as e:
+            raise SigningError(f"web3signer unreachable: {e}") from e
+        try:
+            sig_hex = json.loads(raw)["signature"]
+        except (json.JSONDecodeError, KeyError, TypeError):
+            sig_hex = raw.strip()
+        sig = bytes.fromhex(sig_hex.removeprefix("0x"))
+        if len(sig) != 96:
+            raise SigningError(f"bad signature length {len(sig)} from signer")
+        return sig
+
+
+def list_remote_pubkeys(url: str, timeout: float = 5.0):
+    """GET /api/v1/eth2/publicKeys — discover the keys a remote signer holds
+    (the VC's --web3signer bulk-registration path)."""
+    try:
+        with urllib.request.urlopen(
+            url.rstrip("/") + "/api/v1/eth2/publicKeys", timeout=timeout
+        ) as r:
+            keys = json.loads(r.read().decode())
+    except (HTTPError, URLError, json.JSONDecodeError) as e:
+        raise SigningError(f"publicKeys query failed: {e}") from e
+    return [bytes.fromhex(k.removeprefix("0x")) for k in keys]

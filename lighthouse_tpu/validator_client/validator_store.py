@@ -2,17 +2,15 @@
 
 Mirror of /root/reference/validator_client/src/validator_store.rs: every
 signature flows through here — slashing-protection check first, then the
-signing method (local keystore; the Web3Signer remote path is the same
-seam with an HTTP call).  Doppelganger-protection gates participation
+SigningMethod (local keystore in-process, or Web3Signer over HTTP; see
+signing_method.py).  Doppelganger-protection gates participation
 (doppelganger_service.rs): a validator only signs once its initial
 quiet-watch epochs pass without seeing itself live elsewhere.
 """
 
-from ..crypto.ref import bls as RB
-from ..crypto.ref.curves import g1_compress, g2_compress
-from ..ssz import hash_tree_root
 from ..types import Domain, compute_signing_root
 from ..state_processing import signature_sets as sset
+from .signing_method import LocalKeystore, MessageType, Web3Signer
 from .slashing_protection import NotSafe, SlashingDatabase
 
 
@@ -68,15 +66,24 @@ class ValidatorStore:
         self.spec = spec
         self.preset = spec.preset
         self.slashing_db = slashing_db or SlashingDatabase()
-        self._keys = {}          # pubkey bytes -> secret key int
+        self._methods = {}       # pubkey bytes -> SigningMethod
         self._doppelganger = {}  # pubkey bytes -> remaining watch epochs
         self.doppelganger_epochs = doppelganger_epochs
 
     # ------------------------------------------------------------- keys
 
     def add_validator(self, sk: int):
-        pk = g1_compress(RB.sk_to_pk(sk))
-        self._keys[pk] = sk
+        return self.add_signing_method(LocalKeystore(sk))
+
+    def add_remote_validator(self, pubkey: bytes, url: str, timeout=5.0):
+        """Register a key held by a Web3Signer-style remote signer
+        (signing_method.rs:80): the secret never enters this process, but
+        slashing protection and doppelganger gating apply identically."""
+        return self.add_signing_method(Web3Signer(pubkey, url, timeout))
+
+    def add_signing_method(self, method):
+        pk = bytes(method.pubkey)
+        self._methods[pk] = method
         self._doppelganger[pk] = self.doppelganger_epochs
         self.slashing_db.register_validator(pk)
         return pk
@@ -86,14 +93,14 @@ class ValidatorStore:
         slashing-protection history stays in the db for the interchange
         export (initialized_validators.rs delete semantics)."""
         pk = bytes(pubkey)
-        if pk not in self._keys:
+        if pk not in self._methods:
             return False
-        del self._keys[pk]
+        del self._methods[pk]
         self._doppelganger.pop(pk, None)
         return True
 
     def voting_pubkeys(self):
-        return list(self._keys)
+        return list(self._methods)
 
     # ----------------------------------------------------- doppelganger
 
@@ -119,19 +126,19 @@ class ValidatorStore:
 
     def _require_signable(self, pubkey):
         pk = bytes(pubkey)
-        if pk not in self._keys:
+        if pk not in self._methods:
             raise KeyError("unknown validator")
         count = self._doppelganger.get(pk, 0)
         if count == self._DETECTED:
             raise NotSafe("doppelganger detected — signing permanently disabled")
         if count > 0:
             raise NotSafe("doppelganger watch in progress")
-        return self._keys[pk]
+        return self._methods[pk]
 
     # ---------------------------------------------------------- signing
 
     def sign_block(self, pubkey, block, fork, genesis_validators_root):
-        sk = self._require_signable(pubkey)
+        method = self._require_signable(pubkey)
         epoch = int(block.slot) // self.preset.slots_per_epoch
         domain = self.spec.get_domain(
             Domain.BEACON_PROPOSER, epoch, fork, genesis_validators_root
@@ -140,10 +147,11 @@ class ValidatorStore:
         self.slashing_db.check_and_insert_block_proposal(
             pubkey, int(block.slot), root
         )
-        return g2_compress(RB.sign(sk, root))
+        return method.sign(root, MessageType.BLOCK_V2,
+                           fork_info=(fork, genesis_validators_root))
 
     def sign_attestation(self, pubkey, data, fork, genesis_validators_root):
-        sk = self._require_signable(pubkey)
+        method = self._require_signable(pubkey)
         domain = self.spec.get_domain(
             Domain.BEACON_ATTESTER,
             int(data.target.epoch),
@@ -154,27 +162,30 @@ class ValidatorStore:
         self.slashing_db.check_and_insert_attestation(
             pubkey, int(data.source.epoch), int(data.target.epoch), root
         )
-        return g2_compress(RB.sign(sk, root))
+        return method.sign(root, MessageType.ATTESTATION,
+                           fork_info=(fork, genesis_validators_root))
 
     def sign_randao_reveal(self, pubkey, epoch, fork, genesis_validators_root):
-        sk = self._require_signable(pubkey)
+        method = self._require_signable(pubkey)
         domain = self.spec.get_domain(
             Domain.RANDAO, epoch, fork, genesis_validators_root
         )
         root = sset.compute_signing_root_uint64(epoch, domain)
-        return g2_compress(RB.sign(sk, root))
+        return method.sign(root, MessageType.RANDAO_REVEAL,
+                           fork_info=(fork, genesis_validators_root))
 
     def sign_selection_proof(self, pubkey, slot, fork, genesis_validators_root):
-        sk = self._require_signable(pubkey)
+        method = self._require_signable(pubkey)
         epoch = int(slot) // self.preset.slots_per_epoch
         domain = self.spec.get_domain(
             Domain.SELECTION_PROOF, epoch, fork, genesis_validators_root
         )
         root = sset.compute_signing_root_uint64(int(slot), domain)
-        return g2_compress(RB.sign(sk, root))
+        return method.sign(root, MessageType.AGGREGATION_SLOT,
+                           fork_info=(fork, genesis_validators_root))
 
     def sign_aggregate_and_proof(self, pubkey, agg_and_proof, fork, gvr):
-        sk = self._require_signable(pubkey)
+        method = self._require_signable(pubkey)
         epoch = (
             int(agg_and_proof.aggregate.data.slot) // self.preset.slots_per_epoch
         )
@@ -182,20 +193,22 @@ class ValidatorStore:
             Domain.AGGREGATE_AND_PROOF, epoch, fork, gvr
         )
         root = compute_signing_root(agg_and_proof, domain)
-        return g2_compress(RB.sign(sk, root))
+        return method.sign(root, MessageType.AGGREGATE_AND_PROOF,
+                           fork_info=(fork, gvr))
 
     def sign_sync_committee_message(self, pubkey, slot, block_root, fork, gvr):
-        sk = self._require_signable(pubkey)
+        method = self._require_signable(pubkey)
         epoch = int(slot) // self.preset.slots_per_epoch
         domain = self.spec.get_domain(Domain.SYNC_COMMITTEE, epoch, fork, gvr)
         root = sset.compute_signing_root_bytes32(block_root, domain)
-        return g2_compress(RB.sign(sk, root))
+        return method.sign(root, MessageType.SYNC_COMMITTEE_MESSAGE,
+                           fork_info=(fork, gvr))
 
     def sign_sync_selection_proof(self, pubkey, slot, subcommittee_index,
                                   fork, gvr):
         from ..types.containers import SyncAggregatorSelectionData
 
-        sk = self._require_signable(pubkey)
+        method = self._require_signable(pubkey)
         epoch = int(slot) // self.preset.slots_per_epoch
         domain = self.spec.get_domain(
             Domain.SYNC_COMMITTEE_SELECTION_PROOF, epoch, fork, gvr
@@ -203,20 +216,25 @@ class ValidatorStore:
         data = SyncAggregatorSelectionData(
             slot=slot, subcommittee_index=subcommittee_index
         )
-        return g2_compress(RB.sign(sk, compute_signing_root(data, domain)))
+        return method.sign(compute_signing_root(data, domain),
+                           MessageType.SYNC_COMMITTEE_SELECTION_PROOF,
+                           fork_info=(fork, gvr))
 
     def sign_contribution_and_proof(self, pubkey, msg, fork, gvr):
-        sk = self._require_signable(pubkey)
+        method = self._require_signable(pubkey)
         epoch = int(msg.contribution.slot) // self.preset.slots_per_epoch
         domain = self.spec.get_domain(
             Domain.CONTRIBUTION_AND_PROOF, epoch, fork, gvr
         )
-        return g2_compress(RB.sign(sk, compute_signing_root(msg, domain)))
+        return method.sign(compute_signing_root(msg, domain),
+                           MessageType.SYNC_COMMITTEE_CONTRIBUTION_AND_PROOF,
+                           fork_info=(fork, gvr))
 
     def sign_voluntary_exit(self, pubkey, exit_msg, fork, gvr):
-        sk = self._require_signable(pubkey)
+        method = self._require_signable(pubkey)
         domain = self.spec.get_domain(
             Domain.VOLUNTARY_EXIT, int(exit_msg.epoch), fork, gvr
         )
         root = compute_signing_root(exit_msg, domain)
-        return g2_compress(RB.sign(sk, root))
+        return method.sign(root, MessageType.VOLUNTARY_EXIT,
+                           fork_info=(fork, gvr))

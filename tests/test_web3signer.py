@@ -1,0 +1,126 @@
+"""Web3Signer-style remote signing (signing_method.rs:80 role).
+
+The VC signs duties through an HTTP remote signer with NO secret keys in
+the VC process; slashing protection and doppelganger gating still apply
+locally, and the signer's own audit log shows exactly what was requested.
+"""
+
+import pytest
+
+from lighthouse_tpu.beacon.chain import BeaconChain
+from lighthouse_tpu.crypto.backend import SignatureVerifier
+from lighthouse_tpu.testing.harness import Harness
+from lighthouse_tpu.testing.mock_web3signer import MockWeb3Signer
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+from lighthouse_tpu.validator_client.client import (
+    DirectBeaconNode,
+    ValidatorClient,
+)
+from lighthouse_tpu.validator_client.signing_method import (
+    MessageType,
+    SigningError,
+    Web3Signer,
+    list_remote_pubkeys,
+)
+from lighthouse_tpu.validator_client.slashing_protection import NotSafe
+from lighthouse_tpu.validator_client.validator_store import ValidatorStore
+
+SPEC = ChainSpec(preset=MinimalPreset)
+
+
+@pytest.fixture()
+def signer():
+    h = Harness(8, SPEC)
+    s = MockWeb3Signer([kp[0] for kp in h.keypairs]).start()
+    yield h, s
+    s.stop()
+
+
+def test_vc_signs_duties_via_remote_only(signer):
+    """End-to-end: proposals + attestations flow with zero local keys."""
+    h, s = signer
+    chain = BeaconChain(h.state.copy(), SPEC, verifier=SignatureVerifier("oracle"))
+    bn = DirectBeaconNode(chain)
+    store = ValidatorStore(SPEC)
+    for pk in list_remote_pubkeys(s.url):
+        store.add_remote_validator(pk, s.url)
+    assert all(
+        m.kind == "web3signer" for m in store._methods.values()
+    ), "local path must be disabled"
+
+    vc = ValidatorClient(store, bn, SPEC)
+    proposed = attested = 0
+    for slot in range(1, 5):
+        chain.on_tick(slot)
+        out = vc.act_on_slot(slot)
+        proposed += len(out["proposed"])
+        attested += len(out["attested"])
+    assert proposed == 4, "every slot proposed through the remote signer"
+    assert attested >= 4
+    assert int(chain.head_state.slot) == 4
+
+    types_seen = {t for (_, t, _) in s.requests}
+    assert MessageType.BLOCK_V2 in types_seen
+    assert MessageType.ATTESTATION in types_seen
+    assert MessageType.RANDAO_REVEAL in types_seen
+
+
+def test_remote_signature_equals_local(signer):
+    h, s = signer
+    sk = h.keypairs[0][0]
+    from lighthouse_tpu.validator_client.signing_method import LocalKeystore
+
+    local = LocalKeystore(sk)
+    remote = Web3Signer(local.pubkey, s.url)
+    root = b"\x5a" * 32
+    fork = SPEC.fork_at_epoch(0)
+    a = local.sign(root, MessageType.ATTESTATION, fork_info=(fork, b"\x00" * 32))
+    b = remote.sign(root, MessageType.ATTESTATION, fork_info=(fork, b"\x00" * 32))
+    assert a == b
+
+
+def test_slashing_protection_gates_remote_before_http(signer):
+    """The local slashing db refuses BEFORE any bytes reach the signer."""
+    h, s = signer
+    chain = BeaconChain(h.state.copy(), SPEC, verifier=SignatureVerifier("oracle"))
+    bn = DirectBeaconNode(chain)
+    store = ValidatorStore(SPEC)
+    pks = list_remote_pubkeys(s.url)
+    for pk in pks:
+        store.add_remote_validator(pk, s.url)
+    fork = SPEC.fork_at_epoch(0)
+    gvr = bytes(chain.head_state.genesis_validators_root)
+    block = bn.produce_block(1, b"\x00" * 96)
+    store.sign_block(pks[0], block, fork, gvr)
+    n_before = len(s.requests)
+    block2 = bn.produce_block(1, b"\x11" * 96)
+    block2.state_root = b"\x22" * 32  # different root, same slot
+    with pytest.raises(NotSafe):
+        store.sign_block(pks[0], block2, fork, gvr)
+    assert len(s.requests) == n_before, "refused locally, never sent"
+
+
+def test_unknown_key_and_dead_signer_raise(signer):
+    h, s = signer
+    ghost = Web3Signer(b"\xab" * 48, s.url)
+    with pytest.raises(SigningError, match="refused"):
+        ghost.sign(b"\x00" * 32, MessageType.ATTESTATION)
+    dead = Web3Signer(b"\xab" * 48, "http://127.0.0.1:1", timeout=0.5)
+    with pytest.raises(SigningError, match="unreachable"):
+        dead.sign(b"\x00" * 32, MessageType.ATTESTATION)
+
+
+def test_signer_side_policy_second_line(signer):
+    """A policy-enforcing signer refuses a conflicting block root even if
+    the VC-side slashing db were bypassed (defense in depth)."""
+    h, _ = signer
+    sk = h.keypairs[0][0]
+    s = MockWeb3Signer([sk], enforce_policy=True).start()
+    try:
+        pk = s.pubkeys()[0]
+        w = Web3Signer(pk, s.url)
+        w.sign(b"\x01" * 32, MessageType.BLOCK_V2)
+        with pytest.raises(SigningError, match="refused"):
+            w.sign(b"\x02" * 32, MessageType.BLOCK_V2)
+    finally:
+        s.stop()
