@@ -1,0 +1,249 @@
+// Append-only key-value log engine — the native store backend.
+//
+// The LevelDB slot of the reference's store layer
+// (/root/reference/beacon_node/store/src/lib.rs uses leveldb via the
+// `leveldb` crate; SURVEY.md §2.10 calls for a real native KV here).
+// On-disk format is IDENTICAL to the pure-Python FileKV
+// (lighthouse_tpu/beacon/store.py):
+//
+//     record := [klen u32 le][vlen u32 le][key][value]
+//     vlen == 0xFFFFFFFF  -> tombstone (no value bytes follow)
+//
+// so a datadir written by either engine opens under the other.  The
+// in-memory index maps key -> (offset, length); opening replays the log
+// and tolerates a torn tail write (crash recovery).  All entry points
+// are serialized by a per-handle mutex: ctypes releases the GIL during
+// calls, so the beacon processor's threads race here, not in Python.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kTombstone = 0xFFFFFFFFu;
+
+struct KvLog {
+    std::FILE* f = nullptr;           // append + read handle
+    std::string path;
+    std::unordered_map<std::string, std::pair<uint64_t, uint32_t>> index;
+    std::mutex mu;
+};
+
+bool replay(KvLog* h) {
+    if (std::fseek(h->f, 0, SEEK_END) != 0) return false;
+    long end = std::ftell(h->f);
+    if (end < 0) return false;
+    if (std::fseek(h->f, 0, SEEK_SET) != 0) return false;
+    std::vector<char> data(static_cast<size_t>(end));
+    if (end > 0 && std::fread(data.data(), 1, data.size(), h->f) != data.size())
+        return false;
+    size_t pos = 0, n = data.size();
+    while (pos + 8 <= n) {
+        uint32_t klen, vlen;
+        std::memcpy(&klen, data.data() + pos, 4);
+        std::memcpy(&vlen, data.data() + pos + 4, 4);
+        pos += 8;
+        if (pos + klen > n) break;                  // torn tail
+        std::string key(data.data() + pos, klen);
+        pos += klen;
+        if (vlen == kTombstone) {
+            h->index.erase(key);
+            continue;
+        }
+        if (pos + vlen > n) break;                  // torn tail
+        h->index[key] = {static_cast<uint64_t>(pos), vlen};
+        pos += vlen;
+    }
+    std::fseek(h->f, 0, SEEK_END);
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* kvlog_open(const char* path) {
+    auto* h = new KvLog();
+    h->path = path;
+    h->f = std::fopen(path, "ab+");
+    if (!h->f) {
+        delete h;
+        return nullptr;
+    }
+    if (!replay(h)) {
+        std::fclose(h->f);
+        delete h;
+        return nullptr;
+    }
+    return h;
+}
+
+int kvlog_put(void* hp, const uint8_t* k, uint32_t klen, const uint8_t* v,
+              uint32_t vlen) {
+    auto* h = static_cast<KvLog*>(hp);
+    std::lock_guard<std::mutex> lock(h->mu);
+    uint32_t hdr[2] = {klen, vlen};
+    if (std::fwrite(hdr, 4, 2, h->f) != 2) return -1;
+    if (klen && std::fwrite(k, 1, klen, h->f) != klen) return -1;
+    long off = std::ftell(h->f);
+    if (off < 0) return -1;
+    if (vlen && std::fwrite(v, 1, vlen, h->f) != vlen) return -1;
+    h->index[std::string(reinterpret_cast<const char*>(k), klen)] = {
+        static_cast<uint64_t>(off), vlen};
+    return 0;
+}
+
+// Returns a malloc'd buffer the caller releases with kvlog_free; NULL and
+// *out_len == UINT64_MAX means "not found", NULL with *out_len == 0 is an
+// empty value.
+uint8_t* kvlog_get(void* hp, const uint8_t* k, uint32_t klen,
+                   uint64_t* out_len) {
+    auto* h = static_cast<KvLog*>(hp);
+    std::lock_guard<std::mutex> lock(h->mu);
+    auto it = h->index.find(std::string(reinterpret_cast<const char*>(k), klen));
+    if (it == h->index.end()) {
+        *out_len = UINT64_MAX;
+        return nullptr;
+    }
+    uint64_t off = it->second.first;
+    uint32_t len = it->second.second;
+    *out_len = len;
+    if (len == 0) return nullptr;
+    std::fflush(h->f);
+    auto* buf = static_cast<uint8_t*>(std::malloc(len));
+    if (!buf) {
+        *out_len = UINT64_MAX;
+        return nullptr;
+    }
+    long cur = std::ftell(h->f);
+    if (std::fseek(h->f, static_cast<long>(off), SEEK_SET) != 0 ||
+        std::fread(buf, 1, len, h->f) != len) {
+        std::free(buf);
+        std::fseek(h->f, cur, SEEK_SET);
+        *out_len = UINT64_MAX;
+        return nullptr;
+    }
+    std::fseek(h->f, 0, SEEK_END);
+    return buf;
+}
+
+int kvlog_del(void* hp, const uint8_t* k, uint32_t klen) {
+    auto* h = static_cast<KvLog*>(hp);
+    std::lock_guard<std::mutex> lock(h->mu);
+    std::string key(reinterpret_cast<const char*>(k), klen);
+    if (h->index.find(key) == h->index.end()) return 0;
+    uint32_t hdr[2] = {klen, kTombstone};
+    if (std::fwrite(hdr, 4, 2, h->f) != 2) return -1;
+    if (klen && std::fwrite(k, 1, klen, h->f) != klen) return -1;
+    h->index.erase(key);
+    return 0;
+}
+
+// Keys matching a prefix, serialized [klen u32][key]... in one malloc'd
+// buffer (caller frees).  *out_len receives the byte length.
+uint8_t* kvlog_keys(void* hp, const uint8_t* prefix, uint32_t plen,
+                    uint64_t* out_len) {
+    auto* h = static_cast<KvLog*>(hp);
+    std::lock_guard<std::mutex> lock(h->mu);
+    std::string pre(reinterpret_cast<const char*>(prefix), plen);
+    uint64_t total = 0;
+    for (auto& kv : h->index)
+        if (kv.first.compare(0, pre.size(), pre) == 0)
+            total += 4 + kv.first.size();
+    *out_len = total;
+    if (total == 0) return nullptr;
+    auto* buf = static_cast<uint8_t*>(std::malloc(total));
+    if (!buf) {
+        *out_len = UINT64_MAX;
+        return nullptr;
+    }
+    uint64_t pos = 0;
+    for (auto& kv : h->index) {
+        if (kv.first.compare(0, pre.size(), pre) != 0) continue;
+        uint32_t kl = static_cast<uint32_t>(kv.first.size());
+        std::memcpy(buf + pos, &kl, 4);
+        std::memcpy(buf + pos + 4, kv.first.data(), kl);
+        pos += 4 + kl;
+    }
+    return buf;
+}
+
+void kvlog_free(uint8_t* p) { std::free(p); }
+
+int kvlog_flush(void* hp) {
+    auto* h = static_cast<KvLog*>(hp);
+    std::lock_guard<std::mutex> lock(h->mu);
+    return std::fflush(h->f) == 0 ? 0 : -1;
+}
+
+// Rewrite only live records (the LevelDB-compaction role).
+int kvlog_compact(void* hp) {
+    auto* h = static_cast<KvLog*>(hp);
+    std::lock_guard<std::mutex> lock(h->mu);
+    std::string tmp = h->path + ".compact";
+    std::FILE* out = std::fopen(tmp.c_str(), "wb");
+    if (!out) return -1;
+    std::unordered_map<std::string, std::pair<uint64_t, uint32_t>> fresh;
+    std::fflush(h->f);
+    std::vector<uint8_t> val;
+    for (auto& kv : h->index) {
+        uint32_t len = kv.second.second;
+        val.resize(len);
+        if (len) {
+            if (std::fseek(h->f, static_cast<long>(kv.second.first), SEEK_SET) ||
+                std::fread(val.data(), 1, len, h->f) != len) {
+                std::fclose(out);
+                std::remove(tmp.c_str());
+                return -1;
+            }
+        }
+        uint32_t hdr[2] = {static_cast<uint32_t>(kv.first.size()), len};
+        std::fwrite(hdr, 4, 2, out);
+        std::fwrite(kv.first.data(), 1, kv.first.size(), out);
+        long off = std::ftell(out);
+        if (len) std::fwrite(val.data(), 1, len, out);
+        fresh[kv.first] = {static_cast<uint64_t>(off), len};
+    }
+    if (std::fflush(out) != 0) {
+        std::fclose(out);
+        std::remove(tmp.c_str());
+        return -1;
+    }
+    std::fclose(out);
+    std::fclose(h->f);
+    if (std::rename(tmp.c_str(), h->path.c_str()) != 0) {
+        h->f = std::fopen(h->path.c_str(), "ab+");
+        return -1;
+    }
+    h->f = std::fopen(h->path.c_str(), "ab+");
+    if (!h->f) return -1;
+    std::fseek(h->f, 0, SEEK_END);
+    h->index.swap(fresh);
+    return 0;
+}
+
+uint64_t kvlog_count(void* hp) {
+    auto* h = static_cast<KvLog*>(hp);
+    std::lock_guard<std::mutex> lock(h->mu);
+    return h->index.size();
+}
+
+void kvlog_close(void* hp) {
+    auto* h = static_cast<KvLog*>(hp);
+    {
+        std::lock_guard<std::mutex> lock(h->mu);
+        if (h->f) {
+            std::fflush(h->f);
+            std::fclose(h->f);
+        }
+    }
+    delete h;
+}
+
+}  // extern "C"

@@ -11,7 +11,8 @@ BlockReplayer.
 
 Backends: in-memory dict (`MemoryKV`, the reference's memory_store.rs
 test double), an append-only log file with tombstones (`FileKV` — the
-LevelDB slot; see native/kvlog for the C++ engine behind it when built).
+LevelDB slot: the native C++ engine csrc/kvlog.cpp when built, else the
+on-disk-compatible pure-Python `PyFileKV`).
 
 SSZ on disk: every block/state record is prefixed with a 1-byte fork id
 so decode picks the right container class (the reference's multi-fork
@@ -72,14 +73,26 @@ class MemoryKV(KV):
         return [k for k in self._d if k.startswith(prefix)]
 
 
-class FileKV(KV):
+def FileKV(path):
+    """On-disk KV: the native C++ engine (csrc/kvlog.cpp via
+    native.kvlog) when the toolchain is available, else the pure-Python
+    PyFileKV.  Both speak the same log format, so a datadir moves freely
+    between them."""
+    from ..native.kvlog import open_native
+
+    kv = open_native(path)
+    return kv if kv is not None else PyFileKV(path)
+
+
+class PyFileKV(KV):
     """Append-only log with an in-memory index (the LevelDB role).
 
     Record layout: [klen u32][vlen u32][key][value]; vlen == 0xFFFFFFFF is
     a tombstone.  The index maps key -> (offset, length) into the log;
     opening replays the log.  `compact()` rewrites live records.
-    Uses the native C++ engine (native.kvlog) when available.
     """
+
+    engine = "python"
 
     def __init__(self, path):
         self.path = path
@@ -165,9 +178,18 @@ class FileKV(KV):
 
 _BLOCK = b"blk:"
 _HOT_STATE = b"sts:"
+_HOT_SLOT_INDEX = b"hsi:"  # v2: hot state root -> slot (u64)
 _COLD_STATE = b"cst:"      # restore points, keyed by slot
 _COLD_BLOCK_SLOT = b"cbs:"  # slot -> block root (canonical cold index)
 _META = b"meta:"
+
+# On-disk schema version (the reference's store::metadata::CURRENT_SCHEMA_
+# VERSION + beacon_chain/src/schema_change/ stepwise migrations).  History:
+#   v1: round-2 format — no version key; migrate() probed each hot state's
+#       slot at a hard-coded SSZ offset
+#   v2: adds the hsi: hot-state slot index, maintained on every put_state,
+#       so migration never depends on container layout
+SCHEMA_VERSION = 2
 
 
 class _Codec:
@@ -324,6 +346,7 @@ class HotColdStore:
         self.slots_per_restore_point = (
             slots_per_restore_point or 2 * spec.preset.slots_per_epoch
         )
+        self._apply_schema_migrations()
         self.split_slot = self._get_meta("split_slot", 0)
         self._hot_roots = set(
             k[len(_HOT_STATE):] for k in kv.keys_with_prefix(_HOT_STATE)
@@ -332,6 +355,47 @@ class HotColdStore:
         # are shared — callers copy before mutating
         self._state_cache = {}
         self._state_cache_cap = 8
+
+    # ----------------------------------------------------- schema changes
+
+    def _apply_schema_migrations(self):
+        """Stepwise on-disk migrations, one version at a time (the role of
+        /root/reference/beacon_node/beacon_chain/src/schema_change/mod.rs).
+        A fresh datadir is stamped with the current version; an existing
+        datadir without a version key is v1 (the round-2 format); a datadir
+        NEWER than this code refuses to open (no forward compat)."""
+        stored = self._get_meta("schema_version", None)
+        if stored is None:
+            if not self.kv.keys_with_prefix(_BLOCK) and not self.kv.keys_with_prefix(
+                _HOT_STATE
+            ):
+                self.put_meta("schema_version", SCHEMA_VERSION)
+                return
+            stored = 1
+        if stored > SCHEMA_VERSION:
+            raise RuntimeError(
+                f"datadir schema v{stored} is newer than this build "
+                f"(v{SCHEMA_VERSION}); refusing to open"
+            )
+        while stored < SCHEMA_VERSION:
+            getattr(self, f"_migrate_v{stored}_to_v{stored + 1}")()
+            stored += 1
+            self.put_meta("schema_version", stored)
+            if hasattr(self.kv, "flush"):
+                self.kv.flush()
+
+    def _migrate_v1_to_v2(self):
+        """v2 adds the hsi: hot-state slot index.  Backfill it from the v1
+        layout's only source of truth: the state blobs themselves (decoding
+        just the slot field at its fixed SSZ offset, the v1 probe)."""
+        for k in self.kv.keys_with_prefix(_HOT_STATE):
+            blob = self.kv.get(k)
+            if blob is None:
+                continue
+            slot = struct.unpack_from("<Q", blob, 1 + 40)[0]
+            self.kv.put(
+                _HOT_SLOT_INDEX + k[len(_HOT_STATE):], struct.pack("<Q", slot)
+            )
 
     # -------------------------------------------------------------- meta
 
@@ -359,6 +423,7 @@ class HotColdStore:
     def put_state(self, root, state):
         root = bytes(root)
         self.kv.put(_HOT_STATE + root, self.codec.enc_state(state))
+        self.kv.put(_HOT_SLOT_INDEX + root, struct.pack("<Q", int(state.slot)))
         self._hot_roots.add(root)
         self._cache_state(root, state.copy())
 
@@ -404,14 +469,25 @@ class HotColdStore:
         # drop ALL hot states at or below the split (canonical history is
         # reachable via restore points; non-canonical is dead)
         for root in list(self._hot_roots):
-            blob = self.kv.get(_HOT_STATE + root)
-            if blob is None:
-                self._hot_roots.discard(root)
-                continue
-            # cheap slot probe: decode only the slot field (offset 40: 8+32)
-            slot = struct.unpack_from("<Q", blob, 1 + 40)[0]
+            raw = self.kv.get(_HOT_SLOT_INDEX + root)
+            if raw is None:
+                # crash window: put_state writes the blob, then the index.
+                # A blob without an index must not be stranded (it would
+                # survive every compact as a live key) nor blindly deleted
+                # (it may be the freshly-written head) — fall back to the
+                # v1 slot probe and heal the index.
+                blob = self.kv.get(_HOT_STATE + root)
+                if blob is None:
+                    self._hot_roots.discard(root)
+                    continue
+                slot = struct.unpack_from("<Q", blob, 1 + 40)[0]
+                self.kv.put(_HOT_SLOT_INDEX + root, struct.pack("<Q", slot))
+            else:
+                # v2 slot index — no dependence on the state container layout
+                slot = struct.unpack("<Q", raw)[0]
             if slot <= finalized_slot:
                 self.kv.delete(_HOT_STATE + root)
+                self.kv.delete(_HOT_SLOT_INDEX + root)
                 self._hot_roots.discard(root)
                 self._state_cache.pop(root, None)
         self.split_slot = finalized_slot

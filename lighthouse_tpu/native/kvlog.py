@@ -1,0 +1,166 @@
+"""ctypes binding for the native kvlog engine (csrc/kvlog.cpp).
+
+The LevelDB slot of the reference's store layer
+(/root/reference/beacon_node/store/src/lib.rs) — an append-only log with
+an in-memory index, on-disk-compatible with the pure-Python FileKV so
+either engine opens the other's datadir.  `open_native(path)` returns a
+NativeKvLog or None when the toolchain/library is unavailable (the
+caller falls back to Python, mirroring the reference's `portable`
+spirit).
+"""
+
+import ctypes
+import os
+import subprocess
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_CSRC = os.path.join(_HERE, "..", "..", "csrc")
+_SO = os.path.join(_HERE, "libkvlog.so")
+_SRC = os.path.join(_CSRC, "kvlog.cpp")
+
+_UNSET = (1 << 64) - 1
+
+
+def _build():
+    if not os.path.exists(_SRC):
+        return None
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", _SO, _SRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except Exception:
+        return None
+    return _SO
+
+
+def _load():
+    stale = not os.path.exists(_SO) or (
+        os.path.exists(_SRC)
+        and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+    )
+    path = _build() if stale else _SO
+    if path is None:
+        path = _SO if os.path.exists(_SO) else None
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.kvlog_open.argtypes = [ctypes.c_char_p]
+    lib.kvlog_open.restype = ctypes.c_void_p
+    lib.kvlog_put.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.c_char_p, ctypes.c_uint32,
+    ]
+    lib.kvlog_put.restype = ctypes.c_int
+    lib.kvlog_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.kvlog_get.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.kvlog_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+    lib.kvlog_del.restype = ctypes.c_int
+    lib.kvlog_keys.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.kvlog_keys.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.kvlog_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+    lib.kvlog_flush.argtypes = [ctypes.c_void_p]
+    lib.kvlog_flush.restype = ctypes.c_int
+    lib.kvlog_compact.argtypes = [ctypes.c_void_p]
+    lib.kvlog_compact.restype = ctypes.c_int
+    lib.kvlog_count.argtypes = [ctypes.c_void_p]
+    lib.kvlog_count.restype = ctypes.c_uint64
+    lib.kvlog_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+_lib = _load()
+HAVE_NATIVE = _lib is not None
+
+
+class NativeKvLog:
+    """KV-interface adapter over the C++ engine."""
+
+    engine = "native-c++"
+
+    def __init__(self, handle):
+        self._h = handle
+
+    def get(self, key):
+        n = ctypes.c_uint64()
+        p = _lib.kvlog_get(self._h, bytes(key), len(key), ctypes.byref(n))
+        if not p:
+            if n.value == _UNSET:
+                return None
+            return b""
+        try:
+            return ctypes.string_at(p, n.value)
+        finally:
+            _lib.kvlog_free(p)
+
+    def put(self, key, value):
+        value = bytes(value)
+        if _lib.kvlog_put(self._h, bytes(key), len(key), value, len(value)):
+            raise OSError("kvlog put failed")
+
+    def delete(self, key):
+        if _lib.kvlog_del(self._h, bytes(key), len(key)):
+            raise OSError("kvlog delete failed")
+
+    def keys_with_prefix(self, prefix):
+        n = ctypes.c_uint64()
+        p = _lib.kvlog_keys(self._h, bytes(prefix), len(prefix), ctypes.byref(n))
+        if not p:
+            if n.value == _UNSET:
+                raise OSError("kvlog keys failed")
+            return []
+        try:
+            raw = ctypes.string_at(p, n.value)
+        finally:
+            _lib.kvlog_free(p)
+        out, pos = [], 0
+        while pos + 4 <= len(raw):
+            kl = int.from_bytes(raw[pos : pos + 4], "little")
+            out.append(raw[pos + 4 : pos + 4 + kl])
+            pos += 4 + kl
+        return out
+
+    def batch(self, ops):
+        for op in ops:
+            if op[0] == "put":
+                self.put(op[1], op[2])
+            else:
+                self.delete(op[1])
+
+    def flush(self):
+        if _lib.kvlog_flush(self._h):
+            raise OSError("kvlog flush failed")
+
+    def compact(self):
+        if _lib.kvlog_compact(self._h):
+            raise OSError("kvlog compact failed")
+
+    def __len__(self):
+        return _lib.kvlog_count(self._h)
+
+    def close(self):
+        if self._h:
+            _lib.kvlog_close(self._h)
+            self._h = None
+
+
+def open_native(path):
+    """NativeKvLog or None (no toolchain / library failed to open)."""
+    if _lib is None:
+        return None
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    h = _lib.kvlog_open(os.fsencode(path))
+    if not h:
+        return None
+    return NativeKvLog(h)
