@@ -583,6 +583,9 @@ class BeaconChain:
                 continue
             for v in indexed.attesting_indices:
                 self.observed_attesters.add((int(att.data.target.epoch), int(v)))
+            self.validator_monitor.process_gossip_attestation(
+                indexed.attesting_indices, att.data
+            )
             try:
                 self.fork_choice.on_attestation(self.current_slot, indexed)
             except InvalidAttestation:

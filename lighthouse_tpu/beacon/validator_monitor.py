@@ -1,9 +1,11 @@
 """Validator monitor: per-validator observability.
 
 Mirror of /root/reference/beacon_node/beacon_chain/src/validator_monitor.rs
-(:329 registration, :394 auto-registration): track registered validator
-indices through imported blocks and attestations, recording hits/misses
-and inclusion distance, exposed as metrics and queryable summaries.
+(:329 registration, :394 auto-registration, epoch-summary region): track
+registered validator indices through gossip, imported blocks, sync
+aggregates and epoch transitions, recording duty hits/misses, inclusion
+distance, balances and proposals — exposed as metrics, logs and queryable
+per-epoch summaries.
 """
 
 import logging
@@ -17,9 +19,21 @@ MONITOR_ATTESTATION_HITS = metrics.counter(
     "validator_monitor_attestation_included_total",
     "Attestations by monitored validators included in blocks",
 )
+MONITOR_ATTESTATION_MISSES = metrics.counter(
+    "validator_monitor_attestation_missed_total",
+    "Monitored validator epochs with no attestation included",
+)
 MONITOR_BLOCKS = metrics.counter(
     "validator_monitor_block_proposals_total",
     "Blocks proposed by monitored validators",
+)
+MONITOR_GOSSIP_SEEN = metrics.counter(
+    "validator_monitor_attestation_seen_on_gossip_total",
+    "Attestations by monitored validators first seen on gossip",
+)
+MONITOR_SYNC_HITS = metrics.counter(
+    "validator_monitor_sync_committee_hits_total",
+    "Sync-committee messages by monitored validators included in blocks",
 )
 
 
@@ -29,12 +43,30 @@ class ValidatorMonitor:
         self.monitored = set()
         # validator -> {epoch: inclusion_delay}
         self.attestation_inclusions = defaultdict(dict)
+        # validator -> {epoch} seen on gossip (earlier signal than inclusion)
+        self.gossip_seen = defaultdict(set)
         self.proposals = defaultdict(list)       # validator -> [slots]
+        self.sync_hits = defaultdict(int)        # validator -> count
+        self.balances = defaultdict(dict)        # validator -> {epoch: gwei}
+        self._summarized_through = -1            # last epoch closed out
+        self._registered_at_epoch = {}           # validator -> first epoch
 
-    def register(self, validator_index):
-        self.monitored.add(int(validator_index))
+    def register(self, validator_index, current_epoch=0):
+        v = int(validator_index)
+        self.monitored.add(v)
+        self._registered_at_epoch.setdefault(v, int(current_epoch))
 
     # ------------------------------------------------------------- hooks
+
+    def process_gossip_attestation(self, indices, data):
+        """Attestation seen on gossip (validator_monitor.rs
+        register_gossip_attestation): records liveness before inclusion."""
+        epoch = int(data.target.epoch)
+        for v in indices:
+            v = int(v)
+            if v in self.monitored and epoch not in self.gossip_seen[v]:
+                self.gossip_seen[v].add(epoch)
+                MONITOR_GOSSIP_SEEN.inc()
 
     def process_imported_block(self, state, signed_block, preset):
         """Called by the chain after import (beacon_chain.rs:3335 region)."""
@@ -69,19 +101,106 @@ class ValidatorMonitor:
                         self.attestation_inclusions[v][epoch] = delay
                     elif delay < prev:
                         self.attestation_inclusions[v][epoch] = delay
+        self._process_sync_aggregate(state, block, preset)
+        self._sample_epoch(state, block, preset)
+
+    def _process_sync_aggregate(self, state, block, preset):
+        """Credit monitored members of the current sync committee whose bit
+        is set in the imported block's sync aggregate
+        (validator_monitor.rs register_sync_aggregate_in_block)."""
+        agg = getattr(block.body, "sync_aggregate", None)
+        committee = getattr(state, "current_sync_committee", None)
+        if agg is None or committee is None or not self.monitored:
+            return
+        # pubkey -> index map restricted to monitored validators
+        monitored_pk = {}
+        for v in self.monitored:
+            if v < len(state.validators):
+                monitored_pk[bytes(state.validators[v].pubkey)] = v
+        if not monitored_pk:
+            return
+        bits = list(agg.sync_committee_bits)
+        for pk, bit in zip(committee.pubkeys, bits):
+            if bit:
+                v = monitored_pk.get(bytes(pk))
+                if v is not None:
+                    self.sync_hits[v] += 1
+                    MONITOR_SYNC_HITS.inc()
+
+    def _sample_epoch(self, state, block, preset):
+        """At the first block of each epoch: sample balances and close out
+        duty accounting for epochs that can no longer gain inclusions
+        (attestations must land within ~1 epoch)."""
+        epoch = int(block.slot) // preset.slots_per_epoch
+        for v in self.monitored:
+            if v < len(state.balances) and epoch not in self.balances[v]:
+                self.balances[v][epoch] = int(state.balances[v])
+        closing = epoch - 2
+        if closing > self._summarized_through:
+            for e in range(max(self._summarized_through + 1, 0), closing + 1):
+                self._close_epoch(e)
+            self._summarized_through = closing
+
+    def _close_epoch(self, epoch):
+        """Emit the per-epoch hit/miss summary (the reference's
+        EpochSummary logging) once `epoch` is final for duty purposes."""
+        for v in sorted(self.monitored):
+            if self._registered_at_epoch.get(v, 0) > epoch:
+                continue
+            hit = epoch in self.attestation_inclusions.get(v, {})
+            if not hit:
+                MONITOR_ATTESTATION_MISSES.inc()
+                seen = epoch in self.gossip_seen.get(v, set())
+                log.warning(
+                    "validator %d MISSED attestation in epoch %d%s", v, epoch,
+                    " (seen on gossip but not included)" if seen else "",
+                )
+            else:
+                log.info(
+                    "validator %d epoch %d: attestation included (delay %d)",
+                    v, epoch, self.attestation_inclusions[v][epoch],
+                )
 
     # ---------------------------------------------------------- queries
 
     def summary(self, validator_index, current_epoch=None):
         v = int(validator_index)
         inclusions = self.attestation_inclusions.get(v, {})
+        balances = self.balances.get(v, {})
         out = {
             "validator_index": v,
             "proposals": list(self.proposals.get(v, [])),
             "attestations_included": len(inclusions),
             "best_inclusion_delay": min(inclusions.values()) if inclusions else None,
+            "sync_committee_hits": self.sync_hits.get(v, 0),
+            "gossip_seen_epochs": len(self.gossip_seen.get(v, set())),
+            "balance_history": dict(sorted(balances.items())[-8:]),
         }
-        if current_epoch is not None and inclusions:
-            recent = [e for e in inclusions if e >= current_epoch - 2]
-            out["recent_hits"] = len(recent)
+        if current_epoch is not None:
+            first = self._registered_at_epoch.get(v, 0)
+            duty_epochs = [e for e in range(first, current_epoch) if e >= 0]
+            hits = sum(1 for e in duty_epochs if e in inclusions)
+            out["recent_hits"] = sum(
+                1 for e in inclusions if e >= current_epoch - 2
+            )
+            out["attestation_hit_rate"] = (
+                round(hits / len(duty_epochs), 4) if duty_epochs else None
+            )
+        return out
+
+    def epoch_summary(self, epoch, slots_per_epoch=32):
+        """Hit/miss table for one epoch across all monitored validators."""
+        out = {}
+        for v in sorted(self.monitored):
+            inclusions = self.attestation_inclusions.get(v, {})
+            out[v] = {
+                "attestation_hit": epoch in inclusions,
+                "inclusion_delay": inclusions.get(epoch),
+                "gossip_seen": epoch in self.gossip_seen.get(v, set()),
+                "proposed_slots": [
+                    s for s in self.proposals.get(v, [])
+                    if s // slots_per_epoch == epoch
+                ],
+                "balance": self.balances.get(v, {}).get(epoch),
+            }
         return out

@@ -27,6 +27,23 @@ class WatchDB:
                 epoch INTEGER PRIMARY KEY,
                 finalized_root TEXT NOT NULL
             );
+            CREATE TABLE IF NOT EXISTS block_packing (
+                slot INTEGER PRIMARY KEY,
+                included_attesters INTEGER,
+                new_attesters INTEGER,
+                attestation_count INTEGER
+            );
+            CREATE TABLE IF NOT EXISTS suboptimal_attestations (
+                slot INTEGER,
+                inclusion_slot INTEGER,
+                delay INTEGER,
+                wrong_head INTEGER,
+                attesters INTEGER,
+                PRIMARY KEY (slot, inclusion_slot)
+            );
+            CREATE TABLE IF NOT EXISTS analysis_gaps (
+                slot INTEGER PRIMARY KEY
+            );
             """
         )
 
@@ -45,6 +62,50 @@ class WatchDB:
                 (epoch, root.hex()),
             )
             self._conn.commit()
+
+    def record_packing(self, slot, included, new, count):
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO block_packing VALUES (?, ?, ?, ?)",
+                (slot, included, new, count),
+            )
+            self._conn.commit()
+
+    def record_analysis_gap(self, slot):
+        """A slot whose packing/attester analyses could not run (hot state
+        pruned before the updater caught up) — recorded so the gap is
+        visible instead of masquerading as zero-attester data."""
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO analysis_gaps VALUES (?)", (slot,)
+            )
+            self._conn.commit()
+
+    def record_suboptimal(self, att_slot, inclusion_slot, delay, wrong_head,
+                          attesters):
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO suboptimal_attestations "
+                "VALUES (?, ?, ?, ?, ?)",
+                (att_slot, inclusion_slot, delay, int(wrong_head), attesters),
+            )
+            self._conn.commit()
+
+    def packing(self):
+        return list(
+            self._conn.execute(
+                "SELECT slot, included_attesters, new_attesters, "
+                "attestation_count FROM block_packing ORDER BY slot"
+            )
+        )
+
+    def suboptimal(self):
+        return list(
+            self._conn.execute(
+                "SELECT slot, inclusion_slot, delay, wrong_head, attesters "
+                "FROM suboptimal_attestations ORDER BY inclusion_slot"
+            )
+        )
 
     def highest_slot(self):
         row = self._conn.execute(
@@ -102,7 +163,52 @@ class WatchUpdater:
                 int(blk.message.proposer_index),
                 len(blk.message.body.attestations),
             )
+            self._analyze_block(root, blk)
         fin_epoch, fin_root = chain.fork_choice.store.finalized_checkpoint
         if fin_epoch > 0:
             self.db.record_finality(fin_epoch, fin_root)
         return len(new)
+
+    def _analyze_block(self, root, blk):
+        """Block-packing + suboptimal-attestation analyses (the role of
+        /root/reference/watch/src/block_packing and suboptimal_attestations:
+        how many distinct attesters a proposer packed, and which included
+        attestations were late or voted a non-canonical head)."""
+        from ..state_processing import phase0
+
+        state = self.chain.store.get_state(root)
+        slot = int(blk.message.slot)
+        if state is None:
+            # pruned hot state (at/below the split): attester indices are
+            # unrecoverable without a cold replay — skip the analyses
+            # rather than record zeroed rows as if they were real data
+            self.db.record_analysis_gap(slot)
+            return
+        seen_attesters = set()
+        for att in blk.message.body.attestations:
+            try:
+                idx = phase0.get_attesting_indices_np(
+                    state, att.data, att.aggregation_bits,
+                    self.chain.preset,
+                )
+            except Exception:
+                idx = []
+            att_slot = int(att.data.slot)
+            delay = slot - att_slot
+            canonical = self._recorded_root(att_slot)
+            wrong_head = (
+                canonical is not None
+                and bytes(att.data.beacon_block_root) != canonical
+            )
+            if delay > 1 or wrong_head:
+                self.db.record_suboptimal(
+                    att_slot, slot, delay, wrong_head, len(idx)
+                )
+            seen_attesters.update(int(v) for v in idx)
+        prior = getattr(self, "_all_attesters", set())
+        new_attesters = seen_attesters - prior
+        self._all_attesters = prior | seen_attesters
+        self.db.record_packing(
+            slot, len(seen_attesters), len(new_attesters),
+            len(blk.message.body.attestations),
+        )
