@@ -201,16 +201,53 @@ def _mul_cols_int32(a, b, n_out=2 * NLIMB):
     return cols.astype(U32)
 
 
-# the active column-sum implementation: LTPU_MULCOLS=int32 switches the
-# whole kernel stack (towers/curves/pairing all flow through mont_mul);
-# the differential test suite passes under either setting.
+def _mul_cols_shift(a, b, n_out=2 * NLIMB):
+    """Same column sums via a row-shift reshape — no einsum, no constant.
+
+    cols[k] = sum_{i+j=k} a_i*b_j is the set of anti-diagonal sums of the
+    outer-product matrix.  Flipping b turns anti-diagonals into diagonals,
+    and a (rows, L) -> (rows, L+1) flat reshape shifts row i left by i, so
+    one axis-0 reduction yields all diagonal sums.  ~8 cheap elementwise
+    HLO ops per multiplication versus three (2*NLIMB x NLIMB^2)-constant
+    einsums — measured ~6x cheaper to COMPILE, which matters because XLA
+    compile time for the pairing graph is linear in per-multiplication op
+    cost (ROUND3_NOTES compile-cliff table).  Products stay < 2^16 and
+    48-term sums < 2^24, exact in f32 — the same bound as the einsum path.
+    """
+    bshape = _bshape(a, b)
+    af = a.astype(F32)
+    bf = b[::-1].astype(F32)                       # flip limb axis
+    prods = af[:, None] * bf[None, :]              # (48, 48, *batch)
+    # diag d = j'-i in [-(NLIMB-1), NLIMB-1]; col k = (NLIMB-1) - d
+    L = 3 * NLIMB - 2                              # 47 left + 48 + 47 right
+    pad = [(0, 0), (NLIMB - 1, L - (2 * NLIMB - 1))] + [(0, 0)] * len(bshape)
+    xp = jnp.pad(prods, pad)                       # (48, L, *batch)
+    flat = xp.reshape((NLIMB * L,) + bshape)
+    flat = jnp.concatenate(
+        [flat, jnp.zeros((NLIMB,) + bshape, F32)], axis=0
+    )
+    v = flat.reshape((NLIMB, L + 1) + bshape)      # row i shifted left by i
+    diags = v[:, : 2 * NLIMB - 1].sum(axis=0)      # (95, *batch): diag d at
+    cols = diags[::-1]                             # index (NLIMB-1)+d -> flip
+    if n_out > cols.shape[0]:
+        cols = jnp.concatenate(
+            [cols, jnp.zeros((n_out - cols.shape[0],) + bshape, F32)], axis=0
+        )
+    return cols[:n_out].astype(U32)
+
+
+# the active column-sum implementation: LTPU_MULCOLS=einsum|int32 switches
+# the whole kernel stack (towers/curves/pairing all flow through mont_mul);
+# the differential test suite passes under any setting.  Default is the
+# shift formulation: exact, einsum-free, ~6x cheaper to compile; bench.py's
+# kernel_candidates section measures all three per backend.
 import os as _os
 
-_mul_cols = (
-    _mul_cols_int32
-    if _os.environ.get("LTPU_MULCOLS") == "int32"
-    else _mul_cols_f32
-)
+_mul_cols = {
+    "int32": _mul_cols_int32,
+    "einsum": _mul_cols_f32,
+    "f32": _mul_cols_f32,
+}.get(_os.environ.get("LTPU_MULCOLS", "shift"), _mul_cols_shift)
 
 
 def _add_limbs(a, b):

@@ -4,6 +4,12 @@ both sign bits, infinity, and every rejection class."""
 import numpy as np
 import pytest
 
+# slow-marked while the compile-cliff work lands (ROUND3_NOTES): the
+# decompress kernel's cold compile dominates the whole fast lane on a
+# machine whose XLA cache is empty.  Un-mark once cold compile is
+# back under ~1 minute.
+pytestmark = pytest.mark.slow
+
 from lighthouse_tpu.crypto.ref import bls as RB
 from lighthouse_tpu.crypto.ref import curves as C
 from lighthouse_tpu.crypto.tpu import curve as cv

@@ -23,6 +23,9 @@ def to_dev(xs):
 
 
 def from_dev(a):
+    """Device (possibly lazily-reduced) Montgomery limbs -> canonical ints.
+    The lazy representation returns any value ≡ x·R (mod p); host-side
+    de-Montgomery + mod p recovers the canonical residue."""
     r_inv = pow(fp.R_INT, -1, P)
     return [(v * r_inv) % P for v in fp.array_to_ints(np.asarray(a))]
 
@@ -36,8 +39,32 @@ def test_limb_roundtrip():
 def test_mont_roundtrip():
     xs = rand_fp(5) + [0, 1, P - 1]
     a = to_dev(xs)
-    back = fp.array_to_ints(np.asarray(fp.from_mont(a)))
+    back = [v % P for v in fp.array_to_ints(np.asarray(fp.from_mont(a)))]
     assert back == xs
+
+
+def test_canonical_and_lazy_chains():
+    """Deep lazy add/sub chains stay exact and `canonical` recovers the
+    byte-exact residue (the lazy-reduction contract)."""
+    if not hasattr(fp, "canonical"):
+        import pytest
+
+        pytest.skip("pre-lazy representation")
+    n = 9
+    xs, ys, zs = rand_fp(n), rand_fp(n), rand_fp(n)
+    a, b, c = to_dev(xs), to_dev(ys), to_dev(zs)
+    # (a - b + c + a - c)*b + (b - a) deep chain, no normalization
+    acc = fp.add(fp.add(fp.sub(a, b), c), fp.sub(a, c))
+    out = fp.add(fp.mont_mul(acc, b), fp.sub(b, a))
+    want = [
+        ((2 * x - y) * y + (y - x)) % P for x, y, z in zip(xs, ys, zs)
+    ]
+    assert from_dev(out) == want
+    # canonical() produces byte-exact residues
+    cano = np.asarray(fp.canonical(fp.from_mont(out)))
+    got = fp.array_to_ints(cano)
+    assert got == want
+    assert cano.min() >= 0 and cano.max() < 256
 
 
 @pytest.mark.parametrize("op,ref", [
