@@ -44,7 +44,7 @@ class _Ops:
     """Field-op namespace shared by the generic point formulas."""
 
     def __init__(self, name, add, sub, neg, sqr, mul_many, is_zero, eq,
-                 select, const, zero):
+                 select, const, zero, is_zero_many=None):
         self.name = name
         self.add = add
         self.sub = sub
@@ -56,6 +56,12 @@ class _Ops:
         self.select = select
         self.const = const         # python value -> field element w/ batch shape
         self.zero = zero
+        # [x...] -> [bool...] with ONE mont_mul + ONE carry scan for the
+        # whole list (is_zero costs a full Montgomery step under lazy
+        # reduction — the complete-add formulas need 4 masks per call)
+        self.is_zero_many = is_zero_many or (
+            lambda xs: [is_zero(x) for x in xs]
+        )
 
     def mul(self, a, b):
         return self.mul_many([a], [b])[0]
@@ -79,10 +85,21 @@ def _f2_mul_many(xs, ys):
     return fp.tunstack(tw.f2_mul(fp.tstack(xs), fp.tstack(ys)), len(xs))
 
 
+def _fp_is_zero_many(xs):
+    z = fp.is_zero(fp.fstack(xs))
+    return [z[i] for i in range(len(xs))]
+
+
+def _f2_is_zero_many(xs):
+    z = fp.is_zero(fp.fstack([c for x in xs for c in x]))
+    return [z[2 * i] & z[2 * i + 1] for i in range(len(xs))]
+
+
 FP_OPS = _Ops(
     "fp", fp.add, fp.sub, fp.neg, fp.mont_sqr, _fp_mul_many,
     fp.is_zero, fp.eq, fp.select,
     lambda v, bs=(): fp.const(v, bs), lambda bs=(): fp.zeros(bs),
+    is_zero_many=_fp_is_zero_many,
 )
 
 F2_OPS = _Ops(
@@ -90,6 +107,7 @@ F2_OPS = _Ops(
     tw.f2_is_zero, tw.f2_eq, tw.f2_select,
     lambda v, bs=(): tw.f2_const(*(v if isinstance(v, tuple) else (v, 0)), batch_shape=bs),
     lambda bs=(): tw.f2_zero(bs),
+    is_zero_many=_f2_is_zero_many,
 )
 
 
@@ -144,10 +162,7 @@ def add(ops, p, q):
     Y3 = ops.sub(RX, S1H3)
     generic = (X3, Y3, Z3)
 
-    x_eq = ops.is_zero(H)
-    y_eq = ops.is_zero(Rr)
-    p_inf = is_inf(ops, p)
-    q_inf = is_inf(ops, q)
+    x_eq, y_eq, p_inf, q_inf = ops.is_zero_many([H, Rr, Z1, Z2])
 
     out = generic
     dbl_res = double(ops, p)

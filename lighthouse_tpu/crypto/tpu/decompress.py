@@ -78,15 +78,9 @@ def parse_g2_bytes(blobs):
 
 
 def _gt_half(a):
-    """Canonical (non-Montgomery) limb array > (P-1)/2, per lane."""
-    _, borrow = fp._sub_limbs(
-        jnp.asarray(fp.int_to_limbs(_HALF_P))[
-            (...,) + (None,) * (a.ndim - 1)
-        ],
-        a,
-    )
-    # borrow set  <=>  half < a  <=>  a > (P-1)/2
-    return borrow.astype(bool)
+    """Canonical (non-Montgomery) limb array > (P-1)/2, per lane.
+    a > (P-1)/2  <=>  a >= (P-1)/2 + 1 (both sides canonical < p)."""
+    return fp._ge_const(a, fp.int_to_limbs(_HALF_P + 1))
 
 
 def _sqrt_fp(a):
@@ -144,10 +138,12 @@ def decompress_kernel(c0, c1, y_big):
     valid = tw.f2_eq(tw.f2_sqr(y), y2)
 
     # sign normalization (ZCash lex rule: compare c1 unless zero, else
-    # c0): flip so the encoded bit matches
-    y0c = fp.from_mont(y[0])
-    y1c = fp.from_mont(y[1])
-    big = jnp.where(fp.is_zero(y1c), _gt_half(y0c), _gt_half(y1c))
+    # c0): flip so the encoded bit matches.  The lex compare needs the
+    # CANONICAL residues (from_mont alone is lazily reduced).
+    yc = fp.canonical(fp.from_mont(fp.fstack([y[0], y[1]])))
+    y0c, y1c = fp.funstack(yc)
+    # y1c is fully reduced into [0, p): the zero test is a free compare
+    big = jnp.where(jnp.all(y1c == 0, axis=0), _gt_half(y0c), _gt_half(y1c))
     flip = big != y_big
     y = tw.f2_select(flip, tw.f2_neg(y), y)
 
@@ -186,7 +182,7 @@ def g2_decompress_batch(blobs, subgroup_check=True):
     ok = valid & (np.asarray(on_curve) | is_inf)
     # infinity lanes: zero Z (the kernel's Z is 1 everywhere)
     if is_inf.any():
-        zmask = jnp.asarray(~is_inf)[None, :].astype(jnp.uint32)
+        zmask = jnp.asarray(~is_inf)[None, :].astype(fp.I32)
         z = (z[0] * zmask, z[1])
     if subgroup_check:
         in_sub = np.asarray(_jit_g2_subgroup((x, y, z)))

@@ -117,11 +117,14 @@ def sqrt_ratio(u, v):
 # ------------------------------------------------------------------- sgn0
 
 def sgn0(a):
-    """RFC 9380 sgn0 for Fp2 (m=2): parity of the canonical representation."""
-    c0, c1 = fp.funstack(fp.from_mont(fp.fstack([a[0], a[1]])))
+    """RFC 9380 sgn0 for Fp2 (m=2): parity of the canonical representation
+    (the CANONICAL residue — a lazily-reduced from_mont value has the
+    wrong parity whenever it is off by an odd multiple of p)."""
+    c0, c1 = fp.funstack(fp.canonical(fp.from_mont(fp.fstack([a[0], a[1]]))))
     s0 = (c0[0] & 1).astype(bool)
     s1 = (c1[0] & 1).astype(bool)
-    z0 = fp.is_zero(c0)
+    # c0 is fully reduced into [0, p): the zero test is a free compare
+    z0 = jnp.all(c0 == 0, axis=0)
     return jnp.where(z0, s1, s0)
 
 

@@ -1,18 +1,31 @@
 """Experimental Pallas kernel: fused Montgomery multiplication.
 
-The default `fp.mont_mul` is a chain of XLA ops (three `_mul_cols` GEMMs,
-redundant folds, one carry scan); XLA fuses much of it, but every stage
-still round-trips intermediates at the fusion boundaries.  This kernel
-runs the WHOLE SOS Montgomery multiply — both limb-product contractions,
-the Montgomery-quotient contraction, the redundant folds, and the final
-carry propagation — as ONE `pallas_call` per batch tile: operands land in
-VMEM once, the three contractions hit the MXU back-to-back, and only the
+The default `fp.mont_mul` is a chain of XLA ops (input compressions, three
+`_mul_cols` contractions, redundant folds, one carry scan); XLA fuses much
+of it, but every stage still round-trips intermediates at the fusion
+boundaries.  This kernel runs the WHOLE lazy-domain SOS Montgomery
+multiply — both limb-product contractions, the Montgomery-quotient
+contraction, the value-preserving input compressions, and the final carry
+propagation — as ONE `pallas_call` per batch tile: operands land in VMEM
+once, the three contractions hit the MXU back-to-back, and only the
 reduced result returns to HBM (pallas_guide.md: HBM->VMEM->compute).
 
-Status: correctness-verified in interpreter mode (differential vs
-`fp.mont_mul` in tests/test_pallas_fp.py); opt-in on hardware via
-`fp_backend="pallas"` plumbing until profiled — the f32 exactness
-argument is identical to fp.py's (products < 2^16, column sums < 2^24).
+It is a bit-for-bit mirror of `fp.mont_mul` on the lazy representation
+(49 signed int32 limbs, R = 2^392, fp.py module docstring).  The fold
+pipeline is REIMPLEMENTED here rather than calling fp's helpers: pallas
+rejects kernel bodies that capture constants, and fp's folds close over
+the R392/R400 wrap arrays — so those constants are threaded in as refs
+instead.  Drift between the two copies is caught by the bit-equality
+asserts in tests/test_pallas_fp.py (full pipeline, multiple tile shapes
+and edge values).  Only the column contraction intentionally differs:
+f32 dots against constant gather matrices (the MXU-friendly form;
+`fp._mul_cols_shift`'s reshape trick exists to keep the *XLA graph*
+small, which is irrelevant within a single fused kernel) — exact, so
+bit-identity still holds.  The f32 exactness argument is fp.py's:
+compressed limbs <= ~260, products < 2^18, 49-term sums < 2^24.
+
+Status: correctness-verified in interpreter mode; opt-in on hardware via
+bench.py's kernel candidates until profiled.
 """
 
 import numpy as np
@@ -22,105 +35,111 @@ from jax import lax
 
 from . import fp
 
-NLIMB = fp.NLIMB      # 48
+NLIMB = fp.NLIMB      # 49
 LB = fp.LB            # 8
-MASK = int(fp.MASK)
 
-# contraction matrices as f32 constants (antidiagonal gather, fp._DIAG_MAT)
-_DIAG96 = fp._diag_mat()                  # (96, 2304)
-_DIAG48 = fp._diag_mat()[:NLIMB]          # (48, 2304)
-_NPRIME_F = fp.NPRIME_LIMBS.astype(np.float32)
-_P_F = fp.P_LIMBS.astype(np.float32)
-_P_U = fp.P_LIMBS.astype(np.uint32)
+# contraction matrices as f32 constants (antidiagonal gather, fp._diag_mat)
+_DIAG2N = fp._diag_mat()                  # (2N, N^2)
+_DIAGN = fp._diag_mat()[:NLIMB]           # (N, N^2)
 
 TILE = 256  # batch elements per grid step
 
 
-def _mont_mul_kernel(a_ref, b_ref, d96_ref, d48_ref, np_ref, p_ref, out_ref):
-    """One tile: a, b (48, TILE) u32 fully-reduced -> out (48, TILE) u32."""
-    af = a_ref[:].astype(jnp.float32)          # (48, T)
-    bf = b_ref[:].astype(jnp.float32)
-    d96 = d96_ref[:]
-    d48 = d48_ref[:]
+MASK = int(fp.MASK)
 
-    def cols96(x, y):
-        prods = (x[:, None, :] * y[None, :, :]).reshape(NLIMB * NLIMB, -1)
-        return jax.lax.dot(
-            d96, prods, precision=lax.Precision.HIGHEST
-        )                                       # (96, T) f32, exact < 2^24
 
-    def cols48(x, y):
-        prods = (x[:, None, :] * y[None, :, :]).reshape(NLIMB * NLIMB, -1)
-        return jax.lax.dot(
-            d48, prods, precision=lax.Precision.HIGHEST
+def _mont_mul_kernel(
+    a_ref, b_ref, d2n_ref, dn_ref, np_ref, p_ref, r392_ref, r400_ref, out_ref
+):
+    """One tile: a, b (N, TILE) i32 lazy -> out (N, TILE) i32 lazy.
+
+    Bit-for-bit mirror of fp.mont_mul: _compress_limbs on both operands,
+    cols_t, t mod R, m = t*(-p^-1) mod R, u = m*p + t, one carry scan,
+    upper half + final carry folded into the top limb.
+    """
+    a = a_ref[:]
+    b = b_ref[:]
+    d2n = d2n_ref[:]
+    dn = dn_ref[:]
+    r392 = r392_ref[:][:, None]
+    r400 = r400_ref[:][:, None]
+
+    z1 = jnp.zeros((1, a.shape[1]), jnp.int32)
+    z2 = jnp.zeros((2, a.shape[1]), jnp.int32)
+
+    def fold_w(c):
+        lo = c & MASK
+        hi = c >> LB
+        return lo + jnp.concatenate([z1, hi[:-1]], axis=0) + hi[-1][None] * r392
+
+    def fold3_w(c):
+        b0 = c & MASK
+        b1 = (c >> LB) & MASK
+        b2 = c >> (2 * LB)
+        out = (
+            b0
+            + jnp.concatenate([z1, b1[:-1]], axis=0)
+            + jnp.concatenate([z2, b2[:-2]], axis=0)
         )
+        spill392 = b1[-1] + b2[-2]
+        return out + spill392[None] * r392 + b2[-1][None] * r400
 
-    def fold3_fold(cols_u, n_out):
-        """fp._fold3 then fp._fold: redundant carry folds, limbs <= 257."""
-        b0 = cols_u & MASK
-        b1 = (cols_u >> LB) & MASK
-        b2 = cols_u >> (2 * LB)
-        z1 = jnp.zeros((1,) + cols_u.shape[1:], jnp.uint32)
-        z2 = jnp.zeros((2,) + cols_u.shape[1:], jnp.uint32)
+    def compress(c):
+        return fold_w(fold_w(fold3_w(c)))
+
+    def fold3_trunc(c, n_out):
+        b0 = c & MASK
+        b1 = (c >> LB) & MASK
+        b2 = c >> (2 * LB)
         s1 = jnp.concatenate([z1, b1[: n_out - 1]], axis=0)
         s2 = jnp.concatenate([z2, b2[: n_out - 2]], axis=0)
-        f = b0[:n_out] + s1 + s2
-        lo = f & MASK
-        hi = f >> LB
+        return b0[:n_out] + s1 + s2
+
+    def fold_trunc(c, n_out):
+        lo = c & MASK
+        hi = c >> LB
         sh = jnp.concatenate([z1, hi[: n_out - 1]], axis=0)
         return lo[:n_out] + sh
 
-    cols_t = cols96(af, bf).astype(jnp.uint32)            # t columns
-    t_red = fold3_fold(cols_t, NLIMB)                     # t mod R, redundant
-    np_f = np_ref[:].astype(jnp.float32)[:, None]
-    m_red = fold3_fold(
-        cols48(t_red.astype(jnp.float32), jnp.broadcast_to(np_f, af.shape))
-        .astype(jnp.uint32),
-        NLIMB,
-    )
-    p_f = p_ref[:].astype(jnp.float32)[:, None]
-    u = (
-        cols96(m_red.astype(jnp.float32), jnp.broadcast_to(p_f, af.shape))
-        .astype(jnp.uint32)
-        + cols_t
-    )                                                     # (96, T) < 2^23
+    def compress_mod_R(c):
+        return fold_trunc(fold3_trunc(c, NLIMB), NLIMB)
 
-    # carry propagation over all 96 columns; keep the high 48 limbs
-    T = u.shape[1]
+    def cols(x, y, d):
+        prods = (x[:, None, :] * y[None, :, :]).reshape(NLIMB * NLIMB, -1)
+        return lax.dot(d, prods, precision=lax.Precision.HIGHEST)
+
+    ar = compress(a).astype(jnp.float32)
+    br = compress(b).astype(jnp.float32)
+    cols_t = cols(ar, br, d2n).astype(jnp.int32)          # (2N, T)
+    t_red = compress_mod_R(cols_t[:NLIMB])
+    np_f = jnp.broadcast_to(np_ref[:].astype(jnp.float32)[:, None], a.shape)
+    m_red = compress_mod_R(
+        cols(t_red.astype(jnp.float32), np_f, dn).astype(jnp.int32)
+    )
+    p_f = jnp.broadcast_to(p_ref[:].astype(jnp.float32)[:, None], a.shape)
+    u = cols(m_red.astype(jnp.float32), p_f, d2n).astype(jnp.int32) + cols_t
 
     def carry_step(carry, col):
         t = col + carry
         return t >> LB, t & MASK
 
-    carry, limbs = lax.scan(carry_step, jnp.zeros((T,), jnp.uint32), u)
-    hi = limbs[NLIMB:]                                    # (48, T) = u / R
-
-    # conditional subtract p (result < 1.22p)
-    p_u = p_ref[:][:, None]
-
-    def sub_step(borrow, ab):
-        ai, pi = ab
-        need = pi + borrow
-        d = (ai - need) & MASK
-        return jnp.where(ai < need, jnp.uint32(1), jnp.uint32(0)), d
-
-    borrow, diff = lax.scan(
-        sub_step,
-        jnp.zeros((T,), jnp.uint32),
-        (hi, jnp.broadcast_to(p_u, hi.shape)),
+    carry, limbs = lax.scan(
+        carry_step, jnp.zeros((u.shape[1],), jnp.int32), u
     )
-    out_ref[:] = jnp.where(borrow[None, :] == 0, diff, hi)
+    res = limbs[NLIMB:]                                   # (N, T) = u / R
+    top = res[-1] + carry * (1 << LB)
+    out_ref[:] = jnp.concatenate([res[:-1], top[None]], axis=0)
 
 
 def mont_mul_pallas(a, b, interpret=False):
     """Drop-in fused `fp.mont_mul` — one pallas_call per TILE-wide slab.
 
-    a, b: (48, B) uint32 fully-reduced Montgomery operands.
+    a, b: (NLIMB, B) int32 lazily-reduced Montgomery operands (any values
+    within fp.mont_mul's contract).
     """
     from jax.experimental import pallas as pl
 
     orig_shape = a.shape
-    bshape = orig_shape[1:]
     a2 = a.reshape(NLIMB, -1)
     b2 = jnp.broadcast_to(b, orig_shape).reshape(NLIMB, -1)
     n = a2.shape[1]
@@ -132,7 +151,7 @@ def mont_mul_pallas(a, b, interpret=False):
 
     out = pl.pallas_call(
         _mont_mul_kernel,
-        out_shape=jax.ShapeDtypeStruct((NLIMB, total), jnp.uint32),
+        out_shape=jax.ShapeDtypeStruct((NLIMB, total), jnp.int32),
         grid=(total // TILE,),
         in_specs=[
             pl.BlockSpec((NLIMB, TILE), lambda i: (0, i)),
@@ -141,16 +160,20 @@ def mont_mul_pallas(a, b, interpret=False):
             pl.BlockSpec((NLIMB, NLIMB * NLIMB), lambda i: (0, 0)),
             pl.BlockSpec((NLIMB,), lambda i: (0,)),
             pl.BlockSpec((NLIMB,), lambda i: (0,)),
+            pl.BlockSpec((NLIMB,), lambda i: (0,)),
+            pl.BlockSpec((NLIMB,), lambda i: (0,)),
         ],
         out_specs=pl.BlockSpec((NLIMB, TILE), lambda i: (0, i)),
         interpret=interpret,
     )(
         a2,
         b2,
-        jnp.asarray(_DIAG96),
-        jnp.asarray(_DIAG48),
+        jnp.asarray(_DIAG2N),
+        jnp.asarray(_DIAGN),
         jnp.asarray(fp.NPRIME_LIMBS),
-        jnp.asarray(_P_U),
+        jnp.asarray(fp.P_LIMBS),
+        jnp.asarray(fp.R392_LIMBS),
+        jnp.asarray(fp.R400_LIMBS),
     )
     if pad:
         out = out[:, :n]

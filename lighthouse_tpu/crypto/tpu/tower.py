@@ -355,4 +355,13 @@ def f12_cyclotomic_sqr(a):
     z3 = out_im(t8, x3)      # w^1
     z4 = out_im(t6, x4)      # w^3
     z5 = out_im(t7, x5)      # w^5
+    # the 3T±2x path is mul-free: under lazy reduction the ±2x term would
+    # DOUBLE limb magnitudes every chained squaring (the seed ladder runs
+    # 64 of them back-to-back) and overflow int32 — compress each output
+    # (value-preserving mod p, a few elementwise ops, no scans)
+    zs = fstack([c for z in (z0, z3, z1, z4, z2, z5) for c in z])
+    zs = fp.compress(zs)
+    z0, z3, z1, z4, z2, z5 = (
+        (zs[:, 2 * i], zs[:, 2 * i + 1]) for i in range(6)
+    )
     return f12_from_coeffs([z0, z3, z1, z4, z2, z5])
