@@ -24,7 +24,7 @@ class BeaconNode:
     slot timer."""
 
     def __init__(self, chain, processor, api_server, clock, executor,
-                 wire=None, router=None, dial=()):
+                 wire=None, router=None, dial=(), discovery=None):
         self.chain = chain
         self.processor = processor
         self.api_server = api_server
@@ -32,7 +32,9 @@ class BeaconNode:
         self.executor = executor
         self.wire = wire
         self.router = router
+        self.discovery = discovery
         self._dial = list(dial)
+        self.mesh_interval = 15.0    # seconds between PEX/discovery passes
 
     def start(self):
         if self.api_server is not None:
@@ -48,6 +50,8 @@ class BeaconNode:
         self.executor.shutdown("node stop")
         if self.wire is not None:
             self.wire.stop()
+        if self.discovery is not None:
+            self.discovery.stop()
         if self.api_server is not None:
             self.api_server.stop()
 
@@ -97,15 +101,44 @@ class BeaconNode:
             pending = still
             if pending and executor.sleep_or_shutdown(1.0):
                 break
-        # then keep meshing through peer exchange PERIODICALLY — addresses
-        # learned after startup (late joiners) must get dialed too
+        # then keep meshing PERIODICALLY — addresses learned after
+        # startup (late joiners) must get dialed too.  Two sources: TCP
+        # peer exchange, and (when enabled) UDP discovery records.
         while not executor.shutting_down:
             try:
                 for pid in self.wire.discover():
                     log.info("discovered peer %s", pid)
             except Exception as e:
                 log.debug("discovery pass failed: %s", e)
-            if executor.sleep_or_shutdown(15.0):
+            if self.discovery is not None:
+                try:
+                    self.discovery.poll()
+                    # FINDNODE answers arrive async over UDP: give them a
+                    # beat to land so this SAME pass dials what it learned
+                    # (otherwise meshing waits a full extra interval)
+                    if executor.sleep_or_shutdown(
+                        min(1.0, self.mesh_interval / 4)
+                    ):
+                        break
+                    self.discovery.evict_stale()
+                    digest = bytes(self.wire.local_status().fork_digest)
+                    connected = {
+                        p.listen_addr for p in self.wire.peers.values()
+                        if getattr(p, "listen_addr", None)
+                    }
+                    for host, port in self.discovery.dial_candidates(digest):
+                        if (port == 0 or (host, port) in connected
+                                or port == self.wire.port):
+                            continue
+                        try:
+                            pid = self.wire.dial(host, port)
+                            log.info("udp-discovered peer %s (%s:%s)",
+                                     pid, host, port)
+                        except Exception:
+                            continue
+                except Exception as e:
+                    log.debug("udp discovery pass failed: %s", e)
+            if executor.sleep_or_shutdown(self.mesh_interval):
                 break
 
     def _notifier_loop(self, executor):
@@ -135,6 +168,9 @@ class ClientBuilder:
         self._net_port = None
         self._dial = []
         self._slasher = False
+        self._disc_boot = None
+        self._disc_port = 0
+        self._disc_sk = None
 
     def genesis_state(self, state):
         self._genesis_state = state
@@ -173,6 +209,15 @@ class ClientBuilder:
         `port` and connect the static `dial` peers at startup."""
         self._net_port = port
         self._dial = list(dial)
+        return self
+
+    def discovery(self, boot_nodes=(), udp_port=0, sk=None):
+        """Enable UDP discovery (the discv5 role, network/discovery.py):
+        learn dialable peers from signed node records instead of — or in
+        addition to — static --dial endpoints."""
+        self._disc_boot = list(boot_nodes)
+        self._disc_port = udp_port
+        self._disc_sk = sk
         return self
 
     def slasher(self, enabled=True):
@@ -233,7 +278,21 @@ class ClientBuilder:
                     log.debug("light-client gossip failed: %s", e)
 
             chain.on_light_client_update = _publish_light_client
+        discovery = None
+        if self._disc_boot is not None and wire is not None:
+            import secrets
+
+            from ..network.discovery import DiscoveryService
+
+            discovery = DiscoveryService(
+                self._disc_sk or (secrets.randbits(250) | 1),
+                tcp_port=wire.port,
+                fork_digest=bytes(wire.local_status().fork_digest),
+                boot_nodes=self._disc_boot,
+                port=self._disc_port,
+                verifier=chain.verifier,
+            )
         return BeaconNode(
             chain, processor, api_server, clock, TaskExecutor(),
-            wire=wire, router=router, dial=self._dial,
+            wire=wire, router=router, dial=self._dial, discovery=discovery,
         )

@@ -62,9 +62,17 @@ def build_parser_with_subs():
                     help="TCP wire port (0 = ephemeral); omit to disable networking")
     bn.add_argument("--dial", action="append", default=[],
                     metavar="HOST:PORT", help="static peer to connect (repeatable)")
+    bn.add_argument("--boot-node", action="append", default=[],
+                    metavar="HOST:UDP_PORT",
+                    help="UDP discovery seed (repeatable); enables the "
+                         "discv5-role discovery service")
+    bn.add_argument("--discovery-port", type=int, default=0,
+                    help="UDP discovery listen port (0 = ephemeral)")
 
     boot = sub.add_parser("boot-node", help="chainless peer-exchange node")
     boot.add_argument("--listen-port", type=int, default=9100)
+    boot.add_argument("--discovery-port", type=int, default=9109,
+                      help="UDP discovery listen port")
 
     vc = sub.add_parser("vc", help="validator client")
     _add_common(vc)
@@ -273,6 +281,18 @@ def _run_bn(args):
                 return 1
             dial.append((host or "127.0.0.1", int(port)))
         builder.network(port=args.listen_port or 0, dial=dial)
+    if args.boot_node:
+        boots = []
+        for hp in args.boot_node:
+            host, sep, port = hp.rpartition(":")
+            if not sep or not port.isdigit():
+                print(f"--boot-node expects HOST:UDP_PORT, got {hp!r}",
+                      file=sys.stderr)
+                return 1
+            boots.append((host or "127.0.0.1", int(port)))
+        if args.listen_port is None and not args.dial:
+            builder.network(port=0)      # discovery implies networking
+        builder.discovery(boot_nodes=boots, udp_port=args.discovery_port)
     if args.memory_store:
         builder.memory_store()
     else:
@@ -287,19 +307,27 @@ def _run_bn(args):
 
 
 def _run_boot_node(args):
-    """The boot_node binary's role: a chainless rendezvous that accepts
-    any fork (mirroring the dialer's digest) and serves peer exchange so
-    fresh nodes can find the mesh."""
+    """The boot_node binary's role (boot_node/src/server.rs): a chainless
+    rendezvous serving BOTH rails fresh nodes use to find the mesh — TCP
+    peer exchange and UDP discovery (signed node records)."""
+    import secrets
     import time
 
+    from .network.discovery import DiscoveryService
     from .network.wire import WireNode
 
     node = WireNode(None, port=args.listen_port, accept_any_fork=True)
-    print(f"boot node up — wire on :{node.port} (peer exchange only)")
+    disc = DiscoveryService(
+        secrets.randbits(250) | 1, tcp_port=node.port,
+        port=args.discovery_port,
+    )
+    print(f"boot node up — wire on :{node.port} (peer exchange), "
+          f"udp discovery on :{disc.port}")
     try:
         while True:
             time.sleep(5)
     except KeyboardInterrupt:
+        disc.stop()
         node.stop()
         return 0
 
