@@ -8,7 +8,8 @@ optimal-ate pairing, hash-to-curve, and the batched randomized
 shardable JAX functions with fixed trip counts (XLA-friendly control flow).
 
 Layout convention: a base-field element is a uint32 array of shape
-``(24, *batch)`` — 24 sixteen-bit limbs, little-endian, **limbs leading** so
+``(49, *batch)`` — 49 signed 8-bit limbs (lazily-reduced Montgomery form,
+R = 2^392; see fp.py), little-endian, **limbs leading** so
 that batch dimensions map onto TPU vector lanes (the VPU is 8x128; putting
 the 24-limb axis last would waste 80% of each lane group).
 """

@@ -8,13 +8,13 @@ direct zip:
   Fp6  : (a0, a1, a2) of Fp2      = a0 + a1*v + a2*v^2, v^3 = xi = 1+u
   Fp12 : (b0, b1) of Fp6          = b0 + b1*w,        w^2 = v
 
-All coefficients are Montgomery-form (24, *batch) uint32 arrays, so every
+All coefficients are lazy-Montgomery (NLIMB, *batch) int32 limb arrays, so every
 tower op is vectorized over trailing batch dims and shardable along them.
 
 **Stacked-multiplication design (TPU-first).** Every tower formula folds its
 independent base-field multiplications into ONE batched `fp.mont_mul` via
-`fp.fstack`: an Fp2 Karatsuba is a single (24, 3, *B) multiply, an Fp6 mul
-stacks its 6 Fp2 mults into one (24, 3, 6, *B) call, and a full Fp12 mul
+`fp.fstack`: an Fp2 Karatsuba is a single (NLIMB, 3, *B) multiply, an Fp6 mul
+stacks its 6 Fp2 mults into one (NLIMB, 3, 6, *B) call, and a full Fp12 mul
 bottoms out in exactly one mont_mul over a 54x-wider batch.  This keeps XLA
 graphs ~50x smaller than naive nesting (compile-time is the binding
 constraint for the Miller loop — SURVEY.md §7 "hard parts" 2) and hands the
