@@ -380,6 +380,20 @@ def warm():
     print(json.dumps({"warmed": True, "left_s": round(_left(), 1)}))
 
 
+def config0():
+    """Tiny (2 sets x 2 pks) bucket — the shape entry() and the fast-lane
+    smoke compile, so its program is usually CACHED.  Exists purely to
+    get SOME honestly-measured primary on stdout within minutes: every
+    later config can only improve it, and a budget kill during config
+    2/3's big-bucket compile no longer leaves an empty result (the
+    round-2 rc=124 failure mode, second guard)."""
+    sets = build_sets(2, 2)
+    sps, dt = timed_verify(sets, iters=2)
+    note("0_tiny_bucket", sets=len(sets), sets_per_sec=round(sps, 2),
+         batch_ms=round(dt * 1e3, 2))
+    return sps
+
+
 def main():
     if "--warm" in sys.argv:
         warm()
@@ -387,13 +401,22 @@ def main():
     _install_term_handler()
     note("platform", platform=jax.devices()[0].platform, note=_PLATFORM_NOTE)
     primary = None
-    # config 2 first: the guaranteed-green primary (round-1 shape)
     try:
-        primary = config2()
+        primary = config0()
+        _emit_primary(primary)
+    except Exception as e:
+        note("config0_error", error=str(e)[:300])
+    # config 2: the guaranteed-green primary (round-1 shape)
+    try:
+        r = config2()
+        if r is not None and (primary is None or r > primary):
+            primary = r
         _emit_primary(primary)   # a later timeout still leaves this line
     except Exception as e:
-        print(json.dumps({"error": f"config2: {e}"}))
-        sys.exit(1)
+        if primary is None:
+            print(json.dumps({"error": f"config2: {e}"}))
+            sys.exit(1)
+        note("config2_error", error=str(e)[:300])
 
     for fn in (config3, config1, config4, config5, config_kernels):
         if _left() < 120:

@@ -147,6 +147,43 @@ class _Handler(JsonHandler):
             return
         if path == "/eth/v1/node/version":
             return self._json({"data": {"version": VERSION}})
+        if path == "/eth/v1/node/identity":
+            wire = getattr(self.server, "wire", None)
+            disc = getattr(self.server, "discovery", None)
+            data = {
+                "peer_id": wire.peer_id if wire is not None else "",
+                "p2p_addresses": (
+                    [f"/ip4/127.0.0.1/tcp/{wire.port}"]
+                    if wire is not None else []
+                ),
+                "discovery_addresses": (
+                    [f"/ip4/{disc.record.ip}/udp/{disc.port}"]
+                    if disc is not None else []
+                ),
+                # the BLS-signed node record stands in the enr field's slot
+                "enr": (
+                    disc.record.to_bytes().hex() if disc is not None else ""
+                ),
+            }
+            return self._json({"data": data})
+        if path == "/eth/v1/node/peers":
+            wire = getattr(self.server, "wire", None)
+            peers = []
+            if wire is not None:
+                for pid, p in list(wire.peers.items()):
+                    la = getattr(p, "listen_addr", None)
+                    peers.append({
+                        "peer_id": pid,
+                        "last_seen_p2p_address": (
+                            f"/ip4/{la[0]}/tcp/{la[1]}" if la else ""
+                        ),
+                        "state": "connected" if p._alive else "disconnected",
+                        "direction": getattr(p, "direction", "outbound"),
+                    })
+            return self._json({
+                "data": peers,
+                "meta": {"count": len(peers)},
+            })
         if path == "/metrics":
             return self._text(metrics.gather())
         if path == "/eth/v1/beacon/genesis":

@@ -292,6 +292,10 @@ class ClientBuilder:
                 port=self._disc_port,
                 verifier=chain.verifier,
             )
+        if api_server is not None:
+            # node/identity + node/peers routes read the network state
+            api_server.server.wire = wire
+            api_server.server.discovery = discovery
         return BeaconNode(
             chain, processor, api_server, clock, TaskExecutor(),
             wire=wire, router=router, dial=self._dial, discovery=discovery,

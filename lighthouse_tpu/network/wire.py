@@ -371,6 +371,7 @@ class WireNode:
         sock = socket.create_connection((host, port), timeout=timeout)
         sock.settimeout(None)
         peer = _Peer(self, sock, (host, port))
+        peer.direction = "outbound"
         if self.encrypt:
             self._noise_handshake(peer, initiator=True)
         peer.sent_hello = True
@@ -403,6 +404,7 @@ class WireNode:
             except OSError:
                 return
             peer = _Peer(self, sock, addr)
+            peer.direction = "inbound"
             threading.Thread(
                 target=self._reader_loop, args=(peer,), daemon=True
             ).start()

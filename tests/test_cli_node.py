@@ -105,6 +105,21 @@ def test_networked_nodes_sync_and_gossip():
         client_a.publish_block_ssz("0x" + codec.enc_block(blk2).hex())
         assert wait(lambda: int(node_b.chain.head_state.slot) == 2)
         assert node_b.chain.head_root == node_a.chain.head_root
+
+        # node/peers + node/identity surface the wire state
+        import urllib.request
+
+        peers = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{node_a.api_server.port}/eth/v1/node/peers"
+        ).read())
+        assert peers["meta"]["count"] == 1
+        assert peers["data"][0]["state"] == "connected"
+        assert peers["data"][0]["direction"] == "inbound"
+        ident = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{node_a.api_server.port}/eth/v1/node/identity"
+        ).read())
+        assert ident["data"]["peer_id"] == node_a.wire.peer_id
+        assert str(node_a.wire.port) in ident["data"]["p2p_addresses"][0]
     finally:
         node_a.stop()
         if node_b is not None:
