@@ -24,6 +24,8 @@
 #include <unordered_map>
 #include <vector>
 
+#include <unistd.h>  // ftruncate: torn-tail recovery must CUT the tail
+
 namespace {
 
 constexpr uint32_t kTombstone = 0xFFFFFFFFu;
@@ -43,7 +45,7 @@ bool replay(KvLog* h) {
     std::vector<char> data(static_cast<size_t>(end));
     if (end > 0 && std::fread(data.data(), 1, data.size(), h->f) != data.size())
         return false;
-    size_t pos = 0, n = data.size();
+    size_t pos = 0, n = data.size(), last_good = 0;
     while (pos + 8 <= n) {
         uint32_t klen, vlen;
         std::memcpy(&klen, data.data() + pos, 4);
@@ -54,11 +56,22 @@ bool replay(KvLog* h) {
         pos += klen;
         if (vlen == kTombstone) {
             h->index.erase(key);
+            last_good = pos;
             continue;
         }
         if (pos + vlen > n) break;                  // torn tail
         h->index[key] = {static_cast<uint64_t>(pos), vlen};
         pos += vlen;
+        last_good = pos;
+    }
+    // A torn record must be TRUNCATED, not just skipped: the handle is in
+    // append mode, so post-crash puts would otherwise land AFTER the
+    // partial record and the next replay's header parse would swallow or
+    // misalign them (advisor r3 finding).
+    if (last_good < n) {
+        std::fflush(h->f);
+        if (ftruncate(fileno(h->f), static_cast<off_t>(last_good)) != 0)
+            return false;
     }
     std::fseek(h->f, 0, SEEK_END);
     return true;

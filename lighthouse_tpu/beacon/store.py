@@ -105,18 +105,29 @@ class PyFileKV(KV):
         self._f.seek(0)
         data = self._f.read()
         pos = 0
+        last_good = 0
         while pos + 8 <= len(data):
             klen, vlen = struct.unpack_from("<II", data, pos)
             pos += 8
+            if pos + klen > len(data):
+                break  # torn tail write
             key = data[pos : pos + klen]
             pos += klen
             if vlen == _TOMBSTONE:
                 self._index.pop(key, None)
+                last_good = pos
                 continue
             if pos + vlen > len(data):
-                break  # torn tail write — ignore (crash recovery)
+                break  # torn tail write
             self._index[key] = (pos, vlen)
             pos += vlen
+            last_good = pos
+        if last_good < len(data):
+            # truncate the torn record: the handle is append-mode, so new
+            # puts would otherwise land AFTER the partial record and the
+            # next replay would swallow or misalign them (advisor r3)
+            self._f.flush()
+            self._f.truncate(last_good)
         self._f.seek(0, 2)
 
     def get(self, key):

@@ -49,12 +49,22 @@ class ValidatorMonitor:
         self.sync_hits = defaultdict(int)        # validator -> count
         self.balances = defaultdict(dict)        # validator -> {epoch: gwei}
         self._summarized_through = -1            # last epoch closed out
-        self._registered_at_epoch = {}           # validator -> first epoch
+        # validator -> first duty epoch; None = "from the next sampled
+        # epoch" (resolved in _sample_epoch — callers rarely know the
+        # chain's current epoch at registration time)
+        self._registered_at_epoch = {}
+        self._first_epoch_seen = None            # first sampled epoch
 
-    def register(self, validator_index, current_epoch=0):
+    def register(self, validator_index, current_epoch=None):
+        """Monitor a validator.  Without `current_epoch`, duty accounting
+        starts at the next sampled epoch — a node starting mid-chain must
+        not emit MISSED warnings for every historical epoch (advisor r3:
+        the old default of 0 did exactly that)."""
         v = int(validator_index)
         self.monitored.add(v)
-        self._registered_at_epoch.setdefault(v, int(current_epoch))
+        self._registered_at_epoch.setdefault(
+            v, None if current_epoch is None else int(current_epoch)
+        )
 
     # ------------------------------------------------------------- hooks
 
@@ -132,6 +142,15 @@ class ValidatorMonitor:
         duty accounting for epochs that can no longer gain inclusions
         (attestations must land within ~1 epoch)."""
         epoch = int(block.slot) // preset.slots_per_epoch
+        if self._first_epoch_seen is None:
+            # first observation: never close out epochs from before the
+            # monitor existed (mid-chain start must not warn per history)
+            self._first_epoch_seen = epoch
+            self._summarized_through = max(self._summarized_through, epoch - 3)
+        # resolve "from now on" registrations to the sampled epoch
+        for v, reg in list(self._registered_at_epoch.items()):
+            if reg is None:
+                self._registered_at_epoch[v] = epoch
         for v in self.monitored:
             if v < len(state.balances) and epoch not in self.balances[v]:
                 self.balances[v][epoch] = int(state.balances[v])
@@ -145,7 +164,8 @@ class ValidatorMonitor:
         """Emit the per-epoch hit/miss summary (the reference's
         EpochSummary logging) once `epoch` is final for duty purposes."""
         for v in sorted(self.monitored):
-            if self._registered_at_epoch.get(v, 0) > epoch:
+            reg = self._registered_at_epoch.get(v, 0)
+            if reg is None or reg > epoch:
                 continue
             hit = epoch in self.attestation_inclusions.get(v, {})
             if not hit:
@@ -178,6 +198,8 @@ class ValidatorMonitor:
         }
         if current_epoch is not None:
             first = self._registered_at_epoch.get(v, 0)
+            if first is None:           # registered, no epoch sampled yet
+                first = current_epoch
             duty_epochs = [e for e in range(first, current_epoch) if e >= 0]
             hits = sum(1 for e in duty_epochs if e in inclusions)
             out["recent_hits"] = sum(

@@ -278,6 +278,18 @@ class WireNode:
         # to an encrypted node at all
         self.encrypt = encrypt
         self._static_sk = static_sk
+        if encrypt:
+            # identity binding (libp2p noise signs the host key over the
+            # noise static; we make the static key BE the identity): one
+            # long-lived static keypair per node, peer_id DERIVED from the
+            # static pubkey — a HELLO claiming someone else's peer_id
+            # fails the _register_peer cross-check because the claimant
+            # cannot complete the XX handshake under the matching static
+            # secret (advisor r3: peer_id was self-asserted).
+            from .noise import keypair as _noise_keypair
+
+            self._static_sk, static_pk = _noise_keypair(static_sk)
+            peer_id = self._peer_id_of_static(static_pk)
         # boot-node mode (the reference's boot_node binary over discv5):
         # no chain, no gossip interest — just handshake + peer exchange,
         # so the fork-digest gate must not apply
@@ -320,6 +332,11 @@ class WireNode:
             target=self._heartbeat_loop, daemon=True
         )
         self._heartbeat_thread.start()
+
+    @staticmethod
+    def _peer_id_of_static(static_pk: bytes) -> str:
+        """Transport identity of a noise static pubkey (encrypt mode)."""
+        return hashlib.sha256(b"ltpu-noise-id" + static_pk).hexdigest()[:16]
 
     # ------------------------------------------------------------ status
 
@@ -430,6 +447,16 @@ class WireNode:
             peer.send_frame(GOODBYE_FRAME, struct.pack("<Q", GB_BANNED))
             peer.close()
             return False
+        if self.encrypt:
+            # identity binding: the claimed peer_id must be the one derived
+            # from the noise static key that authenticated this connection
+            # — an active MITM or impersonator cannot pass this without the
+            # matching static secret (advisor r3 finding).
+            expected = self._peer_id_of_static(peer.noise_static or b"")
+            if peer_id != expected:
+                peer.send_frame(GOODBYE_FRAME, struct.pack("<Q", GB_FAULT))
+                peer.close()
+                return False
         peer.peer_id = peer_id
         peer.status = status
         peer.listen_addr = (peer.addr[0], listen_port)

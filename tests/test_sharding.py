@@ -99,7 +99,10 @@ def test_dp2_mp4_invalid_batch_rejected(batch8x2):
 def test_per_set_kernel_dp8_sharded(batch8x2):
     """Per-set verdict kernel under dp sharding: verdicts match unsharded."""
     pk, sig, u0, u1, _, _ = batch8x2
-    ref = np.asarray(tb._jit_per_set(pk, sig, u0, u1))
+    n = sig[0][0].shape[1]
+    real = jnp.ones((n,), bool)
+    ref_all, ref = tb._jit_per_set(pk, sig, u0, u1, real)
+    ref = np.asarray(ref)
     mesh = Mesh(np.array(jax.devices()).reshape(8), ("dp",))
     pk_s = NamedSharding(mesh, PS(None, "dp", None))
     set_s = NamedSharding(mesh, PS(None, "dp"))
@@ -110,8 +113,11 @@ def test_per_set_kernel_dp8_sharded(batch8x2):
             jax.tree_util.tree_map(lambda _: set_s, sig),
             jax.tree_util.tree_map(lambda _: set_s, u0),
             jax.tree_util.tree_map(lambda _: set_s, u1),
+            NamedSharding(mesh, PS("dp")),
         ),
     )
-    got = np.asarray(jitted(pk, sig, u0, u1))
+    got_all, got = jitted(pk, sig, u0, u1, real)
+    got = np.asarray(got)
     assert (got == ref).all()
     assert ref.all()
+    assert bool(got_all) is True and bool(ref_all) is True
