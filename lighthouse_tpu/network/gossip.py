@@ -60,6 +60,120 @@ class PeerScore:
         return self.score <= BAN_THRESHOLD
 
 
+# --------------------------------------------------- gossipsub topic scores
+
+
+class TopicScoreParams:
+    """Per-topic mesh-quality scoring parameters.
+
+    Role mirror of the reference's gossipsub topic params
+    (/root/reference/beacon_node/lighthouse_network/src/service/
+    gossipsub_scoring_parameters.rs:1-359): first-message-deliveries
+    reward useful mesh members, a mesh-message-deliveries DEFICIT below
+    `mmd_threshold` penalizes quadratically (a grafted peer that stops
+    forwarding), and invalid messages carry a heavy decaying penalty.
+    Counters decay each heartbeat so scores describe recent behavior."""
+
+    def __init__(self, weight=1.0,
+                 fmd_weight=1.0, fmd_cap=10.0, fmd_decay=0.9,
+                 mmd_weight=-1.0, mmd_threshold=4.0, mmd_cap=20.0,
+                 mmd_decay=0.9, mmd_activation=2,
+                 invalid_weight=-40.0, invalid_decay=0.8):
+        self.weight = weight
+        self.fmd_weight, self.fmd_cap, self.fmd_decay = (
+            fmd_weight, fmd_cap, fmd_decay)
+        self.mmd_weight, self.mmd_threshold, self.mmd_cap, self.mmd_decay = (
+            mmd_weight, mmd_threshold, mmd_cap, mmd_decay)
+        self.mmd_activation = mmd_activation   # heartbeats before deficit counts
+        self.invalid_weight, self.invalid_decay = invalid_weight, invalid_decay
+
+
+# topic-family params: blocks are rare and precious (big weight, low
+# delivery threshold); attestation subnets are high-rate (lower weight)
+_DEFAULT_PARAMS = TopicScoreParams()
+TOPIC_PARAMS = {
+    GossipKind.BEACON_BLOCK: TopicScoreParams(
+        weight=2.0, mmd_threshold=2.0, invalid_weight=-80.0),
+    GossipKind.AGGREGATE_AND_PROOF: TopicScoreParams(weight=1.5),
+    GossipKind.ATTESTATION: TopicScoreParams(weight=0.5, fmd_cap=20.0),
+    GossipKind.SYNC_COMMITTEE: TopicScoreParams(weight=0.5),
+}
+
+
+def params_for(topic):
+    """Longest family match (subnet topics inherit their family params)."""
+    best = _DEFAULT_PARAMS
+    best_len = -1
+    for fam, p in TOPIC_PARAMS.items():
+        if topic_matches(topic, fam) and len(fam) > best_len:
+            best, best_len = p, len(fam)
+    return best
+
+
+class _TopicCounters:
+    __slots__ = ("fmd", "mmd", "invalid", "mesh_beats")
+
+    def __init__(self):
+        self.fmd = 0.0          # first-message deliveries (decaying)
+        self.mmd = 0.0          # mesh-message deliveries (decaying)
+        self.invalid = 0.0      # invalid messages (decaying)
+        self.mesh_beats = 0     # heartbeats spent grafted in this mesh
+
+
+class PeerTopicScores:
+    """One peer's per-topic counters + the derived topic scores.
+
+    The derived score feeds that topic's mesh GRAFT/PRUNE decisions
+    (combined with the additive behavioral PeerScore); it never bans on
+    its own — bans stay with PeerScore."""
+
+    def __init__(self):
+        self._topics = {}       # topic -> _TopicCounters
+
+    def _c(self, topic):
+        c = self._topics.get(topic)
+        if c is None:
+            c = self._topics[topic] = _TopicCounters()
+        return c
+
+    def on_delivery(self, topic, first, in_mesh):
+        c = self._c(topic)
+        p = params_for(topic)
+        if first:
+            c.fmd = min(c.fmd + 1.0, p.fmd_cap)
+        if in_mesh:
+            c.mmd = min(c.mmd + 1.0, p.mmd_cap)
+
+    def on_invalid(self, topic):
+        self._c(topic).invalid += 1.0
+
+    def heartbeat(self, mesh_topics):
+        """Decay all counters; count grafted heartbeats per topic."""
+        for topic, c in self._topics.items():
+            p = params_for(topic)
+            c.fmd *= p.fmd_decay
+            c.mmd *= p.mmd_decay
+            c.invalid *= p.invalid_decay
+            c.mesh_beats = c.mesh_beats + 1 if topic in mesh_topics else 0
+        for topic in mesh_topics:
+            if topic not in self._topics:
+                self._c(topic).mesh_beats = 1
+
+    def topic_score(self, topic):
+        c = self._topics.get(topic)
+        if c is None:
+            return 0.0
+        p = params_for(topic)
+        s = p.fmd_weight * c.fmd
+        # mesh-delivery deficit: only after the activation window (a
+        # freshly-grafted peer hasn't had time to deliver anything)
+        if c.mesh_beats >= p.mmd_activation and c.mmd < p.mmd_threshold:
+            deficit = p.mmd_threshold - c.mmd
+            s += p.mmd_weight * deficit * deficit
+        s += p.invalid_weight * c.invalid * c.invalid
+        return p.weight * s
+
+
 class GossipBus:
     """The shared medium: every node registers a handler per topic."""
 

@@ -35,7 +35,7 @@ from collections import OrderedDict
 from ..ssz import Bytes4, Bytes32, Container, decode, encode, uint64
 from ..types.spec import compute_fork_data_root
 from . import snappy
-from .gossip import GossipKind, PeerScore
+from .gossip import GossipKind, PeerScore, PeerTopicScores
 from .gossip import topic_matches as _tm
 from .rate_limiter import RateLimited, RateLimiter
 
@@ -226,6 +226,10 @@ class _Peer:
         self.listen_addr = None      # remote's announced (host, port)
         self.topics = set()          # topics the REMOTE subscribed to
         self.score = PeerScore()
+        # gossipsub topic-quality counters (gossipsub_scoring_parameters.rs
+        # role): first/mesh deliveries + invalids per topic, decayed each
+        # heartbeat, feeding GRAFT/PRUNE decisions
+        self.topic_scores = PeerTopicScores()
         self.status = None           # remote StatusMessage
         self.metadata_seq = 0
         self._wlock = threading.Lock()
@@ -323,6 +327,7 @@ class WireNode:
         # reference's gossipsub mesh with graft/prune + heartbeat,
         # service/gossipsub_scoring_parameters.rs neighborhood)
         self.mesh = {}
+        self._topic_traffic = {}       # topic -> decaying delivery count
         self.forward_counts = {}       # mid -> peers forwarded to (stats)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True
@@ -593,10 +598,15 @@ class WireNode:
                 self.known_addrs.add(addr)
         elif ftype == GRAFT:
             topic = body.decode()
-            # accept the graft only for topics we serve; else prune back
-            if any(
+            # accept the graft only for topics we serve AND peers whose
+            # topic score qualifies (an invalid-sender cannot graft
+            # itself straight back after a quality prune); else prune back
+            serves = any(
                 _tm(topic, sub) for sub in self.handlers
-            ) or topic in self.mesh:
+            ) or topic in self.mesh
+            if serves and (
+                self._combined_score(peer, topic) >= self.TOPIC_GRAFT_SCORE
+            ):
                 self.mesh.setdefault(topic, set()).add(peer.peer_id)
             else:
                 peer.send_frame(PRUNE, body)
@@ -622,13 +632,19 @@ class WireNode:
             except ConnectionError:
                 pass
 
+    # duplicates count as mesh deliveries only this long after the first
+    # copy landed (gossipsub mesh_message_deliveries_window role): beyond
+    # it a copy proves nothing about timely forwarding
+    MESH_DELIVERY_WINDOW_S = 2.0
+
     def _mark_seen(self, mid):
         """Record a message id; False when already seen.  Trims the cache
-        to SEEN_CACHE_SIZE."""
+        to SEEN_CACHE_SIZE.  Stores the first-seen timestamp (the
+        mesh-delivery window anchor)."""
         with self._seen_lock:
             if mid in self._seen:
                 return False
-            self._seen[mid] = None
+            self._seen[mid] = time.time()
             while len(self._seen) > SEEN_CACHE_SIZE:
                 self._seen.popitem(last=False)
             return True
@@ -657,17 +673,63 @@ class WireNode:
             except Exception:
                 pass
 
+    # mesh-quality thresholds (gossipsub_scoring_parameters.rs role):
+    # below PRUNE the peer leaves that topic's mesh (connection kept);
+    # below GRAFT it is not grafted in the first place
+    TOPIC_PRUNE_SCORE = -1.0
+    TOPIC_GRAFT_SCORE = 0.0
+
+    def _note_topic_traffic(self, topic):
+        """Decaying per-topic delivery counter: the mesh-deficit penalty
+        only applies on topics that actually carry traffic (an idle
+        subnet must not get its honest mesh pruned for silence)."""
+        self._topic_traffic[topic] = self._topic_traffic.get(topic, 0.0) + 1.0
+
+    def _combined_score(self, peer, topic):
+        return peer.score.score + peer.topic_scores.topic_score(topic)
+
     def _heartbeat(self, _random):
-        """gossipsub heartbeat: keep every active topic's mesh degree in
-        [D_lo, D_hi], grafting random eligible peers in and pruning the
-        lowest-scored members out."""
+        """gossipsub heartbeat: decay topic counters, evict mesh members
+        whose TOPIC score fell below the prune threshold (invalid or
+        silent-under-traffic peers lose the mesh slot, not the
+        connection), then keep every active topic's mesh degree in
+        [D_lo, D_hi] — grafting random non-negative-score peers in and
+        pruning the lowest-combined-score members out."""
+        # decay: per-peer topic counters + node-level traffic estimate
+        for p in list(self.peers.values()):
+            grafted = {t for t, m in self.mesh.items() if p.peer_id in m}
+            p.topic_scores.heartbeat(grafted)
+        for t in list(self._topic_traffic):
+            self._topic_traffic[t] *= 0.9
+            if self._topic_traffic[t] < 0.05:
+                del self._topic_traffic[t]
         for topic in list(self.mesh):
             members = self.mesh[topic]
             cands = {p.peer_id: p for p in self._mesh_candidates(topic)}
             # drop vanished peers
             members &= set(cands)
+            # topic-quality eviction: deficit penalties only count when
+            # the topic carries traffic; invalid penalties always count
+            has_traffic = self._topic_traffic.get(topic, 0.0) >= 1.0
+            for pid in list(members):
+                ts = cands[pid].topic_scores
+                tscore = ts.topic_score(topic)
+                if tscore >= self.TOPIC_PRUNE_SCORE:
+                    continue
+                if not has_traffic and ts._c(topic).invalid == 0.0:
+                    continue      # silent mesh on a silent topic is fine
+                members.discard(pid)
+                try:
+                    cands[pid].send_frame(PRUNE, topic.encode())
+                except ConnectionError:
+                    pass
             if len(members) < MESH_D_LO:
-                pool = [pid for pid in cands if pid not in members]
+                pool = [
+                    pid for pid in cands
+                    if pid not in members
+                    and self._combined_score(cands[pid], topic)
+                    >= self.TOPIC_GRAFT_SCORE
+                ]
                 _random.shuffle(pool)
                 for pid in pool[: MESH_D - len(members)]:
                     members.add(pid)
@@ -677,7 +739,8 @@ class WireNode:
                         members.discard(pid)
             elif len(members) > MESH_D_HI:
                 ranked = sorted(
-                    members, key=lambda pid: cands[pid].score.score
+                    members,
+                    key=lambda pid: self._combined_score(cands[pid], topic),
                 )
                 for pid in ranked[: len(members) - MESH_D]:
                     members.discard(pid)
@@ -730,9 +793,34 @@ class WireNode:
         topic = body[1 : 1 + tlen].decode()
         mid = body[1 + tlen : 21 + tlen]
         compressed = body[21 + tlen :]
+        in_mesh = peer.peer_id in self.mesh.get(topic, ())
         with self._seen_lock:
-            if mid in self._seen:
-                return
+            first_seen = self._seen.get(mid)
+        if first_seen is not None:
+            # duplicate: counts as a mesh delivery ONLY inside the
+            # delivery window after the first copy, and only when the
+            # body is AUTHENTIC for the claimed id — otherwise a
+            # freeloader could hold its mesh slot by echoing seen ids
+            # over garbage (code-review r4 finding).  The decompress cost
+            # is bounded by the gossip_publish rate limiter above.
+            if in_mesh and (
+                time.time() - first_seen <= self.MESH_DELIVERY_WINDOW_S
+            ):
+                try:
+                    payload = snappy.decompress(compressed)
+                    authentic = (
+                        hashlib.sha256(topic.encode() + payload).digest()[:20]
+                        == mid
+                    )
+                except Exception:
+                    authentic = False
+                if authentic:
+                    peer.topic_scores.on_delivery(topic, first=False,
+                                                  in_mesh=True)
+                else:
+                    peer.topic_scores.on_invalid(topic)
+                    self._score(peer, -10.0)
+            return
         try:
             payload = snappy.decompress(compressed)
             expect = hashlib.sha256(topic.encode() + payload).digest()[:20]
@@ -742,6 +830,7 @@ class WireNode:
         except Exception:
             # do NOT mark seen: a peer flooding garbage under a real
             # message's id must not censor the honest copy
+            peer.topic_scores.on_invalid(topic)
             self._score(peer, -10.0)
             return
         if not self._mark_seen(mid):
@@ -758,8 +847,11 @@ class WireNode:
         if handler is not None:
             ok = handler(peer.peer_id, message)
             if ok is False:
+                peer.topic_scores.on_invalid(topic)
                 self._score(peer, -10.0)
                 return        # invalid gossip is NOT re-flooded
+        peer.topic_scores.on_delivery(topic, first=True, in_mesh=in_mesh)
+        self._note_topic_traffic(topic)
         # flood onward (at-most-once per node via the seen cache)
         self._flood(topic, mid, compressed, exclude=peer)
 

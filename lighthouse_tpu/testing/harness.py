@@ -38,13 +38,20 @@ class Harness:
         self._engines = {}  # fork-aware mock EL instances
 
     def engine(self, capella=False):
-        """Shared mock execution engine (test_utils mock EL)."""
-        key = bool(capella)
-        if key not in self._engines:
+        """Shared mock execution engine (test_utils mock EL).
+
+        ONE underlying EL chain regardless of fork: a harness chain that
+        crosses bellatrix→capella keeps building on the payloads the
+        pre-fork engine produced (two separate engines would lose the
+        parent-hash ancestry at the fork boundary); the `capella` flag
+        only switches the payload TYPE produced."""
+        if "el" not in self._engines:
             from ..execution import MockExecutionEngine
 
-            self._engines[key] = MockExecutionEngine(self.T, capella=capella)
-        return self._engines[key]
+            self._engines["el"] = MockExecutionEngine(self.T, capella=capella)
+        eng = self._engines["el"]
+        eng.capella = bool(capella)
+        return eng
 
     # ------------------------------------------------------------- signing
 
@@ -58,7 +65,7 @@ class Harness:
 
     def produce_block(self, slot, attestations=(), deposits=(),
                       proposer_slashings=(), attester_slashings=(),
-                      voluntary_exits=()):
+                      voluntary_exits=(), bls_to_execution_changes=()):
         """Build a valid signed block at `slot` on the current state
         (phase0 or altair body depending on the state's fork)."""
         spec, preset = self.spec, self.preset
@@ -94,7 +101,9 @@ class Harness:
                 state, randao_reveal, capella
             )
         if capella:
-            body_kwargs["bls_to_execution_changes"] = []
+            body_kwargs["bls_to_execution_changes"] = list(
+                bls_to_execution_changes
+            )
             body = self.T.BeaconBlockBodyCapella(**body_kwargs)
             block_cls, signed_cls = self.T.BeaconBlockCapella, self.T.SignedBeaconBlockCapella
         elif bellatrix:
@@ -215,6 +224,41 @@ class Harness:
             validator_index, compute_signing_root(exit_msg, domain)
         )
         return SignedVoluntaryExit(message=exit_msg, signature=sig)
+
+    def make_bls_to_execution_change(self, validator_index, wd_sk,
+                                     to_address=b"\xbb" * 20,
+                                     set_credentials=True):
+        """A signed BLS→execution credential rotation for `validator_index`
+        under withdrawal key `wd_sk`.  With `set_credentials`, the
+        validator's 0x00 credentials are first pointed at the withdrawal
+        key's hash so the change validates (signature_sets.rs
+        bls_to_execution_change domain: genesis fork version)."""
+        import hashlib as _hashlib
+
+        from ..types import compute_domain
+        from ..types.containers import (
+            BLSToExecutionChange,
+            SignedBLSToExecutionChange,
+        )
+
+        wd_pk = g1_compress(RB.sk_to_pk(wd_sk))
+        if set_credentials:
+            v = self.state.validators[int(validator_index)]
+            v.withdrawal_credentials = (
+                b"\x00" + _hashlib.sha256(wd_pk).digest()[1:]
+            )
+        change = BLSToExecutionChange(
+            validator_index=int(validator_index),
+            from_bls_pubkey=wd_pk,
+            to_execution_address=to_address,
+        )
+        domain = compute_domain(
+            Domain.BLS_TO_EXECUTION_CHANGE,
+            self.spec.genesis_fork_version,
+            bytes(self.state.genesis_validators_root),
+        )
+        sig = g2_compress(RB.sign(wd_sk, compute_signing_root(change, domain)))
+        return SignedBLSToExecutionChange(message=change, signature=sig)
 
     def _execution_payload(self, state, randao_reveal, capella):
         from ..state_processing import bellatrix as bx

@@ -100,7 +100,7 @@ def test_encrypted_peer_id_derived_from_static_key():
     from tests.test_wire import _make_chain
     from lighthouse_tpu.network.wire import WireNode
 
-    chain = _make_chain()
+    _, chain = _make_chain()
     node = WireNode(chain, encrypt=True, quotas={}, peer_id="spoofed-id")
     try:
         # encrypt mode IGNORES a self-asserted peer_id: identity is the
@@ -119,7 +119,7 @@ def test_impersonating_peer_id_rejected():
     from tests.test_wire import _make_chain
     from lighthouse_tpu.network.wire import WireError, WireNode
 
-    chain = _make_chain()
+    _, chain = _make_chain()
     a = WireNode(chain, encrypt=True, quotas={})
     b = WireNode(chain, encrypt=True, quotas={})
     try:
@@ -138,7 +138,7 @@ def test_honest_encrypted_dial_still_works():
     from tests.test_wire import _make_chain, _wait
     from lighthouse_tpu.network.wire import WireNode
 
-    chain = _make_chain()
+    _, chain = _make_chain()
     a = WireNode(chain, encrypt=True, quotas={})
     b = WireNode(chain, encrypt=True, quotas={})
     try:
@@ -246,7 +246,7 @@ def test_blocks_by_range_step_not_one_rejected():
     )
     from lighthouse_tpu.ssz import encode
 
-    chain = _make_chain()
+    _, chain = _make_chain()
     a = WireNode(chain, quotas={})
     b = WireNode(chain, quotas={})
     try:
@@ -255,7 +255,7 @@ def test_blocks_by_range_step_not_one_rejected():
         with pytest.raises(WireError):
             b._request(pid, M_BLOCKS_BY_RANGE, encode(BlocksByRangeRequest, req))
         # step == 1 on the same connection still answers
-        ok = b._request(
+        chunks, _code = b._request(
             pid,
             M_BLOCKS_BY_RANGE,
             encode(
@@ -263,7 +263,7 @@ def test_blocks_by_range_step_not_one_rejected():
                 BlocksByRangeRequest(start_slot=0, count=4, step=1),
             ),
         )
-        assert isinstance(ok, list)
+        assert isinstance(chunks, list)
     finally:
         a.stop()
         b.stop()
