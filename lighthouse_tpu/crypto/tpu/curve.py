@@ -337,12 +337,22 @@ def g2_clear_cofactor(p):
     """[h_eff]P by the psi trick (RFC 9380 G.3, as in the oracle):
 
     h_eff P = [x^2 - x - 1]P + [x - 1]psi(P) + psi^2(2P),  x = -|x|.
+
+    [x]P and [x]psi(P) are independent, so they ride ONE stacked ladder
+    instance (compile cost is per-instance — r4 profile: each G2 ladder
+    ~6 s to compile); only [x^2]P = [x]([x]P) needs a second ladder.
     """
-    t1 = mul_int(F2_OPS, p, -BLS_X)                      # [x]P
     t2 = g2_psi(p)                                       # psi(P)
+    cat = jax.tree_util.tree_map(
+        lambda a, b: jnp.concatenate([a, b], axis=-1), p, t2
+    )
+    xs = mul_int(F2_OPS, cat, -BLS_X)                    # [x]P ‖ [x]psi(P)
+    n = jax.tree_util.tree_leaves(p[0])[0].shape[-1]
+    t1 = jax.tree_util.tree_map(lambda a: a[..., :n], xs)
+    xt2 = jax.tree_util.tree_map(lambda a: a[..., n:], xs)
     out = add(F2_OPS, mul_int(F2_OPS, t1, -BLS_X), neg_point(F2_OPS, t1))
     out = add(F2_OPS, out, neg_point(F2_OPS, p))         # [x^2 - x - 1]P
-    out = add(F2_OPS, out, mul_int(F2_OPS, t2, -BLS_X))  # + [x]psi(P)
+    out = add(F2_OPS, out, xt2)                          # + [x]psi(P)
     out = add(F2_OPS, out, neg_point(F2_OPS, t2))        # - psi(P)
     out = add(F2_OPS, out, g2_psi(g2_psi(double(F2_OPS, p))))  # + psi^2(2P)
     return out
