@@ -1,0 +1,169 @@
+// Compact SHA-256 + RFC 9380 expand_message_xmd for the native BLS
+// backend.  Scalar FIPS 180-4 implementation (the hot path hashes tiny
+// inputs: one compression per block); the merkleization engine
+// (csrc/sha256_merkle.cpp) keeps its own SHA-NI dispatch — this header
+// is self-contained so blsnative.so has no link dependency.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+
+namespace blsn_sha {
+
+static const uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+static inline uint32_t rotr(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+}
+
+struct Ctx {
+    uint32_t h[8];
+    uint8_t buf[64];
+    uint64_t total;
+    size_t fill;
+};
+
+static void sha_init(Ctx& c) {
+    static const uint32_t H0[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                   0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                   0x1f83d9ab, 0x5be0cd19};
+    std::memcpy(c.h, H0, sizeof(H0));
+    c.total = 0;
+    c.fill = 0;
+}
+
+static void sha_block(Ctx& c, const uint8_t* p) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+        w[i] = ((uint32_t)p[4 * i] << 24) | ((uint32_t)p[4 * i + 1] << 16) |
+               ((uint32_t)p[4 * i + 2] << 8) | p[4 * i + 3];
+    for (int i = 16; i < 64; i++) {
+        uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+        uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = c.h[0], b = c.h[1], cc = c.h[2], d = c.h[3], e = c.h[4],
+             f = c.h[5], g = c.h[6], hh = c.h[7];
+    for (int i = 0; i < 64; i++) {
+        uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+        uint32_t ch = (e & f) ^ (~e & g);
+        uint32_t t1 = hh + S1 + ch + K[i] + w[i];
+        uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+        uint32_t mj = (a & b) ^ (a & cc) ^ (b & cc);
+        uint32_t t2 = S0 + mj;
+        hh = g; g = f; f = e; e = d + t1;
+        d = cc; cc = b; b = a; a = t1 + t2;
+    }
+    c.h[0] += a; c.h[1] += b; c.h[2] += cc; c.h[3] += d;
+    c.h[4] += e; c.h[5] += f; c.h[6] += g; c.h[7] += hh;
+}
+
+static void sha_update(Ctx& c, const uint8_t* p, size_t n) {
+    c.total += n;
+    while (n) {
+        size_t take = 64 - c.fill;
+        if (take > n) take = n;
+        std::memcpy(c.buf + c.fill, p, take);
+        c.fill += take;
+        p += take;
+        n -= take;
+        if (c.fill == 64) {
+            sha_block(c, c.buf);
+            c.fill = 0;
+        }
+    }
+}
+
+static void sha_final(Ctx& c, uint8_t out[32]) {
+    uint64_t bits = c.total * 8;
+    uint8_t pad = 0x80;
+    sha_update(c, &pad, 1);
+    uint8_t z = 0;
+    while (c.fill != 56) sha_update(c, &z, 1);
+    uint8_t len[8];
+    for (int i = 0; i < 8; i++) len[i] = (uint8_t)(bits >> (56 - 8 * i));
+    sha_update(c, len, 8);
+    for (int i = 0; i < 8; i++) {
+        out[4 * i] = (uint8_t)(c.h[i] >> 24);
+        out[4 * i + 1] = (uint8_t)(c.h[i] >> 16);
+        out[4 * i + 2] = (uint8_t)(c.h[i] >> 8);
+        out[4 * i + 3] = (uint8_t)c.h[i];
+    }
+}
+
+}  // namespace blsn_sha
+
+// RFC 9380 expand_message_xmd (SHA-256); mirrors
+// lighthouse_tpu/crypto/ref/hash_to_curve.py expand_message_xmd.
+static void expand_message_xmd(uint8_t* out, uint32_t len_in_bytes,
+                               const uint8_t* msg, uint32_t msg_len,
+                               const uint8_t* dst, uint32_t dst_len) {
+    using namespace blsn_sha;
+    uint8_t dst_buf[256];
+    uint32_t dlen = dst_len;
+    if (dst_len > 255) {
+        Ctx c;
+        sha_init(c);
+        const char* pre = "H2C-OVERSIZE-DST-";
+        sha_update(c, (const uint8_t*)pre, 17);
+        sha_update(c, dst, dst_len);
+        sha_final(c, dst_buf);
+        dlen = 32;
+    } else {
+        std::memcpy(dst_buf, dst, dst_len);
+    }
+    dst_buf[dlen] = (uint8_t)dlen;  // dst_prime = dst || len(dst)
+    uint32_t ell = (len_in_bytes + 31) / 32;
+
+    uint8_t b0[32];
+    {
+        Ctx c;
+        sha_init(c);
+        uint8_t z_pad[64] = {0};
+        sha_update(c, z_pad, 64);
+        sha_update(c, msg, msg_len);
+        uint8_t lib[3] = {(uint8_t)(len_in_bytes >> 8),
+                          (uint8_t)len_in_bytes, 0};
+        sha_update(c, lib, 3);
+        sha_update(c, dst_buf, dlen + 1);
+        sha_final(c, b0);
+    }
+    uint8_t bi[32];
+    {
+        Ctx c;
+        sha_init(c);
+        sha_update(c, b0, 32);
+        uint8_t one = 1;
+        sha_update(c, &one, 1);
+        sha_update(c, dst_buf, dlen + 1);
+        sha_final(c, bi);
+    }
+    uint32_t produced = 0;
+    for (uint32_t i = 1; i <= ell; i++) {
+        uint32_t take = len_in_bytes - produced;
+        if (take > 32) take = 32;
+        std::memcpy(out + produced, bi, take);
+        produced += take;
+        if (i == ell) break;
+        uint8_t x[32];
+        for (int j = 0; j < 32; j++) x[j] = b0[j] ^ bi[j];
+        Ctx c;
+        sha_init(c);
+        sha_update(c, x, 32);
+        uint8_t idx = (uint8_t)(i + 1);
+        sha_update(c, &idx, 1);
+        sha_update(c, dst_buf, dlen + 1);
+        sha_final(c, bi);
+    }
+}

@@ -1,17 +1,20 @@
-"""BLS backend seam with device→host fallback.
+"""BLS backend seam with device→native→host fallback.
 
 Mirror of the reference's compile-time backend selection in
 /root/reference/crypto/bls/src/lib.rs:29-49 (supranational | milagro |
 fake_crypto | ckb-vm behind `define_mod!`), recast as a runtime seam:
 
   * "tpu"    — the JAX batched kernel (crypto/tpu/bls.py), the product
+  * "native" — the C++ engine (csrc/blsnative.cpp), the blst-slot CPU
+               path (~150+ sets/s/core vs the oracle's ~1)
   * "oracle" — the pure-python host reference (crypto/ref/bls.py), the
                milagro-analogue differential oracle
   * "fake"   — always-true (fake_crypto.rs:29-33), for STF-only tests
 
-A device failure degrades to the oracle instead of taking the node down
-(SURVEY.md §7 hard part 7: "TPU server crash must degrade to blst, or a
-node outage becomes consensus-critical"), counting the event in metrics.
+A device failure degrades to the native engine (then the oracle) instead
+of taking the node down (SURVEY.md §7 hard part 7: "TPU server crash
+must degrade to blst, or a node outage becomes consensus-critical"),
+counting the event in metrics.
 """
 
 import logging
@@ -21,9 +24,40 @@ from ..utils import metrics
 log = logging.getLogger("lighthouse_tpu.crypto")
 
 
+def _host_verify(sets):
+    """Best host path: native C++ when buildable, else the oracle.  A
+    native failure degrades to the oracle (the fallback chain must never
+    re-raise out of its middle hop — SURVEY §7 hard part 7)."""
+    from . import native_bls
+
+    if native_bls.available():
+        try:
+            return native_bls.verify_signature_sets(sets)
+        except Exception as e:
+            metrics.HOST_BACKEND_FALLBACKS.inc()
+            log.warning("native verify failed (%s); oracle fallback", e)
+    from .ref import bls as RB
+
+    return RB.verify_signature_sets(sets)
+
+
+def _host_per_set(sets):
+    from . import native_bls
+
+    if native_bls.available():
+        try:
+            return native_bls.verify_signature_sets_per_set(sets)
+        except Exception as e:
+            metrics.HOST_BACKEND_FALLBACKS.inc()
+            log.warning("native per-set failed (%s); oracle fallback", e)
+    from .ref import bls as RB
+
+    return [RB.verify_signature_sets([s]) for s in sets]
+
+
 class SignatureVerifier:
     def __init__(self, backend="tpu", fallback=True):
-        assert backend in ("tpu", "oracle", "fake")
+        assert backend in ("tpu", "native", "oracle", "fake")
         self.backend = backend
         self.fallback = fallback
 
@@ -41,7 +75,18 @@ class SignatureVerifier:
                 if not self.fallback:
                     raise
                 metrics.DEVICE_FALLBACKS.inc()
-                log.warning("TPU verify failed (%s); falling back to oracle", e)
+                log.warning("TPU verify failed (%s); host fallback", e)
+            return _host_verify(sets)
+        if self.backend == "native":
+            try:
+                from . import native_bls
+
+                return native_bls.verify_signature_sets(sets)
+            except Exception as e:
+                if not self.fallback:
+                    raise
+                metrics.HOST_BACKEND_FALLBACKS.inc()
+                log.warning("native verify failed (%s); oracle fallback", e)
         from .ref import bls as RB
 
         return RB.verify_signature_sets(sets)
@@ -59,7 +104,18 @@ class SignatureVerifier:
                 if not self.fallback:
                     raise
                 metrics.DEVICE_FALLBACKS.inc()
-                log.warning("TPU per-set verify failed (%s); oracle fallback", e)
+                log.warning("TPU per-set verify failed (%s); host fallback", e)
+            return _host_per_set(sets)
+        if self.backend == "native":
+            try:
+                from . import native_bls
+
+                return native_bls.verify_signature_sets_per_set(sets)
+            except Exception as e:
+                if not self.fallback:
+                    raise
+                metrics.HOST_BACKEND_FALLBACKS.inc()
+                log.warning("native per-set failed (%s); oracle fallback", e)
         from .ref import bls as RB
 
         return [RB.verify_signature_sets([s]) for s in sets]

@@ -1,0 +1,194 @@
+"""ctypes binding for the native C++ BLS backend (csrc/blsnative.cpp).
+
+The blst slot: the reference's CPU verification path is the native blst
+library (/root/reference/crypto/bls/src/impls/blst.rs); on hosts without
+a healthy accelerator this engine carries `verify_signature_sets`
+instead of the ~1 set/s pure-Python oracle (~150+ sets/s/core measured).
+API mirrors the oracle exactly (crypto/ref/bls.py): oracle-style
+SignatureSets in (affine int points), bool / verdict-list out, identical
+structural/subgroup reject semantics — differentially tested in
+tests/test_native_bls.py including the frozen BLS vectors.
+
+Build-on-first-use like native/kvlog.py: recompiles when the source is
+newer than the .so; returns None from `available()` when the toolchain
+is missing so the backend seam can fall through to the oracle.
+"""
+
+import ctypes
+import os
+import secrets
+import subprocess
+import threading
+
+from .constants import DST_POP, RAND_BITS
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_CSRC = os.path.join(_HERE, "..", "..", "csrc")
+_SO = os.path.join(_HERE, "..", "native", "libblsnative.so")
+_SRC = os.path.join(_CSRC, "blsnative.cpp")
+_DEPS = (_SRC, os.path.join(_CSRC, "blsnative_sha.h"),
+         os.path.join(_CSRC, "blsnative_constants.h"))
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    if not os.path.exists(_SRC):
+        return None
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", _SO, _SRC],
+            check=True,
+            capture_output=True,
+            timeout=180,
+        )
+    except Exception:
+        return None
+    return _SO
+
+
+def _load():
+    stale = not os.path.exists(_SO) or any(
+        os.path.exists(d) and os.path.getmtime(d) > os.path.getmtime(_SO)
+        for d in _DEPS
+    )
+    path = _build() if stale else _SO
+    if path is None:
+        path = _SO if os.path.exists(_SO) else None
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.blsn_verify_sets.argtypes = [
+        ctypes.c_uint32,
+        ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_char_p,
+    ]
+    lib.blsn_verify_sets.restype = ctypes.c_int
+    lib.blsn_g2_in_subgroup.argtypes = [ctypes.c_char_p]
+    lib.blsn_g2_in_subgroup.restype = ctypes.c_int
+    return lib
+
+
+def _get():
+    global _lib, _tried
+    with _lock:
+        if not _tried:
+            _lib = _load()
+            _tried = True
+        return _lib
+
+
+def available() -> bool:
+    return _get() is not None
+
+
+def _be48(x):
+    return int(x).to_bytes(48, "big")
+
+
+def _g2_bytes(p):
+    return (_be48(p[0][0]) + _be48(p[0][1])
+            + _be48(p[1][0]) + _be48(p[1][1]))
+
+
+def _draw_rands(n, rng):
+    draw = rng if rng is not None else (
+        lambda: secrets.randbits(RAND_BITS)
+    )
+    out = []
+    for _ in range(n):
+        r = 0
+        while r == 0:
+            r = draw() & ((1 << RAND_BITS) - 1)
+        out.append(r)
+    return out
+
+
+def _marshal(sets):
+    """Oracle-style sets -> C buffers.  Returns None when a structural
+    reject applies batch-wide (mirrors ref/bls.py early Falses)."""
+    sig_blob = bytearray()
+    sig_inf = bytearray()
+    pk_offsets = [0]
+    pks = bytearray()
+    msg_offsets = [0]
+    msgs = bytearray()
+    for s in sets:
+        if s.signature is None:
+            sig_blob += b"\x00" * 192
+            sig_inf.append(1)
+        else:
+            sig_blob += _g2_bytes(s.signature)
+            sig_inf.append(0)
+        n_valid_pks = 0
+        for pk in s.pubkeys:
+            if pk is None:
+                return None  # infinity pubkey: batch-wide reject
+            pks += _be48(pk[0]) + _be48(pk[1])
+            n_valid_pks += 1
+        pk_offsets.append(pk_offsets[-1] + n_valid_pks)
+        msgs += bytes(s.message)
+        msg_offsets.append(len(msgs))
+    u32 = ctypes.c_uint32 * len(pk_offsets)
+    return (bytes(sig_blob), bytes(sig_inf), u32(*pk_offsets), bytes(pks),
+            (ctypes.c_uint32 * len(msg_offsets))(*msg_offsets), bytes(msgs))
+
+
+def verify_signature_sets(sets, dst=DST_POP, rng=None) -> bool:
+    """blst verify_multiple_aggregate_signatures semantics — native."""
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native BLS backend unavailable")
+    sets = list(sets)
+    if not sets:
+        return False
+    m = _marshal(sets)
+    if m is None:
+        return False
+    sig_blob, sig_inf, pk_off, pks, msg_off, msgs = m
+    rands = _draw_rands(len(sets), rng)
+    rc = lib.blsn_verify_sets(
+        len(sets), sig_blob, sig_inf, pk_off, pks, msg_off, msgs,
+        bytes(dst), len(dst),
+        (ctypes.c_uint64 * len(rands))(*rands), None,
+    )
+    return rc == 1
+
+
+def verify_signature_sets_per_set(sets, dst=DST_POP) -> list:
+    """Per-set verdict vector (the poisoning fallback), native."""
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native BLS backend unavailable")
+    sets = list(sets)
+    if not sets:
+        return []
+    m = _marshal(sets)
+    if m is None:
+        # an infinity pubkey poisons only its own set under per-set
+        # semantics: split around the offending sets
+        out = []
+        for s in sets:
+            if any(pk is None for pk in s.pubkeys):
+                out.append(False)
+            else:
+                out.append(verify_signature_sets([s], dst))
+        return out
+    sig_blob, sig_inf, pk_off, pks, msg_off, msgs = m
+    rands = _draw_rands(len(sets), None)
+    verdicts = ctypes.create_string_buffer(len(sets))
+    lib.blsn_verify_sets(
+        len(sets), sig_blob, sig_inf, pk_off, pks, msg_off, msgs,
+        bytes(dst), len(dst),
+        (ctypes.c_uint64 * len(rands))(*rands), verdicts,
+    )
+    return [bool(b) for b in verdicts.raw]

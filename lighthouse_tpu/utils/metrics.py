@@ -146,7 +146,11 @@ SIGNATURE_SETS_VERIFIED = counter(
     "bls_signature_sets_verified_total", "Signature sets through the kernel"
 )
 DEVICE_FALLBACKS = counter(
-    "bls_device_fallback_total", "Kernel failures degraded to host oracle"
+    "bls_device_fallback_total", "Kernel failures degraded to host path"
+)
+HOST_BACKEND_FALLBACKS = counter(
+    "bls_native_fallback_total",
+    "Native C++ engine failures degraded to the python oracle",
 )
 HEAD_RECOMPUTE_TIMES = histogram(
     "beacon_fork_choice_find_head_seconds", "Fork-choice head recompute"
