@@ -262,7 +262,16 @@ static void f2_mul(F2& r, const F2& x, const F2& y) {
     r.b = im;
 }
 
-static inline void f2_sqr(F2& r, const F2& x) { f2_mul(r, x, x); }
+static inline void f2_sqr(F2& r, const F2& x) {
+    // (a + bu)^2 = (a+b)(a-b) + 2ab u — two base mults
+    Fp s, d, m, ab;
+    fp_add(s, x.a, x.b);
+    fp_sub(d, x.a, x.b);
+    fp_mul(m, s, d);
+    fp_mul(ab, x.a, x.b);
+    r.a = m;
+    fp_add(r.b, ab, ab);
+}
 
 static inline void f2_mul_fp(F2& r, const F2& x, const Fp& s) {
     fp_mul(r.a, x.a, s);
@@ -476,7 +485,102 @@ static inline void f12_mul(F12& r, const F12& x, const F12& y) {
     r.b = w;
 }
 
-static inline void f12_sqr(F12& r, const F12& x) { f12_mul(r, x, x); }
+static inline void f12_sqr(F12& r, const F12& x) {
+    // complex-Karatsuba: 2 f6_muls instead of f12_mul's 3
+    F6 t2, t0, t1v, vt, m;
+    f6_mul(t2, x.a, x.b);
+    f6_add(t0, x.a, x.b);
+    f6_mul_v(vt, x.b);
+    f6_add(t1v, x.a, vt);
+    f6_mul(m, t0, t1v);
+    F6 c0;
+    f6_sub(c0, m, t2);
+    f6_mul_v(vt, t2);
+    f6_sub(c0, c0, vt);
+    r.a = c0;
+    f6_add(r.b, t2, t2);
+}
+
+// Granger–Scott cyclotomic squaring (valid after the easy part of the
+// final exponentiation) — 9 f2 squarings vs f12_sqr's 12 f2 muls.
+// Port of crypto/tpu/tower.py f12_cyclotomic_sqr (w-coefficient layout:
+// the Fp4 sub-blocks are (x0,x4), (x3,x2), (x1,x5) with t^2 = xi).
+static void f12_cyc_sqr(F12& r, const F12& x) {
+    // w-coeffs: [w^0, w^1, w^2, w^3, w^4, w^5]
+    const F2& x0 = x.a.a;
+    const F2& x3g = x.b.a;   // w^1
+    const F2& x1 = x.a.b;    // w^2
+    const F2& x4 = x.b.b;    // w^3
+    const F2& x2 = x.a.c;    // w^4
+    const F2& x5 = x.b.c;    // w^5
+    // python naming: x0=w0, x3=w1, x1=w2, x4=w3, x2=w4, x5=w5;
+    // t0=x4^2 t1=x0^2 t2=x2^2 t3=x3^2 t4=x5^2 t5=x1^2
+    F2 t0, t1, t2, t3, t4, t5, s, t6, t7, t8, T0, T2, T4, w;
+    f2_sqr(t0, x4);
+    f2_sqr(t1, x0);
+    f2_sqr(t2, x2);
+    f2_sqr(t3, x3g);
+    f2_sqr(t4, x5);
+    f2_sqr(t5, x1);
+    f2_add(s, x4, x0);
+    f2_sqr(t6, s);
+    f2_sub(t6, t6, t0);
+    f2_sub(t6, t6, t1);      // 2 x4 x0
+    f2_add(s, x2, x3g);
+    f2_sqr(t7, s);
+    f2_sub(t7, t7, t2);
+    f2_sub(t7, t7, t3);      // 2 x2 x3
+    f2_add(s, x5, x1);
+    f2_sqr(t8, s);
+    f2_sub(t8, t8, t4);
+    f2_sub(t8, t8, t5);
+    f2_mul_xi(t8, t8);       // 2 x5 x1 xi
+    f2_mul_xi(w, t0);
+    f2_add(T0, w, t1);       // xi x4^2 + x0^2
+    f2_mul_xi(w, t2);
+    f2_add(T2, w, t3);       // xi x2^2 + x3^2
+    f2_mul_xi(w, t4);
+    f2_add(T4, w, t5);       // xi x5^2 + x1^2
+    // z_re = 3T - 2x ; z_im = 3t + 2x
+    F2 z0, z1, z2, z3, z4, z5;
+    auto out_re = [](F2& z, const F2& T, const F2& xx) {
+        F2 d;
+        f2_sub(d, T, xx);
+        f2_add(z, d, d);
+        f2_add(z, z, T);
+    };
+    auto out_im = [](F2& z, const F2& t, const F2& xx) {
+        F2 sm;
+        f2_add(sm, t, xx);
+        f2_add(z, sm, sm);
+        f2_add(z, z, t);
+    };
+    out_re(z0, T0, x0);      // w^0
+    out_re(z1, T2, x1);      // w^2
+    out_re(z2, T4, x2);      // w^4
+    out_im(z3, t8, x3g);     // w^1
+    out_im(z4, t6, x4);      // w^3
+    out_im(z5, t7, x5);      // w^5
+    r.a.a = z0;
+    r.b.a = z3;
+    r.a.b = z1;
+    r.b.b = z4;
+    r.a.c = z2;
+    r.b.c = z5;
+}
+
+// square-and-multiply with cyclotomic squarings — hard-part ladders only
+static void f12_pow_cyc(F12& r, const F12& a, const u64* e, int nlimbs) {
+    F12 base = a, acc;
+    f12_one(acc);
+    int topbit = nlimbs * 64 - 1;
+    while (topbit > 0 && !((e[topbit / 64] >> (topbit % 64)) & 1)) topbit--;
+    for (int i = 0; i <= topbit; i++) {
+        if ((e[i / 64] >> (i % 64)) & 1) f12_mul(acc, acc, base);
+        f12_cyc_sqr(base, base);
+    }
+    r = acc;
+}
 
 static inline void f12_conj(F12& r, const F12& x) {
     r.a = x.a;
@@ -522,18 +626,6 @@ static void f12_frobenius(F12& r, const F12& x, int power) {
     r.b.b = cs[3];
     r.a.c = cs[4];
     r.b.c = cs[5];
-}
-
-static void f12_pow_limbs(F12& r, const F12& a, const u64* e, int nlimbs) {
-    F12 base = a, acc;
-    f12_one(acc);
-    int topbit = nlimbs * 64 - 1;
-    while (topbit > 0 && !((e[topbit / 64] >> (topbit % 64)) & 1)) topbit--;
-    for (int i = 0; i <= topbit; i++) {
-        if ((e[i / 64] >> (i % 64)) & 1) f12_mul(acc, acc, base);
-        f12_sqr(base, base);
-    }
-    r = acc;
 }
 
 // ------------------------------------------------------- G1 (Jacobian/Fp)
@@ -834,12 +926,47 @@ static void g2_clear_cofactor(G2& r, const G2& p) {
 // value (c0 at w^0, c2 at w^2, c3 at w^3); the per-line w^3 factor
 // accumulates to an Fp2 value killed by the final exponentiation.
 
-static void line_to_f12(F12& r, const F2& c0, const F2& c2, const F2& c3) {
-    f6_zero(r.a);
-    f6_zero(r.b);
-    r.a.a = c0;   // w^0
-    r.a.b = c2;   // w^2 = v
-    r.b.b = c3;   // w^3 = v w
+// f <- f * line, exploiting the line's sparsity (only w^0, w^2, w^3
+// nonzero): 15 f2 muls vs the generic f12_mul's 18.
+static void f6_mul_sparse2(F6& r, const F6& x, const F2& c0, const F2& c1) {
+    // (a,b,c) * (c0, c1, 0)
+    F2 t, u;
+    f2_mul(t, x.c, c1);
+    f2_mul_xi(t, t);
+    f2_mul(u, x.a, c0);
+    f2_add(r.a, u, t);                 // a c0 + xi(c c1)
+    F2 ba, ab;
+    f2_mul(ba, x.b, c0);
+    f2_mul(ab, x.a, c1);
+    f2_add(r.b, ba, ab);               // b c0 + a c1
+    f2_mul(ba, x.c, c0);
+    f2_mul(ab, x.b, c1);
+    f2_add(r.c, ba, ab);               // c c0 + b c1
+}
+
+static void f6_mul_sparse1(F6& r, const F6& x, const F2& c) {
+    // (a,b,c) * (0, c, 0)
+    F2 t;
+    f2_mul(t, x.c, c);
+    f2_mul_xi(r.a, t);
+    f2_mul(r.b, x.a, c);
+    f2_mul(r.c, x.b, c);
+}
+
+static void f12_mul_line(F12& f, const F2& l0, const F2& l2, const F2& l3) {
+    F6 t0, t1, s, w;
+    f6_mul_sparse2(t0, f.a, l0, l2);
+    f6_mul_sparse1(t1, f.b, l3);
+    f6_add(s, f.a, f.b);
+    F2 l23;
+    f2_add(l23, l2, l3);
+    f6_mul_sparse2(w, s, l0, l23);     // (a0+a1)(b0+b1)
+    f6_sub(w, w, t0);
+    f6_sub(w, w, t1);
+    F6 vt1;
+    f6_mul_v(vt1, t1);
+    f6_add(f.a, t0, vt1);
+    f.b = w;
 }
 
 // doubling step (pairing.py _dbl_step): T <- 2T, line coeffs at psi(P)
@@ -944,18 +1071,15 @@ static void miller_into(F12& f_out, const Fp& xp, const Fp& yp,
     F12 f;
     f12_one(f);
     F2 c0, c2, c3;
-    F12 line;
     // MSB-first bits of |x| after the leading 1 (pairing.py _LOOP_BITS);
     // BLS_X is exactly 64 bits, so the leading 1 sits at bit 63
     for (int i = 62; i >= 0; i--) {
         f12_sqr(f, f);
         dbl_step(T, c0, c2, c3, xp, yp);
-        line_to_f12(line, c0, c2, c3);
-        f12_mul(f, f, line);
+        f12_mul_line(f, c0, c2, c3);
         if ((BLS_X_U64 >> i) & 1) {
             add_step(T, c0, c2, c3, qx, qy, xp, yp);
-            line_to_f12(line, c0, c2, c3);
-            f12_mul(f, f, line);
+            f12_mul_line(f, c0, c2, c3);
         }
     }
     F12 fc;
@@ -974,18 +1098,20 @@ static void final_exp(F12& r, const F12& fin) {
     F12 fr;
     f12_frobenius(fr, f, 2);
     f12_mul(f, fr, f);
-    // hard: f^(c (x+p)(x^2+p^2-1) + 1), c = (x-1)^2/3; x = -|x|
+    // hard: f^(c (x+p)(x^2+p^2-1) + 1), c = (x-1)^2/3; x = -|x|.
+    // All ladder bases live in the cyclotomic subgroup after the easy
+    // part, so the squarings are Granger–Scott (f12_pow_cyc).
     F12 tt;
-    f12_pow_limbs(tt, f, HARD_C_LIMBS, 2);         // t = f^c
+    f12_pow_cyc(tt, f, HARD_C_LIMBS, 2);           // t = f^c
     F12 ex, s;
     u64 xe[1] = {BLS_X_U64};
-    f12_pow_limbs(ex, tt, xe, 1);
+    f12_pow_cyc(ex, tt, xe, 1);
     f12_conj(ex, ex);                              // t^x (x negative)
     f12_frobenius(fr, tt, 1);
     f12_mul(s, ex, fr);                            // s = t^(x+p)
     F12 sx2;
-    f12_pow_limbs(sx2, s, xe, 1);
-    f12_pow_limbs(sx2, sx2, xe, 1);                // s^(x^2) (sign cancels)
+    f12_pow_cyc(sx2, s, xe, 1);
+    f12_pow_cyc(sx2, sx2, xe, 1);                  // s^(x^2) (sign cancels)
     f12_frobenius(fr, s, 2);
     f12_mul(sx2, sx2, fr);
     F12 sc;
