@@ -24,7 +24,10 @@
 // against the Python oracle and runs the frozen BLS vectors.
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 #include "blsnative_constants.h"
 
@@ -1285,44 +1288,54 @@ extern "C" {
 //               fallback); when non-null the function ALSO writes these.
 // Returns 1 if every set verifies (randomized batch check), else 0;
 // -1 on malformed input.
-int blsn_verify_sets(uint32_t n_sets,
-                     const uint8_t* sig_blob, const uint8_t* sig_inf,
-                     const uint32_t* pk_offsets, const uint8_t* pks_blob,
-                     const uint32_t* msg_offsets, const uint8_t* msgs_blob,
-                     const uint8_t* dst, uint32_t dst_len,
-                     const u64* rands,
-                     uint8_t* per_set_out) {
-    if (n_sets == 0) return 0;  // blst: false on empty input
+// per-thread batch state: each worker owns a contiguous set range and
+// accumulates a local miller product + local [r]sig partial sum — the
+// data-parallel shape of the reference's rayon fan-out
+// (block_signature_verifier.rs:396-404), with the merge + single final
+// exponentiation after the join.
+struct _BatchIn {
+    const uint8_t* sig_blob;
+    const uint8_t* sig_inf;
+    const uint32_t* pk_offsets;
+    const uint8_t* pks_blob;
+    const uint32_t* msg_offsets;
+    const uint8_t* msgs_blob;
+    const uint8_t* dst;
+    uint32_t dst_len;
+    const u64* rands;
+    uint8_t* per_set_out;
+    Fp g1x, ng1y;
+};
+
+static void _verify_range(const _BatchIn& in, uint32_t begin, uint32_t end,
+                          F12* prod_out, G2* sacc_out, bool* reject_out,
+                          bool* all_ok_out) {
     F12 acc;
     f12_one(acc);
     G2 sig_acc = {F2_ZERO_, F2_ZERO_, F2_ZERO_};
-    bool all_ok = true;
-    Fp g1x, g1y, ng1y;
-    fp_from_c(g1x, G1X_MONT);
-    fp_from_c(g1y, G1Y_MONT);
-    fp_neg(ng1y, g1y);
-
-    for (uint32_t i = 0; i < n_sets; i++) {
-        // structural / subgroup rejects: batch mode fails fast (oracle
+    bool reject = false, all_ok = true;
+    for (uint32_t i = begin; i < end && !(reject && !in.per_set_out); i++) {
+        // structural / subgroup rejects: batch mode fails (oracle
         // semantics); per-set mode records False and keeps judging the
         // other sets (the poisoning-fallback contract)
         G2 sig;
-        bool set_ok = !sig_inf[i]
-            && (pk_offsets[i + 1] - pk_offsets[i]) > 0
-            && load_g2_affine(sig, sig_blob + (size_t)i * 192)
+        bool set_ok = !in.sig_inf[i]
+            && (in.pk_offsets[i + 1] - in.pk_offsets[i]) > 0
+            && load_g2_affine(sig, in.sig_blob + (size_t)i * 192)
             && g2_in_subgroup_jac(sig);
         if (!set_ok) {
-            if (!per_set_out) return 0;
-            per_set_out[i] = 0;
+            reject = true;
             all_ok = false;
+            if (in.per_set_out) in.per_set_out[i] = 0;
             continue;
         }
-        uint32_t npk = pk_offsets[i + 1] - pk_offsets[i];
+        uint32_t npk = in.pk_offsets[i + 1] - in.pk_offsets[i];
 
         // aggregate the set's pubkeys
         G1 agg = {FP_ZERO, FP_ZERO, FP_ZERO};
         for (uint32_t k = 0; k < npk; k++) {
-            const uint8_t* pb = pks_blob + ((size_t)pk_offsets[i] + k) * 96;
+            const uint8_t* pb =
+                in.pks_blob + ((size_t)in.pk_offsets[i] + k) * 96;
             G1 pk;
             fp_from_be(pk.x, pb);
             fp_from_be(pk.y, pb + 48);
@@ -1331,14 +1344,15 @@ int blsn_verify_sets(uint32_t n_sets,
         }
 
         G2 h;
-        hash_to_g2_native(h, msgs_blob + msg_offsets[i],
-                          msg_offsets[i + 1] - msg_offsets[i], dst, dst_len);
+        hash_to_g2_native(h, in.msgs_blob + in.msg_offsets[i],
+                          in.msg_offsets[i + 1] - in.msg_offsets[i],
+                          in.dst, in.dst_len);
         F2 hx, hy;
         g2_to_affine(hx, hy, h);
 
         // blinded lane: e([r] agg, H(m))
         G1 agg_r;
-        g1_mul_u64(agg_r, agg, rands[i]);
+        g1_mul_u64(agg_r, agg, in.rands[i]);
         if (!g1_is_inf(agg_r)) {
             Fp ax, ay;
             g1_to_affine(ax, ay, agg_r);
@@ -1346,10 +1360,10 @@ int blsn_verify_sets(uint32_t n_sets,
         }
         // accumulate [r] sig
         G2 sig_r;
-        g2_mul_u64(sig_r, sig, rands[i]);
+        g2_mul_u64(sig_r, sig, in.rands[i]);
         g2_add(sig_acc, sig_acc, sig_r);
 
-        if (per_set_out) {
+        if (in.per_set_out) {
             // unblinded per-set verdict: e(agg, H(m)) e(-g1, sig) == 1
             F12 f;
             f12_one(f);
@@ -1360,23 +1374,90 @@ int blsn_verify_sets(uint32_t n_sets,
                 miller_into(f, ax, ay, hx, hy);
                 F2 sx, sy;
                 g2_to_affine(sx, sy, sig);
-                miller_into(f, g1x, ng1y, sx, sy);
+                miller_into(f, in.g1x, in.ng1y, sx, sy);
                 F12 out;
                 final_exp(out, f);
                 ok = f12_is_one(out);
             }
-            per_set_out[i] = ok ? 1 : 0;
+            in.per_set_out[i] = ok ? 1 : 0;
             if (!ok) all_ok = false;
         }
     }
+    *prod_out = acc;
+    *sacc_out = sig_acc;
+    *reject_out = reject;
+    *all_ok_out = all_ok;
+}
+
+static uint32_t _n_threads(uint32_t n_sets) {
+    const char* env = std::getenv("LTPU_NATIVE_THREADS");
+    uint32_t t = env ? (uint32_t)std::atoi(env)
+                     : (uint32_t)std::thread::hardware_concurrency();
+    if (t < 1) t = 1;
+    if (t > n_sets) t = n_sets;
+    if (t > 64) t = 64;
+    return t;
+}
+
+int blsn_verify_sets(uint32_t n_sets,
+                     const uint8_t* sig_blob, const uint8_t* sig_inf,
+                     const uint32_t* pk_offsets, const uint8_t* pks_blob,
+                     const uint32_t* msg_offsets, const uint8_t* msgs_blob,
+                     const uint8_t* dst, uint32_t dst_len,
+                     const u64* rands,
+                     uint8_t* per_set_out) {
+    if (n_sets == 0) return 0;  // blst: false on empty input
+    _BatchIn in = {sig_blob, sig_inf, pk_offsets, pks_blob, msg_offsets,
+                   msgs_blob, dst, dst_len, rands, per_set_out,
+                   Fp{}, Fp{}};
+    Fp g1y;
+    fp_from_c(in.g1x, G1X_MONT);
+    fp_from_c(g1y, G1Y_MONT);
+    fp_neg(in.ng1y, g1y);
+
+    uint32_t nt = _n_threads(n_sets);
+    std::vector<F12> prods(nt);
+    std::vector<G2> saccs(nt);
+    std::vector<uint8_t> rejects(nt), oks(nt);
+    if (nt == 1) {
+        bool rej, aok;
+        _verify_range(in, 0, n_sets, &prods[0], &saccs[0], &rej, &aok);
+        rejects[0] = rej;
+        oks[0] = aok;
+    } else {
+        std::vector<std::thread> pool;
+        uint32_t chunk = (n_sets + nt - 1) / nt;
+        for (uint32_t t = 0; t < nt; t++) {
+            uint32_t b = t * chunk;
+            uint32_t e = b + chunk > n_sets ? n_sets : b + chunk;
+            pool.emplace_back([&, t, b, e]() {
+                bool rej, aok;
+                _verify_range(in, b, e, &prods[t], &saccs[t], &rej, &aok);
+                rejects[t] = rej;
+                oks[t] = aok;
+            });
+        }
+        for (auto& th : pool) th.join();
+    }
+    bool any_reject = false, all_ok = true;
+    F12 acc;
+    f12_one(acc);
+    G2 sig_acc = {F2_ZERO_, F2_ZERO_, F2_ZERO_};
+    for (uint32_t t = 0; t < nt; t++) {
+        any_reject = any_reject || rejects[t];
+        all_ok = all_ok && oks[t];
+        f12_mul(acc, acc, prods[t]);
+        g2_add(sig_acc, sig_acc, saccs[t]);
+    }
+    if (any_reject && !per_set_out) return 0;
     if (!g2_is_inf(sig_acc)) {
         F2 sx, sy;
         g2_to_affine(sx, sy, sig_acc);
-        miller_into(acc, g1x, ng1y, sx, sy);
+        miller_into(acc, in.g1x, in.ng1y, sx, sy);
     }
     F12 out;
     final_exp(out, acc);
-    bool batch_ok = f12_is_one(out);
+    bool batch_ok = f12_is_one(out) && !any_reject;
     if (per_set_out) return (batch_ok && all_ok) ? 1 : 0;
     return batch_ok ? 1 : 0;
 }

@@ -218,6 +218,26 @@ def test_native_matches_frozen(vectors, name):
     assert per == case["per_set"], f"{name}: native per-set={per}"
 
 
+def test_threaded_batch_matches_single(monkeypatch):
+    """The rayon-role thread fan-out (LTPU_NATIVE_THREADS) must agree
+    with the single-thread path on valid, poisoned, and per-set batches
+    across odd chunkings."""
+    import os
+
+    sets = _mk_sets([(1, True)] * 11 + [(2, True)])
+    bad = list(sets)
+    bad[5] = RB.SignatureSet(C.g2_mul(bad[5].signature, 3),
+                             bad[5].pubkeys, bad[5].message)
+    monkeypatch.setenv("LTPU_NATIVE_THREADS", "5")
+    try:
+        assert native_bls.verify_signature_sets(sets, rng=_roll()) is True
+        assert native_bls.verify_signature_sets(bad, rng=_roll()) is False
+        per = native_bls.verify_signature_sets_per_set(bad)
+        assert per == [i != 5 for i in range(12)]
+    finally:
+        monkeypatch.delenv("LTPU_NATIVE_THREADS", raising=False)
+
+
 # ------------------------------------------------------- backend fallback
 
 
