@@ -44,36 +44,20 @@ import time
 
 
 def _preflight_device():
-    """The axon tunnel has died mid-run in rounds 1-3 (hangs, then refuses
-    remote_compile) — probe it in a SUBPROCESS with a hard timeout so a
-    sick device degrades this run to a clearly-labeled CPU measurement
-    instead of a 55-minute hang and rc=1."""
-    import subprocess
-    import sys
-
+    """The axon tunnel has died mid-run in rounds 1-4 (hangs, then refuses
+    remote_compile) — probe it via the shared subprocess helper so a sick
+    device degrades this run to a clearly-labeled CPU measurement instead
+    of a 55-minute hang and rc=1."""
     if os.environ.get("BENCH_PLATFORM"):
         return os.environ["BENCH_PLATFORM"], "forced by BENCH_PLATFORM"
-    probe = (
-        "import jax\n"
-        "x = jax.jit(lambda v: v * 2 + 1)(jax.numpy.ones((128, 128)))\n"
-        "x.block_until_ready()\n"
-        "print(jax.devices()[0].platform)\n"
+    from lighthouse_tpu.utils.device_probe import probe_device
+
+    platform, note = probe_device(
+        float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "300"))
     )
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", probe],
-            capture_output=True,
-            text=True,
-            timeout=float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "300")),
-        )
-        if out.returncode == 0:
-            platform = out.stdout.strip().splitlines()[-1]
-            return None, f"device ok ({platform})"
-        return "cpu", f"device probe failed rc={out.returncode}: " + (
-            out.stderr.strip()[-200:] or "no stderr"
-        )
-    except subprocess.TimeoutExpired:
-        return "cpu", "device probe HUNG (tunnel dead?) — cpu fallback"
+    if platform is not None:
+        return None, note          # healthy device (cpu included): use it
+    return "cpu", note + " — cpu fallback"
 
 
 _FORCED_PLATFORM, _PLATFORM_NOTE = _preflight_device()

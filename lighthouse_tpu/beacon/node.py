@@ -162,7 +162,7 @@ class ClientBuilder:
         self.spec = spec
         self._genesis_state = None
         self._store = None
-        self._backend = "tpu"
+        self._backend = "auto"   # device if healthy, else native/oracle
         self._http_port = None
         self._clock = None
         self._net_port = None

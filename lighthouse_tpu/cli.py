@@ -48,8 +48,8 @@ def build_parser_with_subs():
     _add_common(bn)
     bn.add_argument("--datadir", default="./datadir")
     bn.add_argument("--http-port", type=int, default=5052)
-    bn.add_argument("--crypto-backend", default="tpu",
-                    choices=["tpu", "oracle", "fake"])
+    bn.add_argument("--crypto-backend", default="auto",
+                    choices=["auto", "tpu", "native", "oracle", "fake"])
     bn.add_argument("--genesis-time", type=int, default=None,
                     help="interop genesis timestamp (default: now — a "
                          "live clock must not start billions of slots in)")

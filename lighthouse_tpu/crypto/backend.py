@@ -55,9 +55,45 @@ def _host_per_set(sets):
     return [RB.verify_signature_sets([s]) for s in sets]
 
 
+_AUTO_RESOLVED = None
+
+
+def resolve_auto():
+    """Pick the production backend for THIS host, once per process:
+    a healthy accelerator -> "tpu"; else the native C++ engine; else the
+    oracle.  The device is probed via the shared subprocess helper
+    (utils/device_probe.py, same probe bench.py's preflight uses) — the
+    axon tunnel's failure mode is a jit that hangs forever, and a node
+    must degrade to the host path instead of hanging at startup."""
+    global _AUTO_RESOLVED
+    if _AUTO_RESOLVED is not None:
+        return _AUTO_RESOLVED
+    import os
+
+    from .native_bls import available as _native_available
+    from ..utils.device_probe import probe_device
+
+    try:
+        timeout_s = float(os.environ.get("LTPU_DEVICE_PROBE_TIMEOUT", "60"))
+    except ValueError:
+        timeout_s = 60.0
+    platform, note = probe_device(timeout_s)
+    if platform is not None and platform != "cpu":
+        backend = "tpu"
+        log.info("auto crypto backend: %s -> %r", note, backend)
+    else:
+        backend = "native" if _native_available() else "oracle"
+        log.warning("auto crypto backend: %s -> %r (device path disabled)",
+                    note, backend)
+    _AUTO_RESOLVED = backend
+    return backend
+
+
 class SignatureVerifier:
     def __init__(self, backend="tpu", fallback=True):
-        assert backend in ("tpu", "native", "oracle", "fake")
+        assert backend in ("auto", "tpu", "native", "oracle", "fake")
+        if backend == "auto":
+            backend = resolve_auto()
         self.backend = backend
         self.fallback = fallback
 

@@ -1,0 +1,44 @@
+"""Subprocess accelerator probe — shared by bench.py's preflight and the
+"auto" crypto backend (crypto/backend.py resolve_auto).
+
+The axon tunnel's failure mode is a jit that HANGS forever, so the probe
+runs in a subprocess with a hard timeout; the caller decides what to do
+with the (platform, note) verdict.  No jax import at this module's level:
+bench.py calls this before configuring jax in-process.
+"""
+
+import subprocess
+import sys
+
+_PROBE_SRC = (
+    "import jax\n"
+    "x = jax.jit(lambda v: v * 2 + 1)(jax.numpy.ones((128, 128)))\n"
+    "x.block_until_ready()\n"
+    "print(jax.devices()[0].platform)\n"
+)
+
+
+def probe_device(timeout_s=60.0):
+    """Run a tiny jit in a subprocess.  Returns (platform, note):
+    platform is the backend string ("tpu"/"cpu"/...) when the probe
+    succeeded, None when the device is unusable; note always carries the
+    human-readable reason (rc + trailing stderr, or the hang)."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True,
+            text=True,
+            timeout=float(timeout_s),
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"device probe HUNG after {timeout_s}s (tunnel dead?)"
+    except Exception as e:  # spawn failure etc.
+        return None, f"device probe failed to run: {e!r}"
+    if out.returncode != 0:
+        tail = (out.stderr or "").strip()[-200:] or "no stderr"
+        return None, f"device probe rc={out.returncode}: {tail}"
+    lines = out.stdout.strip().splitlines()
+    if not lines:
+        return None, "device probe produced no output"
+    platform = lines[-1].strip()
+    return platform, f"device ok ({platform})"

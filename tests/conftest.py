@@ -10,6 +10,9 @@ import os
 # plugin registered from sitecustomize), where every eager op is a ~0.6s
 # network round-trip.  Tests must run on the local CPU backend.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# the auto crypto backend probes the device in a subprocess; tests that
+# touch it must not burn the production 60 s dead-tunnel timeout
+os.environ.setdefault("LTPU_DEVICE_PROBE_TIMEOUT", "15")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

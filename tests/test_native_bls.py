@@ -250,3 +250,39 @@ def test_backend_seam_prefers_native_on_device_failure(monkeypatch):
     bad = _mk_sets([(1, False)])
     assert v.verify_signature_sets(bad) is False
     assert v.verify_signature_sets_per_set(sets + bad) == [True, False]
+
+
+def test_auto_backend_resolution_logic(monkeypatch):
+    """"auto" picks the device only when the probe reports a healthy
+    accelerator; a cpu-only or dead-device probe resolves to the native
+    engine (whose CPU throughput is ~1000x the device kernel's CPU
+    emulation).  The probe is stubbed — real device state must not decide
+    a unit test (and the dead-tunnel box would stall it)."""
+    import lighthouse_tpu.crypto.backend as B
+
+    def run_with_probe(result):
+        monkeypatch.setattr(
+            "lighthouse_tpu.utils.device_probe.probe_device",
+            lambda timeout_s=60.0: result,
+        )
+        B._AUTO_RESOLVED = None
+        try:
+            return B.SignatureVerifier("auto").backend
+        finally:
+            B._AUTO_RESOLVED = None
+
+    old = B._AUTO_RESOLVED
+    try:
+        assert run_with_probe(("tpu", "device ok (tpu)")) == "tpu"
+        assert run_with_probe(("cpu", "device ok (cpu)")) == "native"
+        assert run_with_probe((None, "device probe HUNG")) == "native"
+        # and the resolved native verifier actually verifies
+        monkeypatch.setattr(
+            "lighthouse_tpu.utils.device_probe.probe_device",
+            lambda timeout_s=60.0: (None, "device probe HUNG"),
+        )
+        B._AUTO_RESOLVED = None
+        v = B.SignatureVerifier("auto")
+        assert v.verify_signature_sets(_mk_sets([(1, True)])) is True
+    finally:
+        B._AUTO_RESOLVED = old
