@@ -96,12 +96,17 @@ def _left():
     return BUDGET_S - (time.time() - _T0)
 
 
+_DETAILS_PATH = "BENCH_DETAILS.json"   # --warm redirects to its own file
+                                       # so a warm run never clobbers the
+                                       # timed run's artifact
+
+
 def note(name, **kw):
     rec = {"config": name, **kw}
     DETAILS.append(rec)
     print(json.dumps(rec), file=sys.stderr, flush=True)
     try:
-        with open("BENCH_DETAILS.json", "w") as f:
+        with open(_DETAILS_PATH, "w") as f:
             json.dump(DETAILS, f, indent=1)
     except OSError:
         pass
@@ -560,7 +565,9 @@ def warm():
 
 
 def main():
+    global _DETAILS_PATH
     if "--warm" in sys.argv:
+        _DETAILS_PATH = "BENCH_WARM.json"
         warm()
         return
     _install_term_handler()
