@@ -305,6 +305,55 @@ def test_blocks_by_range_response_streams_per_block_frames():
         b.stop()
 
 
+def test_response_stream_abuse_drops_peer():
+    """A responder claiming an absurd chunk total (or out-of-range seq)
+    commits a protocol fault: the requester drops the connection instead
+    of allocating for the claimed stream (r4 review finding on the
+    streamed-response rewrite)."""
+    import struct as _s
+
+    from tests.test_wire import _make_chain, _wait
+    from lighthouse_tpu.network.wire import (
+        M_PING,
+        MAX_RESPONSE_CHUNKS,
+        RESPONSE,
+        WireNode,
+    )
+
+    import threading
+
+    _, chain = _make_chain()
+    a = WireNode(chain, quotas={})
+    b = WireNode(chain, quotas={})
+    try:
+        b.dial("127.0.0.1", a.port)
+        # plant a pending request record on b (no wire round-trip, so no
+        # race with a legitimate response), then forge the stream header
+        # from a's side of the connection
+        rid = 424242
+        ev = threading.Event()
+        rec = [ev, None, None, b.peers[a.peer_id], {}]
+        with b._lock:
+            b._pending[rid] = rec
+        peer = a.peers[b.peer_id]
+        # n far beyond the chunk cap: b's reader must fault the
+        # connection instead of allocating for the claimed stream
+        peer.send_frame(
+            RESPONSE, _s.pack("<IBII", rid, 0, 0, MAX_RESPONSE_CHUNKS + 1)
+        )
+        _wait(lambda: a.peer_id not in b.peers, timeout=5)
+        assert a.peer_id not in b.peers, "abusive stream kept the peer"
+        # the pending waiter is FAILED by the disconnect (never handed
+        # chunks), and nothing was accumulated for the claimed stream
+        _wait(ev.is_set, timeout=5)
+        assert rec[1] is None and rec[4] == {}, (
+            "forged stream header produced data"
+        )
+    finally:
+        a.stop()
+        b.stop()
+
+
 # ------------------------------------------------- snappy declared length
 
 
