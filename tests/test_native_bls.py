@@ -252,6 +252,40 @@ def test_backend_seam_prefers_native_on_device_failure(monkeypatch):
     assert v.verify_signature_sets_per_set(sets + bad) == [True, False]
 
 
+def test_chain_imports_signed_blocks_through_native_backend():
+    """End-to-end: a BeaconChain with the NATIVE verifier imports fully
+    signed blocks (proposal + randao + attestation signature sets all
+    through csrc/blsnative.cpp) and rejects a tampered one — the
+    production CPU path the auto backend selects on accelerator-less
+    hosts."""
+    from lighthouse_tpu.beacon.chain import BeaconChain, BlockError
+    from lighthouse_tpu.crypto.backend import SignatureVerifier
+    from lighthouse_tpu.testing.harness import Harness
+    from lighthouse_tpu.types import ChainSpec, MinimalPreset
+
+    spec = ChainSpec(preset=MinimalPreset)
+    h = Harness(8, spec)
+    chain = BeaconChain(h.state.copy(), spec,
+                        verifier=SignatureVerifier("native", fallback=False))
+    pending = []
+    for slot in range(1, 4):
+        block = h.produce_block(slot, attestations=pending)
+        h.process_block(block, strategy="no_verification")
+        chain.on_tick(slot)
+        root = chain.process_block(block)
+        from lighthouse_tpu.ssz import hash_tree_root
+
+        assert bytes(root) == bytes(hash_tree_root(block.message))
+        pending = h.attest_slot(h.state, slot, root)
+    assert int(chain.head_state.slot) == 3
+    # a forged proposer signature must be rejected by the same path
+    bad = h.produce_block(4, attestations=pending)
+    bad.signature = bytes([bad.signature[0] ^ 1]) + bytes(bad.signature[1:])
+    chain.on_tick(4)
+    with pytest.raises(BlockError):
+        chain.process_block(bad)
+
+
 def test_auto_backend_resolution_logic(monkeypatch):
     """"auto" picks the device only when the probe reports a healthy
     accelerator; a cpu-only or dead-device probe resolves to the native
