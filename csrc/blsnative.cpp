@@ -137,7 +137,62 @@ static void fp_mul(Fp& r, const Fp& a, const Fp& b) {
     std::memcpy(r.l, t, sizeof(r.l));
 }
 
-static inline void fp_sqr(Fp& r, const Fp& a) { fp_mul(r, a, a); }
+// Dedicated Montgomery squaring: the 36 schoolbook products collapse to
+// 15 off-diagonal (doubled) + 6 diagonal, then one 12-limb Montgomery
+// reduction — ~25% fewer wide multiplies than fp_mul(a, a).  Squarings
+// dominate the pairing (dbl_step / f12_sqr / every pow chain).
+static void fp_sqr(Fp& r, const Fp& a) {
+    u64 t[12] = {0};
+    // off-diagonal products a_i * a_j (i < j)
+    for (int i = 0; i < 6; i++) {
+        u128 c = 0;
+        for (int j = i + 1; j < 6; j++) {
+            c += (u128)t[i + j] + (u128)a.l[i] * a.l[j];
+            t[i + j] = (u64)c;
+            c >>= 64;
+        }
+        t[i + 6] = (u64)c;
+    }
+    // double, then add the diagonal a_i^2
+    u64 top = t[11] >> 63;
+    for (int i = 11; i > 0; i--) t[i] = (t[i] << 1) | (t[i - 1] >> 63);
+    t[0] <<= 1;
+    u128 c = 0;
+    for (int i = 0; i < 6; i++) {
+        u128 d = (u128)a.l[i] * a.l[i];
+        c += (u128)t[2 * i] + (u64)d;
+        t[2 * i] = (u64)c;
+        c >>= 64;
+        c += (u128)t[2 * i + 1] + (u64)(d >> 64);
+        t[2 * i + 1] = (u64)c;
+        c >>= 64;
+    }
+    top += (u64)c;  // p < 2^384 so the square < 2^762: top stays 0 here
+    // Montgomery reduction of the 12-limb value (independent loop, same
+    // invariants as fp_mul's interleaved reduction)
+    for (int i = 0; i < 6; i++) {
+        u64 m = t[i] * N0;
+        u128 cc = (u128)t[i] + (u128)m * P_LIMBS[0];
+        cc >>= 64;
+        for (int j = 1; j < 6; j++) {
+            cc += (u128)t[i + j] + (u128)m * P_LIMBS[j];
+            t[i + j] = (u64)cc;
+            cc >>= 64;
+        }
+        // propagate the carry into the upper limbs
+        for (int j = i + 6; cc && j < 12; j++) {
+            cc += t[j];
+            t[j] = (u64)cc;
+            cc >>= 64;
+        }
+        top += (u64)cc;
+    }
+    u64 out[7];
+    std::memcpy(out, t + 6, 6 * sizeof(u64));
+    out[6] = top;
+    if (out[6] || geq_p(out)) sub_p(out);
+    std::memcpy(r.l, out, sizeof(r.l));
+}
 
 static void fp_pow_limbs(Fp& r, const Fp& a, const u64* e, int nlimbs) {
     Fp base = a;
@@ -847,6 +902,161 @@ static void g2_to_affine(F2& ax, F2& ay, const G2& p) {
     f2_mul(ay, p.y, zi3);
 }
 
+// ------------------------------------------------- batch inversion (r5)
+//
+// Montgomery's trick: n inversions for ONE field inversion + 3n muls.
+// Zeros pass through as zero (inv0 semantics, matching fp_inv).  This is
+// what blst's batch paths lean on (pippenger/to_affine loops); here it
+// serves the cross-set affine conversions and the batch-affine pubkey
+// aggregation tree.
+
+static void fp_batch_inv(Fp* xs, int n) {
+    if (n <= 0) return;
+    std::vector<Fp> pre((size_t)n);
+    Fp acc;
+    fp_from_c(acc, R1_MONT);           // 1 (mont)
+    for (int i = 0; i < n; i++) {
+        pre[i] = acc;
+        if (!fp_is_zero(xs[i])) fp_mul(acc, acc, xs[i]);
+    }
+    Fp inv;
+    fp_inv(inv, acc);
+    for (int i = n - 1; i >= 0; i--) {
+        if (fp_is_zero(xs[i])) continue;
+        Fp xi;
+        fp_mul(xi, pre[i], inv);
+        fp_mul(inv, inv, xs[i]);
+        xs[i] = xi;
+    }
+}
+
+static void f2_batch_inv(F2* xs, int n) {
+    if (n <= 0) return;
+    std::vector<F2> pre((size_t)n);
+    F2 acc;
+    f2_one(acc);
+    for (int i = 0; i < n; i++) {
+        pre[i] = acc;
+        if (!f2_is_zero(xs[i])) f2_mul(acc, acc, xs[i]);
+    }
+    F2 inv;
+    f2_inv(inv, acc);
+    for (int i = n - 1; i >= 0; i--) {
+        if (f2_is_zero(xs[i])) continue;
+        F2 xi;
+        f2_mul(xi, pre[i], inv);
+        f2_mul(inv, inv, xs[i]);
+        xs[i] = xi;
+    }
+}
+
+// --------------------------------------- batch-affine G1 aggregation (r5)
+//
+// Per-set pubkey aggregation for MANY pubkeys (config 4: 512/set): a
+// pairwise tree of AFFINE additions where each level's slope denominators
+// are inverted together (one fp_inv per level instead of Jacobian Z
+// chains).  An affine add costs ~6 muls amortized vs ~16 for the Jacobian
+// mixed add.  All exceptional pairs (doubling, opposite, infinity) take a
+// uniform slope formulation so the level stays batchable:
+//     add:  lam = (y2-y1)/(x2-x1)          dbl: lam = 3x^2 / 2y
+// then x3 = lam^2 - x1 - x2, y3 = lam(x1-x3) - y1.
+
+struct AffG1 { Fp x, y; bool inf; };
+
+static void g1_aggregate_batch_affine(G1& out, AffG1* pts, int n) {
+    std::vector<Fp> den((size_t)(n / 2 + 1));
+    std::vector<Fp> num((size_t)(n / 2 + 1));
+    // pair kinds: 0 = normal add, 1 = dbl, 2 = result known (inf/copy)
+    std::vector<uint8_t> kind((size_t)(n / 2 + 1));
+    while (n > 1) {
+        int half = n / 2;
+        for (int i = 0; i < half; i++) {
+            const AffG1 &p = pts[2 * i], &q = pts[2 * i + 1];
+            if (p.inf || q.inf) { kind[i] = 2; den[i] = FP_ZERO; continue; }
+            if (!fp_eq_raw(p.x, q.x)) {
+                kind[i] = 0;
+                fp_sub(den[i], q.x, p.x);
+                fp_sub(num[i], q.y, p.y);
+            } else if (fp_eq_raw(p.y, q.y) && !fp_is_zero(p.y)) {
+                kind[i] = 1;
+                fp_add(den[i], p.y, p.y);          // 2y
+                Fp x2;
+                fp_sqr(x2, p.x);
+                fp_add(num[i], x2, x2);
+                fp_add(num[i], num[i], x2);        // 3x^2
+            } else {
+                kind[i] = 2;                       // P + (-P) = inf
+                den[i] = FP_ZERO;
+            }
+        }
+        // one inversion for the whole level (kind==2 slots were zeroed
+        // at classification so fp_batch_inv passes them through)
+        fp_batch_inv(den.data(), half);
+        for (int i = 0; i < half; i++) {
+            AffG1 &p = pts[2 * i];
+            const AffG1 &q = pts[2 * i + 1];
+            AffG1 r;
+            if (kind[i] == 2) {
+                if (p.inf && q.inf) r = p;
+                else if (p.inf) r = q;
+                else if (q.inf) r = p;
+                else { r.inf = true; r.x = FP_ZERO; r.y = FP_ZERO; }
+            } else {
+                Fp lam, l2;
+                fp_mul(lam, num[i], den[i]);
+                fp_sqr(l2, lam);
+                fp_sub(r.x, l2, p.x);
+                fp_sub(r.x, r.x, q.x);
+                Fp t;
+                fp_sub(t, p.x, r.x);
+                fp_mul(t, lam, t);
+                fp_sub(r.y, t, p.y);
+                r.inf = false;
+            }
+            pts[i] = r;
+        }
+        if (n & 1) { pts[half] = pts[n - 1]; n = half + 1; }
+        else n = half;
+    }
+    if (pts[0].inf) { out = {FP_ZERO, FP_ZERO, FP_ZERO}; return; }
+    out.x = pts[0].x;
+    out.y = pts[0].y;
+    fp_from_c(out.z, R1_MONT);
+}
+
+// ------------------------------------------------ G2 Pippenger MSM (r5)
+//
+// Windowed bucket MSM for sum_i [k_i] P_i with 64-bit scalars (the
+// blinded-signature accumulation — blst.rs:103-117's per-set [r]sig
+// role).  Window c=4: 16 windows x (n bucket adds + 30 reduction adds)
+// + 60 doublings, ~2.7x fewer point ops than n independent
+// double-and-add ladders at n >= 64.
+static void g2_msm_u64(G2& out, const G2* pts, const u64* ks, uint32_t n) {
+    constexpr int C = 4, NBUCKET = (1 << C) - 1, NWIN = 64 / C;
+    G2 acc = {F2_ZERO_, F2_ZERO_, F2_ZERO_};
+    G2 buckets[NBUCKET];
+    for (int w = NWIN - 1; w >= 0; w--) {
+        if (w != NWIN - 1)
+            for (int k = 0; k < C; k++) g2_dbl(acc, acc);
+        for (int b = 0; b < NBUCKET; b++)
+            buckets[b] = {F2_ZERO_, F2_ZERO_, F2_ZERO_};
+        bool any = false;
+        for (uint32_t i = 0; i < n; i++) {
+            int d = (int)((ks[i] >> (C * w)) & NBUCKET);
+            if (d) { g2_add(buckets[d - 1], buckets[d - 1], pts[i]); any = true; }
+        }
+        if (!any) continue;
+        G2 run = {F2_ZERO_, F2_ZERO_, F2_ZERO_};
+        G2 sum = {F2_ZERO_, F2_ZERO_, F2_ZERO_};
+        for (int b = NBUCKET - 1; b >= 0; b--) {
+            g2_add(run, run, buckets[b]);
+            g2_add(sum, sum, run);
+        }
+        g2_add(acc, acc, sum);
+    }
+    out = acc;
+}
+
 // psi endomorphism on JACOBIAN coords: conj all, scale x by cx, y by cy
 // (mirrors crypto/tpu/curve.py g2_psi)
 static void g2_psi(G2& r, const G2& p) {
@@ -1232,19 +1442,27 @@ static void horner(F2& r, const F2c* coeffs, int n, const F2& x) {
     }
 }
 
-// 3-isogeny E2' -> E2 (affine; ref/hash_to_curve.py iso_map)
-static void iso3_map(F2& X, F2& Y, const F2& x, const F2& y) {
-    F2 xn, xd, yn, yd, t;
+// 3-isogeny E2' -> E2 (ref/hash_to_curve.py iso_map), PROJECTIVE output:
+// affine (xn/xd, y*yn/yd) becomes Jacobian with Z = xd*yd —
+//   X = (xn/xd)*Z^2 = xn*xd*yd^2,  Y = (y*yn/yd)*Z^3 = y*yn*xd^3*yd^2
+// — ~8 f2 muls instead of two ~50us field inversions (the r5 native
+// hash-path optimization; outputs differentially tested vs the oracle).
+static void iso3_map_jac(G2& r, const F2& x, const F2& y) {
+    F2 xn, xd, yn, yd;
     horner(xn, ISO3_XNUM_M, 4, x);
     horner(xd, ISO3_XDEN_M, 3, x);
     horner(yn, ISO3_YNUM_M, 4, x);
     horner(yd, ISO3_YDEN_M, 4, x);
-    F2 xdi, ydi;
-    f2_inv(xdi, xd);
-    f2_inv(ydi, yd);
-    f2_mul(X, xn, xdi);
-    f2_mul(t, yn, ydi);
-    f2_mul(Y, y, t);
+    F2 yd2, xd2, xd3, t;
+    f2_sqr(yd2, yd);
+    f2_sqr(xd2, xd);
+    f2_mul(xd3, xd2, xd);
+    f2_mul(t, xn, xd);
+    f2_mul(r.x, t, yd2);               // xn*xd*yd^2
+    f2_mul(t, yn, xd3);
+    f2_mul(t, t, yd2);
+    f2_mul(r.y, y, t);                 // y*yn*xd^3*yd^2
+    f2_mul(r.z, xd, yd);
 }
 
 // full hash_to_g2 -> Jacobian point in the subgroup
@@ -1254,12 +1472,9 @@ static void hash_to_g2_native(G2& r, const uint8_t* msg, uint32_t msg_len,
     hash_to_field_2(u, msg, msg_len, dst, dst_len);
     G2 q[2];
     for (int i = 0; i < 2; i++) {
-        F2 sx, sy, ix, iy;
+        F2 sx, sy;
         sswu_map(sx, sy, u[i]);
-        iso3_map(ix, iy, sx, sy);
-        q[i].x = ix;
-        q[i].y = iy;
-        f2_one(q[i].z);
+        iso3_map_jac(q[i], sx, sy);
     }
     G2 s;
     g2_add(s, q[0], q[1]);
@@ -1310,79 +1525,140 @@ struct _BatchIn {
 static void _verify_range(const _BatchIn& in, uint32_t begin, uint32_t end,
                           F12* prod_out, G2* sacc_out, bool* reject_out,
                           bool* all_ok_out) {
+    // r5 phased layout: per BLOCK of sets, (1) checks + aggregation +
+    // hashing into Jacobian scratch, (2) ONE batched affine conversion
+    // (Montgomery trick) for every [r]agg / agg / H(m) in the block,
+    // (3) the Miller lanes; then ONE Pippenger MSM for the whole range's
+    // [r_i] sig_i accumulation.  Same math as the per-set loop it
+    // replaces (differentially tested), ~2.4x fewer field inversions
+    // and ~2.7x fewer point ops in the blinding accumulation.
     F12 acc;
     f12_one(acc);
-    G2 sig_acc = {F2_ZERO_, F2_ZERO_, F2_ZERO_};
     bool reject = false, all_ok = true;
-    for (uint32_t i = begin; i < end && !(reject && !in.per_set_out); i++) {
-        // structural / subgroup rejects: batch mode fails (oracle
-        // semantics); per-set mode records False and keeps judging the
-        // other sets (the poisoning-fallback contract)
-        G2 sig;
-        bool set_ok = !in.sig_inf[i]
-            && (in.pk_offsets[i + 1] - in.pk_offsets[i]) > 0
-            && load_g2_affine(sig, in.sig_blob + (size_t)i * 192)
-            && g2_in_subgroup_jac(sig);
-        if (!set_ok) {
-            reject = true;
-            all_ok = false;
-            if (in.per_set_out) in.per_set_out[i] = 0;
-            continue;
-        }
-        uint32_t npk = in.pk_offsets[i + 1] - in.pk_offsets[i];
+    constexpr uint32_t BLOCK = 256;
+    constexpr uint32_t BATCH_AFFINE_MIN_PKS = 32;
 
-        // aggregate the set's pubkeys
-        G1 agg = {FP_ZERO, FP_ZERO, FP_ZERO};
-        for (uint32_t k = 0; k < npk; k++) {
-            const uint8_t* pb =
-                in.pks_blob + ((size_t)in.pk_offsets[i] + k) * 96;
-            G1 pk;
-            fp_from_be(pk.x, pb);
-            fp_from_be(pk.y, pb + 48);
-            fp_from_c(pk.z, R1_MONT);
-            g1_add(agg, agg, pk);
-        }
+    std::vector<G2> msm_pts;           // valid sigs (affine, Z=1)
+    std::vector<u64> msm_ks;
+    msm_pts.reserve(end - begin);
+    msm_ks.reserve(end - begin);
 
-        G2 h;
-        hash_to_g2_native(h, in.msgs_blob + in.msg_offsets[i],
-                          in.msg_offsets[i + 1] - in.msg_offsets[i],
-                          in.dst, in.dst_len);
-        F2 hx, hy;
-        g2_to_affine(hx, hy, h);
+    std::vector<G1> aggr(BLOCK), aggu(BLOCK);
+    std::vector<G2> sigs(BLOCK), hs(BLOCK);
+    std::vector<uint32_t> idx(BLOCK);
+    std::vector<AffG1> affbuf;
 
-        // blinded lane: e([r] agg, H(m))
-        G1 agg_r;
-        g1_mul_u64(agg_r, agg, in.rands[i]);
-        if (!g1_is_inf(agg_r)) {
-            Fp ax, ay;
-            g1_to_affine(ax, ay, agg_r);
-            miller_into(acc, ax, ay, hx, hy);
-        }
-        // accumulate [r] sig
-        G2 sig_r;
-        g2_mul_u64(sig_r, sig, in.rands[i]);
-        g2_add(sig_acc, sig_acc, sig_r);
-
-        if (in.per_set_out) {
-            // unblinded per-set verdict: e(agg, H(m)) e(-g1, sig) == 1
-            F12 f;
-            f12_one(f);
-            bool ok = !g1_is_inf(agg);
-            if (ok) {
-                Fp ax, ay;
-                g1_to_affine(ax, ay, agg);
-                miller_into(f, ax, ay, hx, hy);
-                F2 sx, sy;
-                g2_to_affine(sx, sy, sig);
-                miller_into(f, in.g1x, in.ng1y, sx, sy);
-                F12 out;
-                final_exp(out, f);
-                ok = f12_is_one(out);
+    for (uint32_t b0 = begin; b0 < end && !(reject && !in.per_set_out);
+         b0 += BLOCK) {
+        uint32_t b1 = b0 + BLOCK < end ? b0 + BLOCK : end;
+        uint32_t nb = 0;
+        // ---- phase 1: structural/subgroup gates, aggregate, hash
+        for (uint32_t i = b0; i < b1 && !(reject && !in.per_set_out); i++) {
+            G2 sig;
+            bool set_ok = !in.sig_inf[i]
+                && (in.pk_offsets[i + 1] - in.pk_offsets[i]) > 0
+                && load_g2_affine(sig, in.sig_blob + (size_t)i * 192)
+                && g2_in_subgroup_jac(sig);
+            if (!set_ok) {
+                reject = true;
+                all_ok = false;
+                if (in.per_set_out) in.per_set_out[i] = 0;
+                continue;
             }
-            in.per_set_out[i] = ok ? 1 : 0;
-            if (!ok) all_ok = false;
+            uint32_t npk = in.pk_offsets[i + 1] - in.pk_offsets[i];
+            G1 agg = {FP_ZERO, FP_ZERO, FP_ZERO};
+            if (npk >= BATCH_AFFINE_MIN_PKS) {
+                affbuf.resize(npk);
+                for (uint32_t k = 0; k < npk; k++) {
+                    const uint8_t* pb =
+                        in.pks_blob + ((size_t)in.pk_offsets[i] + k) * 96;
+                    fp_from_be(affbuf[k].x, pb);
+                    fp_from_be(affbuf[k].y, pb + 48);
+                    affbuf[k].inf = false;
+                }
+                g1_aggregate_batch_affine(agg, affbuf.data(), (int)npk);
+            } else {
+                for (uint32_t k = 0; k < npk; k++) {
+                    const uint8_t* pb =
+                        in.pks_blob + ((size_t)in.pk_offsets[i] + k) * 96;
+                    G1 pk;
+                    fp_from_be(pk.x, pb);
+                    fp_from_be(pk.y, pb + 48);
+                    fp_from_c(pk.z, R1_MONT);
+                    g1_add(agg, agg, pk);
+                }
+            }
+            uint32_t j = nb++;
+            idx[j] = i;
+            sigs[j] = sig;
+            aggu[j] = agg;
+            hash_to_g2_native(hs[j], in.msgs_blob + in.msg_offsets[i],
+                              in.msg_offsets[i + 1] - in.msg_offsets[i],
+                              in.dst, in.dst_len);
+            g1_mul_u64(aggr[j], agg, in.rands[i]);
+            msm_pts.push_back(sig);
+            msm_ks.push_back(in.rands[i]);
+        }
+        if (!nb) continue;
+        // ---- phase 2: batched affine conversions for the block
+        // G1: [r]agg always; agg too in per-set mode (shared fp batch)
+        uint32_t ng1 = in.per_set_out ? nb * 2 : nb;
+        std::vector<Fp> z1(ng1);
+        for (uint32_t j = 0; j < nb; j++) {
+            z1[j] = aggr[j].z;
+            if (in.per_set_out) z1[nb + j] = aggu[j].z;
+        }
+        fp_batch_inv(z1.data(), (int)ng1);
+        auto g1_apply = [](G1& p, const Fp& zi) {
+            if (fp_is_zero(zi)) return;          // infinity stays marked
+            Fp zi2, zi3;
+            fp_sqr(zi2, zi);
+            fp_mul(zi3, zi2, zi);
+            fp_mul(p.x, p.x, zi2);
+            fp_mul(p.y, p.y, zi3);
+            // z left untouched as the inf marker (z==0 -> inf)
+        };
+        for (uint32_t j = 0; j < nb; j++) {
+            g1_apply(aggr[j], z1[j]);
+            if (in.per_set_out) g1_apply(aggu[j], z1[nb + j]);
+        }
+        std::vector<F2> z2(nb);
+        for (uint32_t j = 0; j < nb; j++) z2[j] = hs[j].z;
+        f2_batch_inv(z2.data(), (int)nb);
+        for (uint32_t j = 0; j < nb; j++) {
+            if (f2_is_zero(z2[j])) continue;
+            F2 zi2, zi3;
+            f2_sqr(zi2, z2[j]);
+            f2_mul(zi3, zi2, z2[j]);
+            f2_mul(hs[j].x, hs[j].x, zi2);
+            f2_mul(hs[j].y, hs[j].y, zi3);
+        }
+        // ---- phase 3: Miller lanes
+        for (uint32_t j = 0; j < nb; j++) {
+            if (!g1_is_inf(aggr[j]))
+                miller_into(acc, aggr[j].x, aggr[j].y, hs[j].x, hs[j].y);
+            if (in.per_set_out) {
+                uint32_t i = idx[j];
+                F12 f;
+                f12_one(f);
+                bool ok = !g1_is_inf(aggu[j]);
+                if (ok) {
+                    miller_into(f, aggu[j].x, aggu[j].y, hs[j].x, hs[j].y);
+                    // sig was loaded affine (Z == 1): coords direct
+                    miller_into(f, in.g1x, in.ng1y, sigs[j].x, sigs[j].y);
+                    F12 out;
+                    final_exp(out, f);
+                    ok = f12_is_one(out);
+                }
+                in.per_set_out[i] = ok ? 1 : 0;
+                if (!ok) all_ok = false;
+            }
         }
     }
+    // ---- phase 4: one windowed MSM for sum_i [r_i] sig_i
+    G2 sig_acc;
+    g2_msm_u64(sig_acc, msm_pts.data(), msm_ks.data(),
+               (uint32_t)msm_pts.size());
     *prod_out = acc;
     *sacc_out = sig_acc;
     *reject_out = reject;

@@ -69,7 +69,9 @@ bool replay(KvLog* h) {
     // partial record and the next replay's header parse would swallow or
     // misalign them (advisor r3 finding).
     if (last_good < n) {
-        std::fflush(h->f);
+        // fseek (not fflush) resyncs the stream: fflush on an update
+        // stream whose last op was input is UB per ISO C (advisor r4).
+        std::fseek(h->f, static_cast<long>(last_good), SEEK_SET);
         if (ftruncate(fileno(h->f), static_cast<off_t>(last_good)) != 0)
             return false;
     }

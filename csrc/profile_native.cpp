@@ -1,0 +1,117 @@
+// Component-level profiler for blsnative.cpp (one-TU include so the
+// statics are visible).  Build:
+//   g++ -O3 -std=c++17 -pthread csrc/profile_native.cpp -o /tmp/profnative
+// Prints per-component microseconds for the batch-verify inner loop.
+#include "blsnative.cpp"
+
+#include <chrono>
+#include <cstdio>
+#include <vector>
+
+using Clock = std::chrono::steady_clock;
+
+static double us_since(Clock::time_point t0) {
+    return std::chrono::duration<double, std::micro>(Clock::now() - t0)
+        .count();
+}
+
+int main(int argc, char** argv) {
+    int iters = argc > 1 ? atoi(argv[1]) : 200;
+
+    // a valid-ish G1 point: the generator
+    G1 g1;
+    fp_from_c(g1.x, G1X_MONT);
+    fp_from_c(g1.y, G1Y_MONT);
+    fp_from_c(g1.z, R1_MONT);
+    // a G2 point: clear cofactor of a mapped point to land in the group
+    G2 g2;
+    {
+        uint8_t msg[32] = {1};
+        uint8_t dst[] = "PROF-DST";
+        hash_to_g2_native(g2, msg, 32, dst, 8);
+    }
+
+    // --- g1_add chain (pubkey aggregation cost, Jacobian)
+    {
+        G1 acc = g1;
+        auto t0 = Clock::now();
+        for (int i = 0; i < iters * 64; i++) g1_add(acc, acc, g1);
+        printf("g1_add              %8.3f us\n", us_since(t0) / (iters * 64));
+    }
+    // --- g1_mul_u64
+    {
+        G1 out;
+        auto t0 = Clock::now();
+        for (int i = 0; i < iters; i++)
+            g1_mul_u64(out, g1, 0x9e3779b97f4a7c15ull + i);
+        printf("g1_mul_u64          %8.3f us\n", us_since(t0) / iters);
+    }
+    // --- g2_add / g2_mul_u64
+    {
+        G2 acc = g2;
+        auto t0 = Clock::now();
+        for (int i = 0; i < iters * 16; i++) g2_add(acc, acc, g2);
+        printf("g2_add              %8.3f us\n", us_since(t0) / (iters * 16));
+    }
+    {
+        G2 out;
+        auto t0 = Clock::now();
+        for (int i = 0; i < iters; i++)
+            g2_mul_u64(out, g2, 0x9e3779b97f4a7c15ull + i);
+        printf("g2_mul_u64          %8.3f us\n", us_since(t0) / iters);
+    }
+    // --- g2 subgroup check
+    {
+        auto t0 = Clock::now();
+        volatile bool ok = true;
+        for (int i = 0; i < iters; i++) ok &= g2_in_subgroup_jac(g2);
+        printf("g2_in_subgroup      %8.3f us (ok=%d)\n", us_since(t0) / iters,
+               (int)ok);
+    }
+    // --- hash_to_g2
+    {
+        uint8_t msg[32] = {2};
+        uint8_t dst[] = "PROF-DST";
+        G2 h;
+        auto t0 = Clock::now();
+        for (int i = 0; i < iters; i++) {
+            msg[0] = (uint8_t)i;
+            hash_to_g2_native(h, msg, 32, dst, 8);
+        }
+        printf("hash_to_g2          %8.3f us\n", us_since(t0) / iters);
+    }
+    // --- miller lane
+    {
+        Fp ax, ay;
+        g1_to_affine(ax, ay, g1);
+        F2 qx, qy;
+        g2_to_affine(qx, qy, g2);
+        F12 acc;
+        f12_one(acc);
+        auto t0 = Clock::now();
+        for (int i = 0; i < iters; i++) miller_into(acc, ax, ay, qx, qy);
+        printf("miller_into         %8.3f us\n", us_since(t0) / iters);
+    }
+    // --- final exp
+    {
+        Fp ax, ay;
+        g1_to_affine(ax, ay, g1);
+        F2 qx, qy;
+        g2_to_affine(qx, qy, g2);
+        F12 f, out;
+        f12_one(f);
+        miller_into(f, ax, ay, qx, qy);
+        auto t0 = Clock::now();
+        int fiters = iters / 4 + 1;
+        for (int i = 0; i < fiters; i++) final_exp(out, f);
+        printf("final_exp           %8.3f us\n", us_since(t0) / fiters);
+    }
+    // --- fp mul baseline
+    {
+        Fp a = g1.x, b = g1.y, c;
+        auto t0 = Clock::now();
+        for (int i = 0; i < iters * 4096; i++) fp_mul(c, a, b);
+        printf("fp_mul              %8.4f us\n", us_since(t0) / (iters * 4096.0));
+    }
+    return 0;
+}

@@ -57,8 +57,14 @@ def _load():
     )
     path = _build() if stale else _SO
     if path is None:
-        path = _SO if os.path.exists(_SO) else None
-    if path is None:
+        # A stale .so after a FAILED rebuild would silently mask a
+        # source-level crypto fix behind a broken toolchain (advisor r4):
+        # refuse to load it so the seam degrades to the oracle, loudly.
+        if os.path.exists(_SO):
+            import logging
+            logging.getLogger("lighthouse_tpu.crypto").warning(
+                "blsnative rebuild FAILED with stale %s present; refusing "
+                "stale binary — falling back to oracle", _SO)
         return None
     try:
         lib = ctypes.CDLL(path)

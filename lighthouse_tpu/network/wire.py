@@ -897,8 +897,9 @@ class WireNode:
         with self._lock:
             self._req_id += 1
             rid = self._req_id
-            # [event, chunks, code, peer, per-seq chunk accumulator]
-            rec = [threading.Event(), None, None, peer, {}]
+            # [event, chunks, code, peer, per-seq chunk accumulator,
+            #  pinned (code, total) from the stream's first frame]
+            rec = [threading.Event(), None, None, peer, {}, None]
             self._pending[rid] = rec
         try:
             peer.send_frame(
@@ -1012,6 +1013,17 @@ class WireNode:
         # guessing the (sequential) rid must not complete or poison it
         if rec is None or rec[3] is not peer:
             return
+        # pin (code, total) from the FIRST frame of the stream: a
+        # responder shrinking n or flipping code mid-stream could
+        # otherwise complete the request with fewer chunks than first
+        # advertised (advisor r4) — treat a mismatch like the seq bound,
+        # a protocol fault that drops the peer
+        if rec[5] is None:
+            rec[5] = (code, n)
+        elif rec[5] != (code, n):
+            raise WireError(
+                f"response stream header changed mid-stream: "
+                f"{rec[5]} -> {(code, n)}")
         self._resp_frames += 1
         acc = rec[4]
         if n:

@@ -419,3 +419,87 @@ def test_light_client_period_boundary_committee_choice():
     # two periods ahead: unknown
     with pytest.raises(LightClientError):
         lc._committee_for(2 * period_slots)
+
+
+# ---------------------------------------- r4: stream header pinning
+
+
+def test_response_stream_header_pinned():
+    """A responder may not shrink the advertised chunk total (or flip the
+    code) mid-stream to complete a request with fewer chunks than first
+    promised (advisor r4): the second, mismatching header is a WireError."""
+    import struct as _struct
+    import threading
+
+    from lighthouse_tpu.network import snappy as _snappy
+    from lighthouse_tpu.network.wire import WireError, WireNode
+
+    peer = object()
+    rec = [threading.Event(), None, None, peer, {}, None]
+    node = SimpleNamespace(
+        _lock=threading.Lock(), _pending={7: rec}, _resp_frames=0)
+
+    def frame(code, seq, n, payload=b"x"):
+        return _struct.pack("<IBII", 7, code, seq, n) + _snappy.compress(payload)
+
+    WireNode._on_response(node, peer, frame(0, 0, 3))
+    assert rec[5] == (0, 3) and not rec[0].is_set()
+    # shrinking n mid-stream is a protocol fault, not an early completion
+    with pytest.raises(WireError):
+        WireNode._on_response(node, peer, frame(0, 1, 2))
+    assert not rec[0].is_set()
+    # flipping the response code mid-stream is equally rejected
+    with pytest.raises(WireError):
+        WireNode._on_response(node, peer, frame(1, 1, 3))
+    # the honest continuation still completes
+    WireNode._on_response(node, peer, frame(0, 1, 3))
+    WireNode._on_response(node, peer, frame(0, 2, 3))
+    assert rec[0].is_set() and len(rec[1]) == 3
+
+
+# ------------------------------------- r4: stale native .so refusal
+
+
+def test_stale_native_so_refused_after_failed_rebuild(monkeypatch, tmp_path):
+    """A source-newer-than-.so state with a FAILING rebuild must refuse the
+    stale binary (degrade to oracle) instead of silently masking the source
+    fix behind a broken toolchain (advisor r4)."""
+    from lighthouse_tpu.crypto import native_bls as nb
+
+    so = tmp_path / "libblsnative.so"
+    so.write_bytes(b"stale")
+    src = tmp_path / "blsnative.cpp"
+    src.write_text("// newer")
+    import os as _os
+
+    _os.utime(so, (1, 1))          # .so older than source -> stale
+    monkeypatch.setattr(nb, "_SO", str(so))
+    monkeypatch.setattr(nb, "_SRC", str(src))
+    monkeypatch.setattr(nb, "_DEPS", (str(src),))
+    monkeypatch.setattr(nb, "_build", lambda: None)   # rebuild FAILS
+    assert nb._load() is None      # stale binary refused
+
+
+# ----------------------------- r4: per-set infinity split (tpu path)
+
+
+def test_tpu_per_set_infinity_splits_not_poisons(monkeypatch):
+    """An infinity-pubkey set fails INDIVIDUALLY on the device per-set
+    path; sibling sets in the chunk still verify (advisor r4: the whole
+    chunk used to come back [False]*n, diverging from native/oracle)."""
+    from lighthouse_tpu.crypto.ref.bls import SignatureSet
+    from lighthouse_tpu.crypto.tpu import bls as tb
+
+    calls = []
+
+    def fake_chunk(sets, dst, min_sets=1, min_pks=1):
+        calls.append(len(sets))
+        return [True] * len(sets)
+
+    monkeypatch.setattr(tb, "_per_set_chunk", fake_chunk)
+    good = SignatureSet(object(), [object()], b"\x00" * 32)
+    bad_inf = SignatureSet(object(), [None], b"\x00" * 32)      # infinity pk
+    bad_nosig = SignatureSet(None, [object()], b"\x00" * 32)
+    out = tb.verify_signature_sets_per_set([good, bad_inf, good, bad_nosig])
+    assert out == [True, False, True, False]
+    assert sum(calls) == 2        # only the two good sets hit the device

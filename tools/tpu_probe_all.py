@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Staged TPU measurement driver (judge r4 item 1a).
+
+Runs each tools/tpu_stage_bench.py stage in its own subprocess with a
+hard timeout (the tunnel's observed failure mode is an indefinite hang)
+and APPENDS every result — including timeouts and crashes — to
+TPU_MEASUREMENTS.jsonl as it goes, so a mid-run tunnel death still
+leaves a usable artifact.
+
+Usage: python tools/tpu_probe_all.py [plan]
+Plans: quick (sub-kernels + small verify), full (default), kernels-only.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+OUT = os.path.join(REPO, "TPU_MEASUREMENTS.jsonl")
+STAGE = os.path.join(HERE, "tpu_stage_bench.py")
+
+# (stage, args, timeout_s)
+PLAN_FULL = [
+    ("sanity", [], 120),
+    ("mont_mul", ["65536"], 420),
+    ("mont_mul", ["1048576"], 300),
+    ("fp_inv", ["4096"], 420),
+    ("mul_u64", ["32"], 600),
+    ("g2_subgroup", ["32"], 600),
+    ("tree_sum", ["32", "64"], 600),
+    ("hash_to_g2", ["32"], 900),
+    ("miller", ["33"], 600),
+    ("final_exp", ["1"], 700),
+    ("verify", ["2", "1"], 1200),
+    ("verify", ["32", "1"], 1500),
+    ("per_set", ["32", "1"], 1500),
+    ("validate_pk", ["512"], 600),
+    ("verify", ["128", "1"], 1800),
+    ("verify", ["32", "64"], 1800),
+    ("verify", ["256", "1"], 2400),
+]
+
+PLAN_QUICK = PLAN_FULL[:11]
+
+
+def run_stage(stage, args, timeout):
+    t0 = time.time()
+    try:
+        out = subprocess.run(
+            [sys.executable, STAGE, stage] + args,
+            capture_output=True, text=True, timeout=timeout, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return {"stage": stage, "args": args, "error": "timeout",
+                "timeout_s": timeout}
+    except Exception as e:
+        return {"stage": stage, "args": args, "error": repr(e)}
+    wall = time.time() - t0
+    if out.returncode != 0:
+        return {"stage": stage, "args": args, "error": f"rc={out.returncode}",
+                "stderr_tail": (out.stderr or "")[-400:], "wall_s": round(wall, 1)}
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+            rec["args"] = args
+            rec["wall_s"] = round(wall, 1)
+            return rec
+        except json.JSONDecodeError:
+            continue
+    return {"stage": stage, "args": args, "error": "no json output",
+            "stdout_tail": (out.stdout or "")[-200:]}
+
+
+def main():
+    plan_name = sys.argv[1] if len(sys.argv) > 1 else "full"
+    plan = {"quick": PLAN_QUICK, "full": PLAN_FULL}[plan_name]
+    for stage, args, timeout in plan:
+        print(f"== {stage} {args} (timeout {timeout}s)", file=sys.stderr,
+              flush=True)
+        rec = run_stage(stage, args, timeout)
+        rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+        with open(OUT, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(json.dumps(rec), flush=True)
+        if stage == "sanity" and "error" in rec:
+            print("sanity failed — aborting plan", file=sys.stderr)
+            break
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,268 @@
+#!/usr/bin/env python
+"""One TPU measurement stage, run in its own process (the axon tunnel's
+failure mode is a HANG, so the driver gives each stage a hard timeout).
+
+Usage: python tools/tpu_stage_bench.py STAGE [ARGS...]
+Prints ONE JSON line on stdout with the measurement.
+
+Stages (args in brackets):
+  sanity                      tiny eager + jit
+  mont_mul   [batch]          Fp Montgomery mul throughput
+  mont_mul_pallas [batch]     pallas candidate (if it compiles on tpu)
+  fp_inv     [batch]          Fp inversion (pow-scan) throughput
+  tree_sum   [sets pks]       G1 pubkey tree aggregation
+  mul_u64    [batch]          G2 64-bit blinding ladder
+  g2_subgroup [batch]         G2 subgroup check
+  hash_to_g2 [batch]          batched SWU+isogeny+cofactor (device part)
+  miller     [lanes]          multi-Miller loop alone
+  final_exp  [batch]          final exponentiation alone
+  verify     [sets pks]       FULL batched_verify kernel, real signatures
+  per_set    [sets pks]       per-set-verdict kernel, real signatures
+  validate_pk [batch]         pubkey-cache import gate kernel
+
+Every stage reports: platform, compile_s (first call), run_s (steady
+state, median of iters), throughput in stage-appropriate units.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("LTPU_BLS_BACKEND", "oracle")  # host sets via oracle
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/lighthouse_tpu_xla_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lighthouse_tpu.crypto.constants import P, DST_POP  # noqa: E402
+from lighthouse_tpu.crypto.ref import bls as RB  # noqa: E402
+from lighthouse_tpu.crypto.tpu import fp  # noqa: E402
+from lighthouse_tpu.crypto.tpu import tower as tw  # noqa: E402
+from lighthouse_tpu.crypto.tpu import curve as cv  # noqa: E402
+from lighthouse_tpu.crypto.tpu import pairing as pr  # noqa: E402
+from lighthouse_tpu.crypto.tpu import hash_to_curve as h2c  # noqa: E402
+from lighthouse_tpu.crypto.tpu import bls as tb  # noqa: E402
+
+
+def _rand_fp(shape, seed=0):
+    """(49, *shape) random residues in Montgomery form."""
+    rng = np.random.default_rng(seed)
+    n = int(np.prod(shape)) if shape else 1
+    vals = [int(rng.integers(0, 2**63)) * int(rng.integers(0, 2**63)) % P
+            for _ in range(n)]
+    arr = fp.ints_to_array(vals).reshape((fp.NLIMB,) + tuple(shape))
+    return fp.to_mont_jit(jnp.asarray(arr))
+
+
+def _time_fn(fn, args, iters=None, min_time=2.0, max_iters=200):
+    """Returns (compile_s, per_call_s).  First call = compile+run; then
+    steady-state until min_time seconds or max_iters calls."""
+    t0 = time.time()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    compile_s = time.time() - t0
+    # warm single call to estimate cost
+    t0 = time.time()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    one = time.time() - t0
+    if iters is None:
+        iters = max(1, min(max_iters, int(min_time / max(one, 1e-6))))
+    t0 = time.time()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    per_call = (time.time() - t0) / iters
+    return compile_s, per_call, iters
+
+
+def _emit(stage, compile_s, per_call_s, iters, **extra):
+    rec = {
+        "stage": stage,
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+        "compile_s": round(compile_s, 3),
+        "per_call_s": round(per_call_s, 6),
+        "iters": iters,
+        **extra,
+    }
+    print(json.dumps(rec), flush=True)
+
+
+def stage_sanity(_args):
+    t0 = time.time()
+    x = jnp.ones((256, 256))
+    f = jax.jit(lambda a: (a @ a).sum())
+    v = float(f(x))
+    _emit("sanity", time.time() - t0, 0.0, 1, value=v)
+
+
+def stage_mont_mul(args):
+    n = int(args[0]) if args else 65536
+    a = _rand_fp((n,), 1)
+    b = _rand_fp((n,), 2)
+    f = jax.jit(fp.mont_mul)
+    c, p, it = _time_fn(f, (a, b))
+    _emit("mont_mul", c, p, it, batch=n, mults_per_s=round(n / p, 1))
+
+
+def stage_fp_inv(args):
+    n = int(args[0]) if args else 4096
+    a = _rand_fp((n,), 3)
+    f = jax.jit(fp.inv)
+    c, p, it = _time_fn(f, (a,))
+    _emit("fp_inv", c, p, it, batch=n, invs_per_s=round(n / p, 1))
+
+
+def _real_prep(n_sets, pks_per_set):
+    import random
+    rng = random.Random(7)
+    sks = [rng.randrange(1, 2**250) for _ in range(pks_per_set)]
+    pks = [RB.sk_to_pk(sk) for sk in sks]
+    sets = []
+    for i in range(n_sets):
+        msg = i.to_bytes(32, "big")
+        sig = RB.aggregate([RB.sign(sk, msg) for sk in sks])
+        sets.append(RB.SignatureSet(sig, pks, msg))
+    prep = tb._prepare(sets, DST_POP)
+    assert prep is not None
+    return prep
+
+
+def stage_tree_sum(args):
+    n_sets = int(args[0]) if args else 32
+    pks = int(args[1]) if len(args) > 1 else 64
+    _, n_pad, pk, sig, u0, u1 = _real_prep(min(n_sets, 4), pks)
+    # broadcast the small real batch up to n_sets lanes
+    reps = max(1, n_sets // pk[0].shape[1])
+    pk = jax.tree_util.tree_map(
+        lambda x: jnp.tile(x, (1, reps) + (1,) * (x.ndim - 2)), pk)
+    f = jax.jit(lambda q: cv.point_tree_sum(cv.FP_OPS, q, axis=-1))
+    c, p, it = _time_fn(f, (pk,))
+    n_eff = pk[0].shape[1]
+    _emit("tree_sum", c, p, it, sets=n_eff, pks=pks,
+          pk_adds_per_s=round(n_eff * max(pks - 1, 1) / p, 1))
+
+
+def stage_mul_u64(args):
+    n = int(args[0]) if args else 32
+    _, n_pad, pk, sig, u0, u1 = _real_prep(min(n, 4), 1)
+    reps = max(1, n // sig[0][0].shape[1])
+    sig = jax.tree_util.tree_map(
+        lambda x: jnp.tile(x, (1, reps)), sig)
+    rands = tb._rand_scalars(sig[0][0].shape[1])
+    f = jax.jit(lambda s, r: cv.mul_u64(cv.F2_OPS, s, r))
+    c, p, it = _time_fn(f, (sig, rands))
+    _emit("mul_u64", c, p, it, batch=sig[0][0].shape[1],
+          ladders_per_s=round(sig[0][0].shape[1] / p, 1))
+
+
+def stage_g2_subgroup(args):
+    n = int(args[0]) if args else 32
+    _, n_pad, pk, sig, u0, u1 = _real_prep(min(n, 4), 1)
+    reps = max(1, n // sig[0][0].shape[1])
+    sig = jax.tree_util.tree_map(lambda x: jnp.tile(x, (1, reps)), sig)
+    f = jax.jit(cv.g2_in_subgroup)
+    c, p, it = _time_fn(f, (sig,))
+    _emit("g2_subgroup", c, p, it, batch=sig[0][0].shape[1],
+          checks_per_s=round(sig[0][0].shape[1] / p, 1))
+
+
+def stage_hash_to_g2(args):
+    n = int(args[0]) if args else 32
+    msgs = [i.to_bytes(32, "big") for i in range(n)]
+    u0, u1 = h2c.hash_to_field_host(msgs, DST_POP)
+    f = jax.jit(h2c.hash_to_g2_device)
+    c, p, it = _time_fn(f, (u0, u1))
+    _emit("hash_to_g2", c, p, it, batch=n,
+          hashes_per_s=round(n / p, 1))
+
+
+def stage_miller(args):
+    lanes = int(args[0]) if args else 33
+    px = _rand_fp((lanes,), 11)
+    py = _rand_fp((lanes,), 12)
+    qx = (_rand_fp((lanes,), 13), _rand_fp((lanes,), 14))
+    qy = (_rand_fp((lanes,), 15), _rand_fp((lanes,), 16))
+    mask = jnp.ones((lanes,), bool)
+    f = jax.jit(lambda a, b, c_, d, m: pr.miller_loop((a, b), (c_, d), m))
+    c, p, it = _time_fn(f, (px, py, qx, qy, mask))
+    _emit("miller", c, p, it, lanes=lanes,
+          pairs_per_s=round(lanes / p, 1))
+
+
+def stage_final_exp(args):
+    n = int(args[0]) if args else 1
+    coeffs = [( _rand_fp((n,), 20 + 2 * i), _rand_fp((n,), 21 + 2 * i))
+              for i in range(6)]
+    f12 = tw.f12_from_coeffs(coeffs)
+    flat, treedef = jax.tree_util.tree_flatten(f12)
+    f = jax.jit(lambda *xs: pr.final_exponentiation(
+        jax.tree_util.tree_unflatten(treedef, xs)))
+    c, p, it = _time_fn(f, tuple(flat))
+    _emit("final_exp", c, p, it, batch=n, fexp_per_s=round(n / p, 1))
+
+
+def stage_verify(args):
+    n_sets = int(args[0]) if args else 32
+    pks = int(args[1]) if len(args) > 1 else 1
+    t0 = time.time()
+    sets, n_pad, pk, sig, u0, u1 = _real_prep(n_sets, pks)
+    prep_s = time.time() - t0
+    rands = tb._rand_scalars(n_pad)
+    c, p, it = _time_fn(tb._jit_batched, (pk, sig, u0, u1, rands),
+                        min_time=4.0)
+    ok = bool(tb._jit_batched(pk, sig, u0, u1, rands))
+    _emit("verify", c, p, it, sets=n_pad, pks=pks, ok=ok,
+          prep_s=round(prep_s, 2),
+          sets_per_s=round(n_pad / p, 2))
+
+
+def stage_per_set(args):
+    n_sets = int(args[0]) if args else 32
+    pks = int(args[1]) if len(args) > 1 else 1
+    sets, n_pad, pk, sig, u0, u1 = _real_prep(n_sets, pks)
+    real = jnp.arange(n_pad) < len(sets)
+    c, p, it = _time_fn(tb._jit_per_set, (pk, sig, u0, u1, real),
+                        min_time=4.0)
+    all_ok, verdicts = tb._jit_per_set(pk, sig, u0, u1, real)
+    _emit("per_set", c, p, it, sets=n_pad, pks=pks,
+          ok=bool(all_ok), sets_per_s=round(n_pad / p, 2))
+
+
+def stage_validate_pk(args):
+    n = int(args[0]) if args else 512
+    _, n_pad, pk, sig, u0, u1 = _real_prep(2, 2)
+    flatpk = jax.tree_util.tree_map(lambda x: x.reshape(fp.NLIMB, -1), pk)
+    reps = max(1, n // flatpk[0].shape[1])
+    flatpk = jax.tree_util.tree_map(lambda x: jnp.tile(x, (1, reps)), flatpk)
+    c, p, it = _time_fn(tb._jit_validate_pk, (flatpk,))
+    _emit("validate_pk", c, p, it, batch=flatpk[0].shape[1],
+          keys_per_s=round(flatpk[0].shape[1] / p, 1))
+
+
+STAGES = {
+    "sanity": stage_sanity,
+    "mont_mul": stage_mont_mul,
+    "fp_inv": stage_fp_inv,
+    "tree_sum": stage_tree_sum,
+    "mul_u64": stage_mul_u64,
+    "g2_subgroup": stage_g2_subgroup,
+    "hash_to_g2": stage_hash_to_g2,
+    "miller": stage_miller,
+    "final_exp": stage_final_exp,
+    "verify": stage_verify,
+    "per_set": stage_per_set,
+    "validate_pk": stage_validate_pk,
+}
+
+
+if __name__ == "__main__":
+    stage = sys.argv[1]
+    STAGES[stage](sys.argv[2:])
