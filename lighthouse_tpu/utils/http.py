@@ -20,4 +20,7 @@ class JsonHandler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def _err(self, code, message):
-        self._json({"code": code, "message": message}, code)
+        # spec-shaped error body (Beacon API ErrorMessage: code, message,
+        # stacktraces — http_api/src/lib.rs warp rejection mapping)
+        self._json({"code": code, "message": message, "stacktraces": []},
+                   code)

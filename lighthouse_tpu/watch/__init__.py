@@ -2,6 +2,7 @@
 monitoring process polling a beacon node and recording per-slot/per-epoch
 analytics into sqlite (the reference uses postgres/diesel)."""
 
+from .server import WatchServer
 from .updater import WatchDB, WatchUpdater
 
-__all__ = ["WatchDB", "WatchUpdater"]
+__all__ = ["WatchDB", "WatchServer", "WatchUpdater"]

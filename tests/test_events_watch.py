@@ -53,3 +53,52 @@ def test_watch_updater_records_canonical_slots():
     rows = updater.db.slots()
     assert [r[0] for r in rows] == [1, 2, 3, 4]
     assert all(r[2] is not None for r in rows)
+
+
+# ----------------------- r5: watch persistence + own HTTP server (item 10)
+
+
+def test_watch_survives_restart_and_serves_http(tmp_path):
+    """File-backed WatchDB reopened after a 'restart' still serves every
+    recorded row through the watch server's own HTTP API (the reference's
+    updater/server split over a persistent DB — watch/src/server)."""
+    import json
+    import urllib.request
+
+    from lighthouse_tpu.watch import WatchDB, WatchServer
+
+    path = str(tmp_path / "watch.sqlite")
+    db = WatchDB(path)
+    db.record_slot(5, b"\x0a" * 32, 3, 12)
+    db.record_slot(6, b"\x0b" * 32, 1, 7)
+    db.record_finality(1, b"\x0c" * 32)
+    db.record_packing(6, 10, 8, 7)
+    db.record_suboptimal(5, 7, 2, True, 4)
+    db.record_analysis_gap(4)
+    db.close()
+
+    db2 = WatchDB(path)                   # fresh process, same file
+    srv = WatchServer(db2).start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def get(p):
+        with urllib.request.urlopen(base + p, timeout=5) as r:
+            return json.loads(r.read())["data"]
+
+    try:
+        assert get("/v1/slots/highest")["slot"] == 6
+        slots = get("/v1/slots")
+        assert [s["slot"] for s in slots] == [5, 6]
+        assert slots[0]["root"] == "0x" + "0a" * 32
+        only6 = get("/v1/slots?start=6")
+        assert [s["slot"] for s in only6] == [6]
+        fin = get("/v1/finality")
+        assert fin == [{"epoch": 1, "finalized_root": "0x" + "0c" * 32}]
+        pack = get("/v1/block_packing")
+        assert pack[0]["included_attesters"] == 10
+        sub = get("/v1/suboptimal_attestations")
+        assert sub[0]["wrong_head"] is True and sub[0]["delay"] == 2
+        assert get("/v1/gaps") == [4]
+    finally:
+        srv.stop()
+        db2.close()

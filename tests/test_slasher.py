@@ -99,7 +99,99 @@ def test_pruning_drops_old_history():
     s = Slasher()
     s.accept_attestation(_att([1], 1, 2))
     s.process_queued()
-    assert (1, 2) in s.attestations
-    s.process_queued(current_epoch=s.config.history_length + 10)
-    assert (1, 2) not in s.attestations
-    assert 1 not in s.spans
+    assert s.kv.get(b"att/2/1") is not None
+    # horizon must clear a WHOLE epoch-chunk for the arrays to drop it
+    from lighthouse_tpu.slasher.array import CHUNK_EPOCHS
+
+    s.process_queued(current_epoch=s.config.history_length + CHUNK_EPOCHS + 1)
+    assert s.kv.get(b"att/2/1") is None
+    assert not s.kv.keys_with_prefix(b"mm/")   # arrays pruned too
+
+
+# ------------------------------- r5: chunked arrays + persistence + scale
+
+
+def test_chunked_arrays_match_bruteforce():
+    """Differential: the chunked min-max verdicts equal a brute-force
+    span scan across randomized vote histories (array.rs semantics)."""
+    import random
+
+    from lighthouse_tpu.beacon.store import MemoryKV
+    from lighthouse_tpu.slasher.array import ChunkedArrays
+
+    rng = random.Random(5)
+    for trial in range(30):
+        arrays = ChunkedArrays(MemoryKV(), history_length=256)
+        seen = []                       # (source, target) accepted votes
+        for _ in range(40):
+            s = rng.randrange(0, 120)
+            t = s + 1 + rng.randrange(0, 40)
+            want = None
+            for s2, t2 in seen:
+                if s < s2 and t2 < t:
+                    want = "new_surrounds_old"
+                    break
+                if s2 < s and t < t2:
+                    want = "old_surrounds_new"
+                    break
+            got = arrays.check(7, s, t)
+            assert (got[0] if got else None) == want, (trial, s, t, seen)
+            if got is None:
+                arrays.update(7, s, t)
+                seen.append((s, t))
+
+
+def test_slasher_state_survives_restart(tmp_path):
+    """Surround evidence recorded before a restart still produces a
+    slashing after: arrays, evidence bodies, and the prune cursor are all
+    in the KV (the r4 verdict gap; ref slasher/src/migrate.rs role)."""
+    from lighthouse_tpu.beacon.store import PyFileKV
+    from lighthouse_tpu.slasher.slasher import ssz_codec
+
+    path = str(tmp_path / "slasher.kv")
+    kv = PyFileKV(path)
+    s = Slasher(kv=kv, types=T)
+    s.accept_attestation(_att([3], 4, 9))
+    assert s.process_queued(current_epoch=10) == []
+    s.flush()
+    kv.flush()
+    kv.close()
+
+    kv2 = PyFileKV(path)
+    s2 = Slasher(kv=kv2, types=T)          # fresh process, same datadir
+    s2.accept_attestation(_att([3], 5, 7))  # surrounded by pre-restart vote
+    found = s2.process_queued(current_epoch=10)
+    assert len(found) == 1 and found[0][0] == "attester"
+    slashing = found[0][1]
+    # attestation_1 surrounds attestation_2 (pre-restart evidence intact)
+    assert int(slashing.attestation_1.data.source.epoch) == 4
+    assert int(slashing.attestation_1.data.target.epoch) == 9
+    assert int(slashing.attestation_2.data.source.epoch) == 5
+    kv2.close()
+
+
+def test_slasher_scale_bounded_memory():
+    """>=100k validators x 2 epochs of attestations with a bounded chunk
+    cache (the r4 'won't scale past toy validator counts' item).  One
+    aggregate covers a 2048-strong committee, 64 committees per epoch ->
+    131k validators; the LRU must stay at its cap and a surround by any
+    of them must still be caught."""
+    from lighthouse_tpu.slasher.array import VALIDATOR_CHUNK
+
+    s = Slasher(config=None, kv=None)
+    s.config.cache_chunks = 64            # tiny resident bound
+    s.arrays.cache_chunks = 64
+    n_validators = 131072
+    committee = 2048
+    for epoch in (1, 2):
+        for c in range(n_validators // committee):
+            lo = c * committee
+            s.accept_attestation(
+                _att(range(lo, lo + committee), epoch, epoch + 1))
+        found = s.process_queued(current_epoch=epoch + 2)
+        assert found == []
+    assert len(s.arrays._cache) <= 64     # LRU held its bound
+    # validator 100_000 now equivocates with a surrounding vote
+    s.accept_attestation(_att([100_000], 0, 5, root=b"\x02" * 32))
+    found = s.process_queued(current_epoch=5)
+    assert len(found) == 1 and found[0][0] == "attester"

@@ -35,7 +35,11 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
-jax.config.update("jax_compilation_cache_dir", "/tmp/lighthouse_tpu_xla_cache")
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get("LTPU_XLA_CACHE",
+                   os.path.join(os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))), ".xla_cache")))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -50,10 +54,17 @@ from lighthouse_tpu.crypto.tpu import hash_to_curve as h2c  # noqa: E402
 from lighthouse_tpu.crypto.tpu import bls as tb  # noqa: E402
 
 
-def _rand_fp(shape, seed=0):
-    """(49, *shape) random residues in Montgomery form."""
+def _rand_fp(shape, seed=0, fast=False):
+    """(49, *shape) random residues in Montgomery form.  fast=True uses
+    raw random limbs (valid lazy-representation magnitudes, not canonical
+    residues) — right for pure-throughput stages where host bigint prep
+    at 1M lanes would otherwise dominate the stage timeout."""
     rng = np.random.default_rng(seed)
     n = int(np.prod(shape)) if shape else 1
+    if fast or n >= 100_000:
+        arr = rng.integers(0, 256, size=(fp.NLIMB,) + tuple(shape),
+                           dtype=np.int64).astype(np.int32)
+        return jnp.asarray(arr)
     vals = [int(rng.integers(0, 2**63)) * int(rng.integers(0, 2**63)) % P
             for _ in range(n)]
     arr = fp.ints_to_array(vals).reshape((fp.NLIMB,) + tuple(shape))

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Tunnel watchdog: poll the TPU cheaply; when it revives, run the
+staged measurement plan immediately (highest-value stages first).
+
+The axon tunnel's observed behavior (rounds 1-5) is intermittent life —
+alive minutes, dead hours.  Rather than hoping it is up when a human
+looks, this daemon polls with a bounded subprocess probe every
+POLL_S seconds and fires tools/tpu_stage_bench.py stages on revival,
+appending to TPU_MEASUREMENTS.jsonl.  Stages already measured (a
+same-stage same-args success in the artifact) are skipped, so across
+multiple revivals the plan converges to complete.
+
+Usage: nohup python tools/tpu_watchdog.py > /tmp/tpu_watchdog.log 2>&1 &
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+OUT = os.path.join(REPO, "TPU_MEASUREMENTS.jsonl")
+STAGE = os.path.join(HERE, "tpu_stage_bench.py")
+
+POLL_S = float(os.environ.get("WATCHDOG_POLL_S", "420"))
+PROBE_TIMEOUT = 75
+
+# value-ordered: throughput curve (cheap, anchors the roofline), then the
+# money kernel at growing shapes, then per-set + sub-kernels
+PLAN = [
+    ("mont_mul", ["4096"], 420),
+    ("mont_mul", ["65536"], 300),
+    ("mont_mul", ["262144"], 300),
+    ("mont_mul", ["1048576"], 420),
+    ("verify", ["32", "1"], 1500),
+    ("miller", ["33"], 900),
+    ("final_exp", ["4"], 900),
+    ("hash_to_g2", ["32"], 1200),
+    ("mul_u64", ["32"], 700),
+    ("g2_subgroup", ["32"], 700),
+    ("fp_inv", ["4096"], 600),
+    ("verify", ["128", "1"], 1800),
+    ("per_set", ["32", "1"], 1800),
+    ("tree_sum", ["32", "64"], 900),
+    ("validate_pk", ["512"], 700),
+    ("verify", ["32", "64"], 2400),
+    ("verify", ["256", "1"], 2400),
+]
+
+
+def done_stages():
+    done = set()
+    try:
+        with open(OUT) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "error" not in r and r.get("stage"):
+                    done.add((r["stage"], tuple(r.get("args", []))))
+    except OSError:
+        pass
+    return done
+
+
+def probe_alive() -> bool:
+    src = ("import jax,jax.numpy as jnp;"
+           "x=jax.jit(lambda v:v*2+1)(jnp.ones((128,128)));"
+           "x.block_until_ready();print('ALIVE')")
+    try:
+        out = subprocess.run([sys.executable, "-c", src],
+                             capture_output=True, text=True,
+                             timeout=PROBE_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        return False
+    return out.returncode == 0 and "ALIVE" in out.stdout
+
+
+def run_stage(stage, args, timeout):
+    t0 = time.time()
+    try:
+        out = subprocess.run([sys.executable, STAGE, stage] + args,
+                             capture_output=True, text=True,
+                             timeout=timeout, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return {"stage": stage, "args": args, "error": "timeout",
+                "timeout_s": timeout}
+    if out.returncode != 0:
+        return {"stage": stage, "args": args,
+                "error": f"rc={out.returncode}",
+                "stderr_tail": (out.stderr or "")[-300:]}
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+            rec["args"] = args
+            rec["wall_s"] = round(time.time() - t0, 1)
+            return rec
+        except json.JSONDecodeError:
+            continue
+    return {"stage": stage, "args": args, "error": "no json output"}
+
+
+def emit(rec):
+    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+
+
+def main():
+    deadline = time.time() + float(
+        os.environ.get("WATCHDOG_MAX_S", str(11 * 3600)))
+    while time.time() < deadline:
+        if not probe_alive():
+            print(f"[{time.strftime('%H:%M:%S')}] tunnel dead; sleeping "
+                  f"{POLL_S:.0f}s", flush=True)
+            time.sleep(POLL_S)
+            continue
+        print(f"[{time.strftime('%H:%M:%S')}] tunnel ALIVE", flush=True)
+        emit({"stage": "watchdog", "event": "tunnel-alive"})
+        for stage, args, timeout in PLAN:
+            if (stage, tuple(args)) in done_stages():
+                continue
+            rec = run_stage(stage, args, timeout)
+            emit(rec)
+            if rec.get("error") == "timeout":
+                # tunnel probably died mid-stage; back to polling
+                break
+        else:
+            print("plan complete", flush=True)
+            return
+        time.sleep(POLL_S)
+
+
+if __name__ == "__main__":
+    main()
