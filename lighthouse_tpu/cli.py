@@ -18,17 +18,28 @@ def _spec_from_args(args):
     kwargs = {}
     if args.altair_fork_epoch is not None:
         kwargs["altair_fork_epoch"] = args.altair_fork_epoch
-    if args.network == "gnosis":
-        from .types.spec import gnosis_spec
+    if args.network == "minimal":
+        return ChainSpec(preset=MinimalPreset, **kwargs)
+    # built-in network configs (eth2_network_config analogue): real fork
+    # schedules, deposit contracts, genesis constants per network.
+    # Overrides compose via replace() UNIFORMLY — mainnet-with-a-tweak
+    # keeps mainnet's later forks and deposit identity exactly like the
+    # testnets do (review r5: the old mainnet branch silently dropped
+    # them back to interop defaults).
+    from .types.networks import network_spec
 
-        return gnosis_spec(**kwargs)
-    preset = MinimalPreset if args.network == "minimal" else MainnetPreset
-    return ChainSpec(preset=preset, **kwargs)
+    spec = network_spec(args.network)
+    if kwargs:
+        import dataclasses
+
+        spec = dataclasses.replace(spec, **kwargs)
+    return spec
 
 
 def _add_common(p):
     p.add_argument("--network", default="mainnet",
-                   choices=["mainnet", "minimal", "gnosis"])
+                   choices=["mainnet", "minimal", "gnosis", "sepolia",
+                            "prater", "goerli"])
     p.add_argument("--altair-fork-epoch", type=int, default=None)
     p.add_argument("--config", help="JSON flags file (clap_utils flags.rs)")
     p.add_argument("--dump-config", action="store_true")

@@ -48,21 +48,12 @@ TILE = 256  # batch elements per grid step
 MASK = int(fp.MASK)
 
 
-def _mont_mul_kernel(
-    a_ref, b_ref, d2n_ref, dn_ref, np_ref, p_ref, r392_ref, r400_ref, out_ref
-):
-    """One tile: a, b (N, TILE) i32 lazy -> out (N, TILE) i32 lazy.
-
-    Bit-for-bit mirror of fp.mont_mul: _compress_limbs on both operands,
-    cols_t, t mod R, m = t*(-p^-1) mod R, u = m*p + t, one carry scan,
-    upper half + final carry folded into the top limb.
-    """
-    a = a_ref[:]
-    b = b_ref[:]
-    d2n = d2n_ref[:]
-    dn = dn_ref[:]
-    r392 = r392_ref[:][:, None]
-    r400 = r400_ref[:][:, None]
+def _mont_body(a, b, d2n, dn, npl, pconst, r392c, r400c):
+    """The fused SOS Montgomery multiply on plain arrays (N, T) — shared
+    by the one-shot kernel and the CHAIN kernel (state held in VMEM
+    across iterations; the TPU_BOUND.md byte-wall experiment)."""
+    r392 = r392c[:, None]
+    r400 = r400c[:, None]
 
     z1 = jnp.zeros((1, a.shape[1]), jnp.int32)
     z2 = jnp.zeros((2, a.shape[1]), jnp.int32)
@@ -112,11 +103,11 @@ def _mont_mul_kernel(
     br = compress(b).astype(jnp.float32)
     cols_t = cols(ar, br, d2n).astype(jnp.int32)          # (2N, T)
     t_red = compress_mod_R(cols_t[:NLIMB])
-    np_f = jnp.broadcast_to(np_ref[:].astype(jnp.float32)[:, None], a.shape)
+    np_f = jnp.broadcast_to(npl.astype(jnp.float32)[:, None], a.shape)
     m_red = compress_mod_R(
         cols(t_red.astype(jnp.float32), np_f, dn).astype(jnp.int32)
     )
-    p_f = jnp.broadcast_to(p_ref[:].astype(jnp.float32)[:, None], a.shape)
+    p_f = jnp.broadcast_to(pconst.astype(jnp.float32)[:, None], a.shape)
     u = cols(m_red.astype(jnp.float32), p_f, d2n).astype(jnp.int32) + cols_t
 
     def carry_step(carry, col):
@@ -128,7 +119,37 @@ def _mont_mul_kernel(
     )
     res = limbs[NLIMB:]                                   # (N, T) = u / R
     top = res[-1] + carry * (1 << LB)
-    out_ref[:] = jnp.concatenate([res[:-1], top[None]], axis=0)
+    return jnp.concatenate([res[:-1], top[None]], axis=0)
+
+
+def _mont_mul_kernel(
+    a_ref, b_ref, d2n_ref, dn_ref, np_ref, p_ref, r392_ref, r400_ref, out_ref
+):
+    """One tile: a, b (N, TILE) i32 lazy -> out (N, TILE) i32 lazy.
+
+    Bit-for-bit mirror of fp.mont_mul: _compress_limbs on both operands,
+    cols_t, t mod R, m = t*(-p^-1) mod R, u = m*p + t, one carry scan,
+    upper half + final carry folded into the top limb.
+    """
+    out_ref[:] = _mont_body(
+        a_ref[:], b_ref[:], d2n_ref[:], dn_ref[:], np_ref[:], p_ref[:],
+        r392_ref[:], r400_ref[:])
+
+
+def _mont_chain_kernel(steps):
+    def kernel(a_ref, b_ref, d2n_ref, dn_ref, np_ref, p_ref, r392_ref,
+               r400_ref, out_ref):
+        b = b_ref[:]
+        d2n, dn = d2n_ref[:], dn_ref[:]
+        npl, pconst = np_ref[:], p_ref[:]
+        r392c, r400c = r392_ref[:], r400_ref[:]
+
+        def body(_, x):
+            return _mont_body(x, b, d2n, dn, npl, pconst, r392c, r400c)
+
+        out_ref[:] = lax.fori_loop(0, steps, body, a_ref[:])
+
+    return kernel
 
 
 def mont_mul_pallas(a, b, interpret=False):
@@ -178,3 +199,57 @@ def mont_mul_pallas(a, b, interpret=False):
     if pad:
         out = out[:, :n]
     return out.reshape(orig_shape)
+
+
+def mont_chain_pallas(a, b, steps, interpret=False):
+    """x <- mont_mul(x, b), `steps` times, as ONE pallas_call: the chain
+    state never leaves VMEM between iterations.  This is the byte-wall
+    experiment from TPU_BOUND.md — against `mont_chain_xla` (same chain
+    as `steps` separate XLA ops, HBM round-trip per step) the ratio
+    directly measures what pairing-layer fusion buys."""
+    from jax.experimental import pallas as pl
+
+    orig_shape = a.shape
+    a2 = a.reshape(NLIMB, -1)
+    b2 = jnp.broadcast_to(b, orig_shape).reshape(NLIMB, -1)
+    n = a2.shape[1]
+    pad = (-n) % TILE
+    if pad:
+        a2 = jnp.pad(a2, ((0, 0), (0, pad)))
+        b2 = jnp.pad(b2, ((0, 0), (0, pad)))
+    total = a2.shape[1]
+
+    out = pl.pallas_call(
+        _mont_chain_kernel(steps),
+        out_shape=jax.ShapeDtypeStruct((NLIMB, total), jnp.int32),
+        grid=(total // TILE,),
+        in_specs=[
+            pl.BlockSpec((NLIMB, TILE), lambda i: (0, i)),
+            pl.BlockSpec((NLIMB, TILE), lambda i: (0, i)),
+            pl.BlockSpec((2 * NLIMB, NLIMB * NLIMB), lambda i: (0, 0)),
+            pl.BlockSpec((NLIMB, NLIMB * NLIMB), lambda i: (0, 0)),
+            pl.BlockSpec((NLIMB,), lambda i: (0,)),
+            pl.BlockSpec((NLIMB,), lambda i: (0,)),
+            pl.BlockSpec((NLIMB,), lambda i: (0,)),
+            pl.BlockSpec((NLIMB,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((NLIMB, TILE), lambda i: (0, i)),
+        interpret=interpret,
+    )(
+        a2,
+        b2,
+        jnp.asarray(_DIAG2N),
+        jnp.asarray(_DIAGN),
+        jnp.asarray(fp.NPRIME_LIMBS),
+        jnp.asarray(fp.P_LIMBS),
+        jnp.asarray(fp.R392_LIMBS),
+        jnp.asarray(fp.R400_LIMBS),
+    )
+    if pad:
+        out = out[:, :n]
+    return out.reshape(orig_shape)
+
+
+def mont_chain_xla(a, b, steps):
+    """The same chain as separate fp.mont_mul XLA ops (fusion baseline)."""
+    return lax.fori_loop(0, steps, lambda _, x: fp.mont_mul(x, b), a)

@@ -1,0 +1,117 @@
+"""ctypes binding for the native snappy block codec (csrc/snappy_block.cpp).
+
+The wire path's codec at C speed (the reference rides C snappy for every
+gossip payload / rpc chunk); network/snappy.py keeps the pure-Python
+implementation as the no-toolchain fallback and delegates here when the
+library loads.  Same on-wire format both ways — payloads are freely
+interchangeable (differentially tested in tests/test_wire.py).
+
+Build-on-first-use like native/kvlog.py; stale-after-failed-rebuild is
+refused just like native_bls (a broken toolchain must not pin an old
+codec).
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_CSRC = os.path.join(_HERE, "..", "..", "csrc")
+_SO = os.path.join(_HERE, "libsnappyblock.so")
+_SRC = os.path.join(_CSRC, "snappy_block.cpp")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+# past this declared size the python fallback handles the frame (bounds
+# the eager output allocation the C api needs)
+MAX_NATIVE_DECLARED = 64 * 1024 * 1024
+# past this input size compress() falls back to python: the C ABI is
+# u32-sized and snpy_max_compressed_length would overflow (review r5)
+MAX_NATIVE_INPUT = 1 << 30
+
+
+def _build():
+    if not os.path.exists(_SRC):
+        return None
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+             "-o", _SO, _SRC],
+            check=True, capture_output=True, timeout=120,
+        )
+    except Exception:
+        return None
+    return _SO
+
+
+def _load():
+    stale = not os.path.exists(_SO) or (
+        os.path.exists(_SRC)
+        and os.path.getmtime(_SRC) > os.path.getmtime(_SO))
+    path = _build() if stale else _SO
+    if path is None:
+        return None          # failed rebuild: refuse any stale binary
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.snpy_max_compressed_length.argtypes = [ctypes.c_uint32]
+    lib.snpy_max_compressed_length.restype = ctypes.c_uint32
+    lib.snpy_compress.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint32, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint32)]
+    lib.snpy_compress.restype = ctypes.c_int
+    lib.snpy_decompress.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint32, ctypes.c_char_p,
+        ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint32)]
+    lib.snpy_decompress.restype = ctypes.c_int
+    return lib
+
+
+def _get():
+    global _lib, _tried
+    with _lock:
+        if not _tried:
+            _lib = _load()
+            _tried = True
+        return _lib
+
+
+def available() -> bool:
+    return _get() is not None
+
+
+def compress(data: bytes):
+    """Compressed bytes, or None when the python fallback should handle
+    it (input over the u32-safe bound)."""
+    if len(data) > MAX_NATIVE_INPUT:
+        return None
+    lib = _get()
+    buf = ctypes.create_string_buffer(
+        int(lib.snpy_max_compressed_length(len(data))))
+    out_len = ctypes.c_uint32(0)
+    rc = lib.snpy_compress(bytes(data), len(data), buf,
+                           ctypes.byref(out_len))
+    if rc != 0:
+        raise RuntimeError(f"snpy_compress rc={rc}")
+    return buf.raw[: out_len.value]
+
+
+def decompress(data: bytes, declared: int):
+    """Returns the decompressed bytes, or None when the python fallback
+    should handle it (declared size over the native allocation bound).
+    Raises ValueError on malformed input (mapped to SnappyError by the
+    caller)."""
+    if declared > MAX_NATIVE_DECLARED:
+        return None
+    lib = _get()
+    buf = ctypes.create_string_buffer(max(declared, 1))
+    out_len = ctypes.c_uint32(0)
+    rc = lib.snpy_decompress(bytes(data), len(data), buf, declared,
+                             ctypes.byref(out_len))
+    if rc != 0:
+        raise ValueError(f"malformed snappy block (native rc={rc})")
+    return buf.raw[: out_len.value]

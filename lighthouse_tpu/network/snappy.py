@@ -23,6 +23,26 @@ class SnappyError(ValueError):
     pass
 
 
+# native C engine (csrc/snappy_block.cpp) when the toolchain builds it.
+# Resolved LAZILY on first codec call — the on-first-use g++ build must
+# not run at import time (review r5); None -> pure-Python paths.
+_native = None
+_native_tried = False
+
+
+def _get_native():
+    global _native, _native_tried
+    if not _native_tried:
+        _native_tried = True
+        try:
+            from ..native import snappy_native as _snative
+
+            _native = _snative if _snative.available() else None
+        except Exception:  # pragma: no cover — import/toolchain failure
+            _native = None
+    return _native
+
+
 def uvarint_encode(n):
     out = bytearray()
     while True:
@@ -91,6 +111,11 @@ def _emit_copy(out, offset, length):
 
 def compress(data):
     data = bytes(data)
+    native = _get_native()
+    if native is not None:
+        out = native.compress(data)
+        if out is not None:
+            return out
     n = len(data)
     out = bytearray(uvarint_encode(n))
     if n == 0:
@@ -131,6 +156,16 @@ def decompress(data):
     if ulen >= (1 << 32):
         # the snappy format caps the uncompressed length at 2**32 - 1
         raise SnappyError("unreasonable uncompressed length")
+    native = _get_native()
+    if native is not None:
+        try:
+            got = native.decompress(data, ulen)
+        except ValueError as e:
+            raise SnappyError(str(e)) from e
+        if got is not None:
+            return got
+        # declared size over the native allocation bound: fall through
+        # to the incremental python path
     out = bytearray()
     n = len(data)
     while pos < n:

@@ -1,0 +1,138 @@
+"""Built-in network configurations (eth2_network_config analogue).
+
+Mirror of /root/reference/common/eth2_network_config/
+built_in_network_configs/{mainnet,sepolia,prater,gnosis}/config.yaml:
+the public per-network constants — fork versions and epochs, genesis
+parameters, deposit contract — embedded so `--network <name>` selects a
+real network's ChainSpec without external files.
+
+One deliberate difference from the reference: it also EMBEDS each
+network's genesis state ssz (multi-MB binary blobs fetched at build
+time).  This environment has no egress, so nodes join a named network
+via checkpoint sync (`--checkpoint-state`, beacon/checkpoint sync path)
+or an explicitly supplied genesis state; the constants below make the
+fork digests, domains, and deposit queries correct for each network.
+
+All values are the public chain constants from the networks' published
+configs.
+"""
+
+from dataclasses import dataclass
+
+from .spec import ChainSpec, GnosisPreset, MainnetPreset
+
+
+@dataclass(frozen=True)
+class NetworkConfig:
+    name: str
+    spec: ChainSpec
+    min_genesis_active_validator_count: int
+    genesis_delay: int
+
+
+def _mainnet():
+    return NetworkConfig(
+        name="mainnet",
+        spec=ChainSpec(
+            preset=MainnetPreset,
+            genesis_fork_version=bytes.fromhex("00000000"),
+            altair_fork_version=bytes.fromhex("01000000"),
+            altair_fork_epoch=74240,
+            bellatrix_fork_version=bytes.fromhex("02000000"),
+            bellatrix_fork_epoch=144896,
+            capella_fork_version=bytes.fromhex("03000000"),
+            capella_fork_epoch=194048,
+            deposit_chain_id=1,
+            deposit_contract_address=(
+                "0x00000000219ab540356cbb839cbe05303d7705fa"),
+            min_genesis_time=1606824000,
+        ),
+        min_genesis_active_validator_count=16384,
+        genesis_delay=604800,
+    )
+
+
+def _sepolia():
+    return NetworkConfig(
+        name="sepolia",
+        spec=ChainSpec(
+            preset=MainnetPreset,
+            genesis_fork_version=bytes.fromhex("90000069"),
+            altair_fork_version=bytes.fromhex("90000070"),
+            altair_fork_epoch=50,
+            bellatrix_fork_version=bytes.fromhex("90000071"),
+            bellatrix_fork_epoch=100,
+            capella_fork_version=bytes.fromhex("90000072"),
+            capella_fork_epoch=56832,
+            deposit_chain_id=11155111,
+            deposit_contract_address=(
+                "0x7f02c3e3c98b133055b8b348b2ac625669ed295d"),
+            min_genesis_time=1655647200,
+        ),
+        min_genesis_active_validator_count=1300,
+        genesis_delay=86400,
+    )
+
+
+def _prater():
+    return NetworkConfig(
+        name="prater",
+        spec=ChainSpec(
+            preset=MainnetPreset,
+            genesis_fork_version=bytes.fromhex("00001020"),
+            altair_fork_version=bytes.fromhex("01001020"),
+            altair_fork_epoch=36660,
+            bellatrix_fork_version=bytes.fromhex("02001020"),
+            bellatrix_fork_epoch=112260,
+            capella_fork_version=bytes.fromhex("03001020"),
+            capella_fork_epoch=162304,
+            deposit_chain_id=5,
+            deposit_contract_address=(
+                "0xff50ed3d0ec03ac01d4c79aad74928bff48a7b2b"),
+            min_genesis_time=1614588812,
+        ),
+        min_genesis_active_validator_count=16384,
+        genesis_delay=1919188,
+    )
+
+
+def _gnosis():
+    from .spec import gnosis_spec
+
+    return NetworkConfig(
+        name="gnosis",
+        spec=gnosis_spec(
+            altair_fork_epoch=512,
+            bellatrix_fork_epoch=385536,
+            capella_fork_epoch=648704,
+            deposit_chain_id=100,
+            deposit_contract_address=(
+                "0x0b98057ea310f4d31f2a452b414647007d1645d9"),
+            min_genesis_time=1638968400,
+        ),
+        min_genesis_active_validator_count=4096,
+        genesis_delay=6000,
+    )
+
+
+_BUILDERS = {
+    "mainnet": _mainnet,
+    "sepolia": _sepolia,
+    "prater": _prater,
+    "goerli": _prater,          # alias, as in the reference
+    "gnosis": _gnosis,
+}
+
+NETWORK_NAMES = tuple(sorted(set(_BUILDERS) - {"goerli"})) + ("goerli",)
+
+
+def network_config(name: str) -> NetworkConfig:
+    try:
+        return _BUILDERS[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown network {name!r}; built-ins: {NETWORK_NAMES}") from None
+
+
+def network_spec(name: str) -> ChainSpec:
+    return network_config(name).spec

@@ -44,3 +44,27 @@ def test_pallas_handles_edge_values():
     want = np.asarray(fp.mont_mul(a, b))
     got = np.asarray(mont_mul_pallas(a, b, interpret=True))
     assert np.array_equal(want, got)
+
+
+def test_mont_chain_pallas_matches_xla():
+    """The fused chain kernel (state in VMEM across iterations) is
+    bit-identical to the XLA op-per-step chain (TPU_BOUND.md experiment
+    machinery must be trustworthy before its ratio means anything)."""
+    import jax.numpy as jnp
+
+    from lighthouse_tpu.crypto.tpu.pallas_fp import (
+        mont_chain_pallas,
+        mont_chain_xla,
+    )
+
+    vals_a = [pow(5, i + 1, P) for i in range(8)]
+    vals_b = [pow(7, i + 1, P) for i in range(8)]
+    a = fp.to_mont_jit(jnp.asarray(fp.ints_to_array(vals_a)))
+    b = fp.to_mont_jit(jnp.asarray(fp.ints_to_array(vals_b)))
+    got = mont_chain_pallas(a, b, steps=5, interpret=True)
+    want = mont_chain_xla(a, b, steps=5)
+    # same VALUE mod p (lazy representations may differ limb-wise only
+    # if the pipelines diverge — they must not: compare canonically)
+    got_ints = [x % P for x in fp.array_to_ints(np.asarray(got))]
+    want_ints = [x % P for x in fp.array_to_ints(np.asarray(want))]
+    assert got_ints == want_ints

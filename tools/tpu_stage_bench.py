@@ -123,6 +123,36 @@ def stage_mont_mul(args):
     _emit("mont_mul", c, p, it, batch=n, mults_per_s=round(n / p, 1))
 
 
+def stage_mont_chain(args):
+    """THE byte-wall experiment (TPU_BOUND.md): a K-step mont_mul chain
+    as (a) one fused pallas kernel holding state in VMEM vs (b) K
+    separate XLA ops round-tripping HBM.  The ratio is the measured
+    fusion headroom for the pairing layer."""
+    n = int(args[0]) if args else 4096
+    steps = int(args[1]) if len(args) > 1 else 64
+    from lighthouse_tpu.crypto.tpu import pallas_fp
+
+    a = _rand_fp((n,), 21)
+    b = _rand_fp((n,), 22)
+    fx = jax.jit(lambda x, y: pallas_fp.mont_chain_xla(x, y, steps))
+    cx, px, itx = _time_fn(fx, (a, b))
+    rec = {"stage": "mont_chain", "batch": n, "steps": steps,
+           "xla_compile_s": round(cx, 2), "xla_per_call_s": round(px, 6),
+           "xla_mults_per_s": round(n * steps / px, 1)}
+    try:
+        fp_ = jax.jit(lambda x, y: pallas_fp.mont_chain_pallas(x, y, steps))
+        cp, pp, itp = _time_fn(fp_, (a, b))
+        rec.update({"pallas_compile_s": round(cp, 2),
+                    "pallas_per_call_s": round(pp, 6),
+                    "pallas_mults_per_s": round(n * steps / pp, 1),
+                    "fusion_speedup": round(px / pp, 2)})
+    except Exception as e:       # pallas may not lower on this backend
+        rec["pallas_error"] = str(e)[:300]
+    rec.update({"platform": jax.devices()[0].platform,
+                "device": str(jax.devices()[0])})
+    print(json.dumps(rec), flush=True)
+
+
 def stage_fp_inv(args):
     n = int(args[0]) if args else 4096
     a = _rand_fp((n,), 3)
@@ -261,6 +291,7 @@ def stage_validate_pk(args):
 STAGES = {
     "sanity": stage_sanity,
     "mont_mul": stage_mont_mul,
+    "mont_chain": stage_mont_chain,
     "fp_inv": stage_fp_inv,
     "tree_sum": stage_tree_sum,
     "mul_u64": stage_mul_u64,

@@ -34,6 +34,7 @@ PLAN = [
     ("mont_mul", ["65536"], 300),
     ("mont_mul", ["262144"], 300),
     ("mont_mul", ["1048576"], 420),
+    ("mont_chain", ["4096", "64"], 900),
     ("verify", ["32", "1"], 1500),
     ("miller", ["33"], 900),
     ("final_exp", ["4"], 900),
