@@ -44,33 +44,36 @@ import time
 
 
 def _preflight_device():
-    """The axon tunnel has died mid-run in rounds 1-4 (hangs, then refuses
-    remote_compile) — probe it via the shared subprocess helper so a sick
-    device degrades this run to a clearly-labeled CPU measurement instead
-    of a 55-minute hang and rc=1."""
-    if os.environ.get("BENCH_PLATFORM"):
-        return os.environ["BENCH_PLATFORM"], "forced by BENCH_PLATFORM"
+    """The axon tunnel has died MID-RUN in every round so far — including
+    (r5) the window between a successful preflight probe and the first
+    in-process `jax.devices()` call, which then hangs forever.  So the
+    main bench process NEVER initializes the accelerator backend: it is
+    always pinned to CPU, and every device measurement happens in a
+    bounded SUBPROCESS (config_device: tools/tpu_stage_bench.py stages).
+    The probe verdict only decides how eagerly those subprocesses run."""
+    forced = os.environ.get("BENCH_PLATFORM")
+    if forced:
+        # debugging override: the operator takes responsibility for the
+        # in-process init; a non-cpu force also counts as a live device
+        return forced, "forced by BENCH_PLATFORM", forced != "cpu"
     from lighthouse_tpu.utils.device_probe import probe_device
 
     platform, note = probe_device(
-        float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "300"))
+        float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "90"))
     )
-    if platform is not None:
-        return None, note          # healthy device (cpu included): use it
-    return "cpu", note + " — cpu fallback"
+    alive = platform is not None and platform != "cpu"
+    return "cpu", note + " — main process pinned to cpu", alive
 
 
-_FORCED_PLATFORM, _PLATFORM_NOTE = _preflight_device()
+_FORCED_PLATFORM, _PLATFORM_NOTE, _DEVICE_ALIVE = _preflight_device()
 
 import jax  # noqa: E402
 
 if _FORCED_PLATFORM:
     jax.config.update("jax_platforms", _FORCED_PLATFORM)
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.environ.get("LTPU_XLA_CACHE",
-                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                ".xla_cache")))
+from lighthouse_tpu.utils.xla_cache import cache_dir as _xla_cache_dir  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", _xla_cache_dir())
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 from lighthouse_tpu.crypto.constants import DST_POP  # noqa: E402
@@ -469,14 +472,16 @@ def config_device_retry():
     revived, measure the device kernel in a SUBPROCESS (this process is
     already pinned to CPU) via tools/tpu_stage_bench.py.  The probe cost
     is bounded; a dead tunnel costs 75 s, not the run."""
-    if not _FORCED_PLATFORM:
-        return None                    # in-process device already live
     if not _fits(200.0, "device_retry"):
         return None
     import subprocess
 
     from lighthouse_tpu.utils.device_probe import probe_device
 
+    # ALWAYS re-probe with the short bound, even when preflight saw a
+    # live device: the tunnel dying between preflight and now is exactly
+    # the staleness this redesign exists for (review r5) — a fresh 75 s
+    # probe keeps a dead tunnel's cost at 75 s, not the stage timeout.
     plat, note_txt = probe_device(75.0)
     if plat is None or plat == "cpu":
         note("device_retry", alive=False, probe=note_txt)
@@ -734,14 +739,16 @@ def main():
     # shapes) and the bounded device-retry probe run BEFORE the
     # CPU-emulated device extras, which previously starved them (r4:
     # configs 4 and 5 budget-skipped).
-    on_cpu = jax.devices()[0].platform == "cpu"
+    # the main process is always CPU-pinned; a live device moves its
+    # subprocess measurements to the front of the extras
     stages = (
+        (config_device_retry, config_gossip_latency, config_native_shapes,
+         config5, run_device_smoke_and_curve, config_kernels, config1,
+         config4)
+        if _DEVICE_ALIVE else
         (config_gossip_latency, config_native_shapes, config5,
          config_device_retry, run_device_smoke_and_curve, config_kernels,
          config1, config4)
-        if on_cpu else
-        (run_device_smoke_and_curve, config_gossip_latency, config5,
-         config_native_shapes, config_kernels, config1, config4)
     )
     for fn in stages:
         if _left() < 120:

@@ -137,6 +137,8 @@ static void fp_mul(Fp& r, const Fp& a, const Fp& b) {
     std::memcpy(r.l, t, sizeof(r.l));
 }
 
+static void redc_wide(Fp& r, const u64 t_in[12]);
+
 // Dedicated Montgomery squaring: the 36 schoolbook products collapse to
 // 15 off-diagonal (doubled) + 6 diagonal, then one 12-limb Montgomery
 // reduction — ~25% fewer wide multiplies than fp_mul(a, a).  Squarings
@@ -168,30 +170,10 @@ static void fp_sqr(Fp& r, const Fp& a) {
         c >>= 64;
     }
     top += (u64)c;  // p < 2^384 so the square < 2^762: top stays 0 here
-    // Montgomery reduction of the 12-limb value (independent loop, same
-    // invariants as fp_mul's interleaved reduction)
-    for (int i = 0; i < 6; i++) {
-        u64 m = t[i] * N0;
-        u128 cc = (u128)t[i] + (u128)m * P_LIMBS[0];
-        cc >>= 64;
-        for (int j = 1; j < 6; j++) {
-            cc += (u128)t[i + j] + (u128)m * P_LIMBS[j];
-            t[i + j] = (u64)cc;
-            cc >>= 64;
-        }
-        // propagate the carry into the upper limbs
-        for (int j = i + 6; cc && j < 12; j++) {
-            cc += t[j];
-            t[j] = (u64)cc;
-            cc >>= 64;
-        }
-        top += (u64)cc;
-    }
-    u64 out[7];
-    std::memcpy(out, t + 6, 6 * sizeof(u64));
-    out[6] = top;
-    if (out[6] || geq_p(out)) sub_p(out);
-    std::memcpy(r.l, out, sizeof(r.l));
+    (void)top;
+    // one shared 12-limb Montgomery reduction (review r5: this tail used
+    // to duplicate redc_wide instruction-for-instruction)
+    redc_wide(r, t);
 }
 
 static void fp_pow_limbs(Fp& r, const Fp& a, const u64* e, int nlimbs) {
@@ -207,8 +189,99 @@ static void fp_pow_limbs(Fp& r, const Fp& a, const u64* e, int nlimbs) {
     r = acc;
 }
 
-static inline void fp_inv(Fp& r, const Fp& a) {
-    fp_pow_limbs(r, a, EXP_P_MINUS_2, 6);  // Fermat; 0 -> 0 (inv0)
+// ---- binary extended GCD inversion (r5): ~4x faster than the Fermat
+// pow for this verification workload (inputs are public — no
+// constant-time requirement on the verify path).
+
+static inline bool _limbs_is_zero(const u64* a) {
+    return !(a[0] | a[1] | a[2] | a[3] | a[4] | a[5]);
+}
+
+static inline int _limbs_cmp(const u64* a, const u64* b) {
+    for (int i = 5; i >= 0; i--) {
+        if (a[i] != b[i]) return a[i] > b[i] ? 1 : -1;
+    }
+    return 0;
+}
+
+static inline void _limbs_sub(u64* a, const u64* b) {  // a -= b (a >= b)
+    u128 borrow = 0;
+    for (int i = 0; i < 6; i++) {
+        u128 d = (u128)a[i] - b[i] - borrow;
+        a[i] = (u64)d;
+        borrow = (d >> 64) & 1;
+    }
+}
+
+static inline void _limbs_shr1(u64* a) {
+    for (int i = 0; i < 6; i++) {
+        a[i] = (a[i] >> 1) | (i < 5 ? (a[i + 1] << 63) : 0);
+    }
+}
+
+static inline void _limbs_halve_mod_p(u64* a) {
+    // a/2 mod p for a in [0, p): if odd, add p first (tracks the carry
+    // bit out of limb 5 through the shift)
+    u64 carry = 0;
+    if (a[0] & 1) {
+        u128 c = 0;
+        for (int i = 0; i < 6; i++) {
+            c += (u128)a[i] + P_LIMBS[i];
+            a[i] = (u64)c;
+            c >>= 64;
+        }
+        carry = (u64)c;
+    }
+    _limbs_shr1(a);
+    a[5] |= carry << 63;
+}
+
+static void fp_inv(Fp& r, const Fp& a) {
+    // Montgomery-domain binary xgcd: for x = a*R, computes x^-1 =
+    // a^-1 R^-1, then one Montgomery multiply by R^3 lands on a^-1 R.
+    if (fp_is_zero(a)) { r = a; return; }   // inv0, matching the pow
+    u64 u[6], v[6], b[6], c[6];
+    std::memcpy(u, a.l, sizeof(u));
+    std::memcpy(v, P_LIMBS, sizeof(v));
+    std::memset(b, 0, sizeof(b)); b[0] = 1;   // b tracks u (b*x == u)
+    std::memset(c, 0, sizeof(c));             // c tracks v
+    while (!_limbs_is_zero(u)) {
+        while (!(u[0] & 1)) { _limbs_shr1(u); _limbs_halve_mod_p(b); }
+        while (!(v[0] & 1)) { _limbs_shr1(v); _limbs_halve_mod_p(c); }
+        // x = (x - y) mod p with x,y < p: when x < y, add p first.
+        // b+p < 2^383 so the add never carries out of limb 5 and the
+        // following subtract never borrows past it (review r5: the old
+        // 7-limb ceremony implied a carry path that cannot occur).
+        auto mod_sub = [](u64* x, const u64* y) {
+            if (_limbs_cmp(x, y) < 0) {
+                u128 cy = 0;
+                for (int i = 0; i < 6; i++) {
+                    cy += (u128)x[i] + P_LIMBS[i];
+                    x[i] = (u64)cy;
+                    cy >>= 64;
+                }
+            }
+            _limbs_sub(x, y);
+        };
+        if (_limbs_cmp(u, v) >= 0) {
+            _limbs_sub(u, v);
+            mod_sub(b, c);
+        } else {
+            _limbs_sub(v, u);
+            mod_sub(c, b);
+        }
+    }
+    // v == gcd == 1; c == x^-1 mod p (possibly == p... reduce once)
+    if (geq_p(c)) sub_p(c);
+    Fp raw;
+    std::memcpy(raw.l, c, sizeof(raw.l));
+    static Fp r3 = [] {          // R^3 mod p (computed once)
+        Fp r2, out;
+        std::memcpy(r2.l, R2_CONST.l, sizeof(r2.l));
+        fp_mul(out, r2, r2);     // R^2*R^2*R^-1 = R^3
+        return out;
+    }();
+    fp_mul(r, raw, r3);
 }
 
 // sqrt for p ≡ 3 (mod 4): a^((p+1)/4); returns false if a is a non-residue.
@@ -304,20 +377,117 @@ static inline void f2_conj(F2& r, const F2& x) {
     fp_neg(r.b, x.b);
 }
 
+// ---- lazy double-width Fp2 multiplication (r5): Karatsuba with the
+// three products kept UNREDUCED at 768 bits and ONE Montgomery
+// reduction per output coefficient — 2 reductions instead of 3 full
+// CIOS multiplies (the relic/blst "lazy reduction" tower trick).
+// Range argument: operands < 2p (the unreduced sums), so every wide
+// product < 4p^2 < p*R (4p < R since p < 2^382), which is exactly
+// redc_wide's contract; its output is < 2p, one conditional subtract.
+
+static inline void _mul_wide(u64 t[12], const Fp& a, const Fp& b) {
+    std::memset(t, 0, 12 * sizeof(u64));
+    for (int i = 0; i < 6; i++) {
+        u128 c = 0;
+        for (int j = 0; j < 6; j++) {
+            c += (u128)t[i + j] + (u128)a.l[i] * b.l[j];
+            t[i + j] = (u64)c;
+            c >>= 64;
+        }
+        t[i + 6] = (u64)c;
+    }
+}
+
+static inline void _wide_add(u64 a[12], const u64 b[12]) {
+    u128 c = 0;
+    for (int i = 0; i < 12; i++) {
+        c += (u128)a[i] + b[i];
+        a[i] = (u64)c;
+        c >>= 64;
+    }
+}
+
+static inline void _wide_sub(u64 a[12], const u64 b[12]) {  // a >= b
+    u128 borrow = 0;
+    for (int i = 0; i < 12; i++) {
+        u128 d = (u128)a[i] - b[i] - borrow;
+        a[i] = (u64)d;
+        borrow = (d >> 64) & 1;
+    }
+}
+
+// p * 2^382 as a 12-limb constant: the additive slack that keeps
+// m0 - m1 nonnegative without leaving the redc_wide range
+static const u64* _p_shift382() {
+    // magic static: thread-safe under C++11 (the verify thread pool
+    // calls f2_mul concurrently — review r5 caught the non-atomic
+    // lazy-init race of the first version)
+    struct PS {
+        u64 v[12];
+        PS() : v{} {
+            // P_LIMBS << 382 = << (5*64 + 62)
+            for (int i = 0; i < 6; i++) {
+                v[i + 5] |= P_LIMBS[i] << 62;
+                v[i + 6] |= P_LIMBS[i] >> 2;
+            }
+        }
+    };
+    static const PS ps;
+    return ps.v;
+}
+
+static void redc_wide(Fp& r, const u64 t_in[12]) {
+    u64 x[13];
+    std::memcpy(x, t_in, 12 * sizeof(u64));
+    x[12] = 0;
+    for (int i = 0; i < 6; i++) {
+        u64 m = x[i] * N0;
+        u128 c = 0;
+        for (int j = 0; j < 6; j++) {
+            c += (u128)x[i + j] + (u128)m * P_LIMBS[j];
+            x[i + j] = (u64)c;
+            c >>= 64;
+        }
+        for (int j = i + 6; c && j < 13; j++) {
+            c += x[j];
+            x[j] = (u64)c;
+            c >>= 64;
+        }
+    }
+    u64 out[7];
+    std::memcpy(out, x + 6, 6 * sizeof(u64));
+    out[6] = x[12];
+    if (out[6] || geq_p(out)) sub_p(out);
+    std::memcpy(r.l, out, sizeof(r.l));
+}
+
+static inline void _fp_add_nored(Fp& r, const Fp& a, const Fp& b) {
+    u128 c = 0;
+    for (int i = 0; i < 6; i++) {
+        c += (u128)a.l[i] + b.l[i];
+        r.l[i] = (u64)c;
+        c >>= 64;
+    }
+    // a, b < p < 2^383 so the sum < 2^384: no carry out
+}
+
 static void f2_mul(F2& r, const F2& x, const F2& y) {
-    // Karatsuba: 3 base mults
-    Fp m0, m1, sa, sb, m2;
-    fp_mul(m0, x.a, y.a);
-    fp_mul(m1, x.b, y.b);
-    fp_add(sa, x.a, x.b);
-    fp_add(sb, y.a, y.b);
-    fp_mul(m2, sa, sb);
-    Fp re, im;
-    fp_sub(re, m0, m1);
-    fp_sub(im, m2, m0);
-    fp_sub(im, im, m1);
-    r.a = re;
-    r.b = im;
+    u64 m0[12], m1[12], m2[12];
+    _mul_wide(m0, x.a, y.a);
+    _mul_wide(m1, x.b, y.b);
+    Fp sa, sb;
+    _fp_add_nored(sa, x.a, x.b);
+    _fp_add_nored(sb, y.a, y.b);
+    _mul_wide(m2, sa, sb);
+    // re = m0 - m1 (+ p<<382 for nonnegativity); im = m2 - m0 - m1 >= 0
+    u64 re[12];
+    std::memcpy(re, _p_shift382(), 12 * sizeof(u64));
+    _wide_add(re, m0);
+    _wide_sub(re, m1);
+    _wide_sub(m2, m0);
+    _wide_sub(m2, m1);
+    redc_wide(r.a, re);
+    redc_wide(r.b, m2);
 }
 
 static inline void f2_sqr(F2& r, const F2& x) {

@@ -39,8 +39,8 @@ def _build():
         return None
     try:
         subprocess.run(
-            ["g++", "-O3", "-std=c++17", "-pthread", "-shared", "-fPIC",
-             "-o", _SO, _SRC],
+            ["g++", "-O3", "-funroll-loops", "-std=c++17", "-pthread",
+             "-shared", "-fPIC", "-o", _SO, _SRC],
             check=True,
             capture_output=True,
             timeout=180,

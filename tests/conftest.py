@@ -27,7 +27,9 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent compile cache: the kernel graphs (scan ladders, Miller loops)
 # are compile-heavy; cache across test runs.
-jax.config.update("jax_compilation_cache_dir", "/tmp/lighthouse_tpu_xla_cache")
+from lighthouse_tpu.utils.xla_cache import cache_dir as _xla_cache_dir  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", _xla_cache_dir())
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 

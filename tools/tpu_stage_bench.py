@@ -35,14 +35,12 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.environ.get("LTPU_XLA_CACHE",
-                   os.path.join(os.path.dirname(os.path.dirname(
-                       os.path.abspath(__file__))), ".xla_cache")))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from lighthouse_tpu.utils.xla_cache import cache_dir as _xla_cache_dir  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", _xla_cache_dir())
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from lighthouse_tpu.crypto.constants import P, DST_POP  # noqa: E402
 from lighthouse_tpu.crypto.ref import bls as RB  # noqa: E402
