@@ -266,6 +266,41 @@ class _Handler(JsonHandler):
             return self._json({"data": data})
 
         m = re.fullmatch(
+            r"/eth/v1/beacon/states/([^/]+)/sync_committees", path)
+        if m:
+            st, _ = self._resolve_state(m.group(1))
+            if st is None:
+                return self._err(404, "state not found")
+            if not hasattr(st, "current_sync_committee"):
+                return self._err(400, "state has no sync committees "
+                                      "(pre-altair)")
+            from ..state_processing.altair import (
+                sync_committee_validator_indices,
+            )
+
+            preset = chain.spec.preset
+            epoch = int(q["epoch"][0]) if "epoch" in q else None
+            committee = st.current_sync_committee
+            if epoch is not None:
+                cur_period = (int(st.slot) // preset.slots_per_epoch
+                              ) // preset.epochs_per_sync_committee_period
+                period = epoch // preset.epochs_per_sync_committee_period
+                if period == cur_period + 1:
+                    committee = st.next_sync_committee
+                elif period != cur_period:
+                    return self._err(400, "epoch outside stored periods")
+            idxs = sync_committee_validator_indices(st, preset, committee)
+            per_sub = preset.sync_subcommittee_size
+            aggs = [
+                [str(int(v)) for v in idxs[i:i + per_sub]]
+                for i in range(0, len(idxs), per_sub)
+            ]
+            return self._json({"data": {
+                "validators": [str(int(v)) for v in idxs],
+                "validator_aggregates": aggs,
+            }})
+
+        m = re.fullmatch(
             r"/eth/v1/beacon/states/([^/]+)/validator_balances", path)
         if m:
             st, _ = self._resolve_state(m.group(1))

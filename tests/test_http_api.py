@@ -251,3 +251,50 @@ def test_validator_balances_route(api):
         "/eth/v1/beacon/states/head/validator_balances",
         params={"id": pk})["data"]
     assert [d["index"] for d in by_pk] == ["2"]
+
+
+def test_sync_committees_route():
+    """Altair state serves its sync committee membership; a phase0 state
+    400s (spec: endpoint exists from altair)."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from lighthouse_tpu.types import ChainSpec, MinimalPreset
+
+    ALTAIR = ChainSpec(preset=MinimalPreset, altair_fork_epoch=0)
+    h = Harness(8, ALTAIR)
+    chain = BeaconChain(h.state.copy(), ALTAIR,
+                        verifier=SignatureVerifier("fake"))
+    server = BeaconApiServer(chain).start()
+    try:
+        url = (f"http://127.0.0.1:{server.port}"
+               "/eth/v1/beacon/states/head/sync_committees")
+        with urllib.request.urlopen(url, timeout=5) as r:
+            data = _json.loads(r.read())["data"]
+        size = MinimalPreset.sync_committee_size
+        assert len(data["validators"]) == size
+        assert all(v.isdigit() for v in data["validators"])
+        flat = [v for agg in data["validator_aggregates"] for v in agg]
+        assert flat == data["validators"]
+        sub = MinimalPreset.sync_subcommittee_size
+        assert all(len(a) <= sub for a in data["validator_aggregates"])
+    finally:
+        server.stop()
+
+    # pre-altair: spec-shaped 400, not a crash
+    PHASE0 = ChainSpec(preset=MinimalPreset)
+    h0 = Harness(8, PHASE0)
+    chain0 = BeaconChain(h0.state.copy(), PHASE0,
+                         verifier=SignatureVerifier("fake"))
+    server0 = BeaconApiServer(chain0).start()
+    try:
+        url0 = (f"http://127.0.0.1:{server0.port}"
+                "/eth/v1/beacon/states/head/sync_committees")
+        try:
+            urllib.request.urlopen(url0, timeout=5)
+            raise AssertionError("expected 400 for phase0 state")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        server0.stop()
