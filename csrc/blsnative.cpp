@@ -384,6 +384,15 @@ static inline void f2_conj(F2& r, const F2& x) {
 // Range argument: operands < 2p (the unreduced sums), so every wide
 // product < 4p^2 < p*R (4p < R since p < 2^382), which is exactly
 // redc_wide's contract; its output is < 2p, one conditional subtract.
+//
+// Why laziness STOPS at Fp2 here: extending it through f6_mul (delay
+// all 12 reductions to 6) needs signed wide intermediates with
+// magnitude up to ~4p^2 ~ 3.1*(p<<382); keeping them nonnegative for
+// REDC costs multiples of p<<382 of additive slack, and 4p^2 + 4p<<382
+// ~ 7.2*(p<<382) > p*R — the BLS12-381 prime leaves only ~2.3 bits of
+// Montgomery headroom, not enough for the fully-lazy sextic tower
+// without a wider R.  Measured upside was ~7%; not worth a redesign
+// of the reduction domain.
 
 static inline void _mul_wide(u64 t[12], const Fp& a, const Fp& b) {
     std::memset(t, 0, 12 * sizeof(u64));

@@ -99,6 +99,76 @@ class _Handler(JsonHandler):
                 return chain.store.get_state(root), root
         return None, None
 
+    @staticmethod
+    def _header_json(msg):
+        return {
+            "slot": str(int(msg.slot)),
+            "proposer_index": str(int(msg.proposer_index)),
+            "parent_root": _hex(msg.parent_root),
+            "state_root": _hex(msg.state_root),
+            "body_root": _hex(hash_tree_root(msg.body)),
+        }
+
+    def _pool_get(self, path):
+        """GET views of the operation pool + the EIP-4881 deposit
+        snapshot (http_api pool routes; ssz-hex payloads, the repo's
+        wire convention).  Returns None when the path is not one of the
+        handled GETs (the POST routes share these prefixes)."""
+        chain = self.chain
+        pool = chain.op_pool
+        from ..ssz import encode as _enc
+        from ..types.containers import (
+            AttesterSlashing,
+            ProposerSlashing,
+            SignedBLSToExecutionChange,
+            SignedVoluntaryExit,
+        )
+        from ..types.state import state_types
+
+        T = state_types(chain.preset)
+        if path == "/eth/v1/beacon/pool/attestations":
+            atts = [entry["att"] for entries in pool.attestations.values()
+                    for entry in entries]
+            self._json({"data": [
+                _hex(_enc(T.Attestation, a)) for a in atts]})
+            return True
+        if path == "/eth/v1/beacon/pool/attester_slashings":
+            self._json({"data": [
+                _hex(_enc(AttesterSlashing, s))
+                for s in pool.attester_slashings]})
+            return True
+        if path == "/eth/v1/beacon/pool/proposer_slashings":
+            self._json({"data": [
+                _hex(_enc(ProposerSlashing, s))
+                for s in pool.proposer_slashings.values()]})
+            return True
+        if path == "/eth/v1/beacon/pool/voluntary_exits":
+            self._json({"data": [
+                _hex(_enc(SignedVoluntaryExit, e))
+                for e in pool.voluntary_exits.values()]})
+            return True
+        if path == "/eth/v1/beacon/pool/bls_to_execution_changes":
+            self._json({"data": [
+                _hex(_enc(SignedBLSToExecutionChange, c))
+                for c in pool.bls_to_execution_changes.values()]})
+            return True
+        if path == "/eth/v1/beacon/deposit_snapshot":
+            eth1 = getattr(self.server, "eth1", None)
+            if eth1 is None or getattr(eth1, "deposit_tree", None) is None:
+                self._err(404, "no eth1 service attached")
+                return True
+            snap = eth1.deposit_tree.snapshot()
+            self._json({"data": {
+                "finalized": [_hex(b) for b in snap.finalized],
+                "deposit_root": _hex(snap.deposit_root),
+                "deposit_count": str(int(snap.deposit_count)),
+                "execution_block_hash": _hex(snap.execution_block_hash),
+                "execution_block_height": str(
+                    int(getattr(snap, "execution_block_height", 0))),
+            }})
+            return True
+        return False
+
     def _resolve_block_root(self, block_id):
         chain = self.chain
         if block_id == "head":
@@ -265,6 +335,11 @@ class _Handler(JsonHandler):
                     })
             return self._json({"data": data})
 
+        if path.startswith("/eth/v1/beacon/pool/") or \
+                path == "/eth/v1/beacon/deposit_snapshot":
+            if self._pool_get(path):
+                return
+
         m = re.fullmatch(
             r"/eth/v1/beacon/states/([^/]+)/sync_committees", path)
         if m:
@@ -405,19 +480,31 @@ class _Handler(JsonHandler):
                 }
             )
 
+        if path == "/eth/v1/beacon/headers":
+            # list form: the canonical head header, or the header at
+            # EXACTLY ?slot= (empty list for skipped slots — the
+            # at-or-before resolver serves block_id semantics, not this
+            # filter; review r5)
+            chain_ = self.chain
+            want_slot = int(q["slot"][0]) if "slot" in q else None
+            target = (self._canonical_root_at_slot(want_slot)
+                      if want_slot is not None else chain_.head_root)
+            blk = chain_.store.get_block(target) if target else None
+            if blk is None or (want_slot is not None
+                               and int(blk.message.slot) != want_slot):
+                return self._json({"data": []})
+            return self._json({"data": [{
+                "root": _hex(target),
+                "canonical": True,
+                "header": {"message": self._header_json(blk.message)},
+            }]})
+
         m = re.fullmatch(r"/eth/v1/beacon/headers/([^/]+)", path)
         if m:
             root = self._resolve_block_root(m.group(1))
             blk = chain.store.get_block(root) if root else None
             if blk is not None:
-                msg = blk.message
-                header = {
-                    "slot": str(int(msg.slot)),
-                    "proposer_index": str(int(msg.proposer_index)),
-                    "parent_root": _hex(msg.parent_root),
-                    "state_root": _hex(msg.state_root),
-                    "body_root": _hex(hash_tree_root(msg.body)),
-                }
+                header = self._header_json(blk.message)
             else:
                 # checkpoint/genesis anchors exist only as states — serve
                 # the state's latest_block_header (block_id.rs anchor case)

@@ -298,3 +298,95 @@ def test_sync_committees_route():
             assert e.code == 400
     finally:
         server0.stop()
+
+
+def test_pool_get_routes_and_deposit_snapshot():
+    """GET views of the op pool (ssz-hex) + EIP-4881 deposit snapshot."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from lighthouse_tpu.ssz import decode as _dec
+    from lighthouse_tpu.types import ChainSpec, MinimalPreset
+    from lighthouse_tpu.types.containers import SignedVoluntaryExit
+    from lighthouse_tpu.types.containers import VoluntaryExit
+
+    SPEC0 = ChainSpec(preset=MinimalPreset)
+    h = Harness(8, SPEC0)
+    chain = BeaconChain(h.state.copy(), SPEC0,
+                        verifier=SignatureVerifier("fake"))
+    exit_ = SignedVoluntaryExit(
+        message=VoluntaryExit(epoch=0, validator_index=3),
+        signature=b"\x00" * 96)
+    chain.op_pool.insert_voluntary_exit(exit_)
+    server = BeaconApiServer(chain).start()
+    base = f"http://127.0.0.1:{server.port}"
+
+    def get(p):
+        with urllib.request.urlopen(base + p, timeout=5) as r:
+            return _json.loads(r.read())["data"]
+
+    try:
+        exits = get("/eth/v1/beacon/pool/voluntary_exits")
+        assert len(exits) == 1
+        back = _dec(SignedVoluntaryExit, bytes.fromhex(exits[0][2:]))
+        assert int(back.message.validator_index) == 3
+        assert get("/eth/v1/beacon/pool/attester_slashings") == []
+        assert get("/eth/v1/beacon/pool/proposer_slashings") == []
+        assert get("/eth/v1/beacon/pool/bls_to_execution_changes") == []
+        # NON-empty attestation pool (review r5: the dict-entry shape)
+        att = h.attest_slot(chain.head_state, int(chain.head_state.slot),
+                            chain.head_root)[0]
+        chain.op_pool.insert_attestation(att)
+        from lighthouse_tpu.types.state import state_types as _st
+
+        T0 = _st(MinimalPreset)
+        pooled = get("/eth/v1/beacon/pool/attestations")
+        assert len(pooled) == 1
+        back_att = _dec(T0.Attestation, bytes.fromhex(pooled[0][2:]))
+        assert bytes(back_att.data.beacon_block_root) == bytes(
+            att.data.beacon_block_root)
+        # no eth1 service attached -> typed 404
+        try:
+            get("/eth/v1/beacon/deposit_snapshot")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        server.stop()
+
+    # with an eth1 service: a real EIP-4881 snapshot comes back
+    from lighthouse_tpu.eth1.deposit_tree import DepositTree
+
+    from lighthouse_tpu.types.containers import DepositData
+
+    class _Eth1:
+        deposit_tree = DepositTree()
+
+    for i in (1, 2):
+        _Eth1.deposit_tree.push(DepositData(
+            pubkey=bytes([i]) * 48, withdrawal_credentials=bytes(32),
+            amount=32, signature=bytes(96)))
+    server2 = BeaconApiServer(chain).start()
+    server2.server.eth1 = _Eth1()
+    base = f"http://127.0.0.1:{server2.port}"
+    try:
+        snap = get("/eth/v1/beacon/deposit_snapshot")
+        assert snap["deposit_count"] == "2"
+        assert snap["deposit_root"].startswith("0x")
+        assert len(snap["finalized"]) >= 1
+    finally:
+        server2.stop()
+
+
+def test_headers_list_route(api):
+    chain, client = api
+    data = client._get("/eth/v1/beacon/headers")["data"]
+    assert len(data) == 1 and data[0]["canonical"] is True
+    assert data[0]["root"] == "0x" + bytes(chain.head_root).hex()
+    at1 = client._get("/eth/v1/beacon/headers", params={"slot": "1"})["data"]
+    assert at1 and at1[0]["header"]["message"]["slot"] == "1"
+    # a slot with no block yields an EMPTY list, not the at-or-before hit
+    skipped = client._get("/eth/v1/beacon/headers",
+                          params={"slot": "9"})["data"]
+    assert skipped == []
