@@ -717,20 +717,35 @@ def main():
         note("config_native_error", error=str(e)[:300])
 
     def run_device_smoke_and_curve():
+        # the jax persistent-cache WRITE path has aborted the process
+        # intermittently after many in-process compiles (SIGABRT inside
+        # put_executable_and_time, observed r5 on the slow lane).  The
+        # primary and every host config are already recorded by the time
+        # the emulation stages run, so: stop writing new cache entries
+        # (reads stay warm) rather than risk the artifact's final line.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          10**9)
         nonlocal_primary = [None]
         try:
-            r = config0()
-            if r is not None:
-                nonlocal_primary[0] = r
-        except Exception as e:
-            note("config0_error", error=str(e)[:300])
-        try:
-            r = config_curve()     # the north-star device shape: curve
-            if r is not None and (nonlocal_primary[0] is None
-                                  or r > nonlocal_primary[0]):
-                nonlocal_primary[0] = r
-        except Exception as e:
-            note("curve_error", error=str(e)[:500])
+            try:
+                r = config0()
+                if r is not None:
+                    nonlocal_primary[0] = r
+            except Exception as e:
+                note("config0_error", error=str(e)[:300])
+            try:
+                r = config_curve()     # the north-star device shape: curve
+                if r is not None and (nonlocal_primary[0] is None
+                                      or r > nonlocal_primary[0]):
+                    nonlocal_primary[0] = r
+            except Exception as e:
+                note("curve_error", error=str(e)[:500])
+        finally:
+            # later stages (config_kernels / device config1/config4)
+            # cache their smaller compiles again (review r5: suppression
+            # must not leak past the big-kernel emulation stages)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.5)
         return nonlocal_primary[0]
 
     # Ordering is platform-aware (judge r5 items 1a + 3): with a live
