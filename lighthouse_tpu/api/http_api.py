@@ -5,17 +5,24 @@ the standard Beacon API routes the VC and tooling need, over the stdlib
 threading HTTP server (the reference uses warp; the route surface and
 JSON shapes follow the beacon-APIs spec):
 
-  GET  /eth/v1/node/health | /eth/v1/node/version
-  GET  /eth/v1/beacon/genesis
-  GET  /eth/v1/beacon/states/{state_id}/root
-  GET  /eth/v1/beacon/states/{state_id}/finality_checkpoints
-  GET  /eth/v1/beacon/states/{state_id}/validators/{validator_id}
-  GET  /eth/v1/beacon/headers/{block_id}
-  GET  /eth/v1/beacon/blocks/{block_id}/root
-  GET  /eth/v1/validator/duties/proposer/{epoch}
-  POST /eth/v1/validator/duties/attester/{epoch}
-  GET  /eth/v1/validator/attestation_data?slot=&committee_index=
-  GET  /metrics  (http_metrics/src/lib.rs:84 — Prometheus text)
+  node:      health, version, identity, peers, syncing
+  config:    fork_schedule, deposit_contract
+  beacon:    genesis; states/{id}/{root,finality_checkpoints,
+             validators/{vid},validator_balances,committees,
+             sync_committees}; headers[/{id}]; blocks/{id}[/root] (ssz);
+             pool/{attestations,attester_slashings,proposer_slashings,
+             voluntary_exits,bls_to_execution_changes,sync_committees}
+             (GET views + POST submit); deposit_snapshot (EIP-4881);
+             rewards/{attestations,blocks,sync_committee};
+             light_client/{updates,finality_update,optimistic_update};
+             blinded_blocks
+  validator: duties/{proposer,attester,sync}, attestation_data,
+             aggregate_attestation, aggregate_and_proofs,
+             sync_committee_contribution, contribution_and_proofs,
+             prepare_beacon_proposer, blocks/{slot} (produce)
+  events:    /eth/v1/events SSE stream
+  /metrics   (http_metrics/src/lib.rs:84 — Prometheus text)
+  /lighthouse/liveness
 
 `state_id`/`block_id` resolution: head | finalized | genesis | 0x<root> |
 <slot> (http_api block_id.rs/state_id.rs).
