@@ -95,10 +95,14 @@ class BeaconProcessor:
 
     def process_pending(self):
         """One manager pass: blocks first (they unblock attestations),
-        then ONE batched attestation verification, then reprocessing.
-        Returns the number of work items handled."""
+        then ONE batched aggregate verification, ONE batched attestation
+        verification, then reprocessing.  Returns the number of work
+        items handled.  Through a chain wired to the VerificationService
+        the two batches — and any concurrent caller's work (discovery,
+        light client, backfill) — coalesce into shared device passes."""
         handled = 0
         handled += self._drain_blocks()
+        handled += self._drain_aggregate_batch()
         handled += self._drain_attestation_batch()
         handled += self._retry_reprocess()
         return handled
@@ -137,6 +141,22 @@ class BeaconProcessor:
         results = self.chain.batch_verify_unaggregated_attestations(batch)
         for att, indexed, err in results:
             self.results.append(("attestation", err is None, err))
+        return len(batch)
+
+    def _drain_aggregate_batch(self):
+        """Aggregates drain LIFO like unaggregated attestations (newest
+        matter most) into one batched verification (each item is a 3-set
+        group; attestation_verification/batch.rs:31-134)."""
+        batch = []
+        with self._lock:
+            while self.aggregate_queue and len(batch) < self.attestation_batch_size:
+                batch.append(self.aggregate_queue.pop().payload)
+        if not batch:
+            return 0
+        BATCHES_ASSEMBLED.inc()
+        results = self.chain.batch_verify_aggregated_attestations(batch)
+        for sa, indexed, err in results:
+            self.results.append(("aggregate", err is None, err))
         return len(batch)
 
     def _retry_reprocess(self):

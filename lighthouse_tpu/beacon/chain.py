@@ -22,6 +22,7 @@ The chain is device-backend-generic via crypto.backend.SignatureVerifier
 import logging
 
 from ..crypto.backend import SignatureVerifier
+from ..verify_service import verify_with_verdicts
 from ..fork_choice.fork_choice import ForkChoice, InvalidAttestation
 from ..operation_pool.pool import OperationPool
 from ..ssz import hash_tree_root
@@ -234,7 +235,7 @@ class BeaconChain:
             )
         except sset.SignatureSetError as e:
             raise BlockError(f"undecodable proposer signature: {e}") from e
-        if not self.verifier.verify_signature_sets([s]):
+        if not self.verifier.verify_signature_sets([s], priority="block"):
             raise BlockError("invalid proposer signature")
 
         self.observed_block_producers.add(key)
@@ -375,7 +376,7 @@ class BeaconChain:
                 raise BlockError(f"undecodable signature in block: {e}") from e
             except (AssertionError, phase0.BlockProcessingError) as e:
                 raise BlockError(f"invalid block: {e}") from e
-            if not self.verifier.verify_signature_sets(sets):
+            if not self.verifier.verify_signature_sets(sets, priority="block"):
                 raise BlockError("bulk signature verification failed")
         sv = SignatureVerifiedBlock(gossip_verified)
         sv.post_state = state
@@ -493,7 +494,7 @@ class BeaconChain:
                 raise BlockError(f"invalid block in segment: {e}") from e
             states.append(state.copy())
         with metrics.BLOCK_SIGNATURE_VERIFY_TIMES.start_timer():
-            if not self.verifier.verify_signature_sets(sets):
+            if not self.verifier.verify_signature_sets(sets, priority="block"):
                 raise BlockError("segment bulk signature verification failed")
         roots = []
         for sb, post_state in zip(blocks, states):
@@ -568,11 +569,12 @@ class BeaconChain:
 
         if sets:
             with metrics.ATTESTATION_BATCH_VERIFY_TIMES.start_timer():
-                ok = self.verifier.verify_signature_sets(sets)
+                ok, verdicts = verify_with_verdicts(
+                    self.verifier, sets, priority="attestation"
+                )
             if not ok:
-                # poisoned batch: per-set verdicts in one extra pass
+                # poisoned batch: per-set verdicts from ONE extra pass
                 # (batch.rs:210-219 does N CPU re-verifications instead)
-                verdicts = self.verifier.verify_signature_sets_per_set(sets)
                 for owner, good in zip(set_owners, verdicts):
                     if not good:
                         results[owner][1] = None
@@ -660,9 +662,10 @@ class BeaconChain:
 
         if sets:
             with metrics.ATTESTATION_BATCH_VERIFY_TIMES.start_timer():
-                ok = self.verifier.verify_signature_sets(sets)
+                ok, verdicts = verify_with_verdicts(
+                    self.verifier, sets, priority="aggregate"
+                )
             if not ok:
-                verdicts = self.verifier.verify_signature_sets_per_set(sets)
                 for owner, start, count in owners:
                     if not all(verdicts[start : start + count]):
                         results[owner][1] = None
@@ -828,7 +831,7 @@ class BeaconChain:
             state.genesis_validators_root,
             self.spec,
         )
-        if not self.verifier.verify_signature_sets([s]):
+        if not self.verifier.verify_signature_sets([s], priority="attestation"):
             raise AttestationError("invalid sync message signature")
         self.observed_sync_contributors.add(key)
         self.sync_pool.insert_message(message, committee_indices)
@@ -876,9 +879,10 @@ class BeaconChain:
             owners.append(len(results) - 1)
             sets.append(s)
         if sets:
-            ok = self.verifier.verify_signature_sets(sets)
+            ok, verdicts = verify_with_verdicts(
+                self.verifier, sets, priority="attestation"
+            )
             if not ok:
-                verdicts = self.verifier.verify_signature_sets_per_set(sets)
                 for owner, good in zip(owners, verdicts):
                     if not good:
                         results[owner][1] = AttestationError("invalid signature")
@@ -963,7 +967,7 @@ class BeaconChain:
         sets, key, insert_args = self._sync_contribution_checks(
             signed_contribution, state, committee_indices
         )
-        if not self.verifier.verify_signature_sets(sets):
+        if not self.verifier.verify_signature_sets(sets, priority="aggregate"):
             raise AttestationError("sync contribution verification failed")
         self.observed_sync_aggregators.add(key)
         # fold the contribution into the block-production pool at its
@@ -1004,9 +1008,17 @@ class BeaconChain:
             groups.append((len(results) - 1, sets, key, insert_args))
         if groups:
             all_sets = [s for _, sets, _, _ in groups for s in sets]
-            if not self.verifier.verify_signature_sets(all_sets):
+            ok, verdicts = verify_with_verdicts(
+                self.verifier, all_sets, priority="aggregate"
+            )
+            if not ok:
+                # attribute from the verdicts the failed batch already
+                # computed — no per-group re-verification
+                pos = 0
                 for owner, sets, _, _ in groups:
-                    if not self.verifier.verify_signature_sets(sets):
+                    good = all(verdicts[pos:pos + len(sets)])
+                    pos += len(sets)
+                    if not good:
                         results[owner][1] = AttestationError(
                             "sync contribution verification failed"
                         )

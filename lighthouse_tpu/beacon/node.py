@@ -39,6 +39,11 @@ class BeaconNode:
     def start(self):
         if self.api_server is not None:
             self.api_server.start()
+        # the verification dispatcher runs supervised like every other
+        # service loop (it would also lazy-start on first submit)
+        verifier = self.chain.verifier
+        if hasattr(verifier, "start") and hasattr(verifier, "submit"):
+            verifier.start(self.executor)
         self.executor.spawn(self._timer_loop, "slot_timer")
         self.executor.spawn(self.processor.run, "beacon_processor")
         self.executor.spawn(self._notifier_loop, "notifier", critical=False)
@@ -48,6 +53,9 @@ class BeaconNode:
 
     def stop(self):
         self.executor.shutdown("node stop")
+        stop_verify = getattr(self.chain.verifier, "stop", None)
+        if stop_verify is not None:
+            stop_verify()
         if self.wire is not None:
             self.wire.stop()
         if self.discovery is not None:
@@ -228,11 +236,18 @@ class ClientBuilder:
 
     def build(self) -> BeaconNode:
         assert self._genesis_state is not None, "a genesis/checkpoint state is required"
+        from ..verify_service import VerificationService
+
+        # ONE process-wide dispatcher in front of the backend seam: the
+        # chain, processor, router backfill, discovery, and light-client
+        # paths all submit here, so their small batches coalesce into
+        # device-sized passes (continuous batching across callers)
+        verify_service = VerificationService(SignatureVerifier(self._backend))
         chain = BeaconChain(
             self._genesis_state,
             self.spec,
             store=self._store,
-            verifier=SignatureVerifier(self._backend),
+            verifier=verify_service,
         )
         if self._slasher:
             from ..slasher import Slasher

@@ -203,10 +203,16 @@ _META = b"meta:"
 SCHEMA_VERSION = 2
 
 
+# high bit of the fork-id byte marks a payload-pruned (blinded) block
+# record: the execution payload was replaced by its header (`lighthouse
+# db prune-payloads` role).  hash_tree_root is unchanged by construction.
+_BLINDED_FID = 0x80
+
+
 class _Codec:
     """Fork-aware SSZ (de)serialization for blocks and states (the
     reference's multi-fork container-enum dispatch, one id byte on disk:
-    0=phase0 1=altair 2=bellatrix 3=capella)."""
+    0=phase0 1=altair 2=bellatrix 3=capella; |0x80 = payload pruned)."""
 
     def __init__(self, preset):
         self.T = state_types(preset)
@@ -287,11 +293,61 @@ class _Codec:
         return 0
 
     def enc_block(self, signed_block):
+        # payload-pruned history decodes to BLINDED containers; every
+        # re-encode path (wire BlocksByRange/Root, http SSZ, put_block)
+        # must round-trip them — the flagged fid keeps dec_block exact
+        if hasattr(signed_block.message.body, "execution_payload_header"):
+            return self.enc_pruned_block(signed_block)
         fid = self._block_fid(signed_block)
         return bytes([fid]) + encode(self._block_cls[fid], signed_block)
 
     def dec_block(self, blob):
+        if blob[0] & _BLINDED_FID:
+            return self.dec_blinded(bytes([blob[0] & ~_BLINDED_FID]) + blob[1:])
         return decode(self._block_cls[blob[0]], blob[1:])
+
+    def blind_block(self, signed_block):
+        """Full -> blinded signed block: the payload header replaces the
+        payload, every other body field carried over.  Root-preserving
+        (SSZ: hash_tree_root(header) == hash_tree_root(payload))."""
+        from ..state_processing.bellatrix import payload_to_header
+
+        T = self.T
+        body = signed_block.message.body
+        fid = self.body_fid(body)
+        body_cls = {
+            2: T.BeaconBlockBodyBlindedBellatrix,
+            3: T.BeaconBlockBodyBlindedCapella,
+        }[fid]
+        kwargs = {}
+        for name, _typ in body_cls.fields:
+            if name == "execution_payload_header":
+                kwargs[name] = payload_to_header(body.execution_payload, T)
+            else:
+                kwargs[name] = getattr(body, name)
+        msg = signed_block.message
+        blk_cls = {
+            2: T.BlindedBeaconBlockBellatrix, 3: T.BlindedBeaconBlockCapella,
+        }[fid]
+        signed_cls = {
+            2: T.SignedBlindedBeaconBlockBellatrix,
+            3: T.SignedBlindedBeaconBlockCapella,
+        }[fid]
+        return signed_cls(
+            message=blk_cls(
+                slot=msg.slot,
+                proposer_index=msg.proposer_index,
+                parent_root=msg.parent_root,
+                state_root=msg.state_root,
+                body=body_cls(**kwargs),
+            ),
+            signature=signed_block.signature,
+        )
+
+    def enc_pruned_block(self, signed_blinded):
+        fid = self._block_fid(signed_blinded)
+        cls = self.signed_cls_for_body(signed_blinded.message.body)
+        return bytes([fid | _BLINDED_FID]) + encode(cls, signed_blinded)
 
     def enc_blinded(self, signed_blinded):
         fid = self._block_fid(signed_blinded)
@@ -505,6 +561,33 @@ class HotColdStore:
         self.put_meta("split_slot", finalized_slot)
         if hasattr(self.kv, "compact"):
             self.kv.compact()
+
+    def prune_payloads(self, before_slot=None):
+        """`lighthouse db prune-payloads`: replace finalized blocks'
+        execution payloads with their headers (blinded form, same block
+        root).  Only blocks at/below `before_slot` (default: the hot/cold
+        split, i.e. finalized history) are pruned.  Pruned ranges can no
+        longer serve full payloads or replay execution-dependent STF —
+        the same trade the reference makes.  Returns the pruned count."""
+        limit = self.split_slot if before_slot is None else int(before_slot)
+        pruned = 0
+        for key in self.kv.keys_with_prefix(_BLOCK):
+            blob = self.kv.get(key)
+            # the fid byte answers "already pruned?" and "pre-bellatrix?"
+            # without decoding — on a long phase0/altair history that IS
+            # the cost of this command
+            if blob is None or blob[0] & _BLINDED_FID or blob[0] < 2:
+                continue
+            sb = self.codec.dec_block(blob)
+            if int(sb.message.slot) > limit:
+                continue
+            if not hasattr(sb.message.body, "execution_payload"):
+                continue  # blinded-at-write (builder path): nothing to do
+            self.kv.put(
+                key, self.codec.enc_pruned_block(self.codec.blind_block(sb))
+            )
+            pruned += 1
+        return pruned
 
     # ------------------------------------------------------ reconstruction
 

@@ -111,6 +111,22 @@ def build_parser_with_subs():
     new.add_argument("--password", required=True)
     slp = am_sub.add_parser("slashing-protection-export")
     slp.add_argument("--db", required=True)
+    ex = am_sub.add_parser(
+        "validator-exit",
+        help="build and sign a voluntary exit for a keystore validator "
+             "(account_manager validator exit; offline — publish the "
+             "printed SignedVoluntaryExit via any BN)",
+    )
+    ex.add_argument("--keystore", required=True,
+                    help="path to the validator's EIP-2335 keystore JSON")
+    ex.add_argument("--password", required=True)
+    ex.add_argument("--validator-index", type=int, required=True)
+    ex.add_argument("--epoch", type=int, required=True,
+                    help="exit epoch signed into the message")
+    ex.add_argument("--genesis-validators-root", required=True,
+                    metavar="0xROOT",
+                    help="the chain's genesis_validators_root (domain "
+                         "separation; from /eth/v1/beacon/genesis)")
 
     db = sub.add_parser("db", help="database manager")
     _add_common(db)
@@ -119,6 +135,20 @@ def build_parser_with_subs():
     insp.add_argument("--datadir", default="./datadir")
     comp = db_sub.add_parser("compact")
     comp.add_argument("--datadir", default="./datadir")
+    ver = db_sub.add_parser(
+        "version", help="print the datadir's on-disk schema version stamp"
+    )
+    ver.add_argument("--datadir", default="./datadir")
+    pp = db_sub.add_parser(
+        "prune-payloads",
+        help="replace finalized blocks' execution payloads with their "
+             "headers (root-preserving; pruned history cannot serve full "
+             "payloads afterwards)",
+    )
+    pp.add_argument("--datadir", default="./datadir")
+    pp.add_argument("--before-slot", type=int, default=None,
+                    help="prune at/below this slot (default: the hot/cold "
+                         "split slot, i.e. finalized history)")
 
     lcli = sub.add_parser("lcli", help="dev/bench tools (lcli analogue)")
     _add_common(lcli)
@@ -481,13 +511,56 @@ def _run_am(args):
         db = SlashingDatabase(args.db)
         print(db.export_json())
         return 0
+    if args.am_command == "validator-exit":
+        # create_signed_voluntary_exit through the EXISTING signing path
+        # (ValidatorStore.sign_voluntary_exit -> LocalKeystore), not a
+        # bespoke one — the same code the VC keymanager route runs
+        from .ssz import encode
+        from .types import SignedVoluntaryExit, VoluntaryExit
+        from .validator_client.validator_store import ValidatorStore
+
+        spec = _spec_from_args(args)
+        try:
+            gvr = bytes.fromhex(
+                args.genesis_validators_root.removeprefix("0x")
+            )
+        except ValueError:
+            gvr = b""
+        if len(gvr) != 32:
+            print("--genesis-validators-root must be 32 bytes of hex",
+                  file=sys.stderr)
+            return 1
+        try:
+            ks = keys.load_keystore(args.keystore)
+            sk = keys.decrypt_keystore(ks, args.password)
+        except Exception as e:
+            print(f"cannot unlock keystore: {e}", file=sys.stderr)
+            return 1
+        store = ValidatorStore(spec)
+        pk = store.add_validator(sk)
+        exit_msg = VoluntaryExit(
+            epoch=args.epoch, validator_index=args.validator_index
+        )
+        sig = store.sign_voluntary_exit(
+            pk, exit_msg, spec.fork_at_epoch(args.epoch), gvr
+        )
+        signed = SignedVoluntaryExit(message=exit_msg, signature=bytes(sig))
+        print(json.dumps({
+            "message": {
+                "epoch": str(args.epoch),
+                "validator_index": str(args.validator_index),
+            },
+            "signature": "0x" + bytes(sig).hex(),
+            "ssz": "0x" + encode(SignedVoluntaryExit, signed).hex(),
+        }))
+        return 0
     return 2
 
 
 def _run_db(args):
     import os
 
-    from .beacon.store import FileKV, HotColdStore
+    from .beacon.store import SCHEMA_VERSION, FileKV, HotColdStore
 
     spec = _spec_from_args(args)
     path = os.path.join(args.datadir, "chain.db")
@@ -504,6 +577,18 @@ def _run_db(args):
     elif args.db_command == "compact":
         kv.compact()
         print(json.dumps({"compacted": path}))
+    elif args.db_command == "version":
+        # opening above already ran the stepwise migrations, so the
+        # stored stamp equals the build's unless the open refused
+        print(json.dumps({
+            "schema_version": store.get_meta("schema_version"),
+            "build_schema_version": SCHEMA_VERSION,
+        }))
+    elif args.db_command == "prune-payloads":
+        n = store.prune_payloads(before_slot=args.before_slot)
+        if hasattr(kv, "compact"):
+            kv.compact()   # reclaim the dropped payload bytes now
+        print(json.dumps({"pruned_payloads": n, "datadir": path}))
     store.close()
     return 0
 

@@ -96,8 +96,23 @@ class SignatureVerifier:
             backend = resolve_auto()
         self.backend = backend
         self.fallback = fallback
+        # verify_service circuit-breaker seam: called with the exception
+        # whenever a device attempt degrades to the host path
+        self.on_device_fallback = None
 
-    def verify_signature_sets(self, sets) -> bool:
+    def _note_device_fallback(self, e):
+        metrics.DEVICE_FALLBACKS.inc()
+        cb = self.on_device_fallback
+        if cb is not None:
+            try:
+                cb(e)
+            except Exception:
+                pass
+
+    def verify_signature_sets(self, sets, priority=None) -> bool:
+        # `priority` is accepted (and ignored) so call sites can tag work
+        # for the verify_service drop-in without caring which seam they
+        # hold — the service honors it, the bare verifier does not.
         sets = list(sets)
         if self.backend == "fake":
             return True
@@ -110,7 +125,7 @@ class SignatureVerifier:
             except Exception as e:  # device/compile failure — degrade
                 if not self.fallback:
                     raise
-                metrics.DEVICE_FALLBACKS.inc()
+                self._note_device_fallback(e)
                 log.warning("TPU verify failed (%s); host fallback", e)
             return _host_verify(sets)
         if self.backend == "native":
@@ -127,7 +142,7 @@ class SignatureVerifier:
 
         return RB.verify_signature_sets(sets)
 
-    def verify_signature_sets_per_set(self, sets) -> list:
+    def verify_signature_sets_per_set(self, sets, priority=None) -> list:
         sets = list(sets)
         if self.backend == "fake":
             return [True] * len(sets)
@@ -139,7 +154,7 @@ class SignatureVerifier:
             except Exception as e:
                 if not self.fallback:
                     raise
-                metrics.DEVICE_FALLBACKS.inc()
+                self._note_device_fallback(e)
                 log.warning("TPU per-set verify failed (%s); host fallback", e)
             return _host_per_set(sets)
         if self.backend == "native":

@@ -101,11 +101,128 @@ def validator_keypairs_from_seed(seed: bytes, n: int):
 
 
 def _aes128ctr(key16: bytes, iv16: bytes, data: bytes) -> bytes:
-    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+    try:
+        from cryptography.hazmat.primitives.ciphers import (
+            Cipher, algorithms, modes,
+        )
+    except ImportError:
+        # the container may not ship the `cryptography` wheel; keystores
+        # must still open (the VC cannot run otherwise) — fall back to
+        # the pure-Python AES below (FIPS-197-vector-checked on first use)
+        return _aes128ctr_py(key16, iv16, data)
 
     cipher = Cipher(algorithms.AES(key16), modes.CTR(iv16))
     enc = cipher.encryptor()
     return enc.update(data) + enc.finalize()
+
+
+# ------------------------------------------------ pure-Python AES-128-CTR
+# (fallback when the `cryptography` wheel is absent.  CTR mode needs only
+# block ENCRYPTION; keystore payloads are 32 bytes, so speed is moot.)
+
+_AES_SBOX = None
+
+
+def _aes_sbox():
+    global _AES_SBOX
+    if _AES_SBOX is not None:
+        return _AES_SBOX
+    # generate the S-box from GF(2^8) inverses + the affine transform
+    # (FIPS-197 §5.1.1) instead of embedding a 256-entry magic table
+    p, q, sbox = 1, 1, [0] * 256
+    first = True
+    while p != 1 or first:
+        first = False
+        p = (p ^ (p << 1) ^ (0x1B if p & 0x80 else 0)) & 0xFF  # * 0x03
+        q ^= q << 1
+        q ^= q << 2
+        q ^= q << 4
+        q &= 0xFF
+        if q & 0x80:
+            q ^= 0x09  # / 0x03 (i.e. * f6^-1 in the generator walk)
+        x = q ^ ((q << 1) | (q >> 7)) ^ ((q << 2) | (q >> 6)) \
+            ^ ((q << 3) | (q >> 5)) ^ ((q << 4) | (q >> 4))
+        sbox[p] = (x & 0xFF) ^ 0x63
+    sbox[0] = 0x63
+    _AES_SBOX = sbox
+    return sbox
+
+
+def _aes_expand_key(key16: bytes):
+    sbox = _aes_sbox()
+    w = [list(key16[i:i + 4]) for i in range(0, 16, 4)]
+    rcon = 1
+    for i in range(4, 44):
+        t = list(w[i - 1])
+        if i % 4 == 0:
+            t = t[1:] + t[:1]                    # RotWord
+            t = [sbox[b] for b in t]             # SubWord
+            t[0] ^= rcon
+            rcon = (rcon << 1) ^ (0x11B if rcon & 0x80 else 0)
+            rcon &= 0xFF
+        w.append([a ^ b for a, b in zip(w[i - 4], t)])
+    return w
+
+
+def _aes_encrypt_block(block16: bytes, w) -> bytes:
+    sbox = _aes_sbox()
+
+    def xt(a):
+        return ((a << 1) ^ 0x1B) & 0xFF if a & 0x80 else a << 1
+
+    # state[r + 4c] = in[r + 4c] column-major (FIPS-197 §3.4)
+    s = list(block16)
+
+    def add_round_key(rnd):
+        for c in range(4):
+            for r in range(4):
+                s[4 * c + r] ^= w[4 * rnd + c][r]
+
+    add_round_key(0)
+    for rnd in range(1, 11):
+        s[:] = [sbox[b] for b in s]                       # SubBytes
+        for r in range(1, 4):                             # ShiftRows
+            row = [s[4 * c + r] for c in range(4)]
+            row = row[r:] + row[:r]
+            for c in range(4):
+                s[4 * c + r] = row[c]
+        if rnd != 10:                                     # MixColumns
+            for c in range(4):
+                a = s[4 * c:4 * c + 4]
+                s[4 * c + 0] = xt(a[0]) ^ xt(a[1]) ^ a[1] ^ a[2] ^ a[3]
+                s[4 * c + 1] = a[0] ^ xt(a[1]) ^ xt(a[2]) ^ a[2] ^ a[3]
+                s[4 * c + 2] = a[0] ^ a[1] ^ xt(a[2]) ^ xt(a[3]) ^ a[3]
+                s[4 * c + 3] = xt(a[0]) ^ a[0] ^ a[1] ^ a[2] ^ xt(a[3])
+        add_round_key(rnd)
+    return bytes(s)
+
+
+_AES_SELF_TESTED = False
+
+
+def _aes128ctr_py(key16: bytes, iv16: bytes, data: bytes) -> bytes:
+    global _AES_SELF_TESTED
+    if not _AES_SELF_TESTED:
+        # FIPS-197 appendix C.1 known answer — a silently-wrong cipher
+        # would write keystores no other client can open
+        kat = _aes_encrypt_block(
+            bytes.fromhex("00112233445566778899aabbccddeeff"),
+            _aes_expand_key(bytes.fromhex("000102030405060708090a0b0c0d0e0f")),
+        )
+        assert kat == bytes.fromhex("69c4e0d86a7b0430d8cdb78070b4c55a"), \
+            "pure-Python AES self-test failed"
+        _AES_SELF_TESTED = True
+    w = _aes_expand_key(key16)
+    counter = int.from_bytes(iv16, "big")
+    out = bytearray()
+    for i in range(0, len(data), 16):
+        ks = _aes_encrypt_block(
+            (counter & ((1 << 128) - 1)).to_bytes(16, "big"), w
+        )
+        chunk = data[i:i + 16]
+        out += bytes(a ^ b for a, b in zip(chunk, ks))
+        counter += 1
+    return bytes(out)
 
 
 def _scrypt(password: bytes, salt: bytes, n, r, p, dklen):

@@ -131,6 +131,6 @@ def verify_bid(signed_bid, spec, verifier, parent_hash=None):
         s = SignatureSet(_sig(bytes(signed_bid.signature)), [pk], root)
     except Exception as e:
         raise BuilderError(f"undecodable bid: {e}") from e
-    if not verifier.verify_signature_sets([s]):
+    if not verifier.verify_signature_sets([s], priority="block"):
         raise BuilderError("invalid builder bid signature")
     return bid

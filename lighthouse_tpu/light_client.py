@@ -354,7 +354,9 @@ class LightClientStore:
             pubkeys, sync_aggregate, prev_slot,
             hash_tree_root(attested_header), fork, gvr, self.spec,
         )
-        if s is not None and not self.verifier.verify_signature_sets([s]):
+        if s is not None and not self.verifier.verify_signature_sets(
+            [s], priority="light_client"
+        ):
             raise LightClientError("invalid sync aggregate signature")
 
     # -- update processing

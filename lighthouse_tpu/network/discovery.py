@@ -199,10 +199,12 @@ def verify_records(records, verifier=None, cache=None):
                 sets.append(
                     RB.SignatureSet(sig, [pk] if pk else [], r._signed_content())
                 )
-            if verifier.verify_signature_sets(sets):
-                fresh = [True] * len(todo)
-            else:
-                fresh = list(verifier.verify_signature_sets_per_set(sets))
+            from ..verify_service import verify_with_verdicts
+
+            ok, verdicts = verify_with_verdicts(
+                verifier, sets, priority="discovery"
+            )
+            fresh = [True] * len(todo) if ok else list(verdicts)
         for i, v in zip(todo, fresh):
             out[i] = bool(v)
             if len(cache) >= _VERIFY_CACHE_MAX:

@@ -21,6 +21,8 @@ class Router:
         self.reqresp = reqresp
         bus.subscribe(peer_id, GossipKind.BEACON_BLOCK, self._on_block)
         bus.subscribe(peer_id, GossipKind.ATTESTATION, self._on_attestation)
+        bus.subscribe(peer_id, GossipKind.AGGREGATE_AND_PROOF,
+                      self._on_aggregate)
         reqresp.register(peer_id, chain)
 
     # ------------------------------------------------------- gossip in
@@ -32,6 +34,9 @@ class Router:
 
     def _on_attestation(self, from_peer, attestation):
         self.processor.enqueue_attestation(attestation)
+
+    def _on_aggregate(self, from_peer, signed_aggregate):
+        self.processor.enqueue_aggregate(signed_aggregate)
 
     # ------------------------------------------------------ gossip out
 
@@ -103,7 +108,7 @@ class Router:
                     continue
                 if verify_signatures and int(b.message.slot) > 0:
                     if not chain.verifier.verify_signature_sets(
-                        [proposal_set(b)]
+                        [proposal_set(b)], priority="block"
                     ):
                         raise ValueError("anchor block signature invalid")
                 chain.store.put_block(chain.genesis_root, b)
@@ -130,7 +135,9 @@ class Router:
                 expected_parent = bytes(b.message.parent_root)
                 if verify_signatures and int(b.message.slot) > 0:
                     sets.append(proposal_set(b))
-            if sets and not chain.verifier.verify_signature_sets(sets):
+            if sets and not chain.verifier.verify_signature_sets(
+                sets, priority="block"
+            ):
                 raise ValueError("backfill signature batch failed")
             for b in blocks:
                 chain.store.put_block(hash_tree_root(b.message), b)

@@ -137,6 +137,14 @@ class PeerRateLimited(WireError):
 _uvarint = snappy.uvarint_encode
 
 
+def _payload_pruned(signed_block):
+    """True for payload-pruned (blinded-on-disk) history.  Serving such a
+    block over req/resp would crash the syncing peer's STF on the missing
+    payload — refuse (the reference's resource-unavailable response) and
+    let the peer fill the range from an unpruned node instead."""
+    return hasattr(signed_block.message.body, "execution_payload_header")
+
+
 def _read_exact(sock, n):
     buf = bytearray()
     while len(buf) < n:
@@ -1172,7 +1180,7 @@ class WireNode:
             out = []
             for r in roots:
                 b = self.chain.store.get_block(r)
-                if b is not None:
+                if b is not None and not _payload_pruned(b):
                     out.append(self.codec._block_codec.enc_block(b))
             return out
         if method == M_BLOCKS_BY_RANGE:
@@ -1196,6 +1204,12 @@ class WireNode:
                 if slot < start + count:
                     blocks[slot] = b
                 root = bytes(b.message.parent_root)
+            if any(_payload_pruned(b) for b in blocks.values()):
+                # refuse the WHOLE range: silently omitting pruned slots
+                # would hand the peer a gappy response indistinguishable
+                # from empty slots, and its backfill linkage check would
+                # abort against an honest node
+                raise WireError("range covers payload-pruned history")
             return [
                 self.codec._block_codec.enc_block(blocks[s])
                 for s in sorted(blocks)

@@ -34,7 +34,9 @@ def _verify(sets, verifier):
         from ..crypto.ref.bls import verify_signature_sets as v
 
         return v(sets)
-    return verifier.verify_signature_sets(sets)
+    # pool operations ride the lowest verify_service class: they are
+    # gossip-rate background work, never on the block-import critical path
+    return verifier.verify_signature_sets(sets, priority="discovery")
 
 
 def verify_proposer_slashing(slashing, state, spec, verifier=None):

@@ -1,0 +1,50 @@
+"""verify_service: the process-wide continuous-batching verification
+dispatcher.
+
+Every call path that used to invoke the `SignatureVerifier` seam
+synchronously with its own small batch — gossip router, discovery,
+light client, block import, BeaconProcessor — instead submits
+`SignatureSet` work here.  The service coalesces requests *across
+callers* into device-sized micro-batches (the continuous-batching shape
+every inference-serving stack uses), so gossip attestations arriving
+from many peers land in ONE device pass instead of N tiny ones.
+
+Pieces:
+  * `VerificationService.submit(sets, priority, deadline) -> VerifyFuture`
+    plus blocking `verify_signature_sets(...)` compat wrappers that make
+    the service a drop-in `SignatureVerifier`
+  * priority classes (block > aggregate > attestation >
+    discovery/light-client) with bounded per-class queues and admission
+    control (`QueueFullError`)
+  * a dispatcher loop (runs under `utils/task_executor.py` in the node,
+    or a lazy daemon thread standalone) forming deadline-aware
+    micro-batches: dispatch when the batch reaches the target size OR
+    the oldest request's deadline nears
+  * poisoned-batch attribution through the existing per-set-verdict path
+    (crypto/tpu/bls.py verify_signature_sets_per_set) so only the
+    poisoner's future fails
+  * a circuit breaker pinning the service to the host path after
+    consecutive device failures (extends the device→native→oracle chain
+    in crypto/backend.py via the `on_device_fallback` hook)
+  * Prometheus metrics via utils/metrics.py (verify_service/metrics.py)
+"""
+
+from .circuit import CircuitBreaker
+from .service import (
+    PRIORITY_CLASSES,
+    QueueFullError,
+    ServiceStopped,
+    VerificationService,
+    VerifyFuture,
+    verify_with_verdicts,
+)
+
+__all__ = [
+    "CircuitBreaker",
+    "PRIORITY_CLASSES",
+    "QueueFullError",
+    "ServiceStopped",
+    "VerificationService",
+    "VerifyFuture",
+    "verify_with_verdicts",
+]

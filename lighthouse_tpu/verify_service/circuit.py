@@ -1,0 +1,60 @@
+"""Device circuit breaker: consecutive-failure trip with cooldown.
+
+Extends the per-call device→native→oracle fallback chain in
+crypto/backend.py with process-level health memory: one dead-tunnel jit
+already degrades that single call, but every subsequent call would still
+pay the device attempt (a hang-then-timeout each time).  The breaker
+counts consecutive device failures and pins the service to the host path
+for a cooldown, then lets one probe batch through (half-open) before
+closing again.
+"""
+
+import time
+
+from . import metrics as M
+
+CLOSED = 0      # device healthy, dispatch normally
+OPEN = 1        # pinned to host path until cooldown elapses
+HALF_OPEN = 2   # cooldown over: one probe batch decides
+
+
+class CircuitBreaker:
+    """Single-dispatcher-thread breaker (no internal locking: only the
+    service's dispatcher loop drives it)."""
+
+    def __init__(self, threshold=3, cooldown=30.0, clock=time.monotonic):
+        self.threshold = max(1, int(threshold))
+        self.cooldown = float(cooldown)
+        self._clock = clock
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self.opened_at = None
+        M.CIRCUIT_STATE.set(CLOSED)
+
+    def _set_state(self, state):
+        self.state = state
+        M.CIRCUIT_STATE.set(state)
+
+    def allow_device(self) -> bool:
+        """Should the next batch try the device path?"""
+        if self.state == CLOSED:
+            return True
+        if self.state == OPEN:
+            if self._clock() - self.opened_at >= self.cooldown:
+                self._set_state(HALF_OPEN)
+                return True
+            return False
+        return True  # HALF_OPEN: the probe batch is in flight
+
+    def record_failure(self):
+        self.consecutive_failures += 1
+        if self.state == HALF_OPEN or self.consecutive_failures >= self.threshold:
+            if self.state != OPEN:
+                M.CIRCUIT_TRIPS.inc()
+            self._set_state(OPEN)
+            self.opened_at = self._clock()
+
+    def record_success(self):
+        self.consecutive_failures = 0
+        if self.state != CLOSED:
+            self._set_state(CLOSED)

@@ -1,0 +1,63 @@
+"""verify_service metrics, registered in the process-global registry
+(utils/metrics.py) so the http_metrics endpoint serves them directly.
+
+Names follow the beacon_chain/src/metrics.rs convention; the batch-size
+histogram buckets are set counts (not seconds) so the exposition shows
+the coalescing distribution directly.
+"""
+
+from ..utils import metrics
+
+# batch sizes are counts of signature sets, bucketed at powers of two up
+# to the device chunk ceiling
+SET_COUNT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+QUEUE_DEPTH = {}
+
+
+def queue_depth_gauge(cls_name):
+    g = QUEUE_DEPTH.get(cls_name)
+    if g is None:
+        g = metrics.gauge(
+            f"verify_service_queue_depth_{cls_name}",
+            f"Pending verification requests in the {cls_name} class queue",
+        )
+        QUEUE_DEPTH[cls_name] = g
+    return g
+
+
+BATCH_SETS = metrics.histogram(
+    "verify_service_batch_sets",
+    "Signature sets per dispatched micro-batch",
+    buckets=SET_COUNT_BUCKETS,
+)
+QUEUE_WAIT = metrics.histogram(
+    "verify_service_queue_wait_seconds",
+    "Submit-to-dispatch latency per request",
+)
+BATCHES_DISPATCHED = metrics.counter(
+    "verify_service_batches_total", "Micro-batches dispatched to the backend"
+)
+COALESCED_BATCHES = metrics.counter(
+    "verify_service_coalesced_batches_total",
+    "Dispatched batches that merged requests from more than one submitter",
+)
+SETS_SUBMITTED = metrics.counter(
+    "verify_service_sets_submitted_total", "Signature sets submitted"
+)
+ADMISSION_REJECTED = metrics.counter(
+    "verify_service_admission_rejected_total",
+    "Requests rejected by per-class queue admission control",
+)
+POISONED_BATCHES = metrics.counter(
+    "verify_service_poisoned_batches_total",
+    "Failed batches resolved through the per-set-verdict attribution pass",
+)
+CIRCUIT_STATE = metrics.gauge(
+    "verify_service_circuit_state",
+    "Device circuit breaker: 0=closed 1=open 2=half-open",
+)
+CIRCUIT_TRIPS = metrics.counter(
+    "verify_service_circuit_trips_total",
+    "Times the breaker pinned the service to the host path",
+)

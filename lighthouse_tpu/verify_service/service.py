@@ -1,0 +1,482 @@
+"""VerificationService: cross-caller continuous batching for BLS work.
+
+The device kernel amortizes its fixed cost only at large batch sizes
+(BENCH: the gossip-batch curve knees at the compile bucket), but each
+call path on its own offers small batches — a single proposer signature,
+a page of discovery records, one sync aggregate.  This service is the
+missing coalescing layer: all callers submit; one dispatcher forms
+deadline-aware micro-batches across them and runs the existing
+`SignatureVerifier` backend seam once per batch.
+
+Request lifecycle:
+
+    submit(sets, priority, deadline) -> VerifyFuture
+        bounded per-class queue (admission control raises QueueFullError)
+    dispatcher: dispatch when total queued sets >= target_batch
+        OR the oldest queued request's deadline arrives
+    one backend call per batch; on a failed batch, ONE extra per-set
+        pass (crypto/tpu/bls.py:329 / backend.py:130) attributes the
+        poison to individual submitters — innocent futures still succeed
+
+The blocking `verify_signature_sets` / `verify_signature_sets_per_set`
+wrappers (and the `backend` property) make the service a drop-in
+`SignatureVerifier`, so every existing call site routes through it
+unchanged apart from a priority tag.
+"""
+
+import logging
+import threading
+import time
+from collections import deque
+
+from ..crypto.backend import SignatureVerifier
+from . import metrics as M
+from .circuit import CircuitBreaker
+
+log = logging.getLogger("lighthouse_tpu.verify_service")
+
+# priority classes, highest first (ISSUE: block > aggregate > attestation
+# > discovery/light-client).  Index IS the drain order.
+PRIORITY_CLASSES = ("block", "aggregate", "attestation", "discovery")
+_CLASS_INDEX = {name: i for i, name in enumerate(PRIORITY_CLASSES)}
+_PRIORITY_ALIASES = {"light_client": "discovery"}
+
+DEFAULT_TARGET_BATCH = 128          # dispatch immediately at this many sets
+DEFAULT_MAX_BATCH = 512             # never exceed (device chunk ceiling)
+DEFAULT_MAX_DELAY = {               # per-class coalescing window (seconds)
+    "block": 0.002,                 # blocks are latency-critical
+    "aggregate": 0.010,
+    "attestation": 0.025,
+    "discovery": 0.050,             # discovery/light-client can wait
+}
+DEFAULT_QUEUE_CAPS = {              # requests, mirroring beacon_processor caps
+    "block": 1024,
+    "aggregate": 4096,
+    "attestation": 16384,
+    "discovery": 4096,
+}
+
+
+def verify_with_verdicts(verifier, sets, priority="attestation"):
+    """(ok, verdicts) for the batch-then-attribute call pattern; on a
+    failed batch `verdicts` is ALWAYS the per-set vector (None only when
+    ok).
+
+    Against a VerificationService this is ONE want_per_set submission: a
+    clean batch costs one backend pass ([True]*n is free) and a poisoned
+    batch exactly one attribution pass — asking for a bool would discard
+    the verdicts the service already computed and force the caller to
+    re-submit the same sets for a third pass.  Against a bare
+    SignatureVerifier it runs the pre-service two-call pattern (batch,
+    then per-set on failure) so every call site reduces to
+    `if not ok: use verdicts`.
+    """
+    sets = list(sets)
+    if sets and hasattr(verifier, "submit"):
+        verdicts = verifier.verify_signature_sets_per_set(
+            sets, priority=priority
+        )
+        return all(verdicts), verdicts
+    ok = verifier.verify_signature_sets(sets, priority=priority)
+    if ok:
+        return True, None
+    return False, verifier.verify_signature_sets_per_set(
+        sets, priority=priority
+    )
+
+
+class QueueFullError(RuntimeError):
+    """Admission control: the request's class queue is at capacity."""
+
+
+class ServiceStopped(RuntimeError):
+    """The service stopped while the request was queued."""
+
+
+def normalize_priority(priority):
+    if priority is None:
+        return "attestation"
+    priority = _PRIORITY_ALIASES.get(priority, priority)
+    return priority if priority in _CLASS_INDEX else "attestation"
+
+
+class VerifyFuture:
+    """Completion handle for one submitted request."""
+
+    __slots__ = ("_event", "_result", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+
+    def done(self):
+        return self._event.is_set()
+
+    def set_result(self, value):
+        self._result = value
+        self._event.set()
+
+    def set_error(self, exc):
+        self._error = exc
+        self._event.set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("verification not complete")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class _Request:
+    __slots__ = ("sets", "future", "cls", "deadline", "submitted", "per_set")
+
+    def __init__(self, sets, future, cls, deadline, submitted, per_set):
+        self.sets = sets
+        self.future = future
+        self.cls = cls
+        self.deadline = deadline
+        self.submitted = submitted
+        self.per_set = per_set
+
+
+class VerificationService:
+    """Process-wide asynchronous verification dispatcher.
+
+    `verifier` is the backend seam (crypto/backend.SignatureVerifier or
+    any duck-typed equivalent).  `host_verifier` overrides the path the
+    circuit breaker pins to; by default a device-backed primary degrades
+    to `SignatureVerifier("native")` (which itself falls through to the
+    oracle).  The dispatcher runs under a supervised TaskExecutor thread
+    when `start(executor)` is called (node wiring), or under a lazily
+    spawned daemon thread on first submit (tests, CLI tools).
+    """
+
+    def __init__(self, verifier=None, host_verifier=None,
+                 target_batch=DEFAULT_TARGET_BATCH,
+                 max_batch=DEFAULT_MAX_BATCH,
+                 max_delay=None, queue_caps=None,
+                 breaker_threshold=3, breaker_cooldown=30.0):
+        self.verifier = verifier or SignatureVerifier("oracle")
+        self.target_batch = int(target_batch)
+        self.max_batch = max(int(max_batch), self.target_batch)
+        self.max_delay = dict(DEFAULT_MAX_DELAY)
+        if max_delay:
+            self.max_delay.update(max_delay)
+        self.queue_caps = dict(DEFAULT_QUEUE_CAPS)
+        if queue_caps:
+            self.queue_caps.update(queue_caps)
+
+        self._queues = [deque() for _ in PRIORITY_CLASSES]
+        self._queued_sets = 0
+        self._cv = threading.Condition()
+        self._thread = None
+        self._executor = None
+        self._stopped = False
+
+        self.breaker = CircuitBreaker(breaker_threshold, breaker_cooldown)
+        self._host_verifier = host_verifier
+        self._device_event = False
+        # hook into the backend seam: a device failure inside a verify
+        # call (already degraded to host by the seam) feeds the breaker
+        if hasattr(self.verifier, "on_device_fallback"):
+            self.verifier.on_device_fallback = self._note_device_failure
+
+        # bounded observability windows (tools/verify_service_bench.py and
+        # tests read these; Prometheus carries the unbounded series)
+        self.dispatched_batches = deque(maxlen=4096)   # sets per batch
+        self.recent_waits = deque(maxlen=8192)         # queue wait seconds
+
+    # ------------------------------------------------------------ compat
+
+    @property
+    def backend(self):
+        return getattr(self.verifier, "backend", "host")
+
+    def verify_signature_sets(self, sets, priority="attestation") -> bool:
+        """Blocking drop-in for SignatureVerifier.verify_signature_sets:
+        submit + wait.  Admission rejection or service shutdown degrade
+        to a direct synchronous backend call — the compat path must never
+        fail work that the bare seam would have verified.  The direct
+        call still honors the circuit breaker: a dead device must not be
+        re-probed per call exactly when the queues are overloaded."""
+        sets = list(sets)
+        if not sets or self._stopped:
+            return self._degraded_verifier().verify_signature_sets(sets)
+        try:
+            fut = self.submit(sets, priority=priority)
+        except QueueFullError:
+            return self._degraded_verifier().verify_signature_sets(sets)
+        try:
+            return fut.result()
+        except ServiceStopped:
+            return self._degraded_verifier().verify_signature_sets(sets)
+
+    # the ISSUE's `verify(...)` compat spelling
+    verify = verify_signature_sets
+
+    def verify_signature_sets_per_set(self, sets, priority="attestation") -> list:
+        sets = list(sets)
+        if not sets or self._stopped:
+            return self._degraded_verifier().verify_signature_sets_per_set(sets)
+        try:
+            fut = self.submit(sets, priority=priority, want_per_set=True)
+        except QueueFullError:
+            return self._degraded_verifier().verify_signature_sets_per_set(sets)
+        try:
+            return fut.result()
+        except ServiceStopped:
+            return self._degraded_verifier().verify_signature_sets_per_set(sets)
+
+    # ------------------------------------------------------------ submit
+
+    def submit(self, sets, priority="attestation", deadline=None,
+               want_per_set=False) -> VerifyFuture:
+        """Queue `sets` for batched verification.
+
+        `priority`: one of PRIORITY_CLASSES (or "light_client", an alias
+        for the discovery class).  `deadline`: maximum seconds this
+        request may wait for coalescing (default: the class window).
+        Returns a VerifyFuture resolving to a bool (or a per-set verdict
+        list when `want_per_set`).  Raises QueueFullError when the class
+        queue is at capacity — callers either shed load or verify inline.
+        """
+        sets = list(sets)
+        fut = VerifyFuture()
+        if not sets:
+            fut.set_result([] if want_per_set else
+                           self.verifier.verify_signature_sets([]))
+            return fut
+        cls = normalize_priority(priority)
+        idx = _CLASS_INDEX[cls]
+        now = time.monotonic()
+        window = self.max_delay[cls] if deadline is None else float(deadline)
+        req = _Request(sets, fut, cls, now + window, now, want_per_set)
+        with self._cv:
+            if self._stopping():
+                fut.set_error(ServiceStopped("verification service stopped"))
+                return fut
+            if len(self._queues[idx]) >= self.queue_caps[cls]:
+                M.ADMISSION_REJECTED.inc()
+                raise QueueFullError(f"{cls} queue at capacity")
+            self._queues[idx].append(req)
+            self._queued_sets += len(sets)
+            M.SETS_SUBMITTED.inc(len(sets))
+            M.queue_depth_gauge(cls).set(len(self._queues[idx]))
+            self._ensure_running_locked()
+            self._cv.notify_all()
+        return fut
+
+    # --------------------------------------------------------- lifecycle
+
+    def start(self, executor):
+        """Run the dispatcher under a supervised TaskExecutor (node
+        wiring).  Idempotent; a lazily-started daemon thread keeps
+        running if one already exists."""
+        with self._cv:
+            if self._thread is not None or self._executor is not None:
+                return self
+            self._executor = executor
+        executor.spawn(self._run_supervised, "verify_service")
+        return self
+
+    def stop(self):
+        with self._cv:
+            self._stopped = True
+            # the dispatcher may already be gone (executor shutdown
+            # exits the loop without setting _stopped) — fail whatever
+            # is queued HERE so no submitter blocks forever; running
+            # this twice is harmless
+            self._fail_pending_locked()
+            self._cv.notify_all()
+
+    def _ensure_running_locked(self):
+        if self._thread is None and self._executor is None:
+            t = threading.Thread(
+                target=self._loop, name="verify_service", daemon=True
+            )
+            self._thread = t
+            t.start()
+
+    def _run_supervised(self, executor):
+        self._loop()
+
+    def _stopping(self):
+        return self._stopped or (
+            self._executor is not None and self._executor.shutting_down
+        )
+
+    # -------------------------------------------------------- dispatcher
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while True:
+                    if self._stopping():
+                        # mark stopped so post-shutdown submits take the
+                        # compat degrade path instead of queueing onto a
+                        # dispatcher that no longer exists
+                        self._stopped = True
+                        self._fail_pending_locked()
+                        return
+                    wait = self._dispatch_wait_locked()
+                    if wait is not None and wait <= 0:
+                        break
+                    # cap the wait so executor shutdown (no cv notify) is
+                    # noticed promptly
+                    self._cv.wait(0.25 if wait is None else min(wait, 0.25))
+                batch = self._form_batch_locked()
+            if batch:
+                self._dispatch(batch)
+
+    def _dispatch_wait_locked(self):
+        """None = no work; <=0 = dispatch now; >0 = seconds until the
+        nearest queued deadline.  ALL queued requests are scanned, not
+        just queue heads: an explicit short `deadline` can sit behind a
+        default-window request in the same class.  Cheap by construction
+        — this path only runs when queued sets < target_batch."""
+        if self._queued_sets == 0:
+            return None
+        if self._queued_sets >= self.target_batch:
+            return 0.0
+        now = time.monotonic()
+        nearest = min(r.deadline for q in self._queues for r in q)
+        return nearest - now
+
+    def _form_batch_locked(self):
+        """Pop requests in priority order up to max_batch sets.  Requests
+        are atomic (never split); an oversized request dispatches alone."""
+        reqs = []
+        n = 0
+        for idx, cls in enumerate(PRIORITY_CLASSES):
+            q = self._queues[idx]
+            while q:
+                k = len(q[0].sets)
+                if reqs and n + k > self.max_batch:
+                    break
+                reqs.append(q.popleft())
+                n += k
+            M.queue_depth_gauge(cls).set(len(q))
+            if reqs and n >= self.max_batch:
+                break
+        self._queued_sets -= n
+        return reqs
+
+    def _fail_pending_locked(self):
+        err = ServiceStopped("verification service stopped")
+        for idx, cls in enumerate(PRIORITY_CLASSES):
+            q = self._queues[idx]
+            while q:
+                q.popleft().future.set_error(err)
+            M.queue_depth_gauge(cls).set(0)
+        self._queued_sets = 0
+
+    def _note_device_failure(self, exc=None):
+        # called from inside the backend seam on a device→host fallback
+        self._device_event = True
+
+    def _host(self):
+        if self._host_verifier is None:
+            self._host_verifier = SignatureVerifier("native")
+        return self._host_verifier
+
+    def _active_verifier(self):
+        """Dispatcher-side: the breaker decides whether this batch tries
+        the device (allow_device may transition OPEN -> HALF_OPEN; only
+        the dispatcher thread calls it — circuit.py's contract)."""
+        if self.backend != "tpu":
+            return self.verifier
+        if self.breaker.allow_device():
+            return self.verifier
+        return self._host()
+
+    def _degraded_verifier(self):
+        """Caller-thread-side (compat wrappers on overflow/shutdown): a
+        READ-ONLY breaker check — a non-CLOSED breaker means the host
+        path, without racing the dispatcher's probe state machine."""
+        if self.backend != "tpu" or self.breaker.state == 0:  # CLOSED
+            return self.verifier
+        return self._host()
+
+    def _dispatch(self, reqs):
+        now = time.monotonic()
+        all_sets = []
+        for r in reqs:
+            wait = now - r.submitted
+            M.QUEUE_WAIT.observe(wait)
+            self.recent_waits.append(wait)
+            all_sets.extend(r.sets)
+        M.BATCH_SETS.observe(len(all_sets))
+        M.BATCHES_DISPATCHED.inc()
+        if len(reqs) > 1:
+            M.COALESCED_BATCHES.inc()
+        self.dispatched_batches.append(len(all_sets))
+
+        v = self._active_verifier()
+        device_attempt = v is self.verifier and self.backend == "tpu"
+        self._device_event = False
+        try:
+            ok = v.verify_signature_sets(all_sets)
+        except Exception as e:
+            # the seam's internal fallback chain should make this
+            # unreachable; fail the batch's futures rather than hang them
+            log.exception("verification batch failed hard")
+            if device_attempt:
+                self.breaker.record_failure()
+            for r in reqs:
+                r.future.set_error(e)
+            return
+        if device_attempt:
+            if self._device_event:
+                self.breaker.record_failure()
+            else:
+                self.breaker.record_success()
+
+        if ok:
+            for r in reqs:
+                r.future.set_result([True] * len(r.sets) if r.per_set else True)
+            return
+
+        if len(reqs) == 1 and not reqs[0].per_set:
+            # single submitter wanting a bool: the batch verdict IS its
+            # verdict — no attribution pass needed (the caller runs its
+            # own per-set fallback, same as against the bare seam)
+            reqs[0].future.set_result(False)
+            return
+
+        # poisoned multi-caller batch: ONE per-set pass attributes the
+        # failure; innocent submitters still succeed
+        M.POISONED_BATCHES.inc()
+        try:
+            verdicts = v.verify_signature_sets_per_set(all_sets)
+        except Exception as e:
+            log.exception("per-set attribution pass failed hard")
+            for r in reqs:
+                r.future.set_error(e)
+            return
+        pos = 0
+        for r in reqs:
+            mine = list(verdicts[pos:pos + len(r.sets)])
+            pos += len(r.sets)
+            r.future.set_result(mine if r.per_set else all(mine))
+
+    # ----------------------------------------------------------- insight
+
+    def stats(self):
+        """Aggregates over the recent observability windows."""
+        batches = list(self.dispatched_batches)
+        waits = sorted(self.recent_waits)
+
+        def pct(p):
+            return waits[min(int(p * len(waits)), len(waits) - 1)] if waits else 0.0
+
+        return {
+            "batches": len(batches),
+            "sets": sum(batches),
+            "mean_batch_sets": (sum(batches) / len(batches)) if batches else 0.0,
+            "max_batch_sets": max(batches) if batches else 0,
+            "queue_wait_p50_ms": pct(0.50) * 1e3,
+            "queue_wait_p99_ms": pct(0.99) * 1e3,
+            "circuit_state": self.breaker.state,
+        }

@@ -153,6 +153,136 @@ def test_cli_am_validator_new_and_db_inspect(tmp_path, capsys):
     assert info["blocks"] == 0
 
 
+def test_cli_db_version(tmp_path, capsys):
+    from lighthouse_tpu.beacon.store import SCHEMA_VERSION
+
+    rc = main(["db", "version", "--datadir", str(tmp_path)])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    # opening stamps a fresh datadir with the build's schema version
+    assert out["schema_version"] == SCHEMA_VERSION
+    assert out["build_schema_version"] == SCHEMA_VERSION
+
+
+def test_db_prune_payloads_blinds_finalized_blocks(tmp_path, capsys):
+    """A bellatrix block's payload is replaced by its header below the
+    prune slot; the record still decodes and keeps its block root."""
+    from lighthouse_tpu.beacon.store import FileKV, HotColdStore
+    from lighthouse_tpu.ssz import hash_tree_root
+    from lighthouse_tpu.types.state import state_types
+
+    spec = SPEC
+    T = state_types(spec.preset)
+    path = str(tmp_path / "chain.db")
+    store = HotColdStore(FileKV(path), spec)
+    sb = T.SignedBeaconBlockBellatrix()
+    sb.message.slot = 5
+    sb.message.body.execution_payload.transactions.append(b"\x01\x02")
+    root = hash_tree_root(sb.message)
+    store.put_block(root, sb)
+    assert store.prune_payloads(before_slot=4) == 0   # too recent
+    assert store.prune_payloads(before_slot=10) == 1
+    assert store.prune_payloads(before_slot=10) == 0  # idempotent
+    pruned = store.get_block(root)
+    assert hasattr(pruned.message.body, "execution_payload_header")
+    assert not hasattr(pruned.message.body, "execution_payload")
+    assert hash_tree_root(pruned.message) == root
+    # serving paths (wire req/resp, http SSZ, put_block) re-encode what
+    # get_block returned: the codec must round-trip blinded records
+    blob = store.codec.enc_block(pruned)
+    again = store.codec.dec_block(blob)
+    assert hash_tree_root(again.message) == root
+    store.put_block(root, pruned)
+    assert hash_tree_root(store.get_block(root).message) == root
+    store.close()
+
+    rc = main(["db", "--network", "minimal", "prune-payloads",
+               "--datadir", str(tmp_path), "--before-slot", "10"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["pruned_payloads"] == 0    # already pruned above
+
+
+def test_wire_refuses_payload_pruned_blocks():
+    """Serving pruned history must refuse (by-root: omit; by-range: error
+    out) — a silently gappy range would abort an honest peer's linkage
+    check mid-sync."""
+    from lighthouse_tpu.beacon.chain import BeaconChain
+    from lighthouse_tpu.beacon.store import _Codec
+    from lighthouse_tpu.crypto.backend import SignatureVerifier
+    from lighthouse_tpu.network.wire import WireNode
+    from lighthouse_tpu.ssz import hash_tree_root
+    from lighthouse_tpu.testing.harness import Harness
+    from lighthouse_tpu.types.state import state_types
+
+    h = Harness(8, SPEC)
+    chain = BeaconChain(h.state.copy(), SPEC,
+                        verifier=SignatureVerifier("fake"))
+    T = state_types(SPEC.preset)
+    sb = T.SignedBeaconBlockBellatrix()
+    sb.message.slot = 1
+    root = hash_tree_root(sb.message)
+    codec = _Codec(SPEC.preset)
+    chain.store.put_block(root, codec.blind_block(sb))    # pruned form
+    a = WireNode(chain, port=0)
+    b = WireNode(
+        BeaconChain(h.state.copy(), SPEC,
+                    verifier=SignatureVerifier("fake")), port=0)
+    try:
+        pid = b.dial("127.0.0.1", a.port)
+        assert b.reqresp_view().blocks_by_root(b.peer_id, pid, [root]) == []
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_cli_am_validator_exit(tmp_path, capsys):
+    """`am validator-exit` signs through the existing ValidatorStore
+    path; the printed signature must verify against the spec domain."""
+    rc = main([
+        "am", "validator-new",
+        "--seed-hex", "22" * 32,
+        "--count", "1",
+        "--out-dir", str(tmp_path),
+        "--password", "pw",
+    ])
+    assert rc == 0
+    keystore = json.loads(capsys.readouterr().out)["created"][0]
+    gvr = b"\x42" * 32
+    rc = main([
+        "am", "--network", "minimal", "validator-exit",
+        "--keystore", keystore,
+        "--password", "pw",
+        "--validator-index", "7",
+        "--epoch", "3",
+        "--genesis-validators-root", "0x" + gvr.hex(),
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["message"] == {"epoch": "3", "validator_index": "7"}
+
+    from lighthouse_tpu.crypto import keys
+    from lighthouse_tpu.crypto.ref import bls as RB
+    from lighthouse_tpu.crypto.ref.curves import g2_decompress
+    from lighthouse_tpu.types import (
+        Domain,
+        VoluntaryExit,
+        compute_signing_root,
+    )
+
+    sk = keys.derive_path(bytes.fromhex("22" * 32), "m/12381/3600/0/0/0")
+    domain = SPEC.get_domain(
+        Domain.VOLUNTARY_EXIT, 3, SPEC.fork_at_epoch(3), gvr
+    )
+    root = compute_signing_root(
+        VoluntaryExit(epoch=3, validator_index=7), domain
+    )
+    sig = g2_decompress(bytes.fromhex(out["signature"][2:]))
+    assert RB.verify_signature_sets(
+        [RB.SignatureSet(sig, [RB.sk_to_pk(sk)], root)]
+    )
+
+
 def test_cli_config_file(tmp_path, capsys):
     cfg = tmp_path / "flags.json"
     cfg.write_text(json.dumps({"network": "minimal"}))

@@ -1,0 +1,180 @@
+"""verify_service offered-load sweep: coalescing efficiency tracker.
+
+Drives the VerificationService with N submitter threads each offering
+single-set requests at a target rate, and reports — per load point — the
+achieved dispatched-batch-size distribution and the p50/p99 queue wait.
+Future PRs tune the dispatcher (target batch, class windows) against
+these numbers: the whole point of the service is that mean batch size
+grows with offered load while queue wait stays inside the class window.
+
+By default the backend is a stub with a device-shaped latency model
+(fixed launch cost + small per-set cost), so the sweep measures the
+DISPATCHER, not BLS math, and runs in seconds.  --backend native|oracle
+verifies one real signature set repeatedly through the real seam.
+
+Usage:
+    python tools/verify_service_bench.py
+    python tools/verify_service_bench.py --rates 200,1000,5000 --submitters 16
+    python tools/verify_service_bench.py --backend native
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lighthouse_tpu.verify_service import VerificationService  # noqa: E402
+
+
+class StubSet:
+    """Opaque token standing in for a SignatureSet (the service never
+    looks inside a set)."""
+
+    __slots__ = ()
+
+
+class StubVerifier:
+    """Device-shaped latency model: fixed kernel-launch cost plus a
+    per-set cost, mirroring the measured gossip-batch curve shape (flat
+    batch latency up to the compile bucket)."""
+
+    backend = "stub"
+
+    def __init__(self, fixed_ms=2.0, per_set_us=20.0):
+        self.fixed_s = fixed_ms / 1e3
+        self.per_set_s = per_set_us / 1e6
+        self.calls = 0
+        self.on_device_fallback = None
+
+    def verify_signature_sets(self, sets, priority=None):
+        sets = list(sets)
+        self.calls += 1
+        time.sleep(self.fixed_s + self.per_set_s * len(sets))
+        return True
+
+    def verify_signature_sets_per_set(self, sets, priority=None):
+        sets = list(sets)
+        self.calls += 1
+        time.sleep(self.fixed_s + self.per_set_s * len(sets))
+        return [True] * len(sets)
+
+
+def _real_backend(name):
+    from lighthouse_tpu.crypto.backend import SignatureVerifier
+    from lighthouse_tpu.crypto.ref import bls as RB
+
+    sk = 12345
+    msg = b"\x07" * 32
+    s = RB.SignatureSet(RB.sign(sk, msg), [RB.sk_to_pk(sk)], msg)
+    return SignatureVerifier(name), s
+
+
+def run_point(service, make_set, submitters, offered_rps, duration):
+    """One load point: each submitter offers single-set requests at
+    offered_rps/submitters, futures collected and awaited at the end."""
+    service.dispatched_batches.clear()
+    service.recent_waits.clear()
+    per_thread_rps = offered_rps / submitters
+    interval = 1.0 / per_thread_rps if per_thread_rps > 0 else 0.0
+    stop_at = time.monotonic() + duration
+    submitted = [0] * submitters
+    rejected = [0] * submitters
+    futures = [[] for _ in range(submitters)]
+
+    def submitter(i):
+        nxt = time.monotonic()
+        while time.monotonic() < stop_at:
+            try:
+                futures[i].append(service.submit([make_set()]))
+                submitted[i] += 1
+            except Exception:
+                rejected[i] += 1
+            nxt += interval
+            delay = nxt - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=submitter, args=(i,), daemon=True)
+        for i in range(submitters)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ok = 0
+    for fl in futures:
+        for f in fl:
+            if f.result(timeout=30.0):
+                ok += 1
+    wall = time.monotonic() - t0
+
+    batches = sorted(service.dispatched_batches)
+    waits = sorted(service.recent_waits)
+
+    def pct(vals, p):
+        return vals[min(int(p * len(vals)), len(vals) - 1)] if vals else 0
+
+    return {
+        "offered_rps": offered_rps,
+        "submitters": submitters,
+        "submitted": sum(submitted),
+        "rejected": sum(rejected),
+        "verified_ok": ok,
+        "achieved_rps": round(sum(submitted) / wall, 1),
+        "batches": len(batches),
+        "batch_sets_mean": round(sum(batches) / len(batches), 2) if batches else 0,
+        "batch_sets_p50": pct(batches, 0.50),
+        "batch_sets_p95": pct(batches, 0.95),
+        "batch_sets_max": batches[-1] if batches else 0,
+        "queue_wait_p50_ms": round(pct(waits, 0.50) * 1e3, 3),
+        "queue_wait_p99_ms": round(pct(waits, 0.99) * 1e3, 3),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--submitters", type=int, default=8)
+    ap.add_argument("--rates", default="100,500,2000,8000",
+                    help="comma-separated total offered requests/sec")
+    ap.add_argument("--duration", type=float, default=2.0,
+                    help="seconds per load point")
+    ap.add_argument("--backend", default="stub",
+                    choices=["stub", "fake", "native", "oracle"])
+    ap.add_argument("--fixed-ms", type=float, default=2.0,
+                    help="stub backend: fixed per-batch latency")
+    ap.add_argument("--per-set-us", type=float, default=20.0,
+                    help="stub backend: marginal per-set latency")
+    ap.add_argument("--target-batch", type=int, default=128)
+    args = ap.parse_args(argv)
+
+    if args.backend == "stub":
+        verifier = StubVerifier(args.fixed_ms, args.per_set_us)
+        make_set = StubSet
+    else:
+        verifier, real_set = _real_backend(args.backend)
+        make_set = lambda: real_set  # noqa: E731
+    service = VerificationService(verifier, target_batch=args.target_batch)
+
+    points = []
+    for rate in (float(r) for r in args.rates.split(",")):
+        pt = run_point(service, make_set, args.submitters, rate, args.duration)
+        points.append(pt)
+        print(json.dumps(pt), flush=True)
+    service.stop()
+    print(json.dumps({
+        "tool": "verify_service_bench",
+        "backend": args.backend,
+        "target_batch": args.target_batch,
+        "points": points,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
