@@ -105,6 +105,7 @@ _T0 = time.time()
 DETAILS = []
 _PRIMARY = None   # best sets/sec so far; flushed incrementally + on SIGTERM
 _COMPILE_EST = 240.0   # refined after the first measured compile
+_VS_SUMMARY = None     # verify_service coalescing sweep (ROADMAP item d)
 
 
 def _left():
@@ -152,18 +153,21 @@ def _emit_primary(value, final=False, backend="tpu-kernel", platform=None):
     platform = platform or _PRIMARY_PLATFORM
     value = max(value, _PRIMARY or 0.0)
     _PRIMARY = value
-    line = json.dumps(
-        {
-            "metric": "bls_signature_sets_verified_per_sec",
-            "value": round(value, 2),
-            "unit": "sets/s",
-            "vs_baseline": round(value / BASELINE_SETS_PER_SEC, 4),
-            "platform": platform or jax.devices()[0].platform,
-            "backend": _PRIMARY_BACKEND,
-            "threads": NATIVE_THREADS,
-            "final": final,
-        }
-    )
+    rec = {
+        "metric": "bls_signature_sets_verified_per_sec",
+        "value": round(value, 2),
+        "unit": "sets/s",
+        "vs_baseline": round(value / BASELINE_SETS_PER_SEC, 4),
+        "platform": platform or jax.devices()[0].platform,
+        "backend": _PRIMARY_BACKEND,
+        "threads": NATIVE_THREADS,
+        "final": final,
+    }
+    if _VS_SUMMARY is not None:
+        # coalescing efficiency rides the primary artifact so the
+        # dispatcher's trajectory is tracked across PRs (ROADMAP item d)
+        rec["verify_service"] = _VS_SUMMARY
+    line = json.dumps(rec)
     print(line, flush=True)
     try:
         with open("BENCH_PRIMARY.json", "w") as f:
@@ -342,6 +346,55 @@ def config_curve():
              knee=f"bucket size {BUCKET}: sub-bucket batches pay padded "
                   f"lanes, super-bucket batches chunk at flat per-set cost")
     return best
+
+
+def config_verify_service():
+    """Coalescing-efficiency sweep (ROADMAP item d): drive the
+    VerificationService through tools/verify_service_bench.py's offered-
+    load harness against the device-shaped stub backend and record the
+    achieved batch-size distribution into BENCH_PRIMARY.json, so the
+    dispatcher's trajectory (mean batch vs. target, queue wait vs. class
+    window) is comparable across PRs.  Host-only, seconds of wall."""
+    global _VS_SUMMARY
+    if not _fits(30.0, "verify_service_sweep"):
+        return
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tools", "verify_service_bench.py",
+    )
+    spec_ = importlib.util.spec_from_file_location("verify_service_bench", path)
+    vsb = importlib.util.module_from_spec(spec_)
+    spec_.loader.exec_module(vsb)
+
+    target_batch = 128
+    service = vsb.VerificationService(
+        vsb.StubVerifier(), target_batch=target_batch
+    )
+    points = []
+    try:
+        for rate in (500.0, 4000.0):
+            pt = vsb.run_point(service, vsb.StubSet, 8, rate, 1.5)
+            points.append(pt)
+            note("verify_service_point", **pt)
+    finally:
+        service.stop()
+    if not points:
+        return
+    top = points[-1]
+    _VS_SUMMARY = {
+        "offered_rps": top["offered_rps"],
+        "achieved_rps": top["achieved_rps"],
+        "mean_batch_sets": top["batch_sets_mean"],
+        "coalescing_efficiency": round(
+            top["batch_sets_mean"] / target_batch, 4
+        ),
+        "queue_wait_p50_ms": top["queue_wait_p50_ms"],
+        "queue_wait_p99_ms": top["queue_wait_p99_ms"],
+        "target_batch": target_batch,
+    }
+    note("verify_service_sweep", **_VS_SUMMARY)
 
 
 def config_native():
@@ -715,6 +768,12 @@ def main():
     note("platform", platform=jax.devices()[0].platform, note=_PLATFORM_NOTE,
          bucket=BUCKET, budget_s=BUDGET_S)
     primary = None
+    try:
+        # coalescing sweep FIRST (cheap, host-only) so every emitted
+        # primary line already carries the verify_service summary
+        config_verify_service()
+    except Exception as e:
+        note("verify_service_sweep_error", error=str(e)[:300])
     try:
         # the native C++ engine first: seconds of wall for a complete,
         # honest production-path number before any XLA compile starts

@@ -708,6 +708,36 @@ class _Handler(JsonHandler):
                 return self._err(404, str(e))
             return self._json({"data": data})
 
+        if path == "/lighthouse/tracing":
+            # recent pipeline span traces (utils/tracing.py ring buffer):
+            # queue wait / batch assembly / kernel stages per block or
+            # verification batch, newest first
+            from ..utils import tracing
+
+            limit = int(q.get("limit", ["64"])[0])
+            kind = q.get("kind", [None])[0]
+            traces = tracing.recent(limit if kind is None else None)
+            if kind is not None:
+                traces = [t for t in traces if t["kind"] == kind][:limit]
+            return self._json({"data": traces})
+
+        if path == "/lighthouse/ui/health":
+            # the reference's /lighthouse/ui/health JSON snapshot, built
+            # on utils/system_health.observe plus chain position
+            from ..utils.system_health import observe
+
+            data = observe()
+            data["beacon"] = {
+                "head_slot": int(chain.head_state.slot),
+                "head_root": _hex(chain.head_root),
+                "current_slot": int(chain.current_slot),
+                "finalized_epoch": int(
+                    chain.head_state.finalized_checkpoint.epoch
+                ),
+                "block_times_cached": len(chain.block_times_cache),
+            }
+            return self._json({"data": data})
+
         if path == "/lighthouse/liveness":
             # the doppelganger-service probe: was each validator index seen
             # attesting (gossip or blocks) in the given epoch?

@@ -16,9 +16,10 @@ holds early/unknown-parent objects for retry on the next tick.
 
 import logging
 import threading
+import time
 from collections import deque
 
-from ..utils import metrics
+from ..utils import metrics, tracing
 
 log = logging.getLogger("lighthouse_tpu.processor")
 
@@ -40,12 +41,15 @@ BATCHES_ASSEMBLED = metrics.counter(
 
 
 class WorkEvent:
-    __slots__ = ("kind", "payload", "retries")
+    __slots__ = ("kind", "payload", "retries", "enqueued", "arrival", "trace")
 
-    def __init__(self, kind, payload):
+    def __init__(self, kind, payload, trace=None):
         self.kind = kind
         self.payload = payload
         self.retries = 0
+        self.enqueued = time.monotonic()
+        self.arrival = time.time()  # wall clock: the gossip-observed stamp
+        self.trace = trace          # pipeline trace (utils/tracing.py)
 
 
 class BeaconProcessor:
@@ -71,7 +75,12 @@ class BeaconProcessor:
             if len(self.block_queue) >= MAX_GOSSIP_BLOCK_QUEUE:
                 WORK_DROPPED.inc()
                 return False
-            self.block_queue.append(WorkEvent("block", signed_block))
+            trace = tracing.start_trace(
+                "gossip_block", slot=int(signed_block.message.slot)
+            )
+            self.block_queue.append(
+                WorkEvent("block", signed_block, trace=trace)
+            )
         return True
 
     def enqueue_attestation(self, attestation):
@@ -107,77 +116,102 @@ class BeaconProcessor:
         handled += self._retry_reprocess()
         return handled
 
-    def _drain_blocks(self):
+    def _process_block_event(self, ev):
+        """One import attempt with tracing.  An unknown-parent retry
+        re-queues the event WITH its trace (an early-arriving block that
+        imports on the next tick must not show up as a failure) and
+        re-stamps `enqueued` so the next attempt's queue wait is its own."""
         from .chain import BlockError
 
+        tr, ev.trace = ev.trace, None
+        if tr is not None:
+            tr.add_span("queue_wait", ev.enqueued, time.monotonic())
+        try:
+            with tracing.use(tr):
+                if tr is None:
+                    root = self.chain.process_block(
+                        ev.payload, observed_at=ev.arrival
+                    )
+                else:
+                    with tr.span("process"):
+                        root = self.chain.process_block(
+                            ev.payload, observed_at=ev.arrival
+                        )
+            self.results.append(("block", True, root))
+            if tr is not None:
+                tr.finish(ok=True, root=root.hex())
+        except BlockError as e:
+            if "unknown parent" in str(e) and ev.retries < 3:
+                ev.retries += 1
+                with self._lock:
+                    requeued = len(self.reprocess_queue) < MAX_REPROCESS_QUEUE
+                    if requeued:
+                        ev.trace = tr
+                        ev.enqueued = time.monotonic()
+                        self.reprocess_queue.append(ev)
+                if not requeued and tr is not None:
+                    tr.finish(ok=False, error=str(e)[:200])
+            else:
+                if tr is not None:
+                    tr.finish(ok=False, error=str(e)[:200])
+                self.results.append(("block", False, str(e)))
+
+    def _drain_blocks(self):
         n = 0
         while True:
             with self._lock:
                 if not self.block_queue:
                     break
                 ev = self.block_queue.popleft()
-            try:
-                root = self.chain.process_block(ev.payload)
-                self.results.append(("block", True, root))
-            except BlockError as e:
-                if "unknown parent" in str(e) and ev.retries < 3:
-                    ev.retries += 1
-                    with self._lock:
-                        if len(self.reprocess_queue) < MAX_REPROCESS_QUEUE:
-                            self.reprocess_queue.append(ev)
-                else:
-                    self.results.append(("block", False, str(e)))
+            self._process_block_event(ev)
             n += 1
         return n
 
     def _drain_attestation_batch(self):
-        batch = []
-        with self._lock:
-            while self.attestation_queue and len(batch) < self.attestation_batch_size:
-                batch.append(self.attestation_queue.pop().payload)  # LIFO
-        if not batch:
-            return 0
-        BATCHES_ASSEMBLED.inc()
-        results = self.chain.batch_verify_unaggregated_attestations(batch)
-        for att, indexed, err in results:
-            self.results.append(("attestation", err is None, err))
-        return len(batch)
+        return self._drain_lifo_batch(
+            self.attestation_queue,
+            self.chain.batch_verify_unaggregated_attestations,
+            "attestation",
+        )
 
     def _drain_aggregate_batch(self):
         """Aggregates drain LIFO like unaggregated attestations (newest
         matter most) into one batched verification (each item is a 3-set
         group; attestation_verification/batch.rs:31-134)."""
+        return self._drain_lifo_batch(
+            self.aggregate_queue,
+            self.chain.batch_verify_aggregated_attestations,
+            "aggregate",
+        )
+
+    def _drain_lifo_batch(self, queue, verify_fn, kind):
         batch = []
+        oldest = None
         with self._lock:
-            while self.aggregate_queue and len(batch) < self.attestation_batch_size:
-                batch.append(self.aggregate_queue.pop().payload)
+            while queue and len(batch) < self.attestation_batch_size:
+                ev = queue.pop()                                    # LIFO
+                batch.append(ev.payload)
+                oldest = ev.enqueued if oldest is None else min(
+                    oldest, ev.enqueued)
         if not batch:
             return 0
         BATCHES_ASSEMBLED.inc()
-        results = self.chain.batch_verify_aggregated_attestations(batch)
-        for sa, indexed, err in results:
-            self.results.append(("aggregate", err is None, err))
+        tr = tracing.start_trace(f"{kind}_batch", count=len(batch))
+        tr.add_span("queue_wait", oldest, time.monotonic())
+        with tracing.use(tr), tr.span("process"):
+            results = verify_fn(batch)
+        tr.finish(accepted=sum(1 for _, _, err in results if err is None))
+        for item, indexed, err in results:
+            self.results.append((kind, err is None, err))
         return len(batch)
 
     def _retry_reprocess(self):
-        from .chain import BlockError
-
         n = 0
         with self._lock:
             pending = list(self.reprocess_queue)
             self.reprocess_queue.clear()
         for ev in pending:
-            try:
-                root = self.chain.process_block(ev.payload)
-                self.results.append(("block", True, root))
-            except BlockError as e:
-                if "unknown parent" in str(e) and ev.retries < 3:
-                    ev.retries += 1
-                    with self._lock:
-                        if len(self.reprocess_queue) < MAX_REPROCESS_QUEUE:
-                            self.reprocess_queue.append(ev)
-                else:
-                    self.results.append(("block", False, str(e)))
+            self._process_block_event(ev)
             n += 1
         return n
 

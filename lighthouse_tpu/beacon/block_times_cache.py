@@ -1,0 +1,184 @@
+"""Per-root block pipeline timestamps and slot-relative delay histograms.
+
+Mirror of /root/reference/beacon_node/beacon_chain/src/block_times_cache.rs
+(`BlockTimesCache`: per-root `Timestamps` stamped as the block moves
+through the pipeline, `BlockDelays` derived relative to the slot start,
+pruned by slot), recast for this repo's pipeline stages:
+
+    gossip-observed -> signature-verified -> executed -> imported
+        -> set-as-head
+
+Each stamp is first-sighting-wins (a block can arrive over gossip AND the
+API; the earliest observation is the honest one).  When a block becomes
+head, `observe_delays` turns the stamps into the stage-delay histograms
+below — the breakdown that makes a regression in queue wait vs. kernel
+time vs. state transition distinguishable from the outside.
+"""
+
+import threading
+import time
+
+from ..utils import metrics
+
+# delays are slot-scale: buckets stretch past the 12 s mainnet slot
+DELAY_BUCKETS = (
+    0.05, 0.1, 0.25, 0.5, 0.75, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0,
+)
+
+BLOCK_OBSERVED_SLOT_START_DELAY = metrics.histogram(
+    "beacon_block_observed_slot_start_delay_seconds",
+    "Slot start to first (gossip/API) observation of the block",
+    buckets=DELAY_BUCKETS,
+)
+BLOCK_SIGNATURE_VERIFIED_DELAY = metrics.histogram(
+    "beacon_block_signature_verified_delay_seconds",
+    "Observation to full bulk signature verification",
+    buckets=DELAY_BUCKETS,
+)
+BLOCK_EXECUTED_DELAY = metrics.histogram(
+    "beacon_block_executed_delay_seconds",
+    "Signature verification to state-transition/payload-execution accept",
+    buckets=DELAY_BUCKETS,
+)
+BLOCK_IMPORTED_DELAY = metrics.histogram(
+    "beacon_block_imported_delay_seconds",
+    "Execution accept to fork-choice and store import",
+    buckets=DELAY_BUCKETS,
+)
+BLOCK_HEAD_SLOT_START_DELAY = metrics.histogram(
+    "beacon_block_set_as_head_slot_start_delay_seconds",
+    "Slot start to the block becoming head (end-to-end pipeline delay)",
+    buckets=DELAY_BUCKETS,
+)
+
+STAGES = (
+    "observed", "signature_verified", "executed", "imported", "set_as_head",
+)
+
+
+class BlockTimes:
+    """Timestamps for one block root (block_times_cache.rs Timestamps)."""
+
+    __slots__ = ("root", "slot", "reported") + STAGES
+
+    def __init__(self, root, slot):
+        self.root = root
+        self.slot = slot
+        self.reported = False       # delays already fed to the histograms
+        for stage in STAGES:
+            setattr(self, stage, None)
+
+    def as_dict(self):
+        return {
+            "root": self.root.hex(),
+            "slot": self.slot,
+            **{stage: getattr(self, stage) for stage in STAGES},
+        }
+
+
+class BlockTimesCache:
+    """Thread-safe per-root stamp store, pruned by slot horizon.
+
+    `time_fn` is injectable (tests stamp deterministic clocks); slot
+    starts are computed by the caller (the chain owns genesis time), so
+    the cache itself is slot-clock-agnostic.
+    """
+
+    def __init__(self, time_fn=time.time, horizon_slots=64):
+        self._times = {}
+        self._lock = threading.Lock()
+        self._time_fn = time_fn
+        self.horizon_slots = int(horizon_slots)
+
+    def _stamp(self, root, slot, stage, timestamp):
+        t = self._time_fn() if timestamp is None else float(timestamp)
+        root = bytes(root)
+        with self._lock:
+            e = self._times.get(root)
+            if e is None:
+                e = BlockTimes(root, int(slot))
+                self._times[root] = e
+            if getattr(e, stage) is None:      # first sighting wins
+                setattr(e, stage, t)
+        return t
+
+    def set_time_observed(self, root, slot, timestamp=None):
+        return self._stamp(root, slot, "observed", timestamp)
+
+    def set_time_signature_verified(self, root, slot, timestamp=None):
+        return self._stamp(root, slot, "signature_verified", timestamp)
+
+    def set_time_executed(self, root, slot, timestamp=None):
+        return self._stamp(root, slot, "executed", timestamp)
+
+    def set_time_imported(self, root, slot, timestamp=None):
+        return self._stamp(root, slot, "imported", timestamp)
+
+    def set_time_set_as_head(self, root, slot, timestamp=None):
+        return self._stamp(root, slot, "set_as_head", timestamp)
+
+    def get(self, root):
+        with self._lock:
+            return self._times.get(bytes(root))
+
+    def __len__(self):
+        with self._lock:
+            return len(self._times)
+
+    def delays(self, root, slot_start):
+        """Stage-delay breakdown (block_times_cache.rs BlockDelays):
+        `observed` and `set_as_head` are relative to the slot start;
+        the middle stages are deltas from the previous completed stage.
+        Unstamped stages are None; raw values may be negative (clock
+        skew) — `observe_delays` clamps for the histograms."""
+        e = self.get(root)
+        if e is None or e.observed is None:
+            return None
+        out = {"slot": e.slot, "observed": e.observed - float(slot_start)}
+        prev = e.observed
+        for stage in ("signature_verified", "executed", "imported"):
+            t = getattr(e, stage)
+            out[stage] = None if t is None else t - prev
+            if t is not None:
+                prev = t
+        out["set_as_head"] = (
+            None if e.set_as_head is None
+            else e.set_as_head - float(slot_start)
+        )
+        return out
+
+    def observe_delays(self, root, slot_start):
+        """Feed the stage histograms for `root` — once per root: a reorg
+        re-electing a previous head must not double-count its samples.
+        Returns the delay dict, or None when the root was never observed
+        (e.g. a sync-imported head) or was already reported."""
+        with self._lock:
+            e = self._times.get(bytes(root))
+            if e is None or e.observed is None or e.reported:
+                return None
+            e.reported = True
+        d = self.delays(root, slot_start)
+        if d is None:
+            return None
+
+        def obs(hist, v):
+            if v is not None:
+                hist.observe(max(v, 0.0))
+
+        obs(BLOCK_OBSERVED_SLOT_START_DELAY, d["observed"])
+        obs(BLOCK_SIGNATURE_VERIFIED_DELAY, d["signature_verified"])
+        obs(BLOCK_EXECUTED_DELAY, d["executed"])
+        obs(BLOCK_IMPORTED_DELAY, d["imported"])
+        obs(BLOCK_HEAD_SLOT_START_DELAY, d["set_as_head"])
+        return d
+
+    def prune(self, current_slot):
+        """Drop entries older than the slot horizon (the reference prunes
+        on each slot tick; entries are tiny but unbounded roots are not)."""
+        horizon = int(current_slot) - self.horizon_slots
+        if horizon <= 0:
+            return
+        with self._lock:
+            self._times = {
+                r: e for r, e in self._times.items() if e.slot >= horizon
+            }

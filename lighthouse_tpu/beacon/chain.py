@@ -129,12 +129,17 @@ class BeaconChain:
         self.observed_sync_contributors = set()  # (slot, validator)
         self.observed_sync_aggregators = set()  # (slot, aggregator, subnet)
 
+        from .block_times_cache import BlockTimesCache
         from .events import EventBroadcaster
         from .sync_pool import SyncContributionPool
         from .validator_monitor import ValidatorMonitor
 
         self.sync_pool = SyncContributionPool(spec)
         self.validator_monitor = ValidatorMonitor()
+        # per-root pipeline timestamps (gossip-observed -> ... -> head);
+        # slot starts come from the genesis time this state anchors
+        self._genesis_time = int(genesis_state.genesis_time)
+        self.block_times_cache = BlockTimesCache()
         self.events = EventBroadcaster()
         self.light_client_server = None   # created on first altair import
         self.slasher = None               # attached via attach_slasher()
@@ -157,6 +162,11 @@ class BeaconChain:
     def head_snapshot(self):
         return self._head
 
+    def slot_start_time(self, slot):
+        """Wall-clock start of `slot` (slot_clock::start_of): the anchor
+        for the BlockTimesCache's slot-relative delay histograms."""
+        return self._genesis_time + int(slot) * int(self.spec.seconds_per_slot)
+
     # ------------------------------------------------------------- clock
 
     def on_tick(self, slot):
@@ -165,6 +175,7 @@ class BeaconChain:
         self.current_slot = max(self.current_slot, int(slot))
         self.fork_choice.on_tick(self.current_slot)
         self.sync_pool.prune(self.current_slot)
+        self.block_times_cache.prune(self.current_slot)
         self._slasher_tick()
         # observed-* filters only matter for current/previous epoch
         horizon_epoch = self.current_slot // self.preset.slots_per_epoch - 2
@@ -188,9 +199,11 @@ class BeaconChain:
 
     # --------------------------------------------------- block pipeline
 
-    def verify_block_for_gossip(self, signed_block):
+    def verify_block_for_gossip(self, signed_block, observed_at=None):
         """GossipVerifiedBlock::new (block_verification.rs:594): slot/parent
-        checks, duplicate-proposal filter, proposer signature only."""
+        checks, duplicate-proposal filter, proposer signature only.
+        `observed_at`: wall-clock first sighting (the processor's work-
+        event arrival) for the BlockTimesCache; defaults to now."""
         block = signed_block.message
         slot = int(block.slot)
         if slot > self.current_slot:
@@ -241,6 +254,11 @@ class BeaconChain:
         self.observed_block_producers.add(key)
         self._slasher_accept_header(signed_block)
         block_root = hash_tree_root(block)
+        # gossip-observed stamp: the network-arrival time when the block
+        # came through the processor, now() for direct/API publishes
+        self.block_times_cache.set_time_observed(
+            block_root, slot, timestamp=observed_at
+        )
         return GossipVerifiedBlock(signed_block, block_root, pre_state)
 
     # -------------------------------------------------- slasher service
@@ -339,7 +357,7 @@ class BeaconChain:
             state = phase0.process_slots(state, slot, self.preset, spec=self.spec)
         return state
 
-    def process_block(self, signed_block):
+    def process_block(self, signed_block, observed_at=None):
         """beacon_chain.rs:2664 process_block: full pipeline to import.
 
         Accepts a raw SignedBeaconBlock or a GossipVerifiedBlock.
@@ -348,7 +366,9 @@ class BeaconChain:
             if isinstance(signed_block, GossipVerifiedBlock):
                 gossip_verified = signed_block
             else:
-                gossip_verified = self.verify_block_for_gossip(signed_block)
+                gossip_verified = self.verify_block_for_gossip(
+                    signed_block, observed_at=observed_at
+                )
             sig_verified = self._verify_all_signatures(gossip_verified)
             return self._import_block(sig_verified)
 
@@ -378,6 +398,10 @@ class BeaconChain:
                 raise BlockError(f"invalid block: {e}") from e
             if not self.verifier.verify_signature_sets(sets, priority="block"):
                 raise BlockError("bulk signature verification failed")
+        self.block_times_cache.set_time_signature_verified(
+            gossip_verified.block_root,
+            int(gossip_verified.signed_block.message.slot),
+        )
         sv = SignatureVerifiedBlock(gossip_verified)
         sv.post_state = state
         return sv
@@ -389,6 +413,10 @@ class BeaconChain:
         post_state = sig_verified.post_state
         if bytes(block.state_root) != hash_tree_root(post_state):
             raise BlockError("state root mismatch")
+        # the state transition (incl. payload execution) is now accepted
+        self.block_times_cache.set_time_executed(
+            sig_verified.block_root, int(block.slot)
+        )
 
         self.fork_choice.on_block(
             self.current_slot, block, sig_verified.block_root, post_state
@@ -410,6 +438,9 @@ class BeaconChain:
 
         self.store.put_block(sig_verified.block_root, sig_verified.signed_block)
         self.store.put_state(sig_verified.block_root, post_state)
+        self.block_times_cache.set_time_imported(
+            sig_verified.block_root, int(block.slot)
+        )
         if hasattr(block.body, "sync_aggregate"):
             self._serve_light_clients(block)
         self._import_new_pubkeys(post_state)
@@ -1061,6 +1092,7 @@ class BeaconChain:
                 return self.head_root
             new_state = state.copy()
             self._head = (head_root, new_state)
+            self._register_block_delays(head_root, int(new_state.slot))
             self.events.publish(
                 EventKind.HEAD,
                 {
@@ -1078,6 +1110,21 @@ class BeaconChain:
                     bytes(32),
                 )
         return self.head_root
+
+    def _register_block_delays(self, root, slot):
+        """The new head's pipeline stamps become the stage-delay
+        histograms (block_times_cache.rs register-on-head role) and feed
+        the validator monitor's per-proposer attribution."""
+        cache = self.block_times_cache
+        cache.set_time_set_as_head(root, slot)
+        delays = cache.observe_delays(root, self.slot_start_time(slot))
+        if delays is None:
+            return          # sync-imported head: never gossip-observed
+        blk = self.store.get_block(root)
+        if blk is not None:
+            self.validator_monitor.process_block_delays(
+                int(blk.message.proposer_index), slot, delays
+            )
 
     # -------------------------------------------------------- persistence
 

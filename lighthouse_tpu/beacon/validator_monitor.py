@@ -35,6 +35,11 @@ MONITOR_SYNC_HITS = metrics.counter(
     "validator_monitor_sync_committee_hits_total",
     "Sync-committee messages by monitored validators included in blocks",
 )
+MONITOR_HEAD_DELAY = metrics.histogram(
+    "validator_monitor_block_set_as_head_delay_seconds",
+    "Slot start to set-as-head for monitored proposers' blocks",
+    buckets=(0.25, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0),
+)
 
 
 class ValidatorMonitor:
@@ -47,6 +52,7 @@ class ValidatorMonitor:
         self.gossip_seen = defaultdict(set)
         self.proposals = defaultdict(list)       # validator -> [slots]
         self.sync_hits = defaultdict(int)        # validator -> count
+        self.block_delays = defaultdict(list)    # validator -> delay dicts
         self.balances = defaultdict(dict)        # validator -> {epoch: gwei}
         self._summarized_through = -1            # last epoch closed out
         # validator -> first duty epoch; None = "from the next sampled
@@ -77,6 +83,27 @@ class ValidatorMonitor:
             if v in self.monitored and epoch not in self.gossip_seen[v]:
                 self.gossip_seen[v].add(epoch)
                 MONITOR_GOSSIP_SEEN.inc()
+
+    def process_block_delays(self, proposer, slot, delays):
+        """Per-proposer delay attribution fed by the BlockTimesCache when
+        a block becomes head (validator_monitor.rs register_block_delays
+        role): records the end-to-end stage breakdown for monitored
+        proposers, bounded per validator."""
+        proposer = int(proposer)
+        if proposer not in self.monitored:
+            return
+        total = delays.get("set_as_head")
+        if total is not None:
+            MONITOR_HEAD_DELAY.observe(max(total, 0.0))
+        hist = self.block_delays[proposer]
+        hist.append({"slot": int(slot), **delays})
+        del hist[:-16]
+        log.info(
+            "monitored validator %d block at slot %d set as head "
+            "(slot-start delay %s s)",
+            proposer, slot,
+            "?" if total is None else round(total, 3),
+        )
 
     def process_imported_block(self, state, signed_block, preset):
         """Called by the chain after import (beacon_chain.rs:3335 region)."""
@@ -195,6 +222,7 @@ class ValidatorMonitor:
             "sync_committee_hits": self.sync_hits.get(v, 0),
             "gossip_seen_epochs": len(self.gossip_seen.get(v, set())),
             "balance_history": dict(sorted(balances.items())[-8:]),
+            "recent_block_delays": list(self.block_delays.get(v, []))[-4:],
         }
         if current_epoch is not None:
             first = self._registered_at_epoch.get(v, 0)

@@ -29,7 +29,10 @@ class Router:
 
     def _on_block(self, from_peer, signed_block):
         # a full local queue is OUR backpressure, not sender misbehavior —
-        # never return False (the invalid-gossip score signal) for it
+        # never return False (the invalid-gossip score signal) for it.
+        # The enqueued WorkEvent records the arrival wall-clock, which
+        # becomes the BlockTimesCache's gossip-observed stamp (so queue
+        # wait is attributed correctly without hashing the block here)
         self.processor.enqueue_block(signed_block)
 
     def _on_attestation(self, from_peer, attestation):

@@ -1,13 +1,22 @@
-"""Process-global metrics registry: counters, gauges, histograms.
+"""Process-global metrics registry: counters, gauges, histograms — with
+label support.
 
 Mirror of /root/reference/common/lighthouse_metrics/src/lib.rs (lazy-static
-global prometheus registry, start_timer/stop guards) and the per-crate
+global prometheus registry, start_timer/stop guards, the `*Vec` labeled
+families behind try_create_int_gauge_vec & co) and the per-crate
 `metrics.rs` convention (e.g. beacon_chain/src/metrics.rs:37
 BLOCK_PROCESSING_TIMES, :248-260 ATTESTATION_PROCESSING_BATCH_* — the
 timers bracketing exactly the code the TPU kernel replaces).
 
-Text exposition follows the Prometheus format so the http_metrics endpoint
-can serve scrapes directly.
+Label support mirrors prometheus' metric vectors: registering with
+`labels=("class",)` returns a `Family`; `.with_labels("block")` returns
+the per-label-value child (created on demand, cached), so one metric
+family serves every class instead of name-mangled per-class metrics.
+
+Text exposition follows the Prometheus format — `# HELP` + `# TYPE`
+headers per family, escaped label values, float-formatted `le` bucket
+bounds with `+Inf` last — so the http_metrics endpoint serves scrapes
+directly.
 """
 
 import threading
@@ -24,9 +33,54 @@ DEFAULT_BUCKETS = (
 )
 
 
-class Counter:
-    def __init__(self, name, help=""):
-        self.name, self.help = name, help
+def _escape_help(text):
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label_value(value):
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace("\n", "\\n")
+        .replace('"', '\\"')
+    )
+
+
+def _label_str(pairs):
+    if not pairs:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in pairs
+    )
+    return "{" + inner + "}"
+
+
+class _Metric:
+    """One concrete time series (possibly a labeled child of a Family)."""
+
+    kind = "untyped"
+
+    def __init__(self, name, help="", label_pairs=()):
+        self.name = name
+        self.help = help
+        self.label_pairs = tuple(label_pairs)
+
+    def header(self):
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {_escape_help(self.help)}")
+        lines.append(f"# TYPE {self.name} {self.kind}")
+        return lines
+
+    def collect(self):
+        return self.header() + self.samples()
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help="", label_pairs=()):
+        super().__init__(name, help, label_pairs)
         self.value = 0
         self._lock = threading.Lock()
 
@@ -34,26 +88,43 @@ class Counter:
         with self._lock:
             self.value += by
 
-    def collect(self):
-        return [f"# TYPE {self.name} counter", f"{self.name} {self.value}"]
+    def samples(self):
+        return [f"{self.name}{_label_str(self.label_pairs)} {self.value}"]
 
 
-class Gauge:
-    def __init__(self, name, help=""):
-        self.name, self.help = name, help
+class Gauge(_Metric):
+    """IntGauge API (set/inc/dec) with a lock so read-modify-write
+    updates from concurrent threads never lose increments."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help="", label_pairs=()):
+        super().__init__(name, help, label_pairs)
         self.value = 0
+        self._lock = threading.Lock()
 
     def set(self, v):
-        self.value = v
+        with self._lock:
+            self.value = v
 
-    def collect(self):
-        return [f"# TYPE {self.name} gauge", f"{self.name} {self.value}"]
+    def inc(self, by=1):
+        with self._lock:
+            self.value += by
+
+    def dec(self, by=1):
+        with self._lock:
+            self.value -= by
+
+    def samples(self):
+        return [f"{self.name}{_label_str(self.label_pairs)} {self.value}"]
 
 
-class Histogram:
-    def __init__(self, name, help="", buckets=DEFAULT_BUCKETS):
-        self.name, self.help = name, help
-        self.buckets = tuple(buckets)
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help="", label_pairs=(), buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help, label_pairs)
+        self.buckets = tuple(float(b) for b in buckets)
         self.counts = [0] * (len(self.buckets) + 1)
         self.sum = 0.0
         self.count = 0
@@ -69,15 +140,21 @@ class Histogram:
         """Context manager observing elapsed seconds (metrics::start_timer)."""
         return _Timer(self)
 
-    def collect(self):
-        out = [f"# TYPE {self.name} histogram"]
+    def samples(self):
+        with self._lock:
+            counts = list(self.counts)
+            total, sum_ = self.count, self.sum
+        out = []
         cum = 0
-        for b, c in zip(self.buckets, self.counts):
+        for b, c in zip(self.buckets, counts):
             cum += c
-            out.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
-        out.append(f'{self.name}_bucket{{le="+Inf"}} {self.count}')
-        out.append(f"{self.name}_sum {self.sum}")
-        out.append(f"{self.name}_count {self.count}")
+            ls = _label_str(self.label_pairs + (("le", repr(b)),))
+            out.append(f"{self.name}_bucket{ls} {cum}")
+        ls = _label_str(self.label_pairs + (("le", "+Inf"),))
+        out.append(f"{self.name}_bucket{ls} {total}")
+        tail = _label_str(self.label_pairs)
+        out.append(f"{self.name}_sum{tail} {sum_}")
+        out.append(f"{self.name}_count{tail} {total}")
         return out
 
 
@@ -94,29 +171,114 @@ class _Timer:
         return False
 
 
-def _register(kind, name, help, **kw):
+class Family:
+    """A labeled metric family: one exposition name, one child per
+    label-value tuple (`prometheus::IntGaugeVec` role)."""
+
+    def __init__(self, cls, name, help, labelnames, **kw):
+        self._cls = cls
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(str(n) for n in labelnames)
+        self._kw = kw
+        self._children = {}
+        self._lock = threading.Lock()
+
+    @property
+    def kind(self):
+        return self._cls.kind
+
+    def with_labels(self, *values):
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name} expects labels {self.labelnames}, "
+                f"got {len(values)} value(s)"
+            )
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._cls(
+                    self.name, self.help,
+                    label_pairs=tuple(zip(self.labelnames, key)),
+                    **self._kw,
+                )
+                self._children[key] = child
+        return child
+
+    # prometheus-client spelling
+    labels = with_labels
+
+    def header(self):
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {_escape_help(self.help)}")
+        lines.append(f"# TYPE {self.name} {self.kind}")
+        return lines
+
+    def samples(self):
+        with self._lock:
+            children = list(self._children.values())
+        out = []
+        for c in children:
+            out.extend(c.samples())
+        return out
+
+    def collect(self):
+        return self.header() + self.samples()
+
+
+def _register(kind, name, help, labels=(), **kw):
+    labels = tuple(str(n) for n in labels)
     with _LOCK:
         m = _REGISTRY.get(name)
         if m is None:
-            m = kind(name, help, **kw)
+            if labels:
+                m = Family(kind, name, help, labels, **kw)
+            else:
+                m = kind(name, help, **kw)
             _REGISTRY[name] = m
-        return m
+            return m
+    # idempotent on exact agreement; a kind or label-set mismatch is a
+    # programming error surfaced at registration, not a silent wrong-type
+    # return that breaks the caller (or the scrape) at first use
+    existing_kind = m._cls if isinstance(m, Family) else type(m)
+    existing_labels = tuple(getattr(m, "labelnames", ()))
+    if existing_kind is not kind or existing_labels != labels:
+        raise ValueError(
+            f"metric {name!r} already registered as {m.kind} with labels "
+            f"{existing_labels}; cannot re-register as {kind.kind} "
+            f"with labels {labels}"
+        )
+    return m
 
 
-def counter(name, help=""):
-    return _register(Counter, name, help)
+def counter(name, help="", labels=()):
+    return _register(Counter, name, help, labels)
 
 
-def gauge(name, help=""):
-    return _register(Gauge, name, help)
+def gauge(name, help="", labels=()):
+    return _register(Gauge, name, help, labels)
 
 
-def histogram(name, help="", buckets=DEFAULT_BUCKETS):
-    return _register(Histogram, name, help, buckets=buckets)
+def histogram(name, help="", labels=(), buckets=DEFAULT_BUCKETS):
+    return _register(Histogram, name, help, labels, buckets=buckets)
+
+
+def all_metrics():
+    """(name, kind, help, labelnames) for every registered family —
+    the metrics-name lint test's view of the registry."""
+    with _LOCK:
+        items = list(_REGISTRY.values())
+    return [
+        (m.name, m.kind, m.help, tuple(getattr(m, "labelnames", ())))
+        for m in items
+    ]
 
 
 def gather() -> str:
-    """Prometheus text exposition of every registered metric."""
+    """Prometheus text exposition of every registered metric family
+    (`# HELP` + `# TYPE` headers, then the samples)."""
     with _LOCK:
         metrics = list(_REGISTRY.values())
     lines = []

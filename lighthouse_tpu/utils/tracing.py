@@ -1,0 +1,147 @@
+"""Lightweight pipeline tracing: spans threaded router -> beacon_processor
+-> verify_service -> crypto backend.
+
+Not OpenTelemetry — a process-local ring buffer of recent traces served
+at the `/lighthouse/tracing` debug endpoint, answering the delay-
+attribution question Prometheus histograms can't: for THIS block (or
+THIS verification batch), how long was queue wait vs. batch assembly vs.
+kernel time, and what pad ratio / occupancy did the device see.
+
+Usage contract:
+
+  * a pipeline entry point creates a trace (`start_trace(kind, **attrs)`)
+    and makes it current for its thread with `use(trace)`; code running
+    underneath reads `current_trace()` and attaches spans
+  * traces cross thread boundaries EXPLICITLY: verify_service requests
+    capture the submitter's current trace at submit() and the dispatcher
+    thread appends the stage spans before resolving the future
+  * `finish()` publishes the trace into the ring buffer (idempotent)
+
+Span timestamps are time.monotonic() seconds; each trace additionally
+records one wall-clock timestamp at creation for display.  Spans may
+start before the trace was created (a queued request's submit time) —
+their relative start_ms is simply negative.
+"""
+
+import itertools
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+CAPACITY = 256
+
+_BUFFER = deque(maxlen=CAPACITY)
+_BUF_LOCK = threading.Lock()
+_NEXT_ID = itertools.count(1)
+_TLS = threading.local()
+
+
+class Trace:
+    __slots__ = (
+        "trace_id", "kind", "attrs", "spans", "wall_start", "t_start",
+        "_finished", "_lock",
+    )
+
+    def __init__(self, kind, **attrs):
+        self.trace_id = next(_NEXT_ID)
+        self.kind = kind
+        self.attrs = dict(attrs)
+        self.spans = []          # (name, start, end, attrs)
+        self.wall_start = time.time()
+        self.t_start = time.monotonic()
+        self._finished = False
+        self._lock = threading.Lock()
+
+    def add_span(self, name, start=None, end=None, **attrs):
+        end = time.monotonic() if end is None else float(end)
+        start = end if start is None else float(start)
+        with self._lock:
+            self.spans.append((name, start, end, attrs))
+        return self
+
+    @contextmanager
+    def span(self, name, **attrs):
+        t0 = time.monotonic()
+        try:
+            yield self
+        finally:
+            self.add_span(name, t0, time.monotonic(), **attrs)
+
+    def finish(self, **attrs):
+        with self._lock:
+            if attrs:
+                self.attrs.update(attrs)
+            if self._finished:
+                return self
+            self._finished = True
+        with _BUF_LOCK:
+            _BUFFER.append(self)
+        return self
+
+    def span_names(self):
+        with self._lock:
+            return [s[0] for s in self.spans]
+
+    def to_dict(self):
+        with self._lock:
+            spans = list(self.spans)
+            attrs = dict(self.attrs)
+        t_end = max((e for _, _, e, _ in spans), default=self.t_start)
+        return {
+            "trace_id": self.trace_id,
+            "kind": self.kind,
+            "wall_start": round(self.wall_start, 6),
+            "duration_ms": round((t_end - self.t_start) * 1e3, 3),
+            "attrs": attrs,
+            "spans": [
+                {
+                    "name": name,
+                    "start_ms": round((s - self.t_start) * 1e3, 3),
+                    "duration_ms": round((e - s) * 1e3, 3),
+                    **({"attrs": a} if a else {}),
+                }
+                for name, s, e, a in spans
+            ],
+        }
+
+
+def start_trace(kind, **attrs):
+    return Trace(kind, **attrs)
+
+
+def current_trace():
+    stack = getattr(_TLS, "stack", None)
+    return stack[-1] if stack else None
+
+
+@contextmanager
+def use(trace):
+    """Make `trace` the calling thread's current trace for the block.
+    `use(None)` is a no-op, so call sites don't branch on optionality."""
+    if trace is None:
+        yield None
+        return
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    stack.append(trace)
+    try:
+        yield trace
+    finally:
+        stack.pop()
+
+
+def recent(limit=None):
+    """Most-recent-first dicts of the finished traces in the ring."""
+    with _BUF_LOCK:
+        traces = list(_BUFFER)
+    traces.reverse()
+    if limit is not None:
+        traces = traces[: max(int(limit), 0)]
+    return [t.to_dict() for t in traces]
+
+
+def clear():
+    with _BUF_LOCK:
+        _BUFFER.clear()

@@ -3,7 +3,9 @@
 
 Names follow the beacon_chain/src/metrics.rs convention; the batch-size
 histogram buckets are set counts (not seconds) so the exposition shows
-the coalescing distribution directly.
+the coalescing distribution directly.  Per-class series are ONE metric
+family with a `class` label (the prometheus `*Vec` shape) — Grafana
+queries select `{class="block"}` instead of name-mangled metric names.
 """
 
 from ..utils import metrics
@@ -12,18 +14,15 @@ from ..utils import metrics
 # to the device chunk ceiling
 SET_COUNT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
 
-QUEUE_DEPTH = {}
+QUEUE_DEPTH = metrics.gauge(
+    "verify_service_queue_depth",
+    "Pending verification requests per priority-class queue",
+    labels=("class",),
+)
 
 
 def queue_depth_gauge(cls_name):
-    g = QUEUE_DEPTH.get(cls_name)
-    if g is None:
-        g = metrics.gauge(
-            f"verify_service_queue_depth_{cls_name}",
-            f"Pending verification requests in the {cls_name} class queue",
-        )
-        QUEUE_DEPTH[cls_name] = g
-    return g
+    return QUEUE_DEPTH.with_labels(cls_name)
 
 
 BATCH_SETS = metrics.histogram(
@@ -33,7 +32,14 @@ BATCH_SETS = metrics.histogram(
 )
 QUEUE_WAIT = metrics.histogram(
     "verify_service_queue_wait_seconds",
-    "Submit-to-dispatch latency per request",
+    "Submit-to-dispatch latency per request, by priority class",
+    labels=("class",),
+)
+SUBMIT_RESOLVE = metrics.histogram(
+    "verify_service_submit_resolve_seconds",
+    "Submit-to-resolution latency per request (queue wait + batch "
+    "assembly + verification), by priority class",
+    labels=("class",),
 )
 BATCHES_DISPATCHED = metrics.counter(
     "verify_service_batches_total", "Micro-batches dispatched to the backend"

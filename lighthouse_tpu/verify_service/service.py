@@ -30,6 +30,7 @@ import time
 from collections import deque
 
 from ..crypto.backend import SignatureVerifier
+from ..utils import tracing
 from . import metrics as M
 from .circuit import CircuitBreaker
 
@@ -130,15 +131,20 @@ class VerifyFuture:
 
 
 class _Request:
-    __slots__ = ("sets", "future", "cls", "deadline", "submitted", "per_set")
+    __slots__ = ("sets", "future", "cls", "deadline", "submitted", "per_set",
+                 "trace")
 
-    def __init__(self, sets, future, cls, deadline, submitted, per_set):
+    def __init__(self, sets, future, cls, deadline, submitted, per_set,
+                 trace=None):
         self.sets = sets
         self.future = future
         self.cls = cls
         self.deadline = deadline
         self.submitted = submitted
         self.per_set = per_set
+        # the submitter thread's current pipeline trace: the dispatcher
+        # appends queue-wait/batch/kernel spans to it before resolving
+        self.trace = trace
 
 
 class VerificationService:
@@ -219,15 +225,26 @@ class VerificationService:
     def verify_signature_sets_per_set(self, sets, priority="attestation") -> list:
         sets = list(sets)
         if not sets or self._stopped:
-            return self._degraded_verifier().verify_signature_sets_per_set(sets)
+            return self._degraded_per_set(sets)
         try:
             fut = self.submit(sets, priority=priority, want_per_set=True)
         except QueueFullError:
-            return self._degraded_verifier().verify_signature_sets_per_set(sets)
+            return self._degraded_per_set(sets)
         try:
             return fut.result()
         except ServiceStopped:
-            return self._degraded_verifier().verify_signature_sets_per_set(sets)
+            return self._degraded_per_set(sets)
+
+    def _degraded_per_set(self, sets):
+        """Overload/shutdown degrade for the per-set wrapper: batch-verify
+        FIRST and only attribute per set on failure (the two-call pattern
+        verify_with_verdicts uses against a bare seam).  Running N
+        individual host verifications for a clean batch would multiply
+        CPU cost exactly when the queues are already saturated."""
+        v = self._degraded_verifier()
+        if sets and v.verify_signature_sets(sets):
+            return [True] * len(sets)
+        return v.verify_signature_sets_per_set(sets)
 
     # ------------------------------------------------------------ submit
 
@@ -252,7 +269,8 @@ class VerificationService:
         idx = _CLASS_INDEX[cls]
         now = time.monotonic()
         window = self.max_delay[cls] if deadline is None else float(deadline)
-        req = _Request(sets, fut, cls, now + window, now, want_per_set)
+        req = _Request(sets, fut, cls, now + window, now, want_per_set,
+                       trace=tracing.current_trace())
         with self._cv:
             if self._stopping():
                 fut.set_error(ServiceStopped("verification service stopped"))
@@ -399,12 +417,36 @@ class VerificationService:
             return self.verifier
         return self._host()
 
+    def _resolve(self, req, value=None, error=None):
+        """Complete one request's future, observing the per-class
+        submit->resolve delay (the attestation/aggregate analogue of the
+        BlockTimesCache's per-stage block delays)."""
+        M.SUBMIT_RESOLVE.with_labels(req.cls).observe(
+            time.monotonic() - req.submitted
+        )
+        if error is not None:
+            req.future.set_error(error)
+        else:
+            req.future.set_result(value)
+
+    def _attach_spans(self, reqs, t_dispatch, t_k0, t_k1, attrs):
+        """Append the dispatcher's stage spans to each submitter trace
+        (the cross-thread handoff: the request captured its submitter's
+        current trace; the dispatcher reports where the time went)."""
+        for r in reqs:
+            tr = r.trace
+            if tr is None:
+                continue
+            tr.add_span("queue_wait", r.submitted, t_dispatch, cls=r.cls)
+            tr.add_span("batch", t_dispatch, t_k0, **attrs)
+            tr.add_span("kernel", t_k0, t_k1, backend=attrs.get("backend"))
+
     def _dispatch(self, reqs):
         now = time.monotonic()
         all_sets = []
         for r in reqs:
             wait = now - r.submitted
-            M.QUEUE_WAIT.observe(wait)
+            M.QUEUE_WAIT.with_labels(r.cls).observe(wait)
             self.recent_waits.append(wait)
             all_sets.extend(r.sets)
         M.BATCH_SETS.observe(len(all_sets))
@@ -415,18 +457,40 @@ class VerificationService:
 
         v = self._active_verifier()
         device_attempt = v is self.verifier and self.backend == "tpu"
+        batch_attrs = {
+            "sets": len(all_sets),
+            "requests": len(reqs),
+            "coalesced": len(reqs) > 1,
+            "classes": sorted({r.cls for r in reqs}),
+            "backend": getattr(v, "backend", "host"),
+        }
+        # the service's own trace of this batch: queue wait (oldest
+        # submit), batch bookkeeping, and the kernel call — with any
+        # device-level spans (pad ratio, chunking) the crypto backend
+        # attaches while this trace is current
+        bt = tracing.start_trace("verify_batch", **batch_attrs)
+        bt.add_span("queue_wait", min(r.submitted for r in reqs), now)
         self._device_event = False
+        t_k0 = time.monotonic()
+        bt.add_span("batch", now, t_k0, **batch_attrs)
         try:
-            ok = v.verify_signature_sets(all_sets)
+            with tracing.use(bt):
+                ok = v.verify_signature_sets(all_sets)
         except Exception as e:
             # the seam's internal fallback chain should make this
             # unreachable; fail the batch's futures rather than hang them
             log.exception("verification batch failed hard")
+            t_k1 = time.monotonic()
+            bt.add_span("kernel", t_k0, t_k1, error=str(e)[:200])
+            bt.finish(ok=False)
             if device_attempt:
                 self.breaker.record_failure()
+            self._attach_spans(reqs, now, t_k0, t_k1, batch_attrs)
             for r in reqs:
-                r.future.set_error(e)
+                self._resolve(r, error=e)
             return
+        t_k1 = time.monotonic()
+        bt.add_span("kernel", t_k0, t_k1, backend=batch_attrs["backend"])
         if device_attempt:
             if self._device_event:
                 self.breaker.record_failure()
@@ -434,32 +498,41 @@ class VerificationService:
                 self.breaker.record_success()
 
         if ok:
+            bt.finish(ok=True)
+            self._attach_spans(reqs, now, t_k0, t_k1, batch_attrs)
             for r in reqs:
-                r.future.set_result([True] * len(r.sets) if r.per_set else True)
+                self._resolve(r, [True] * len(r.sets) if r.per_set else True)
             return
 
         if len(reqs) == 1 and not reqs[0].per_set:
             # single submitter wanting a bool: the batch verdict IS its
             # verdict — no attribution pass needed (the caller runs its
             # own per-set fallback, same as against the bare seam)
-            reqs[0].future.set_result(False)
+            bt.finish(ok=False)
+            self._attach_spans(reqs, now, t_k0, t_k1, batch_attrs)
+            self._resolve(reqs[0], False)
             return
 
         # poisoned multi-caller batch: ONE per-set pass attributes the
         # failure; innocent submitters still succeed
         M.POISONED_BATCHES.inc()
         try:
-            verdicts = v.verify_signature_sets_per_set(all_sets)
+            with tracing.use(bt), bt.span("attribution"):
+                verdicts = v.verify_signature_sets_per_set(all_sets)
         except Exception as e:
             log.exception("per-set attribution pass failed hard")
+            bt.finish(ok=False)
+            self._attach_spans(reqs, now, t_k0, t_k1, batch_attrs)
             for r in reqs:
-                r.future.set_error(e)
+                self._resolve(r, error=e)
             return
+        bt.finish(ok=False, poisoned=True)
+        self._attach_spans(reqs, now, t_k0, t_k1, batch_attrs)
         pos = 0
         for r in reqs:
             mine = list(verdicts[pos:pos + len(r.sets)])
             pos += len(r.sets)
-            r.future.set_result(mine if r.per_set else all(mine))
+            self._resolve(r, mine if r.per_set else all(mine))
 
     # ----------------------------------------------------------- insight
 

@@ -1,0 +1,150 @@
+"""BlockTimesCache: delay arithmetic across slot boundaries, pruning,
+and the end-to-end acceptance drive — a block through gossip -> verify ->
+import -> head populates all five ordered timestamps, the delay
+histograms, and a complete span trace on the tracing ring."""
+
+import re
+
+from lighthouse_tpu.beacon.beacon_processor import BeaconProcessor
+from lighthouse_tpu.beacon.block_times_cache import BlockTimesCache
+from lighthouse_tpu.beacon.chain import BeaconChain
+from lighthouse_tpu.crypto.backend import SignatureVerifier
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.testing.harness import Harness
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+from lighthouse_tpu.utils import metrics, tracing
+from lighthouse_tpu.verify_service import VerificationService
+
+SPEC = ChainSpec(preset=MinimalPreset)
+ROOT = b"\x11" * 32
+
+
+def test_delay_arithmetic_across_slot_boundary():
+    cache = BlockTimesCache()
+    # genesis 100, 6 s slots: slot 5 starts at 130, slot 6 at 136
+    slot_start = 130.0
+    cache.set_time_observed(ROOT, 5, timestamp=131.0)
+    cache.set_time_signature_verified(ROOT, 5, timestamp=131.5)
+    cache.set_time_executed(ROOT, 5, timestamp=132.0)
+    cache.set_time_imported(ROOT, 5, timestamp=133.0)
+    # head election lands AFTER the next slot boundary
+    cache.set_time_set_as_head(ROOT, 5, timestamp=137.0)
+    d = cache.delays(ROOT, slot_start)
+    assert d["observed"] == 1.0
+    assert d["signature_verified"] == 0.5
+    assert d["executed"] == 0.5
+    assert d["imported"] == 1.0
+    assert d["set_as_head"] == 7.0          # > one slot: crossed boundary
+    assert d["set_as_head"] > 6.0
+
+
+def test_first_sighting_wins_and_missing_stages():
+    cache = BlockTimesCache()
+    cache.set_time_observed(ROOT, 3, timestamp=10.0)
+    cache.set_time_observed(ROOT, 3, timestamp=99.0)   # later dupe ignored
+    assert cache.get(ROOT).observed == 10.0
+    d = cache.delays(ROOT, 9.0)
+    assert d["observed"] == 1.0
+    assert d["signature_verified"] is None
+    assert d["set_as_head"] is None
+    # an unobserved root yields no delays at all
+    assert cache.delays(b"\x22" * 32, 0.0) is None
+
+
+def test_negative_skew_kept_raw_but_clamped_in_histograms():
+    cache = BlockTimesCache()
+    other = b"\x33" * 32
+    cache.set_time_observed(other, 1, timestamp=5.0)
+    cache.set_time_set_as_head(other, 1, timestamp=5.5)
+    d = cache.delays(other, 6.0)            # observed BEFORE slot start
+    assert d["observed"] == -1.0
+    before = _hist_sum("beacon_block_observed_slot_start_delay_seconds")
+    cache.observe_delays(other, 6.0)
+    assert _hist_sum("beacon_block_observed_slot_start_delay_seconds") == before
+
+
+def test_observe_delays_reports_once():
+    """A reorg re-electing a previous head must not double-count."""
+    cache = BlockTimesCache()
+    r = b"\x44" * 32
+    cache.set_time_observed(r, 1, timestamp=10.0)
+    cache.set_time_set_as_head(r, 1, timestamp=11.0)
+    assert cache.observe_delays(r, 9.0) is not None
+    assert cache.observe_delays(r, 9.0) is None
+
+
+def test_prune_drops_old_roots():
+    cache = BlockTimesCache(horizon_slots=4)
+    for slot in range(1, 11):
+        cache.set_time_observed(bytes([slot]) * 32, slot)
+    cache.prune(10)
+    assert len(cache) == 5                  # slots 6..10 survive
+    assert cache.get(bytes([5]) * 32) is None
+    assert cache.get(bytes([6]) * 32) is not None
+
+
+def _hist_sum(name):
+    m = re.search(rf"{name}_sum(?:{{[^}}]*}})? ([0-9.e+-]+)", metrics.gather())
+    return float(m.group(1)) if m else 0.0
+
+
+def _hist_count(name):
+    m = re.search(rf"^{name}_count ([0-9]+)$", metrics.gather(), re.M)
+    return int(m.group(1)) if m else 0
+
+
+def test_block_pipeline_populates_cache_metrics_and_traces():
+    """ISSUE 2 acceptance: gossip -> verify -> import -> head yields a
+    populated BlockTimesCache entry with five ordered timestamps,
+    non-zero delay histograms in /metrics, and a complete span trace
+    (queue-wait + batch + kernel) on the tracing ring."""
+    tracing.clear()
+    counts_before = {
+        name: _hist_count(name)
+        for name in (
+            "beacon_block_observed_slot_start_delay_seconds",
+            "beacon_block_signature_verified_delay_seconds",
+            "beacon_block_executed_delay_seconds",
+            "beacon_block_imported_delay_seconds",
+            "beacon_block_set_as_head_slot_start_delay_seconds",
+        )
+    }
+    h = Harness(8, SPEC)
+    service = VerificationService(SignatureVerifier("oracle"))
+    chain = BeaconChain(h.state.copy(), SPEC, verifier=service)
+    processor = BeaconProcessor(chain)
+    block = h.produce_block(1)
+    h.process_block(block, strategy="no_verification")
+    root = hash_tree_root(block.message)
+    chain.on_tick(1)
+    # the router's network-arrival stamp (gossip-observed) precedes the
+    # processor queue
+    chain.block_times_cache.set_time_observed(root, 1)
+    assert processor.enqueue_block(block)
+    assert processor.process_pending() >= 1
+    assert chain.head_root == root
+
+    entry = chain.block_times_cache.get(root)
+    stamps = [entry.observed, entry.signature_verified, entry.executed,
+              entry.imported, entry.set_as_head]
+    assert all(s is not None for s in stamps), entry.as_dict()
+    assert stamps == sorted(stamps), entry.as_dict()
+
+    for name, before in counts_before.items():
+        assert _hist_count(name) == before + 1, name
+    assert _hist_sum("beacon_block_set_as_head_slot_start_delay_seconds") > 0
+
+    traces = tracing.recent()
+    assert traces, "no traces on the ring"
+    complete = [
+        t for t in traces
+        if {"queue_wait", "batch", "kernel"} <= {s["name"] for s in t["spans"]}
+    ]
+    assert complete, [t["kind"] for t in traces]
+    # the gossip_block trace threads processor queue wait through the
+    # verify_service stage spans
+    gb = next(t for t in traces if t["kind"] == "gossip_block")
+    gb_names = {s["name"] for s in gb["spans"]}
+    assert {"queue_wait", "process", "kernel"} <= gb_names
+    assert gb["attrs"].get("ok") is True
+    service.stop()

@@ -1,0 +1,201 @@
+"""Labeled metrics exposition, registry idempotence, Gauge API, the
+metrics-name lint, and the /lighthouse observability endpoints.
+
+Covers ISSUE 2's metrics-layer acceptance criteria: `# HELP` lines in
+`gather()`, per-class queue depth as ONE labeled family (name-mangled
+gauges gone), consistent float `le` bucket bounds with `+Inf` last, and
+the Prometheus-naming lint that keeps new metrics scrapeable.
+"""
+
+import json
+import re
+import threading
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.utils import metrics
+
+
+def test_counter_labels_exposition():
+    c = metrics.counter("t_labeled_total", "labeled counter", labels=("class",))
+    c.with_labels("block").inc()
+    c.with_labels("attestation").inc(3)
+    text = metrics.gather()
+    assert "# HELP t_labeled_total labeled counter" in text
+    assert "# TYPE t_labeled_total counter" in text
+    assert 't_labeled_total{class="block"} 1' in text
+    assert 't_labeled_total{class="attestation"} 3' in text
+
+
+def test_label_values_escape():
+    c = metrics.counter("t_escape_total", "escaping", labels=("who",))
+    c.with_labels('a"b\\c\nd').inc()
+    text = metrics.gather()
+    assert 't_escape_total{who="a\\"b\\\\c\\nd"} 1' in text
+    # the raw metasharacters must not appear unescaped inside the braces
+    assert 'who="a"b' not in text
+
+
+def test_help_lines_escape_newlines():
+    metrics.counter("t_help_total", "line one\nline two").inc()
+    assert "# HELP t_help_total line one\\nline two" in metrics.gather()
+
+
+def test_registry_idempotence():
+    a = metrics.counter("t_same_total", "first registration")
+    b = metrics.counter("t_same_total", "second registration ignored")
+    assert a is b
+    f1 = metrics.gauge("t_same_depth", "fam", labels=("class",))
+    f2 = metrics.gauge("t_same_depth", "fam", labels=("class",))
+    assert f1 is f2
+    assert f1.with_labels("x") is f2.with_labels("x")
+    assert f1.with_labels("x") is not f1.with_labels("y")
+    # one family header even with many children
+    text = metrics.gather()
+    assert text.count("# TYPE t_same_depth gauge") == 1
+
+
+def test_registry_rejects_kind_or_label_mismatch():
+    metrics.counter("t_conflict_total", "as counter")
+    with pytest.raises(ValueError):
+        metrics.gauge("t_conflict_total", "as gauge")
+    metrics.gauge("t_conflict_depth", "labeled", labels=("class",))
+    with pytest.raises(ValueError):
+        metrics.gauge("t_conflict_depth", "unlabeled")
+    with pytest.raises(ValueError):
+        metrics.gauge("t_conflict_depth", "other labels", labels=("kind",))
+
+
+def test_histogram_le_floats_and_inf_last():
+    h = metrics.histogram("t_le_seconds", "le formatting", buckets=(1, 2.5))
+    h.observe(1.5)
+    h.observe(100.0)
+    lines = [
+        line for line in metrics.gather().splitlines()
+        if line.startswith("t_le_seconds_bucket")
+    ]
+    les = [re.search(r'le="([^"]+)"', line).group(1) for line in lines]
+    assert les[-1] == "+Inf"
+    for le in les[:-1]:
+        float(le)                      # parses as a float
+        assert "." in le               # formatted AS a float ("1.0" not "1")
+    # cumulative counts: 1 below 2.5, 2 total
+    counts = [int(line.rsplit(" ", 1)[1]) for line in lines]
+    assert counts == [0, 1, 2]
+    assert "t_le_seconds_sum 101.5" in metrics.gather()
+
+
+def test_labeled_histogram_merges_le_with_labels():
+    h = metrics.histogram(
+        "t_le_labeled_seconds", "labeled le", labels=("class",), buckets=(1,)
+    )
+    with h.with_labels("block").start_timer():
+        pass
+    text = metrics.gather()
+    assert 't_le_labeled_seconds_bucket{class="block",le="1.0"} 1' in text
+    assert 't_le_labeled_seconds_bucket{class="block",le="+Inf"} 1' in text
+    assert 't_le_labeled_seconds_count{class="block"} 1' in text
+
+
+def test_gauge_inc_dec_threadsafe():
+    g = metrics.gauge("t_gauge_depth", "gauge API")
+    g.set(10)
+    g.inc()
+    g.dec(2)
+    assert g.value == 9
+
+    g.set(0)
+
+    def hammer():
+        for _ in range(1000):
+            g.inc()
+        for _ in range(1000):
+            g.dec()
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert g.value == 0
+
+
+def test_metric_name_lint():
+    """Every registered metric matches the Prometheus naming regex and
+    carries non-empty help text — new metrics can't silently break
+    scrapes.  Importing the metrics-bearing modules first makes the lint
+    cover the real registry, not just this file's test metrics."""
+    import lighthouse_tpu.beacon.beacon_processor  # noqa: F401
+    import lighthouse_tpu.beacon.block_times_cache  # noqa: F401
+    import lighthouse_tpu.beacon.validator_monitor  # noqa: F401
+    import lighthouse_tpu.verify_service.metrics  # noqa: F401
+
+    name_re = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+    label_re = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*")
+    registered = metrics.all_metrics()
+    assert len(registered) > 10
+    for name, kind, help_text, labels in registered:
+        assert name_re.fullmatch(name), f"bad metric name {name!r}"
+        assert kind in ("counter", "gauge", "histogram"), (name, kind)
+        assert help_text and help_text.strip(), f"{name} has empty help"
+        for label in labels:
+            assert label_re.fullmatch(label), f"{name}: bad label {label!r}"
+            assert not label.startswith("__"), f"{name}: reserved {label!r}"
+
+
+def test_verify_service_queue_depth_is_one_labeled_family():
+    """Acceptance: per-class queue depth is ONE metric family with a
+    `class` label; the old name-mangled gauges are gone."""
+    from lighthouse_tpu.crypto.backend import SignatureVerifier
+    from lighthouse_tpu.verify_service import VerificationService
+
+    service = VerificationService(SignatureVerifier("fake"))
+    assert service.verify_signature_sets([object()], priority="block") is True
+    service.stop()
+    text = metrics.gather()
+    assert 'verify_service_queue_depth{class="block"}' in text
+    assert "verify_service_queue_depth_block" not in text
+    assert "# HELP verify_service_queue_depth " in text
+    # the submit->resolve breakdown rides the same label scheme
+    assert 'verify_service_submit_resolve_seconds_count{class="block"}' in text
+
+
+def test_tracing_and_health_endpoints():
+    """/lighthouse/tracing serves the span ring buffer and
+    /lighthouse/ui/health the system snapshot, next to /metrics."""
+    from lighthouse_tpu.api.http_api import BeaconApiServer
+    from lighthouse_tpu.beacon.chain import BeaconChain
+    from lighthouse_tpu.crypto.backend import SignatureVerifier
+    from lighthouse_tpu.testing.harness import Harness
+    from lighthouse_tpu.types import ChainSpec, MinimalPreset
+    from lighthouse_tpu.utils import tracing
+    from lighthouse_tpu.verify_service import VerificationService
+
+    h = Harness(8, ChainSpec(preset=MinimalPreset))
+    service = VerificationService(SignatureVerifier("fake"))
+    chain = BeaconChain(h.state.copy(), ChainSpec(preset=MinimalPreset),
+                        verifier=service)
+    # one dispatched batch -> one finished verify_batch trace
+    assert service.verify_signature_sets([object()], priority="block") is True
+    server = BeaconApiServer(chain).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/lighthouse/tracing?limit=16") as r:
+            traces = json.load(r)["data"]
+        kinds = {t["kind"] for t in traces}
+        assert "verify_batch" in kinds
+        batch = next(t for t in traces if t["kind"] == "verify_batch")
+        names = {s["name"] for s in batch["spans"]}
+        assert {"queue_wait", "batch", "kernel"} <= names
+        with urllib.request.urlopen(base + "/lighthouse/ui/health") as r:
+            health = json.load(r)["data"]
+        assert "beacon" in health and "cpu_count" in health
+        assert health["beacon"]["head_slot"] == 0
+        with urllib.request.urlopen(base + "/metrics") as r:
+            text = r.read().decode()
+        assert "# HELP" in text and "# TYPE" in text
+    finally:
+        server.stop()
+        service.stop()
+    assert tracing.recent(1)  # ring buffer non-empty
