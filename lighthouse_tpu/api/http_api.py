@@ -216,6 +216,39 @@ class _Handler(JsonHandler):
         except Exception as e:
             self._err(500, str(e))
 
+    def do_PATCH(self):
+        url = urlparse(self.path)
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(length) or b"null"
+            try:
+                body = json.loads(raw)
+            except json.JSONDecodeError as e:
+                return self._err(400, f"malformed JSON body: {e}")
+            return self._route_patch(url.path.rstrip("/"), body)
+        except Exception as e:
+            self._err(500, str(e))
+
+    def _route_patch(self, path, body):
+        if path == "/lighthouse/logs/level":
+            # runtime log-level control: {"level": "...", "component":
+            # "..."} — omit component to set the package-wide default.
+            # Takes effect immediately, no restart.
+            from ..utils import logging as ltpu_logging
+
+            if not isinstance(body, dict) or "level" not in body:
+                return self._err(400, 'body must be {"level": ..., '
+                                      '"component": optional}')
+            component = body.get("component")
+            try:
+                applied = ltpu_logging.set_level(component, body["level"])
+            except ValueError as e:
+                return self._err(400, str(e))
+            return self._json({"data": {
+                "component": component or "root", "level": applied,
+            }})
+        return self._err(404, f"no route {path}")
+
     def _route_get(self, path, q):
         chain = self.chain
         if path == "/eth/v1/node/health":
@@ -720,6 +753,91 @@ class _Handler(JsonHandler):
             if kind is not None:
                 traces = [t for t in traces if t["kind"] == kind][:limit]
             return self._json({"data": traces})
+
+        if path == "/lighthouse/logs/recent":
+            # newest-first structured records from the flight recorder's
+            # ring buffer; ?level= filters at-or-above, ?component= exact
+            from ..utils import logging as ltpu_logging
+
+            limit = int(q.get("limit", ["128"])[0])
+            try:
+                records = ltpu_logging.recent(
+                    limit=limit,
+                    level=q.get("level", [None])[0],
+                    component=q.get("component", [None])[0],
+                )
+            except ValueError as e:
+                return self._err(400, str(e))
+            return self._json({"data": records})
+
+        if path == "/lighthouse/logs/level":
+            # GET view of the PATCH knob: effective level per component
+            from ..utils import logging as ltpu_logging
+
+            return self._json({"data": ltpu_logging.levels()})
+
+        if path == "/lighthouse/logs":
+            # live log stream, /eth/v1/events SSE framing (`event: log`),
+            # with the same ?level=/?component= filters as /recent
+            from ..utils import logging as ltpu_logging
+
+            try:
+                floor = (
+                    ltpu_logging.parse_level(q["level"][0])
+                    if "level" in q else 0
+                )
+            except ValueError as e:
+                return self._err(400, str(e))
+            component = q.get("component", [None])[0]
+            sub = ltpu_logging.subscribe()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+            import queue as _queue
+
+            try:
+                while True:
+                    try:
+                        rec = sub.get(timeout=1.0)
+                    except _queue.Empty:
+                        self.wfile.write(b": keepalive\n\n")
+                        self.wfile.flush()
+                        continue
+                    if ltpu_logging.LEVELS.get(rec["level"], 0) < floor:
+                        continue
+                    if component is not None and \
+                            rec["component"] != component:
+                        continue
+                    self.wfile.write(ltpu_logging.sse_frame(rec))
+                    self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                return
+            finally:
+                ltpu_logging.unsubscribe(sub)
+
+        if path == "/lighthouse/ui/validator-metrics":
+            # per-monitored-validator summaries (the reference UI's
+            # POST /lighthouse/ui/validator-metrics role); ?epoch= adds
+            # the closed-epoch hit/miss table
+            mon = chain.validator_monitor
+            spe = chain.preset.slots_per_epoch
+            current_epoch = int(chain.current_slot) // spe
+            data = {
+                "current_epoch": current_epoch,
+                "validators": {
+                    str(v): mon.summary(v, current_epoch=current_epoch)
+                    for v in sorted(mon.monitored)
+                },
+            }
+            if "epoch" in q:
+                epoch = int(q["epoch"][0])
+                data["epoch"] = epoch
+                data["epoch_summary"] = {
+                    str(v): row
+                    for v, row in mon.epoch_summary(epoch, spe).items()
+                }
+            return self._json({"data": data})
 
         if path == "/lighthouse/ui/health":
             # the reference's /lighthouse/ui/health JSON snapshot, built

@@ -14,14 +14,14 @@ re-verifications; and the reprocessing queue (work_reprocessing_queue.rs)
 holds early/unknown-parent objects for retry on the next tick.
 """
 
-import logging
 import threading
 import time
 from collections import deque
 
 from ..utils import metrics, tracing
+from ..utils.logging import get_logger
 
-log = logging.getLogger("lighthouse_tpu.processor")
+log = get_logger("beacon_processor")
 
 # queue caps (mod.rs:89-204 has explicit caps per queue kind)
 MAX_GOSSIP_BLOCK_QUEUE = 1024
@@ -70,34 +70,56 @@ class BeaconProcessor:
 
     # ---------------------------------------------------------- enqueue
 
+    def _warn_dropped(self, kind, depth):
+        """OUTSIDE self._lock — the log handlers do console/file I/O
+        that must never stall the enqueue path's lock."""
+        log.warning_rate_limited(
+            f"drop:{kind}", 1.0, "%s queue full; dropping", kind, depth=depth,
+        )
+
     def enqueue_block(self, signed_block):
         with self._lock:
-            if len(self.block_queue) >= MAX_GOSSIP_BLOCK_QUEUE:
+            depth = len(self.block_queue)
+            if depth >= MAX_GOSSIP_BLOCK_QUEUE:
                 WORK_DROPPED.inc()
-                return False
-            trace = tracing.start_trace(
-                "gossip_block", slot=int(signed_block.message.slot)
-            )
-            self.block_queue.append(
-                WorkEvent("block", signed_block, trace=trace)
-            )
+            else:
+                depth = None
+                trace = tracing.start_trace(
+                    "gossip_block", slot=int(signed_block.message.slot)
+                )
+                self.block_queue.append(
+                    WorkEvent("block", signed_block, trace=trace)
+                )
+        if depth is not None:
+            self._warn_dropped("block", depth)
+            return False
         return True
 
     def enqueue_attestation(self, attestation):
         with self._lock:
-            if len(self.attestation_queue) >= MAX_GOSSIP_ATTESTATION_QUEUE:
+            depth = len(self.attestation_queue)
+            if depth >= MAX_GOSSIP_ATTESTATION_QUEUE:
                 # LIFO semantics: drop the OLDEST (leftmost) to make room
                 self.attestation_queue.popleft()
                 WORK_DROPPED.inc()
+            else:
+                depth = None
             self.attestation_queue.append(WorkEvent("attestation", attestation))
+        if depth is not None:
+            self._warn_dropped("attestation", depth)
         return True
 
     def enqueue_aggregate(self, signed_aggregate):
         with self._lock:
-            if len(self.aggregate_queue) >= MAX_GOSSIP_AGGREGATE_QUEUE:
+            depth = len(self.aggregate_queue)
+            if depth >= MAX_GOSSIP_AGGREGATE_QUEUE:
                 self.aggregate_queue.popleft()
                 WORK_DROPPED.inc()
+            else:
+                depth = None
             self.aggregate_queue.append(WorkEvent("aggregate", signed_aggregate))
+        if depth is not None:
+            self._warn_dropped("aggregate", depth)
         return True
 
     # ------------------------------------------------------------ drain

@@ -19,8 +19,6 @@ The chain is device-backend-generic via crypto.backend.SignatureVerifier
 (tpu kernel with host-oracle fallback; `fake` for STF-only tests).
 """
 
-import logging
-
 from ..crypto.backend import SignatureVerifier
 from ..verify_service import verify_with_verdicts
 from ..fork_choice.fork_choice import ForkChoice, InvalidAttestation
@@ -30,9 +28,10 @@ from ..state_processing import phase0
 from ..state_processing import signature_sets as sset
 from ..state_processing.phase0 import BlockSignatureStrategy
 from ..utils import metrics
+from ..utils.logging import get_logger
 from .validator_pubkey_cache import ValidatorPubkeyCache
 
-log = logging.getLogger("lighthouse_tpu.chain")
+log = get_logger("chain")
 
 
 class BlockError(Exception):

@@ -8,12 +8,12 @@ distance, balances and proposals — exposed as metrics, logs and queryable
 per-epoch summaries.
 """
 
-import logging
 from collections import defaultdict
 
 from ..utils import metrics
+from ..utils.logging import get_logger
 
-log = logging.getLogger("lighthouse_tpu.validator_monitor")
+log = get_logger("validator_monitor")
 
 MONITOR_ATTESTATION_HITS = metrics.counter(
     "validator_monitor_attestation_included_total",
@@ -103,6 +103,7 @@ class ValidatorMonitor:
             "(slot-start delay %s s)",
             proposer, slot,
             "?" if total is None else round(total, 3),
+            validator=proposer, slot=int(slot),
         )
 
     def process_imported_block(self, state, signed_block, preset):
@@ -201,6 +202,7 @@ class ValidatorMonitor:
                 log.warning(
                     "validator %d MISSED attestation in epoch %d%s", v, epoch,
                     " (seen on gossip but not included)" if seen else "",
+                    validator=v, epoch=epoch, gossip_seen=seen,
                 )
             else:
                 log.info(

@@ -43,6 +43,15 @@ def _add_common(p):
     p.add_argument("--altair-fork-epoch", type=int, default=None)
     p.add_argument("--config", help="JSON flags file (clap_utils flags.rs)")
     p.add_argument("--dump-config", action="store_true")
+    # structured-logging setup shared by the daemon subcommands
+    # (utils/logging.py; the reference's --logfile/--log-format flags)
+    p.add_argument("--log-level", default="info",
+                   choices=["debug", "info", "warning", "error", "critical"])
+    p.add_argument("--log-format", default="text", choices=["text", "json"],
+                   help="console log format")
+    p.add_argument("--logfile", default=None, metavar="PATH",
+                   help="also write JSON logs to PATH with size-based "
+                        "rotation")
 
 
 def build_parser():
@@ -273,13 +282,12 @@ def _run_lcli(args):
 
 
 def _run_bn(args):
-    import logging
     import os
 
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(levelname)s %(name)s %(message)s",
-    )
+    from .utils.logging import setup_logging
+
+    setup_logging(level=args.log_level, fmt=args.log_format,
+                  logfile=args.logfile)
     spec = _spec_from_args(args)
     from .beacon.node import ClientBuilder
     from .state_processing.genesis import interop_genesis_state, interop_keypairs
@@ -378,14 +386,13 @@ def _run_vc(args):
     the Beacon API, run duties on the slot clock
     (validator_client/src/lib.rs:491 start_service)."""
     import glob
-    import logging
     import os
     import time
 
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(levelname)s %(name)s %(message)s",
-    )
+    from .utils.logging import setup_logging
+
+    setup_logging(level=args.log_level, fmt=args.log_format,
+                  logfile=args.logfile)
     spec = _spec_from_args(args)
     from .api.client import BeaconApiClient
     from .crypto import keys

@@ -17,11 +17,10 @@ must degrade to blst, or a node outage becomes consensus-critical"),
 counting the event in metrics.
 """
 
-import logging
-
 from ..utils import metrics
+from ..utils.logging import get_logger
 
-log = logging.getLogger("lighthouse_tpu.crypto")
+log = get_logger("crypto")
 
 
 def _host_verify(sets):

@@ -14,7 +14,10 @@ from ..ssz import hash_tree_root
 from ..state_processing import phase0
 from ..types.containers import DepositData, DepositMessage
 from ..types.state import state_types
+from ..utils.logging import get_logger
 from .deposit_tree import DepositTree
+
+log = get_logger("eth1")
 
 ETH1_FOLLOW_DISTANCE = 2048
 SECONDS_PER_ETH1_BLOCK = 14
@@ -125,6 +128,11 @@ def get_eth1_vote(state, cache, preset):
     for v in period_votes:
         key = (bytes(v.deposit_root), int(v.deposit_count), bytes(v.block_hash))
         if key not in candidates:
+            # peers voting eth1 data we can't see usually means our view
+            # of the deposit chain is lagging — worth a trace in the
+            # flight recorder, not worth a warning per vote
+            log.debug("eth1 vote for unknown candidate block ignored",
+                      deposit_count=int(v.deposit_count))
             continue
         # never vote below the chain's recorded deposit count
         if int(v.deposit_count) < int(state.eth1_data.deposit_count):
@@ -200,4 +208,7 @@ def initialize_beacon_state_from_eth1(eth1_block, deposits, spec, T=None):
     state.genesis_validators_root = hash_tree_root(
         validators_type, state.validators
     )
+    log.info("eth1 genesis state initialized: %d validators",
+             len(state.validators),
+             deposits=len(deposits), eth1_block=int(eth1_block.number))
     return state

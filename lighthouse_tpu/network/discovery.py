@@ -204,6 +204,14 @@ def verify_records(records, verifier=None, cache=None):
             ok, verdicts = verify_with_verdicts(
                 verifier, sets, priority="discovery"
             )
+            if getattr(verdicts, "shed", False):
+                # overload shed, not a signature verdict: the page is
+                # dropped (all False) but MUST NOT enter the cache — its
+                # invariant is that a record's verdict never changes, and
+                # these records may be perfectly valid once load clears
+                for i in todo:
+                    out[i] = False
+                return out
             fresh = [True] * len(todo) if ok else list(verdicts)
         for i, v in zip(todo, fresh):
             out[i] = bool(v)

@@ -5,11 +5,10 @@ Mirror of /root/reference/beacon_node/network/src/router.rs:234
 batches, BlockLookups parent lookups).
 """
 
-import logging
-
+from ..utils.logging import get_logger
 from .gossip import GossipKind
 
-log = logging.getLogger("lighthouse_tpu.router")
+log = get_logger("router")
 
 
 class Router:
@@ -146,6 +145,8 @@ class Router:
                 chain.store.put_block(hash_tree_root(b.message), b)
             total += len(blocks)
             next_top = start
+        log.info("backfill complete: %d blocks stored", total,
+                 peer=str(peer_id), verified=verify_signatures)
         return total
 
     def range_sync_from(self, peer_id, batch_epochs=2):
@@ -163,6 +164,9 @@ class Router:
             )
             blocks = [b for b in blocks if int(b.message.slot) >= start]
             if not blocks:
+                if imported:
+                    log.info("range sync complete: %d blocks imported",
+                             imported, peer=str(peer_id))
                 return imported
             self.chain.on_tick(int(blocks[-1].message.slot))
             self.chain.process_chain_segment(blocks)

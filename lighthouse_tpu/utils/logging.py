@@ -1,0 +1,420 @@
+"""Structured logging flight-recorder: the node's black-box event layer.
+
+Mirror of the reference's `common/logging` crate (SSE log delivery for
+the UI via SSELoggingComponents, the `crit/error/warn_total` metrics,
+size-rotated file logging) built over stdlib `logging` so existing
+`logging.getLogger("lighthouse_tpu.*")` call sites keep working:
+
+  * `get_logger("verify_service")` returns a component-scoped logger
+    whose records carry (ts, level, component, msg, fields) plus the
+    active `tracing.current_trace()` trace_id — a WARN inside a traced
+    dispatch is joinable against the `/lighthouse/tracing` span that
+    produced it
+  * a `_FlightRecorder` handler on the package root logger captures
+    EVERY `lighthouse_tpu.*` record (converted call sites and legacy
+    stdlib ones alike) into a bounded ring buffer, increments the
+    `lighthouse_logs_total{level,component}` counter family, and fans
+    out live to SSE subscribers (the beacon/events.py EventBroadcaster
+    pattern — reimplemented here, not imported, so this module depends
+    only on utils and never drags the beacon package into crypto-layer
+    imports)
+  * runtime per-component level control (`set_level`) backing the
+    `PATCH /lighthouse/logs/level` route, so a noisy component can be
+    silenced — or a quiet one opened up to debug — without a restart
+  * `setup_logging()` replaces the daemon entry points' duplicated
+    `logging.basicConfig` blocks: text or JSON console output plus an
+    optional size-rotated JSON logfile (stdlib RotatingFileHandler —
+    no wheels)
+
+Severity parity with prometheus conventions: level label values are the
+lowercase python names (debug/info/warning/error/critical).
+"""
+
+import json
+import logging as _stdlog
+import queue
+import threading
+import time
+from collections import deque
+from logging.handlers import RotatingFileHandler
+
+from . import metrics, tracing
+
+ROOT = "lighthouse_tpu"
+RING_CAPACITY = 1024
+
+LEVELS = {
+    "debug": _stdlog.DEBUG,
+    "info": _stdlog.INFO,
+    "warning": _stdlog.WARNING,
+    "error": _stdlog.ERROR,
+    "critical": _stdlog.CRITICAL,
+}
+
+LOGS_TOTAL = metrics.counter(
+    "lighthouse_logs_total",
+    "Structured log records by severity and component",
+    labels=("level", "component"),
+)
+
+_TEXT_FORMAT = "%(asctime)s %(levelname)s %(name)s %(message)s"
+
+
+def parse_level(level):
+    """'warning' | 'WARNING' | 30 -> 30; raises ValueError on junk."""
+    if isinstance(level, int):
+        return level
+    try:
+        return LEVELS[str(level).lower()]
+    except KeyError:
+        raise ValueError(f"unknown log level {level!r}") from None
+
+
+def _component_of(record):
+    """Component for a stdlib record: the explicit `component` extra a
+    ComponentLogger stamps, else the logger-name suffix (so legacy
+    `lighthouse_tpu.wire`-style loggers are attributed too)."""
+    comp = getattr(record, "component", None)
+    if comp:
+        return comp
+    name = record.name
+    if name.startswith(ROOT + "."):
+        return name[len(ROOT) + 1:].split(".", 1)[0]
+    return "node"
+
+
+def structured(record):
+    """The flight-recorder dict for one stdlib LogRecord; the active
+    pipeline trace (if any) is injected HERE, in the emitting thread."""
+    tr = tracing.current_trace()
+    rec = {
+        "ts": round(record.created, 6),
+        "level": record.levelname.lower(),
+        "component": _component_of(record),
+        "msg": record.getMessage(),
+        "trace_id": tr.trace_id if tr is not None else None,
+    }
+    fields = getattr(record, "fields", None)
+    if fields:
+        rec["fields"] = dict(fields)
+    if record.exc_info and record.exc_info[0] is not None:
+        rec["exc"] = "".join(
+            _stdlog.Formatter().formatException(record.exc_info)
+        )[-2000:]
+    return rec
+
+
+def sse_frame(rec) -> bytes:
+    """`/eth/v1/events`-style framing (beacon/events.py sse_frame)."""
+    return f"event: log\ndata: {json.dumps(rec)}\n\n".encode()
+
+
+class _LogBroadcaster:
+    """Live record fan-out (the EventBroadcaster subscribe/publish shape;
+    slow SSE consumers drop rather than block the emitting thread)."""
+
+    def __init__(self, max_queue=2048):
+        self._subs = []
+        self._lock = threading.Lock()
+        self.max_queue = max_queue
+
+    def subscribe(self):
+        q = queue.Queue(maxsize=self.max_queue)
+        with self._lock:
+            self._subs.append(q)
+        return q
+
+    def unsubscribe(self, q):
+        with self._lock:
+            self._subs = [s for s in self._subs if s is not q]
+
+    def publish(self, rec):
+        with self._lock:
+            subs = list(self._subs)
+        for q in subs:
+            try:
+                q.put_nowait(rec)
+            except queue.Full:
+                pass
+
+
+class _FlightRecorder(_stdlog.Handler):
+    """Captures every record reaching the package root logger into the
+    ring buffer + severity counters + live broadcaster.  Level 0: what
+    gets recorded is decided by the per-component LOGGER levels (the
+    runtime-controllable knob), not re-filtered here."""
+
+    def __init__(self, capacity=RING_CAPACITY):
+        super().__init__(level=0)
+        self.ring = deque(maxlen=capacity)
+        self.counts = {name: 0 for name in LEVELS}
+        self._ring_lock = threading.Lock()
+        self.broadcast = _LogBroadcaster()
+
+    def emit(self, record):
+        try:
+            rec = structured(record)
+            LOGS_TOTAL.with_labels(rec["level"], rec["component"]).inc()
+            with self._ring_lock:
+                self.ring.append(rec)
+                if rec["level"] in self.counts:
+                    self.counts[rec["level"]] += 1
+            self.broadcast.publish(rec)
+        except Exception:
+            self.handleError(record)
+
+
+_RECORDER = None
+_INSTALL_LOCK = threading.Lock()
+
+
+def recorder() -> _FlightRecorder:
+    """The process-wide flight recorder, installed on first use on the
+    `lighthouse_tpu` root logger (idempotent).  The root logger level
+    defaults to INFO when nothing configured it — records must reach the
+    ring even in library use where no daemon setup ever runs."""
+    global _RECORDER
+    with _INSTALL_LOCK:
+        if _RECORDER is None:
+            h = _FlightRecorder()
+            root = _stdlog.getLogger(ROOT)
+            root.addHandler(h)
+            if root.level == _stdlog.NOTSET:
+                root.setLevel(_stdlog.INFO)
+            _RECORDER = h
+    return _RECORDER
+
+
+class ComponentLogger:
+    """Component-scoped structured logger.
+
+    Methods mirror stdlib (`%`-style args) plus keyword `fields` that
+    ride the structured record: `log.warning("shed %s", cls, depth=n)`.
+    Forwarding goes through the stdlib logger named
+    `lighthouse_tpu.<component>`, so text/JSON console handlers, the
+    flight recorder, and runtime level control all see one stream.
+    """
+
+    __slots__ = ("component", "_logger", "_throttle", "_throttle_lock")
+
+    def __init__(self, component):
+        self.component = component
+        self._logger = _stdlog.getLogger(f"{ROOT}.{component}")
+        self._throttle = {}
+        self._throttle_lock = threading.Lock()
+
+    def is_enabled_for(self, level) -> bool:
+        return self._logger.isEnabledFor(parse_level(level))
+
+    def _log(self, level, msg, args, fields, exc_info=None):
+        if not self._logger.isEnabledFor(level):
+            return
+        self._logger.log(
+            level, msg, *args, exc_info=exc_info,
+            extra={"component": self.component, "fields": fields or None},
+        )
+
+    def debug(self, msg, *args, **fields):
+        self._log(_stdlog.DEBUG, msg, args, fields)
+
+    def info(self, msg, *args, **fields):
+        self._log(_stdlog.INFO, msg, args, fields)
+
+    def warning(self, msg, *args, **fields):
+        self._log(_stdlog.WARNING, msg, args, fields)
+
+    def error(self, msg, *args, **fields):
+        self._log(_stdlog.ERROR, msg, args, fields)
+
+    def critical(self, msg, *args, **fields):
+        self._log(_stdlog.CRITICAL, msg, args, fields)
+
+    def exception(self, msg, *args, **fields):
+        self._log(_stdlog.ERROR, msg, args, fields, exc_info=True)
+
+    def warning_rate_limited(self, key, interval, msg, *args, **fields):
+        """At most one WARN per `key` per `interval` seconds (overload
+        paths fire per-request; the log must not).  Suppressed repeats
+        are counted and reported on the next emitted record.  Returns
+        whether a record was emitted."""
+        now = time.monotonic()
+        with self._throttle_lock:
+            last, suppressed = self._throttle.get(key, (None, 0))
+            if last is not None and now - last < interval:
+                self._throttle[key] = (last, suppressed + 1)
+                return False
+            self._throttle[key] = (now, 0)
+        if suppressed:
+            fields["suppressed"] = suppressed
+        self.warning(msg, *args, **fields)
+        return True
+
+
+_LOGGERS = {}
+_LOGGERS_LOCK = threading.Lock()
+
+
+def get_logger(component) -> ComponentLogger:
+    """The component's structured logger (cached; also installs the
+    flight recorder so importing any converted module arms capture)."""
+    recorder()
+    with _LOGGERS_LOCK:
+        lg = _LOGGERS.get(component)
+        if lg is None:
+            lg = _LOGGERS[component] = ComponentLogger(component)
+    return lg
+
+
+# ------------------------------------------------------- runtime control
+
+def known_components() -> set:
+    """Components that actually exist: ComponentLoggers registered via
+    get_logger plus any legacy `lighthouse_tpu.*` stdlib logger."""
+    with _LOGGERS_LOCK:
+        out = set(_LOGGERS)
+    for name, logger in list(_stdlog.Logger.manager.loggerDict.items()):
+        if name.startswith(ROOT + ".") and isinstance(logger, _stdlog.Logger):
+            out.add(name[len(ROOT) + 1:])
+    return out
+
+
+def set_level(component, level) -> str:
+    """Set a component's level at runtime (PATCH /lighthouse/logs/level).
+    `component` None/''/'root' targets the package root — every
+    component without an explicit override follows it.  Unknown
+    components are rejected: stdlib loggers live forever once created,
+    so minting one per arbitrary client-supplied name would grow the
+    process unboundedly (and bloat every levels() response)."""
+    lvl = parse_level(level)
+    recorder()
+    if component in (None, "", "root"):
+        name = ROOT
+    else:
+        if component not in known_components():
+            raise ValueError(f"unknown component {str(component)[:64]!r}")
+        name = f"{ROOT}.{component}"
+    _stdlog.getLogger(name).setLevel(lvl)
+    return _stdlog.getLevelName(lvl).lower()
+
+
+def levels() -> dict:
+    """Effective level per known lighthouse logger (component name ->
+    lowercase level name; 'root' is the package default)."""
+    recorder()
+    out = {"root": _stdlog.getLevelName(
+        _stdlog.getLogger(ROOT).getEffectiveLevel()).lower()}
+    for name, logger in list(_stdlog.Logger.manager.loggerDict.items()):
+        if not name.startswith(ROOT + "."):
+            continue
+        if not isinstance(logger, _stdlog.Logger):
+            continue   # placeholder nodes have no level
+        out[name[len(ROOT) + 1:]] = _stdlog.getLevelName(
+            logger.getEffectiveLevel()).lower()
+    return out
+
+
+# ------------------------------------------------------------- querying
+
+def recent(limit=None, level=None, component=None):
+    """Most-recent-first structured records from the ring buffer.
+    `level` filters to records AT OR ABOVE the given severity;
+    `component` to exact component matches."""
+    rec = recorder()
+    with rec._ring_lock:
+        records = list(rec.ring)
+    records.reverse()
+    if level is not None:
+        floor = parse_level(level)
+        records = [r for r in records
+                   if LEVELS.get(r["level"], 0) >= floor]
+    if component is not None:
+        records = [r for r in records if r["component"] == component]
+    if limit is not None:
+        records = records[: max(int(limit), 0)]
+    return records
+
+
+def subscribe():
+    """Live record queue for SSE streaming; pair with unsubscribe()."""
+    return recorder().broadcast.subscribe()
+
+
+def unsubscribe(q):
+    recorder().broadcast.unsubscribe(q)
+
+
+def severity_totals() -> dict:
+    """Cumulative record counts per severity since process start (the
+    reference monitoring body's crit/error/warn_total parity)."""
+    rec = recorder()
+    with rec._ring_lock:
+        return dict(rec.counts)
+
+
+def ring_depth() -> int:
+    rec = recorder()
+    with rec._ring_lock:
+        return len(rec.ring)
+
+
+def clear():
+    """Drop buffered records and severity totals (test isolation only —
+    the prometheus counter family is monotonic and stays)."""
+    rec = recorder()
+    with rec._ring_lock:
+        rec.ring.clear()
+        rec.counts = {name: 0 for name in LEVELS}
+
+
+# --------------------------------------------------------- daemon setup
+
+class JsonFormatter(_stdlog.Formatter):
+    """One JSON object per line: the flight-recorder record shape, so
+    file logs and /lighthouse/logs/recent are join-compatible."""
+
+    def format(self, record):
+        return json.dumps(structured(record))
+
+
+def add_file_handler(path, max_bytes=10 * 1024 * 1024, backup_count=2,
+                     fmt="json"):
+    """Attach a size-rotated logfile to the package root logger
+    (common/logging's file_rotate role; stdlib RotatingFileHandler)."""
+    h = RotatingFileHandler(
+        path, maxBytes=int(max_bytes), backupCount=int(backup_count)
+    )
+    h.setFormatter(
+        JsonFormatter() if fmt == "json" else _stdlog.Formatter(_TEXT_FORMAT)
+    )
+    h._ltpu_managed = True
+    _stdlog.getLogger(ROOT).addHandler(h)
+    return h
+
+
+def setup_logging(level="info", fmt="text", logfile=None,
+                  max_bytes=10 * 1024 * 1024, backup_count=2):
+    """Daemon entry-point setup (replaces the CLI's duplicated
+    `logging.basicConfig` blocks): console handler in `fmt` (text|json)
+    on the package root logger, optional rotating logfile, flight
+    recorder armed.  Idempotent — a re-run replaces the handlers it
+    installed earlier instead of stacking duplicates."""
+    recorder()
+    root = _stdlog.getLogger(ROOT)
+    root.setLevel(parse_level(level))
+    for h in list(root.handlers):
+        if getattr(h, "_ltpu_managed", False):
+            root.removeHandler(h)
+            h.close()
+    console = _stdlog.StreamHandler()
+    console.setFormatter(
+        JsonFormatter() if fmt == "json" else _stdlog.Formatter(_TEXT_FORMAT)
+    )
+    console._ltpu_managed = True
+    root.addHandler(console)
+    # the package root now owns its output; propagating further would
+    # double-print through any application-level basicConfig
+    root.propagate = False
+    if logfile:
+        add_file_handler(logfile, max_bytes=max_bytes,
+                         backup_count=backup_count, fmt="json")
+    return root

@@ -3,22 +3,33 @@ POST a process/health/metrics snapshot to a remote endpoint (the
 beaconcha.in-style client stats protocol the reference implements)."""
 
 import json
-import logging
 import urllib.request
 
+from . import logging as ltpu_logging
 from . import metrics as metrics_mod
+from . import tracing
+from .logging import get_logger
 from .sensitive_url import SensitiveUrl
 from .system_health import observe
 
-log = logging.getLogger("lighthouse_tpu.monitoring")
+log = get_logger("monitoring")
 
 
 def gather_snapshot(chain=None, process="beaconnode"):
-    """monitoring_api/src/gather.rs: the pushed JSON body."""
+    """monitoring_api/src/gather.rs: the pushed JSON body.  The
+    `observability` section carries the flight recorder's cumulative
+    severity totals (the reference body's crit/error/warn_total) and
+    the log/tracing ring depths, so a stats collector sees error-rate
+    regressions without scraping /metrics."""
     body = {
         "version": 1,
         "process": process,
         "system": observe(),
+        "observability": {
+            "log_totals": ltpu_logging.severity_totals(),
+            "log_ring_depth": ltpu_logging.ring_depth(),
+            "tracing_ring_depth": tracing.depth(),
+        },
     }
     if chain is not None:
         st = chain.head_state

@@ -132,6 +132,13 @@ def use(trace):
         stack.pop()
 
 
+def depth():
+    """Finished traces currently buffered (monitoring snapshot reads
+    this instead of materializing every trace dict via recent())."""
+    with _BUF_LOCK:
+        return len(_BUFFER)
+
+
 def recent(limit=None):
     """Most-recent-first dicts of the finished traces in the ring."""
     with _BUF_LOCK:

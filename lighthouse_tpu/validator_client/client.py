@@ -9,15 +9,14 @@ tests/simulator; the HTTP api client in production) with ordered-failover
 across multiple nodes (beacon_node_fallback.rs).
 """
 
-import logging
-
 from ..ssz import hash_tree_root
 from ..state_processing import phase0
 from ..types.containers import AttestationData, Checkpoint
 from ..types.state import state_types
+from ..utils.logging import get_logger
 from .slashing_protection import NotSafe
 
-log = logging.getLogger("lighthouse_tpu.vc")
+log = get_logger("validator_client")
 
 
 class BeaconNodeInterface:

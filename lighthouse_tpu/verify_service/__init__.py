@@ -32,6 +32,9 @@ Pieces:
 from .circuit import CircuitBreaker
 from .service import (
     PRIORITY_CLASSES,
+    SHED_LEVEL,
+    LoadShedError,
+    ShedVerdicts,
     QueueFullError,
     ServiceStopped,
     VerificationService,
@@ -41,8 +44,11 @@ from .service import (
 
 __all__ = [
     "CircuitBreaker",
+    "LoadShedError",
     "PRIORITY_CLASSES",
     "QueueFullError",
+    "SHED_LEVEL",
+    "ShedVerdicts",
     "ServiceStopped",
     "VerificationService",
     "VerifyFuture",

@@ -55,6 +55,11 @@ ADMISSION_REJECTED = metrics.counter(
     "verify_service_admission_rejected_total",
     "Requests rejected by per-class queue admission control",
 )
+SHED = metrics.counter(
+    "verify_service_shed_total",
+    "Requests shed by overload policy before queueing, by priority class",
+    labels=("class",),
+)
 POISONED_BATCHES = metrics.counter(
     "verify_service_poisoned_batches_total",
     "Failed batches resolved through the per-set-verdict attribution pass",

@@ -24,23 +24,30 @@ wrappers (and the `backend` property) make the service a drop-in
 unchanged apart from a priority tag.
 """
 
-import logging
 import threading
 import time
 from collections import deque
 
 from ..crypto.backend import SignatureVerifier
 from ..utils import tracing
+from ..utils.logging import get_logger
 from . import metrics as M
-from .circuit import CircuitBreaker
+from .circuit import OPEN, CircuitBreaker
 
-log = logging.getLogger("lighthouse_tpu.verify_service")
+log = get_logger("verify_service")
 
 # priority classes, highest first (ISSUE: block > aggregate > attestation
 # > discovery/light-client).  Index IS the drain order.
 PRIORITY_CLASSES = ("block", "aggregate", "attestation", "discovery")
 _CLASS_INDEX = {name: i for i, name in enumerate(PRIORITY_CLASSES)}
 _PRIORITY_ALIASES = {"light_client": "discovery"}
+
+# shed-by-class policy: the overload level at which each class is
+# REJECTED before queueing (blocks and aggregates are never shed — an
+# aggregate stands in for a whole committee's attestations).  Level 1 =
+# device circuit open or queues past the shed watermark; level 2 =
+# queues saturated well past it.
+SHED_LEVEL = {"discovery": 1, "attestation": 2}
 
 DEFAULT_TARGET_BATCH = 128          # dispatch immediately at this many sets
 DEFAULT_MAX_BATCH = 512             # never exceed (device chunk ceiling)
@@ -88,6 +95,25 @@ def verify_with_verdicts(verifier, sets, priority="attestation"):
 
 class QueueFullError(RuntimeError):
     """Admission control: the request's class queue is at capacity."""
+
+
+class LoadShedError(QueueFullError):
+    """Overload policy rejected the request before queueing: low-value
+    work (discovery/light-client, then attestations) is dropped so the
+    degraded path spends its budget on blocks and aggregates.  Subclass
+    of QueueFullError so pre-shed call sites that caught overflow keep
+    working; the blocking compat wrappers distinguish the two — overflow
+    degrades to an inline verify, shed fails closed."""
+
+
+class ShedVerdicts(list):
+    """Per-set verdict vector for SHED work: all False (fail-closed),
+    but distinguishable from real invalid-signature verdicts via
+    `.shed` — callers that cache verdicts by immutable input bytes
+    (network/discovery.py's record cache) must NOT persist these, or
+    valid records would stay rejected long after the overload clears."""
+
+    shed = True
 
 
 class ServiceStopped(RuntimeError):
@@ -163,10 +189,18 @@ class VerificationService:
                  target_batch=DEFAULT_TARGET_BATCH,
                  max_batch=DEFAULT_MAX_BATCH,
                  max_delay=None, queue_caps=None,
-                 breaker_threshold=3, breaker_cooldown=30.0):
+                 breaker_threshold=3, breaker_cooldown=30.0,
+                 shed_watermark=None):
         self.verifier = verifier or SignatureVerifier("oracle")
         self.target_batch = int(target_batch)
         self.max_batch = max(int(max_batch), self.target_batch)
+        # queued-set depth at which sheddable classes start being
+        # rejected (level 1); 4x this is level 2.  Default: several
+        # device passes' worth of backlog.
+        self.shed_watermark = (
+            4 * self.max_batch if shed_watermark is None
+            else int(shed_watermark)
+        )
         self.max_delay = dict(DEFAULT_MAX_DELAY)
         if max_delay:
             self.max_delay.update(max_delay)
@@ -212,6 +246,10 @@ class VerificationService:
             return self._degraded_verifier().verify_signature_sets(sets)
         try:
             fut = self.submit(sets, priority=priority)
+        except LoadShedError:
+            # shed means DROPPED, not "verify inline anyway" — fail
+            # closed so the caller treats the work as unverified
+            return False
         except QueueFullError:
             return self._degraded_verifier().verify_signature_sets(sets)
         try:
@@ -228,6 +266,8 @@ class VerificationService:
             return self._degraded_per_set(sets)
         try:
             fut = self.submit(sets, priority=priority, want_per_set=True)
+        except LoadShedError:
+            return ShedVerdicts([False] * len(sets))   # dropped, fail-closed
         except QueueFullError:
             return self._degraded_per_set(sets)
         try:
@@ -271,6 +311,27 @@ class VerificationService:
         window = self.max_delay[cls] if deadline is None else float(deadline)
         req = _Request(sets, fut, cls, now + window, now, want_per_set,
                        trace=tracing.current_trace())
+        shed_at = SHED_LEVEL.get(cls)
+        if shed_at is not None:
+            with self._cv:
+                shed_level, shed_queued = (
+                    self._overload_level_locked(), self._queued_sets
+                )
+            # decided under the lock, reported OUTSIDE it: the log
+            # handler does console/file I/O that must never stall the
+            # lock every submitter and the dispatcher share
+            if shed_level >= shed_at:
+                M.SHED.with_labels(cls).inc()
+                log.warning_rate_limited(
+                    f"shed:{cls}", 1.0,
+                    "shedding %s verification work under overload",
+                    cls, overload_level=shed_level,
+                    breaker_state=self.breaker.state,
+                    queued_sets=shed_queued,
+                )
+                raise LoadShedError(
+                    f"{cls} work shed under overload (level {shed_level})"
+                )
         with self._cv:
             if self._stopping():
                 fut.set_error(ServiceStopped("verification service stopped"))
@@ -285,6 +346,21 @@ class VerificationService:
             self._ensure_running_locked()
             self._cv.notify_all()
         return fut
+
+    def _overload_level_locked(self):
+        """Shed policy input (read-only breaker peek, caller thread —
+        same contract as _degraded_verifier): 0 = healthy; 1 = the
+        device circuit is OPEN (host path is paying for everything) or
+        the backlog crossed the shed watermark; 2 = backlog far past
+        the watermark (shed attestations too; blocks/aggregates never)."""
+        level = 0
+        if self.breaker.state == OPEN:
+            level = 1
+        if self._queued_sets >= self.shed_watermark:
+            level = max(level, 1)
+        if self._queued_sets >= 4 * self.shed_watermark:
+            level = 2
+        return level
 
     # --------------------------------------------------------- lifecycle
 
@@ -517,8 +593,19 @@ class VerificationService:
         # failure; innocent submitters still succeed
         M.POISONED_BATCHES.inc()
         try:
-            with tracing.use(bt), bt.span("attribution"):
-                verdicts = v.verify_signature_sets_per_set(all_sets)
+            with tracing.use(bt):
+                # emitted while the batch trace is current: the record's
+                # trace_id joins this WARN to the /lighthouse/tracing
+                # verify_batch entry that carries the stage spans
+                log.warning(
+                    "poisoned verification batch: %d sets from %d "
+                    "submitter(s); running attribution pass",
+                    len(all_sets), len(reqs),
+                    classes=batch_attrs["classes"],
+                    backend=batch_attrs["backend"],
+                )
+                with bt.span("attribution"):
+                    verdicts = v.verify_signature_sets_per_set(all_sets)
         except Exception as e:
             log.exception("per-set attribution pass failed hard")
             bt.finish(ok=False)

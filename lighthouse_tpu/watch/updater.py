@@ -10,6 +10,10 @@ HTTP surface of the reference.
 import sqlite3
 import threading
 
+from ..utils.logging import get_logger
+
+log = get_logger("watch")
+
 
 class WatchDB:
     def __init__(self, path=":memory:"):
@@ -167,6 +171,9 @@ class WatchUpdater:
         fin_epoch, fin_root = chain.fork_choice.store.finalized_checkpoint
         if fin_epoch > 0:
             self.db.record_finality(fin_epoch, fin_root)
+        if new:
+            log.debug("watch poll recorded %d canonical slots", len(new),
+                      head_slot=int(new[0][1].message.slot))
         return len(new)
 
     def _analyze_block(self, root, blk):
@@ -183,6 +190,8 @@ class WatchUpdater:
             # unrecoverable without a cold replay — skip the analyses
             # rather than record zeroed rows as if they were real data
             self.db.record_analysis_gap(slot)
+            log.warning("watch analysis gap: state pruned for slot %d",
+                        slot, slot=slot)
             return
         seen_attesters = set()
         for att in blk.message.body.attestations:
