@@ -126,15 +126,14 @@ class BeaconProcessor:
 
     def process_pending(self):
         """One manager pass: blocks first (they unblock attestations),
-        then ONE batched aggregate verification, ONE batched attestation
-        verification, then reprocessing.  Returns the number of work
-        items handled.  Through a chain wired to the VerificationService
-        the two batches — and any concurrent caller's work (discovery,
-        light client, backfill) — coalesce into shared device passes."""
+        then the aggregate AND attestation batches — SUBMITTED together
+        before either resolves, so one tick's gossip work coalesces into
+        a single device pass through the VerificationService (along with
+        any concurrent caller's work: discovery, light client, backfill).
+        Returns the number of work items handled."""
         handled = 0
         handled += self._drain_blocks()
-        handled += self._drain_aggregate_batch()
-        handled += self._drain_attestation_batch()
+        handled += self._drain_verify_batches()
         handled += self._retry_reprocess()
         return handled
 
@@ -189,24 +188,9 @@ class BeaconProcessor:
             n += 1
         return n
 
-    def _drain_attestation_batch(self):
-        return self._drain_lifo_batch(
-            self.attestation_queue,
-            self.chain.batch_verify_unaggregated_attestations,
-            "attestation",
-        )
-
-    def _drain_aggregate_batch(self):
-        """Aggregates drain LIFO like unaggregated attestations (newest
-        matter most) into one batched verification (each item is a 3-set
-        group; attestation_verification/batch.rs:31-134)."""
-        return self._drain_lifo_batch(
-            self.aggregate_queue,
-            self.chain.batch_verify_aggregated_attestations,
-            "aggregate",
-        )
-
-    def _drain_lifo_batch(self, queue, verify_fn, kind):
+    def _pop_lifo_batch(self, queue):
+        """Newest-first drain of up to attestation_batch_size events
+        (LIFO: newest matter most).  Returns (payloads, oldest_enqueued)."""
         batch = []
         oldest = None
         with self._lock:
@@ -215,17 +199,65 @@ class BeaconProcessor:
                 batch.append(ev.payload)
                 oldest = ev.enqueued if oldest is None else min(
                     oldest, ev.enqueued)
-        if not batch:
-            return 0
-        BATCHES_ASSEMBLED.inc()
-        tr = tracing.start_trace(f"{kind}_batch", count=len(batch))
-        tr.add_span("queue_wait", oldest, time.monotonic())
-        with tracing.use(tr), tr.span("process"):
-            results = verify_fn(batch)
-        tr.finish(accepted=sum(1 for _, _, err in results if err is None))
-        for item, indexed, err in results:
-            self.results.append((kind, err is None, err))
-        return len(batch)
+        return batch, oldest
+
+    def _drain_verify_batches(self):
+        """Submit-side async merge: pop the aggregate batch (each item a
+        3-set group; attestation_verification/batch.rs:31-134) AND the
+        attestation batch, submit BOTH to the chain before resolving
+        either — through a VerificationService the two submissions land
+        in one coalesced device pass instead of two serial ones.  Side
+        effects still apply in priority order (aggregates first).
+        Falls back to the blocking batch_verify_* calls against chain
+        doubles without the submit_* phase-split surface."""
+        plans = []
+        for kind, queue, submit_name, verify_name in (
+            ("aggregate", self.aggregate_queue,
+             "submit_aggregated_attestations",
+             "batch_verify_aggregated_attestations"),
+            ("attestation", self.attestation_queue,
+             "submit_unaggregated_attestations",
+             "batch_verify_unaggregated_attestations"),
+        ):
+            batch, oldest = self._pop_lifo_batch(queue)
+            if not batch:
+                continue
+            BATCHES_ASSEMBLED.inc()
+            tr = tracing.start_trace(f"{kind}_batch", count=len(batch))
+            tr.add_span("queue_wait", oldest, time.monotonic())
+            submit = getattr(self.chain, submit_name, None)
+            handle = None
+            if submit is not None:
+                with tracing.use(tr), tr.span("submit"):
+                    handle = submit(batch)
+            plans.append((kind, batch, tr, handle, verify_name))
+        n = 0
+        for kind, batch, tr, handle, verify_name in plans:
+            # a hard failure resolving one batch must not discard the
+            # OTHER already-popped batch (its events are gone from the
+            # queue — the sibling's resolve still has to run)
+            try:
+                with tracing.use(tr), tr.span("process"):
+                    if handle is not None:
+                        results = handle.resolve()
+                    else:
+                        results = getattr(self.chain, verify_name)(batch)
+            except Exception as e:
+                log.warning_rate_limited(
+                    f"batch:{kind}", 1.0,
+                    "%s batch verification failed hard", kind,
+                    error=str(e)[:200], count=len(batch),
+                )
+                tr.finish(ok=False, error=str(e)[:200])
+                for _ in batch:
+                    self.results.append((kind, False, e))
+                n += len(batch)
+                continue
+            tr.finish(accepted=sum(1 for _, _, err in results if err is None))
+            for item, indexed, err in results:
+                self.results.append((kind, err is None, err))
+            n += len(batch)
+        return n
 
     def _retry_reprocess(self):
         n = 0

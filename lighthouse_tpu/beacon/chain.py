@@ -20,7 +20,12 @@ The chain is device-backend-generic via crypto.backend.SignatureVerifier
 """
 
 from ..crypto.backend import SignatureVerifier
-from ..verify_service import verify_with_verdicts
+from ..verify_service import (
+    LoadShedError,
+    ServiceStopped,
+    ShedVerdicts,
+    verify_with_verdicts,
+)
 from ..fork_choice.fork_choice import ForkChoice, InvalidAttestation
 from ..operation_pool.pool import OperationPool
 from ..ssz import hash_tree_root
@@ -62,6 +67,27 @@ class SignatureVerifiedBlock:
         self.signed_block = gossip_verified.signed_block
         self.block_root = gossip_verified.block_root
         self.pre_state = gossip_verified.pre_state
+
+
+class PendingVerification:
+    """A submitted-but-unresolved verification batch.
+
+    Phase 1 (the `submit_*` chain methods) indexes the gossip objects and
+    SUBMITS their signature sets to the verify service without blocking;
+    `resolve()` waits for the device pass and applies the batch's side
+    effects (fork choice, pools, observers), returning the same result
+    list the blocking `batch_verify_*` method produces.  This is the
+    submit-side async merge: the processor submits its attestation,
+    aggregate, and sync batches back-to-back, so one tick's work
+    coalesces into a single device pass before anything resolves."""
+
+    __slots__ = ("_finish",)
+
+    def __init__(self, finish):
+        self._finish = finish
+
+    def resolve(self):
+        return self._finish()
 
 
 class BeaconChain:
@@ -356,11 +382,72 @@ class BeaconChain:
             state = phase0.process_slots(state, slot, self.preset, spec=self.spec)
         return state
 
+    # ------------------------------------------ async submission helpers
+
+    def _submit_with_verdicts(self, sets, priority):
+        """Non-blocking analogue of `verify_with_verdicts`: submit NOW,
+        return a thunk producing (ok, verdicts) on demand.  The submit
+        happens before the caller's remaining host work (and before any
+        sibling batch submits), so concurrent callers coalesce into one
+        device pass.  Against a bare seam (no `submit`) the verification
+        runs inside the thunk — nothing to overlap, same verdicts."""
+        sets = list(sets)
+        if not sets:
+            return lambda: (True, [])
+        v = self.verifier
+        if not hasattr(v, "submit"):
+            return lambda: verify_with_verdicts(v, sets, priority=priority)
+        try:
+            fut = v.submit(sets, priority=priority, want_per_set=True)
+        except LoadShedError:
+            verdicts = ShedVerdicts([False] * len(sets))
+            return lambda: (False, verdicts)
+        except Exception:
+            # QueueFullError etc: degrade exactly like the blocking
+            # wrapper — verify through the compat path at resolve time
+            return lambda: verify_with_verdicts(v, sets, priority=priority)
+
+        def finish():
+            try:
+                verdicts = fut.result()
+            except ServiceStopped:
+                return verify_with_verdicts(v, sets, priority=priority)
+            return all(verdicts), verdicts
+
+        return finish
+
+    def _submit_ok(self, sets, priority):
+        """Bool flavor of `_submit_with_verdicts` for the block paths
+        (a failed block batch needs no per-set attribution — the whole
+        block is invalid either way)."""
+        sets = list(sets)
+        v = self.verifier
+        if not sets or not hasattr(v, "submit"):
+            return lambda: v.verify_signature_sets(sets, priority=priority)
+        try:
+            fut = v.submit(sets, priority=priority)
+        except Exception:
+            # blocks are never shed (SHED_LEVEL); overflow degrades to
+            # the blocking compat wrapper at resolve time
+            return lambda: v.verify_signature_sets(sets, priority=priority)
+
+        def finish():
+            try:
+                return fut.result()
+            except ServiceStopped:
+                return v.verify_signature_sets(sets, priority=priority)
+
+        return finish
+
+    # ------------------------------------------------------ block import
+
     def process_block(self, signed_block, observed_at=None):
         """beacon_chain.rs:2664 process_block: full pipeline to import.
 
-        Accepts a raw SignedBeaconBlock or a GossipVerifiedBlock.
-        """
+        Accepts a raw SignedBeaconBlock or a GossipVerifiedBlock.  The
+        signature batch is SUBMITTED before the state-root check, so the
+        device verifies while the host hashes the post-state — the two
+        longest stages of the import pipeline overlap."""
         with metrics.BLOCK_PROCESSING_TIMES.start_timer():
             if isinstance(signed_block, GossipVerifiedBlock):
                 gossip_verified = signed_block
@@ -368,49 +455,69 @@ class BeaconChain:
                 gossip_verified = self.verify_block_for_gossip(
                     signed_block, observed_at=observed_at
                 )
-            sig_verified = self._verify_all_signatures(gossip_verified)
-            return self._import_block(sig_verified)
+            sv, finish = self._submit_block_signatures(gossip_verified)
+            state_root_ok = (
+                bytes(sv.signed_block.message.state_root)
+                == hash_tree_root(sv.post_state)
+            )
+            finish()   # raises BlockError on bad signatures (checked first)
+            if not state_root_ok:
+                raise BlockError("state root mismatch")
+            return self._import_block(sv, state_root_checked=True)
 
-    def _verify_all_signatures(self, gossip_verified):
+    def _submit_block_signatures(self, gossip_verified):
         """SignatureVerifiedBlock::from_gossip_verified_block
         (block_verification.rs:987): collect every signature set in the
-        block EXCEPT the already-checked proposal, one device batch."""
+        block EXCEPT the already-checked proposal and SUBMIT them as one
+        batch.  Returns (sv, finish); `finish()` blocks for the verdict
+        and raises BlockError on failure."""
         state = gossip_verified.pre_state.copy()
         sets = []
-        with metrics.BLOCK_SIGNATURE_VERIFY_TIMES.start_timer():
-            # STF with set collection (include_all_signatures_except_proposal:
-            # the proposal was verified at gossip; the collected run re-adds
-            # it — cheap relative to one extra pairing and keeps the state
-            # advance single-pass)
-            try:
-                phase0.per_block_processing(
-                    state,
-                    gossip_verified.signed_block,
-                    self.spec,
-                    signature_strategy=BlockSignatureStrategy.VERIFY_BULK,
-                    collected_sets=sets,
-                    execution_engine=self.execution_engine,
-                )
-            except sset.SignatureSetError as e:
-                raise BlockError(f"undecodable signature in block: {e}") from e
-            except (AssertionError, phase0.BlockProcessingError) as e:
-                raise BlockError(f"invalid block: {e}") from e
-            if not self.verifier.verify_signature_sets(sets, priority="block"):
-                raise BlockError("bulk signature verification failed")
-        self.block_times_cache.set_time_signature_verified(
-            gossip_verified.block_root,
-            int(gossip_verified.signed_block.message.slot),
-        )
+        # STF with set collection (include_all_signatures_except_proposal:
+        # the proposal was verified at gossip; the collected run re-adds
+        # it — cheap relative to one extra pairing and keeps the state
+        # advance single-pass)
+        try:
+            phase0.per_block_processing(
+                state,
+                gossip_verified.signed_block,
+                self.spec,
+                signature_strategy=BlockSignatureStrategy.VERIFY_BULK,
+                collected_sets=sets,
+                execution_engine=self.execution_engine,
+            )
+        except sset.SignatureSetError as e:
+            raise BlockError(f"undecodable signature in block: {e}") from e
+        except (AssertionError, phase0.BlockProcessingError) as e:
+            raise BlockError(f"invalid block: {e}") from e
+        pending = self._submit_ok(sets, "block")
         sv = SignatureVerifiedBlock(gossip_verified)
         sv.post_state = state
-        return sv
 
-    def _import_block(self, sig_verified):
+        def finish():
+            # ONE observation per block: the residual signature-verify
+            # cost on the import critical path (with the overlapped
+            # submit, device time hidden behind the state-root hash is
+            # exactly what this should NOT count)
+            with metrics.BLOCK_SIGNATURE_VERIFY_TIMES.start_timer():
+                if not pending():
+                    raise BlockError("bulk signature verification failed")
+            self.block_times_cache.set_time_signature_verified(
+                gossip_verified.block_root,
+                int(gossip_verified.signed_block.message.slot),
+            )
+            return sv
+
+        return sv, finish
+
+    def _import_block(self, sig_verified, state_root_checked=False):
         """beacon_chain.rs:2827 import_block: state-root check, fork choice,
         store write, head recompute."""
         block = sig_verified.signed_block.message
         post_state = sig_verified.post_state
-        if bytes(block.state_root) != hash_tree_root(post_state):
+        if not state_root_checked and (
+            bytes(block.state_root) != hash_tree_root(post_state)
+        ):
             raise BlockError("state root mismatch")
         # the state transition (incl. payload execution) is now accepted
         self.block_times_cache.set_time_executed(
@@ -523,14 +630,18 @@ class BeaconChain:
             except (AssertionError, phase0.BlockProcessingError) as e:
                 raise BlockError(f"invalid block in segment: {e}") from e
             states.append(state.copy())
-        with metrics.BLOCK_SIGNATURE_VERIFY_TIMES.start_timer():
-            if not self.verifier.verify_signature_sets(sets, priority="block"):
-                raise BlockError("segment bulk signature verification failed")
+        # submit the whole segment's signature batch, then hash block
+        # roots + state roots (pure SSZ work) while the device verifies
+        pending = self._submit_ok(sets, "block")
         roots = []
         for sb, post_state in zip(blocks, states):
-            block_root = hash_tree_root(sb.message)
+            roots.append(hash_tree_root(sb.message))
             if bytes(sb.message.state_root) != hash_tree_root(post_state):
                 raise BlockError("state root mismatch in segment")
+        with metrics.BLOCK_SIGNATURE_VERIFY_TIMES.start_timer():
+            if not pending():
+                raise BlockError("segment bulk signature verification failed")
+        for sb, post_state, block_root in zip(blocks, states, roots):
             self.on_tick(max(self.current_slot, int(sb.message.slot)))
             self.fork_choice.on_block(
                 self.current_slot, sb.message, block_root, post_state
@@ -556,7 +667,6 @@ class BeaconChain:
             if hasattr(sb.message.body, "sync_aggregate"):
                 self._serve_light_clients(sb.message)
             self._import_new_pubkeys(post_state)
-            roots.append(block_root)
         self.recompute_head()
         return roots
 
@@ -582,6 +692,12 @@ class BeaconChain:
         Returns a list of (attestation, indexed | None, error | None);
         verified attestations are fed to fork choice and the op pool.
         """
+        return self.submit_unaggregated_attestations(attestations).resolve()
+
+    def submit_unaggregated_attestations(self, attestations):
+        """Async flavor: index + SUBMIT the batch, defer the wait and the
+        side effects to `resolve()` — sibling batches submitted before
+        resolving merge into the same device pass."""
         results = []
         sets = []
         set_owners = []
@@ -596,36 +712,41 @@ class BeaconChain:
                 results.append([att, indexed, None])
                 set_owners.append(len(results) - 1)
                 sets.append(s)
+        pending = self._submit_with_verdicts(sets, "attestation")
 
-        if sets:
-            with metrics.ATTESTATION_BATCH_VERIFY_TIMES.start_timer():
-                ok, verdicts = verify_with_verdicts(
-                    self.verifier, sets, priority="attestation"
+        def finish():
+            if sets:
+                with metrics.ATTESTATION_BATCH_VERIFY_TIMES.start_timer():
+                    ok, verdicts = pending()
+                if not ok:
+                    # poisoned batch: per-set verdicts from ONE extra pass
+                    # (batch.rs:210-219 does N CPU re-verifications instead)
+                    for owner, good in zip(set_owners, verdicts):
+                        if not good:
+                            results[owner][1] = None
+                            results[owner][2] = AttestationError(
+                                "invalid signature"
+                            )
+            for att, indexed, err in results:
+                if err is not None or indexed is None:
+                    continue
+                for v in indexed.attesting_indices:
+                    self.observed_attesters.add(
+                        (int(att.data.target.epoch), int(v))
+                    )
+                self.validator_monitor.process_gossip_attestation(
+                    indexed.attesting_indices, att.data
                 )
-            if not ok:
-                # poisoned batch: per-set verdicts from ONE extra pass
-                # (batch.rs:210-219 does N CPU re-verifications instead)
-                for owner, good in zip(set_owners, verdicts):
-                    if not good:
-                        results[owner][1] = None
-                        results[owner][2] = AttestationError("invalid signature")
+                try:
+                    self.fork_choice.on_attestation(self.current_slot, indexed)
+                except InvalidAttestation:
+                    pass
+                if self.slasher is not None:
+                    self.slasher.accept_attestation(indexed)
+                self.op_pool.insert_attestation(att)
+            return [tuple(r) for r in results]
 
-        for att, indexed, err in results:
-            if err is not None or indexed is None:
-                continue
-            for v in indexed.attesting_indices:
-                self.observed_attesters.add((int(att.data.target.epoch), int(v)))
-            self.validator_monitor.process_gossip_attestation(
-                indexed.attesting_indices, att.data
-            )
-            try:
-                self.fork_choice.on_attestation(self.current_slot, indexed)
-            except InvalidAttestation:
-                pass
-            if self.slasher is not None:
-                self.slasher.accept_attestation(indexed)
-            self.op_pool.insert_attestation(att)
-        return [tuple(r) for r in results]
+        return PendingVerification(finish)
 
     def _index_and_set(self, att, epoch_states=None):
         """IndexedUnaggregatedAttestation::verify equivalents: committee
@@ -665,6 +786,11 @@ class BeaconChain:
         SignedAggregateAndProof three sets — selection proof, aggregator
         signature, aggregate attestation — verified in ONE device batch
         (<=3N sets), per-set fallback on poisoning."""
+        return self.submit_aggregated_attestations(signed_aggregates).resolve()
+
+    def submit_aggregated_attestations(self, signed_aggregates):
+        """Async flavor of the aggregate batch: index + submit now,
+        resolve later (see `submit_unaggregated_attestations`)."""
         results = []
         sets = []
         owners = []
@@ -689,33 +815,37 @@ class BeaconChain:
                 results.append([sa, indexed, None])
                 owners.append((len(results) - 1, len(sets), len(triple)))
                 sets.extend(triple)
+        pending = self._submit_with_verdicts(sets, "aggregate")
 
-        if sets:
-            with metrics.ATTESTATION_BATCH_VERIFY_TIMES.start_timer():
-                ok, verdicts = verify_with_verdicts(
-                    self.verifier, sets, priority="aggregate"
+        def finish():
+            if sets:
+                with metrics.ATTESTATION_BATCH_VERIFY_TIMES.start_timer():
+                    ok, verdicts = pending()
+                if not ok:
+                    for owner, start, count in owners:
+                        if not all(verdicts[start : start + count]):
+                            results[owner][1] = None
+                            results[owner][2] = AttestationError(
+                                "invalid signature"
+                            )
+            for sa, indexed, err in results:
+                if err is not None or indexed is None:
+                    continue
+                agg = sa.message
+                self.observed_aggregators.add(
+                    (int(agg.aggregate.data.target.epoch),
+                     int(agg.aggregator_index))
                 )
-            if not ok:
-                for owner, start, count in owners:
-                    if not all(verdicts[start : start + count]):
-                        results[owner][1] = None
-                        results[owner][2] = AttestationError("invalid signature")
+                try:
+                    self.fork_choice.on_attestation(self.current_slot, indexed)
+                except InvalidAttestation:
+                    pass
+                if self.slasher is not None:
+                    self.slasher.accept_attestation(indexed)
+                self.op_pool.insert_attestation(agg.aggregate)
+            return [tuple(r) for r in results]
 
-        for sa, indexed, err in results:
-            if err is not None or indexed is None:
-                continue
-            agg = sa.message
-            self.observed_aggregators.add(
-                (int(agg.aggregate.data.target.epoch), int(agg.aggregator_index))
-            )
-            try:
-                self.fork_choice.on_attestation(self.current_slot, indexed)
-            except InvalidAttestation:
-                pass
-            if self.slasher is not None:
-                self.slasher.accept_attestation(indexed)
-            self.op_pool.insert_attestation(agg.aggregate)
-        return [tuple(r) for r in results]
+        return PendingVerification(finish)
 
     def _index_aggregate(self, signed_aggregate, epoch_states=None):
         """VerifiedAggregatedAttestation checks: aggregator in committee,
@@ -871,6 +1001,11 @@ class BeaconChain:
         """All gossip sync messages of a tick in ONE device batch
         (sync_committee_verification.rs batch flavor); per-set fallback on
         poisoning.  Returns [(message, error|None)]."""
+        return self.submit_sync_messages(messages).resolve()
+
+    def submit_sync_messages(self, messages):
+        """Async flavor of the sync-message batch: index + submit now,
+        resolve later (see `submit_unaggregated_attestations`)."""
         from ..state_processing import altair
 
         state = self.head_state
@@ -878,10 +1013,11 @@ class BeaconChain:
         sets = []
         owners = []
         if not altair.is_altair_state(state):
-            return [
+            results = [
                 (m, AttestationError("pre-altair state has no sync committee"))
                 for m in messages
             ]
+            return PendingVerification(lambda: results)
         committee_indices = altair.sync_committee_validator_indices(
             state, self.preset
         )
@@ -908,21 +1044,26 @@ class BeaconChain:
             results.append([m, None])
             owners.append(len(results) - 1)
             sets.append(s)
-        if sets:
-            ok, verdicts = verify_with_verdicts(
-                self.verifier, sets, priority="attestation"
-            )
-            if not ok:
-                for owner, good in zip(owners, verdicts):
-                    if not good:
-                        results[owner][1] = AttestationError("invalid signature")
-        for m, err in results:
-            if err is None:
-                self.observed_sync_contributors.add(
-                    (int(m.slot), int(m.validator_index))
-                )
-                self.sync_pool.insert_message(m, committee_indices)
-        return [tuple(r) for r in results]
+        pending = self._submit_with_verdicts(sets, "attestation")
+
+        def finish():
+            if sets:
+                ok, verdicts = pending()
+                if not ok:
+                    for owner, good in zip(owners, verdicts):
+                        if not good:
+                            results[owner][1] = AttestationError(
+                                "invalid signature"
+                            )
+            for m, err in results:
+                if err is None:
+                    self.observed_sync_contributors.add(
+                        (int(m.slot), int(m.validator_index))
+                    )
+                    self.sync_pool.insert_message(m, committee_indices)
+            return [tuple(r) for r in results]
+
+        return PendingVerification(finish)
 
     def _sync_contribution_checks(self, signed_contribution, state,
                                   committee_indices):
@@ -1009,14 +1150,20 @@ class BeaconChain:
         """All ContributionAndProof publishes of a tick in ONE device
         batch (each item is itself a 3-set group); per-item fallback when
         the batch is poisoned.  Returns [(signed, error|None)]."""
+        return self.submit_sync_contributions(signed_contributions).resolve()
+
+    def submit_sync_contributions(self, signed_contributions):
+        """Async flavor of the contribution batch: check + submit now,
+        resolve later (see `submit_unaggregated_attestations`)."""
         from ..state_processing import altair
 
         state = self.head_state
         if not altair.is_altair_state(state):
-            return [
+            results = [
                 (c, AttestationError("pre-altair state has no sync committee"))
                 for c in signed_contributions
             ]
+            return PendingVerification(lambda: results)
         committee_indices = altair.sync_committee_validator_indices(
             state, self.preset
         )
@@ -1036,27 +1183,30 @@ class BeaconChain:
             seen_in_batch.add(key)
             results.append([sc, None])
             groups.append((len(results) - 1, sets, key, insert_args))
-        if groups:
-            all_sets = [s for _, sets, _, _ in groups for s in sets]
-            ok, verdicts = verify_with_verdicts(
-                self.verifier, all_sets, priority="aggregate"
-            )
-            if not ok:
-                # attribute from the verdicts the failed batch already
-                # computed — no per-group re-verification
-                pos = 0
-                for owner, sets, _, _ in groups:
-                    good = all(verdicts[pos:pos + len(sets)])
-                    pos += len(sets)
-                    if not good:
-                        results[owner][1] = AttestationError(
-                            "sync contribution verification failed"
-                        )
-            for owner, _, key, insert_args in groups:
-                if results[owner][1] is None:
-                    self.observed_sync_aggregators.add(key)
-                    self.sync_pool.insert_contribution(*insert_args)
-        return [tuple(r) for r in results]
+        all_sets = [s for _, sets, _, _ in groups for s in sets]
+        pending = self._submit_with_verdicts(all_sets, "aggregate")
+
+        def finish():
+            if groups:
+                ok, verdicts = pending()
+                if not ok:
+                    # attribute from the verdicts the failed batch already
+                    # computed — no per-group re-verification
+                    pos = 0
+                    for owner, sets, _, _ in groups:
+                        good = all(verdicts[pos:pos + len(sets)])
+                        pos += len(sets)
+                        if not good:
+                            results[owner][1] = AttestationError(
+                                "sync contribution verification failed"
+                            )
+                for owner, _, key, insert_args in groups:
+                    if results[owner][1] is None:
+                        self.observed_sync_aggregators.add(key)
+                        self.sync_pool.insert_contribution(*insert_args)
+            return [tuple(r) for r in results]
+
+        return PendingVerification(finish)
 
     @staticmethod
     def _is_sync_aggregator(preset, selection_proof):

@@ -108,6 +108,23 @@ class SignatureVerifier:
             except Exception:
                 pass
 
+    def plan_pipeline(self, sets):
+        """Two-stage (host-prep, device-execute) chunk plan for the
+        verify_service dispatcher's prep/device pipeline, or None when
+        this backend has no stage split (host backends do all their work
+        in one place; nothing to overlap).  A device failure inside an
+        execute stage propagates to the caller, which falls back to the
+        plain `verify_signature_sets` path — and THAT call drives the
+        normal device→native→oracle degrade chain."""
+        if self.backend != "tpu":
+            return None
+        try:
+            from .tpu import bls as tb
+
+            return tb.plan_pipeline(sets)
+        except Exception:
+            return None
+
     def verify_signature_sets(self, sets, priority=None) -> bool:
         # `priority` is accepted (and ignored) so call sites can tag work
         # for the verify_service drop-in without caring which seam they

@@ -32,12 +32,15 @@ batch.rs:210-219) costs one extra kernel here, not N round-trips.
 
 import os as _os
 import secrets
+import threading as _threading
 import time as _time
+from collections import OrderedDict
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ...utils import metrics as _metrics
 from ...utils import tracing
 from ..constants import P, G1_X, G1_Y, RAND_BITS, DST_POP
 from . import fp
@@ -53,40 +56,148 @@ def _next_pow2(n):
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
 
 
-def _fp_dev(ints, shape):
-    """Host ints (flat list) -> Montgomery limb array reshaped to (24, *shape).
+def _fp_host_mont(ints, shape):
+    """Host ints (flat list) -> Montgomery limb device array (NLIMB, *shape).
 
-    Uses the JITTED Montgomery conversion: eagerly it dispatched
-    hundreds of small ops per call and dominated batch prep (~1.2 s per
-    call at 2048 sets — measured); jitted it is a cached shape."""
-    arr = fp.ints_to_array(ints).reshape((fp.NLIMB,) + shape)
-    return fp.to_mont_jit(jnp.asarray(arr))
+    Replaces the jitted on-device `to_mont` staging: the conversion is
+    host bigint work (fp.ints_to_mont_array), so the prep stage of the
+    verify pipeline stays entirely on the host while the device executes
+    the previous chunk — and the canonical limbs it yields live in the
+    same lazy domain the kernels accept, so verdicts are unchanged."""
+    arr = fp.ints_to_mont_array(ints).reshape((fp.NLIMB,) + shape)
+    return jnp.asarray(arr)
+
+
+# ------------------------------------------------- device-ready pubkey cache
+
+_PK_HITS = _metrics.counter(
+    "verify_pubkey_cache_hits_total",
+    "Device-ready pubkey limb-cache hits (batch staged by gather)",
+)
+_PK_MISSES = _metrics.counter(
+    "verify_pubkey_cache_misses_total",
+    "Device-ready pubkey limb-cache misses (int->Montgomery-limb conversion paid)",
+)
+
+_P_HALF = (P - 1) // 2
+
+
+class PubkeyLimbCache:
+    """Bounded LRU of per-pubkey Montgomery Fp limb arrays.
+
+    The per-batch `_g1_pad_dev` staging used to re-run the int->limb
+    conversion (plus an on-device `to_mont` pass) for every pubkey of
+    every set, every batch — but validator pubkeys recur every epoch, so
+    the same keys are converted over and over.  This cache is the
+    device-ready analogue of the reference's deserialize-once
+    `ValidatorPubkeyCache` (validator_pubkey_cache.rs:10-23): keyed on
+    the 48-byte compressed encoding, holding the (2, NLIMB) int32
+    Montgomery limbs of (x, y) so batch staging is a numpy gather.
+    Steady-state hit rate is ~100%; misses pay one host bigint mulmod
+    per coordinate.  Thread-safe (prep thread + dispatcher + direct
+    callers all stage batches)."""
+
+    def __init__(self, capacity=None):
+        if capacity is None:
+            capacity = int(_os.environ.get("LTPU_PUBKEY_CACHE_SIZE", "131072"))
+        self.capacity = max(1, int(capacity))
+        self._entries = OrderedDict()     # key bytes -> (2, NLIMB) int32
+        self._lock = _threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def key_of(pk):
+        """Affine-int G1 -> its 48-byte compressed encoding (flag bits as
+        in crypto/ref/curves.g1_compress; infinity never reaches here —
+        `_prepare` rejects None pubkeys first)."""
+        x, y = pk
+        out = bytearray(int(x).to_bytes(48, "big"))
+        out[0] |= 0x80
+        if y > _P_HALF:
+            out[0] |= 0x20
+        return bytes(out)
+
+    def limbs(self, pk):
+        """(2, NLIMB) int32 Montgomery limbs of (x, y), cached."""
+        k = self.key_of(pk)
+        with self._lock:
+            e = self._entries.get(k)
+            if e is not None:
+                self._entries.move_to_end(k)
+                self.hits += 1
+        if e is not None:
+            _PK_HITS.inc()
+            return e
+        e = np.stack([fp.int_to_mont_limbs(pk[0]), fp.int_to_mont_limbs(pk[1])])
+        with self._lock:
+            self.misses += 1
+            self._entries[k] = e
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+        _PK_MISSES.inc()
+        return e
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+
+    def stats(self):
+        with self._lock:
+            hits, misses, size = self.hits, self.misses, len(self._entries)
+        total = hits + misses
+        return {
+            "hits": hits,
+            "misses": misses,
+            "size": size,
+            "capacity": self.capacity,
+            "hit_rate": round(hits / total, 4) if total else 0.0,
+        }
+
+
+PK_CACHE = PubkeyLimbCache()
+
+_ONE_MONT_I32 = fp.ONE_MONT.astype(np.int32)
 
 
 def _g1_pad_dev(sets_pubkeys, m_pad):
-    """[[affine-int G1]] -> Jacobian (24, n, m_pad) arrays, infinity-padded."""
+    """[[affine-int G1]] -> Jacobian (NLIMB, n, m_pad) arrays, infinity-padded.
+
+    Assembled by GATHER from the pubkey limb cache: a warm batch costs
+    numpy row copies, not per-pubkey bigint conversions.  Padding lanes
+    are the infinity encoding (x=1, y=1, z=0) in Montgomery form."""
     n = len(sets_pubkeys)
-    xs, ys, zs = [], [], []
-    for pks in sets_pubkeys:
-        row = list(pks) + [None] * (m_pad - len(pks))
-        xs += [1 if p is None else p[0] for p in row]
-        ys += [1 if p is None else p[1] for p in row]
-        zs += [0 if p is None else 1 for p in row]
-    shape = (n, m_pad)
-    return (_fp_dev(xs, shape), _fp_dev(ys, shape), _fp_dev(zs, shape))
+    X = np.empty((n, m_pad, fp.NLIMB), np.int32)
+    Y = np.empty((n, m_pad, fp.NLIMB), np.int32)
+    Z = np.zeros((n, m_pad, fp.NLIMB), np.int32)
+    X[:] = _ONE_MONT_I32
+    Y[:] = _ONE_MONT_I32
+    for i, pks in enumerate(sets_pubkeys):
+        for j, p in enumerate(pks):
+            limbs = PK_CACHE.limbs(p)
+            X[i, j] = limbs[0]
+            Y[i, j] = limbs[1]
+            Z[i, j] = _ONE_MONT_I32
+    def dev(a):
+        return jnp.asarray(np.ascontiguousarray(np.moveaxis(a, 2, 0)))
+    return dev(X), dev(Y), dev(Z)
 
 
 def _g2_dev(points):
     """[affine-int G2 | None] -> Jacobian ((c0,c1) pairs) batched on axis 1."""
     n = len(points)
     def coord(i, j, default):
-        return _fp_dev(
+        return _fp_host_mont(
             [default if p is None else p[i][j] for p in points], (n,)
         )
     X = (coord(0, 0, 1), coord(0, 1, 0))
     Y = (coord(1, 0, 1), coord(1, 1, 0))
-    Z = (_fp_dev([0 if p is None else 1 for p in points], (n,)),
-         _fp_dev([0] * n, (n,)))
+    Z = (_fp_host_mont([0 if p is None else 1 for p in points], (n,)),
+         _fp_host_mont([0] * n, (n,)))
     return (X, Y, Z)
 
 
@@ -277,36 +388,114 @@ def _prepare(sets, dst, min_sets=1, min_pks=1):
     return sets, n_pad, pk, sig, u0, u1
 
 
-def _trace_chunk(tr, t_prep0, t_dev0, n_sets, n_pad, per_set=False):
+def _trace_chunk(tr, host_prep_ms, t_dev0, n_sets, n_pad, per_set=False,
+                 overlap_ratio=0.0):
     """Attach this chunk's host-prep/device split and pad occupancy to
     the current pipeline trace (utils/tracing.py) — the per-batch view
-    of where device time goes that histograms can't give."""
+    of where device time goes that histograms can't give.
+    `overlap_ratio`: fraction of this chunk's host prep that ran while
+    the device executed the previous chunk (0 on the serial path)."""
     tr.add_span(
         "device_chunk", t_dev0, _time.monotonic(),
         sets=n_sets, lanes=n_pad,
         pad_ratio=round(n_pad / max(n_sets, 1), 3),
         occupancy=round(n_sets / max(n_pad, 1), 3),
-        host_prep_ms=round((t_dev0 - t_prep0) * 1e3, 3),
+        host_prep_ms=round(host_prep_ms, 3),
+        overlap_ratio=round(overlap_ratio, 3),
         per_set=per_set,
     )
 
 
-def _verify_chunk(sets, dst, rng, min_sets=1, min_pks=1):
-    tr = tracing.current_trace()
+class PreparedChunk:
+    """Host-stage output for one compile-bucket chunk: staged device
+    arrays plus prep timing, ready for a kernel launch."""
+
+    __slots__ = ("n_sets", "n_pad", "args", "invalid", "t_prep0", "t_prep1")
+
+
+def prepare_chunk(sets, dst=DST_POP, rng=None, min_sets=1, min_pks=1):
+    """HOST stage of the two-stage verify pipeline: structural checks,
+    pubkey-limb gather, padding, message hashing, blinding-scalar draw —
+    everything up to (but not including) the kernel launch.  Pure host
+    work, so the dispatcher's prep thread can run it for chunk N+1 while
+    the device executes chunk N."""
     t0 = _time.monotonic()
+    sets = list(sets)
+    c = PreparedChunk()
+    c.n_sets = len(sets)
+    c.t_prep0 = t0
     prep = _prepare(sets, dst, min_sets, min_pks)
     if prep is None:
-        return False
-    sets, n_pad, pk, sig, u0, u1 = prep
+        c.invalid = True
+        c.n_pad = 0
+        c.args = None
+        c.t_prep1 = _time.monotonic()
+        return c
+    _, n_pad, pk, sig, u0, u1 = prep
     rands = _rand_scalars(len(sets), rng)
     if n_pad != len(sets):
         pad = jnp.zeros((2, n_pad - len(sets)), jnp.uint32)
         rands = jnp.concatenate([rands, pad], axis=1)
-    t1 = _time.monotonic()
-    out = bool(_jit_batched(pk, sig, u0, u1, rands))
+    c.invalid = False
+    c.n_pad = n_pad
+    c.args = (pk, sig, u0, u1, rands)
+    c.t_prep1 = _time.monotonic()
+    return c
+
+
+def execute_chunk(prepared, overlap_ratio=None):
+    """DEVICE stage: launch the batched kernel on a prepared chunk and
+    block for the verdict.  A structurally invalid chunk is False without
+    a launch (the oracle/blst early-False semantics)."""
+    if prepared.invalid:
+        return False
+    tr = tracing.current_trace()
+    t_dev0 = _time.monotonic()
+    out = bool(_jit_batched(*prepared.args))
     if tr is not None:
-        _trace_chunk(tr, t0, t1, len(sets), n_pad)
+        _trace_chunk(
+            tr, (prepared.t_prep1 - prepared.t_prep0) * 1e3, t_dev0,
+            prepared.n_sets, prepared.n_pad,
+            overlap_ratio=overlap_ratio or 0.0,
+        )
     return out
+
+
+def _verify_chunk(sets, dst, rng, min_sets=1, min_pks=1):
+    return execute_chunk(prepare_chunk(sets, dst, rng, min_sets, min_pks))
+
+
+def _batch_m_pad(sets):
+    """Shared pubkey-axis pad bucket for every chunk of a batch — all
+    chunks MUST land on one compiled shape (serial and pipelined paths
+    use this same computation)."""
+    return _next_pow2(max((len(s.pubkeys) for s in sets if s.pubkeys),
+                          default=1))
+
+
+def plan_pipeline(sets, dst=DST_POP, rng=None):
+    """Split a multi-chunk batch into same-shape compile-bucket chunks
+    plus (prepare, execute) stage callables for the dispatcher's
+    two-deep host-prep/device pipeline (verify_service._run_pipeline).
+    Returns (chunks, prepare, execute) or None when the batch fits in
+    one chunk — nothing to overlap.  All chunks share one padded shape
+    (min_sets=bucket, min_pks=batch max) so they reuse ONE compiled
+    program, exactly like the serial chunked path (same structural
+    precheck, same pad computation — `_structurally_bad`/`_batch_m_pad`
+    are the single source of truth for both)."""
+    sets = list(sets)
+    B = _bucket_sets()
+    if len(sets) <= B:
+        return None
+    if any(_structurally_bad(s) for s in sets):
+        return None                      # plain path rejects structurally
+    m_pad = _batch_m_pad(sets)
+    chunks = [sets[i:i + B] for i in range(0, len(sets), B)]
+
+    def prepare(chunk):
+        return prepare_chunk(chunk, dst, rng, min_sets=B, min_pks=m_pad)
+
+    return chunks, prepare, execute_chunk
 
 
 def verify_signature_sets(sets, dst=DST_POP, rng=None):
@@ -322,10 +511,9 @@ def verify_signature_sets(sets, dst=DST_POP, rng=None):
     B = _bucket_sets()
     if len(sets) <= B:
         return _verify_chunk(sets, dst, rng)
-    if not all(s.signature is not None and s.pubkeys
-               and all(pk is not None for pk in s.pubkeys) for s in sets):
+    if any(_structurally_bad(s) for s in sets):
         return False
-    m_pad = _next_pow2(max(len(s.pubkeys) for s in sets))
+    m_pad = _batch_m_pad(sets)
     for i in range(0, len(sets), B):
         if not _verify_chunk(sets[i:i + B], dst, rng,
                              min_sets=B, min_pks=m_pad):
@@ -345,7 +533,7 @@ def _per_set_chunk(sets, dst, min_sets=1, min_pks=1):
     _, out = _jit_per_set(pk, sig, u0, u1, real)
     verdicts = [bool(v) for v in np.asarray(out)[: len(sets)]]
     if tr is not None:
-        _trace_chunk(tr, t0, t1, len(sets), n_pad, per_set=True)
+        _trace_chunk(tr, (t1 - t0) * 1e3, t1, len(sets), n_pad, per_set=True)
     return verdicts
 
 
@@ -376,8 +564,7 @@ def verify_signature_sets_per_set(sets, dst=DST_POP):
         return []
     if len(sets) <= B:
         return _per_set_chunk(sets, dst)
-    m_pad = _next_pow2(max((len(s.pubkeys) for s in sets if s.pubkeys),
-                           default=1))
+    m_pad = _batch_m_pad(sets)
     out = []
     for i in range(0, len(sets), B):
         out.extend(_per_set_chunk(sets[i:i + B], dst,

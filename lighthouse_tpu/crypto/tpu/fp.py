@@ -91,6 +91,19 @@ def ints_to_array(xs) -> np.ndarray:
     return np.ascontiguousarray(a.T).astype(np.int32)
 
 
+def int_to_mont_limbs(x: int) -> np.ndarray:
+    """Host-side Montgomery map: int -> (NLIMB,) canonical int32 limbs of
+    x·R mod p.  One bigint mulmod, no device involvement — the staging
+    path of the verify pipeline's host-prep stage."""
+    return int_to_limbs((int(x) * R_INT) % P)
+
+
+def ints_to_mont_array(xs) -> np.ndarray:
+    """Host-side batch Montgomery map: ints -> (NLIMB, len) int32 limbs
+    (batch trailing), each column x·R mod p."""
+    return ints_to_array([(int(x) * R_INT) % P for x in xs])
+
+
 def array_to_ints(a) -> list:
     a = np.asarray(a)
     flat = a.reshape(NLIMB, -1)

@@ -235,12 +235,18 @@ def hash_to_g2_device(u0, u1):
 
 
 def hash_to_field_host(msgs, dst=DST_POP):
-    """Host: list of byte-strings -> two batched device Fp2 elements."""
+    """Host: list of byte-strings -> two batched device Fp2 elements.
+
+    Montgomery conversion happens on the HOST (one bigint mulmod per
+    element) so batch prep stages no device programs: the verify
+    pipeline's prep thread must never contend with the executing chunk
+    for the device, and a single mulmod is cheaper than a `to_mont`
+    launch per staged array anyway."""
     us = [hash_to_field_fp2(m, 2, dst) for m in msgs]
     def dev(vals):
-        c0 = fp.to_mont_jit(jnp.asarray(fp.ints_to_array([v[0] for v in vals])))
-        c1 = fp.to_mont_jit(jnp.asarray(fp.ints_to_array([v[1] for v in vals])))
-        return (c0, c1)
+        def mont(ints):
+            return jnp.asarray(fp.ints_to_mont_array(ints))
+        return (mont([v[0] for v in vals]), mont([v[1] for v in vals]))
     return dev([u[0] for u in us]), dev([u[1] for u in us])
 
 

@@ -33,6 +33,7 @@ from .circuit import CircuitBreaker
 from .service import (
     PRIORITY_CLASSES,
     SHED_LEVEL,
+    AdaptiveBatchController,
     LoadShedError,
     ShedVerdicts,
     QueueFullError,
@@ -43,6 +44,7 @@ from .service import (
 )
 
 __all__ = [
+    "AdaptiveBatchController",
     "CircuitBreaker",
     "LoadShedError",
     "PRIORITY_CLASSES",

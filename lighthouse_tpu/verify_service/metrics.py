@@ -64,6 +64,16 @@ POISONED_BATCHES = metrics.counter(
     "verify_service_poisoned_batches_total",
     "Failed batches resolved through the per-set-verdict attribution pass",
 )
+TARGET_BATCH = metrics.gauge(
+    "verify_service_target_batch",
+    "Dispatch threshold (signature sets) — walked toward the measured "
+    "fixed-cost/marginal-cost knee by the adaptive EWMA controller",
+)
+OVERLAP_RATIO = metrics.gauge(
+    "verify_service_overlap_ratio",
+    "Mean fraction of host-prep time hidden behind device execution in "
+    "the last pipelined batch (0 = fully serial)",
+)
 CIRCUIT_STATE = metrics.gauge(
     "verify_service_circuit_state",
     "Device circuit breaker: 0=closed 1=open 2=half-open",

@@ -24,9 +24,12 @@ wrappers (and the `backend` property) make the service a drop-in
 unchanged apart from a priority tag.
 """
 
+import heapq
+import itertools
 import threading
 import time
 from collections import deque
+from queue import Empty, Queue
 
 from ..crypto.backend import SignatureVerifier
 from ..utils import tracing
@@ -51,6 +54,7 @@ SHED_LEVEL = {"discovery": 1, "attestation": 2}
 
 DEFAULT_TARGET_BATCH = 128          # dispatch immediately at this many sets
 DEFAULT_MAX_BATCH = 512             # never exceed (device chunk ceiling)
+DEFAULT_MIN_TARGET = 16             # adaptive controller's lower bound
 DEFAULT_MAX_DELAY = {               # per-class coalescing window (seconds)
     "block": 0.002,                 # blocks are latency-critical
     "aggregate": 0.010,
@@ -158,7 +162,7 @@ class VerifyFuture:
 
 class _Request:
     __slots__ = ("sets", "future", "cls", "deadline", "submitted", "per_set",
-                 "trace")
+                 "trace", "dispatched")
 
     def __init__(self, sets, future, cls, deadline, submitted, per_set,
                  trace=None):
@@ -171,6 +175,68 @@ class _Request:
         # the submitter thread's current pipeline trace: the dispatcher
         # appends queue-wait/batch/kernel spans to it before resolving
         self.trace = trace
+        # marks this request's deadline-heap entry stale once popped
+        # from its class queue (lazy heap deletion)
+        self.dispatched = False
+
+
+class AdaptiveBatchController:
+    """EWMA knee controller for the dispatch threshold.
+
+    Every dispatched batch contributes one (sets, kernel_seconds) sample.
+    EWMA first/second moments give a running least-squares fit
+    ``t ≈ fixed + per_set·n``; the knee ``n* = fixed / per_set`` is the
+    batch size at which the per-batch fixed cost (launch, padding, batch
+    bookkeeping) has been amortized down to the marginal per-set cost —
+    the measured operating point the continuous-batching literature
+    (Orca-style iteration scheduling) picks instead of a static 128.
+    `update` walks the target a quarter of the way toward the knee per
+    batch (jumping would thrash the coalescing window) and clamps to
+    [lo, hi], so a nonsense fit can never push the dispatcher outside
+    its bounds."""
+
+    def __init__(self, initial, lo, hi, alpha=0.15):
+        self.lo = float(lo)
+        self.hi = float(max(hi, lo))
+        self.alpha = float(alpha)
+        self.target = min(max(float(initial), self.lo), self.hi)
+        self._m_n = None          # EWMA moments of (n, t) samples
+        self._m_t = self._m_nn = self._m_nt = 0.0
+        self.fixed_s = None       # last fitted per-batch fixed cost
+        self.per_set_s = None     # last fitted marginal per-set cost
+
+    def update(self, n, t):
+        """Feed one (batch sets, kernel seconds) sample; returns the new
+        integer target."""
+        if n <= 0 or t < 0.0:
+            return int(round(self.target))
+        n, t = float(n), float(t)
+        a = self.alpha
+        if self._m_n is None:
+            self._m_n, self._m_t = n, t
+            self._m_nn, self._m_nt = n * n, n * t
+            return int(round(self.target))
+        self._m_n += a * (n - self._m_n)
+        self._m_t += a * (t - self._m_t)
+        self._m_nn += a * (n * n - self._m_nn)
+        self._m_nt += a * (n * t - self._m_nt)
+        var = self._m_nn - self._m_n * self._m_n
+        if var <= 1e-9:
+            return int(round(self.target))    # no size diversity yet
+        per_set = (self._m_nt - self._m_n * self._m_t) / var
+        fixed = self._m_t - per_set * self._m_n
+        self.fixed_s = max(fixed, 0.0)
+        self.per_set_s = max(per_set, 0.0)
+        if per_set <= 0.0:
+            knee = self.hi        # flat marginal cost: batch as large as allowed
+        elif fixed <= 0.0:
+            knee = self.lo        # no fixed cost to amortize
+        else:
+            knee = fixed / per_set
+        knee = min(max(knee, self.lo), self.hi)
+        self.target = min(max(self.target + 0.25 * (knee - self.target),
+                              self.lo), self.hi)
+        return int(round(self.target))
 
 
 class VerificationService:
@@ -190,10 +256,27 @@ class VerificationService:
                  max_batch=DEFAULT_MAX_BATCH,
                  max_delay=None, queue_caps=None,
                  breaker_threshold=3, breaker_cooldown=30.0,
-                 shed_watermark=None):
+                 shed_watermark=None, pipeline=True,
+                 adaptive_batch=False, target_bounds=None):
         self.verifier = verifier or SignatureVerifier("oracle")
         self.target_batch = int(target_batch)
         self.max_batch = max(int(max_batch), self.target_batch)
+        # two-stage host-prep/device pipeline for multi-chunk batches
+        # (engages only when the backend exposes a plan_pipeline split)
+        self.pipeline = bool(pipeline)
+        # adaptive dispatch threshold: walk target_batch toward the
+        # measured fixed-cost/marginal-cost knee instead of pinning the
+        # constructor constant.  Opt-in: latency-sensitive tests (and
+        # custom targets) keep exact dispatch semantics by default.
+        self._controller = None
+        if adaptive_batch:
+            lo, hi = target_bounds or (
+                min(DEFAULT_MIN_TARGET, self.target_batch), self.max_batch
+            )
+            self._controller = AdaptiveBatchController(
+                self.target_batch, lo, hi
+            )
+        M.TARGET_BATCH.set(self.target_batch)
         # queued-set depth at which sheddable classes start being
         # rejected (level 1); 4x this is level 2.  Default: several
         # device passes' worth of backlog.
@@ -210,6 +293,11 @@ class VerificationService:
 
         self._queues = [deque() for _ in PRIORITY_CLASSES]
         self._queued_sets = 0
+        # min-heap of (deadline, seq, request) maintained at submit;
+        # entries whose request already dispatched are dropped lazily —
+        # the nearest-deadline peek is O(log n), not a full-queue scan
+        self._deadline_heap = []
+        self._req_seq = itertools.count()
         self._cv = threading.Condition()
         self._thread = None
         self._executor = None
@@ -227,6 +315,7 @@ class VerificationService:
         # tests read these; Prometheus carries the unbounded series)
         self.dispatched_batches = deque(maxlen=4096)   # sets per batch
         self.recent_waits = deque(maxlen=8192)         # queue wait seconds
+        self.recent_overlaps = deque(maxlen=4096)      # pipelined prep overlap
 
     # ------------------------------------------------------------ compat
 
@@ -340,6 +429,10 @@ class VerificationService:
                 M.ADMISSION_REJECTED.inc()
                 raise QueueFullError(f"{cls} queue at capacity")
             self._queues[idx].append(req)
+            heapq.heappush(
+                self._deadline_heap,
+                (req.deadline, next(self._req_seq), req),
+            )
             self._queued_sets += len(sets)
             M.SETS_SUBMITTED.inc(len(sets))
             M.queue_depth_gauge(cls).set(len(self._queues[idx]))
@@ -426,17 +519,42 @@ class VerificationService:
 
     def _dispatch_wait_locked(self):
         """None = no work; <=0 = dispatch now; >0 = seconds until the
-        nearest queued deadline.  ALL queued requests are scanned, not
-        just queue heads: an explicit short `deadline` can sit behind a
-        default-window request in the same class.  Cheap by construction
-        — this path only runs when queued sets < target_batch."""
+        nearest queued deadline.  The nearest deadline comes from a
+        min-heap maintained at submit time (an explicit short `deadline`
+        can sit behind a default-window request in the same class, so
+        queue heads alone are not enough) — an O(log n) peek with lazy
+        deletion of dispatched entries, where the old full scan was
+        O(total queued requests) per dispatcher tick."""
         if self._queued_sets == 0:
+            # every heap entry is necessarily stale now — drop them so an
+            # idle service doesn't retain resolved requests (and their
+            # signature sets) until the next submit
+            self._deadline_heap.clear()
             return None
+        # prune BEFORE the target-batch early return: under sustained
+        # saturating load that branch fires every tick, and skipping the
+        # pops here would let dispatched entries accumulate unboundedly
+        self._prune_deadline_heap_locked()
         if self._queued_sets >= self.target_batch:
             return 0.0
-        now = time.monotonic()
-        nearest = min(r.deadline for q in self._queues for r in q)
-        return nearest - now
+        heap = self._deadline_heap
+        if not heap:                       # defensive; queued_sets > 0
+            return 0.0                     # implies a live entry exists
+        return heap[0][0] - time.monotonic()
+
+    def _prune_deadline_heap_locked(self):
+        """Lazy deletion: pop dispatched entries off the top; compact the
+        whole heap when stale entries buried behind a live minimum come
+        to dominate (requests dispatch in priority order, not deadline
+        order, so burial is possible)."""
+        heap = self._deadline_heap
+        while heap and heap[0][2].dispatched:
+            heapq.heappop(heap)
+        live = sum(len(q) for q in self._queues)
+        if len(heap) > 64 and len(heap) > 2 * live:
+            heap = [e for e in heap if not e[2].dispatched]
+            heapq.heapify(heap)
+            self._deadline_heap = heap
 
     def _form_batch_locked(self):
         """Pop requests in priority order up to max_batch sets.  Requests
@@ -449,7 +567,9 @@ class VerificationService:
                 k = len(q[0].sets)
                 if reqs and n + k > self.max_batch:
                     break
-                reqs.append(q.popleft())
+                req = q.popleft()
+                req.dispatched = True      # stale-marks its heap entry
+                reqs.append(req)
                 n += k
             M.queue_depth_gauge(cls).set(len(q))
             if reqs and n >= self.max_batch:
@@ -462,8 +582,11 @@ class VerificationService:
         for idx, cls in enumerate(PRIORITY_CLASSES):
             q = self._queues[idx]
             while q:
-                q.popleft().future.set_error(err)
+                req = q.popleft()
+                req.dispatched = True
+                req.future.set_error(err)
             M.queue_depth_gauge(cls).set(0)
+        self._deadline_heap.clear()
         self._queued_sets = 0
 
     def _note_device_failure(self, exc=None):
@@ -517,6 +640,109 @@ class VerificationService:
             tr.add_span("batch", t_dispatch, t_k0, **attrs)
             tr.add_span("kernel", t_k0, t_k1, backend=attrs.get("backend"))
 
+    # ------------------------------------------- host-prep/device pipeline
+
+    def _run_pipeline(self, chunks, prepare, execute):
+        """Two-deep software pipeline: a batch-scoped prep thread stages
+        chunk N+1 while this (dispatcher) thread executes chunk N on the
+        device — a multi-chunk batch's wall time approaches
+        max(prep, device) instead of their sum.  The depth-1 handoff
+        queue is the backpressure: at most one staged chunk waits while
+        one preps and one executes.
+
+        The prep thread is BATCH-SCOPED by design: it exits after its
+        last chunk (or its first error), so there is no worker lifecycle
+        to coordinate with service shutdown — stop() during a pipelined
+        dispatch lets this method finish normally (draining every staged
+        chunk in the finally) and the running batch's futures resolve;
+        only still-queued requests fail with ServiceStopped."""
+        out_q = Queue(maxsize=1)
+
+        def produce():
+            for chunk in chunks:
+                t0 = time.monotonic()
+                try:
+                    item = prepare(chunk)
+                except BaseException as e:   # delivered, not raised: the
+                    out_q.put((t0, time.monotonic(), e))
+                    return                   # dispatcher owns error handling
+                out_q.put((t0, time.monotonic(), item))
+
+        t = threading.Thread(
+            target=produce, name="verify_service_prep", daemon=True
+        )
+        t.start()
+        ok = True
+        consumed = 0
+        overlaps = []
+        prev_exec = None
+        try:
+            for _ in range(len(chunks)):
+                p0, p1, prepared = out_q.get()
+                consumed += 1
+                if isinstance(prepared, BaseException):
+                    raise prepared
+                if not ok:
+                    # verdict already settled False: drain the remaining
+                    # preps without launching kernels (the serial chunk
+                    # loop's early-exit cost profile)
+                    continue
+                # how much of THIS chunk's prep ran during the previous
+                # chunk's device window
+                ratio = 0.0
+                if prev_exec is not None and p1 > p0:
+                    shared = min(p1, prev_exec[1]) - max(p0, prev_exec[0])
+                    ratio = max(0.0, shared) / (p1 - p0)
+                    overlaps.append(ratio)
+                e0 = time.monotonic()
+                ok = execute(prepared, overlap_ratio=ratio) and ok
+                prev_exec = (e0, time.monotonic())
+        finally:
+            # if execute raised, the producer may be blocked on the full
+            # handoff queue: drain until it has delivered every chunk (or
+            # exited).  Empty alone does NOT mean the producer died — a
+            # slow prep can exceed any fixed timeout — so only a dead
+            # thread ends the drain early.
+            while consumed < len(chunks):
+                try:
+                    _, _, item = out_q.get(timeout=0.25)
+                except Empty:
+                    if not t.is_alive():
+                        break   # exited early on its own error
+                    continue    # still prepping — keep draining
+                consumed += 1
+                if isinstance(item, BaseException):
+                    break       # producer stopped after delivering this
+        if overlaps:
+            mean = sum(overlaps) / len(overlaps)
+            self.recent_overlaps.extend(overlaps)
+            M.OVERLAP_RATIO.set(round(mean, 4))
+        return ok
+
+    def _verify_batch(self, v, all_sets):
+        """One backend pass for a formed batch: the two-stage pipeline
+        when the backend exposes a prep/execute split AND the batch spans
+        multiple chunks; the plain call otherwise.  A pipeline failure
+        falls back to the plain call, whose internal degrade chain owns
+        device-failure semantics (breaker events included)."""
+        if self.pipeline:
+            plan_fn = getattr(v, "plan_pipeline", None)
+            plan = None
+            if plan_fn is not None:
+                try:
+                    plan = plan_fn(all_sets)
+                except Exception:
+                    plan = None
+            if plan:
+                try:
+                    return self._run_pipeline(*plan)
+                except Exception as e:
+                    log.warning(
+                        "pipelined dispatch failed (%s); plain path",
+                        str(e)[:200],
+                    )
+        return v.verify_signature_sets(all_sets)
+
     def _dispatch(self, reqs):
         now = time.monotonic()
         all_sets = []
@@ -551,7 +777,7 @@ class VerificationService:
         bt.add_span("batch", now, t_k0, **batch_attrs)
         try:
             with tracing.use(bt):
-                ok = v.verify_signature_sets(all_sets)
+                ok = self._verify_batch(v, all_sets)
         except Exception as e:
             # the seam's internal fallback chain should make this
             # unreachable; fail the batch's futures rather than hang them
@@ -567,6 +793,14 @@ class VerificationService:
             return
         t_k1 = time.monotonic()
         bt.add_span("kernel", t_k0, t_k1, backend=batch_attrs["backend"])
+        if self._controller is not None:
+            # feed the knee controller the measured (sets, kernel time)
+            # sample; target_batch is a plain int write — the dispatcher
+            # is the only writer, readers see old-or-new (both valid)
+            self.target_batch = self._controller.update(
+                len(all_sets), t_k1 - t_k0
+            )
+            M.TARGET_BATCH.set(self.target_batch)
         if device_attempt:
             if self._device_event:
                 self.breaker.record_failure()
@@ -631,6 +865,7 @@ class VerificationService:
         def pct(p):
             return waits[min(int(p * len(waits)), len(waits) - 1)] if waits else 0.0
 
+        overlaps = list(self.recent_overlaps)
         return {
             "batches": len(batches),
             "sets": sum(batches),
@@ -639,4 +874,8 @@ class VerificationService:
             "queue_wait_p50_ms": pct(0.50) * 1e3,
             "queue_wait_p99_ms": pct(0.99) * 1e3,
             "circuit_state": self.breaker.state,
+            "target_batch": self.target_batch,
+            "overlap_ratio_mean": (
+                round(sum(overlaps) / len(overlaps), 4) if overlaps else 0.0
+            ),
         }

@@ -129,6 +129,7 @@ def test_metric_name_lint():
     import lighthouse_tpu.beacon.beacon_processor  # noqa: F401
     import lighthouse_tpu.beacon.block_times_cache  # noqa: F401
     import lighthouse_tpu.beacon.validator_monitor  # noqa: F401
+    import lighthouse_tpu.crypto.tpu.bls  # noqa: F401 (pubkey-cache counters)
     import lighthouse_tpu.verify_service.metrics  # noqa: F401
 
     name_re = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
@@ -142,6 +143,16 @@ def test_metric_name_lint():
         for label in labels:
             assert label_re.fullmatch(label), f"{name}: bad label {label!r}"
             assert not label.startswith("__"), f"{name}: reserved {label!r}"
+    # the fast-path families must be registered (and therefore linted):
+    # pubkey-cache hit/miss counters, the adaptive-batch gauge, and the
+    # pipeline-overlap gauge all ship with this subsystem
+    names = {name for name, _, _, _ in registered}
+    assert {
+        "verify_pubkey_cache_hits_total",
+        "verify_pubkey_cache_misses_total",
+        "verify_service_target_batch",
+        "verify_service_overlap_ratio",
+    } <= names, sorted(names)
 
 
 def test_verify_service_queue_depth_is_one_labeled_family():

@@ -38,28 +38,63 @@ class StubSet:
 
 
 class StubVerifier:
-    """Device-shaped latency model: fixed kernel-launch cost plus a
-    per-set cost, mirroring the measured gossip-batch curve shape (flat
-    batch latency up to the compile bucket)."""
+    """Device-shaped two-stage latency model, chunked like the real
+    backend: per compile-bucket chunk the HOST pays a prep cost
+    (padding, hashing, staging) and the DEVICE a launch + per-set cost —
+    mirroring the measured gossip-batch curve shape.  `plan_pipeline`
+    exposes the same stage split the TPU backend exposes, so the sweep
+    measures the DISPATCHER's pipelining, not BLS math."""
 
     backend = "stub"
 
-    def __init__(self, fixed_ms=2.0, per_set_us=20.0):
+    def __init__(self, fixed_ms=2.0, per_set_us=20.0,
+                 prep_ms=2.0, prep_per_set_us=20.0, chunk=32):
         self.fixed_s = fixed_ms / 1e3
         self.per_set_s = per_set_us / 1e6
+        self.prep_s = prep_ms / 1e3
+        self.prep_per_set_s = prep_per_set_us / 1e6
+        self.chunk = max(1, int(chunk))
         self.calls = 0
         self.on_device_fallback = None
 
-    def verify_signature_sets(self, sets, priority=None):
+    def _prep_cost(self, n):
+        return self.prep_s + self.prep_per_set_s * n
+
+    def _dev_cost(self, n):
+        return self.fixed_s + self.per_set_s * n
+
+    def _chunks(self, sets):
+        return [sets[i:i + self.chunk] for i in range(0, len(sets), self.chunk)]
+
+    def plan_pipeline(self, sets):
+        """Stage split for the service's host-prep/device pipeline; None
+        for single-chunk batches (nothing to overlap)."""
         sets = list(sets)
-        self.calls += 1
-        time.sleep(self.fixed_s + self.per_set_s * len(sets))
+        if len(sets) <= self.chunk:
+            return None
+        chunks = self._chunks(sets)
+
+        def prepare(chunk):
+            time.sleep(self._prep_cost(len(chunk)))
+            return chunk
+
+        def execute(prepared, overlap_ratio=None):
+            self.calls += 1
+            time.sleep(self._dev_cost(len(prepared)))
+            return True
+
+        return chunks, prepare, execute
+
+    def verify_signature_sets(self, sets, priority=None):
+        # serial path: prep + device per chunk, back to back
+        for chunk in self._chunks(list(sets)) or [[]]:
+            self.calls += 1
+            time.sleep(self._prep_cost(len(chunk)) + self._dev_cost(len(chunk)))
         return True
 
     def verify_signature_sets_per_set(self, sets, priority=None):
         sets = list(sets)
-        self.calls += 1
-        time.sleep(self.fixed_s + self.per_set_s * len(sets))
+        self.verify_signature_sets(sets)
         return [True] * len(sets)
 
 
@@ -78,6 +113,7 @@ def run_point(service, make_set, submitters, offered_rps, duration):
     offered_rps/submitters, futures collected and awaited at the end."""
     service.dispatched_batches.clear()
     service.recent_waits.clear()
+    service.recent_overlaps.clear()
     per_thread_rps = offered_rps / submitters
     interval = 1.0 / per_thread_rps if per_thread_rps > 0 else 0.0
     stop_at = time.monotonic() + duration
@@ -116,6 +152,7 @@ def run_point(service, make_set, submitters, offered_rps, duration):
 
     batches = sorted(service.dispatched_batches)
     waits = sorted(service.recent_waits)
+    overlaps = list(service.recent_overlaps)
 
     def pct(vals, p):
         return vals[min(int(p * len(vals)), len(vals) - 1)] if vals else 0
@@ -127,6 +164,9 @@ def run_point(service, make_set, submitters, offered_rps, duration):
         "rejected": sum(rejected),
         "verified_ok": ok,
         "achieved_rps": round(sum(submitted) / wall, 1),
+        # completion throughput (wall includes the drain): the A/B number
+        # the pipeline flag moves
+        "verified_per_sec": round(ok / wall, 1) if wall > 0 else 0.0,
         "batches": len(batches),
         "batch_sets_mean": round(sum(batches) / len(batches), 2) if batches else 0,
         "batch_sets_p50": pct(batches, 0.50),
@@ -134,6 +174,10 @@ def run_point(service, make_set, submitters, offered_rps, duration):
         "batch_sets_max": batches[-1] if batches else 0,
         "queue_wait_p50_ms": round(pct(waits, 0.50) * 1e3, 3),
         "queue_wait_p99_ms": round(pct(waits, 0.99) * 1e3, 3),
+        "overlap_ratio_mean": (
+            round(sum(overlaps) / len(overlaps), 4) if overlaps else 0.0
+        ),
+        "target_batch": service.target_batch,
     }
 
 
@@ -147,19 +191,35 @@ def main(argv=None):
     ap.add_argument("--backend", default="stub",
                     choices=["stub", "fake", "native", "oracle"])
     ap.add_argument("--fixed-ms", type=float, default=2.0,
-                    help="stub backend: fixed per-batch latency")
+                    help="stub backend: fixed per-chunk device latency")
     ap.add_argument("--per-set-us", type=float, default=20.0,
-                    help="stub backend: marginal per-set latency")
+                    help="stub backend: marginal per-set device latency")
+    ap.add_argument("--prep-ms", type=float, default=2.0,
+                    help="stub backend: fixed per-chunk host-prep latency")
+    ap.add_argument("--prep-per-set-us", type=float, default=20.0,
+                    help="stub backend: marginal per-set host-prep latency")
+    ap.add_argument("--chunk", type=int, default=32,
+                    help="stub backend: compile-bucket chunk size")
     ap.add_argument("--target-batch", type=int, default=128)
+    ap.add_argument("--pipeline", choices=["on", "off"], default="on",
+                    help="A/B the dispatcher's host-prep/device pipeline")
+    ap.add_argument("--adaptive", action="store_true",
+                    help="enable the adaptive target_batch controller")
     args = ap.parse_args(argv)
 
     if args.backend == "stub":
-        verifier = StubVerifier(args.fixed_ms, args.per_set_us)
+        verifier = StubVerifier(args.fixed_ms, args.per_set_us,
+                                args.prep_ms, args.prep_per_set_us,
+                                args.chunk)
         make_set = StubSet
     else:
         verifier, real_set = _real_backend(args.backend)
         make_set = lambda: real_set  # noqa: E731
-    service = VerificationService(verifier, target_batch=args.target_batch)
+    service = VerificationService(
+        verifier, target_batch=args.target_batch,
+        pipeline=(args.pipeline == "on"),
+        adaptive_batch=args.adaptive,
+    )
 
     points = []
     for rate in (float(r) for r in args.rates.split(",")):
@@ -171,6 +231,8 @@ def main(argv=None):
         "tool": "verify_service_bench",
         "backend": args.backend,
         "target_batch": args.target_batch,
+        "pipeline": args.pipeline,
+        "adaptive": args.adaptive,
         "points": points,
     }))
     return 0
