@@ -506,6 +506,35 @@ def config_verify_service():
     except Exception as e:
         note("verify_service_pubkey_cache_error", error=str(e)[:300])
 
+    # chaos: goodput under a 20% device-fault storm plus the
+    # deterministic breaker trip -> half-open-probe -> restore time
+    # (tools/chaos_bench.py; ISSUE 5's recovery acceptance numbers)
+    try:
+        cpath = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "tools", "chaos_bench.py",
+        )
+        cspec = importlib.util.spec_from_file_location("chaos_bench", cpath)
+        cb = importlib.util.module_from_spec(cspec)
+        cspec.loader.exec_module(cb)
+        try:
+            pt = cb.run_chaos_point(
+                fault_rate=0.2, submitters=8, offered_rps=2000.0,
+                duration=1.5, seed=1234, target_batch=target_batch,
+            )
+            note("verify_service_chaos_point", **pt)
+            _VS_SUMMARY["goodput_under_faults"] = pt["goodput_per_sec"]
+            _VS_SUMMARY["chaos_lost_verdicts"] = pt["lost"]
+            rec = cb.measure_breaker_recovery(seed=1234)
+            note("verify_service_breaker_recovery", **rec)
+            _VS_SUMMARY["breaker_recovery_seconds"] = rec[
+                "breaker_recovery_seconds"
+            ]
+        finally:
+            cb.failpoints.reset()
+    except Exception as e:
+        note("verify_service_chaos_error", error=str(e)[:300])
+
     note("verify_service_sweep", **_VS_SUMMARY)
 
 

@@ -247,6 +247,43 @@ class _Handler(JsonHandler):
             return self._json({"data": {
                 "component": component or "root", "level": applied,
             }})
+        if path == "/lighthouse/failpoints":
+            # runtime fault injection: {"name": "...", "mode": "..."} for
+            # one failpoint, or {"failpoints": {name: mode, ...}} for a
+            # whole storm.  Takes effect immediately, no restart — the
+            # PATCH twin of the GET snapshot below.
+            from ..utils import failpoints
+
+            if not isinstance(body, dict):
+                return self._err(400, 'body must be {"name": ..., "mode":'
+                                      ' ...} or {"failpoints": {...}}')
+            if "failpoints" in body:
+                updates = body["failpoints"]
+            elif "name" in body:
+                updates = {body["name"]: body.get("mode", "off")}
+            else:
+                updates = None
+            if not isinstance(updates, dict) or not updates:
+                return self._err(400, 'body must be {"name": ..., "mode":'
+                                      ' ...} or {"failpoints": {...}}')
+            # validate EVERY name and spec before arming ANY: a storm
+            # with one bad entry must reject atomically, and a typo'd
+            # name must not mint a never-firing registry entry (the
+            # PATCH /lighthouse/logs/level no-per-PATCH-allocation rule)
+            try:
+                for name, mode in updates.items():
+                    if failpoints.get(str(name)) is None:
+                        return self._err(
+                            400, f"unknown failpoint {str(name)[:64]!r}"
+                        )
+                    failpoints.parse_spec(mode)
+            except ValueError as e:
+                return self._err(400, str(e))
+            applied = {
+                str(name): failpoints.configure(str(name), mode).state()
+                for name, mode in updates.items()
+            }
+            return self._json({"data": applied})
         return self._err(404, f"no route {path}")
 
     def _route_get(self, path, q):
@@ -753,6 +790,13 @@ class _Handler(JsonHandler):
             if kind is not None:
                 traces = [t for t in traces if t["kind"] == kind][:limit]
             return self._json({"data": traces})
+
+        if path == "/lighthouse/failpoints":
+            # every declared fault-injection site with its armed mode and
+            # hit counters; PATCH the same path to (dis)arm at runtime
+            from ..utils import failpoints
+
+            return self._json({"data": failpoints.snapshot()})
 
         if path == "/lighthouse/logs/recent":
             # newest-first structured records from the flight recorder's

@@ -18,7 +18,7 @@ import threading
 import time
 from collections import deque
 
-from ..utils import metrics, tracing
+from ..utils import failpoints, metrics, tracing
 from ..utils.logging import get_logger
 
 log = get_logger("beacon_processor")
@@ -67,6 +67,23 @@ class BeaconProcessor:
         self.aggregate_queue = deque()
         self.reprocess_queue = deque()      # early / unknown-parent retries
         self.results = deque(maxlen=4096)   # (kind, ok, info) audit trail
+        # watchdog surface: `run` stamps `heartbeat` every pass;
+        # `restart_run_loop` bumps the generation so a wedged loop is
+        # superseded with every queue intact
+        self.heartbeat = None
+        # monotonic stamp while process_pending is in flight (None when
+        # idle): the watchdog judges an in-pass loop against its larger
+        # busy budget (first-import XLA compile, cold state hashing)
+        self.pass_started = None
+        self._run_gen = 0
+        self._executor = None
+        self.restarts = 0
+        # work-section mutex: a watchdog-restarted loop must NEVER run
+        # process_pending concurrently with a superseded thread that
+        # was wedged INSIDE a pass (the chain/store have no internal
+        # locking) — the replacement blocks here until the old pass
+        # completes, then the generation check drains the old thread
+        self._work_lock = threading.Lock()
 
     # ---------------------------------------------------------- enqueue
 
@@ -271,7 +288,62 @@ class BeaconProcessor:
 
     def run(self, executor, poll_interval=0.05):
         """Service loop for TaskExecutor.spawn."""
+        self._executor = executor
+        with self._lock:
+            gen = self._run_gen
         while not executor.shutting_down:
-            if self.process_pending() == 0:
+            if self._run_gen != gen:
+                return   # superseded by a watchdog restart
+            self.heartbeat = time.monotonic()
+            try:
+                # chaos seam: `delay` wedges the run loop before any
+                # queue is popped (the watchdog's detection target);
+                # `error` skips one tick and retries
+                failpoints.hit("processor.tick")
+            except failpoints.FailpointError:
+                # skip one tick; the pause keeps an error(1.0) injection
+                # from busy-spinning the loop
                 if executor.sleep_or_shutdown(poll_interval):
                     break
+                continue
+            # the wait does NOT stamp the heartbeat: while a predecessor
+            # is mid-pass, `pass_started` keeps the watchdog on the busy
+            # budget — a pass hung PAST that budget must go visibly
+            # stale and draw another dump/restart, not read as healthy
+            while not self._work_lock.acquire(timeout=poll_interval):
+                if self._run_gen != gen or executor.shutting_down:
+                    return
+            self.pass_started = time.monotonic()
+            try:
+                if self._run_gen != gen:
+                    # superseded while wedged (failpoint or a hung
+                    # pass): the new loop owns the queues — running
+                    # process_pending here would drain them
+                    # concurrently with it
+                    return
+                handled = self.process_pending()
+            finally:
+                self.pass_started = None
+                self._work_lock.release()
+            if handled == 0:
+                if executor.sleep_or_shutdown(poll_interval):
+                    break
+
+    def restart_run_loop(self, poll_interval=0.05):
+        """Watchdog recovery hook: supersede a wedged run loop with a
+        fresh supervised thread, queues intact.  The old thread observes
+        the generation bump at its next pass and exits; queued work
+        drains under the new one.  Returns False when never started or
+        already shutting down."""
+        executor = self._executor
+        if executor is None or executor.shutting_down:
+            return False
+        with self._lock:
+            self._run_gen += 1
+            self.restarts += 1
+        executor.spawn(
+            lambda ex: self.run(ex, poll_interval), "beacon_processor"
+        )
+        log.warning("beacon_processor run loop restarted",
+                    generation=self._run_gen)
+        return True

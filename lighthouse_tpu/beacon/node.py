@@ -13,6 +13,7 @@ from ..api.http_api import BeaconApiServer
 from ..crypto.backend import SignatureVerifier
 from ..utils.slot_clock import SystemSlotClock
 from ..utils.task_executor import TaskExecutor
+from ..utils.watchdog import Watchdog
 from .beacon_processor import BeaconProcessor
 from .chain import BeaconChain
 
@@ -35,6 +36,15 @@ class BeaconNode:
         self.discovery = discovery
         self._dial = list(dial)
         self.mesh_interval = 15.0    # seconds between PEX/discovery passes
+        # heartbeat supervisor over the worker loops (utils/watchdog.py):
+        # a wedged dispatcher/run-loop is restarted with queues intact
+        self.watchdog = Watchdog()
+        self.watchdog_budget = 30.0  # seconds of heartbeat staleness
+        # while a worker reports busy (mid work pass) it is judged
+        # against this instead: a first-time XLA compile inside a device
+        # batch can legitimately run for minutes on CPU and must never
+        # read as a wedge — but a pass hung PAST this is still caught
+        self.watchdog_busy_budget = 600.0
 
     def start(self):
         if self.api_server is not None:
@@ -49,9 +59,28 @@ class BeaconNode:
         self.executor.spawn(self._notifier_loop, "notifier", critical=False)
         if self.wire is not None:
             self.executor.spawn(self._dial_loop, "dialer", critical=False)
+        self.watchdog.register(
+            "beacon_processor",
+            heartbeat=lambda: self.processor.heartbeat,
+            restart=self.processor.restart_run_loop,
+            budget=self.watchdog_budget,
+            busy=lambda: self.processor.pass_started is not None,
+            busy_budget=self.watchdog_busy_budget,
+        )
+        if hasattr(verifier, "restart_dispatcher"):
+            self.watchdog.register(
+                "verify_service",
+                heartbeat=lambda: verifier.heartbeat,
+                restart=verifier.restart_dispatcher,
+                budget=self.watchdog_budget,
+                busy=lambda: verifier.pass_started is not None,
+                busy_budget=self.watchdog_busy_budget,
+            )
+        self.watchdog.start(self.executor)
         return self
 
     def stop(self):
+        self.watchdog.stop()
         self.executor.shutdown("node stop")
         stop_verify = getattr(self.chain.verifier, "stop", None)
         if stop_verify is not None:

@@ -25,6 +25,7 @@ import struct
 
 from ..ssz import decode, encode, hash_tree_root
 from ..types.state import state_types
+from ..utils import failpoints
 
 _TOMBSTONE = 0xFFFFFFFF
 
@@ -141,7 +142,10 @@ class PyFileKV(KV):
             return r.read(length)
 
     def put(self, key, value):
-        value = bytes(value)
+        # chaos seam: `corrupt` bit-rots the record on its way to disk
+        # (a torn write the replay/readers must survive); `error` raises
+        # before anything is appended
+        value = failpoints.hit("store.put", data=bytes(value))
         self._f.write(struct.pack("<II", len(key), len(value)))
         self._f.write(key)
         off = self._f.tell()
@@ -161,10 +165,27 @@ class PyFileKV(KV):
         self._f.flush()
         os.fsync(self._f.fileno())
 
+    def _fsync_dir(self):
+        dirfd = os.open(os.path.dirname(self.path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
+
     def compact(self):
         """Rewrite only live records (hot->cold migration keeps the log
-        from growing unboundedly; LevelDB does this with sstable merges)."""
+        from growing unboundedly; LevelDB does this with sstable merges).
+
+        Crash-safe: the temp file (and its directory entry) are fsynced
+        BEFORE `os.replace` publishes it — a crash between write and
+        rename can no longer publish a torn file; the directory is
+        fsynced again after the rename so the swap itself is durable."""
         tmp = self.path + ".compact"
+        # buffered tail appends must reach the OS before the separate
+        # read handle walks the log — without this, a put() not yet
+        # followed by a get() (which flushes) would compact to a
+        # TRUNCATED value
+        self._f.flush()
         with open(tmp, "wb") as out:
             new_index = {}
             for key, (off, length) in list(self._index.items()):
@@ -175,8 +196,16 @@ class PyFileKV(KV):
                 out.write(key)
                 new_index[key] = (out.tell(), len(val))
                 out.write(val)
+            out.flush()
+            os.fsync(out.fileno())
+        self._fsync_dir()
+        # chaos seam: a panic HERE (temp durable, original still live)
+        # is the worst-case crash window — the store must reopen on the
+        # original log and a later compact must succeed
+        failpoints.hit("store.compact")
         self._f.close()
         os.replace(tmp, self.path)
+        self._fsync_dir()
         self._f = open(self.path, "ab+")
         self._index = new_index
 

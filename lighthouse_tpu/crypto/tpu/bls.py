@@ -40,6 +40,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ...utils import failpoints as _failpoints
 from ...utils import metrics as _metrics
 from ...utils import tracing
 from ..constants import P, G1_X, G1_Y, RAND_BITS, DST_POP
@@ -446,7 +447,13 @@ def prepare_chunk(sets, dst=DST_POP, rng=None, min_sets=1, min_pks=1):
 def execute_chunk(prepared, overlap_ratio=None):
     """DEVICE stage: launch the batched kernel on a prepared chunk and
     block for the verdict.  A structurally invalid chunk is False without
-    a launch (the oracle/blst early-False semantics)."""
+    a launch (the oracle/blst early-False semantics).
+
+    Chaos seam: the `device.execute_chunk` failpoint fires before the
+    launch — an injected error propagates exactly like a dead-tunnel jit
+    and drives the backend seam's device→host fallback (and, through it,
+    the verify_service circuit breaker)."""
+    _failpoints.hit("device.execute_chunk")
     if prepared.invalid:
         return False
     tr = tracing.current_trace()

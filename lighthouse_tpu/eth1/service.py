@@ -14,7 +14,9 @@ from ..ssz import hash_tree_root
 from ..state_processing import phase0
 from ..types.containers import DepositData, DepositMessage
 from ..types.state import state_types
+from ..utils import failpoints
 from ..utils.logging import get_logger
+from ..utils.retries import RetryPolicy
 from .deposit_tree import DepositTree
 
 log = get_logger("eth1")
@@ -72,27 +74,55 @@ class MockEth1Chain:
 
 class Eth1Cache:
     """The node-side cache: follows the eth1 chain at a distance, serves
-    deposits-with-proofs and candidate eth1 votes."""
+    deposits-with-proofs and candidate eth1 votes.
 
-    def __init__(self, chain, follow_distance=8):
+    Every read of the upstream chain goes through `_rpc`: the `eth1.rpc`
+    failpoint plus the shared RetryPolicy (utils/retries.py — backoff
+    with full jitter, per-call deadline, `lighthouse_retry_total{target=
+    "eth1"}` accounting).  The in-process MockEth1Chain stands where an
+    HTTP eth1 endpoint would, so a flaky endpoint is simulated by arming
+    the failpoint, and the voting/genesis layers above see a cache that
+    heals transient upstream faults instead of surfacing them."""
+
+    def __init__(self, chain, follow_distance=8, retries=None):
         self.chain = chain
         self.follow_distance = follow_distance
+        self._retries = retries or RetryPolicy(
+            attempts=4, base_delay=0.02, max_delay=0.25, deadline=2.0,
+            retry_on=(failpoints.FailpointError, OSError),
+        )
+
+    def _rpc(self, fn):
+        """One upstream fetch under the failpoint + retry policy."""
+
+        def once():
+            failpoints.hit("eth1.rpc")
+            return fn()
+
+        return self._retries.call(once, target="eth1")
 
     def head_block(self):
-        idx = max(0, len(self.chain.blocks) - 1 - self.follow_distance)
-        return self.chain.blocks[idx]
+        def fetch():
+            idx = max(0, len(self.chain.blocks) - 1 - self.follow_distance)
+            return self.chain.blocks[idx]
+
+        return self._rpc(fetch)
 
     def deposits_for_range(self, start_index, end_index, T):
         """Deposit objects with proofs valid against deposit_root at
         `end_index` (what block production packs for
         state.eth1_deposit_index..eth1_data.deposit_count)."""
-        out = []
-        for i in range(start_index, end_index):
-            proof = self.chain.tree.proof(i, count=end_index)
-            out.append(
-                T.Deposit(proof=proof, data=self.chain.deposits[i])
-            )
-        return out
+
+        def fetch():
+            out = []
+            for i in range(start_index, end_index):
+                proof = self.chain.tree.proof(i, count=end_index)
+                out.append(
+                    T.Deposit(proof=proof, data=self.chain.deposits[i])
+                )
+            return out
+
+        return self._rpc(fetch)
 
     def eth1_data_for_block(self, block):
         return {
@@ -104,15 +134,19 @@ class Eth1Cache:
     def candidate_eth1_data(self, max_candidates=1024):
         """The valid vote targets: eth1 data of followed-range blocks
         (the spec's candidate-block window)."""
-        end = max(0, len(self.chain.blocks) - self.follow_distance)
-        out = set()
-        for blk in self.chain.blocks[max(0, end - max_candidates) : end + 1]:
-            d = self.eth1_data_for_block(blk)
-            out.add(
-                (bytes(d["deposit_root"]), int(d["deposit_count"]),
-                 bytes(d["block_hash"]))
-            )
-        return out
+
+        def fetch():
+            end = max(0, len(self.chain.blocks) - self.follow_distance)
+            out = set()
+            for blk in self.chain.blocks[max(0, end - max_candidates) : end + 1]:
+                d = self.eth1_data_for_block(blk)
+                out.add(
+                    (bytes(d["deposit_root"]), int(d["deposit_count"]),
+                     bytes(d["block_hash"]))
+                )
+            return out
+
+        return self._rpc(fetch)
 
 
 def get_eth1_vote(state, cache, preset):

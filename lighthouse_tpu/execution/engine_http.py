@@ -20,7 +20,9 @@ import json
 import time
 import urllib.parse
 
+from ..utils import failpoints
 from ..utils.keccak import keccak256
+from ..utils.retries import RetryPolicy
 from . import rlp
 from .engine import ExecutionEngine, PayloadStatus
 
@@ -205,18 +207,48 @@ class EngineApiError(Exception):
     pass
 
 
+class EngineTransportError(EngineApiError):
+    """The transient subset: unreachable endpoint, 5xx, injected fault.
+    Only THIS class retries — auth rejections, protocol errors and rpc
+    error envelopes propagate on the first raise (retrying a rejected
+    request is wasted budget; retrying a restarting EL is the point)."""
+
+
 class HttpJsonRpcClient:
     """Minimal JSON-RPC 2.0 over HTTP with per-request JWT injection
-    (http.rs:648 rpc_request)."""
+    (http.rs:648 rpc_request).
 
-    def __init__(self, url: str, jwt_secret: bytes, timeout: float = 8.0):
+    Transport faults retry under the shared RetryPolicy (utils/retries:
+    exponential backoff + full jitter, per-call deadline,
+    `lighthouse_retry_total{target="engine"}`), and every attempt passes
+    the `engine.rpc` failpoint — an armed `delay` models a stalling EL,
+    an armed `error` a connection-refused restart window."""
+
+    def __init__(self, url: str, jwt_secret: bytes, timeout: float = 8.0,
+                 retries=None):
         self.url = url
         self.parsed = urllib.parse.urlparse(url)
         self.jwt_secret = jwt_secret
         self.timeout = timeout
         self._id = 0
+        self.retries = retries or RetryPolicy(
+            attempts=3, base_delay=0.05, max_delay=0.5,
+            deadline=max(2.0, float(timeout)),
+            retry_on=(EngineTransportError,),
+        )
 
     def call(self, method: str, params: list):
+        return self.retries.call(
+            self._call_once, method, params, target="engine"
+        )
+
+    def _call_once(self, method: str, params: list):
+        try:
+            failpoints.hit("engine.rpc")
+        except failpoints.FailpointError as e:
+            raise EngineTransportError(
+                f"engine unreachable: injected fault ({e})"
+            ) from e
         self._id += 1
         body = json.dumps({
             "jsonrpc": "2.0", "method": method,
@@ -234,10 +266,12 @@ class HttpJsonRpcClient:
             data = resp.read()
             if resp.status == 401 or resp.status == 403:
                 raise EngineApiError(f"engine auth rejected ({resp.status})")
+            if resp.status >= 500:
+                raise EngineTransportError(f"engine http {resp.status}")
             if resp.status != 200:
                 raise EngineApiError(f"engine http {resp.status}")
         except (OSError, http.client.HTTPException) as e:
-            raise EngineApiError(f"engine unreachable: {e!r}") from e
+            raise EngineTransportError(f"engine unreachable: {e!r}") from e
         finally:
             conn.close()
         try:

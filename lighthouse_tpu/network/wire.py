@@ -34,6 +34,7 @@ from collections import OrderedDict
 
 from ..ssz import Bytes4, Bytes32, Container, decode, encode, uint64
 from ..types.spec import compute_fork_data_root
+from ..utils import failpoints
 from . import snappy
 from .gossip import GossipKind, PeerScore, PeerTopicScores
 from .gossip import topic_matches as _tm
@@ -1014,6 +1015,13 @@ class WireNode:
         peer = self.peers.get(peer_id)
         if peer is None:
             raise WireError(f"not connected to {peer_id}")
+        try:
+            # chaos seam: `error` fails the call like a dead peer,
+            # `delay` models a stalling link, `corrupt` mangles the
+            # request body (the remote answers R_INVALID_REQUEST)
+            req_body = failpoints.hit("wire.rpc", data=req_body)
+        except failpoints.FailpointError as e:
+            raise WireError(f"injected req/resp fault: {e}") from e
         with self._lock:
             self._req_id += 1
             rid = self._req_id
@@ -1161,6 +1169,10 @@ class WireNode:
 
     def _serve(self, peer, method, req, parsed=None):
         """Server side of the rpc protocols (router.rs on_rpc_request)."""
+        # chaos seam: an injected fault here surfaces to the peer as the
+        # R_SERVER_ERROR response code (_on_request's Exception arm) —
+        # the client-visible shape of a crashing request handler
+        failpoints.hit("wire.serve")
         if method == M_STATUS:
             return [encode(StatusMessage, self.local_status())]
         if method == M_PING or method == M_METADATA:

@@ -1,0 +1,355 @@
+"""Process-wide fault-injection registry (failpoints).
+
+The chaos seam the recovery layer is proven against: named failpoints are
+compiled into every layer that can fail in production — the device kernel
+launch (`device.execute_chunk`), the verify dispatcher and its pipeline
+prep (`verify.dispatch` / `verify.prep`), store write/compact I/O
+(`store.put` / `store.compact`), the upstream RPC seams (`eth1.rpc`,
+`engine.rpc`, `wire.rpc`, `wire.serve`) and the processor run loop
+(`processor.tick`).  A failpoint is a no-op until armed; armed modes:
+
+    off           no-op (the default)
+    error         raise FailpointError on every hit
+    error(p)      raise FailpointError with probability p
+    delay(ms)     sleep ms milliseconds (a stalled RPC / wedged loop)
+    corrupt       flip bytes in the payload passing through the hit
+    corrupt(p)    ... with probability p
+    panic_once    raise FailpointPanic ONCE, then auto-disarm (a crash)
+
+Control surfaces, mirroring the tikv/fail-rs shape the technique comes
+from:
+
+  * env: ``LTPU_FAILPOINTS="store.compact=panic_once;engine.rpc=delay(50)"``
+    parsed at import, so a daemon can boot straight into a chaos run
+  * Python API: ``configure("device.execute_chunk", "error(0.2)")`` for
+    tests, ``reset()`` between them, ``seed_all(n)`` for deterministic
+    probabilistic firing
+  * HTTP: ``GET/PATCH /lighthouse/failpoints`` (api/http_api.py), so a
+    live node can be fault-injected and healed without a restart
+
+Probabilistic modes draw from a per-failpoint ``random.Random`` seeded
+from (seed, name) — one ``seed_all`` call makes an entire fault storm
+reproducible.  Hits are counted per (name, action) in the
+``lighthouse_failpoint_hits_total`` family.
+
+The un-armed fast path is one module-global int compare: sites can leave
+their ``hit()`` calls in production code.
+"""
+
+import os
+import random
+import threading
+import time
+
+from . import metrics
+from .logging import get_logger
+
+log = get_logger("failpoints")
+
+HITS = metrics.counter(
+    "lighthouse_failpoint_hits_total",
+    "Failpoint evaluations by name and action taken "
+    "(pass / error / delay / corrupt / panic)",
+    labels=("name", "action"),
+)
+
+MODES = ("off", "error", "delay", "corrupt", "panic_once")
+
+
+class FailpointError(RuntimeError):
+    """An injected fault (error / panic_once modes)."""
+
+
+class FailpointPanic(FailpointError):
+    """An injected one-shot crash (panic_once) — the failpoint disarms
+    itself as it fires, so the recovery path it exercises runs against a
+    healed dependency exactly once."""
+
+
+def parse_spec(spec):
+    """'error(0.2)' -> ('error', 0.2); 'delay(50)' -> ('delay', 50.0);
+    bare 'error'/'corrupt' default to probability 1.0.  Raises
+    ValueError on junk (the PATCH route validates EVERY spec with this
+    before arming ANY, so a half-applied storm can't hide behind a
+    400)."""
+    spec = str(spec).strip()
+    mode, arg = spec, None
+    if "(" in spec:
+        if not spec.endswith(")"):
+            raise ValueError(f"malformed failpoint spec {spec!r}")
+        mode, raw = spec[:-1].split("(", 1)
+        mode = mode.strip()
+        try:
+            arg = float(raw)
+        except ValueError:
+            raise ValueError(
+                f"non-numeric failpoint argument in {spec!r}"
+            ) from None
+    if mode not in MODES:
+        raise ValueError(
+            f"unknown failpoint mode {mode!r} (one of {', '.join(MODES)})"
+        )
+    if mode == "delay":
+        if arg is None or arg < 0:
+            raise ValueError(
+                f"delay needs a non-negative ms argument: {spec!r}"
+            )
+    elif mode in ("error", "corrupt"):
+        arg = 1.0 if arg is None else arg
+        if not 0.0 <= arg <= 1.0:
+            raise ValueError(f"probability out of [0,1] in {spec!r}")
+    elif arg is not None:
+        # off/panic_once take no argument — silently dropping one would
+        # arm behavior different from what the caller asked for (e.g.
+        # 'panic_once(0.5)' read as a probabilistic one-shot)
+        raise ValueError(f"{mode} takes no argument: {spec!r}")
+    return mode, arg or 0.0
+
+
+def _corrupt_bytes(data):
+    """Flip bits in the middle of a bytes payload (a torn/bit-rotted
+    record); non-bytes payloads pass through untouched."""
+    if not isinstance(data, (bytes, bytearray)) or len(data) == 0:
+        return data
+    buf = bytearray(data)
+    buf[len(buf) // 2] ^= 0xA5
+    return bytes(buf)
+
+
+class Failpoint:
+    """One named injection site.  `hit(data)` applies the armed mode and
+    returns (possibly corrupted) `data`; thread-safe."""
+
+    __slots__ = ("name", "description", "mode", "arg", "evaluations",
+                 "fired", "_rng", "_lock")
+
+    def __init__(self, name, description=""):
+        self.name = name
+        self.description = description
+        self.mode = "off"
+        self.arg = 0.0
+        self.evaluations = 0
+        self.fired = 0
+        self._rng = random.Random(f"{_SEED}:{name}")
+        self._lock = threading.Lock()
+
+    def spec(self):
+        if self.mode in ("error", "corrupt") and self.arg != 1.0:
+            return f"{self.mode}({self.arg:g})"
+        if self.mode == "delay":
+            return f"delay({self.arg:g})"
+        return self.mode
+
+    def configure(self, spec):
+        mode, arg = parse_spec(spec)
+        with self._lock:
+            self.mode, self.arg = mode, arg
+        return self
+
+    def reseed(self, seed):
+        with self._lock:
+            self._rng = random.Random(f"{seed}:{self.name}")
+
+    def hit(self, data=None):
+        # unlocked off-check: a site whose failpoint is NOT armed must
+        # not contend with sites that are (the race with a concurrent
+        # configure() is benign — a hit straddling the arm may miss it)
+        if self.mode == "off":
+            return data
+        with self._lock:
+            mode, arg = self.mode, self.arg
+            if mode == "off":
+                return data
+            self.evaluations += 1
+            fire = True
+            if mode in ("error", "corrupt") and arg < 1.0:
+                fire = self._rng.random() < arg
+            if mode == "panic_once":
+                self.mode = "off"     # one-shot: disarm as it fires
+            if fire:
+                self.fired += 1
+        if mode == "panic_once":
+            _recount()
+        if not fire:
+            HITS.with_labels(self.name, "pass").inc()
+            return data
+        if mode == "delay":
+            HITS.with_labels(self.name, "delay").inc()
+            time.sleep(arg / 1e3)
+            return data
+        if mode == "corrupt":
+            HITS.with_labels(self.name, "corrupt").inc()
+            return _corrupt_bytes(data)
+        if mode == "panic_once":
+            HITS.with_labels(self.name, "panic").inc()
+            raise FailpointPanic(f"injected panic at failpoint {self.name}")
+        HITS.with_labels(self.name, "error").inc()
+        raise FailpointError(f"injected fault at failpoint {self.name}")
+
+    def state(self):
+        with self._lock:
+            return {
+                "mode": self.spec(),
+                "description": self.description,
+                "evaluations": self.evaluations,
+                "fired": self.fired,
+            }
+
+
+_REG = {}
+_REG_LOCK = threading.Lock()
+_SEED = os.environ.get("LTPU_FAILPOINTS_SEED", "0")
+# count of armed (non-off) failpoints — the un-armed fast path in hit()
+_ARMED = 0
+
+
+def _recount():
+    global _ARMED
+    with _REG_LOCK:
+        fps = list(_REG.values())
+    _ARMED = sum(1 for fp in fps if fp.mode != "off")
+
+
+def declare(name, description="") -> Failpoint:
+    """Register an injection site (idempotent; configure() auto-declares
+    so env/API ordering never matters)."""
+    with _REG_LOCK:
+        fp = _REG.get(name)
+        if fp is None:
+            fp = _REG[name] = Failpoint(name, description)
+        elif description and not fp.description:
+            fp.description = description
+    return fp
+
+
+def get(name):
+    with _REG_LOCK:
+        return _REG.get(name)
+
+
+def configure(name, spec) -> Failpoint:
+    """Arm/disarm one failpoint from a spec string; raises ValueError on
+    a malformed spec (surfaced as HTTP 400 by the PATCH route)."""
+    fp = declare(name).configure(spec)
+    _recount()
+    if fp.mode != "off":
+        log.info("failpoint armed: %s = %s", name, fp.spec())
+    return fp
+
+
+def configure_many(mapping):
+    for name, spec in dict(mapping).items():
+        configure(name, spec)
+
+
+def parse_env(value):
+    """'a=error(0.2);b=delay(50)' -> {'a': 'error(0.2)', 'b': 'delay(50)'}
+    (';' or ',' separated)."""
+    out = {}
+    for part in str(value).replace(",", ";").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"malformed LTPU_FAILPOINTS entry {part!r}")
+        name, spec = part.split("=", 1)
+        out[name.strip()] = spec.strip()
+    return out
+
+
+def hit(name, data=None):
+    """Evaluate a failpoint by name.  Near-free when nothing is armed
+    (one global int compare); unknown names are inert until declared or
+    configured."""
+    if _ARMED == 0:
+        return data
+    # lock-free lookup: the registry dict is insert-only and CPython
+    # dict reads are atomic — arming ONE failpoint must not serialize
+    # every other site's hot path on a process-global mutex (that
+    # contention would skew the very goodput numbers chaos runs measure)
+    fp = _REG.get(name)
+    if fp is None:
+        return data
+    return fp.hit(data)
+
+
+def is_armed(name) -> bool:
+    fp = get(name)
+    return fp is not None and fp.mode != "off"
+
+
+def seed_all(seed):
+    """Reseed every failpoint's RNG from (seed, name) — one call makes a
+    probabilistic fault storm reproducible."""
+    global _SEED
+    _SEED = str(seed)
+    with _REG_LOCK:
+        fps = list(_REG.values())
+    for fp in fps:
+        fp.reseed(_SEED)
+
+
+def reset():
+    """Disarm everything and zero the per-failpoint counters (test
+    isolation; the prometheus family is monotonic and stays)."""
+    with _REG_LOCK:
+        fps = list(_REG.values())
+    for fp in fps:
+        with fp._lock:
+            fp.mode, fp.arg = "off", 0.0
+            fp.evaluations = fp.fired = 0
+    _recount()
+
+
+def snapshot() -> dict:
+    """{name: {mode, description, evaluations, fired}} for every declared
+    failpoint — the GET /lighthouse/failpoints body."""
+    with _REG_LOCK:
+        fps = sorted(_REG.items())
+    return {name: fp.state() for name, fp in fps}
+
+
+# ------------------------------------------------------- well-known sites
+# Declared here so the GET route lists every site even before its module
+# is imported; the wiring lives at the sites themselves.
+
+declare("device.execute_chunk",
+        "device kernel launch (crypto/tpu/bls.execute_chunk)")
+declare("verify.dispatch",
+        "verify_service dispatcher loop, before batch formation")
+declare("verify.prep",
+        "verify_service pipeline host-prep stage (per chunk)")
+declare("store.put", "beacon store KV record write (PyFileKV.put)")
+declare("store.compact",
+        "beacon store log compaction, after the durable temp write")
+declare("eth1.rpc", "eth1 upstream fetch (Eth1Cache reads)")
+declare("engine.rpc", "execution engine JSON-RPC call (engine_http)")
+declare("wire.rpc", "req/resp client request (network/wire._request)")
+declare("wire.serve", "req/resp server handler (network/wire._serve)")
+declare("processor.tick", "beacon_processor run-loop tick")
+
+
+def _load_env():
+    value = os.environ.get("LTPU_FAILPOINTS")
+    if not value:
+        return
+    # same contract as the PATCH route: validate EVERY name and spec
+    # before arming ANY — a typo'd name must not silently mint a
+    # never-firing failpoint (the chaos run would measure a healthy
+    # system), and a bad spec mid-list must not leave a partial storm
+    try:
+        entries = parse_env(value)
+        with _REG_LOCK:
+            known = set(_REG)
+        for name, spec in entries.items():
+            if name not in known:
+                raise ValueError(f"unknown failpoint {name!r}")
+            parse_spec(spec)
+    except ValueError as e:
+        # a typo'd env var must not kill node startup; log and continue
+        log.error("ignoring malformed LTPU_FAILPOINTS (nothing armed): %s", e)
+        return
+    configure_many(entries)
+
+
+_load_env()

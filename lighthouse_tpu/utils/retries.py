@@ -1,0 +1,99 @@
+"""Shared upstream-call retry policy: exponential backoff + full jitter.
+
+One policy object per client seam (eth1 fetches, engine JSON-RPC), so
+every upstream dependency retries the same way and reports into ONE
+metric family — `lighthouse_retry_total{target,outcome}` with outcomes
+`ok` (first try or after retries), `retry` (one backed-off attempt),
+`exhausted` (attempts spent) and `deadline` (per-call budget spent).
+
+Backoff is the AWS "full jitter" scheme: sleep U(0, min(max_delay,
+base_delay * 2^attempt)) — decorrelated enough that a restarted upstream
+is not hit by a synchronized thundering herd of clients.
+
+On giving up the policy re-raises the LAST underlying exception (not a
+wrapper), so existing `except EngineApiError` / `except OSError` call
+sites keep working unchanged when a seam adopts retries.
+"""
+
+import random
+import time
+
+from . import metrics
+from .logging import get_logger
+
+log = get_logger("retries")
+
+RETRY_TOTAL = metrics.counter(
+    "lighthouse_retry_total",
+    "Retryable upstream calls by target seam and outcome "
+    "(ok / retry / exhausted / deadline)",
+    labels=("target", "outcome"),
+)
+
+
+class RetryPolicy:
+    """Reusable retry driver.
+
+    attempts:   total tries (1 = no retry)
+    base_delay: backoff base in seconds (doubles per attempt, pre-jitter)
+    max_delay:  per-sleep ceiling in seconds
+    deadline:   per-call wall budget in seconds (None = unbounded); a
+                retry whose backoff would cross it gives up immediately
+    retry_on:   exception classes that are retryable — anything else
+                propagates on the first raise
+    sleep/clock/rng: injectable for deterministic tests
+    """
+
+    def __init__(self, attempts=4, base_delay=0.05, max_delay=2.0,
+                 deadline=10.0, retry_on=(OSError,), sleep=time.sleep,
+                 clock=time.monotonic, rng=None):
+        self.attempts = max(1, int(attempts))
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.deadline = None if deadline is None else float(deadline)
+        self.retry_on = tuple(retry_on)
+        self._sleep = sleep
+        self._clock = clock
+        self._rng = rng or random.random
+
+    def backoff(self, attempt):
+        """Full-jitter sleep for the given 0-based attempt number."""
+        cap = min(self.max_delay, self.base_delay * (2 ** attempt))
+        return self._rng() * cap
+
+    def call(self, fn, *args, target="call", **kwargs):
+        """Run `fn(*args, **kwargs)` under this policy.  Returns its
+        result; re-raises the last retryable exception when attempts or
+        the deadline run out (non-retryable exceptions propagate
+        immediately, uncounted)."""
+        t0 = self._clock()
+        for attempt in range(self.attempts):
+            try:
+                out = fn(*args, **kwargs)
+            except self.retry_on as e:
+                if attempt + 1 >= self.attempts:
+                    RETRY_TOTAL.with_labels(target, "exhausted").inc()
+                    log.warning(
+                        "%s failed after %d attempts: %s",
+                        target, self.attempts, str(e)[:200],
+                    )
+                    raise
+                delay = self.backoff(attempt)
+                if (self.deadline is not None
+                        and self._clock() + delay - t0 > self.deadline):
+                    RETRY_TOTAL.with_labels(target, "deadline").inc()
+                    log.warning(
+                        "%s gave up at its %.1fs deadline (attempt %d): %s",
+                        target, self.deadline, attempt + 1, str(e)[:200],
+                    )
+                    raise
+                RETRY_TOTAL.with_labels(target, "retry").inc()
+                self._sleep(delay)
+            else:
+                RETRY_TOTAL.with_labels(target, "ok").inc()
+                return out
+
+
+def retry_call(fn, *args, target="call", policy=None, **kwargs):
+    """One-shot convenience: `retry_call(fetch, url, target="eth1")`."""
+    return (policy or RetryPolicy()).call(fn, *args, target=target, **kwargs)

@@ -1,0 +1,207 @@
+"""Heartbeat watchdog: detects wedged worker loops and restarts them.
+
+The supervision gap task_executor.py leaves open: its panic-catcher only
+fires when a worker RAISES — a worker wedged inside a hung kernel call,
+a stalled RPC, or an injected `delay` failpoint never raises, it just
+stops beating.  Each supervised loop (the beacon_processor run loop, the
+verify_service dispatcher) stamps a monotonic heartbeat every pass; the
+watchdog compares heartbeat age against a per-target budget and, on a
+stale target, captures a flight-recorder dump (recent structured log
+records + pipeline traces), logs it, and invokes the target's restart
+hook — which supersedes the wedged thread generation-wise, QUEUES
+INTACT, so no submitted work is dropped by the recovery itself.
+
+Restarts are cooldown-limited (a target that wedges again only restarts
+after another full budget) and counted in
+`lighthouse_watchdog_restarts_total{target}`;
+`lighthouse_watchdog_heartbeat_age_seconds{target}` exposes the live
+staleness each sweep observed.
+"""
+
+import threading
+import time
+
+from . import logging as ltpu_logging
+from . import metrics, tracing
+from .logging import get_logger
+
+log = get_logger("watchdog")
+
+RESTARTS = metrics.counter(
+    "lighthouse_watchdog_restarts_total",
+    "Wedged-worker restarts performed by the heartbeat watchdog",
+    labels=("target",),
+)
+HEARTBEAT_AGE = metrics.gauge(
+    "lighthouse_watchdog_heartbeat_age_seconds",
+    "Seconds since the watched worker's last heartbeat at the last sweep",
+    labels=("target",),
+)
+
+
+class _Target:
+    __slots__ = ("name", "heartbeat", "restart", "budget", "anchor",
+                 "restarts", "busy", "busy_budget")
+
+    def __init__(self, name, heartbeat, restart, budget, anchor,
+                 busy=None, busy_budget=None):
+        self.name = name
+        self.heartbeat = heartbeat      # () -> monotonic ts | None
+        self.restart = restart          # () -> bool (restarted?)
+        self.budget = float(budget)
+        # () -> bool: the worker is inside a legitimate long work pass
+        # (a device batch that may be paying a first-time XLA compile) —
+        # while True, staleness is judged against busy_budget instead,
+        # so a multi-minute compile never reads as a wedge but a
+        # genuinely hung pass is still detected, dumped and restarted
+        self.busy = busy
+        self.busy_budget = (
+            None if busy_budget is None else float(busy_budget)
+        )
+        # grace anchor: registration/restart time, used until the worker
+        # beats for the first time (and as the restart cooldown base)
+        self.anchor = anchor
+        self.restarts = 0
+
+
+class Watchdog:
+    """Register worker loops; run `check_once()` per sweep (a background
+    thread does this when started, or tests drive it directly)."""
+
+    def __init__(self, interval=0.5, clock=time.monotonic):
+        self.interval = float(interval)
+        self._clock = clock
+        self._targets = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+        # name -> the evidence captured at the last stale detection
+        self.last_dumps = {}
+
+    def register(self, name, heartbeat, restart, budget=5.0,
+                 busy=None, busy_budget=None):
+        """Watch one worker: `heartbeat()` returns the monotonic stamp of
+        its last loop pass (None until it first runs); `restart()` must
+        supersede the wedged thread and return True on success.  Optional
+        `busy()` reports the worker mid-work-pass — while True, staleness
+        is judged against `busy_budget` (a long legitimate pass, e.g. a
+        first-time XLA compile, must not read as a wedge; a pass hung
+        PAST busy_budget still does)."""
+        with self._lock:
+            self._targets[name] = _Target(
+                name, heartbeat, restart, budget, self._clock(),
+                busy=busy, busy_budget=busy_budget,
+            )
+
+    def unregister(self, name):
+        with self._lock:
+            self._targets.pop(name, None)
+
+    def targets(self):
+        with self._lock:
+            return sorted(self._targets)
+
+    # ------------------------------------------------------------ sweeps
+
+    def check_once(self):
+        """One sweep over every target; returns the names restarted."""
+        restarted = []
+        now = self._clock()
+        with self._lock:
+            targets = list(self._targets.values())
+        for t in targets:
+            try:
+                hb = t.heartbeat()
+            except Exception:
+                hb = None
+            stamps = [x for x in (hb, t.anchor) if x is not None]
+            if not stamps:
+                continue
+            anchor = max(stamps)
+            age = now - anchor
+            HEARTBEAT_AGE.with_labels(t.name).set(round(age, 3))
+            budget = t.budget
+            if t.busy is not None and t.busy_budget is not None:
+                try:
+                    if t.busy():
+                        budget = t.busy_budget
+                except Exception:
+                    pass
+            if age <= budget:
+                continue
+            self._dump(t, age, budget)
+            ok = False
+            try:
+                ok = bool(t.restart())
+            except Exception:
+                log.exception("restart hook for %s failed", t.name)
+            # cooldown either way: the next verdict waits a full budget
+            t.anchor = now
+            if ok:
+                t.restarts += 1
+                RESTARTS.with_labels(t.name).inc()
+                restarted.append(t.name)
+        return restarted
+
+    def _dump(self, t, age, budget):
+        """Flight-recorder dump for a stale target: the recent structured
+        records and pipeline traces, kept on the watchdog for the
+        operator (and the chaos tests) and summarized into one ERROR.
+        `budget` is the EFFECTIVE budget the verdict was judged against
+        (busy_budget for a mid-pass worker) — the evidence must match
+        the restart decision."""
+        records = ltpu_logging.recent(limit=32)
+        traces = tracing.recent(8)
+        self.last_dumps[t.name] = {
+            "heartbeat_age_s": round(age, 3),
+            "budget_s": budget,
+            "records": records,
+            "traces": traces,
+        }
+        log.error(
+            "worker %s wedged (heartbeat %.2fs stale, budget %.2fs); "
+            "flight-recorder dump captured, restarting",
+            t.name, age, budget,
+            recent_records=len(records),
+            trace_ring=tracing.depth(),
+            components=sorted({r["component"] for r in records[:16]}),
+        )
+
+    # --------------------------------------------------------- lifecycle
+
+    def start(self, executor=None):
+        """Run sweeps on a background thread: supervised under a
+        TaskExecutor when given (node wiring), else a daemon thread.
+        Idempotent while running; after stop() a new sweep thread is
+        started (a stopped watchdog must not silently stay off)."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._thread = None
+        self._stop.clear()
+        if executor is not None:
+            self._thread = executor.spawn(
+                self._run_supervised, "watchdog", critical=False
+            )
+        else:
+            t = threading.Thread(
+                target=self._run, args=(None,), name="watchdog", daemon=True
+            )
+            self._thread = t
+            t.start()
+        return self
+
+    def _run_supervised(self, executor):
+        self._run(executor)
+
+    def _run(self, executor):
+        while not self._stop.is_set():
+            if executor is not None and executor.shutting_down:
+                return
+            try:
+                self.check_once()
+            except Exception:
+                log.exception("watchdog sweep failed")
+            self._stop.wait(self.interval)
+
+    def stop(self):
+        self._stop.set()

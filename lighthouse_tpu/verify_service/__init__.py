@@ -25,7 +25,14 @@ Pieces:
     poisoner's future fails
   * a circuit breaker pinning the service to the host path after
     consecutive device failures (extends the device→native→oracle chain
-    in crypto/backend.py via the `on_device_fallback` hook)
+    in crypto/backend.py via the `on_device_fallback` hook); after the
+    cooldown it HALF-OPENs with one BOUNDED probe batch — at most
+    `probe_max_sets` sets risk the device, the rest of the batch runs
+    on the host — and only a successful probe restores the device path
+  * chaos seams (`utils/failpoints.py`: `verify.dispatch`,
+    `verify.prep`, `device.execute_chunk`) plus a watchdog-facing
+    `heartbeat`/`restart_dispatcher` surface so a wedged dispatcher is
+    restarted with its queues intact
   * Prometheus metrics via utils/metrics.py (verify_service/metrics.py)
 """
 

@@ -1,39 +1,85 @@
-"""Device circuit breaker: consecutive-failure trip with cooldown.
+"""Device circuit breaker: consecutive-failure trip, cooldown, and a
+BOUNDED half-open probe.
 
 Extends the per-call device→native→oracle fallback chain in
 crypto/backend.py with process-level health memory: one dead-tunnel jit
 already degrades that single call, but every subsequent call would still
 pay the device attempt (a hang-then-timeout each time).  The breaker
 counts consecutive device failures and pins the service to the host path
-for a cooldown, then lets one probe batch through (half-open) before
-closing again.
+for a cooldown.  When the cooldown elapses the breaker goes HALF_OPEN —
+and instead of blindly re-opening the device to whatever batch happens
+to be queued (a 512-set batch against a still-dead device pays the whole
+hang again), it exposes `probe_cap()`: the dispatcher sends at most
+`probe_max_sets` sets to the device as the probe and routes the
+remainder to the host.  Only a SUCCESSFUL probe restores CLOSED; a
+failed probe re-opens immediately for another cooldown.
+
+State transitions are observable: the `verify_service_breaker_state`
+gauge (0=closed 1=open 2=half_open; `verify_service_circuit_state` is
+the pre-PR-5 alias) plus a WARN on trip and an INFO on probe/restore
+through the component logger.
 """
 
 import time
 
+from ..utils.logging import get_logger
 from . import metrics as M
+
+log = get_logger("verify_service")
 
 CLOSED = 0      # device healthy, dispatch normally
 OPEN = 1        # pinned to host path until cooldown elapses
-HALF_OPEN = 2   # cooldown over: one probe batch decides
+HALF_OPEN = 2   # cooldown over: one bounded probe batch decides
+
+_STATE_NAMES = {CLOSED: "closed", OPEN: "open", HALF_OPEN: "half_open"}
+
+DEFAULT_PROBE_MAX_SETS = 64
 
 
 class CircuitBreaker:
     """Single-dispatcher-thread breaker (no internal locking: only the
-    service's dispatcher loop drives it)."""
+    service's dispatcher loop drives transitions; callers may READ
+    `state`)."""
 
-    def __init__(self, threshold=3, cooldown=30.0, clock=time.monotonic):
+    def __init__(self, threshold=3, cooldown=30.0, clock=time.monotonic,
+                 probe_max_sets=DEFAULT_PROBE_MAX_SETS):
         self.threshold = max(1, int(threshold))
         self.cooldown = float(cooldown)
+        self.probe_max_sets = max(1, int(probe_max_sets))
         self._clock = clock
         self.state = CLOSED
         self.consecutive_failures = 0
         self.opened_at = None
+        self.trips = 0
         M.CIRCUIT_STATE.set(CLOSED)
+        M.BREAKER_STATE.set(CLOSED)
 
     def _set_state(self, state):
-        self.state = state
+        prev, self.state = self.state, state
         M.CIRCUIT_STATE.set(state)
+        M.BREAKER_STATE.set(state)
+        if state == prev:
+            return
+        if state == OPEN:
+            log.warning(
+                "device circuit breaker tripped %s -> open; pinning "
+                "verification to the host path",
+                _STATE_NAMES[prev],
+                consecutive_failures=self.consecutive_failures,
+                cooldown_s=self.cooldown,
+            )
+        elif state == HALF_OPEN:
+            log.info(
+                "device circuit breaker half-open: probing the device "
+                "with one bounded batch",
+                probe_max_sets=self.probe_max_sets,
+            )
+        else:
+            log.info(
+                "device circuit breaker restored %s -> closed after a "
+                "successful probe batch",
+                _STATE_NAMES[prev],
+            )
 
     def allow_device(self) -> bool:
         """Should the next batch try the device path?"""
@@ -46,13 +92,20 @@ class CircuitBreaker:
             return False
         return True  # HALF_OPEN: the probe batch is in flight
 
+    def probe_cap(self):
+        """Bounded half-open probe: when HALF_OPEN, at most this many
+        sets may ride the device attempt (the dispatcher routes the
+        rest of the batch to the host); None in every other state."""
+        return self.probe_max_sets if self.state == HALF_OPEN else None
+
     def record_failure(self):
         self.consecutive_failures += 1
         if self.state == HALF_OPEN or self.consecutive_failures >= self.threshold:
             if self.state != OPEN:
+                self.trips += 1
                 M.CIRCUIT_TRIPS.inc()
-            self._set_state(OPEN)
             self.opened_at = self._clock()
+            self._set_state(OPEN)
 
     def record_success(self):
         self.consecutive_failures = 0

@@ -78,6 +78,13 @@ CIRCUIT_STATE = metrics.gauge(
     "verify_service_circuit_state",
     "Device circuit breaker: 0=closed 1=open 2=half-open",
 )
+# the PR-5 canonical name; CIRCUIT_STATE kept as the pre-PR-5 alias so
+# existing dashboards keep scraping
+BREAKER_STATE = metrics.gauge(
+    "verify_service_breaker_state",
+    "Device circuit breaker state: 0=closed 1=open 2=half_open "
+    "(alias of verify_service_circuit_state)",
+)
 CIRCUIT_TRIPS = metrics.counter(
     "verify_service_circuit_trips_total",
     "Times the breaker pinned the service to the host path",

@@ -32,7 +32,7 @@ from collections import deque
 from queue import Empty, Queue
 
 from ..crypto.backend import SignatureVerifier
-from ..utils import tracing
+from ..utils import failpoints, tracing
 from ..utils.logging import get_logger
 from . import metrics as M
 from .circuit import OPEN, CircuitBreaker
@@ -256,6 +256,7 @@ class VerificationService:
                  max_batch=DEFAULT_MAX_BATCH,
                  max_delay=None, queue_caps=None,
                  breaker_threshold=3, breaker_cooldown=30.0,
+                 breaker_probe_max=None,
                  shed_watermark=None, pipeline=True,
                  adaptive_batch=False, target_bounds=None):
         self.verifier = verifier or SignatureVerifier("oracle")
@@ -302,8 +303,31 @@ class VerificationService:
         self._thread = None
         self._executor = None
         self._stopped = False
+        # watchdog surface: the dispatcher stamps `heartbeat` every loop
+        # pass; `restart_dispatcher` bumps the generation so a wedged
+        # thread is superseded with the queues intact
+        self.heartbeat = None
+        # monotonic stamp while a dispatch pass is in flight (None when
+        # idle): the watchdog judges an in-pass dispatcher against its
+        # larger busy budget — a first-time XLA compile inside a device
+        # batch can legitimately run for minutes
+        self.pass_started = None
+        self._gen = 0
+        self.restarts = 0
+        # work-section mutex: a restarted dispatcher must not run
+        # _dispatch concurrently with a superseded thread wedged inside
+        # one (the breaker, _device_event and the adaptive controller
+        # are single-dispatcher state by contract) — the replacement
+        # blocks until the old thread's in-flight batch resolves
+        self._work_lock = threading.Lock()
 
-        self.breaker = CircuitBreaker(breaker_threshold, breaker_cooldown)
+        breaker_kw = (
+            {} if breaker_probe_max is None
+            else {"probe_max_sets": breaker_probe_max}
+        )
+        self.breaker = CircuitBreaker(
+            breaker_threshold, breaker_cooldown, **breaker_kw
+        )
         self._host_verifier = host_verifier
         self._device_event = False
         # hook into the backend seam: a device failure inside a verify
@@ -497,9 +521,36 @@ class VerificationService:
     # -------------------------------------------------------- dispatcher
 
     def _loop(self):
+        with self._cv:
+            gen = self._gen
         while True:
+            self.heartbeat = time.monotonic()
+            try:
+                # chaos seam: `delay` wedges the dispatcher HERE — before
+                # any batch is popped — so a watchdog restart loses
+                # nothing; `error` just retries the loop
+                failpoints.hit("verify.dispatch")
+            except failpoints.FailpointError:
+                # retry the loop; the pause keeps an error(1.0)
+                # injection from busy-spinning the dispatcher, and the
+                # generation check keeps a superseded thread from
+                # spinning forever (and stamping the shared heartbeat)
+                # without ever reaching the in-lock check
+                time.sleep(0.005)
+                if self._gen != gen:
+                    return
+                if not self._stopping():
+                    continue
+                # stopping while the fault is armed: fall through to
+                # the cv block, which fails pending work and exits —
+                # otherwise stop() could never terminate this loop
             with self._cv:
                 while True:
+                    if self._gen != gen:
+                        # superseded by a watchdog restart: a fresh
+                        # dispatcher owns the queues now — exit without
+                        # failing pending work
+                        return
                     if self._stopping():
                         # mark stopped so post-shutdown submits take the
                         # compat degrade path instead of queueing onto a
@@ -507,15 +558,76 @@ class VerificationService:
                         self._stopped = True
                         self._fail_pending_locked()
                         return
+                    self.heartbeat = time.monotonic()
                     wait = self._dispatch_wait_locked()
                     if wait is not None and wait <= 0:
                         break
                     # cap the wait so executor shutdown (no cv notify) is
                     # noticed promptly
                     self._cv.wait(0.25 if wait is None else min(wait, 0.25))
-                batch = self._form_batch_locked()
-            if batch:
-                self._dispatch(batch)
+            # work is ready: take the work section BEFORE popping the
+            # batch, so a replacement dispatcher blocked behind a
+            # wedged-in-dispatch predecessor leaves the work QUEUED
+            # (blocking after the pop would strand popped futures).
+            # The wait does NOT stamp the heartbeat: while a predecessor
+            # is mid-pass, `pass_started` keeps the watchdog on the busy
+            # budget — a pass hung PAST that budget must go visibly
+            # stale and draw another dump/restart, not read as healthy.
+            while not self._work_lock.acquire(timeout=0.25):
+                if self._gen != gen:
+                    return
+                if self._stopping():
+                    # the canonical exit, sans work lock: fail pending
+                    # under the cv so no submitter blocks forever
+                    with self._cv:
+                        self._stopped = True
+                        self._fail_pending_locked()
+                    return
+            self.pass_started = time.monotonic()
+            try:
+                with self._cv:
+                    if self._gen != gen or self._stopping():
+                        continue   # the loop-top cv block exits canonically
+                    batch = self._form_batch_locked()
+                if batch:
+                    self._dispatch(batch)
+            finally:
+                self.pass_started = None
+                self._work_lock.release()
+
+    def restart_dispatcher(self):
+        """Watchdog recovery hook: supersede a wedged dispatcher with a
+        fresh thread, QUEUES INTACT.  The old thread observes the
+        generation bump at its next lock acquisition and exits without
+        failing pending work; queued requests drain under the new one.
+        The replacement runs under the SAME supervision as the original
+        — executor.spawn when the service was started(executor), so a
+        later crash still trips the panic-catcher instead of silently
+        hanging every caller.  Returns False when the service (or its
+        executor) is stopped: nothing to recover."""
+        with self._cv:
+            if self._stopped:
+                return False
+            executor = self._executor
+            if executor is not None and executor.shutting_down:
+                return False
+            self._gen += 1
+            self.restarts += 1
+            gen, queued = self._gen, self._queued_sets
+            if executor is None:
+                t = threading.Thread(
+                    target=self._loop, name="verify_service", daemon=True
+                )
+                self._thread = t
+                t.start()
+            self._cv.notify_all()
+        if executor is not None:
+            executor.spawn(self._run_supervised, "verify_service")
+        log.warning(
+            "verification dispatcher restarted (generation %d)", gen,
+            queued_sets=queued,
+        )
+        return True
 
     def _dispatch_wait_locked(self):
         """None = no work; <=0 = dispatch now; >0 = seconds until the
@@ -662,6 +774,10 @@ class VerificationService:
             for chunk in chunks:
                 t0 = time.monotonic()
                 try:
+                    # chaos seam: an injected prep fault aborts the
+                    # pipeline; _verify_batch falls back to the plain
+                    # path, so the batch still verifies correctly
+                    failpoints.hit("verify.prep")
                     item = prepare(chunk)
                 except BaseException as e:   # delivered, not raised: the
                     out_q.put((t0, time.monotonic(), e))
@@ -743,6 +859,22 @@ class VerificationService:
                     )
         return v.verify_signature_sets(all_sets)
 
+    def _verify_probe_split(self, all_sets, cap):
+        """HALF_OPEN dispatch for a batch larger than the probe budget:
+        only the first `cap` sets risk the device (the bounded probe);
+        the remainder runs on the host path in the same pass.  The
+        breaker judges the probe alone (`_device_event` is only set by
+        the device verifier's fallback hook), and the batch verdict is
+        the AND of both halves — verdict semantics are unchanged."""
+        probe, rest = all_sets[:cap], all_sets[cap:]
+        ok = self.verifier.verify_signature_sets(probe)
+        if ok and rest:
+            # a settled-False probe skips the host pass: the verdict
+            # cannot change, and a failing batch pays the per-set
+            # attribution pass over every set right after anyway
+            ok = self._host().verify_signature_sets(rest)
+        return ok
+
     def _dispatch(self, reqs):
         now = time.monotonic()
         all_sets = []
@@ -759,6 +891,10 @@ class VerificationService:
 
         v = self._active_verifier()
         device_attempt = v is self.verifier and self.backend == "tpu"
+        # bounded half-open probe (circuit.py): when the breaker is
+        # probing, cap the device's exposure to probe_max_sets and run
+        # the rest of the batch on the host
+        probe_cap = self.breaker.probe_cap() if device_attempt else None
         batch_attrs = {
             "sets": len(all_sets),
             "requests": len(reqs),
@@ -777,7 +913,10 @@ class VerificationService:
         bt.add_span("batch", now, t_k0, **batch_attrs)
         try:
             with tracing.use(bt):
-                ok = self._verify_batch(v, all_sets)
+                if probe_cap is not None and len(all_sets) > probe_cap:
+                    ok = self._verify_probe_split(all_sets, probe_cap)
+                else:
+                    ok = self._verify_batch(v, all_sets)
         except Exception as e:
             # the seam's internal fallback chain should make this
             # unreachable; fail the batch's futures rather than hang them
@@ -838,8 +977,16 @@ class VerificationService:
                     classes=batch_attrs["classes"],
                     backend=batch_attrs["backend"],
                 )
+                # if the device failed this very batch (breaker now
+                # OPEN), attribute on the host path instead of paying a
+                # second hang against a dead device
+                av = (
+                    self._host()
+                    if device_attempt and self.breaker.state == OPEN
+                    else v
+                )
                 with bt.span("attribution"):
-                    verdicts = v.verify_signature_sets_per_set(all_sets)
+                    verdicts = av.verify_signature_sets_per_set(all_sets)
         except Exception as e:
             log.exception("per-set attribution pass failed hard")
             bt.finish(ok=False)
@@ -875,6 +1022,7 @@ class VerificationService:
             "queue_wait_p99_ms": pct(0.99) * 1e3,
             "circuit_state": self.breaker.state,
             "target_batch": self.target_batch,
+            "dispatcher_restarts": self.restarts,
             "overlap_ratio_mean": (
                 round(sum(overlaps) / len(overlaps), 4) if overlaps else 0.0
             ),

@@ -1,0 +1,754 @@
+"""Failpoints + supervised recovery: the ISSUE 5 chaos acceptance.
+
+Covers the fault-injection registry itself (modes, env syntax, seeded
+determinism), the injection seams (device kernel launch, verify
+dispatcher/prep, store put/compact, eth1 + engine RPC, wire req/resp),
+the recovery layers built on top (shared retries, breaker half-open
+bounded probe, watchdog restart with queues intact, compaction crash
+safety), the /lighthouse/failpoints HTTP control surface, and the
+chaos-storm acceptance: under 20% device faults + engine delay + one
+store panic, zero verdicts are lost, every submitted set resolves, and
+the breaker trips -> half-open-probes -> restores.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import pytest
+
+from lighthouse_tpu.utils import failpoints
+from lighthouse_tpu.utils import logging as ltpu_logging
+from lighthouse_tpu.utils import metrics
+from lighthouse_tpu.utils.retries import RetryPolicy
+from lighthouse_tpu.utils.watchdog import Watchdog
+from lighthouse_tpu.verify_service import VerificationService
+from lighthouse_tpu.verify_service.circuit import CLOSED, HALF_OPEN, OPEN
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+def mk():
+    return SimpleNamespace(poison=False)
+
+
+class RecordingDevice:
+    """Device-backed seam double: records every dispatched batch size;
+    while `broken`, reports an internal device→host degrade through
+    on_device_fallback (SignatureVerifier's tpu fallback observable)."""
+
+    backend = "tpu"
+
+    def __init__(self):
+        self.batches = []
+        self.broken = False
+        self.on_device_fallback = None
+
+    def verify_signature_sets(self, sets, priority=None):
+        sets = list(sets)
+        self.batches.append(len(sets))
+        if self.broken and self.on_device_fallback is not None:
+            self.on_device_fallback(RuntimeError("device tunnel dead"))
+        return True
+
+    def verify_signature_sets_per_set(self, sets, priority=None):
+        self.verify_signature_sets(sets)
+        return [True] * len(list(sets))
+
+
+class RecordingHost(RecordingDevice):
+    backend = "native"
+
+    def __init__(self):
+        super().__init__()
+        self.broken = False
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_mode_parsing_and_env_syntax():
+    fp = failpoints.configure("eth1.rpc", "error(0.25)")
+    assert fp.mode == "error" and fp.arg == 0.25
+    assert fp.spec() == "error(0.25)"
+    assert failpoints.configure("eth1.rpc", "delay(50)").spec() == "delay(50)"
+    assert failpoints.configure("eth1.rpc", "off").mode == "off"
+    assert failpoints.parse_env(
+        "store.put=corrupt; engine.rpc=delay(5),wire.rpc=error"
+    ) == {"store.put": "corrupt", "engine.rpc": "delay(5)",
+          "wire.rpc": "error"}
+    for bad in ("bogus", "error(2.0)", "delay(-1)", "delay", "error(x)",
+                "error(", "panic_once(0.5)", "off(1)"):
+        with pytest.raises(ValueError):
+            failpoints.configure("eth1.rpc", bad)
+    with pytest.raises(ValueError):
+        failpoints.parse_env("no-equals-sign")
+    # snapshot lists every declared site with counters
+    snap = failpoints.snapshot()
+    assert "device.execute_chunk" in snap and "store.compact" in snap
+    assert snap["device.execute_chunk"]["mode"] == "off"
+
+
+def test_env_arming_validates_atomically(monkeypatch):
+    # a bad spec mid-list arms NOTHING (the PATCH route's contract)
+    monkeypatch.setenv("LTPU_FAILPOINTS", "store.put=error;engine.rpc=junk(5)")
+    failpoints._load_env()
+    assert failpoints.get("store.put").mode == "off"
+    # a typo'd name must not mint a never-firing failpoint
+    monkeypatch.setenv("LTPU_FAILPOINTS", "device.exec_chunk=error")
+    failpoints._load_env()
+    assert failpoints.get("device.exec_chunk") is None
+    # a valid storm arms
+    monkeypatch.setenv("LTPU_FAILPOINTS",
+                       "store.put=delay(1);wire.rpc=error(0.5)")
+    failpoints._load_env()
+    assert failpoints.get("store.put").spec() == "delay(1)"
+    assert failpoints.get("wire.rpc").spec() == "error(0.5)"
+
+
+def test_stop_terminates_dispatcher_under_armed_error():
+    """service.stop() must end the dispatcher loop even while
+    verify.dispatch=error fires every iteration — the fault arm falls
+    through to the canonical stopping exit."""
+
+    class StubOK:
+        backend = "stub"
+        on_device_fallback = None
+
+        def verify_signature_sets(self, sets, priority=None):
+            return True
+
+        def verify_signature_sets_per_set(self, sets, priority=None):
+            return [True] * len(list(sets))
+
+    service = VerificationService(StubOK(), target_batch=10**6)
+    service.submit([mk()], deadline=30.0)       # starts the dispatcher
+    thread = service._thread
+    failpoints.configure("verify.dispatch", "error")
+    time.sleep(0.05)                            # loop is in the fault arm
+    service.stop()
+    thread.join(timeout=5.0)
+    assert not thread.is_alive()
+
+
+def test_error_probability_is_seed_deterministic():
+    def storm():
+        failpoints.seed_all(99)
+        failpoints.configure("wire.rpc", "error(0.3)")
+        fired = []
+        for _ in range(200):
+            try:
+                failpoints.hit("wire.rpc")
+                fired.append(False)
+            except failpoints.FailpointError:
+                fired.append(True)
+        failpoints.configure("wire.rpc", "off")
+        return fired
+
+    a, b = storm(), storm()
+    assert a == b                       # reproducible storm
+    assert 20 < sum(a) < 100            # ~30% of 200
+
+
+def test_panic_once_fires_once_then_disarms():
+    failpoints.configure("store.compact", "panic_once")
+    with pytest.raises(failpoints.FailpointPanic):
+        failpoints.hit("store.compact")
+    assert failpoints.get("store.compact").mode == "off"
+    failpoints.hit("store.compact")     # now inert
+    assert failpoints.get("store.compact").fired == 1
+
+
+def test_corrupt_mode_flips_payload_bytes():
+    failpoints.configure("store.put", "corrupt")
+    blob = bytes(range(16))
+    out = failpoints.hit("store.put", data=blob)
+    assert out != blob and len(out) == len(blob)
+    failpoints.configure("store.put", "off")
+    assert failpoints.hit("store.put", data=blob) == blob
+
+
+# -------------------------------------------------------------- retries
+
+
+def test_retry_policy_backoff_jitter_and_reraise():
+    sleeps = []
+    calls = [0]
+    pol = RetryPolicy(attempts=4, base_delay=0.1, max_delay=0.3,
+                      deadline=100.0, retry_on=(ValueError,),
+                      sleep=sleeps.append, rng=lambda: 1.0)
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise ValueError("transient")
+        return 42
+
+    assert pol.call(flaky, target="t_retry") == 42
+    # full jitter at rng=1.0: min(max_delay, base * 2^attempt)
+    assert sleeps == [0.1, 0.2]
+
+    def always():
+        raise ValueError("permanent")
+
+    pol2 = RetryPolicy(attempts=2, base_delay=0.0, retry_on=(ValueError,),
+                       sleep=lambda s: None)
+    with pytest.raises(ValueError):     # the ORIGINAL class re-raises
+        pol2.call(always, target="t_retry")
+
+    # non-retryable exceptions propagate on the first raise
+    first = [0]
+
+    def wrong_kind():
+        first[0] += 1
+        raise KeyError("nope")
+
+    with pytest.raises(KeyError):
+        pol.call(wrong_kind, target="t_retry")
+    assert first[0] == 1
+
+    # a backoff that would cross the deadline gives up immediately
+    now = [0.0]
+    pol3 = RetryPolicy(attempts=10, base_delay=5.0, max_delay=5.0,
+                       deadline=1.0, retry_on=(ValueError,),
+                       sleep=lambda s: None, clock=lambda: now[0],
+                       rng=lambda: 1.0)
+    tries = [0]
+
+    def tick():
+        tries[0] += 1
+        raise ValueError("slow upstream")
+
+    with pytest.raises(ValueError):
+        pol3.call(tick, target="t_retry")
+    assert tries[0] == 1
+
+
+def test_eth1_rpc_failpoint_heals_through_retries():
+    from lighthouse_tpu.eth1.service import Eth1Cache, MockEth1Chain
+
+    chain = MockEth1Chain()
+    chain.mine_blocks(3)
+    cache = Eth1Cache(chain, follow_distance=0, retries=RetryPolicy(
+        attempts=3, base_delay=0.0, max_delay=0.0, deadline=5.0,
+        retry_on=(failpoints.FailpointError,), sleep=lambda s: None,
+    ))
+    failpoints.configure("eth1.rpc", "panic_once")
+    blk = cache.head_block()            # first attempt panics, retry wins
+    assert blk.number == 3
+    assert failpoints.get("eth1.rpc").fired == 1
+    failpoints.configure("eth1.rpc", "error")
+    with pytest.raises(failpoints.FailpointError):   # exhausted: re-raised
+        cache.head_block()
+    failpoints.configure("eth1.rpc", "off")
+    assert cache.head_block().number == 3
+
+
+def test_engine_transport_faults_retry_and_reraise():
+    from lighthouse_tpu.execution.engine_http import (
+        EngineApiError,
+        EngineTransportError,
+        HttpJsonRpcClient,
+    )
+
+    sleeps = []
+    client = HttpJsonRpcClient(
+        "http://127.0.0.1:1", b"\x00" * 32, timeout=0.2,
+        retries=RetryPolicy(attempts=3, base_delay=0.001, max_delay=0.002,
+                            deadline=10.0, retry_on=(EngineTransportError,),
+                            sleep=sleeps.append),
+    )
+    with pytest.raises(EngineTransportError) as ei:
+        client.call("engine_newPayloadV1", [])
+    assert isinstance(ei.value, EngineApiError)      # compat subclass
+    assert len(sleeps) == 2                          # 3 attempts, 2 backoffs
+
+    # injected transport fault is retryable too
+    sleeps.clear()
+    failpoints.configure("engine.rpc", "error")
+    with pytest.raises(EngineTransportError, match="injected"):
+        client.call("engine_newPayloadV1", [])
+    assert len(sleeps) == 2
+
+
+# ------------------------------------------------------- injection seams
+
+
+def test_device_execute_chunk_failpoint():
+    from lighthouse_tpu.crypto.tpu import bls as tb
+
+    c = tb.PreparedChunk()
+    c.invalid = True
+    c.n_sets = c.n_pad = 0
+    c.args = None
+    c.t_prep0 = c.t_prep1 = 0.0
+    failpoints.configure("device.execute_chunk", "error")
+    with pytest.raises(failpoints.FailpointError):
+        tb.execute_chunk(c)             # fires BEFORE the launch path
+    failpoints.configure("device.execute_chunk", "off")
+    assert tb.execute_chunk(c) is False
+
+
+def test_verify_prep_failpoint_falls_back_to_plain_path():
+    class PipelineStub:
+        backend = "stub"
+
+        def __init__(self):
+            self.plain_calls = 0
+            self.pipeline_execs = 0
+            self.on_device_fallback = None
+
+        def plan_pipeline(self, sets):
+            sets = list(sets)
+            if len(sets) <= 2:
+                return None
+            chunks = [sets[i:i + 2] for i in range(0, len(sets), 2)]
+
+            def prepare(chunk):
+                return chunk
+
+            def execute(prepared, overlap_ratio=None):
+                self.pipeline_execs += 1
+                return True
+
+            return chunks, prepare, execute
+
+        def verify_signature_sets(self, sets, priority=None):
+            self.plain_calls += 1
+            return True
+
+        def verify_signature_sets_per_set(self, sets, priority=None):
+            return [True] * len(list(sets))
+
+    stub = PipelineStub()
+    service = VerificationService(stub, target_batch=1)
+    failpoints.configure("verify.prep", "error")
+    # an injected prep fault aborts the pipeline; the batch must still
+    # verify correctly through the plain path
+    assert service.submit([mk() for _ in range(6)]).result(10.0) is True
+    assert stub.plain_calls == 1 and stub.pipeline_execs == 0
+    failpoints.configure("verify.prep", "off")
+    assert service.submit([mk() for _ in range(6)]).result(10.0) is True
+    assert stub.pipeline_execs >= 3 and stub.plain_calls == 1
+    service.stop()
+
+
+def test_store_put_corrupt_reaches_disk(tmp_path):
+    from lighthouse_tpu.beacon.store import PyFileKV
+
+    kv = PyFileKV(str(tmp_path / "c.log"))
+    failpoints.configure("store.put", "corrupt")
+    kv.put(b"k", bytes(8))
+    failpoints.configure("store.put", "off")
+    stored = kv.get(b"k")
+    assert stored != bytes(8) and len(stored) == 8   # bit-rot injected
+    kv.put(b"k", bytes(8))
+    assert kv.get(b"k") == bytes(8)
+    kv.close()
+
+
+def test_store_compact_crash_safety(tmp_path, monkeypatch):
+    """Satellite: a crash between the compaction write and the rename
+    must never publish a torn file — the temp is fsynced (file AND
+    directory) before os.replace, and a panic in that window leaves the
+    original log fully live and the store usable."""
+    from lighthouse_tpu.beacon.store import PyFileKV
+
+    path = str(tmp_path / "db.log")
+    kv = PyFileKV(path)
+    for i in range(20):
+        kv.put(f"k{i}".encode(), bytes([i]) * 100)
+    for i in range(10):
+        kv.delete(f"k{i}".encode())
+
+    events = []
+    real_fsync, real_replace = os.fsync, os.replace
+    monkeypatch.setattr(
+        os, "fsync", lambda fd: (events.append("fsync"), real_fsync(fd))[1]
+    )
+    monkeypatch.setattr(
+        os, "replace",
+        lambda a, b: (events.append("replace"), real_replace(a, b))[1],
+    )
+    failpoints.configure("store.compact", "panic_once")
+    with pytest.raises(failpoints.FailpointPanic):
+        kv.compact()
+    # crash window: the temp was already durable, the rename never ran
+    assert events.count("fsync") >= 2 and "replace" not in events
+    # the original log is still live and the handle still usable
+    assert kv.get(b"k15") == bytes([15]) * 100
+    kv.put(b"extra", b"v")
+    kv.compact()                        # the retried compaction succeeds
+    assert events.index("replace") > 0
+    assert events[: events.index("replace")].count("fsync") >= 2
+    assert kv.get(b"k15") == bytes([15]) * 100
+    assert kv.get(b"extra") == b"v"
+    assert kv.get(b"k3") is None
+    kv.close()
+    reopened = PyFileKV(path)           # on-disk state replays cleanly
+    assert reopened.get(b"k15") == bytes([15]) * 100
+    assert reopened.get(b"extra") == b"v"
+    reopened.close()
+
+
+def test_wire_reqresp_failpoints():
+    from lighthouse_tpu.network.wire import WireError, WireNode
+
+    a, b = WireNode(), WireNode()
+    try:
+        pid = a.dial("127.0.0.1", b.port)
+        failpoints.configure("wire.rpc", "error")
+        with pytest.raises(WireError, match="injected"):
+            a.request_status(pid)
+        failpoints.configure("wire.rpc", "off")
+        assert a.request_status(pid) is not None
+        # server-side fault surfaces to the client as R_SERVER_ERROR
+        failpoints.configure("wire.serve", "error")
+        with pytest.raises(WireError):
+            a.request_status(pid)
+        failpoints.configure("wire.serve", "off")
+        assert a.request_status(pid) is not None
+    finally:
+        a.stop()
+        b.stop()
+
+
+# ---------------------------------------------- breaker half-open probe
+
+
+def test_half_open_probe_is_bounded_and_restores():
+    device, host = RecordingDevice(), RecordingHost()
+    service = VerificationService(
+        device, host_verifier=host, breaker_threshold=1,
+        breaker_cooldown=0.1, breaker_probe_max=4, target_batch=10**6,
+    )
+    device.broken = True
+    assert service.submit([mk()], deadline=0.001).result(10.0) is True
+    assert service.breaker.state == OPEN
+    device.broken = False
+    time.sleep(0.15)                    # cooldown elapses -> half-open
+    fut = service.submit([mk() for _ in range(20)], priority="block",
+                         deadline=0.001)
+    assert fut.result(10.0) is True
+    # the probe was BOUNDED: the device saw probe_max sets, the host the
+    # remainder, and the successful probe restored the breaker
+    assert device.batches[-1] == 4
+    assert host.batches[-1] == 16
+    assert service.breaker.state == CLOSED
+    service.stop()
+
+
+def test_failed_half_open_probe_reopens():
+    device, host = RecordingDevice(), RecordingHost()
+    service = VerificationService(
+        device, host_verifier=host, breaker_threshold=1,
+        breaker_cooldown=0.1, breaker_probe_max=4, target_batch=10**6,
+    )
+    device.broken = True
+    assert service.submit([mk()], deadline=0.001).result(10.0) is True
+    assert service.breaker.state == OPEN
+    time.sleep(0.15)
+    # device still broken: the bounded probe fails -> straight back OPEN,
+    # and the batch verdict is unharmed (host carried the remainder)
+    fut = service.submit([mk() for _ in range(20)], priority="block",
+                         deadline=0.001)
+    assert fut.result(10.0) is True
+    assert device.batches[-1] == 4
+    assert service.breaker.state == OPEN
+    assert service.breaker.trips == 2
+    service.stop()
+
+
+def test_breaker_state_gauge_and_transition_logs():
+    from lighthouse_tpu.verify_service.circuit import CircuitBreaker
+
+    b = CircuitBreaker(threshold=1, cooldown=0.01)
+    b.record_failure()
+    assert b.state == OPEN
+    assert "verify_service_breaker_state 1" in metrics.gather()
+    time.sleep(0.02)
+    assert b.allow_device() is True and b.state == HALF_OPEN
+    assert "verify_service_breaker_state 2" in metrics.gather()
+    b.record_success()
+    assert "verify_service_breaker_state 0" in metrics.gather()
+    recs = ltpu_logging.recent(limit=64, component="verify_service")
+    msgs = " | ".join(r["msg"] for r in recs)
+    assert "tripped" in msgs and "half-open" in msgs and "restored" in msgs
+    assert any(r["level"] == "warning" and "tripped" in r["msg"]
+               for r in recs)
+
+
+# ----------------------------------------------------- watchdog recovery
+
+
+def test_watchdog_restarts_wedged_dispatcher_with_queues_intact():
+    """Acceptance: a deliberately-wedged dispatcher (delay failpoint at
+    the loop top, before any batch is popped) goes heartbeat-stale, the
+    watchdog restarts it within its budget, and every queued request
+    still resolves — nothing dropped by the recovery."""
+
+    class StubOK:
+        backend = "stub"
+        on_device_fallback = None
+
+        def verify_signature_sets(self, sets, priority=None):
+            return True
+
+        def verify_signature_sets_per_set(self, sets, priority=None):
+            return [True] * len(list(sets))
+
+    service = VerificationService(StubOK(), target_batch=1)
+    wd = Watchdog()
+    wd.register("verify_service", heartbeat=lambda: service.heartbeat,
+                restart=service.restart_dispatcher, budget=0.2)
+    failpoints.configure("verify.dispatch", "delay(30000)")
+    futs = [service.submit([mk()]) for _ in range(5)]   # wedges on start
+    time.sleep(0.5)
+    failpoints.configure("verify.dispatch", "off")
+    assert not any(f.done() for f in futs)              # truly wedged
+    restarted = wd.check_once()
+    assert restarted == ["verify_service"]
+    assert all(f.result(timeout=10.0) is True for f in futs)
+    assert service.restarts == 1
+    dump = wd.last_dumps["verify_service"]
+    assert dump["heartbeat_age_s"] > 0.2 and dump["records"]
+    service.stop()
+
+
+def test_watchdog_busy_budget_tolerates_long_pass_but_not_a_hang():
+    """A worker mid work pass (busy() True — e.g. a device batch paying
+    a first-time XLA compile) is judged against the larger busy budget:
+    staleness past the idle budget does NOT restart it, staleness past
+    the busy budget DOES — a hung pass stays detectable, not invisible."""
+    now = [0.0]
+    restarts = []
+    busy = [False]
+    wd = Watchdog(clock=lambda: now[0])
+    wd.register("worker", heartbeat=lambda: 0.0,
+                restart=lambda: restarts.append(1) or True,
+                budget=1.0, busy=lambda: busy[0], busy_budget=10.0)
+    busy[0] = True
+    now[0] = 5.0                      # stale past idle budget, mid-pass
+    assert wd.check_once() == []      # a long compile is not a wedge
+    now[0] = 11.0                     # stale past the busy budget too
+    assert wd.check_once() == ["worker"]
+    assert restarts == [1]
+    # idle workers keep the tight budget
+    busy[0] = False
+    now[0] = 13.0                     # 2.0 past the restart anchor (11.0)
+    assert wd.check_once() == ["worker"]
+
+
+def test_watchdog_stop_then_start_resumes_sweeping():
+    wd = Watchdog(interval=0.02)
+    wd.start()
+    first = wd._thread
+    assert first.is_alive()
+    wd.stop()
+    first.join(timeout=2.0)
+    assert not first.is_alive()
+    wd.start()                          # must NOT be a silent no-op
+    assert wd._thread is not first and wd._thread.is_alive()
+    wd.stop()
+
+
+def test_watchdog_restarts_wedged_processor():
+    from lighthouse_tpu.beacon.beacon_processor import BeaconProcessor
+    from lighthouse_tpu.utils.task_executor import TaskExecutor
+
+    class FakeChain:
+        def process_block(self, block, observed_at=None):
+            return b"\x00" * 32
+
+    proc = BeaconProcessor(FakeChain())
+    executor = TaskExecutor()
+    wd = Watchdog()
+    wd.register("beacon_processor", heartbeat=lambda: proc.heartbeat,
+                restart=proc.restart_run_loop, budget=0.2)
+    failpoints.configure("processor.tick", "delay(30000)")
+    executor.spawn(proc.run, "beacon_processor")
+    proc.enqueue_block(SimpleNamespace(message=SimpleNamespace(slot=1)))
+    time.sleep(0.5)
+    failpoints.configure("processor.tick", "off")
+    assert not proc.results                              # wedged pre-drain
+    assert wd.check_once() == ["beacon_processor"]
+    deadline = time.monotonic() + 5.0
+    while not proc.results and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert proc.results and proc.results[0][:2] == ("block", True)
+    assert proc.restarts == 1
+    executor.shutdown("test done")
+
+
+# ------------------------------------------------------- http control
+
+
+def test_failpoints_http_api():
+    from lighthouse_tpu.api.http_api import BeaconApiServer
+    from lighthouse_tpu.beacon.chain import BeaconChain
+    from lighthouse_tpu.crypto.backend import SignatureVerifier
+    from lighthouse_tpu.testing.harness import Harness
+    from lighthouse_tpu.types import ChainSpec, MinimalPreset
+
+    h = Harness(8, ChainSpec(preset=MinimalPreset))
+    chain = BeaconChain(h.state.copy(), ChainSpec(preset=MinimalPreset),
+                        verifier=SignatureVerifier("fake"))
+    server = BeaconApiServer(chain).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+
+        def patch(body):
+            req = urllib.request.Request(
+                base + "/lighthouse/failpoints",
+                data=json.dumps(body).encode(), method="PATCH",
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as r:
+                return json.load(r)["data"]
+
+        with urllib.request.urlopen(base + "/lighthouse/failpoints") as r:
+            snap = json.load(r)["data"]
+        assert snap["device.execute_chunk"]["mode"] == "off"
+
+        out = patch({"name": "store.put", "mode": "delay(1)"})
+        assert out["store.put"]["mode"] == "delay(1)"
+        out = patch({"failpoints": {"store.put": "off",
+                                    "eth1.rpc": "error(0.5)"}})
+        assert out["store.put"]["mode"] == "off"
+        assert out["eth1.rpc"]["mode"] == "error(0.5)"
+        with urllib.request.urlopen(base + "/lighthouse/failpoints") as r:
+            snap = json.load(r)["data"]
+        assert snap["eth1.rpc"]["mode"] == "error(0.5)"
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            patch({"name": "eth1.rpc", "mode": "bogus"})
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            patch({"nonsense": True})
+        assert ei.value.code == 400
+        # a storm with one bad entry rejects ATOMICALLY: the valid
+        # entry must not be armed behind the 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            patch({"failpoints": {"wire.rpc": "error",
+                                  "store.put": "junk(1)"}})
+        assert ei.value.code == 400
+        assert failpoints.get("wire.rpc").mode == "off"
+        # a typo'd name must not mint a never-firing registry entry
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            patch({"name": "store.putt", "mode": "error"})
+        assert ei.value.code == 400
+        assert failpoints.get("store.putt") is None
+    finally:
+        server.stop()
+
+
+# --------------------------------------------------- chaos acceptance
+
+
+def test_chaos_storm_acceptance():
+    """The fault storm: 20% device `error`, engine `delay`, one store
+    `panic_once` — zero lost verdicts, every submitted set resolves
+    exactly once, the breaker trips -> half-open-probes -> restores, and
+    the store survives its crash window."""
+    import importlib.util
+
+    spec_ = importlib.util.spec_from_file_location(
+        "chaos_bench",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "chaos_bench.py"),
+    )
+    cb = importlib.util.module_from_spec(spec_)
+    spec_.loader.exec_module(cb)
+
+    failpoints.seed_all(7)
+    device = cb.FaultyDeviceVerifier()
+    service = VerificationService(
+        device, host_verifier=cb.HostVerifier(), target_batch=32,
+        breaker_threshold=2, breaker_cooldown=0.1,
+    )
+    failpoints.configure("device.execute_chunk", "error(0.2)")
+    failpoints.configure("engine.rpc", "delay(5)")
+    failpoints.configure("store.compact", "panic_once")
+
+    n_threads, per_thread = 6, 30
+    futures = [[] for _ in range(n_threads)]
+
+    def submitter(i):
+        for _ in range(per_thread):
+            futures[i].append(service.submit([cb.StubSet()]))
+
+    threads = [threading.Thread(target=submitter, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    results = [f.result(timeout=30.0) for fl in futures for f in fl]
+    # zero lost verdicts: every submitted set resolved, and resolved True
+    assert len(results) == n_threads * per_thread
+    assert all(results)
+    assert sum(service.dispatched_batches) == n_threads * per_thread
+    # the storm must actually storm: coalescing makes the number of
+    # device chunks (hence 20%-fault draws) nondeterministic, so keep
+    # offering until at least one injected fault lands (bounded)
+    deadline = time.monotonic() + 10.0
+    extra = 0
+    while device.faults == 0 and time.monotonic() < deadline:
+        assert service.submit([cb.StubSet()], deadline=0.001).result(10.0)
+        extra += 1
+    assert device.faults > 0
+
+    # engine delay: the RPC stalls but succeeds (no retry storm needed)
+    from lighthouse_tpu.execution.engine_server import MockEngineServer
+    from lighthouse_tpu.execution.engine_http import HttpJsonRpcClient
+    from lighthouse_tpu.types.state import state_types
+    from lighthouse_tpu.types import MinimalPreset
+
+    eng = MockEngineServer(state_types(MinimalPreset), bytes(range(32)))
+    try:
+        client = HttpJsonRpcClient(eng.url, bytes(range(32)))
+        assert client.call("lighthouse_elGenesisHash", []).startswith("0x")
+    finally:
+        eng.close()
+    assert failpoints.get("engine.rpc").fired >= 1
+
+    # store panic_once: crash window survived, retry compacts clean
+    from lighthouse_tpu.beacon.store import PyFileKV
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        kv = PyFileKV(os.path.join(d, "storm.log"))
+        for i in range(8):
+            kv.put(bytes([i]), bytes([i]) * 32)
+        with pytest.raises(failpoints.FailpointPanic):
+            kv.compact()
+        assert kv.get(bytes([5])) == bytes([5]) * 32
+        kv.compact()
+        assert kv.get(bytes([5])) == bytes([5]) * 32
+        kv.close()
+
+    # breaker acceptance: force a trip, then watch the half-open probe
+    # restore CLOSED only after it succeeds
+    failpoints.configure("device.execute_chunk", "error")
+    deadline = time.monotonic() + 10.0
+    while service.breaker.state != OPEN and time.monotonic() < deadline:
+        service.submit([cb.StubSet()], deadline=0.001).result(10.0)
+    assert service.breaker.state == OPEN
+    trips_before_recovery = service.breaker.trips
+    assert trips_before_recovery >= 1
+    failpoints.configure("device.execute_chunk", "off")
+    deadline = time.monotonic() + 10.0
+    while service.breaker.state != CLOSED and time.monotonic() < deadline:
+        service.submit([cb.StubSet()], deadline=0.001).result(10.0)
+        time.sleep(0.02)
+    assert service.breaker.state == CLOSED
+    service.stop()

@@ -130,6 +130,9 @@ def test_metric_name_lint():
     import lighthouse_tpu.beacon.block_times_cache  # noqa: F401
     import lighthouse_tpu.beacon.validator_monitor  # noqa: F401
     import lighthouse_tpu.crypto.tpu.bls  # noqa: F401 (pubkey-cache counters)
+    import lighthouse_tpu.utils.failpoints  # noqa: F401 (hit counters)
+    import lighthouse_tpu.utils.retries  # noqa: F401 (retry outcomes)
+    import lighthouse_tpu.utils.watchdog  # noqa: F401 (restart counters)
     import lighthouse_tpu.verify_service.metrics  # noqa: F401
 
     name_re = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
@@ -152,6 +155,15 @@ def test_metric_name_lint():
         "verify_pubkey_cache_misses_total",
         "verify_service_target_batch",
         "verify_service_overlap_ratio",
+    } <= names, sorted(names)
+    # the robustness families (ISSUE 5) must be registered and linted:
+    # breaker state, failpoint hits, retry outcomes, watchdog restarts
+    assert {
+        "verify_service_breaker_state",
+        "lighthouse_failpoint_hits_total",
+        "lighthouse_retry_total",
+        "lighthouse_watchdog_restarts_total",
+        "lighthouse_watchdog_heartbeat_age_seconds",
     } <= names, sorted(names)
 
 

@@ -1,0 +1,273 @@
+"""Chaos bench: verification goodput and recovery time under injected faults.
+
+Drives the VerificationService with N submitter threads at a target
+offered load while the `device.execute_chunk` failpoint fails a
+configurable fraction of device passes — the fault storm the breaker,
+host fallback and half-open probe exist for — and reports, per point:
+
+  * goodput_per_sec  — verdicts delivered per second UNDER the storm
+    (every future must still resolve: lost work would show up as a
+    submitted/resolved gap, reported separately)
+  * breaker_trips    — how often the storm pinned the service to host
+  * breaker_recovery_seconds — after the faults stop, the time from
+    disarm until a half-open probe batch restores the breaker to CLOSED
+
+The device backend is a latency-shaped stub wired through the SAME
+failpoint the real kernel launch hits (crypto/tpu/bls.execute_chunk), so
+the sweep measures the recovery machinery, not BLS math, and runs in
+seconds.  `bench.py config_verify_service` records one point of this
+sweep into BENCH_PRIMARY.json (`goodput_under_faults`,
+`breaker_recovery_seconds`).
+
+Usage:
+    python tools/chaos_bench.py
+    python tools/chaos_bench.py --fault-rates 0.0,0.2,0.5 --duration 2
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lighthouse_tpu.utils import failpoints  # noqa: E402
+from lighthouse_tpu.verify_service import VerificationService  # noqa: E402
+from lighthouse_tpu.verify_service.circuit import CLOSED  # noqa: E402
+
+
+class StubSet:
+    """Opaque token standing in for a SignatureSet."""
+
+    __slots__ = ()
+
+
+class FaultyDeviceVerifier:
+    """Device-shaped seam double wired through the `device.execute_chunk`
+    failpoint: an injected fault degrades the call internally to the
+    host-cost path and reports through on_device_fallback — exactly the
+    observable behavior of SignatureVerifier's tpu→host fallback chain
+    when the real kernel launch raises."""
+
+    backend = "tpu"
+
+    def __init__(self, fixed_ms=1.0, per_set_us=10.0, host_penalty=2.0,
+                 chunk=32):
+        self.fixed_s = fixed_ms / 1e3
+        self.per_set_s = per_set_us / 1e6
+        self.host_penalty = host_penalty
+        self.chunk = max(1, int(chunk))
+        self.on_device_fallback = None
+        self.device_calls = 0
+        self.faults = 0
+
+    def _verify(self, sets):
+        for i in range(0, max(len(sets), 1), self.chunk):
+            n = len(sets[i:i + self.chunk])
+            self.device_calls += 1
+            cost = self.fixed_s + self.per_set_s * n
+            try:
+                failpoints.hit("device.execute_chunk")
+            except failpoints.FailpointError as e:
+                self.faults += 1
+                cost *= self.host_penalty       # degraded host pass
+                if self.on_device_fallback is not None:
+                    self.on_device_fallback(e)
+            time.sleep(cost)
+        return True
+
+    def verify_signature_sets(self, sets, priority=None):
+        return self._verify(list(sets))
+
+    def verify_signature_sets_per_set(self, sets, priority=None):
+        sets = list(sets)
+        self._verify(sets)
+        return [True] * len(sets)
+
+
+class HostVerifier(FaultyDeviceVerifier):
+    """The breaker's pinned host path: same cost model at the host
+    penalty, never touching the failpoint."""
+
+    backend = "native"
+
+    def _verify(self, sets):
+        for i in range(0, max(len(sets), 1), self.chunk):
+            n = len(sets[i:i + self.chunk])
+            time.sleep((self.fixed_s + self.per_set_s * n)
+                       * self.host_penalty)
+        return True
+
+
+def run_chaos_point(fault_rate=0.2, submitters=8, offered_rps=2000.0,
+                    duration=1.5, seed=1234, target_batch=64,
+                    breaker_threshold=3, breaker_cooldown=0.2,
+                    recovery_timeout=10.0):
+    """One storm + recovery measurement; returns a flat dict."""
+    failpoints.seed_all(seed)
+    device = FaultyDeviceVerifier()
+    service = VerificationService(
+        device, host_verifier=HostVerifier(),
+        target_batch=target_batch,
+        breaker_threshold=breaker_threshold,
+        breaker_cooldown=breaker_cooldown,
+    )
+    failpoints.configure(
+        "device.execute_chunk",
+        f"error({fault_rate})" if fault_rate < 1.0 else "error",
+    )
+    if fault_rate <= 0.0:
+        failpoints.configure("device.execute_chunk", "off")
+
+    per_thread = offered_rps / submitters
+    interval = 1.0 / per_thread if per_thread > 0 else 0.0
+    stop_at = time.monotonic() + duration
+    futures = [[] for _ in range(submitters)]
+    rejected = [0] * submitters
+
+    def submitter(i):
+        nxt = time.monotonic()
+        while time.monotonic() < stop_at:
+            try:
+                futures[i].append(service.submit([StubSet()]))
+            except Exception:
+                rejected[i] += 1
+            nxt += interval
+            delay = nxt - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=submitter, args=(i,), daemon=True)
+               for i in range(submitters)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    resolved = ok = 0
+    for fl in futures:
+        for f in fl:
+            try:
+                if f.result(timeout=30.0):
+                    ok += 1
+                resolved += 1
+            except TimeoutError:
+                pass                # LOST: the verdict never arrived
+            except Exception:
+                resolved += 1       # an errored future still RESOLVED
+    wall = time.monotonic() - t0
+    submitted = sum(len(fl) for fl in futures)
+    trips = service.breaker.trips
+    state_after_storm = service.breaker.state
+
+    # recovery: faults off; keep offering probe ticks until the breaker's
+    # half-open probe restores CLOSED
+    failpoints.configure("device.execute_chunk", "off")
+    recovery_s = 0.0
+    if service.breaker.state != CLOSED:
+        r0 = time.monotonic()
+        while (service.breaker.state != CLOSED
+               and time.monotonic() - r0 < recovery_timeout):
+            try:
+                service.submit([StubSet()], deadline=0.001).result(5.0)
+            except Exception:
+                pass
+            time.sleep(0.02)
+        recovery_s = time.monotonic() - r0
+    recovered = service.breaker.state == CLOSED
+    service.stop()
+    return {
+        "fault_rate": fault_rate,
+        "offered_rps": offered_rps,
+        "submitters": submitters,
+        "submitted": submitted,
+        "rejected": sum(rejected),
+        "resolved": resolved,
+        "lost": submitted - resolved,
+        "verified_ok": ok,
+        "goodput_per_sec": round(ok / wall, 1) if wall > 0 else 0.0,
+        "device_faults": device.faults,
+        "breaker_trips": trips,
+        "breaker_state_after_storm": state_after_storm,
+        "breaker_recovery_seconds": round(recovery_s, 3),
+        "breaker_recovered": recovered,
+    }
+
+
+def measure_breaker_recovery(seed=1234, breaker_threshold=2,
+                             breaker_cooldown=0.2, timeout=10.0):
+    """Deterministic trip→half-open-probe→restore measurement: force the
+    breaker OPEN with a 100% device fault, disarm, and time how long the
+    cooldown + bounded probe take to restore CLOSED.  The number the
+    bench artifact records as `breaker_recovery_seconds`."""
+    failpoints.seed_all(seed)
+    device = FaultyDeviceVerifier()
+    service = VerificationService(
+        device, host_verifier=HostVerifier(), target_batch=8,
+        breaker_threshold=breaker_threshold,
+        breaker_cooldown=breaker_cooldown,
+    )
+    try:
+        failpoints.configure("device.execute_chunk", "error")
+        deadline = time.monotonic() + timeout
+        while (service.breaker.trips == 0
+               and time.monotonic() < deadline):
+            service.submit([StubSet()], deadline=0.001).result(5.0)
+        failpoints.configure("device.execute_chunk", "off")
+        t0 = time.monotonic()
+        while (service.breaker.state != CLOSED
+               and time.monotonic() - t0 < timeout):
+            try:
+                service.submit([StubSet()], deadline=0.001).result(5.0)
+            except Exception:
+                pass
+            time.sleep(0.02)
+        recovery = time.monotonic() - t0
+    finally:
+        failpoints.configure("device.execute_chunk", "off")
+        service.stop()
+    return {
+        "breaker_cooldown_seconds": breaker_cooldown,
+        "breaker_trips": service.breaker.trips,
+        "breaker_recovery_seconds": round(recovery, 3),
+        "breaker_recovered": service.breaker.state == CLOSED,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--fault-rates", default="0.0,0.2,0.5",
+                    help="comma-separated device fault probabilities")
+    ap.add_argument("--offered-rps", type=float, default=2000.0)
+    ap.add_argument("--submitters", type=int, default=8)
+    ap.add_argument("--duration", type=float, default=1.5,
+                    help="seconds per storm point")
+    ap.add_argument("--seed", type=int, default=1234)
+    ap.add_argument("--target-batch", type=int, default=64)
+    args = ap.parse_args(argv)
+
+    points = []
+    try:
+        for rate in (float(r) for r in args.fault_rates.split(",")):
+            pt = run_chaos_point(
+                fault_rate=rate, submitters=args.submitters,
+                offered_rps=args.offered_rps, duration=args.duration,
+                seed=args.seed, target_batch=args.target_batch,
+            )
+            points.append(pt)
+            print(json.dumps(pt), flush=True)
+        recovery = measure_breaker_recovery(seed=args.seed)
+        print(json.dumps(recovery), flush=True)
+    finally:
+        failpoints.reset()
+    print(json.dumps(
+        {"tool": "chaos_bench", "points": points, "recovery": recovery}
+    ))
+    # a lost verdict is a harness failure, not a data point
+    return 1 if any(pt["lost"] for pt in points) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
