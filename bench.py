@@ -106,6 +106,7 @@ DETAILS = []
 _PRIMARY = None   # best sets/sec so far; flushed incrementally + on SIGTERM
 _COMPILE_EST = 240.0   # refined after the first measured compile
 _VS_SUMMARY = None     # verify_service coalescing sweep (ROADMAP item d)
+_CC_SUMMARY = None     # compile-cache cold-vs-cached measurement (ISSUE 6)
 
 
 def _load_prior_primary():
@@ -203,6 +204,16 @@ def _emit_primary(value, final=False, backend="tpu-kernel", platform=None):
         # coalescing efficiency rides the primary artifact so the
         # dispatcher's trajectory is tracked across PRs (ROADMAP item d)
         rec["verify_service"] = _VS_SUMMARY
+    if _CC_SUMMARY is not None:
+        # restart economics ride the primary artifact too: how much of
+        # the compile tax the AOT cache refunds on this platform
+        rec["warm_start_speedup"] = _CC_SUMMARY.get("warm_start_speedup")
+        rec["compile_cache"] = {
+            k: _CC_SUMMARY[k]
+            for k in ("prewarm_cold_s", "prewarm_cached_s",
+                      "cache_hit_rate", "shapes")
+            if k in _CC_SUMMARY
+        }
     line = json.dumps(rec)
     print(line, flush=True)
     try:
@@ -869,6 +880,48 @@ def config_kernels():
     note("kernel_candidates", batch=B, **out)
 
 
+def _run_compile_bench(shapes_spec, timeout):
+    """Drive tools/compile_bench.py in a SUBPROCESS (the main process
+    carries a warm persistent XLA cache, which would fake the 'cold'
+    half of the measurement) and return its summary dict."""
+    import subprocess
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tools", "compile_bench.py",
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"     # bounded, tunnel-proof
+    # the 'cold' half must pay real XLA compiles, not persistent-cache
+    # hits from earlier runs
+    env.pop("LTPU_XLA_CACHE", None)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    out = subprocess.run(
+        [sys.executable, path, "--shapes", shapes_spec],
+        capture_output=True, text=True, timeout=timeout, env=env,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"compile_bench rc={out.returncode}: "
+                           f"{out.stderr[-300:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def config_compile_cache():
+    """Cold-compile vs cached-start (ISSUE 6): how long a fresh process
+    takes to become device-ready with and without a populated AOT
+    executable cache.  Measured at a small canonical shape in the main
+    lane (the full prewarm-menu measurement runs in the --warm lane and
+    lands in BENCH_WARM.json); records `warm_start_speedup` into
+    BENCH_PRIMARY.json."""
+    global _CC_SUMMARY
+    if not _fits(420.0, "compile_cache"):
+        return
+    summary = _run_compile_bench("2x1", timeout=max(_left() - 30, 120))
+    summary.pop("programs_detail", None)
+    note("compile_cache", **summary)
+    _CC_SUMMARY = summary
+
+
 def warm():
     """`python bench.py --warm`: populate the persistent XLA cache with
     the standard bucket shapes — the (2,2) smoke/entry shape, the
@@ -896,6 +949,23 @@ def warm():
                  compile_s=round(time.time() - t0, 1))
         except Exception as e:
             note("warm_bucket_error", plan=name, error=str(e)[:200])
+    # compile-cache restart economics over the real prewarm menu: cold
+    # XLA compile vs second-process AOT deserialization — the numbers
+    # that turn BENCH_WARM from a per-restart tax into a build artifact
+    if _left() >= 240:
+        try:
+            from lighthouse_tpu.crypto.tpu import compile_cache as cc
+
+            spec = ",".join(
+                f"{n}x{m}" for n, m in cc.get_planner().prewarm_menu
+            )
+            summary = _run_compile_bench(spec, timeout=max(_left() - 30, 120))
+            summary.pop("programs_detail", None)
+            note("compile_cache", **summary)
+        except Exception as e:
+            note("compile_cache_error", error=str(e)[:300])
+    else:
+        note("compile_cache_skipped", reason="budget")
     print(json.dumps({"warmed": True, "left_s": round(_left(), 1)}))
 
 
@@ -968,11 +1038,11 @@ def main():
     stages = (
         (config_device_retry, config_gossip_latency, config_native_shapes,
          config5, run_device_smoke_and_curve, config_kernels, config1,
-         config4)
+         config4, config_compile_cache)
         if _DEVICE_ALIVE else
         (config_gossip_latency, config_native_shapes, config5,
          config_device_retry, run_device_smoke_and_curve, config_kernels,
-         config1, config4)
+         config1, config4, config_compile_cache)
     )
     for fn in stages:
         if _left() < 120:

@@ -798,6 +798,21 @@ class _Handler(JsonHandler):
 
             return self._json({"data": failpoints.snapshot()})
 
+        if path == "/lighthouse/compile-cache":
+            # compile-lifecycle status: the persistent AOT executable
+            # cache (hits/misses/loaded programs), the canonical shape
+            # menu, and the verify_service admission warm gate
+            from ..crypto.tpu import compile_cache as cc
+
+            cache = cc.get_cache()
+            data = cache.stats()
+            data["planner"] = cc.get_planner().describe()
+            data["disk"] = cache.disk_entries()
+            verifier = getattr(chain, "verifier", None)
+            if verifier is not None and hasattr(verifier, "device_ready"):
+                data["device_ready"] = bool(verifier.device_ready)
+            return self._json({"data": data})
+
         if path == "/lighthouse/logs/recent":
             # newest-first structured records from the flight recorder's
             # ring buffer; ?level= filters at-or-above, ?component= exact

@@ -8,6 +8,9 @@ under the supervised TaskExecutor.
 """
 
 import logging
+import os
+import threading
+import time
 
 from ..api.http_api import BeaconApiServer
 from ..crypto.backend import SignatureVerifier
@@ -45,6 +48,31 @@ class BeaconNode:
         # batch can legitimately run for minutes on CPU and must never
         # read as a wedge — but a pass hung PAST this is still caught
         self.watchdog_busy_budget = 600.0
+        # slot-timer watchdog surface: the timer loop stamps a heartbeat
+        # every pass; `restart_slot_timer` supersedes a wedged loop
+        # generation-wise (a frozen timer silently stops head updates —
+        # ROADMAP robustness follow-on).  The tick lock serializes
+        # on_tick across generations: a superseded thread unblocking
+        # mid-pass must never tick concurrently with its replacement
+        # (the dispatcher's _work_lock pattern).
+        self.timer_heartbeat = None
+        self._timer_gen = 0
+        self._timer_tick_lock = threading.Lock()
+        # monotonic stamp while a tick is executing (None between
+        # ticks): a long-but-progressing on_tick (epoch processing) is
+        # judged against the busy budget, never the stale budget
+        self.timer_tick_started = None
+        self.timer_restarts = 0
+        # compile-prewarm state: monotonic start stamp while the AOT
+        # warm pass runs (None otherwise), and its summary afterwards
+        self.prewarm_started = None
+        self.prewarm_stats = None
+        # close the device admission gate at ASSEMBLY, not start(): the
+        # wire accept thread is live from construction, so a gossip
+        # submission can lazy-start the verify dispatcher before start()
+        # runs — the gate must already be shut for a device-backed
+        # service (start() spawns the prewarm pass that reopens it)
+        self._prewarm_armed = self._close_gate_for_prewarm(chain.verifier)
 
     def start(self):
         if self.api_server is not None:
@@ -54,6 +82,11 @@ class BeaconNode:
         verifier = self.chain.verifier
         if hasattr(verifier, "start") and hasattr(verifier, "submit"):
             verifier.start(self.executor)
+        # admission-gated compile prewarm: close the service's device
+        # gate BEFORE any worker can submit device work, then load the
+        # canonical AOT menu in the background — the node serves traffic
+        # on the host path meanwhile (the PR-5 breaker degrade seam)
+        warming = self._begin_prewarm(verifier)
         self.executor.spawn(self._timer_loop, "slot_timer")
         self.executor.spawn(self.processor.run, "beacon_processor")
         self.executor.spawn(self._notifier_loop, "notifier", critical=False)
@@ -73,11 +106,103 @@ class BeaconNode:
                 heartbeat=lambda: verifier.heartbeat,
                 restart=verifier.restart_dispatcher,
                 budget=self.watchdog_budget,
-                busy=lambda: verifier.pass_started is not None,
+                # a dispatcher mid work pass OR a node mid compile-prewarm
+                # is judged against the busy budget: a cold compile is
+                # warmup, never a wedge — while a pass hung past the
+                # budget still restarts
+                busy=lambda: (
+                    verifier.pass_started is not None
+                    or self.prewarm_started is not None
+                    or not getattr(verifier, "device_ready", True)
+                ),
                 busy_budget=self.watchdog_busy_budget,
             )
+        # ROADMAP robustness follow-ons: the slot timer and the wire's
+        # gossip heartbeat/reader threads are watched like the worker
+        # loops (a wedged timer stalls on_tick; a wedged gossip
+        # heartbeat stalls mesh maintenance and IWANT budgets)
+        self.watchdog.register(
+            "slot_timer",
+            heartbeat=lambda: self.timer_heartbeat,
+            restart=self.restart_slot_timer,
+            budget=self.watchdog_budget,
+            # an epoch-boundary on_tick can legitimately run long; like
+            # the processor/dispatcher, mid-tick staleness is judged
+            # against the larger busy budget
+            busy=lambda: self.timer_tick_started is not None,
+            busy_budget=self.watchdog_busy_budget,
+        )
+        if self.wire is not None and hasattr(self.wire, "beat_stamp"):
+            self.watchdog.register(
+                "wire_heartbeat",
+                heartbeat=lambda: self.wire.beat_stamp,
+                restart=self.wire.restart_heartbeat_thread,
+                budget=self.watchdog_budget,
+            )
         self.watchdog.start(self.executor)
+        if warming:
+            log.info("compile prewarm running; device admission gated")
         return self
+
+    # -------------------------------------------------- compile prewarm
+
+    def _close_gate_for_prewarm(self, verifier):
+        """Shut the device admission gate (construction-time).  Only
+        engages for a device-backed VerificationService (the warm gate +
+        prewarm seams); `LTPU_PREWARM=0` opts out."""
+        if os.environ.get("LTPU_PREWARM", "1") == "0":
+            return False
+        if not (hasattr(verifier, "begin_warmup")
+                and getattr(verifier, "backend", None) == "tpu"):
+            return False
+        verifier.begin_warmup()
+        return True
+
+    def _begin_prewarm(self, verifier):
+        """Kick the background AOT warm pass that reopens the gate
+        `_close_gate_for_prewarm` shut at assembly."""
+        if not self._prewarm_armed:
+            return False
+        self.prewarm_started = time.monotonic()
+        self.executor.spawn(self._prewarm_task, "compile_prewarm",
+                            critical=False)
+        return True
+
+    def _prewarm_task(self, executor):
+        verifier = self.chain.verifier
+        try:
+            inner = getattr(verifier, "verifier", verifier)
+            prewarm = getattr(inner, "prewarm", None)
+            if prewarm is not None:
+                self.prewarm_stats = prewarm(
+                    progress=getattr(verifier, "set_warmth", None)
+                )
+                log.info(
+                    "compile prewarm complete: %s",
+                    {k: v for k, v in (self.prewarm_stats or {}).items()
+                     if k != "programs_detail"},
+                )
+        except Exception as e:
+            # the gate still opens: the first real device batch pays the
+            # compile under the watchdog's busy budget instead
+            log.warning("compile prewarm failed (%s); first batch "
+                        "compiles inline", e)
+        finally:
+            self.prewarm_started = None
+            if hasattr(verifier, "mark_device_ready"):
+                verifier.mark_device_ready()
+
+    def restart_slot_timer(self):
+        """Watchdog recovery hook: supersede a wedged slot-timer loop
+        with a fresh generation (the superseded thread exits at its next
+        pass; ticks continue under the new one)."""
+        if self.executor.shutting_down:
+            return False
+        self._timer_gen += 1
+        self.timer_restarts += 1
+        self.executor.spawn(self._timer_loop, "slot_timer")
+        log.warning("slot timer restarted (generation %d)", self._timer_gen)
+        return True
 
     def stop(self):
         self.watchdog.stop()
@@ -96,12 +221,48 @@ class BeaconNode:
 
     def _timer_loop(self, executor):
         """timer/src/lib.rs:12-36 per-slot tick.  The wait is capped so a
-        manually-advanced clock (tests, simulator) is noticed promptly."""
+        manually-advanced clock (tests, simulator) is noticed promptly.
+        Stamps `timer_heartbeat` every pass for the watchdog; a restart
+        bumps `_timer_gen` and this (superseded) loop exits at its next
+        pass without ticking."""
+        gen = self._timer_gen
         last = None
+        warned_blocked = False
         while not executor.shutting_down:
+            if self._timer_gen != gen:
+                return            # superseded by restart_slot_timer
+            self.timer_heartbeat = time.monotonic()
             slot = self.clock.now()
             if slot is not None and slot != last:
-                self.chain.on_tick(slot)
+                if not self._timer_tick_lock.acquire(timeout=1.0):
+                    # an older generation is wedged inside on_tick
+                    # holding the lock; ticking concurrently is exactly
+                    # what the lock prevents.  Keep looping — a fresh
+                    # heartbeat stops the watchdog from piling further
+                    # replacements behind the same lock — but say so:
+                    # head updates are silently stalled until the
+                    # wedged tick returns
+                    if not warned_blocked:
+                        warned_blocked = True
+                        log.warning(
+                            "slot timer blocked behind a wedged older "
+                            "tick; head updates paused"
+                        )
+                    continue
+                warned_blocked = False
+                try:
+                    # re-check under the lock: a thread that wedged in
+                    # clock.now() and got superseded must not deliver a
+                    # late tick concurrently with its replacement
+                    if self._timer_gen != gen:
+                        return
+                    self.timer_tick_started = time.monotonic()
+                    try:
+                        self.chain.on_tick(slot)
+                    finally:
+                        self.timer_tick_started = None
+                finally:
+                    self._timer_tick_lock.release()
                 last = slot
             wait = min(self.clock.duration_to_next_slot(), 0.25)
             if executor.sleep_or_shutdown(max(wait, 0.05)):

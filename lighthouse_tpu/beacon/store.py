@@ -22,6 +22,8 @@ so decode picks the right container class (the reference's multi-fork
 import json
 import os
 import struct
+import threading
+import time
 
 from ..ssz import decode, encode, hash_tree_root
 from ..types.state import state_types
@@ -91,13 +93,49 @@ class PyFileKV(KV):
     Record layout: [klen u32][vlen u32][key][value]; vlen == 0xFFFFFFFF is
     a tombstone.  The index maps key -> (offset, length) into the log;
     opening replays the log.  `compact()` rewrites live records.
+
+    Durability policy (`LTPU_STORE_FSYNC`, or the `fsync_policy`
+    kwarg):
+
+      * ``off``    — (default, the historical behavior) appends reach
+        the OS only on explicit flush/close/compact; a power loss can
+        lose the buffered tail (the replay truncates any torn record).
+      * ``group``  — group commit: puts mark the log dirty and an fsync
+        is issued once `fsync_interval` seconds (default 0.05) have
+        passed since the last one; a write landing inside the window
+        arms a one-shot straggler timer so the tail of a burst is
+        synced within one interval even if no later write arrives —
+        bounding the crash-loss window to one interval while amortizing
+        the fsync cost across a burst (the WAL group-commit everyone's
+        database does).
+      * ``always`` — every put/delete fsyncs before returning; maximum
+        durability, per-write latency.
     """
 
     engine = "python"
 
-    def __init__(self, path):
+    FSYNC_POLICIES = ("off", "group", "always")
+
+    def __init__(self, path, fsync_policy=None, fsync_interval=0.05):
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if fsync_policy is None:
+            fsync_policy = os.environ.get("LTPU_STORE_FSYNC", "off")
+        if fsync_policy not in self.FSYNC_POLICIES:
+            raise ValueError(
+                f"LTPU_STORE_FSYNC must be one of {self.FSYNC_POLICIES}, "
+                f"got {fsync_policy!r}"
+            )
+        self.fsync_policy = fsync_policy
+        self.fsync_interval = float(fsync_interval)
+        self._last_fsync = 0.0
+        self._dirty = False
+        self._group_timer = None
+        # serializes the dirty-window state between the writer's
+        # _commit and the straggler timer thread (without it, a put
+        # landing between the timer's flush and its dirty-clear would
+        # have its dirty bit clobbered and never sync)
+        self._fsync_lock = threading.Lock()
         self._index = {}
         self._f = open(path, "ab+")
         self._replay()
@@ -151,12 +189,68 @@ class PyFileKV(KV):
         off = self._f.tell()
         self._f.write(value)
         self._index[key] = (off, len(value))
+        self._commit()
 
     def delete(self, key):
         if key in self._index:
             self._f.write(struct.pack("<II", len(key), _TOMBSTONE))
             self._f.write(key)
             self._index.pop(key, None)
+            self._commit()
+
+    def batch(self, ops):
+        """Atomic-ish StoreOp batch: under the `group`/`always` policies
+        the whole batch rides ONE fsync (the group-commit shape), not
+        one per op."""
+        policy, self.fsync_policy = self.fsync_policy, "off"
+        try:
+            super().batch(ops)
+        finally:
+            self.fsync_policy = policy
+        self._commit()
+
+    def _commit(self):
+        """Apply the durability policy to the write that just landed in
+        the append buffer."""
+        if self.fsync_policy == "always":
+            with self._fsync_lock:
+                self.flush()
+                self._last_fsync = time.monotonic()
+                self._dirty = False
+            return
+        if self.fsync_policy == "group":
+            with self._fsync_lock:
+                self._dirty = True
+                now = time.monotonic()
+                if now - self._last_fsync >= self.fsync_interval:
+                    self.flush()
+                    self._last_fsync = now
+                    self._dirty = False
+                elif self._group_timer is None:
+                    # the crash window must stay bounded even when no
+                    # later write arrives to piggyback the sync on: a
+                    # one-shot straggler flush fires at the end of this
+                    # interval
+                    t = threading.Timer(
+                        self.fsync_interval - (now - self._last_fsync),
+                        self._flush_group_window,
+                    )
+                    t.daemon = True
+                    self._group_timer = t
+                    t.start()
+
+    def _flush_group_window(self):
+        with self._fsync_lock:
+            self._group_timer = None
+            if not self._dirty:
+                return
+            try:
+                self.flush()
+            except (OSError, ValueError):
+                return      # handle closed/replaced underneath us:
+                            # close()/compact() flushed on their own
+            self._last_fsync = time.monotonic()
+            self._dirty = False
 
     def keys_with_prefix(self, prefix):
         return [k for k in self._index if k.startswith(prefix)]
@@ -210,7 +304,16 @@ class PyFileKV(KV):
         self._index = new_index
 
     def close(self):
-        self._f.flush()
+        t = self._group_timer
+        if t is not None:
+            t.cancel()              # a fired-but-running timer instead
+        with self._fsync_lock:      # finishes under the lock, before us
+            self._group_timer = None
+            if self._dirty:
+                # a group-commit window must not outlive the handle
+                self.flush()
+                self._dirty = False
+            self._f.flush()
         self._f.close()
 
 
@@ -622,9 +725,13 @@ class HotColdStore:
 
     def state_at_slot(self, slot):
         """reconstruct.rs: nearest restore point at/below `slot`, then
-        replay canonical cold blocks up to it."""
+        replay canonical cold blocks up to it.
+
+        A range that crosses `db prune-payloads`-blinded records replays
+        in the OPTIMISTIC payload-skipping mode (committed headers apply
+        verbatim; nothing re-validated against a payload that is no
+        longer stored) — per-block state roots still pin the result."""
         from ..state_processing.block_replayer import BlockReplayer
-        from ..state_processing import phase0
 
         rp_keys = sorted(self.kv.keys_with_prefix(_COLD_STATE))
         base = None
@@ -638,14 +745,21 @@ class HotColdStore:
             return None
         state = self.codec.dec_state(base)
         blocks = []
+        pruned_range = False
         for s in range(base_slot + 1, slot + 1):
             root = self.kv.get(_COLD_BLOCK_SLOT + struct.pack(">Q", s))
             if root is None:
                 continue  # skipped slot
-            blocks.append(self.get_block(root))
-        return BlockReplayer(state, self.spec).apply_blocks(
-            blocks, target_slot=slot
-        )
+            blk = self.get_block(root)
+            if blk is not None and hasattr(
+                blk.message.body, "execution_payload_header"
+            ):
+                pruned_range = True
+            blocks.append(blk)
+        replayer = BlockReplayer(state, self.spec)
+        if pruned_range:
+            replayer.with_payload_verification(False)
+        return replayer.apply_blocks(blocks, target_slot=slot)
 
     def close(self):
         self.kv.close()

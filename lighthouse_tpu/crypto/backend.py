@@ -108,6 +108,18 @@ class SignatureVerifier:
             except Exception:
                 pass
 
+    def prewarm(self, progress=None):
+        """Load-or-compile the canonical device kernel menu ahead of
+        admission (crypto/tpu/compile_cache.prewarm): with a populated
+        AOT cache this is seconds of deserialization, not minutes of XLA
+        compilation.  No-op (None) for host backends — they have no
+        compile tax to pay."""
+        if self.backend != "tpu":
+            return None
+        from .tpu import compile_cache
+
+        return compile_cache.prewarm(progress=progress)
+
     def plan_pipeline(self, sets):
         """Two-stage (host-prep, device-execute) chunk plan for the
         verify_service dispatcher's prep/device pipeline, or None when

@@ -44,6 +44,7 @@ from ...utils import failpoints as _failpoints
 from ...utils import metrics as _metrics
 from ...utils import tracing
 from ..constants import P, G1_X, G1_Y, RAND_BITS, DST_POP
+from . import compile_cache as cc
 from . import fp
 from . import tower as tw
 from . import curve as cv
@@ -51,10 +52,6 @@ from . import pairing as pr
 from . import hash_to_curve as h2c
 
 # ----------------------------------------------------------------- helpers
-
-
-def _next_pow2(n):
-    return 1 if n <= 1 else 1 << (n - 1).bit_length()
 
 
 def _fp_host_mont(ints, shape):
@@ -332,8 +329,11 @@ def per_set_verify_kernel(pk, sig, u0, u1, real):
     return all_ok, per_set
 
 
-_jit_batched = jax.jit(batched_verify_kernel)
-_jit_per_set = jax.jit(per_set_verify_kernel)
+# Call-compatible with the old `jax.jit` bindings, but every launch goes
+# through the persistent AOT executable cache (compile_cache.py): a warm
+# host deserializes the canonical programs instead of recompiling them.
+_jit_batched = cc.CachedKernel("bls_batched_verify", batched_verify_kernel)
+_jit_per_set = cc.CachedKernel("bls_per_set_verify", per_set_verify_kernel)
 
 
 def validate_pubkeys_kernel(pk):
@@ -344,6 +344,11 @@ def validate_pubkeys_kernel(pk):
     return cv.g1_in_subgroup(pk) & ~cv.is_inf(cv.FP_OPS, pk)
 
 
+# plain jit, NOT a CachedKernel: pubkey-import batches arrive at raw,
+# un-planned sizes (validator_pubkey_cache feeds the exact key count),
+# so AOT-persisting per-shape entries would grow the disk cache without
+# bound.  The kernel is small; jax's own compilation-cache tier covers
+# its warm starts.
 _jit_validate_pk = jax.jit(validate_pubkeys_kernel)
 
 
@@ -358,8 +363,9 @@ def _bucket_sets() -> int:
     unbounded pow-2 bucket growth (r3: a 2048-set batch demanded its own
     multi-hour XLA compile; r4: it runs as 64 chunks of the 32-shape).
     On real TPU hardware a larger bucket amortizes better: raise via env.
-    """
-    return max(1, int(_os.environ.get("LTPU_MAX_SETS_BUCKET", "32")))
+    The bucket is the top of the ShapePlanner's set-axis menu — one
+    source of truth for every padded shape (compile_cache.py)."""
+    return cc.get_planner().bucket
 
 
 def _prepare(sets, dst, min_sets=1, min_pks=1):
@@ -378,8 +384,10 @@ def _prepare(sets, dst, min_sets=1, min_pks=1):
             return None
         if any(pk is None for pk in s.pubkeys):
             return None                       # infinity pubkey rejection
-    n_pad = max(_next_pow2(len(sets)), min_sets)
-    m_pad = max(_next_pow2(max(len(s.pubkeys) for s in sets)), min_pks)
+    n_pad, m_pad = cc.get_planner().plan(
+        len(sets), max(len(s.pubkeys) for s in sets),
+        min_sets=min_sets, min_pks=min_pks,
+    )
     pk_rows = [list(s.pubkeys) for s in sets] + [[] for _ in range(n_pad - len(sets))]
     pk = _g1_pad_dev(pk_rows, m_pad)
     sigs = [s.signature for s in sets] + [None] * (n_pad - len(sets))
@@ -475,9 +483,11 @@ def _verify_chunk(sets, dst, rng, min_sets=1, min_pks=1):
 def _batch_m_pad(sets):
     """Shared pubkey-axis pad bucket for every chunk of a batch — all
     chunks MUST land on one compiled shape (serial and pipelined paths
-    use this same computation)."""
-    return _next_pow2(max((len(s.pubkeys) for s in sets if s.pubkeys),
-                          default=1))
+    use this same computation).  Canonicalized by the ShapePlanner, so
+    the pubkey axis always lands on the enumerable menu."""
+    return cc.get_planner().plan_pks(
+        max((len(s.pubkeys) for s in sets if s.pubkeys), default=1)
+    )
 
 
 def plan_pipeline(sets, dst=DST_POP, rng=None):
@@ -547,6 +557,38 @@ def _per_set_chunk(sets, dst, min_sets=1, min_pks=1):
 def _structurally_bad(s):
     return (s.signature is None or not s.pubkeys
             or any(pk is None for pk in s.pubkeys))
+
+
+def example_chunk_args(n_pad, m_pad, dst=DST_POP):
+    """Kernel arguments at the canonical (n_pad, m_pad) shape, built
+    from PADDING content through the exact staging helpers `_prepare`
+    uses — the prewarm path must key the compile cache with the same
+    pytree structure, shapes, and dtypes a real chunk produces.
+
+    Returns (batched_args, per_set_args): content is vacuous (infinity
+    points, empty messages, zero scalars) — prewarm lowers and compiles,
+    it never needs a meaningful verdict."""
+    pk = _g1_pad_dev([[] for _ in range(n_pad)], m_pad)
+    sig = _g2_dev([None] * n_pad)
+    u0, u1 = h2c.hash_to_field_host([b""] * n_pad, dst)
+    rands = jnp.zeros((2, n_pad), jnp.uint32)
+    real = jnp.zeros((n_pad,), bool)
+    return (pk, sig, u0, u1, rands), (pk, sig, u0, u1, real)
+
+
+def kernel_specs(n_pad, m_pad, per_set=True):
+    """(name, kernel_fn, example_args, shape_label) entries for the
+    compile cache's prewarm walk over one canonical shape."""
+    batched_args, per_set_args = example_chunk_args(n_pad, m_pad)
+    label = f"{n_pad}x{m_pad}"
+    specs = [
+        ("bls_batched_verify", batched_verify_kernel, batched_args, label),
+    ]
+    if per_set:
+        specs.append(
+            ("bls_per_set_verify", per_set_verify_kernel, per_set_args, label)
+        )
+    return specs
 
 
 def verify_signature_sets_per_set(sets, dst=DST_POP):

@@ -29,11 +29,17 @@ import jax
 import jax.numpy as jnp
 
 from ..constants import P
+from . import compile_cache as cc
 from . import curve as cv
 from . import fp
 from . import tower as tw
 
-_jit_g2_subgroup = jax.jit(lambda p: cv.g2_in_subgroup(p))
+
+def _g2_subgroup_kernel(p):
+    return cv.g2_in_subgroup(p)
+
+
+_jit_g2_subgroup = cc.CachedKernel("g2_subgroup_check", _g2_subgroup_kernel)
 
 # y^2 = x^3 + B2 with B2 = (4, 4)
 _B2 = (4, 4)
@@ -152,11 +158,7 @@ def decompress_kernel(c0, c1, y_big):
     return (x, y, (one, zero)), valid
 
 
-_jit_decompress = jax.jit(decompress_kernel)
-
-
-def _next_pow2(n):
-    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+_jit_decompress = cc.CachedKernel("g2_decompress", decompress_kernel)
 
 
 def g2_decompress_batch(blobs, subgroup_check=True):
@@ -166,13 +168,13 @@ def g2_decompress_batch(blobs, subgroup_check=True):
 
     `subgroup_check=True` (the oracle's and blst's default) also runs
     the device psi-based G2 subgroup check — an on-curve point outside
-    the r-order subgroup gets ok=False.  Batches are padded to the next
-    power of two so varying gossip sizes share a handful of compiled
-    shapes."""
+    the r-order subgroup gets ok=False.  Batches are padded onto the
+    ShapePlanner's lane menu (compile_cache.py) so varying gossip sizes
+    share a bounded, enumerable set of compiled shapes."""
     n = len(blobs)
     if n == 0:
         return None, np.zeros(0, dtype=bool)
-    n_pad = _next_pow2(n)
+    n_pad = cc.get_planner().plan_lanes(n)
     blobs = list(blobs) + [b""] * (n_pad - n)
     c0s, c1s, y_big, valid, is_inf = parse_g2_bytes(blobs)
     shape = (n_pad,)

@@ -418,6 +418,13 @@ def from_mont(a):
     return mont_mul(a, one)
 
 
+# Deliberately plain jit, NOT a compile_cache.CachedKernel: to_mont is
+# called at whatever shapes host staging hands it (constants, curve
+# points, ad-hoc tooling), so AOT-persisting one disk entry per shape
+# would grow the cache without bound for a kernel that compiles in
+# seconds.  The planner-canonicalized heavy kernels (bls, decompress)
+# are where the AOT tier pays; jax's own compilation-cache tier covers
+# this one's warm starts.
 to_mont_jit = jax.jit(to_mont)
 
 

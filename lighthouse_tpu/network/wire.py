@@ -260,6 +260,12 @@ class _Peer:
         self._alive = True
         self.tx = None               # CipherState after noise handshake
         self.rx = None
+        # monotonic stamp while the reader thread is INSIDE a frame
+        # dispatch (None while blocked on recv — an idle connection is
+        # healthy).  The wire heartbeat closes peers whose dispatch has
+        # been stuck past `reader_stall_budget`, which unblocks the
+        # wedged reader thread via the socket teardown.
+        self.dispatch_started = None
 
     SEND_TIMEOUT = 20.0
 
@@ -359,6 +365,21 @@ class WireNode:
         self._mcache = OrderedDict()
         self._beat = 0
         self._iwant_served = {}
+        # watchdog surface (ROADMAP robustness follow-on): the gossip
+        # heartbeat thread stamps `beat_stamp` every pass and can be
+        # superseded generation-wise by `restart_heartbeat_thread`; a
+        # reader thread wedged INSIDE a frame dispatch past this budget
+        # has its peer closed by the next heartbeat (the socket teardown
+        # unblocks the thread)
+        self.beat_stamp = None
+        self._hb_gen = 0
+        # serializes the heartbeat pass across generations: a stalled
+        # pass that unblocks after restart_heartbeat_thread must not
+        # mutate mesh/_mcache/_iwant_served concurrently with its
+        # replacement (the BeaconNode slot-timer tick-lock pattern)
+        self._hb_tick_lock = threading.Lock()
+        self.heartbeat_restarts = 0
+        self.reader_stall_budget = 60.0
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True
         )
@@ -585,7 +606,11 @@ class WireNode:
                             peer.send_frame(SUBSCRIBE, topic.encode())
                     self._exchange_peers(peer)
                     continue
-                self._dispatch(peer, ftype, body)
+                peer.dispatch_started = time.monotonic()
+                try:
+                    self._dispatch(peer, ftype, body)
+                finally:
+                    peer.dispatch_started = None
         except Exception as e:
             # any malformed frame is peer fault (struct/unicode/snappy/
             # index errors included) — drop the connection, never the node
@@ -700,12 +725,77 @@ class WireNode:
     def _heartbeat_loop(self):
         import random as _random
 
+        gen = self._hb_gen
+        warned_blocked = False
         while not self._stopped:
             time.sleep(HEARTBEAT_S)
+            if self._hb_gen != gen:
+                return           # superseded by restart_heartbeat_thread
+            if not self._hb_tick_lock.acquire(timeout=HEARTBEAT_S):
+                # an older generation is wedged mid-pass holding the
+                # lock; running alongside it is what the lock prevents.
+                # Keep stamping so the watchdog doesn't pile further
+                # replacements behind the same lock.
+                self.beat_stamp = time.monotonic()
+                if not warned_blocked:
+                    warned_blocked = True
+                    log.warning(
+                        "gossip heartbeat blocked behind a wedged "
+                        "older pass; mesh maintenance paused"
+                    )
+                continue
             try:
-                self._heartbeat(_random)
-            except Exception:
-                pass
+                # re-check under the lock: a pass that stalled, was
+                # superseded, and then unblocked must not run alongside
+                # the replacement generation's pass
+                if self._hb_gen != gen:
+                    return
+                warned_blocked = False
+                self.beat_stamp = time.monotonic()
+                try:
+                    self._reap_stalled_readers()
+                except Exception:
+                    pass
+                try:
+                    self._heartbeat(_random)
+                except Exception:
+                    pass
+            finally:
+                self._hb_tick_lock.release()
+
+    def restart_heartbeat_thread(self):
+        """Watchdog recovery hook: supersede a wedged gossip-heartbeat
+        thread with a fresh one (mesh/IWANT state is all on the node, so
+        the replacement continues where the old one stalled)."""
+        if self._stopped:
+            return False
+        self._hb_gen += 1
+        self.heartbeat_restarts += 1
+        t = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        self._heartbeat_thread = t
+        t.start()
+        return True
+
+    def _reap_stalled_readers(self):
+        """Close peers whose reader thread has been stuck inside one
+        frame dispatch past the stall budget — a hung handler (dead
+        chain lock, blocked req/resp) must cost ONE peer connection,
+        not a silently dead reader forever."""
+        now = time.monotonic()
+        for peer in list(self.peers.values()):
+            t0 = peer.dispatch_started
+            if t0 is not None and now - t0 > self.reader_stall_budget:
+                log.warning(
+                    "peer %s reader stalled in dispatch %.1fs; closing",
+                    peer.peer_id, now - t0,
+                )
+                # close + unroute NOW: the reader's own finally block
+                # repeats this cleanup harmlessly when (if) the stuck
+                # dispatch finally returns and the loop exits on _alive
+                peer.close()
+                if self.peers.get(peer.peer_id) is peer:
+                    del self.peers[peer.peer_id]
+                    self.limiter.forget(peer.peer_id)
 
     # mesh-quality thresholds (gossipsub_scoring_parameters.rs role):
     # below PRUNE the peer leaves that topic's mesh (connection kept);

@@ -112,10 +112,16 @@ def process_operations(state, body, spec, verifying, sets, get_pubkey):
             )
 
 
-def payload_steps(engine):
+def payload_steps(engine, optimistic=False):
     """The spec-ordered pre-randao steps: capella withdrawals, then
     execution payload (runs between process_block_header and
-    process_randao — payload.prev_randao is therefore the PRE-block mix)."""
+    process_randao — payload.prev_randao is therefore the PRE-block mix).
+
+    `optimistic=True` is the payload-skipping replay mode (historical
+    reconstruction over `db prune-payloads`-blinded ranges): the payload
+    consistency checks are SKIPPED and the committed header/withdrawals
+    are applied to the state verbatim — already-finalized history is
+    trusted, and a blinded record carries no payload to re-validate."""
 
     def hook(state, body, spec):
         blinded = hasattr(body, "execution_payload_header")
@@ -125,8 +131,10 @@ def payload_steps(engine):
             else body.execution_payload
         )
         if is_capella_state(state):
-            process_withdrawals(state, payload, spec.preset)
-        process_execution_payload(state, body, spec, engine)
+            process_withdrawals(state, payload, spec.preset,
+                                verify=not optimistic)
+        process_execution_payload(state, body, spec, engine,
+                                  optimistic=optimistic)
 
     return hook
 
@@ -188,33 +196,44 @@ def produce_payload(state, spec, engine, capella, fee_recipient=b"\x00" * 20):
     )
 
 
-def process_execution_payload(state, body, spec, engine):
+def process_execution_payload(state, body, spec, engine, optimistic=False):
     """Spec process_execution_payload + the engine notify seam.
 
     Accepts blinded bodies too (execution_payload_header instead of
     execution_payload — the reference's AbstractExecPayload dispatch):
     header fields carry the same checks; transactions/withdrawals roots
     are taken as-is and the engine is NOT notified (nothing to execute —
-    the builder reveals the payload at unblinding)."""
+    the builder reveals the payload at unblinding).
+
+    `optimistic=True` (payload-skipping replay over pruned history)
+    skips the consistency assertions and engine notification entirely:
+    the committed header is applied verbatim, trusting finalized
+    storage."""
     preset = spec.preset
     blinded = hasattr(body, "execution_payload_header")
     payload = (
         body.execution_payload_header if blinded else body.execution_payload
     )
     header = state.latest_execution_payload_header
-    if is_merge_transition_complete(state):
-        # the transition block's parent is the terminal EL block, not a
-        # previously-seen payload (spec process_execution_payload guard)
-        assert bytes(payload.parent_hash) == bytes(header.block_hash), (
-            "payload parent hash mismatch"
+    if not optimistic:
+        if is_merge_transition_complete(state):
+            # the transition block's parent is the terminal EL block, not
+            # a previously-seen payload (spec process_execution_payload
+            # guard)
+            assert bytes(payload.parent_hash) == bytes(header.block_hash), (
+                "payload parent hash mismatch"
+            )
+        assert bytes(payload.prev_randao) == get_randao_mix(
+            state, get_current_epoch(state, preset), preset
+        ), "payload prev_randao mismatch"
+        expected_time = (
+            int(state.genesis_time) + int(state.slot) * spec.seconds_per_slot
         )
-    assert bytes(payload.prev_randao) == get_randao_mix(
-        state, get_current_epoch(state, preset), preset
-    ), "payload prev_randao mismatch"
-    expected_time = int(state.genesis_time) + int(state.slot) * spec.seconds_per_slot
-    assert int(payload.timestamp) == expected_time, "payload timestamp mismatch"
+        assert int(payload.timestamp) == expected_time, (
+            "payload timestamp mismatch"
+        )
 
-    if engine is not None and not blinded:
+    if engine is not None and not blinded and not optimistic:
         from ..execution import PayloadStatus
 
         status = engine.notify_new_payload(payload)
@@ -294,27 +313,31 @@ def get_expected_withdrawals(state, preset):
     return out
 
 
-def process_withdrawals(state, payload, preset):
+def process_withdrawals(state, payload, preset, verify=True):
     """Spec process_withdrawals; for a blinded payload HEADER the expected
     list is checked against its withdrawals_root instead of element-wise
-    (capella.rs process_withdrawals for BlindedPayload)."""
+    (capella.rs process_withdrawals for BlindedPayload).  `verify=False`
+    (optimistic pruned-range replay) still APPLIES the expected
+    withdrawals — the balance deltas are part of the state transition —
+    but skips the root/element comparison against the stored record."""
     expected = get_expected_withdrawals(state, preset)
-    if hasattr(payload, "withdrawals_root"):
-        T = state_types(preset)
-        w_type = dict(T.ExecutionPayloadCapella.fields)["withdrawals"]
-        assert bytes(payload.withdrawals_root) == hash_tree_root(
-            w_type, expected
-        ), "withdrawals root mismatch"
-        for e in expected:
-            phase0.decrease_balance(
-                state, int(e.validator_index), int(e.amount)
-            )
-    else:
-        got = list(payload.withdrawals)
-        assert len(got) == len(expected), "withdrawal count mismatch"
-        for w, e in zip(got, expected):
-            assert w == e, "withdrawal mismatch"
-            phase0.decrease_balance(state, int(w.validator_index), int(w.amount))
+    if verify:
+        if hasattr(payload, "withdrawals_root"):
+            T = state_types(preset)
+            w_type = dict(T.ExecutionPayloadCapella.fields)["withdrawals"]
+            assert bytes(payload.withdrawals_root) == hash_tree_root(
+                w_type, expected
+            ), "withdrawals root mismatch"
+        else:
+            got = list(payload.withdrawals)
+            assert len(got) == len(expected), "withdrawal count mismatch"
+            for w, e in zip(got, expected):
+                assert w == e, "withdrawal mismatch"
+    # blinded or full, verified or optimistic: the EXPECTED list (now
+    # proven equal to the committed one when verify is on) drives the
+    # balance deltas
+    for e in expected:
+        phase0.decrease_balance(state, int(e.validator_index), int(e.amount))
     if expected:
         state.next_withdrawal_index = int(expected[-1].index) + 1
     n = len(state.validators)

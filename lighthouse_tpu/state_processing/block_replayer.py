@@ -39,10 +39,22 @@ class BlockReplayer:
         self.pre_block_hook = None
         self.post_block_hook = None
         self.verify_state_roots = True
+        self.verify_payloads = True
 
     def with_signature_strategy(self, strategy, verify_fn=None):
         self.signature_strategy = strategy
         self.verify_fn = verify_fn
+        return self
+
+    def with_payload_verification(self, on):
+        """`False` = the OPTIMISTIC payload-skipping replay mode: the
+        bellatrix payload consistency checks (parent hash, prev_randao,
+        timestamp, withdrawals root) are skipped and committed headers
+        apply verbatim.  Required to replay a `db prune-payloads`-blinded
+        range, where the stored record has no payload left to
+        re-validate; state roots still pin the result when
+        `verify_state_roots` is on."""
+        self.verify_payloads = bool(on)
         return self
 
     def with_pre_block_hook(self, hook):
@@ -76,6 +88,7 @@ class BlockReplayer:
                 signature_strategy=self.signature_strategy,
                 verify_fn=self.verify_fn,
                 collected_sets=collected,
+                payload_optimistic=not self.verify_payloads,
             )
             if self.verify_state_roots:
                 if signed.message.state_root != hash_tree_root(self.state):

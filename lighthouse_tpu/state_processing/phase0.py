@@ -797,6 +797,7 @@ def per_block_processing(
     verify_fn=None,
     collected_sets=None,
     execution_engine=None,
+    payload_optimistic=False,
 ):
     """per_block_processing.rs:95.
 
@@ -805,6 +806,11 @@ def per_block_processing(
     there instead of verified (the BlockSignatureVerifier accumulation
     path), letting callers batch many blocks into one device call
     (block_verification.rs:531 signature_verify_chain_segment).
+
+    `payload_optimistic=True` runs the bellatrix payload steps in the
+    payload-skipping replay mode (consistency checks and engine notify
+    skipped; committed header applied verbatim) — the historical
+    reconstruction path over `db prune-payloads`-blinded ranges.
 
     Dispatches to the altair arm for altair states.
     """
@@ -816,7 +822,9 @@ def per_block_processing(
             collected_sets,
             ops_fn=bellatrix.process_operations,
             post_ops_fn=altair.process_sync_aggregate_step,
-            payload_fn=bellatrix.payload_steps(execution_engine),
+            payload_fn=bellatrix.payload_steps(
+                execution_engine, optimistic=payload_optimistic
+            ),
         )
     if hasattr(state, "previous_epoch_participation"):
         from . import altair

@@ -74,6 +74,11 @@ OVERLAP_RATIO = metrics.gauge(
     "Mean fraction of host-prep time hidden behind device execution in "
     "the last pipelined batch (0 = fully serial)",
 )
+WARMTH = metrics.gauge(
+    "verify_service_warmth",
+    "Compile-prewarm progress gating device admission: 0 = cold (device "
+    "work serves on the host path), 1 = canonical kernel menu loaded",
+)
 CIRCUIT_STATE = metrics.gauge(
     "verify_service_circuit_state",
     "Device circuit breaker: 0=closed 1=open 2=half-open",

@@ -321,6 +321,16 @@ class VerificationService:
         # blocks until the old thread's in-flight batch resolves
         self._work_lock = threading.Lock()
 
+        # admission warm gate: while a compile prewarm is in flight
+        # (BeaconNode.start kicks one before the dispatcher may touch
+        # the device), device work serves on the host path — a fresh
+        # host must never pay a cold XLA compile against live deadlines.
+        # Set by default: standalone services (tests, tools) admit
+        # device work immediately, exactly as before.
+        self._device_ready = threading.Event()
+        self._device_ready.set()
+        M.WARMTH.set(1.0)
+
         breaker_kw = (
             {} if breaker_probe_max is None
             else {"probe_max_sets": breaker_probe_max}
@@ -710,21 +720,58 @@ class VerificationService:
             self._host_verifier = SignatureVerifier("native")
         return self._host_verifier
 
+    # ------------------------------------------------- compile warm gate
+
+    @property
+    def device_ready(self):
+        """False while a compile prewarm gates device admission."""
+        return self._device_ready.is_set()
+
+    def begin_warmup(self):
+        """Close the device admission gate: until `mark_device_ready`,
+        every dispatched batch runs on the host fallback path (the same
+        degrade seam the circuit breaker pins), so prewarm compiles and
+        live traffic never contend for the device."""
+        self._device_ready.clear()
+        M.WARMTH.set(0.0)
+
+    def set_warmth(self, frac):
+        """Prewarm progress callback (0..1) — drives the
+        `verify_service_warmth` gauge; does NOT open the gate."""
+        M.WARMTH.set(round(min(max(float(frac), 0.0), 1.0), 4))
+
+    def mark_device_ready(self):
+        """Open the admission gate (idempotent): the canonical kernel
+        menu is loaded — or prewarm failed and the first real batch pays
+        the compile under the watchdog's busy budget."""
+        self._device_ready.set()
+        M.WARMTH.set(1.0)
+        with self._cv:
+            self._cv.notify_all()
+
     def _active_verifier(self):
-        """Dispatcher-side: the breaker decides whether this batch tries
-        the device (allow_device may transition OPEN -> HALF_OPEN; only
-        the dispatcher thread calls it — circuit.py's contract)."""
+        """Dispatcher-side: the warm gate, then the breaker, decide
+        whether this batch tries the device (allow_device may transition
+        OPEN -> HALF_OPEN; only the dispatcher thread calls it —
+        circuit.py's contract)."""
         if self.backend != "tpu":
             return self.verifier
+        if not self._device_ready.is_set():
+            return self._host()
         if self.breaker.allow_device():
             return self.verifier
         return self._host()
 
     def _degraded_verifier(self):
         """Caller-thread-side (compat wrappers on overflow/shutdown): a
-        READ-ONLY breaker check — a non-CLOSED breaker means the host
-        path, without racing the dispatcher's probe state machine."""
-        if self.backend != "tpu" or self.breaker.state == 0:  # CLOSED
+        READ-ONLY breaker/gate check — a non-CLOSED breaker or a cold
+        warm gate means the host path, without racing the dispatcher's
+        probe state machine."""
+        if self.backend != "tpu":
+            return self.verifier
+        if not self._device_ready.is_set():
+            return self._host()
+        if self.breaker.state == 0:  # CLOSED
             return self.verifier
         return self._host()
 
@@ -1021,6 +1068,7 @@ class VerificationService:
             "queue_wait_p50_ms": pct(0.50) * 1e3,
             "queue_wait_p99_ms": pct(0.99) * 1e3,
             "circuit_state": self.breaker.state,
+            "device_ready": self.device_ready,
             "target_batch": self.target_batch,
             "dispatcher_restarts": self.restarts,
             "overlap_ratio_mean": (

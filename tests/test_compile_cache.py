@@ -1,0 +1,500 @@
+"""Compile-lifecycle subsystem (ISSUE 6): canonical shape planning, the
+persistent AOT executable cache, admission-gated prewarm, and verdict
+stability through cached executables.
+
+The heavy-kernel coverage reuses the (2, 2) batched program the fast
+lane already builds (test_bls_frozen_vectors' device smoke); everything
+else runs against cheap toy kernels so the cache MACHINERY is exercised
+without paying extra multi-minute compiles.
+"""
+
+import json
+import os
+import random
+import threading
+from types import SimpleNamespace
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lighthouse_tpu.crypto.tpu import compile_cache as cc
+from lighthouse_tpu.verify_service import VerificationService
+from lighthouse_tpu.verify_service import metrics as VM
+
+VEC = os.path.join(os.path.dirname(__file__), "vectors", "bls_batch_verify.json")
+
+
+# ------------------------------------------------------------ ShapePlanner
+
+
+def test_planner_is_total_and_bounded(monkeypatch):
+    """Every (n_sets, max_pks) the verify stack can produce lands on the
+    enumerable menu: set axis <= the compile bucket (larger batches are
+    chunked), pubkey axis <= the protocol ceiling."""
+    monkeypatch.delenv("LTPU_SHAPE_SETS_MENU", raising=False)
+    monkeypatch.delenv("LTPU_SHAPE_PKS_MENU", raising=False)
+    p = cc.get_planner()
+    menu = set()
+    for n in range(1, p.bucket + 1):
+        for m in (1, 2, 3, 5, 17, 64, 511, 512, 2048, 4096):
+            shape = p.plan(n, m)
+            assert shape[0] in p.set_menu, shape
+            assert shape[1] in p.pk_menu, shape
+            assert shape[0] >= n and shape[1] >= m
+            menu.add(shape)
+    # bounded and enumerable: everything planned is inside shapes()
+    assert menu <= set(p.shapes())
+    assert len(p.shapes()) == len(p.set_menu) * len(p.pk_menu)
+
+
+def test_planner_floors_pin_chunked_batches():
+    """min_sets/min_pks (the chunked paths) force every chunk of a batch
+    onto ONE canonical shape."""
+    p = cc.get_planner()
+    B = p.bucket
+    assert p.plan(3, 1, min_sets=B, min_pks=8) == (B, 8)
+    # the last short chunk of a chunked batch pads up to the bucket
+    assert p.plan_sets(1, floor=B) == B
+
+
+def test_planner_env_override_and_prewarm_menu(monkeypatch):
+    monkeypatch.setenv("LTPU_SHAPE_SETS_MENU", "4,32")
+    monkeypatch.setenv("LTPU_SHAPE_PKS_MENU", "1,64")
+    monkeypatch.setenv("LTPU_PREWARM_SHAPES", "32x1,4x64")
+    p = cc.get_planner()
+    assert p.set_menu == [4, 32] and p.pk_menu == [1, 64]
+    assert p.plan(2, 2) == (4, 64)
+    assert p.plan(5, 1) == (32, 1)
+    assert p.prewarm_menu == [(32, 1), (4, 64)]
+    monkeypatch.delenv("LTPU_SHAPE_SETS_MENU")
+    monkeypatch.delenv("LTPU_SHAPE_PKS_MENU")
+    monkeypatch.delenv("LTPU_PREWARM_SHAPES")
+    # env restored -> planner rebuilt with defaults
+    assert 2 in cc.get_planner().set_menu
+
+
+def test_verify_service_batch_sizes_land_on_menu():
+    """Acceptance: all batch sizes the verify-service tests exercise map
+    onto canonical shapes — no escape hatch back to unbounded pow-2."""
+    from lighthouse_tpu.verify_service.service import (
+        DEFAULT_MAX_BATCH, DEFAULT_TARGET_BATCH,
+    )
+    from lighthouse_tpu.crypto.tpu import bls as tb
+
+    p = cc.get_planner()
+    B = tb._bucket_sets()
+    for total in list(range(1, 70)) + [DEFAULT_TARGET_BATCH, DEFAULT_MAX_BATCH]:
+        # the chunked entry points cap the set axis at the bucket
+        for chunk in range(1, min(total, B) + 1):
+            assert p.plan_sets(chunk, floor=1 if total <= B else B) in p.set_menu
+
+
+# ---------------------------------------- cached executables, real kernels
+
+
+@pytest.fixture(scope="module")
+def frozen_22_case():
+    with open(VEC) as f:
+        vectors = json.load(f)
+    for case in vectors["cases"]:
+        sets = case["sets"]
+        if (len(sets) == 2 and sets
+                and max(len(s["pubkeys"]) for s in sets) == 2
+                and all(s["pubkeys"] for s in sets)):
+            return case
+    pytest.skip("no (2,2) frozen case")
+
+
+def _load_sets(case):
+    from lighthouse_tpu.crypto.ref import bls as RB
+    from lighthouse_tpu.crypto.ref import curves as C
+
+    sets = []
+    for s in case["sets"]:
+        sig = (
+            None if s["signature"] == C.g2_compress(None).hex()
+            else C.g2_decompress(bytes.fromhex(s["signature"]),
+                                 subgroup_check=False)
+        )
+        pks = [
+            None if pk == C.g1_compress(None).hex()
+            else C.g1_decompress(bytes.fromhex(pk), subgroup_check=False)
+            for pk in s["pubkeys"]
+        ]
+        sets.append(RB.SignatureSet(sig, pks, bytes.fromhex(s["message"])))
+    return sets
+
+
+def test_frozen_verdicts_identical_through_cached_executable(frozen_22_case):
+    """The (2,2) batched program — the one the fast lane already builds —
+    produces the frozen verdict through the compile cache, and again
+    after a simulated restart (memory cleared, executable re-loaded from
+    disk)."""
+    from lighthouse_tpu.crypto.tpu import bls as tb
+
+    cache = cc.get_cache()
+    if not cache.enabled:
+        pytest.skip("compile cache disabled in this environment")
+    sets = _load_sets(frozen_22_case)
+    rng = random.Random(42)
+    got = tb.verify_signature_sets(sets, rng=lambda: rng.getrandbits(64))
+    assert got is frozen_22_case["expect"]
+    assert any(k.startswith("bls_batched_verify@") for k in cache.stats()["loaded"]), (
+        "production path must route through the compile cache"
+    )
+
+    # simulated restart: executables must come back from disk, verdict
+    # byte-identical.  The publish-time round-trip proof can refuse to
+    # write the artifact when earlier deserializations in this process
+    # poisoned XLA:CPU's serialize output (jaxlib 0.4.36 quirk; this
+    # test runs before the deserializing toy tests so a clean process
+    # publishes) — in that degraded mode the verdict must still hold,
+    # but there is no disk entry to count a hit against.
+    published = any(
+        e["current_key"] for e in cache.disk_entries()
+        if e["file"].startswith("bls_batched_verify-")
+    )
+    hits0 = cache.hits
+    cache.clear_memory()
+    rng = random.Random(42)
+    again = tb.verify_signature_sets(sets, rng=lambda: rng.getrandbits(64))
+    assert again is got
+    if published:
+        assert cache.hits > hits0, "restart must deserialize, not recompile"
+    else:
+        pytest.skip("publish-time proof refused the artifact in this "
+                    "(deserialization-polluted) process; verdict held")
+
+
+
+# ----------------------------------------------------------- CompileCache
+
+
+def _toy_kernel(x):
+    return (x * 2 + 1).sum(), x - 3
+
+
+def test_cache_roundtrip_across_simulated_restart(tmp_path):
+    """serialize -> (new-process-simulated) load -> identical results,
+    with the hit/miss counters proving no XLA compile ran the second
+    time."""
+    d = str(tmp_path / "cc")
+    x = jnp.arange(12, dtype=jnp.int32).reshape(3, 4)
+
+    a = cc.CompileCache(cache_dir=d, enabled=True)
+    r1 = a.call("toy", _toy_kernel, (x,))
+    assert a.misses == 1 and a.hits == 0
+    files = [f for f in os.listdir(d) if f.endswith(".aot")]
+    assert len(files) == 1
+
+    # a fresh CompileCache instance = a fresh process's view of the dir
+    b = cc.CompileCache(cache_dir=d, enabled=True)
+    r2 = b.call("toy", _toy_kernel, (x,))
+    assert b.hits == 1 and b.misses == 0, (b.hits, b.misses)
+    assert b.deserialize_failures == 0
+    assert np.asarray(r1[0]) == np.asarray(r2[0])
+    assert np.array_equal(np.asarray(r1[1]), np.asarray(r2[1]))
+    # loaded-entry provenance is visible (the /lighthouse/compile-cache
+    # payload)
+    (info,) = b.stats()["loaded"].values()
+    assert info["source"] == "deserialized"
+
+
+def test_stale_source_fingerprint_reads_as_miss(tmp_path, monkeypatch):
+    """A kernel-source edit (new fingerprint) makes old artifacts
+    invisible: the cache recompiles instead of loading stale binaries."""
+    d = str(tmp_path / "cc")
+    x = jnp.ones((4,), jnp.float32)
+    a = cc.CompileCache(cache_dir=d, enabled=True)
+    a.call("toy", _toy_kernel, (x,))
+    assert a.misses == 1
+
+    monkeypatch.setattr(cc, "_kernel_source_fingerprint", lambda: "deadbeef")
+    b = cc.CompileCache(cache_dir=d, enabled=True)
+    assert b.fingerprint() != a.fingerprint()
+    b.call("toy", _toy_kernel, (x,))
+    assert b.misses == 1 and b.hits == 0
+    # publishing under the new fingerprint garbage-collects the
+    # superseded sibling: exactly one entry remains, and it is current
+    entries = b.disk_entries()
+    assert len(entries) == 1 and entries[0]["current_key"]
+
+
+def test_corrupt_entry_falls_back_to_compile(tmp_path):
+    """Deserialize failure (torn/corrupt file) recompiles and heals the
+    entry — verification is never down because caching is."""
+    d = str(tmp_path / "cc")
+    x = jnp.ones((2, 2), jnp.float32)
+    a = cc.CompileCache(cache_dir=d, enabled=True)
+    r1 = a.call("toy", _toy_kernel, (x,))
+    (name,) = [f for f in os.listdir(d) if f.endswith(".aot")]
+    with open(os.path.join(d, name), "wb") as f:
+        f.write(b"\x00garbage")
+
+    b = cc.CompileCache(cache_dir=d, enabled=True)
+    r2 = b.call("toy", _toy_kernel, (x,))
+    assert b.deserialize_failures == 1 and b.misses == 1
+    assert np.asarray(r1[0]) == np.asarray(r2[0])
+    # healed: a third instance loads clean
+    c = cc.CompileCache(cache_dir=d, enabled=True)
+    c.call("toy", _toy_kernel, (x,))
+    assert c.hits == 1 and c.deserialize_failures == 0
+
+
+def test_disabled_cache_writes_nothing(tmp_path):
+    d = str(tmp_path / "cc")
+    a = cc.CompileCache(cache_dir=d, enabled=False)
+    a.call("toy", _toy_kernel, (jnp.ones((2,), jnp.float32),))
+    assert not os.path.exists(d) or not os.listdir(d)
+
+
+def test_concurrent_loads_share_one_executable(tmp_path):
+    d = str(tmp_path / "cc")
+    a = cc.CompileCache(cache_dir=d, enabled=True)
+    x = jnp.ones((8,), jnp.float32)
+    results = []
+    traces = []
+
+    def counting_kernel(y):
+        traces.append(1)            # jax traces once per COMPILE
+        return _toy_kernel(y)
+
+    def worker():
+        results.append(a.load_or_compile("toy", counting_kernel, (x,)))
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len({id(r) for r in results}) == 1
+    # in-flight dedup: the 7 losers waited on the winner's compile
+    # instead of paying their own
+    assert len(traces) == 1 and a.misses == 1
+
+
+# ------------------------------------------------------ admission warm gate
+
+
+def mk_set():
+    return SimpleNamespace(poison=False)
+
+
+class FakeDeviceVerifier:
+    backend = "tpu"
+
+    def __init__(self):
+        self.calls = 0
+        self.on_device_fallback = None
+
+    def verify_signature_sets(self, sets, priority=None):
+        self.calls += 1
+        return True
+
+    def verify_signature_sets_per_set(self, sets, priority=None):
+        self.calls += 1
+        return [True] * len(list(sets))
+
+
+class FakeHostVerifier(FakeDeviceVerifier):
+    backend = "native"
+
+
+def test_admission_gate_no_device_dispatch_before_warm():
+    """Acceptance: while the warm gate is closed every dispatched batch
+    runs on the host fallback; opening the gate admits device work —
+    and the warmth gauge tracks the transition."""
+    dev, host = FakeDeviceVerifier(), FakeHostVerifier()
+    svc = VerificationService(dev, host_verifier=host, target_batch=1)
+    try:
+        assert svc.device_ready
+        svc.begin_warmup()
+        assert not svc.device_ready
+        assert VM.WARMTH.value == 0.0
+
+        assert svc.verify_signature_sets([mk_set()]) is True
+        assert host.calls == 1 and dev.calls == 0, (host.calls, dev.calls)
+
+        svc.set_warmth(0.5)
+        assert VM.WARMTH.value == 0.5
+        # caller-thread degrade path honors the gate too
+        assert svc._degraded_verifier() is host
+
+        svc.mark_device_ready()
+        assert VM.WARMTH.value == 1.0
+        assert svc.verify_signature_sets([mk_set()]) is True
+        assert dev.calls == 1, "device admitted after warm"
+    finally:
+        svc.stop()
+
+
+def test_node_prewarm_only_engages_for_device_backend():
+    """The assembly-time gate close is a no-op for host backends (no
+    compile tax) and honors the LTPU_PREWARM=0 opt-out; for a
+    device-backed service the gate shuts at construction (before the
+    wire can lazy-start the dispatcher) and start()'s _begin_prewarm
+    spawns the warm pass that reopens it."""
+    from lighthouse_tpu.beacon.node import BeaconNode
+
+    node = BeaconNode.__new__(BeaconNode)  # no full assembly needed
+    node.chain = SimpleNamespace(verifier=None)
+    node.executor = SimpleNamespace(
+        spawn=lambda *a, **k: pytest.fail("must not spawn"),
+        shutting_down=False,
+    )
+    node.prewarm_started = None
+
+    host_svc = VerificationService(FakeHostVerifier(), target_batch=1)
+    try:
+        node._prewarm_armed = node._close_gate_for_prewarm(host_svc)
+        assert node._prewarm_armed is False
+        assert node._begin_prewarm(host_svc) is False
+        assert host_svc.device_ready          # gate untouched
+    finally:
+        host_svc.stop()
+
+    dev_svc = VerificationService(FakeDeviceVerifier(), target_batch=1)
+    try:
+        os.environ["LTPU_PREWARM"] = "0"
+        try:
+            node._prewarm_armed = node._close_gate_for_prewarm(dev_svc)
+            assert node._prewarm_armed is False
+        finally:
+            del os.environ["LTPU_PREWARM"]
+        assert dev_svc.device_ready
+        # with prewarm enabled, the gate closes at assembly and the
+        # start()-time half spawns the warm task
+        spawned = []
+        node.executor = SimpleNamespace(
+            spawn=lambda fn, name, **k: spawned.append(name),
+            shutting_down=False,
+        )
+        node._prewarm_armed = node._close_gate_for_prewarm(dev_svc)
+        assert node._prewarm_armed is True
+        assert not dev_svc.device_ready, "gate shut at assembly"
+        assert node._begin_prewarm(dev_svc) is True
+        assert spawned == ["compile_prewarm"]
+        dev_svc.mark_device_ready()
+    finally:
+        dev_svc.stop()
+
+
+def test_compile_cache_http_route():
+    """GET /lighthouse/compile-cache serves the cache stats, the planner
+    menu, the disk entry table, and the verify_service admission gate."""
+    import urllib.request
+
+    from lighthouse_tpu.api.http_api import BeaconApiServer
+    from lighthouse_tpu.beacon.chain import BeaconChain
+    from lighthouse_tpu.crypto.backend import SignatureVerifier
+    from lighthouse_tpu.testing.harness import Harness
+    from lighthouse_tpu.types import ChainSpec, MinimalPreset
+
+    h = Harness(8, ChainSpec(preset=MinimalPreset))
+    chain = BeaconChain(h.state.copy(), ChainSpec(preset=MinimalPreset),
+                        verifier=SignatureVerifier("fake"))
+    svc = VerificationService(FakeDeviceVerifier(), target_batch=1)
+    chain.verifier = svc
+    server = BeaconApiServer(chain).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/lighthouse/compile-cache") as r:
+            data = json.load(r)["data"]
+        assert data["fingerprint"] and "hits" in data and "misses" in data
+        planner = data["planner"]
+        assert planner["bucket"] == planner["set_menu"][-1]
+        assert planner["programs_bounded_at"] == (
+            len(planner["set_menu"]) * len(planner["pk_menu"])
+        )
+        assert isinstance(data["disk"], list)
+        assert data["device_ready"] is True
+        svc.begin_warmup()
+        with urllib.request.urlopen(base + "/lighthouse/compile-cache") as r:
+            assert json.load(r)["data"]["device_ready"] is False
+        svc.mark_device_ready()
+    finally:
+        svc.stop()
+        server.stop()
+
+
+def _toy_per_set(x):
+    return x.sum(axis=-1), x + 1
+
+
+def _patch_toy_kernels(monkeypatch):
+    """Point prewarm's kernel menu at cheap toy kernels: the cache and
+    prewarm MACHINERY (keys, counters, progress, fresh-process loads)
+    is what these tests pin down — the real BLS programs ride the same
+    path and are covered at the fast lane's (2,2) shape."""
+    from lighthouse_tpu.crypto.tpu import bls as tb
+
+    def fake_specs(n, m, per_set=True):
+        x = jnp.zeros((n, m), jnp.float32)
+        specs = [("toy_batched", _toy_kernel, (x,), f"{n}x{m}")]
+        if per_set:
+            specs.append(("toy_per_set", _toy_per_set, (x,), f"{n}x{m}"))
+        return specs
+
+    monkeypatch.setattr(tb, "kernel_specs", fake_specs)
+
+
+def test_prewarm_second_process_pays_zero_compiles(tmp_path, monkeypatch):
+    """Acceptance: with a populated cache, a fresh process pre-warms
+    every canonical shape with hits only (no XLA compilation), asserted
+    via the hit/miss counters.  Toy kernels keep it cheap; the mechanism
+    is kernel-independent."""
+    monkeypatch.setenv("LTPU_PREWARM_SHAPES", "1x1")
+    _patch_toy_kernels(monkeypatch)
+    d = str(tmp_path / "cc")
+
+    first = cc.CompileCache(cache_dir=d, enabled=True)
+    s1 = cc.prewarm(cache=first)
+    assert s1["programs"] == 2          # batched + per-set kernels
+    assert s1["cache_misses"] + s1["cache_hits"] == 2
+
+    fractions = []
+    second = cc.CompileCache(cache_dir=d, enabled=True)
+    s2 = cc.prewarm(cache=second, progress=fractions.append)
+    assert s2["cache_hits"] == 2 and s2["cache_misses"] == 0
+    assert s2["cache_hit_rate"] == 1.0
+    assert fractions == [0.5, 1.0]
+    # the cached start must be far cheaper than the cold one
+    assert s2["wall_s"] <= max(0.25 * s1["wall_s"], 0.5)
+
+
+@pytest.mark.slow
+def test_real_kernel_prewarm_roundtrip(tmp_path, monkeypatch):
+    """Slow lane: the REAL kernel menu at (2,2) — first prewarm compiles
+    (or loads via the shared XLA cache), a fresh-instance prewarm is
+    pure deserialization, well under the 25% acceptance bound."""
+    monkeypatch.setenv("LTPU_PREWARM_SHAPES", "2x2")
+    d = str(tmp_path / "cc")
+    s1 = cc.prewarm(cache=cc.CompileCache(cache_dir=d, enabled=True))
+    s2 = cc.prewarm(cache=cc.CompileCache(cache_dir=d, enabled=True))
+    assert s2["cache_misses"] == 0 and s2["cache_hit_rate"] == 1.0
+    assert s2["wall_s"] <= max(0.25 * s1["wall_s"], 2.0)
+
+
+def test_compile_bench_tool_records_speedup(tmp_path, monkeypatch):
+    """tools/compile_bench.py end-to-end at the toy shape: records
+    prewarm_cold_s / prewarm_cached_s / cache_hit_rate and a
+    warm-start speedup with cached <= 25% of cold."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "compile_bench.py")
+    spec = importlib.util.spec_from_file_location("compile_bench", path)
+    cb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cb)
+
+    _patch_toy_kernels(monkeypatch)
+    summary = cb.bench_shapes(
+        [(1, 1)], cache_dir=str(tmp_path / "cc"), subprocess_load=False
+    )
+    assert summary["cache_hit_rate"] == 1.0
+    assert summary["prewarm_cached_s"] <= max(
+        0.25 * summary["prewarm_cold_s"], 0.5
+    )
+    assert summary["warm_start_speedup"] is None or summary["warm_start_speedup"] >= 1.0
+    assert summary["programs"] == 2

@@ -400,6 +400,123 @@ def test_store_compact_crash_safety(tmp_path, monkeypatch):
     reopened.close()
 
 
+def test_store_fsync_policy_validation_and_env(tmp_path, monkeypatch):
+    from lighthouse_tpu.beacon.store import PyFileKV
+
+    with pytest.raises(ValueError, match="LTPU_STORE_FSYNC"):
+        PyFileKV(str(tmp_path / "bad.log"), fsync_policy="sometimes")
+    monkeypatch.setenv("LTPU_STORE_FSYNC", "always")
+    kv = PyFileKV(str(tmp_path / "env.log"))
+    assert kv.fsync_policy == "always"
+    kv.close()
+
+
+def test_store_fsync_always_syncs_every_put(tmp_path, monkeypatch):
+    from lighthouse_tpu.beacon.store import PyFileKV
+
+    kv = PyFileKV(str(tmp_path / "a.log"), fsync_policy="always")
+    calls = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(
+        os, "fsync", lambda fd: (calls.append(fd), real_fsync(fd))[1]
+    )
+    for i in range(5):
+        kv.put(f"k{i}".encode(), b"v")
+    assert len(calls) == 5
+    kv.delete(b"k0")
+    assert len(calls) == 6                  # tombstones are durable too
+    kv.close()
+
+
+def test_store_fsync_group_commit_amortizes_a_burst(tmp_path, monkeypatch):
+    """Satellite: group policy — a burst of puts inside one interval
+    rides at most one fsync (after the interval-opening sync), close
+    flushes the dirty window, and a `batch` is ONE sync no matter how
+    many ops it carries."""
+    from lighthouse_tpu.beacon.store import PyFileKV
+
+    kv = PyFileKV(str(tmp_path / "g.log"), fsync_policy="group",
+                  fsync_interval=3600.0)
+    calls = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(
+        os, "fsync", lambda fd: (calls.append(fd), real_fsync(fd))[1]
+    )
+    for i in range(50):
+        kv.put(f"k{i}".encode(), bytes(64))
+    # monotonic >> _last_fsync==0 opens the interval on the first put;
+    # the other 49 ride the window
+    assert len(calls) == 1
+    assert kv._dirty
+    n0 = len(calls)
+    kv.batch([("put", f"b{i}".encode(), b"v") for i in range(10)])
+    assert len(calls) == n0                 # window still open: no sync
+    kv.close()                              # dirty window flushed on close
+    assert len(calls) >= n0 + 1
+
+    # under `always`, a 10-op batch is still ONE group-committed sync
+    kv2 = PyFileKV(str(tmp_path / "b.log"), fsync_policy="always")
+    calls.clear()
+    kv2.batch([("put", f"c{i}".encode(), b"v") for i in range(10)])
+    assert len(calls) == 1
+    kv2.close()
+
+
+def test_store_fsync_group_straggler_timer_bounds_idle_tail(tmp_path):
+    """A write landing inside the group window must become durable
+    within one interval even when NO later write arrives to piggyback
+    the sync on — the one-shot straggler timer fires."""
+    from lighthouse_tpu.beacon.store import PyFileKV
+
+    kv = PyFileKV(str(tmp_path / "s.log"), fsync_policy="group",
+                  fsync_interval=0.05)
+    kv.put(b"a", b"1")              # opens the interval: synced
+    kv.put(b"b", b"2")              # inside the window: buffered
+    assert kv._dirty and kv._group_timer is not None
+    deadline = time.monotonic() + 5.0
+    while kv._dirty and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not kv._dirty, "straggler flush fired without another write"
+    kv.close()
+
+
+def test_store_fsync_group_crash_window_bounded(tmp_path):
+    """Satellite crash-window proof: under `group`, losing everything
+    after the last fsync leaves a log that replays cleanly to exactly
+    the synced prefix — records before the sync survive, the unsynced
+    tail is gone but never torn."""
+    from lighthouse_tpu.beacon.store import PyFileKV
+
+    path = str(tmp_path / "c.log")
+    kv = PyFileKV(path, fsync_policy="group", fsync_interval=3600.0)
+    kv.put(b"durable", b"A" * 100)          # opens the interval: synced
+    synced_size = os.path.getsize(path)
+    kv.put(b"window", b"B" * 100)           # buffered: inside the window
+    # crash now: the OS never saw the tail (it sits in the user-space
+    # append buffer) — simulate by truncating a copy to the synced size
+    crashed = str(tmp_path / "crashed.log")
+    with open(path, "rb") as f:
+        blob = f.read(synced_size)
+    with open(crashed, "wb") as f:
+        f.write(blob)
+    kv.close()
+
+    survivor = PyFileKV(crashed, fsync_policy="group")
+    assert survivor.get(b"durable") == b"A" * 100
+    assert survivor.get(b"window") is None  # lost, bounded by the window
+    survivor.put(b"new", b"v")              # and the log is still usable
+    assert survivor.get(b"new") == b"v"
+    survivor.close()
+
+    # a torn half-record past the synced prefix replays to the same state
+    torn = str(tmp_path / "torn.log")
+    with open(torn, "wb") as f:
+        f.write(blob + b"\x07\x00\x00\x00")  # 4 of 8 header bytes
+    reopened = PyFileKV(torn)
+    assert reopened.get(b"durable") == b"A" * 100
+    reopened.close()
+
+
 def test_wire_reqresp_failpoints():
     from lighthouse_tpu.network.wire import WireError, WireNode
 
@@ -587,6 +704,111 @@ def test_watchdog_restarts_wedged_processor():
     assert proc.results and proc.results[0][:2] == ("block", True)
     assert proc.restarts == 1
     executor.shutdown("test done")
+
+
+def test_watchdog_restarts_wedged_slot_timer():
+    """Satellite: the slot timer is a watchdog target — a timer loop
+    wedged inside clock.now() goes heartbeat-stale, restart_slot_timer
+    supersedes it generation-wise, and ticks resume under the fresh
+    thread while the old one exits at its next pass."""
+    from lighthouse_tpu.beacon.node import BeaconNode
+    from lighthouse_tpu.utils.task_executor import TaskExecutor
+
+    node = BeaconNode.__new__(BeaconNode)
+    ticks = []
+    wedge = threading.Event()
+    slots = iter(range(1, 10**6))
+
+    def now():
+        if wedge.is_set():
+            time.sleep(30.0)            # the wedged generation never returns
+        return next(slots)
+
+    node.chain = SimpleNamespace(on_tick=ticks.append)
+    node.clock = SimpleNamespace(now=now, duration_to_next_slot=lambda: 0.01)
+    node.executor = TaskExecutor()
+    node.timer_heartbeat = None
+    node._timer_gen = 0
+    node._timer_tick_lock = threading.Lock()
+    node.timer_tick_started = None
+    node.timer_restarts = 0
+
+    wd = Watchdog()
+    wd.register("slot_timer", heartbeat=lambda: node.timer_heartbeat,
+                restart=node.restart_slot_timer, budget=0.2)
+    node.executor.spawn(node._timer_loop, "slot_timer")
+    deadline = time.monotonic() + 5.0
+    while not ticks and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert ticks, "timer ticking before the wedge"
+
+    wedge.set()
+    time.sleep(0.4)                     # heartbeat goes stale past budget
+    wedge.clear()
+    n0 = len(ticks)
+    assert wd.check_once() == ["slot_timer"]
+    assert node.timer_restarts == 1
+    deadline = time.monotonic() + 5.0
+    while len(ticks) <= n0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(ticks) > n0, "fresh generation resumed ticking"
+    node.executor.shutdown("test done")
+
+
+def test_wire_heartbeat_watchdog_restart_supersedes():
+    """Satellite: the gossip heartbeat thread stamps beat_stamp and can
+    be superseded by restart_heartbeat_thread — mesh maintenance
+    continues under the replacement."""
+    from lighthouse_tpu.network.wire import WireNode
+
+    node = WireNode()
+    try:
+        deadline = time.monotonic() + 5.0
+        while node.beat_stamp is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert node.beat_stamp is not None, "heartbeat stamping"
+
+        assert node.restart_heartbeat_thread() is True
+        assert node.heartbeat_restarts == 1
+        fresh = node._heartbeat_thread
+        assert fresh.is_alive()
+        stamp0 = node.beat_stamp
+        deadline = time.monotonic() + 5.0
+        while (node.beat_stamp == stamp0 and
+               time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert node.beat_stamp != stamp0, "replacement generation beats"
+    finally:
+        node.stop()
+        assert node.restart_heartbeat_thread() is False  # stopped: no-op
+
+
+def test_wire_reaps_reader_stalled_in_dispatch():
+    """Satellite: a reader thread stuck INSIDE one frame dispatch past
+    the stall budget costs that peer its connection (socket teardown
+    unblocks the thread); an idle reader (blocked on recv, no dispatch
+    in flight) is never reaped."""
+    from lighthouse_tpu.network.wire import WireNode
+
+    a, b = WireNode(), WireNode()
+    try:
+        pid = a.dial("127.0.0.1", b.port)
+        assert a.request_status(pid) is not None
+        peer = a.peers[pid]
+
+        # idle connection: dispatch_started is None -> untouched
+        a.reader_stall_budget = 0.05
+        a._reap_stalled_readers()
+        assert pid in a.peers
+
+        # wedged dispatch: stamp far in the past -> reaped
+        peer.dispatch_started = time.monotonic() - 1.0
+        a._reap_stalled_readers()
+        assert pid not in a.peers
+        assert not peer._alive
+    finally:
+        a.stop()
+        b.stop()
 
 
 # ------------------------------------------------------- http control

@@ -130,6 +130,7 @@ def test_metric_name_lint():
     import lighthouse_tpu.beacon.block_times_cache  # noqa: F401
     import lighthouse_tpu.beacon.validator_monitor  # noqa: F401
     import lighthouse_tpu.crypto.tpu.bls  # noqa: F401 (pubkey-cache counters)
+    import lighthouse_tpu.crypto.tpu.compile_cache  # noqa: F401 (AOT cache)
     import lighthouse_tpu.utils.failpoints  # noqa: F401 (hit counters)
     import lighthouse_tpu.utils.retries  # noqa: F401 (retry outcomes)
     import lighthouse_tpu.utils.watchdog  # noqa: F401 (restart counters)
@@ -164,6 +165,18 @@ def test_metric_name_lint():
         "lighthouse_retry_total",
         "lighthouse_watchdog_restarts_total",
         "lighthouse_watchdog_heartbeat_age_seconds",
+    } <= names, sorted(names)
+    # the compile-lifecycle families (ISSUE 6) must be registered and
+    # linted: AOT cache hit/miss/duration/fallback counters, the shape
+    # planner's off-menu guard, and the admission warmth gauge
+    assert {
+        "compile_cache_hits_total",
+        "compile_cache_misses_total",
+        "compile_cache_deserialize_ms",
+        "compile_cache_compile_ms",
+        "compile_cache_deserialize_failures_total",
+        "compile_cache_offmenu_total",
+        "verify_service_warmth",
     } <= names, sorted(names)
 
 
