@@ -546,6 +546,35 @@ def config_verify_service():
     except Exception as e:
         note("verify_service_chaos_error", error=str(e)[:300])
 
+    # remote verification fabric: offered load against the simulated
+    # verifier pool under a 30% per-call fault rate, all-targets-die
+    # failover time, and the lying-verifier audit catch rate
+    # (tools/chaos_bench.py --remote; ISSUE 8's acceptance numbers)
+    try:
+        cpath = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "tools", "chaos_bench.py",
+        )
+        cspec = importlib.util.spec_from_file_location("chaos_bench_r", cpath)
+        cb = importlib.util.module_from_spec(cspec)
+        cspec.loader.exec_module(cb)
+        try:
+            pt = cb.run_remote_point(
+                fault_rate=0.3, submitters=4, offered_rps=500.0,
+                duration=1.2, seed=1234, n_targets=2,
+            )
+            note("verify_service_remote_point", **pt)
+            _VS_SUMMARY["remote_goodput"] = pt["remote_goodput"]
+            _VS_SUMMARY["remote_lost_verdicts"] = pt["lost"]
+            _VS_SUMMARY["failover_seconds"] = pt["failover_seconds"]
+            audit = cb.measure_audit_catch(seed=1234)
+            note("verify_service_remote_audit", **audit)
+            _VS_SUMMARY["audit_catch_rate"] = audit["audit_catch_rate"]
+        finally:
+            cb.failpoints.reset()
+    except Exception as e:
+        note("verify_service_remote_error", error=str(e)[:300])
+
     note("verify_service_sweep", **_VS_SUMMARY)
 
 

@@ -798,6 +798,22 @@ class _Handler(JsonHandler):
 
             return self._json({"data": failpoints.snapshot()})
 
+        if path == "/lighthouse/remote-verify":
+            # remote verification fabric: per-target health, breaker
+            # state, latency EWMA, and audit/quarantine stats — the
+            # operator view of "which verifier host is serving me and
+            # which one is benched"
+            pool = getattr(
+                getattr(chain, "verifier", None), "remote_pool", None
+            )
+            if pool is None:
+                return self._json({"data": {
+                    "enabled": False, "targets": [],
+                }})
+            data = pool.snapshot()
+            data["enabled"] = True
+            return self._json({"data": data})
+
         if path == "/lighthouse/compile-cache":
             # compile-lifecycle status: the persistent AOT executable
             # cache (hits/misses/loaded programs), the canonical shape

@@ -117,6 +117,19 @@ class BeaconNode:
                 ),
                 busy_budget=self.watchdog_busy_budget,
             )
+        pool = getattr(verifier, "remote_pool", None)
+        if pool is not None and hasattr(pool, "restart_remote_client"):
+            # the remote dispatch/hedge worker is watched like the local
+            # dispatcher: it stamps `heartbeat` every pass and a wedged
+            # thread is superseded generation-wise with the job queue
+            # intact (verify_batch's bounded wait already guarantees a
+            # wedge only costs remote capacity, never local progress)
+            self.watchdog.register(
+                "remote_verify",
+                heartbeat=lambda: pool.heartbeat,
+                restart=pool.restart_remote_client,
+                budget=self.watchdog_budget,
+            )
         # ROADMAP robustness follow-ons: the slot timer and the wire's
         # gossip heartbeat/reader threads are watched like the worker
         # loops (a wedged timer stalls on_tick; a wedged gossip
@@ -207,6 +220,9 @@ class BeaconNode:
     def stop(self):
         self.watchdog.stop()
         self.executor.shutdown("node stop")
+        pool = getattr(self.chain.verifier, "remote_pool", None)
+        if pool is not None:
+            pool.stop()
         stop_verify = getattr(self.chain.verifier, "stop", None)
         if stop_verify is not None:
             stop_verify()
@@ -369,6 +385,7 @@ class ClientBuilder:
         self._disc_boot = None
         self._disc_port = 0
         self._disc_sk = None
+        self._remote_verifiers = None   # None = read LTPU_REMOTE_VERIFIERS
 
     def genesis_state(self, state):
         self._genesis_state = state
@@ -424,6 +441,13 @@ class ClientBuilder:
         self._slasher = enabled
         return self
 
+    def remote_verifiers(self, targets):
+        """Place verification on a remote verifier pool (host:port list)
+        as the first backend tier; an empty list disables the fabric
+        even when LTPU_REMOTE_VERIFIERS is set."""
+        self._remote_verifiers = list(targets)
+        return self
+
     def build(self) -> BeaconNode:
         assert self._genesis_state is not None, "a genesis/checkpoint state is required"
         from ..verify_service import VerificationService
@@ -466,7 +490,12 @@ class ClientBuilder:
             from ..network.router import Router
             from ..network.wire import WireNode
 
-            wire = WireNode(chain, port=self._net_port)
+            # verify_service passed through: the node SERVES the
+            # verifier role for peers' VERIFY_REQ batches (with its
+            # normal priority/shed/admission semantics) in addition to
+            # consuming remote verification itself
+            wire = WireNode(chain, port=self._net_port,
+                            verify_service=verify_service)
             router = Router(
                 wire.peer_id, chain, processor,
                 wire.bus_view(), wire.reqresp_view(),
@@ -493,6 +522,22 @@ class ClientBuilder:
                     log.debug("light-client gossip failed: %s", e)
 
             chain.on_light_client_update = _publish_light_client
+
+            # remote verification fabric (verify_service/remote.py):
+            # targets from the builder, else LTPU_REMOTE_VERIFIERS
+            # (comma-separated host:port).  The pool rides this node's
+            # own wire and audits against the local host path.
+            targets = self._remote_verifiers
+            if targets is None:
+                env = os.environ.get("LTPU_REMOTE_VERIFIERS", "")
+                targets = [t.strip() for t in env.split(",") if t.strip()]
+            if targets:
+                from ..verify_service import RemoteVerifierPool, WireTransport
+
+                verify_service.attach_remote(RemoteVerifierPool(
+                    targets, WireTransport(wire),
+                    audit_verifier=SignatureVerifier("native"),
+                ))
         discovery = None
         if self._disc_boot is not None and wire is not None:
             import secrets

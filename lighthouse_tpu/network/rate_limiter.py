@@ -43,6 +43,10 @@ DEFAULT_QUOTAS = {
     "blocks_by_range": Quota(1024, 10.0),   # tokens = blocks requested
     "blocks_by_root": Quota(128, 10.0),     # tokens = roots requested
     "gossip_publish": Quota(200, 10.0),     # frames; flood-control
+    # batch verification charged by SET count (like blocks_by_range's
+    # block-count charging): one giant batch costs what many small ones
+    # do, so a single client cannot monopolize the verifier host
+    "verify_batch": Quota(8192, 10.0),
 }
 
 

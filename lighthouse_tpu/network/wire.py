@@ -57,6 +57,8 @@ GRAFT = 11     # gossipsub mesh: add me to your mesh for <topic>
 PRUNE = 12     # gossipsub mesh: drop me from your mesh for <topic>
 IHAVE = 13     # lazy gossip: message ids I hold for <topic> (to non-mesh)
 IWANT = 14     # lazy gossip: send me these message ids
+VERIFY_REQ = 15   # batch-verify request: compressed SignatureSet batch
+VERIFY_RESP = 16  # batch-verify response: per-set verdicts + load hint
 
 # mesh degree bounds (gossipsub D / D_lo / D_hi; service/gossipsub defaults)
 MESH_D = 6
@@ -100,6 +102,14 @@ MAX_FRAME = 1 << 24
 # a streamed response may carry at most this many chunk frames (server
 # sends <= 1024 blocks per BlocksByRange; margin for other methods)
 MAX_RESPONSE_CHUNKS = 2048
+
+# batch-verify codec caps: a malformed or hostile frame must fail the
+# typed-WireError path (responded as R_INVALID_REQUEST), never allocate
+# past these bounds or wedge the reader thread
+MAX_VERIFY_SETS = 1024            # sets per batch-verify request
+MAX_VERIFY_PUBKEYS = 512          # pubkeys per signature set
+MAX_VERIFY_BODY = 1 << 22         # encoded request payload bytes (4 MiB)
+MAX_VERIFY_INFLIGHT = 8           # concurrent verify-serve threads
 
 
 class StatusMessage(Container):
@@ -167,6 +177,173 @@ def _read_uvarint(sock):
         shift += 7
         if shift > 35:
             raise WireError("frame length varint too long")
+
+
+class PubkeyDecodeCache:
+    """Compressed-pubkey decode cache for the batch-verify codec.
+
+    `g1_decompress` with the subgroup check is a full scalar
+    multiplication per point — far more than the rest of a request's
+    decode combined — while verifier traffic re-sends the same validator
+    pubkeys every slot.  Keyed on the 48-byte compressed encoding (the
+    same keying as crypto/tpu/bls.PubkeyLimbCache), a hit skips both the
+    square root and the subgroup check; the check ran when the entry was
+    admitted, and the compressed bytes are self-authenticating."""
+
+    def __init__(self, cap=65536):
+        self.cap = int(cap)
+        self.hits = 0
+        self.misses = 0
+        self._entries = OrderedDict()
+        self._lock = threading.Lock()
+
+    def decompress(self, data):
+        data = bytes(data)
+        with self._lock:
+            if data in self._entries:
+                self.hits += 1
+                self._entries.move_to_end(data)
+                return self._entries[data]
+        from ..crypto.ref import curves as _curves
+
+        pt = _curves.g1_decompress(data, subgroup_check=True)
+        with self._lock:
+            self.misses += 1
+            self._entries[data] = pt
+            while len(self._entries) > self.cap:
+                self._entries.popitem(last=False)
+        return pt
+
+
+PK_DECODE_CACHE = PubkeyDecodeCache()
+
+# batch-verify priority classes ride the wire as one byte; the table
+# must stay aligned with verify_service.PRIORITY_CLASSES
+_VERIFY_CLASSES = ("block", "aggregate", "attestation", "discovery")
+_VERIFY_CLASS_INDEX = {name: i for i, name in enumerate(_VERIFY_CLASSES)}
+
+
+def encode_verify_request(sets, priority="attestation", deadline_ms=250):
+    """Serialize a SignatureSet batch for the VERIFY_REQ frame.
+
+    Layout: u8 priority || u32 deadline_ms || u16 n_sets, then per set:
+    u8 flags (bit0 = has signature) || [96B compressed G2 signature] ||
+    32B message || u16 n_pubkeys || n × 48B compressed G1 pubkeys.
+    Points travel compressed (the canonical 2G2T-style outsourcing
+    interface: constant-size elements, verifier-side decompression)."""
+    from ..crypto.ref import curves as _curves
+
+    sets = list(sets)
+    if not sets or len(sets) > MAX_VERIFY_SETS:
+        raise WireError(f"batch of {len(sets)} sets outside [1, {MAX_VERIFY_SETS}]")
+    cls = _VERIFY_CLASS_INDEX.get(priority, 2)
+    out = [struct.pack("<BIH", cls, max(0, int(deadline_ms)), len(sets))]
+    for s in sets:
+        msg = bytes(s.message)
+        if len(msg) != 32:
+            raise WireError(f"message must be 32 bytes, got {len(msg)}")
+        pks = list(s.pubkeys)
+        if not 0 < len(pks) <= MAX_VERIFY_PUBKEYS:
+            raise WireError(f"{len(pks)} pubkeys outside [1, {MAX_VERIFY_PUBKEYS}]")
+        if s.signature is not None:
+            out.append(b"\x01" + _curves.g2_compress(s.signature))
+        else:
+            out.append(b"\x00")
+        out.append(msg)
+        out.append(struct.pack("<H", len(pks)))
+        for pk in pks:
+            out.append(_curves.g1_compress(pk))
+    payload = b"".join(out)
+    if len(payload) > MAX_VERIFY_BODY:
+        raise WireError(f"encoded batch {len(payload)}B exceeds {MAX_VERIFY_BODY}B cap")
+    return payload
+
+
+def decode_verify_request(payload):
+    """Parse a VERIFY_REQ payload -> (sets, priority, deadline_s).
+
+    Every bound is enforced BEFORE the allocation it guards and every
+    malformed encoding raises the typed WireError (surfaced to the peer
+    as R_INVALID_REQUEST) — a hostile frame must not wedge or kill the
+    serving node."""
+    from ..crypto.ref import curves as _curves
+    from ..crypto.ref.bls import SignatureSet
+
+    if len(payload) > MAX_VERIFY_BODY:
+        raise WireError("verify request exceeds size cap")
+    if len(payload) < 7:
+        raise WireError("truncated verify request header")
+    cls, deadline_ms, n_sets = struct.unpack("<BIH", payload[:7])
+    if cls >= len(_VERIFY_CLASSES):
+        raise WireError(f"unknown priority class {cls}")
+    if not 0 < n_sets <= MAX_VERIFY_SETS:
+        raise WireError(f"{n_sets} sets outside [1, {MAX_VERIFY_SETS}]")
+    pos, end = 7, len(payload)
+
+    def take(n, what):
+        nonlocal pos
+        if pos + n > end:
+            raise WireError(f"truncated verify request ({what})")
+        chunk = payload[pos:pos + n]
+        pos += n
+        return chunk
+
+    sets = []
+    for _ in range(n_sets):
+        flags = take(1, "flags")[0]
+        if flags > 1:
+            raise WireError(f"bad set flags {flags:#x}")
+        sig = None
+        if flags & 1:
+            try:
+                # no subgroup check, mirroring the gossip decode path
+                # (state_processing/signature_sets._sig): batch
+                # verification subgroup-checks every signature itself
+                sig = _curves.g2_decompress(
+                    take(96, "signature"), subgroup_check=False
+                )
+            except ValueError as e:
+                raise WireError(f"bad signature encoding: {e}") from e
+        msg = take(32, "message")
+        n_pks = struct.unpack("<H", take(2, "pubkey count"))[0]
+        if not 0 < n_pks <= MAX_VERIFY_PUBKEYS:
+            raise WireError(f"{n_pks} pubkeys outside [1, {MAX_VERIFY_PUBKEYS}]")
+        pks = []
+        for _ in range(n_pks):
+            try:
+                pks.append(PK_DECODE_CACHE.decompress(take(48, "pubkey")))
+            except ValueError as e:
+                raise WireError(f"bad pubkey encoding: {e}") from e
+        sets.append(SignatureSet(sig, pks, msg))
+    if pos != end:
+        raise WireError(f"{end - pos} trailing bytes after verify request")
+    return sets, _VERIFY_CLASSES[cls], deadline_ms / 1e3
+
+
+def encode_verify_response(verdicts, load_hint=0):
+    """u16 n_sets || u32 load_hint (the verifier's queued-set depth, the
+    client's placement signal) || ceil(n/8) verdict bitmap bytes."""
+    n = len(verdicts)
+    bitmap = bytearray((n + 7) // 8)
+    for i, v in enumerate(verdicts):
+        if v:
+            bitmap[i // 8] |= 1 << (i % 8)
+    return struct.pack("<HI", n, max(0, int(load_hint))) + bytes(bitmap)
+
+
+def decode_verify_response(payload):
+    """Parse a VERIFY_RESP payload -> (verdicts, load_hint)."""
+    if len(payload) < 6:
+        raise WireError("truncated verify response header")
+    n, load = struct.unpack("<HI", payload[:6])
+    if n > MAX_VERIFY_SETS:
+        raise WireError(f"{n} verdicts exceeds {MAX_VERIFY_SETS}")
+    bitmap = payload[6:]
+    if len(bitmap) != (n + 7) // 8:
+        raise WireError(
+            f"verdict bitmap {len(bitmap)}B for {n} sets"
+        )
+    return [bool(bitmap[i // 8] >> (i % 8) & 1) for i in range(n)], load
 
 
 class GossipCodec:
@@ -301,8 +478,25 @@ class WireNode:
 
     def __init__(self, chain=None, port=0, peer_id=None, attnets=0,
                  accept_any_fork=False, quotas=None, encrypt=False,
-                 static_sk=None):
+                 static_sk=None, verify_service=None):
         self.chain = chain
+        # verifier-role dispatch: inbound VERIFY_REQ batches feed this
+        # VerificationService (explicitly wired, or the chain's own
+        # service when it exposes submit) with the normal priority/
+        # shed/admission semantics — one accelerator host fairly serves
+        # many client nodes.  None on both counts -> not a verifier;
+        # requests are answered R_RESOURCE_UNAVAILABLE.
+        self.verify_service = verify_service
+        # per-host serve slowdown (seconds) — the chaos harness's
+        # per-target analogue of the process-global `remote.serve`
+        # delay failpoint (simulator slow-verifier scenario)
+        self.verify_serve_delay = 0.0
+        # bound concurrent verify-serve work: each VERIFY_REQ decodes on
+        # its own thread, so without a cap a hostile peer flooding
+        # frames buys unbounded threads/CPU regardless of the
+        # verify_batch quota.  Excess is refused R_RESOURCE_UNAVAILABLE
+        # (the client's tiering treats it like a shed)
+        self._verify_slots = threading.BoundedSemaphore(MAX_VERIFY_INFLIGHT)
         # per-peer per-protocol token buckets (rpc/rate_limiter.rs role);
         # quotas=None -> DEFAULT_QUOTAS, {} -> unlimited (tests)
         self.limiter = RateLimiter(quotas)
@@ -674,6 +868,10 @@ class WireNode:
             self._on_ihave(peer, body)
         elif ftype == IWANT:
             self._on_iwant(peer, body)
+        elif ftype == VERIFY_REQ:
+            self._on_verify_req(peer, body)
+        elif ftype == VERIFY_RESP:
+            self._on_verify_resp(peer, body)
         elif ftype == GOODBYE_FRAME:
             peer.close()
         else:
@@ -1116,8 +1314,10 @@ class WireNode:
             self._req_id += 1
             rid = self._req_id
             # [event, chunks, code, peer, per-seq chunk accumulator,
-            #  pinned (code, total) from the stream's first frame]
-            rec = [threading.Event(), None, None, peer, {}, None]
+            #  pinned (code, total) from the stream's first frame,
+            #  expected response kind — a peer must not answer an rpc
+            #  request with a VERIFY_RESP frame (or vice versa)]
+            rec = [threading.Event(), None, None, peer, {}, None, "rpc"]
             self._pending[rid] = rec
         try:
             peer.send_frame(
@@ -1228,8 +1428,11 @@ class WireNode:
         with self._lock:
             rec = self._pending.get(rid)
         # only the peer the request went to may answer it — another peer
-        # guessing the (sequential) rid must not complete or poison it
-        if rec is None or rec[3] is not peer:
+        # guessing the (sequential) rid must not complete or poison it —
+        # and only with the frame kind the request expects: a VERIFY_RESP
+        # answering an rpc rid would surface a (verdicts, load) tuple as
+        # response chunks downstream
+        if rec is None or rec[3] is not peer or rec[6] != "rpc":
             return
         # pin (code, total) from the FIRST frame of the stream: a
         # responder shrinking n or flipping code mid-stream could
@@ -1317,6 +1520,166 @@ class WireNode:
                 for s in sorted(blocks)
             ]
         raise WireError(f"unknown method {method}")
+
+    # -------------------------------------------- batch-verify protocol
+
+    def _verify_backend(self):
+        """The VerificationService serving the verifier role: the wired
+        one, else the chain's own verifier when it is service-shaped."""
+        if self.verify_service is not None:
+            return self.verify_service
+        v = getattr(self.chain, "verifier", None)
+        return v if (v is not None and hasattr(v, "submit")) else None
+
+    def _on_verify_req(self, peer, body):
+        """VERIFY_REQ dispatch (reader thread): validate just enough to
+        address a response, then hand the decode + verification to a
+        request-scoped thread — a batch verify runs for device-pass
+        wall time, and the reader must keep serving gossip/rpc frames
+        (and further verify requests) meanwhile."""
+        if len(body) < 4:
+            raise WireError("truncated verify request")
+        if len(body) > MAX_VERIFY_BODY + 4:
+            # unaddressable floods still drop the connection; anything
+            # under the frame cap gets the typed-error response below
+            raise WireError("verify request exceeds size cap")
+        rid = struct.unpack("<I", body[:4])[0]
+        if not self._verify_slots.acquire(blocking=False):
+            # over the concurrency cap: refuse from the reader thread —
+            # addressable and cheap, and the client fails over to its
+            # next tier exactly like a shed
+            try:
+                peer.send_frame(
+                    VERIFY_RESP,
+                    struct.pack("<IB", rid, R_RESOURCE_UNAVAILABLE)
+                    + encode_verify_response([], 0),
+                )
+            except (ConnectionError, OSError):
+                pass
+            return
+        threading.Thread(
+            target=self._serve_verify, args=(peer, rid, body[4:]),
+            name="wire_verify_serve", daemon=True,
+        ).start()
+
+    def _serve_verify(self, peer, rid, payload):
+        """Verifier-role server: charge the quota off the fixed-size
+        header, decode, submit into the local VerificationService under
+        its normal priority/shed/admission semantics, and answer per-set
+        verdicts + a load hint."""
+        from ..verify_service.service import QueueFullError
+
+        verdicts, load = [], 0
+        try:
+            # chaos seam: `error` is a crashing verifier handler
+            # (surfaces as R_SERVER_ERROR), `delay` a slow verifier —
+            # the hedged-dispatch trigger
+            failpoints.hit("remote.serve")
+            if self.verify_serve_delay > 0:
+                time.sleep(self.verify_serve_delay)
+            # charge the quota from the 7-byte header BEFORE the body
+            # decode (a per-pubkey square root + subgroup-check scalar
+            # mul on every cache miss): an over-quota peer must not buy
+            # verifier CPU with frames that would be refused anyway
+            if len(payload) < 7:
+                raise WireError("truncated verify request header")
+            n_sets = struct.unpack("<H", payload[5:7])[0]
+            if not 0 < n_sets <= MAX_VERIFY_SETS:
+                raise WireError(
+                    f"{n_sets} sets outside [1, {MAX_VERIFY_SETS}]"
+                )
+            self.limiter.check(peer.peer_id, "verify_batch", n_sets)
+            sets, priority, deadline_s = decode_verify_request(payload)
+            service = self._verify_backend()
+            if service is None:
+                code = R_RESOURCE_UNAVAILABLE   # not serving this role
+            else:
+                fut = service.submit(
+                    sets, priority=priority, deadline=deadline_s,
+                    want_per_set=True,
+                )
+                verdicts = fut.result(timeout=deadline_s + 30.0)
+                if getattr(verdicts, "shed", False):
+                    # shed means DROPPED: all-False placeholders must
+                    # not reach the client as real verdicts
+                    verdicts, code = [], R_RESOURCE_UNAVAILABLE
+                else:
+                    load = getattr(service, "_queued_sets", 0)
+                    code = R_SUCCESS
+        except RateLimited:
+            verdicts, code = [], R_RESOURCE_UNAVAILABLE
+            self._score(peer, -5.0)
+        except QueueFullError:
+            # admission control / load shed, surfaced like over-quota:
+            # the client fails over to its next tier
+            verdicts, code = [], R_RESOURCE_UNAVAILABLE
+        except WireError:
+            verdicts, code = [], R_INVALID_REQUEST
+            self._score(peer, -5.0)
+        except Exception:
+            verdicts, code = [], R_SERVER_ERROR
+        try:
+            resp = encode_verify_response(verdicts, load)
+            # chaos seam: a byzantine verifier — `corrupt` flips verdict
+            # bits in the bitmap (the tail of the payload), which the
+            # client's random-recombination audit must catch
+            resp = resp[:6] + failpoints.hit(
+                "remote.verdict_corrupt", data=resp[6:]
+            )
+            peer.send_frame(
+                VERIFY_RESP, struct.pack("<IB", rid, code) + resp
+            )
+        except failpoints.FailpointError:
+            pass   # injected response loss: the client times out
+        except (ConnectionError, OSError):
+            pass   # client gone mid-verify; nothing to answer
+        finally:
+            self._verify_slots.release()
+
+    def _on_verify_resp(self, peer, body):
+        """Client side: complete the pending batch-verify request."""
+        if len(body) < 5:
+            raise WireError("truncated verify response")
+        rid, code = struct.unpack("<IB", body[:5])
+        with self._lock:
+            rec = self._pending.get(rid)
+        # unknown/expired rid, an impersonating peer, or a peer
+        # answering an rpc request with a verify frame
+        if rec is None or rec[3] is not peer or rec[6] != "verify":
+            return
+        if code == R_SUCCESS:
+            rec[1] = decode_verify_response(body[5:])
+        rec[2] = code
+        rec[0].set()
+
+    def request_verify_batch(self, peer_id, payload, timeout=5.0):
+        """Send one encoded batch-verify request (encode_verify_request
+        output); returns (verdicts, load_hint).  Raises PeerRateLimited
+        when the verifier shed or refused the batch, WireError on every
+        other failure — the remote client's tiering treats both as
+        'this target cannot serve the batch now'."""
+        peer = self.peers.get(peer_id)
+        if peer is None:
+            raise WireError(f"not connected to {peer_id}")
+        if len(payload) > MAX_VERIFY_BODY:
+            raise WireError("verify batch exceeds size cap")
+        with self._lock:
+            self._req_id += 1
+            rid = self._req_id
+            rec = [threading.Event(), None, None, peer, {}, None, "verify"]
+            self._pending[rid] = rec
+        try:
+            peer.send_frame(VERIFY_REQ, struct.pack("<I", rid) + payload)
+            if not rec[0].wait(timeout):
+                raise WireError("verify batch timed out")
+            if rec[2] == R_RESOURCE_UNAVAILABLE:
+                raise PeerRateLimited("verify batch refused (shed/quota)")
+            if rec[2] != R_SUCCESS or rec[1] is None:
+                raise WireError(f"verify batch failed: code {rec[2]}")
+            return rec[1]
+        finally:
+            with self._lock:
+                self._pending.pop(rid, None)
 
     # ------------------------------------------------- rpc client calls
 

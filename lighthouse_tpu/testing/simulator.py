@@ -5,7 +5,16 @@ and node_test_rig: N full nodes — each a BeaconChain + BeaconProcessor +
 Router on a shared gossip bus — plus validator clients holding disjoint
 key shares, driven by a shared manual slot clock.  Checks (checks.rs):
 liveness (every slot has a block) and finality advancement.
+
+The wire transport additionally hosts the remote verification fabric's
+chaos scenarios (`RemoteVerifyFabric`): standalone `VerifierHost`
+processes (chainless boot-node WireNodes feeding a local
+VerificationService) serve batch verification for the sim nodes, and
+the scenario methods kill/slow/partition/corrupt them mid-batch while
+asserting zero lost verdicts and continued chain liveness.
 """
+
+import time
 
 from ..beacon.beacon_processor import BeaconProcessor
 from ..beacon.chain import BeaconChain
@@ -39,22 +48,82 @@ class GossipingBeaconNode(DirectBeaconNode):
         return out
 
 
+class VerifierHost:
+    """Standalone verification-as-a-service host: a chainless boot-node
+    WireNode (accept_any_fork, mirror-digest HELLO) feeding inbound
+    VERIFY_REQ batches into a local VerificationService with the normal
+    priority/shed/admission semantics."""
+
+    def __init__(self, name="verifier0", backend="fake", target_batch=8):
+        from ..network.wire import WireNode
+        from ..verify_service import VerificationService
+
+        self.name = name
+        self.service = VerificationService(
+            SignatureVerifier(backend), target_batch=target_batch
+        )
+        self.wire = WireNode(
+            None, accept_any_fork=True, peer_id=name,
+            verify_service=self.service,
+        )
+
+    @property
+    def address(self):
+        return f"127.0.0.1:{self.wire.port}"
+
+    def stop(self):
+        self.wire.stop()
+        self.service.stop()
+
+
 class SimNode:
     def __init__(self, node_id, genesis_state, spec, bus, reqresp, backend,
-                 transport="bus"):
+                 transport="bus", remote_targets=None, remote_kw=None):
         self.node_id = node_id
         self.chain = BeaconChain(
             genesis_state.copy(), spec, verifier=SignatureVerifier(backend)
         )
         self.processor = BeaconProcessor(self.chain)
+        self.verify_service = None
+        self.remote_pool = None
         if transport == "wire":
             from ..network.wire import WireNode
 
             self.wire = WireNode(self.chain, peer_id=node_id)
             bus, reqresp = self.wire.bus_view(), self.wire.reqresp_view()
+            if remote_targets:
+                # remote verification fabric: this node's verifier
+                # becomes a VerificationService whose FIRST tier is the
+                # remote pool (reached over this node's own wire), with
+                # the local backend as the audit truth source and the
+                # fallthrough tier
+                from ..verify_service import (
+                    RemoteVerifierPool,
+                    VerificationService,
+                    WireTransport,
+                )
+
+                self.verify_service = VerificationService(
+                    SignatureVerifier(backend)
+                )
+                self.remote_pool = RemoteVerifierPool(
+                    list(remote_targets), WireTransport(self.wire),
+                    audit_verifier=SignatureVerifier(backend),
+                    **(remote_kw or {}),
+                )
+                self.verify_service.attach_remote(self.remote_pool)
+                self.chain.verifier = self.verify_service
         else:
             self.wire = None
         self.router = Router(node_id, self.chain, self.processor, bus, reqresp)
+
+    def stop(self):
+        if self.remote_pool is not None:
+            self.remote_pool.stop()
+        if self.verify_service is not None:
+            self.verify_service.stop()
+        if self.wire is not None:
+            self.wire.stop()
 
 
 class Simulator:
@@ -63,7 +132,7 @@ class Simulator:
     meshes them — the same Router/VC code paths either way."""
 
     def __init__(self, n_nodes, n_validators, spec, backend="fake",
-                 transport="bus"):
+                 transport="bus", n_verifier_hosts=0, remote_kw=None):
         self.spec = spec
         self.preset = spec.preset
         self.transport = transport
@@ -78,11 +147,18 @@ class Simulator:
         # handshake) must stop every already-listening node, not leak
         # accept/reader threads into the rest of the process
         self.nodes = []
+        self.verifier_hosts = []
         try:
+            for i in range(n_verifier_hosts):
+                self.verifier_hosts.append(
+                    VerifierHost(f"verifier{i}", backend=backend)
+                )
+            targets = [h.address for h in self.verifier_hosts]
             for i in range(n_nodes):
                 self.nodes.append(
                     SimNode(f"node{i}", self.genesis_state, spec, self.bus,
-                            self.reqresp, backend, transport=transport)
+                            self.reqresp, backend, transport=transport,
+                            remote_targets=targets, remote_kw=remote_kw)
                 )
             if transport == "wire":
                 # full mesh: everyone dials everyone with a lower index
@@ -151,8 +227,9 @@ class Simulator:
 
     def stop(self):
         for node in self.nodes:
-            if node.wire is not None:
-                node.wire.stop()
+            node.stop()
+        for host in self.verifier_hosts:
+            host.stop()
 
     def run_epochs(self, n_epochs):
         for _ in range(n_epochs * self.preset.slots_per_epoch):
@@ -181,3 +258,175 @@ class Simulator:
             assert fin >= min_epoch, (
                 f"{node.node_id} finalized {fin} < {min_epoch}"
             )
+
+
+class RemoteVerifyFabric:
+    """Chaos harness for the remote verification fabric: a wire-transport
+    Simulator whose nodes place verification on standalone VerifierHosts,
+    plus scenario methods that kill, slow, partition and corrupt those
+    hosts mid-batch.  Every scenario asserts the two acceptance
+    invariants — ZERO lost verdicts (each submitted probe batch resolves
+    with the correct per-set verdicts) and continued chain liveness —
+    and is deterministic under LTPU_FAILPOINTS_SEED (the failpoint RNGs
+    and the pool's audit RNG both derive from it)."""
+
+    def __init__(self, spec, n_nodes=2, n_validators=8, n_hosts=1,
+                 backend="fake", hedge_budget=0.2, breaker_threshold=3,
+                 breaker_cooldown=0.5, audit_rate=0.0,
+                 quarantine_cooldown=30.0):
+        self.sim = Simulator(
+            n_nodes, n_validators, spec, backend=backend, transport="wire",
+            n_verifier_hosts=n_hosts,
+            remote_kw={
+                "hedge_budget": hedge_budget,
+                "breaker_threshold": breaker_threshold,
+                "breaker_cooldown": breaker_cooldown,
+                "audit_rate": audit_rate,
+                "quarantine_cooldown": quarantine_cooldown,
+            },
+        )
+        self.hosts = self.sim.verifier_hosts
+
+    def stop(self):
+        self.sim.stop()
+
+    # ---------------------------------------------------------- plumbing
+
+    def node(self, i=0):
+        return self.sim.nodes[i]
+
+    def pool(self, i=0):
+        return self.sim.nodes[i].remote_pool
+
+    def probe_sets(self, n=4, tag=1):
+        """Honestly signed sets from the sim's interop validators — the
+        probe batches the scenarios place on the fabric."""
+        from ..crypto.ref import bls
+
+        msg = bytes([tag]) * 32
+        return [
+            bls.SignatureSet(bls.sign(sk, msg), [pk], msg)
+            for sk, pk in self.sim.keypairs[:n]
+        ]
+
+    def submit_probe(self, sets, node=0, priority="block"):
+        """Async submit through the node's VerificationService (the path
+        gossip/import work rides); returns the VerifyFuture."""
+        return self.node(node).verify_service.submit(
+            sets, priority=priority, want_per_set=True
+        )
+
+    def assert_no_lost_verdicts(self, fut, n_sets, timeout=15.0):
+        verdicts = fut.result(timeout=timeout)
+        assert list(verdicts) == [True] * n_sets, (
+            f"lost/wrong verdicts: {verdicts!r}"
+        )
+        return verdicts
+
+    def step_and_check(self, slots=2):
+        """The liveness half of the acceptance: the chain keeps producing
+        and importing blocks while the fabric is degraded."""
+        for _ in range(slots):
+            self.sim.step_slot()
+        self.sim.check_liveness()
+        self.sim.check_consensus()
+
+    # ---------------------------------------------------------- scenarios
+
+    def scenario_verifier_loss(self):
+        """Verifier-host loss MID-BATCH: the serve path is slowed so the
+        request is in flight at the host when it dies; the client's
+        pending record fails, the pool falls through, and the local tier
+        resolves the batch."""
+        from ..utils import failpoints
+
+        sets = self.probe_sets(tag=1)
+        failpoints.configure("remote.serve", "delay(400)")
+        try:
+            fut = self.submit_probe(sets)
+            time.sleep(0.1)            # batch now in flight at the host
+            self.hosts[0].stop()       # kill the verifier mid-batch
+            self.assert_no_lost_verdicts(fut, len(sets))
+        finally:
+            failpoints.reset()
+        self.step_and_check()
+        snap = self.pool().snapshot()
+        assert snap["jobs_local"] >= 1, snap
+        return snap
+
+    def scenario_slow_verifier(self):
+        """Slow verifier -> hedged failover: host 0 stalls past the hedge
+        budget, the batch is re-issued to host 1, and the first verdict
+        wins (host 0's late answer is an idempotent duplicate)."""
+        assert len(self.hosts) >= 2, "scenario needs two verifier hosts"
+        self.hosts[0].wire.verify_serve_delay = 1.5
+        try:
+            sets = self.probe_sets(tag=2)
+            fut = self.submit_probe(sets)
+            self.assert_no_lost_verdicts(fut, len(sets))
+        finally:
+            self.hosts[0].wire.verify_serve_delay = 0.0
+        snap = self.pool().snapshot()
+        assert snap["hedges"] >= 1, snap
+        assert snap["jobs_remote"] >= 1, snap
+        self.step_and_check()
+        return snap
+
+    def scenario_partition_heal(self):
+        """Partition + heal: every remote call fails (remote.rpc armed),
+        the per-target breakers trip OPEN and batches resolve locally;
+        after the heal the cooldown expires, a HALF_OPEN probe succeeds
+        and the breakers restore CLOSED with remote serving again."""
+        from ..utils import failpoints
+        from ..verify_service.circuit import CLOSED, OPEN
+
+        pool = self.pool()
+        threshold = pool.targets[0].breaker.threshold
+        failpoints.configure("remote.rpc", "error")
+        try:
+            for i in range(threshold):
+                fut = self.submit_probe(self.probe_sets(tag=3 + i))
+                self.assert_no_lost_verdicts(fut, 4)
+            assert all(t.breaker.state == OPEN for t in pool.targets), [
+                t.snapshot() for t in pool.targets
+            ]
+            # degraded-mode liveness: the chain keeps running on the
+            # local tiers while the pool is partitioned away
+            self.step_and_check()
+        finally:
+            failpoints.reset()
+        # heal: sit out the cooldown, then one probe batch re-closes
+        time.sleep(pool.targets[0].breaker.cooldown + 0.05)
+        fut = self.submit_probe(self.probe_sets(tag=9))
+        self.assert_no_lost_verdicts(fut, 4)
+        snap = pool.snapshot()
+        assert any(t.breaker.state == CLOSED for t in pool.targets), snap
+        assert snap["jobs_remote"] >= 1, snap
+        self.step_and_check()
+        return snap
+
+    def scenario_lying_verifier(self):
+        """Byzantine verifier caught by the audit: the host's verdict
+        bitmap is corrupted in flight (remote.verdict_corrupt), the
+        random-recombination audit catches the lie, the target is
+        quarantined (breaker forced OPEN), and the batch re-verifies
+        locally.  The probe rides the block class, which is ALWAYS
+        audited regardless of audit_rate (this fabric's audit_rate is
+        0.0) — the guarantee being asserted is the class policy itself,
+        not a lucky spot-check draw."""
+        from ..utils import failpoints
+        from ..verify_service.circuit import OPEN
+
+        pool = self.pool()
+        failpoints.configure("remote.verdict_corrupt", "corrupt")
+        try:
+            fut = self.submit_probe(self.probe_sets(tag=11))
+            self.assert_no_lost_verdicts(fut, 4)
+        finally:
+            failpoints.reset()
+        snap = pool.snapshot()
+        assert snap["audit_catches"] >= 1, snap
+        quarantined = [t for t in pool.targets if t.quarantined]
+        assert quarantined and quarantined[0].breaker.state == OPEN, snap
+        self.step_and_check()
+        return snap

@@ -327,6 +327,13 @@ declare("engine.rpc", "execution engine JSON-RPC call (engine_http)")
 declare("wire.rpc", "req/resp client request (network/wire._request)")
 declare("wire.serve", "req/resp server handler (network/wire._serve)")
 declare("processor.tick", "beacon_processor run-loop tick")
+declare("remote.rpc",
+        "remote batch-verify client call (verify_service/remote)")
+declare("remote.serve",
+        "remote batch-verify server handler (network/wire._serve_verify)")
+declare("remote.verdict_corrupt",
+        "remote verify response verdict bitmap, pre-send (corrupt "
+        "flips verdicts — the byzantine-verifier injection)")
 
 
 def _load_env():

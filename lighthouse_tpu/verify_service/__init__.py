@@ -34,9 +34,19 @@ Pieces:
     `heartbeat`/`restart_dispatcher` surface so a wedged dispatcher is
     restarted with its queues intact
   * Prometheus metrics via utils/metrics.py (verify_service/metrics.py)
+  * a remote verification fabric (remote.py): a health-ranked pool of
+    remote TPU verifier hosts as the FIRST backend tier — hedged
+    dispatch with per-target circuit breakers and untrusted-verdict
+    spot-checks — ahead of the local device and local host paths
 """
 
 from .circuit import CircuitBreaker
+from .remote import (
+    InProcessTransport,
+    RemoteTarget,
+    RemoteVerifierPool,
+    WireTransport,
+)
 from .service import (
     PRIORITY_CLASSES,
     SHED_LEVEL,
@@ -53,13 +63,17 @@ from .service import (
 __all__ = [
     "AdaptiveBatchController",
     "CircuitBreaker",
+    "InProcessTransport",
     "LoadShedError",
     "PRIORITY_CLASSES",
     "QueueFullError",
+    "RemoteTarget",
+    "RemoteVerifierPool",
     "SHED_LEVEL",
     "ShedVerdicts",
     "ServiceStopped",
     "VerificationService",
     "VerifyFuture",
+    "WireTransport",
     "verify_with_verdicts",
 ]

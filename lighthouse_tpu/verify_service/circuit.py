@@ -42,43 +42,61 @@ class CircuitBreaker:
     `state`)."""
 
     def __init__(self, threshold=3, cooldown=30.0, clock=time.monotonic,
-                 probe_max_sets=DEFAULT_PROBE_MAX_SETS):
+                 probe_max_sets=DEFAULT_PROBE_MAX_SETS,
+                 state_gauge=None, name="device"):
         self.threshold = max(1, int(threshold))
         self.cooldown = float(cooldown)
+        # force_open(cooldown=...) may lengthen `cooldown` for one exile;
+        # a success restores this base so a once-quarantined but now-
+        # honest target doesn't pay the long sit-out on every later trip
+        self._base_cooldown = self.cooldown
         self.probe_max_sets = max(1, int(probe_max_sets))
         self._clock = clock
         self.state = CLOSED
         self.consecutive_failures = 0
         self.opened_at = None
         self.trips = 0
-        M.CIRCUIT_STATE.set(CLOSED)
-        M.BREAKER_STATE.set(CLOSED)
+        self.name = name
+        # a per-instance gauge (e.g. a verify_remote_breaker_state{target}
+        # child) replaces the process-wide device-breaker families — a
+        # remote target's breaker must not clobber the device gauges or
+        # inflate the device trip counter
+        self._state_gauge = state_gauge
+        self._device_metrics = state_gauge is None
+        self._write_state_metric(CLOSED)
+
+    def _write_state_metric(self, state):
+        if self._device_metrics:
+            M.CIRCUIT_STATE.set(state)
+            M.BREAKER_STATE.set(state)
+        else:
+            self._state_gauge.set(state)
 
     def _set_state(self, state):
         prev, self.state = self.state, state
-        M.CIRCUIT_STATE.set(state)
-        M.BREAKER_STATE.set(state)
+        self._write_state_metric(state)
         if state == prev:
             return
         if state == OPEN:
             log.warning(
-                "device circuit breaker tripped %s -> open; pinning "
-                "verification to the host path",
-                _STATE_NAMES[prev],
+                "%s circuit breaker tripped %s -> open; pinning "
+                "verification to the fallback path",
+                self.name, _STATE_NAMES[prev],
                 consecutive_failures=self.consecutive_failures,
                 cooldown_s=self.cooldown,
             )
         elif state == HALF_OPEN:
             log.info(
-                "device circuit breaker half-open: probing the device "
-                "with one bounded batch",
+                "%s circuit breaker half-open: probing with one "
+                "bounded batch",
+                self.name,
                 probe_max_sets=self.probe_max_sets,
             )
         else:
             log.info(
-                "device circuit breaker restored %s -> closed after a "
+                "%s circuit breaker restored %s -> closed after a "
                 "successful probe batch",
-                _STATE_NAMES[prev],
+                self.name, _STATE_NAMES[prev],
             )
 
     def allow_device(self) -> bool:
@@ -103,11 +121,33 @@ class CircuitBreaker:
         if self.state == HALF_OPEN or self.consecutive_failures >= self.threshold:
             if self.state != OPEN:
                 self.trips += 1
-                M.CIRCUIT_TRIPS.inc()
+                if self._device_metrics:
+                    M.CIRCUIT_TRIPS.inc()
             self.opened_at = self._clock()
             self._set_state(OPEN)
 
+    def force_open(self, cooldown=None):
+        """Administrative trip: pin OPEN immediately, regardless of the
+        failure count — the audit-quarantine path for a remote target
+        caught returning wrong verdicts.  An optional `cooldown`
+        override lengthens the sit-out before any half-open re-probe
+        (a byzantine verifier earns a longer exile than a flaky one);
+        it lasts until the next successful probe, which restores the
+        constructor's base cooldown for ordinary trips."""
+        if cooldown is not None:
+            self.cooldown = float(cooldown)
+        if self.state != OPEN:
+            self.trips += 1
+            if self._device_metrics:
+                M.CIRCUIT_TRIPS.inc()
+        self.opened_at = self._clock()
+        self.consecutive_failures = max(
+            self.consecutive_failures, self.threshold
+        )
+        self._set_state(OPEN)
+
     def record_success(self):
         self.consecutive_failures = 0
+        self.cooldown = self._base_cooldown
         if self.state != CLOSED:
             self._set_state(CLOSED)

@@ -94,3 +94,33 @@ CIRCUIT_TRIPS = metrics.counter(
     "verify_service_circuit_trips_total",
     "Times the breaker pinned the service to the host path",
 )
+
+# ---- remote verification fabric (verify_service/remote.py) ----
+REMOTE_RPC = metrics.histogram(
+    "verify_remote_rpc_seconds",
+    "Remote batch-verify RPC latency per target (failures observed too "
+    "— a slow failure costs the hedge budget like a slow success)",
+    labels=("target",),
+)
+REMOTE_HEDGES = metrics.counter(
+    "verify_remote_hedges_total",
+    "Batches re-issued to the next tier after a target exceeded its "
+    "hedge deadline budget (first verdict wins)",
+)
+REMOTE_AUDIT_FAILURES = metrics.counter(
+    "verify_remote_audit_failures_total",
+    "Random-recombination spot-checks that caught a remote target "
+    "returning wrong verdicts (each quarantines the target)",
+    labels=("target",),
+)
+REMOTE_TIER = metrics.gauge(
+    "verify_remote_tier",
+    "Backend tier that served the most recent dispatched batch: "
+    "0=remote pool 1=local device 2=local host",
+)
+REMOTE_BREAKER = metrics.gauge(
+    "verify_remote_breaker_state",
+    "Per-remote-target circuit breaker state: 0=closed 1=open "
+    "2=half_open",
+    labels=("target",),
+)

@@ -1,0 +1,613 @@
+"""Remote verification fabric — the client side of verification-as-a-
+service.
+
+The north star is ONE TPU-backed host serving BLS verification for a
+fleet of CPU-only beacon nodes.  That only works if a node keeps making
+consensus progress when its verifier host is slow, partitioned, dead, or
+actively lying, so the client places every batch on a tiered backend
+chain
+
+    remote TPU verifier pool  ->  local device  ->  local host path
+
+with placement driven by per-target health.  Each `RemoteTarget` owns
+its own PR-5 machinery instance: a retry policy with jitter and a
+per-call deadline (utils/retries.py), a bounded half-open circuit
+breaker (verify_service/circuit.py, per-target gauge children), and the
+`remote.rpc` failpoint on the call path.
+
+Dispatch is HEDGED: the pool's worker issues the batch to the
+healthiest admissible target, and when that target exceeds its hedge
+deadline budget (env `LTPU_REMOTE_HEDGE_BUDGET`) the batch is re-issued
+to the next target while the first call stays in flight — the first
+verdict wins and duplicate resolution is idempotent (`_Job.offer`).
+When no remote target answers inside the total budget, `verify_batch`
+returns None and the VerificationService falls through to its local
+tiers: a wedged remote call can never stall local verification, and the
+worker itself is watchdog-covered (`heartbeat` stamps +
+generation-bumped `restart_remote_client`, the PR-6 pattern).
+
+Returned verdicts are UNTRUSTED, and the audit policy is CLASS-AWARE.
+Consensus-critical batches (priority class `block` or `aggregate`) are
+audited on EVERY return: the claimed-valid subset goes through one
+local host batch verification — which blinds every set with fresh
+random 64-bit scalars, i.e. IS a 2G2T-style random recombination
+(crypto/ref/bls.verify_signature_sets) — and every claimed-invalid set
+is re-verified alone (a recombination over the invalid subset proves
+nothing: one truly-bad set masks a censored good one).  A single
+flipped verdict on a block signature would admit an invalid block, so
+for these classes wrong verdicts never resolve unaudited and a
+byzantine verifier degrades the node to local verification instead of
+corrupting consensus.  Bulk classes (`attestation`, `discovery`) are
+spot-checked at probability p (env `LTPU_REMOTE_AUDIT_RATE`, default
+0.05) with one random claimed-invalid probe: the sample bounds how
+long a lying verifier survives (expected ~1/p batches before
+quarantine), NOT per-batch correctness — the unaudited majority of
+bulk verdicts is accepted as returned, a residual risk an operator
+accepts when enabling `LTPU_REMOTE_VERIFIERS`.  A failed audit of
+either kind quarantines the target (breaker forced OPEN,
+`verify_remote_audit_failures_total{target}`) and the batch is
+re-verified locally.  With no `audit_verifier` attached, no audits run
+at all and the caller owns every trust decision.
+"""
+
+import os
+import random
+import threading
+import time
+from queue import Empty, Queue
+
+from ..utils import failpoints
+from ..utils.logging import get_logger
+from ..utils.retries import RetryPolicy
+from . import metrics as M
+from .circuit import _STATE_NAMES, CLOSED, CircuitBreaker
+
+log = get_logger("remote_verify")
+
+DEFAULT_HEDGE_BUDGET_S = 0.25
+DEFAULT_AUDIT_RATE = 0.05
+# consensus-critical priority classes: one flipped verdict here admits
+# an invalid block, so these batches never resolve unaudited — the
+# spot-check rate only governs the bulk classes below them
+ALWAYS_AUDIT_CLASSES = frozenset({"block", "aggregate"})
+DEFAULT_QUARANTINE_COOLDOWN_S = 300.0
+DEFAULT_BREAKER_THRESHOLD = 3
+DEFAULT_BREAKER_COOLDOWN_S = 5.0
+EWMA_ALPHA = 0.2
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return float(default)
+
+
+class RemoteTarget:
+    """One remote verifier endpoint with its own health machinery.
+
+    `lock` guards the breaker and the health counters: unlike the
+    device breaker (single-dispatcher contract), a target is touched by
+    the pool worker AND by still-in-flight hedge call threads."""
+
+    def __init__(self, name, breaker_threshold=DEFAULT_BREAKER_THRESHOLD,
+                 breaker_cooldown=DEFAULT_BREAKER_COOLDOWN_S,
+                 clock=time.monotonic):
+        self.name = str(name)
+        self.lock = threading.Lock()
+        self.breaker = CircuitBreaker(
+            breaker_threshold, breaker_cooldown, clock=clock,
+            state_gauge=M.REMOTE_BREAKER.with_labels(self.name),
+            name=f"remote:{self.name}",
+        )
+        self.ewma_rpc_s = None     # smoothed successful-call latency
+        self.last_load = 0         # the verifier's queued-set hint
+        self.calls = 0
+        self.failures = 0
+        self.audit_failures = 0
+        self.quarantined = False
+
+    def record_success(self, rpc_s, load_hint):
+        with self.lock:
+            self.calls += 1
+            self.last_load = int(load_hint)
+            self.ewma_rpc_s = (
+                rpc_s if self.ewma_rpc_s is None
+                else self.ewma_rpc_s + EWMA_ALPHA * (rpc_s - self.ewma_rpc_s)
+            )
+            self.breaker.record_success()
+            # a quarantined target that sat out its exile and passed a
+            # probe is trusted again (and re-audited like everyone)
+            if self.breaker.state == CLOSED:
+                self.quarantined = False
+
+    def record_failure(self):
+        with self.lock:
+            self.calls += 1
+            self.failures += 1
+            self.breaker.record_failure()
+
+    def snapshot(self):
+        with self.lock:
+            return {
+                "target": self.name,
+                "breaker_state": self.breaker.state,
+                "breaker_state_name": _STATE_NAMES[self.breaker.state],
+                "breaker_trips": self.breaker.trips,
+                "quarantined": self.quarantined,
+                "ewma_rpc_ms": (
+                    None if self.ewma_rpc_s is None
+                    else round(self.ewma_rpc_s * 1e3, 3)
+                ),
+                "last_load": self.last_load,
+                "calls": self.calls,
+                "failures": self.failures,
+                "audit_failures": self.audit_failures,
+            }
+
+
+class _Job:
+    """One batch riding the hedged dispatch: first verdict wins,
+    duplicates are acknowledged but ignored (idempotent resolution)."""
+
+    __slots__ = ("sets", "priority", "result", "winner", "event", "lock",
+                 "duplicates")
+
+    def __init__(self, sets, priority):
+        self.sets = sets
+        self.priority = priority
+        self.result = None
+        self.winner = None
+        self.event = threading.Event()
+        self.lock = threading.Lock()
+        self.duplicates = 0
+
+    def offer(self, verdicts, target):
+        """Deliver one target's verdicts; False when a faster tier
+        already won (the duplicate is dropped, never re-resolved)."""
+        with self.lock:
+            if self.event.is_set():
+                self.duplicates += 1
+                return False
+            self.result = verdicts
+            self.winner = target
+        self.event.set()
+        return True
+
+    def fail(self):
+        """Resolve with no remote verdict (every tier failed/timed out):
+        the service's local tiers take the batch."""
+        with self.lock:
+            if self.event.is_set():
+                return False
+        self.event.set()
+        return True
+
+
+class InProcessTransport:
+    """Test/bench transport: target name -> callable(sets, priority,
+    deadline_s) returning (verdicts, load_hint)."""
+
+    def __init__(self, backends):
+        self.backends = dict(backends)
+
+    def call(self, target, sets, priority, deadline_s, timeout):
+        return self.backends[target](sets, priority, deadline_s)
+
+
+class WireTransport:
+    """Wire-backed transport: encodes the batch once per call and rides
+    the VERIFY_REQ/VERIFY_RESP frames of an existing WireNode.  Targets
+    are "host:port" addresses (dialed lazily, re-dialed after a
+    connection loss) or already-connected peer ids."""
+
+    def __init__(self, wire):
+        self.wire = wire
+        self._peers = {}   # target -> dialed peer id
+        self._lock = threading.Lock()
+
+    def _peer_for(self, target):
+        if target in self.wire.peers:
+            return target           # target IS a connected peer id
+        with self._lock:
+            pid = self._peers.get(target)
+        if pid is not None and pid in self.wire.peers:
+            return pid
+        host, _, port = target.rpartition(":")
+        if not host:
+            from ..network.wire import WireError
+
+            raise WireError(f"verify target {target!r} is not connected")
+        pid = self.wire.dial(host, int(port))
+        with self._lock:
+            self._peers[target] = pid
+        return pid
+
+    def call(self, target, sets, priority, deadline_s, timeout):
+        from ..network import wire as W
+
+        payload = W.encode_verify_request(
+            sets, priority=priority, deadline_ms=int(deadline_s * 1e3)
+        )
+        return self.wire.request_verify_batch(
+            self._peer_for(target), payload, timeout=timeout
+        )
+
+
+class RemoteVerifierPool:
+    """Health-ranked remote verifier pool with hedged dispatch and
+    untrusted-verdict spot-checks; the first tier of the service's
+    remote -> local device -> local host chain."""
+
+    def __init__(self, targets, transport, audit_verifier=None,
+                 audit_rate=None, hedge_budget=None, rng=None,
+                 retry_attempts=2,
+                 breaker_threshold=DEFAULT_BREAKER_THRESHOLD,
+                 breaker_cooldown=DEFAULT_BREAKER_COOLDOWN_S,
+                 quarantine_cooldown=DEFAULT_QUARANTINE_COOLDOWN_S,
+                 clock=time.monotonic):
+        self.targets = [
+            t if isinstance(t, RemoteTarget) else RemoteTarget(
+                t, breaker_threshold, breaker_cooldown, clock=clock
+            )
+            for t in targets
+        ]
+        self.transport = transport
+        # the local host path used as the audit truth source; None
+        # disables auditing (the caller owns trust decisions then)
+        self.audit_verifier = audit_verifier
+        self.audit_rate = (
+            _env_float("LTPU_REMOTE_AUDIT_RATE", DEFAULT_AUDIT_RATE)
+            if audit_rate is None else float(audit_rate)
+        )
+        self.hedge_budget = max(0.01, (
+            _env_float("LTPU_REMOTE_HEDGE_BUDGET", DEFAULT_HEDGE_BUDGET_S)
+            if hedge_budget is None else float(hedge_budget)
+        ))
+        self.quarantine_cooldown = float(quarantine_cooldown)
+        self.retry_attempts = max(1, int(retry_attempts))
+        # audit sampling is deterministic under LTPU_FAILPOINTS_SEED —
+        # the same contract the failpoint RNGs honor, so a chaos
+        # scenario replays byte-for-byte.  self._rng is consumed ONLY
+        # from the verify_batch caller thread (retry jitter in the hedge
+        # threads uses the module RNG); a concurrent consumer would make
+        # the draw sequence depend on thread timing
+        seed = os.environ.get("LTPU_FAILPOINTS_SEED")
+        self._rng = rng or random.Random(
+            f"{seed}:remote.audit" if seed is not None else None
+        )
+        self._clock = clock
+
+        # hedge/dispatch worker (watchdog surface, PR-6 pattern): the
+        # worker stamps `heartbeat` every loop pass; a wedged worker is
+        # superseded by `restart_remote_client` with the job queue
+        # intact, and `verify_batch`'s bounded wait means callers never
+        # block past the budget either way
+        self._jobs = Queue()
+        self._lock = threading.Lock()
+        self._worker = None
+        self._gen = 0
+        self._stopped = False
+        self.heartbeat = None
+        self.restarts = 0
+
+        # observability (the /lighthouse/remote-verify surface)
+        self.jobs_submitted = 0
+        self.jobs_remote = 0      # resolved by a remote verdict
+        self.jobs_local = 0       # fell through to the local tiers
+        self.hedges = 0
+        self.audits = 0
+        self.audit_catches = 0
+
+    # ------------------------------------------------------------ public
+
+    def verify_batch(self, sets, priority="attestation"):
+        """Place one batch on the remote tier.  Returns the per-set
+        verdict list on a remote (and audit-clean) verdict, or None when
+        the batch should run on the local tiers instead — no admissible
+        target, total hedge budget exhausted, or a failed audit."""
+        sets = list(sets)
+        if not sets or self._stopped or not self.targets:
+            return None
+        order = self._placement()
+        if not order:
+            return None
+        self._ensure_worker()
+        job = _Job(sets, priority)
+        with self._lock:
+            self.jobs_submitted += 1
+        self._jobs.put(job)
+        # bounded wall: one hedge budget per target plus one of grace —
+        # a wedged worker or a black-holed call degrades to the local
+        # tiers instead of stalling the service dispatcher
+        budget = self.hedge_budget * (len(order) + 1) + 0.5
+        if not job.event.wait(budget) or job.result is None:
+            with self._lock:
+                self.jobs_local += 1
+            return None
+        verdicts = job.result
+        if len(verdicts) != len(sets):
+            self._distrust(job.winner, "verdict count mismatch")
+            with self._lock:
+                self.jobs_local += 1
+            return None
+        if self._should_audit(job.priority) and not self._audit(job):
+            with self._lock:
+                self.jobs_local += 1
+            return None
+        with self._lock:
+            self.jobs_remote += 1
+        return verdicts
+
+    def has_admissible_target(self):
+        """Read-only placement peek (no breaker transitions)."""
+        for t in self.targets:
+            with t.lock:
+                if t.breaker.state == CLOSED or (
+                    t.breaker.opened_at is not None
+                    and self._clock() - t.breaker.opened_at
+                    >= t.breaker.cooldown
+                ):
+                    return True
+        return False
+
+    def stop(self):
+        with self._lock:
+            self._stopped = True
+            self._gen += 1
+        # fail queued jobs so no dispatcher waits out its full budget
+        while True:
+            try:
+                self._jobs.get_nowait().fail()
+            except Empty:
+                break
+
+    def restart_remote_client(self):
+        """Watchdog recovery hook: supersede a wedged dispatch/hedge
+        worker with a fresh thread, JOB QUEUE INTACT.  The old thread
+        observes the generation bump and exits; in-flight call threads
+        resolve into their jobs idempotently either way."""
+        with self._lock:
+            if self._stopped:
+                return False
+            self._gen += 1
+            self.restarts += 1
+            gen = self._gen
+            t = threading.Thread(
+                target=self._loop, args=(gen,), name="remote_verify",
+                daemon=True,
+            )
+            self._worker = t
+            t.start()
+        log.warning(
+            "remote verify client restarted (generation %d)", gen,
+            queued_jobs=self._jobs.qsize(),
+        )
+        return True
+
+    def snapshot(self):
+        """Per-target health/breaker/audit stats for the
+        /lighthouse/remote-verify route."""
+        with self._lock:
+            out = {
+                "hedge_budget_s": self.hedge_budget,
+                "audit_rate": self.audit_rate,
+                "jobs_submitted": self.jobs_submitted,
+                "jobs_remote": self.jobs_remote,
+                "jobs_local": self.jobs_local,
+                "hedges": self.hedges,
+                "audits": self.audits,
+                "audit_catches": self.audit_catches,
+                "worker_restarts": self.restarts,
+            }
+        out["targets"] = [t.snapshot() for t in self.targets]
+        return out
+
+    # ------------------------------------------------------- worker loop
+
+    def _ensure_worker(self):
+        with self._lock:
+            if self._stopped:
+                return
+            if self._worker is not None and self._worker.is_alive():
+                return
+            self._gen += 1
+            gen = self._gen
+            t = threading.Thread(
+                target=self._loop, args=(gen,), name="remote_verify",
+                daemon=True,
+            )
+            self._worker = t
+            t.start()
+
+    def _loop(self, gen):
+        while True:
+            self.heartbeat = time.monotonic()
+            if self._stopped or self._gen != gen:
+                return
+            try:
+                job = self._jobs.get(timeout=0.25)
+            except Empty:
+                continue
+            if self._gen != gen:
+                self._jobs.put(job)   # the replacement worker owns it
+                return
+            try:
+                self._hedged(job)
+            except Exception:
+                log.exception("remote hedged dispatch failed")
+            finally:
+                job.fail()   # no-op when a verdict already won
+
+    def _placement(self):
+        """Admissible targets, healthiest first: closed breakers before
+        half-open probes, then lower smoothed latency, then lower
+        reported load.  `allow_device` may transition OPEN -> HALF_OPEN;
+        the per-target lock covers the hedge threads' updates."""
+        ranked = []
+        for i, t in enumerate(self.targets):
+            with t.lock:
+                if not t.breaker.allow_device():
+                    continue
+                probing = t.breaker.state != CLOSED
+                key = (
+                    probing,
+                    t.ewma_rpc_s if t.ewma_rpc_s is not None else 0.0,
+                    t.last_load,
+                    i,
+                )
+            ranked.append((key, t))
+        ranked.sort(key=lambda kt: kt[0])
+        return [t for _, t in ranked]
+
+    def _hedged(self, job):
+        """Issue to the best target; on each hedge-budget expiry without
+        a verdict, ALSO issue to the next tier (previous calls stay in
+        flight — first verdict wins)."""
+        order = self._placement()
+        if not order:
+            return
+        pending = []
+        for i, target in enumerate(order):
+            if i > 0:
+                with self._lock:
+                    self.hedges += 1
+                M.REMOTE_HEDGES.inc()
+                log.debug(
+                    "hedging batch to %s (budget %.0fms expired)",
+                    target.name, self.hedge_budget * 1e3,
+                )
+            th = threading.Thread(
+                target=self._call_target, args=(job, target),
+                name=f"remote_verify_call_{target.name}", daemon=True,
+            )
+            th.start()
+            pending.append(th)
+            if job.event.wait(self.hedge_budget):
+                return
+            self.heartbeat = time.monotonic()
+        # every tier issued: grant one final budget before giving the
+        # batch back to the local path
+        job.event.wait(self.hedge_budget)
+
+    def _call_target(self, job, target):
+        t0 = time.monotonic()
+        try:
+            # chaos seam: `error` fails this target's call (a dead or
+            # partitioned verifier as seen from the client), `delay`
+            # models a stalling link
+            failpoints.hit("remote.rpc")
+            # the call may outlive the hedge budget: hedging covers the
+            # caller's latency with the next tier while this call stays
+            # in flight — a late verdict still lands (idempotently)
+            call_timeout = self.hedge_budget * 4 + 0.5
+            # jitter draws from the module RNG, NOT self._rng: hedge
+            # call threads run concurrently with the caller thread's
+            # audit sampling, and sharing one Random would make the
+            # audit sequence depend on thread timing (breaking the
+            # LTPU_FAILPOINTS_SEED determinism contract)
+            policy = RetryPolicy(
+                attempts=self.retry_attempts, base_delay=0.01,
+                max_delay=0.25, deadline=call_timeout * self.retry_attempts,
+                retry_on=(Exception,), rng=random.random,
+            )
+            verdicts, load = policy.call(
+                self.transport.call, target.name, job.sets, job.priority,
+                self.hedge_budget, call_timeout,
+                target=f"remote_verify:{target.name}",
+            )
+        except Exception as e:
+            M.REMOTE_RPC.with_labels(target.name).observe(
+                time.monotonic() - t0
+            )
+            target.record_failure()
+            log.debug("remote verify call to %s failed: %s",
+                      target.name, str(e)[:200])
+            return
+        dt = time.monotonic() - t0
+        M.REMOTE_RPC.with_labels(target.name).observe(dt)
+        if not isinstance(verdicts, list) or len(verdicts) != len(job.sets):
+            # a shape lie is a failure, not a verdict
+            target.record_failure()
+            return
+        target.record_success(dt, load)
+        job.offer(verdicts, target)
+
+    # ------------------------------------------------------------- audit
+
+    def _should_audit(self, priority):
+        if self.audit_verifier is None:
+            return False
+        # consensus-critical classes are always audited — audit_rate is
+        # the sampling knob for the bulk classes only
+        if priority in ALWAYS_AUDIT_CLASSES:
+            return True
+        if self.audit_rate <= 0.0:
+            return False
+        return self.audit_rate >= 1.0 or self._rng.random() < self.audit_rate
+
+    def _audit(self, job):
+        """2G2T-style check of one returned batch against the local host
+        path; True = the verdicts are consistent and may be used.  For
+        ALWAYS_AUDIT_CLASSES every claimed-invalid set is re-verified
+        (censoring a block must not hide behind a truly-bad neighbor);
+        bulk classes probe one random claimed-invalid set."""
+        target = job.winner
+        verdicts = job.result
+        with self._lock:
+            self.audits += 1
+        ok_sets = [s for s, v in zip(job.sets, verdicts) if v]
+        bad_sets = [s for s, v in zip(job.sets, verdicts) if not v]
+        try:
+            if ok_sets and not self.audit_verifier.verify_signature_sets(
+                ok_sets
+            ):
+                # the random recombination over the claimed-valid subset
+                # failed locally: the target vouched for an invalid set
+                self._audit_caught(target, "claimed-valid subset failed")
+                return False
+            if bad_sets:
+                probes = (
+                    bad_sets if job.priority in ALWAYS_AUDIT_CLASSES
+                    else [bad_sets[self._rng.randrange(len(bad_sets))]]
+                )
+                if any(
+                    self.audit_verifier.verify_signature_sets([p])
+                    for p in probes
+                ):
+                    # a claimed-invalid set verifies locally: censorship
+                    # (or a corrupted verdict stream)
+                    self._audit_caught(
+                        target, "claimed-invalid set verifies locally"
+                    )
+                    return False
+        except Exception:
+            # the audit path itself failed: trust nothing, quarantine
+            # nobody — the batch just re-verifies locally
+            log.warning("remote audit pass errored; batch re-verified "
+                        "locally", target=target.name if target else None)
+            return False
+        return True
+
+    def _audit_caught(self, target, why):
+        with self._lock:
+            self.audit_catches += 1
+        if target is None:
+            return
+        M.REMOTE_AUDIT_FAILURES.with_labels(target.name).inc()
+        with target.lock:
+            target.audit_failures += 1
+            target.quarantined = True
+            target.breaker.force_open(cooldown=self.quarantine_cooldown)
+        log.warning(
+            "remote verifier %s QUARANTINED after failed audit (%s); "
+            "its batches re-verify locally",
+            target.name, why,
+            quarantine_cooldown_s=self.quarantine_cooldown,
+        )
+
+    def _distrust(self, target, why):
+        if target is None:
+            return
+        target.record_failure()
+        log.warning("distrusting remote verifier %s: %s", target.name, why)

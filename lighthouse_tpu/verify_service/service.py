@@ -258,8 +258,16 @@ class VerificationService:
                  breaker_threshold=3, breaker_cooldown=30.0,
                  breaker_probe_max=None,
                  shed_watermark=None, pipeline=True,
-                 adaptive_batch=False, target_bounds=None):
+                 adaptive_batch=False, target_bounds=None,
+                 remote_pool=None):
         self.verifier = verifier or SignatureVerifier("oracle")
+        # remote verification fabric (remote.py): when attached, the
+        # FIRST backend tier — remote pool, then local device, then
+        # local host.  verify_batch returning None (no admissible
+        # target / budget exhausted / failed audit) falls through to
+        # the local tiers, so the remote fabric can only ever ADD
+        # capacity, never block the chain.
+        self.remote_pool = remote_pool
         self.target_batch = int(target_batch)
         self.max_batch = max(int(max_batch), self.target_batch)
         # two-stage host-prep/device pipeline for multi-chunk batches
@@ -922,6 +930,52 @@ class VerificationService:
             ok = self._host().verify_signature_sets(rest)
         return ok
 
+    def attach_remote(self, pool):
+        """Attach a RemoteVerifierPool as the first backend tier (node
+        wiring; also usable live — the dispatcher reads the attribute
+        fresh each batch)."""
+        self.remote_pool = pool
+        return self
+
+    def _try_remote(self, reqs, all_sets, now):
+        """Offer one formed batch to the remote tier.  True = the pool
+        returned (audited) verdicts and every request is resolved; False
+        = the local tiers take the batch — the pool's bounded budget
+        guarantees this returns promptly either way."""
+        pool = self.remote_pool
+        # the most urgent class present rides the whole coalesced batch
+        cls = min(reqs, key=lambda r: _CLASS_INDEX[r.cls]).cls
+        t0 = time.monotonic()
+        try:
+            verdicts = pool.verify_batch(all_sets, priority=cls)
+        except Exception:
+            log.exception(
+                "remote verify tier failed hard; local tiers take the batch"
+            )
+            return False
+        if verdicts is None:
+            return False
+        t1 = time.monotonic()
+        M.REMOTE_TIER.set(0)
+        attrs = {
+            "sets": len(all_sets),
+            "requests": len(reqs),
+            "coalesced": len(reqs) > 1,
+            "classes": sorted({r.cls for r in reqs}),
+            "backend": "remote",
+        }
+        bt = tracing.start_trace("verify_batch", **attrs)
+        bt.add_span("queue_wait", min(r.submitted for r in reqs), now)
+        bt.add_span("kernel", t0, t1, backend="remote")
+        bt.finish(ok=all(verdicts))
+        self._attach_spans(reqs, now, t0, t1, attrs)
+        pos = 0
+        for r in reqs:
+            mine = list(verdicts[pos:pos + len(r.sets)])
+            pos += len(r.sets)
+            self._resolve(r, mine if r.per_set else all(mine))
+        return True
+
     def _dispatch(self, reqs):
         now = time.monotonic()
         all_sets = []
@@ -936,8 +990,17 @@ class VerificationService:
             M.COALESCED_BATCHES.inc()
         self.dispatched_batches.append(len(all_sets))
 
+        # remote tier first: a healthy verifier pool takes the batch off
+        # this host entirely (verdicts already audited by the pool)
+        if self.remote_pool is not None and self._try_remote(
+            reqs, all_sets, now
+        ):
+            return
+
         v = self._active_verifier()
         device_attempt = v is self.verifier and self.backend == "tpu"
+        if self.remote_pool is not None:
+            M.REMOTE_TIER.set(1 if device_attempt else 2)
         # bounded half-open probe (circuit.py): when the breaker is
         # probing, cap the device's exposure to probe_max_sets and run
         # the rest of the batch on the host
@@ -1060,7 +1123,17 @@ class VerificationService:
             return waits[min(int(p * len(waits)), len(waits) - 1)] if waits else 0.0
 
         overlaps = list(self.recent_overlaps)
+        remote = {}
+        if self.remote_pool is not None:
+            snap = self.remote_pool.snapshot()
+            remote = {
+                "remote_jobs_remote": snap["jobs_remote"],
+                "remote_jobs_local": snap["jobs_local"],
+                "remote_hedges": snap["hedges"],
+                "remote_audit_catches": snap["audit_catches"],
+            }
         return {
+            **remote,
             "batches": len(batches),
             "sets": sum(batches),
             "mean_batch_sets": (sum(batches) / len(batches)) if batches else 0.0,

@@ -435,7 +435,7 @@ def test_response_stream_header_pinned():
     from lighthouse_tpu.network.wire import WireError, WireNode
 
     peer = object()
-    rec = [threading.Event(), None, None, peer, {}, None]
+    rec = [threading.Event(), None, None, peer, {}, None, "rpc"]
     node = SimpleNamespace(
         _lock=threading.Lock(), _pending={7: rec}, _resp_frames=0)
 

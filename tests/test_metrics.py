@@ -178,6 +178,16 @@ def test_metric_name_lint():
         "compile_cache_offmenu_total",
         "verify_service_warmth",
     } <= names, sorted(names)
+    # the remote verification fabric families (ISSUE 8) must be
+    # registered and linted: per-target RPC latency, hedge counter,
+    # audit catches, the serving-tier gauge, and per-target breakers
+    assert {
+        "verify_remote_rpc_seconds",
+        "verify_remote_hedges_total",
+        "verify_remote_audit_failures_total",
+        "verify_remote_tier",
+        "verify_remote_breaker_state",
+    } <= names, sorted(names)
 
 
 def test_verify_service_queue_depth_is_one_labeled_family():

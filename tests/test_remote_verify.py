@@ -1,0 +1,465 @@
+"""Remote verification fabric: wire codec, tiered placement, hedged
+dispatch, untrusted-verdict audits, per-target breakers, watchdog
+coverage, and the multi-node chaos scenarios.
+
+The client side is lighthouse_tpu/verify_service/remote.py (pool,
+hedging, audit, quarantine); the serving side is network/wire.py's
+VERIFY_REQ/VERIFY_RESP frames feeding a local VerificationService; the
+chaos scenarios run on testing/simulator.RemoteVerifyFabric over real
+TCP sockets.
+"""
+
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.crypto.backend import SignatureVerifier
+from lighthouse_tpu.crypto.ref import bls
+from lighthouse_tpu.network import wire as W
+from lighthouse_tpu.state_processing.genesis import interop_keypairs
+from lighthouse_tpu.testing.simulator import RemoteVerifyFabric
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+from lighthouse_tpu.utils import failpoints
+from lighthouse_tpu.verify_service import (
+    InProcessTransport,
+    RemoteVerifierPool,
+    VerificationService,
+    WireTransport,
+)
+from lighthouse_tpu.verify_service.circuit import CLOSED, HALF_OPEN, OPEN
+from lighthouse_tpu.verify_service.remote import _Job, RemoteTarget
+
+SPEC = ChainSpec(preset=MinimalPreset)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+def probe_sets(n=3, tag=0x31):
+    msg = bytes([tag]) * 32
+    return [
+        bls.SignatureSet(bls.sign(sk, msg), [pk], msg)
+        for sk, pk in interop_keypairs(n)
+    ]
+
+
+def honest_backend(sets, priority, deadline_s):
+    return [True] * len(sets), 0
+
+
+# ------------------------------------------------------------- codec
+
+
+def test_verify_request_roundtrip_uses_pubkey_decode_cache():
+    """The request codec round-trips sets verbatim, and repeated
+    compressed pubkeys resolve through the decode cache (skipping the
+    expensive subgroup-checked decompression)."""
+    sets = probe_sets(2)
+    payload = W.encode_verify_request(sets, priority="block",
+                                      deadline_ms=250)
+    h0, m0 = W.PK_DECODE_CACHE.hits, W.PK_DECODE_CACHE.misses
+    dec1, priority, deadline = W.decode_verify_request(payload)
+    assert priority == "block" and abs(deadline - 0.25) < 1e-9
+    assert [s.message for s in dec1] == [s.message for s in sets]
+    # same pubkeys again: pure cache hits this time
+    dec2, _, _ = W.decode_verify_request(payload)
+    assert W.PK_DECODE_CACHE.hits >= h0 + 2
+    # decoded sets actually verify (points survived the trip)
+    v = SignatureVerifier("fake")
+    assert v.verify_signature_sets(dec1) and v.verify_signature_sets(dec2)
+
+
+def test_verify_codec_signatureless_sets():
+    """Aggregate-style sets with signature=None keep the None through
+    the codec (flags bit 0)."""
+    base = probe_sets(1)[0]
+    s = bls.SignatureSet(None, base.pubkeys, base.message)
+    payload = W.encode_verify_request([s])
+    dec, _, _ = W.decode_verify_request(payload)
+    assert dec[0].signature is None
+    assert dec[0].message == base.message
+
+
+# ------------------------------------------------- placement + tiering
+
+
+def test_placement_ranks_healthy_targets_first():
+    pool = RemoteVerifierPool(
+        ["a", "b", "c"], InProcessTransport({}), hedge_budget=0.05
+    )
+    ta, tb, tc = pool.targets
+    ta.ewma_rpc_s = 0.05
+    tb.ewma_rpc_s = 0.01
+    tc.breaker.force_open(cooldown=60.0)       # inadmissible
+    order = pool._placement()
+    assert [t.name for t in order] == ["b", "a"]
+    # a target past its cooldown is admitted again — but ranked after
+    # the closed-breaker targets (it is a probe, not a peer)
+    tc.breaker.cooldown = 0.0
+    order = pool._placement()
+    assert [t.name for t in order] == ["b", "a", "c"]
+    assert tc.breaker.state == HALF_OPEN
+    pool.stop()
+
+
+def test_tiered_failover_remote_down_service_resolves_locally():
+    """The tier chain end-to-end: every remote call fails, the batch
+    falls through to the local verifier, and the caller still gets
+    correct verdicts (zero lost)."""
+
+    def dead(sets, priority, deadline_s):
+        raise OSError("verifier unreachable")
+
+    pool = RemoteVerifierPool(
+        ["dead"], InProcessTransport({"dead": dead}), hedge_budget=0.05,
+        retry_attempts=1,
+    )
+    service = VerificationService(
+        SignatureVerifier("fake"), remote_pool=pool, target_batch=4
+    )
+    try:
+        assert service.verify_signature_sets(probe_sets(2)) is True
+        snap = pool.snapshot()
+        assert snap["jobs_local"] >= 1 and snap["jobs_remote"] == 0
+        assert pool.targets[0].failures >= 1
+    finally:
+        service.stop()
+        pool.stop()
+
+
+def test_remote_tier_serves_and_stats_surface():
+    pool = RemoteVerifierPool(
+        ["up"], InProcessTransport({"up": honest_backend}),
+        hedge_budget=0.1,
+    )
+    service = VerificationService(
+        SignatureVerifier("fake"), remote_pool=pool, target_batch=4
+    )
+    try:
+        verdicts = service.verify_signature_sets_per_set(probe_sets(3))
+        assert list(verdicts) == [True, True, True]
+        assert service.stats()["remote_jobs_remote"] >= 1
+        assert pool.targets[0].ewma_rpc_s is not None
+    finally:
+        service.stop()
+        pool.stop()
+
+
+# ------------------------------------------------------------ hedging
+
+
+def test_hedged_dispatch_slow_target_loses_to_next_tier():
+    """Target a stalls past the hedge budget; the batch re-issues to b,
+    whose verdict wins; a's late answer is dropped idempotently."""
+    released = threading.Event()
+
+    def slow(sets, priority, deadline_s):
+        released.wait(2.0)
+        return [True] * len(sets), 0
+
+    pool = RemoteVerifierPool(
+        ["slow", "fast"],
+        InProcessTransport({"slow": slow, "fast": honest_backend}),
+        hedge_budget=0.05,
+    )
+    try:
+        out = pool.verify_batch(probe_sets(2))
+        assert out == [True, True]
+        snap = pool.snapshot()
+        assert snap["hedges"] >= 1
+        winner = [t for t in pool.targets if t.name == "fast"][0]
+        assert winner.calls >= 1
+        released.set()
+        time.sleep(0.1)     # the late slow answer resolves as duplicate
+        assert pool.snapshot()["jobs_remote"] == 1
+    finally:
+        released.set()
+        pool.stop()
+
+
+def test_job_duplicate_resolution_is_idempotent():
+    job = _Job([object(), object()], "block")
+    ta, tb = RemoteTarget("a"), RemoteTarget("b")
+    assert job.offer([True, False], ta) is True
+    # second (hedged duplicate) verdict is acknowledged but ignored
+    assert job.offer([False, True], tb) is False
+    assert job.result == [True, False] and job.winner is ta
+    assert job.duplicates == 1
+    assert job.fail() is False          # can't fail a resolved job
+
+
+# ---------------------------------------------------- audit + quarantine
+
+
+def test_audit_catches_corrupted_verdicts_and_quarantines():
+    """remote.verdict_corrupt flips verdict bits on the serving side;
+    the random-recombination audit catches the lie, quarantines the
+    target (breaker forced OPEN), and the caller still gets correct
+    verdicts from the local tier."""
+    service_host = VerificationService(
+        SignatureVerifier("fake"), target_batch=4
+    )
+    host = W.WireNode(None, accept_any_fork=True, peer_id="vh",
+                      verify_service=service_host)
+    client = W.WireNode(None, accept_any_fork=True, peer_id="vc")
+    pool = RemoteVerifierPool(
+        [f"127.0.0.1:{host.port}"], WireTransport(client),
+        audit_verifier=SignatureVerifier("fake"), audit_rate=1.0,
+        hedge_budget=0.3,
+    )
+    service = VerificationService(
+        SignatureVerifier("fake"), remote_pool=pool, target_batch=4
+    )
+    try:
+        failpoints.configure("remote.verdict_corrupt", "corrupt")
+        verdicts = service.verify_signature_sets_per_set(probe_sets(4))
+        assert list(verdicts) == [True] * 4        # zero lost verdicts
+        snap = pool.snapshot()
+        assert snap["audit_catches"] >= 1
+        t = pool.targets[0]
+        assert t.quarantined and t.breaker.state == OPEN
+        assert t.audit_failures >= 1
+        # quarantined target out of placement: next batch goes local
+        failpoints.reset()
+        assert service.verify_signature_sets(probe_sets(2, tag=0x32))
+        assert pool.snapshot()["jobs_local"] >= 1
+    finally:
+        failpoints.reset()
+        service.stop()
+        pool.stop()
+        client.stop()
+        host.stop()
+        service_host.stop()
+
+
+def lying_backend(sets, priority, deadline_s):
+    return [False] * len(sets), 0
+
+
+def test_block_class_always_audited_even_at_zero_audit_rate():
+    """Consensus-critical classes never resolve unaudited: a verifier
+    lying about block-class verdicts is caught and quarantined even
+    with the bulk-class spot-check sampling disabled — audit_rate only
+    governs attestation/discovery traffic."""
+    pool = RemoteVerifierPool(
+        ["liar"], InProcessTransport({"liar": lying_backend}),
+        audit_verifier=SignatureVerifier("fake"), audit_rate=0.0,
+        hedge_budget=0.1,
+    )
+    try:
+        out = pool.verify_batch(probe_sets(3), priority="block")
+        assert out is None                 # caught -> local re-verify
+        snap = pool.snapshot()
+        assert snap["audits"] >= 1 and snap["audit_catches"] >= 1
+        t = pool.targets[0]
+        assert t.quarantined and t.breaker.state == OPEN
+    finally:
+        pool.stop()
+
+
+def test_bulk_class_is_spot_checked_not_guaranteed():
+    """The documented residual risk of the bulk classes: at
+    audit_rate=0 an attestation-class batch resolves with the remote
+    verdicts as returned, unaudited (the spot check bounds a lying
+    verifier's survival, not per-batch correctness)."""
+    pool = RemoteVerifierPool(
+        ["liar"], InProcessTransport({"liar": lying_backend}),
+        audit_verifier=SignatureVerifier("fake"), audit_rate=0.0,
+        hedge_budget=0.1,
+    )
+    try:
+        out = pool.verify_batch(probe_sets(2), priority="attestation")
+        assert out == [False, False]       # accepted as returned
+        assert pool.snapshot()["audits"] == 0
+    finally:
+        pool.stop()
+
+
+class _NullGauge:
+    def set(self, value):
+        pass
+
+
+def test_quarantine_cooldown_restored_after_successful_probe():
+    """force_open's cooldown override lasts one exile: after a probe
+    succeeds, ordinary breaker trips sit out the base cooldown again
+    instead of the quarantine-length one."""
+    from lighthouse_tpu.verify_service.circuit import CircuitBreaker
+
+    t = [0.0]
+    b = CircuitBreaker(threshold=1, cooldown=5.0, clock=lambda: t[0],
+                       state_gauge=_NullGauge())
+    b.force_open(cooldown=300.0)
+    assert b.cooldown == 300.0
+    t[0] += 299.0
+    assert not b.allow_device()            # still in exile
+    t[0] += 1.0
+    assert b.allow_device()                # HALF_OPEN probe
+    b.record_success()
+    assert b.state == CLOSED and b.cooldown == 5.0
+    b.record_failure()                     # ordinary trip after restore
+    assert b.state == OPEN
+    t[0] += 5.0
+    assert b.allow_device()                # base cooldown, not 300s
+
+
+def test_quarantine_reprobe_restores_trust():
+    """After the quarantine cooldown a HALF_OPEN probe that succeeds
+    restores the target to CLOSED and clears the quarantine flag."""
+    pool = RemoteVerifierPool(
+        ["t"], InProcessTransport({"t": honest_backend}),
+        hedge_budget=0.05, quarantine_cooldown=0.15,
+    )
+    try:
+        target = pool.targets[0]
+        pool._audit_caught(target, "test quarantine")
+        assert target.quarantined and target.breaker.state == OPEN
+        assert pool.verify_batch(probe_sets(1)) is None   # benched
+        time.sleep(0.2)                                   # cooldown over
+        out = pool.verify_batch(probe_sets(1))
+        assert out == [True]
+        assert target.breaker.state == CLOSED
+        assert not target.quarantined
+    finally:
+        pool.stop()
+
+
+def test_audit_rng_deterministic_under_failpoints_seed(monkeypatch):
+    monkeypatch.setenv("LTPU_FAILPOINTS_SEED", "1729")
+    p1 = RemoteVerifierPool(["x"], InProcessTransport({}), audit_rate=0.5)
+    p2 = RemoteVerifierPool(["x"], InProcessTransport({}), audit_rate=0.5)
+    assert [p1._rng.random() for _ in range(8)] == [
+        p2._rng.random() for _ in range(8)
+    ]
+    p1.stop()
+    p2.stop()
+
+
+# ------------------------------------------------------------ watchdog
+
+
+def test_restart_remote_client_supersedes_worker_queue_intact():
+    pool = RemoteVerifierPool(
+        ["up"], InProcessTransport({"up": honest_backend}),
+        hedge_budget=0.1,
+    )
+    try:
+        assert pool.verify_batch(probe_sets(1)) == [True]
+        assert pool.heartbeat is not None
+        old_worker = pool._worker
+        assert pool.restart_remote_client() is True
+        assert pool.restarts == 1
+        assert pool._worker is not old_worker
+        # the replacement worker serves batches
+        assert pool.verify_batch(probe_sets(1, tag=0x33)) == [True]
+        # the superseded thread observes the generation bump and exits
+        old_worker.join(timeout=2.0)
+        assert not old_worker.is_alive()
+    finally:
+        pool.stop()
+    assert pool.restart_remote_client() is False      # stopped pool
+
+
+# ----------------------------------------------- multi-node chaos scenarios
+
+
+def test_chaos_scenario_verifier_host_loss_mid_batch():
+    f = RemoteVerifyFabric(SPEC, n_hosts=1)
+    try:
+        snap = f.scenario_verifier_loss()
+        assert snap["jobs_local"] >= 1
+    finally:
+        f.stop()
+
+
+def test_chaos_scenario_slow_verifier_hedged_failover():
+    f = RemoteVerifyFabric(SPEC, n_hosts=2)
+    try:
+        snap = f.scenario_slow_verifier()
+        assert snap["hedges"] >= 1 and snap["jobs_remote"] >= 1
+    finally:
+        f.stop()
+
+
+def test_chaos_scenario_partition_and_heal():
+    f = RemoteVerifyFabric(SPEC, n_hosts=1)
+    try:
+        snap = f.scenario_partition_heal()
+        assert snap["targets"][0]["breaker_state_name"] == "closed"
+        assert snap["jobs_remote"] >= 1
+    finally:
+        f.stop()
+
+
+def test_chaos_scenario_lying_verifier_caught_by_audit():
+    f = RemoteVerifyFabric(SPEC, n_hosts=1)
+    try:
+        snap = f.scenario_lying_verifier()
+        assert snap["audit_catches"] >= 1
+        assert any(t["quarantined"] for t in snap["targets"])
+    finally:
+        f.stop()
+
+
+# ------------------------------------------------------------- http api
+
+
+def test_remote_verify_http_route():
+    """GET /lighthouse/remote-verify serves the per-target health/
+    breaker/audit snapshot for the operator."""
+    import json
+    from urllib.request import urlopen
+
+    from lighthouse_tpu.api.http_api import BeaconApiServer
+    from lighthouse_tpu.beacon.chain import BeaconChain
+    from lighthouse_tpu.testing.harness import Harness
+
+    h = Harness(8, SPEC)
+    pool = RemoteVerifierPool(
+        ["up"], InProcessTransport({"up": honest_backend}),
+        hedge_budget=0.05,
+    )
+    service = VerificationService(
+        SignatureVerifier("fake"), remote_pool=pool
+    )
+    chain = BeaconChain(h.state.copy(), SPEC, verifier=service)
+    api = BeaconApiServer(chain, port=0)
+    api.start()
+    try:
+        pool._audit_caught(pool.targets[0], "route test")
+        url = f"http://127.0.0.1:{api.port}/lighthouse/remote-verify"
+        data = json.load(urlopen(url))["data"]
+        assert data["enabled"] is True
+        assert data["targets"][0]["quarantined"] is True
+        assert data["targets"][0]["breaker_state_name"] == "open"
+        assert data["audit_rate"] == pool.audit_rate
+    finally:
+        api.stop()
+        service.stop()
+        pool.stop()
+
+
+def test_remote_verify_http_route_disabled():
+    import json
+    from urllib.request import urlopen
+
+    from lighthouse_tpu.api.http_api import BeaconApiServer
+    from lighthouse_tpu.beacon.chain import BeaconChain
+    from lighthouse_tpu.testing.harness import Harness
+
+    h = Harness(8, SPEC)
+    chain = BeaconChain(h.state.copy(), SPEC,
+                        verifier=SignatureVerifier("fake"))
+    api = BeaconApiServer(chain, port=0)
+    api.start()
+    try:
+        url = f"http://127.0.0.1:{api.port}/lighthouse/remote-verify"
+        data = json.load(urlopen(url))["data"]
+        assert data == {"enabled": False, "targets": []}
+    finally:
+        api.stop()

@@ -455,3 +455,239 @@ def test_snappy_native_python_interchangeable():
             S.decompress(bad_copy)
     finally:
         S._native = backup
+
+
+# ------------------------------------------- verify batch codec hardening
+
+
+def _probe_sets(n=2, tag=0x21):
+    from lighthouse_tpu.crypto.ref import bls
+    from lighthouse_tpu.state_processing.genesis import interop_keypairs
+
+    msg = bytes([tag]) * 32
+    return [
+        bls.SignatureSet(bls.sign(sk, msg), [pk], msg)
+        for sk, pk in interop_keypairs(n)
+    ]
+
+
+def test_verify_request_codec_fuzz_truncations():
+    """Every truncated prefix of a valid request raises the typed
+    WireError — a short read must never wedge or crash the server
+    thread, and must never decode to a smaller batch silently."""
+    from lighthouse_tpu.network.wire import (
+        decode_verify_request,
+        encode_verify_request,
+    )
+
+    payload = encode_verify_request(_probe_sets(2), priority="aggregate",
+                                    deadline_ms=50)
+    # full payload decodes
+    sets, priority, deadline = decode_verify_request(payload)
+    assert len(sets) == 2 and priority == "aggregate"
+    assert abs(deadline - 0.05) < 1e-9
+    # every proper prefix is a typed error (step 7 keeps runtime sane
+    # while still crossing every field boundary)
+    for cut in range(0, len(payload), 7):
+        with pytest.raises(WireError):
+            decode_verify_request(payload[:cut])
+    # trailing garbage is as malformed as a truncation
+    with pytest.raises(WireError):
+        decode_verify_request(payload + b"\x00")
+
+
+def test_verify_request_codec_rejects_malformed():
+    import struct as _struct
+
+    from lighthouse_tpu.network.wire import (
+        MAX_VERIFY_PUBKEYS,
+        MAX_VERIFY_SETS,
+        WireError as WE,
+        decode_verify_request,
+        encode_verify_request,
+    )
+
+    good = encode_verify_request(_probe_sets(1))
+    # unknown priority class byte
+    with pytest.raises(WE):
+        decode_verify_request(b"\xff" + good[1:])
+    # oversized set count in the header
+    bad_n = good[:5] + _struct.pack("<H", MAX_VERIFY_SETS + 1) + good[7:]
+    with pytest.raises(WE):
+        decode_verify_request(bad_n)
+    # unknown flag bits
+    flagged = good[:7] + b"\x7e" + good[8:]
+    with pytest.raises(WE):
+        decode_verify_request(flagged)
+    # corrupted signature point bytes (not a valid G2 compression)
+    bad_sig = bytearray(good)
+    bad_sig[8:8 + 96] = b"\x01" * 96
+    with pytest.raises(WE):
+        decode_verify_request(bytes(bad_sig))
+    # a pubkey count past the cap
+    off = 8 + 96 + 32
+    bad_pk = good[:off] + _struct.pack("<H", MAX_VERIFY_PUBKEYS + 1) + good[off + 2:]
+    with pytest.raises(WE):
+        decode_verify_request(bad_pk)
+    # encode-side guards: empty batch, oversized batch, bad message len
+    from lighthouse_tpu.crypto.ref.bls import SignatureSet
+
+    with pytest.raises(WE):
+        encode_verify_request([])
+    with pytest.raises(WE):
+        encode_verify_request(
+            [SignatureSet(None, _probe_sets(1)[0].pubkeys, b"short")]
+        )
+
+
+def test_verify_response_codec_negative():
+    from lighthouse_tpu.network.wire import (
+        MAX_VERIFY_SETS,
+        WireError as WE,
+        decode_verify_response,
+        encode_verify_response,
+    )
+    import struct as _struct
+
+    resp = encode_verify_response([True, False, True, True], load_hint=9)
+    verdicts, load = decode_verify_response(resp)
+    assert verdicts == [True, False, True, True] and load == 9
+    for cut in range(len(resp)):
+        with pytest.raises(WE):
+            decode_verify_response(resp[:cut])
+    # bitmap shorter/longer than the declared count
+    with pytest.raises(WE):
+        decode_verify_response(resp + b"\x00")
+    # verdict count past the cap
+    with pytest.raises(WE):
+        decode_verify_response(
+            _struct.pack("<HI", MAX_VERIFY_SETS + 1, 0) + b"\x00" * 4096
+        )
+
+
+def test_garbage_verify_req_answers_typed_error_and_connection_survives():
+    """A malformed batch body gets R_INVALID_REQUEST (surfaced as a
+    WireError client-side) instead of wedging or dropping the reader —
+    the SAME connection then serves a well-formed batch."""
+    from lighthouse_tpu.verify_service import VerificationService
+
+    service = VerificationService(SignatureVerifier("fake"), target_batch=4)
+    server = WireNode(None, accept_any_fork=True, peer_id="vhost",
+                      verify_service=service)
+    client = WireNode(None, accept_any_fork=True, peer_id="vclient")
+    try:
+        pid = client.dial("127.0.0.1", server.port)
+        with pytest.raises(WireError):
+            client.request_verify_batch(pid, b"\xff" * 64, timeout=5.0)
+        # connection still up: a valid batch round-trips
+        from lighthouse_tpu.network.wire import encode_verify_request
+
+        payload = encode_verify_request(_probe_sets(2, tag=0x44))
+        verdicts, _load = client.request_verify_batch(pid, payload,
+                                                      timeout=10.0)
+        assert verdicts == [True, True]
+        assert pid in client.peers
+    finally:
+        client.stop()
+        server.stop()
+        service.stop()
+
+
+def test_verify_serve_inflight_cap_refuses_excess():
+    """Concurrent verify-serve work is bounded: with every slot held the
+    server refuses from the reader thread with R_RESOURCE_UNAVAILABLE
+    (no new serve thread, no decode); freed slots serve again on the
+    same connection."""
+    from lighthouse_tpu.network.wire import (
+        PeerRateLimited,
+        encode_verify_request,
+    )
+    from lighthouse_tpu.verify_service import VerificationService
+
+    service = VerificationService(SignatureVerifier("fake"), target_batch=4)
+    server = WireNode(None, accept_any_fork=True, peer_id="vh_cap",
+                      verify_service=service)
+    client = WireNode(None, accept_any_fork=True, peer_id="vc_cap")
+    try:
+        pid = client.dial("127.0.0.1", server.port)
+        payload = encode_verify_request(_probe_sets(1, tag=0x51))
+        held = 0
+        while server._verify_slots.acquire(blocking=False):
+            held += 1
+        with pytest.raises(PeerRateLimited):
+            client.request_verify_batch(pid, payload, timeout=5.0)
+        for _ in range(held):
+            server._verify_slots.release()
+        verdicts, _load = client.request_verify_batch(pid, payload,
+                                                      timeout=10.0)
+        assert verdicts == [True]
+    finally:
+        client.stop()
+        server.stop()
+        service.stop()
+
+
+def test_verify_quota_charged_before_body_decode():
+    """The verify_batch quota is charged off the fixed-size header: an
+    over-quota request is refused WITHOUT paying the per-pubkey
+    decompression (the decode cache sees no traffic)."""
+    from lighthouse_tpu.network.rate_limiter import Quota
+    from lighthouse_tpu.network.wire import (
+        PK_DECODE_CACHE,
+        PeerRateLimited,
+        encode_verify_request,
+    )
+    from lighthouse_tpu.verify_service import VerificationService
+
+    service = VerificationService(SignatureVerifier("fake"), target_batch=4)
+    server = WireNode(None, accept_any_fork=True, peer_id="vh_quota",
+                      verify_service=service,
+                      quotas={"verify_batch": Quota(1, 1000.0)})
+    client = WireNode(None, accept_any_fork=True, peer_id="vc_quota")
+    try:
+        pid = client.dial("127.0.0.1", server.port)
+        # 2 sets against a 1-token bucket: rejected as too large
+        payload = encode_verify_request(_probe_sets(2, tag=0x52))
+        h0, m0 = PK_DECODE_CACHE.hits, PK_DECODE_CACHE.misses
+        with pytest.raises(PeerRateLimited):
+            client.request_verify_batch(pid, payload, timeout=5.0)
+        assert (PK_DECODE_CACHE.hits, PK_DECODE_CACHE.misses) == (h0, m0)
+    finally:
+        client.stop()
+        server.stop()
+        service.stop()
+
+
+def test_verify_resp_frame_cannot_complete_rpc_request():
+    """Pending records are tagged with the expected response kind: a
+    VERIFY_RESP whose rid matches an in-flight rpc request is ignored
+    instead of surfacing a (verdicts, load) tuple as response chunks
+    (and an rpc RESPONSE cannot complete a verify request either)."""
+    import struct as _struct
+    import threading as _threading
+
+    from lighthouse_tpu.network.wire import (
+        R_SUCCESS,
+        encode_verify_response,
+    )
+
+    node = WireNode(None, accept_any_fork=True, peer_id="vh_kind")
+    try:
+        peer = object()
+        rpc_rec = [_threading.Event(), None, None, peer, {}, None, "rpc"]
+        node._pending[41] = rpc_rec
+        node._on_verify_resp(
+            peer,
+            _struct.pack("<IB", 41, R_SUCCESS)
+            + encode_verify_response([True], 0),
+        )
+        assert not rpc_rec[0].is_set() and rpc_rec[1] is None
+        ver_rec = [_threading.Event(), None, None, peer, {}, None, "verify"]
+        node._pending[42] = ver_rec
+        node._on_response(
+            peer,
+            _struct.pack("<IBII", 42, R_SUCCESS, 0, 1) + snappy.compress(b"x"),
+        )
+        assert not ver_rec[0].is_set() and ver_rec[1] is None
+    finally:
+        node.stop()

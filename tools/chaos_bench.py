@@ -19,14 +19,24 @@ seconds.  `bench.py config_verify_service` records one point of this
 sweep into BENCH_PRIMARY.json (`goodput_under_faults`,
 `breaker_recovery_seconds`).
 
+`--remote` switches to the remote verification fabric: the same offered-
+load sweep against a SIMULATED verifier pool (in-process transport,
+latency-shaped backends) under injected per-call fault/partition rates,
+reporting `remote_goodput` (verdicts/s with the remote tier first),
+`failover_seconds` (all targets die -> time until the next batch
+resolves on the local tiers) and `audit_catch_rate` (fraction of lying-
+verifier batches the random-recombination spot-check catches).
+
 Usage:
     python tools/chaos_bench.py
     python tools/chaos_bench.py --fault-rates 0.0,0.2,0.5 --duration 2
+    python tools/chaos_bench.py --remote --fault-rates 0.0,0.3
 """
 
 import argparse
 import json
 import os
+import random
 import sys
 import threading
 import time
@@ -34,7 +44,11 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from lighthouse_tpu.utils import failpoints  # noqa: E402
-from lighthouse_tpu.verify_service import VerificationService  # noqa: E402
+from lighthouse_tpu.verify_service import (  # noqa: E402
+    InProcessTransport,
+    RemoteVerifierPool,
+    VerificationService,
+)
 from lighthouse_tpu.verify_service.circuit import CLOSED  # noqa: E402
 
 
@@ -236,6 +250,177 @@ def measure_breaker_recovery(seed=1234, breaker_threshold=2,
     }
 
 
+# --------------------------------------------------------- remote mode
+
+
+class TruthVerifier:
+    """Audit truth source for the simulated fabric: every StubSet is
+    valid (so any False verdict from a lying backend is a catch)."""
+
+    backend = "native"
+
+    def verify_signature_sets(self, sets, priority=None):
+        return True
+
+    def verify_signature_sets_per_set(self, sets, priority=None):
+        return [True] * len(sets)
+
+
+class SimRemoteBackend:
+    """One simulated verifier host behind InProcessTransport: latency-
+    shaped, failing a configurable fraction of calls (the fault/partition
+    injection), optionally lying (inverted verdicts) for the audit-catch
+    measurement."""
+
+    def __init__(self, rng, fault_rate=0.0, latency_ms=2.0, lie=False):
+        self._rng = rng
+        self.fault_rate = fault_rate
+        self.latency_s = latency_ms / 1e3
+        self.lie = lie
+        self.calls = 0
+        self.faults = 0
+
+    def __call__(self, sets, priority, deadline_s):
+        self.calls += 1
+        if self._rng.random() < self.fault_rate:
+            self.faults += 1
+            raise OSError("injected remote fault")
+        time.sleep(self.latency_s)
+        verdicts = [not self.lie] * len(sets)
+        return verdicts, 0
+
+
+def _build_remote_service(backends, seed, hedge_budget, audit_rate,
+                          breaker_cooldown=0.2, target_batch=16):
+    pool = RemoteVerifierPool(
+        list(backends), InProcessTransport(backends),
+        audit_verifier=TruthVerifier(), audit_rate=audit_rate,
+        hedge_budget=hedge_budget, breaker_cooldown=breaker_cooldown,
+        rng=random.Random(seed),
+    )
+    service = VerificationService(
+        HostVerifier(), target_batch=target_batch, remote_pool=pool
+    )
+    return service, pool
+
+
+def run_remote_point(fault_rate=0.2, submitters=4, offered_rps=500.0,
+                     duration=1.5, seed=1234, n_targets=2,
+                     hedge_budget=0.05, audit_rate=0.1, latency_ms=2.0,
+                     failover_timeout=10.0):
+    """One remote-fabric storm point: offered load with the remote tier
+    first while each simulated target drops `fault_rate` of its calls;
+    then kill EVERY target and time the failover to the local tiers."""
+    backends = {
+        f"sim{i}": SimRemoteBackend(
+            random.Random(f"{seed}:{i}"), fault_rate, latency_ms
+        )
+        for i in range(n_targets)
+    }
+    service, pool = _build_remote_service(
+        backends, seed, hedge_budget, audit_rate
+    )
+
+    per_thread = offered_rps / submitters
+    interval = 1.0 / per_thread if per_thread > 0 else 0.0
+    stop_at = time.monotonic() + duration
+    futures = [[] for _ in range(submitters)]
+    rejected = [0] * submitters
+
+    def submitter(i):
+        nxt = time.monotonic()
+        while time.monotonic() < stop_at:
+            try:
+                futures[i].append(service.submit([StubSet()]))
+            except Exception:
+                rejected[i] += 1
+            nxt += interval
+            delay = nxt - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=submitter, args=(i,), daemon=True)
+               for i in range(submitters)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    resolved = ok = 0
+    for fl in futures:
+        for f in fl:
+            try:
+                if f.result(timeout=30.0):
+                    ok += 1
+                resolved += 1
+            except TimeoutError:
+                pass                # LOST: the verdict never arrived
+            except Exception:
+                resolved += 1
+    wall = time.monotonic() - t0
+    submitted = sum(len(fl) for fl in futures)
+    snap = pool.snapshot()
+
+    # failover: every remote target dies; time from the kill until one
+    # subsequent submit resolves (on the local tiers, breakers tripping
+    # along the way)
+    for b in backends.values():
+        b.fault_rate = 1.0
+    f0 = time.monotonic()
+    try:
+        assert service.submit([StubSet()]).result(timeout=failover_timeout)
+        failover_s = time.monotonic() - f0
+        failed_over = True
+    except Exception:
+        failover_s = failover_timeout
+        failed_over = False
+    service.stop()
+    pool.stop()
+    return {
+        "fault_rate": fault_rate,
+        "offered_rps": offered_rps,
+        "n_targets": n_targets,
+        "submitted": submitted,
+        "rejected": sum(rejected),
+        "resolved": resolved,
+        "lost": submitted - resolved,
+        "verified_ok": ok,
+        "remote_goodput": round(ok / wall, 1) if wall > 0 else 0.0,
+        "remote_batches": snap["jobs_remote"],
+        "local_batches": snap["jobs_local"],
+        "hedges": snap["hedges"],
+        "failover_seconds": round(failover_s, 3),
+        "failed_over": failed_over,
+    }
+
+
+def measure_audit_catch(seed=1234, rounds=8):
+    """Lying-verifier detection rate: a backend inverting every verdict,
+    audited on every batch (a fresh pool per round — a caught target is
+    quarantined, which would otherwise end the experiment after one)."""
+    catches = 0
+    for r in range(rounds):
+        backends = {"liar": SimRemoteBackend(
+            random.Random(f"{seed}:liar:{r}"), 0.0, 1.0, lie=True
+        )}
+        pool = RemoteVerifierPool(
+            ["liar"], InProcessTransport(backends),
+            audit_verifier=TruthVerifier(), audit_rate=1.0,
+            hedge_budget=0.05, rng=random.Random(seed + r),
+        )
+        out = pool.verify_batch([StubSet() for _ in range(4)])
+        snap = pool.snapshot()
+        # a caught lie returns None (local re-verify) and quarantines
+        if out is None and snap["audit_catches"] >= 1:
+            catches += 1
+        pool.stop()
+    return {
+        "lying_batches": rounds,
+        "caught": catches,
+        "audit_catch_rate": round(catches / rounds, 3) if rounds else 0.0,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--fault-rates", default="0.0,0.2,0.5",
@@ -246,20 +431,37 @@ def main(argv=None):
                     help="seconds per storm point")
     ap.add_argument("--seed", type=int, default=1234)
     ap.add_argument("--target-batch", type=int, default=64)
+    ap.add_argument("--remote", action="store_true",
+                    help="sweep the remote verification fabric instead "
+                         "of the local device storm")
+    ap.add_argument("--n-targets", type=int, default=2,
+                    help="simulated verifier hosts (--remote)")
     args = ap.parse_args(argv)
 
     points = []
     try:
-        for rate in (float(r) for r in args.fault_rates.split(",")):
-            pt = run_chaos_point(
-                fault_rate=rate, submitters=args.submitters,
-                offered_rps=args.offered_rps, duration=args.duration,
-                seed=args.seed, target_batch=args.target_batch,
-            )
-            points.append(pt)
-            print(json.dumps(pt), flush=True)
-        recovery = measure_breaker_recovery(seed=args.seed)
-        print(json.dumps(recovery), flush=True)
+        if args.remote:
+            for rate in (float(r) for r in args.fault_rates.split(",")):
+                pt = run_remote_point(
+                    fault_rate=rate, submitters=args.submitters,
+                    offered_rps=args.offered_rps, duration=args.duration,
+                    seed=args.seed, n_targets=args.n_targets,
+                )
+                points.append(pt)
+                print(json.dumps(pt), flush=True)
+            recovery = measure_audit_catch(seed=args.seed)
+            print(json.dumps(recovery), flush=True)
+        else:
+            for rate in (float(r) for r in args.fault_rates.split(",")):
+                pt = run_chaos_point(
+                    fault_rate=rate, submitters=args.submitters,
+                    offered_rps=args.offered_rps, duration=args.duration,
+                    seed=args.seed, target_batch=args.target_batch,
+                )
+                points.append(pt)
+                print(json.dumps(pt), flush=True)
+            recovery = measure_breaker_recovery(seed=args.seed)
+            print(json.dumps(recovery), flush=True)
     finally:
         failpoints.reset()
     print(json.dumps(
