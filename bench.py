@@ -809,6 +809,63 @@ def config5():
          seconds=round(dt, 3), slots_per_sec=round(slots / dt, 2))
 
 
+def config_aggregation(n_validators=None, json_path=None):
+    """Million-validator aggregation tier lane: tools/scale_bench.py in
+    a CPU-pinned subprocess — the full gossip → processor →
+    verify_service → operation_pool → head epoch replay plus the
+    tier-vs-naive-pool insert microbench (byte-identity checked in the
+    same run).  The small-N form rides every bench; `--scale` runs it
+    at N=1,000,000 and records BENCH_SCALE.json."""
+    global _VS_SUMMARY
+    import subprocess
+
+    n = n_validators or int(os.environ.get("BENCH_AGG_VALIDATORS", "16384"))
+    est = 60.0 + n / 5000.0
+    if not _fits(est, "aggregation_tier"):
+        return
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "scale_bench.py"),
+           "--validators", str(n)]
+    if json_path:
+        cmd += ["--json", json_path]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                           timeout=max(300.0, 4 * est))
+    except subprocess.TimeoutExpired:
+        note("aggregation_tier_error", error="timeout", validators=n)
+        return
+    if r.returncode != 0:
+        note("aggregation_tier_error", rc=r.returncode, validators=n,
+             stderr=r.stderr[-300:])
+        return
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    note("aggregation_tier", validators=n,
+         agg_inserts_per_sec=out["agg_inserts_per_sec"],
+         insert_baseline_per_sec=out["insert_baseline_per_sec"],
+         insert_speedup=out["insert_speedup"],
+         byte_identical=out["byte_identical"],
+         epoch_replay_seconds=out["epoch_replay_seconds"],
+         replay_msgs_per_sec=out["replay_msgs_per_sec"],
+         lost_verdicts=out["verdicts"]["lost"],
+         flush_batch_sizes=out["flush_batch_sizes"],
+         peak_rss_mb=out["peak_rss_mb"])
+    summary = {
+        # the tier's economics ride BENCH_PRIMARY.json's verify_service
+        # key so the aggregation trajectory is guarded across PRs
+        "agg_inserts_per_sec": out["agg_inserts_per_sec"],
+        "agg_insert_speedup": out["insert_speedup"],
+        "agg_byte_identical": out["byte_identical"],
+        "agg_replay_msgs_per_sec": out["replay_msgs_per_sec"],
+        "agg_lost_verdicts": out["verdicts"]["lost"],
+    }
+    if _VS_SUMMARY is None:
+        _VS_SUMMARY = summary
+    else:
+        _VS_SUMMARY.update(summary)
+
+
 def config_kernels():
     """mont_mul candidate shoot-out: f32-HIGHEST GEMM vs int32 einsum vs
     the fused Pallas kernel, one jit each on a wide batch — a single
@@ -1004,6 +1061,14 @@ def main():
         _DETAILS_PATH = "BENCH_WARM.json"
         warm()
         return
+    if "--scale" in sys.argv:
+        # the full-epoch 1M-validator aggregation-tier scenario ONLY:
+        # records BENCH_SCALE.json and the run details, skips the
+        # verify-path configs entirely
+        _install_term_handler()
+        config_aggregation(n_validators=1_000_000,
+                           json_path="BENCH_SCALE.json")
+        return 0
     _install_term_handler()
     note("platform", platform=jax.devices()[0].platform, note=_PLATFORM_NOTE,
          bucket=BUCKET, budget_s=BUDGET_S)
@@ -1066,12 +1131,13 @@ def main():
     # subprocess measurements to the front of the extras
     stages = (
         (config_device_retry, config_gossip_latency, config_native_shapes,
-         config5, run_device_smoke_and_curve, config_kernels, config1,
-         config4, config_compile_cache)
+         config5, config_aggregation, run_device_smoke_and_curve,
+         config_kernels, config1, config4, config_compile_cache)
         if _DEVICE_ALIVE else
         (config_gossip_latency, config_native_shapes, config5,
-         config_device_retry, run_device_smoke_and_curve, config_kernels,
-         config1, config4, config_compile_cache)
+         config_aggregation, config_device_retry,
+         run_device_smoke_and_curve, config_kernels, config1, config4,
+         config_compile_cache)
     )
     for fn in stages:
         if _left() < 120:

@@ -1,0 +1,7 @@
+"""Million-validator aggregation tier — lazy gossip-side accumulation of
+compressed signature contributions with device-batched flushes (see
+tier.py for the trust boundary and flush policy)."""
+
+from .tier import AggregationTier, bits_of, bits_or, bits_overlap
+
+__all__ = ["AggregationTier", "bits_of", "bits_or", "bits_overlap"]

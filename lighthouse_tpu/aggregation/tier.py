@@ -1,0 +1,321 @@
+"""Device-batched attestation aggregation tier (naive_aggregation_pool.rs
+semantics, million-validator economics).
+
+The old `OperationPool.insert_attestation` paid a host G2
+decompress → point-add → compress round-trip per gossip insert — the
+per-message aggregation cost Wonderboom (PAPERS.md) shows dominating
+million-scale consensus.  This tier makes the insert O(bytes): a
+contribution is just its aggregation bitset (numpy uint8) plus its
+96-byte compressed signature, appended to the entry chosen by the same
+bits-only greedy disjoint-merge rule the naive pool used (first stored
+entry with a disjoint bitset merges, else a new entry) — so the GROUPING
+is decided identically, only the curve math is deferred.
+
+A **flush** settles every pending entry in one batched pass
+(`crypto/tpu/aggregation.aggregate_segments`): all pending compressed
+signatures decompress together, per-entry tree reductions produce the
+aggregate points, and canonical re-compression writes the settled
+signature bytes.  Point addition is associative, so the settled bytes
+are byte-identical to what the naive pool's incremental merging would
+have produced.  Flushes run on-demand at every read
+(`get_attestations` / `get_aggregate` / snapshot), when the pending
+count crosses `LTPU_AGG_FLUSH_THRESHOLD`, or when
+`LTPU_AGG_FLUSH_INTERVAL` seconds elapse (`maybe_flush`, ticked by the
+beacon processor).
+
+**Trust boundary (the `subgroup_check=False` fix):** gossip inserts do
+NOT validate signature points — not even the structural decompress the
+old pool paid.  Every contribution is instead subgroup-checked exactly
+once, batched, at flush time (device `g2_decompress_batch(...,
+subgroup_check=True)` or the host oracle with the same semantics)
+BEFORE any aggregate built from it can reach `verify_service` or a
+packed block.  Invalid contributions (undecodable, off-curve, or
+outside the r-order subgroup) are dropped individually — the entry's
+bitset is recomputed from its valid contributions only, so one poisoned
+gossip message never invalidates honest signatures sharing its entry.
+Until the first flush, unvalidated bytes exist only inside this tier.
+"""
+
+import os
+import threading
+import time
+from collections import defaultdict
+
+import numpy as np
+
+from ..utils import metrics
+
+INSERTS = metrics.counter(
+    "aggregation_inserts_total",
+    "Attestation contributions accepted by the aggregation tier (O(bytes) path)",
+)
+PENDING = metrics.gauge(
+    "aggregation_pending_contributions",
+    "Contributions accumulated but not yet flushed/validated",
+)
+FLUSHES = metrics.counter(
+    "aggregation_flush_total",
+    "Batched aggregation flushes by trigger",
+    labels=("trigger",),
+)
+FLUSH_BATCH = metrics.histogram(
+    "aggregation_flush_batch_size",
+    "Contributions settled per flush batch",
+    buckets=(1, 8, 64, 512, 4096, 32768),
+)
+FLUSH_SECONDS = metrics.histogram(
+    "aggregation_flush_seconds",
+    "Wall time of one batched aggregation flush",
+    buckets=(0.001, 0.01, 0.1, 1.0, 10.0),
+)
+INVALID = metrics.counter(
+    "aggregation_invalid_signatures_total",
+    "Contributions dropped at flush (undecodable / off-curve / non-subgroup)",
+)
+PRESUMS = metrics.counter(
+    "aggregation_pubkey_presums_total",
+    "Multi-pubkey signature sets collapsed to one aggregate pubkey",
+)
+
+
+def bits_of(bits):
+    """Any 0/1 sequence (Bitlist view, list, array) -> numpy uint8 row."""
+    return np.asarray(list(bits), dtype=np.uint8)
+
+
+def bits_or(a, b):
+    return np.bitwise_or(bits_of(a), bits_of(b))
+
+
+def bits_overlap(a, b):
+    return bool(np.bitwise_and(bits_of(a), bits_of(b)).any())
+
+
+class AggregationTier:
+    """The accumulator behind `OperationPool.attestations`.
+
+    `entries` keeps the pool's public shape — data root -> list of
+    {"bits", "att", ...} — so existing readers (max-cover packing, the
+    HTTP pool routes) keep working; each entry additionally carries its
+    pending `contribs` [(uint8 bits, sig bytes)] and a `validated` flag.
+    """
+
+    def __init__(self, spec):
+        self.spec = spec
+        self.entries = defaultdict(list)
+        self._lock = threading.RLock()
+        self.pending = 0
+        self.inserts = 0
+        self.invalid = 0
+        self.flushes = defaultdict(int)
+        self.flush_batches = []          # last few batch sizes (stats/bench)
+        self.presums = 0
+        self.flush_interval = float(
+            os.environ.get("LTPU_AGG_FLUSH_INTERVAL", "2.0")
+        )
+        self.flush_threshold = int(
+            os.environ.get("LTPU_AGG_FLUSH_THRESHOLD", "1024")
+        )
+        self._last_flush = time.monotonic()
+
+    # ------------------------------------------------------------ insert
+
+    def insert(self, attestation):
+        """O(bytes): pick the entry by the naive pool's bits-only greedy
+        rule and append the compressed contribution.  No curve math."""
+        from ..ssz import hash_tree_root
+
+        key = hash_tree_root(attestation.data)
+        bits = bits_of(attestation.aggregation_bits)
+        sig = bytes(attestation.signature)
+        with self._lock:
+            self.inserts += 1
+            for entry in self.entries[key]:
+                if not np.bitwise_and(entry["bits"], bits).any():
+                    entry["bits"] = np.bitwise_or(entry["bits"], bits)
+                    entry["contribs"].append((bits, sig))
+                    entry["validated"] = False
+                    self.pending += 1
+                    break
+            else:
+                self.entries[key].append(
+                    {
+                        "bits": bits,
+                        "att": attestation.copy(),
+                        "contribs": [(bits, sig)],
+                        "validated": False,
+                    }
+                )
+                self.pending += 1
+        INSERTS.inc()
+        PENDING.set(self.pending)
+
+    # ------------------------------------------------------------- flush
+
+    def maybe_flush(self):
+        """Periodic tick: flush when the pending count crosses the
+        threshold or the interval elapses.  Returns contributions
+        settled (0 when no trigger fired)."""
+        with self._lock:
+            if not self.pending:
+                self._last_flush = time.monotonic()
+                return 0
+            if self.pending >= self.flush_threshold:
+                trigger = "threshold"
+            elif time.monotonic() - self._last_flush >= self.flush_interval:
+                trigger = "interval"
+            else:
+                return 0
+        return self.flush(trigger)
+
+    def flush(self, trigger="manual"):
+        """Settle every pending entry in ONE batched pass.  Returns the
+        number of contributions settled."""
+        from ..crypto.ref.curves import g2_compress
+        from ..crypto.tpu import aggregation as ta
+
+        t0 = time.monotonic()
+        with self._lock:
+            if not self.pending:
+                self._last_flush = time.monotonic()
+                return 0
+            work, blobs, seg_of = [], [], []
+            for key, entries in self.entries.items():
+                for entry in entries:
+                    if entry["validated"]:
+                        continue
+                    seg = len(work)
+                    work.append((key, entry))
+                    for b, sig in entry["contribs"]:
+                        blobs.append(sig)
+                        seg_of.append(seg)
+            if not blobs:
+                self.pending = 0
+                PENDING.set(0)
+                self._last_flush = time.monotonic()
+                return 0
+
+            sums, ok = ta.aggregate_segments(blobs, seg_of, len(work))
+
+            pos = 0
+            dropped = 0
+            for seg, (key, entry) in enumerate(work):
+                contribs = entry["contribs"]
+                k = len(contribs)
+                good = [c for c, o in zip(contribs, ok[pos : pos + k]) if o]
+                pos += k
+                dropped += k - len(good)
+                if not good:
+                    self.entries[key].remove(entry)
+                    continue
+                merged = good[0][0]
+                for b, _ in good[1:]:
+                    merged = np.bitwise_or(merged, b)
+                sig = good[0][1] if len(good) == 1 else g2_compress(sums[seg])
+                entry["bits"] = merged
+                entry["contribs"] = [(merged, sig)]
+                entry["validated"] = True
+                entry["att"].aggregation_bits = [int(x) for x in merged]
+                entry["att"].signature = sig
+            for key in [k for k, v in self.entries.items() if not v]:
+                del self.entries[key]
+            settled = len(blobs)
+            self.pending = 0
+            self.invalid += dropped
+            self.flushes[trigger] += 1
+            self.flush_batches = (self.flush_batches + [settled])[-32:]
+            self._last_flush = time.monotonic()
+        PENDING.set(0)
+        FLUSHES.with_labels(trigger).inc()
+        FLUSH_BATCH.observe(settled)
+        FLUSH_SECONDS.observe(time.monotonic() - t0)
+        if dropped:
+            INVALID.inc(dropped)
+        return settled
+
+    # ------------------------------------------------------------ presum
+
+    def maybe_presum(self, sets):
+        """Collapse multi-pubkey SignatureSets to one aggregate pubkey
+        each (identity-preserving — the verifier aggregates per-set
+        pubkeys anyway) when the presum kernel is enabled."""
+        from ..crypto.tpu import aggregation as ta
+
+        if not sets or not ta.presum_enabled():
+            return sets
+        rows = [s.pubkeys for s in sets if len(s.pubkeys) > 1]
+        if not rows:
+            return sets
+        from ..crypto.ref.bls import SignatureSet
+
+        sums = ta.aggregate_pubkeys(rows)
+        out, it = [], iter(sums)
+        for s in sets:
+            if len(s.pubkeys) > 1:
+                agg = next(it)
+                # an infinity sum means a degenerate set — hand the
+                # original through so the verifier's own checks decide
+                out.append(
+                    s if agg is None
+                    else SignatureSet(s.signature, [agg], s.message)
+                )
+            else:
+                out.append(s)
+        with self._lock:
+            self.presums += len(rows)
+        PRESUMS.inc(len(rows))
+        return out
+
+    # ----------------------------------------------------- housekeeping
+
+    def prune(self, current_epoch):
+        """Drop entries that can no longer be included; pending counts
+        follow the surviving contributions."""
+        with self._lock:
+            for key in list(self.entries):
+                kept = [
+                    e
+                    for e in self.entries[key]
+                    if e["att"].data.target.epoch + 1 >= current_epoch
+                ]
+                if kept:
+                    self.entries[key] = kept
+                else:
+                    del self.entries[key]
+            self.pending = sum(
+                len(e["contribs"])
+                for entries in self.entries.values()
+                for e in entries
+                if not e["validated"]
+            )
+        PENDING.set(self.pending)
+
+    def iter_contributions(self):
+        """(template attestation, bits, sig bytes) per contribution —
+        the snapshot surface: one synthetic attestation per contribution
+        round-trips pending-unflushed state exactly (restore re-inserts,
+        and the bits-only grouping rule reproduces the entries)."""
+        with self._lock:
+            for entries in self.entries.values():
+                for entry in entries:
+                    for b, sig in entry["contribs"]:
+                        yield entry["att"], b, sig
+
+    def stats(self):
+        with self._lock:
+            from ..crypto.tpu import aggregation as ta
+
+            return {
+                "inserts": self.inserts,
+                "pending_contributions": self.pending,
+                "entries": sum(len(v) for v in self.entries.values()),
+                "data_roots": len(self.entries),
+                "flushes": dict(self.flushes),
+                "last_flush_batches": list(self.flush_batches),
+                "invalid_dropped": self.invalid,
+                "pubkey_presums": self.presums,
+                "device_enabled": ta.device_enabled(),
+                "presum_enabled": ta.presum_enabled(),
+                "flush_interval_seconds": self.flush_interval,
+                "flush_threshold": self.flush_threshold,
+            }

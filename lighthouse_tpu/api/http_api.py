@@ -134,6 +134,9 @@ class _Handler(JsonHandler):
 
         T = state_types(chain.preset)
         if path == "/eth/v1/beacon/pool/attestations":
+            # settle pending contributions so listed signatures are real
+            if hasattr(pool, "aggregation"):
+                pool.aggregation.flush("read")
             atts = [entry["att"] for entries in pool.attestations.values()
                     for entry in entries]
             self._json({"data": [
@@ -814,6 +817,12 @@ class _Handler(JsonHandler):
             data["enabled"] = True
             return self._json({"data": data})
 
+        if path == "/lighthouse/aggregation":
+            # million-validator aggregation tier: accumulator depth,
+            # flush triggers/batches, invalid-drop and presum counters,
+            # and the device/flush-policy knobs in force
+            self._json({"data": chain.op_pool.aggregation.stats()})
+            return True
         if path == "/lighthouse/compile-cache":
             # compile-lifecycle status: the persistent AOT executable
             # cache (hits/misses/loaded programs), the canonical shape

@@ -152,6 +152,11 @@ class BeaconProcessor:
         handled += self._drain_blocks()
         handled += self._drain_verify_batches()
         handled += self._retry_reprocess()
+        # aggregation tier: periodic flush tick (threshold / interval
+        # policy lives in the tier; a quiet tick is a cheap no-op)
+        pool = getattr(self.chain, "op_pool", None)
+        if pool is not None and hasattr(pool, "maybe_flush"):
+            pool.maybe_flush()
         return handled
 
     def _process_block_event(self, ev):

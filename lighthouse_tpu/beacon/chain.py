@@ -394,6 +394,10 @@ class BeaconChain:
         sets = list(sets)
         if not sets:
             return lambda: (True, [])
+        # aggregation tier: collapse multi-pubkey sets to one aggregate
+        # pubkey on device (identity-preserving) before the service sees
+        # them — gated off unless the presum kernel wins on this backend
+        sets = self.op_pool.aggregation.maybe_presum(sets)
         v = self.verifier
         if not hasattr(v, "submit"):
             return lambda: verify_with_verdicts(v, sets, priority=priority)

@@ -47,14 +47,29 @@ class ValidatorPubkeyCache:
         the reference's refusal to cache undecodable keys."""
         if not compressed_keys:
             return
-        pts = [g1_decompress(bytes(k), subgroup_check=False) for k in compressed_keys]
+        # decompress + validate each DISTINCT encoding once: at
+        # million-validator registry scale the host decompression is the
+        # boot bottleneck, and synthetic/test registries tile a small key
+        # pool — real registries lose nothing (all keys distinct)
+        uniq = {}
+        for k in compressed_keys:
+            kb = bytes(k)
+            if kb not in uniq:
+                uniq[kb] = g1_decompress(kb, subgroup_check=False)
+        uniq_keys = list(uniq)
+        uniq_pts = [uniq[kb] for kb in uniq_keys]
         if self._validate == "device":
-            dev = cv.g1_from_ints(pts)
-            ok = np.asarray(tb._jit_validate_pk(dev))
+            dev = cv.g1_from_ints(uniq_pts)
+            uniq_ok = np.asarray(tb._jit_validate_pk(dev))
         else:
             from ..crypto.ref.curves import g1_in_subgroup
 
-            ok = np.array([p is not None and g1_in_subgroup(p) for p in pts])
+            uniq_ok = np.array(
+                [p is not None and g1_in_subgroup(p) for p in uniq_pts]
+            )
+        ok_of = dict(zip(uniq_keys, uniq_ok))
+        pts = [uniq[bytes(k)] for k in compressed_keys]
+        ok = np.array([bool(ok_of[bytes(k)]) for k in compressed_keys])
         if not ok.all():
             bad = [i for i, v in enumerate(ok) if not v]
             raise ValueError(f"invalid pubkeys at batch offsets {bad}")

@@ -1,0 +1,202 @@
+"""Device-batched aggregation kernels for the million-validator tier.
+
+Two planner-shaped programs back `lighthouse_tpu/aggregation/` (the lazy
+accumulator behind `OperationPool`):
+
+* **G2 segment aggregation** — all pending attestation signatures across
+  every pool entry decompress in ONE `g2_decompress_batch` pass (WITH the
+  psi-based subgroup check — this is where the trust boundary sits, see
+  aggregation/tier.py), then a gather scatters the lanes into a
+  (segments, width) grid whose tree-reduction of complete Jacobian adds
+  yields one aggregate point per pool entry.  Invalid lanes are masked to
+  infinity so a bad contribution never poisons its segment.
+* **G1 multi-scalar pubkey aggregation** — a set's pubkey rows gather
+  their Montgomery limbs from `bls.PK_CACHE` (`_g1_pad_dev`), tree-reduce
+  on device, and come back as one affine point per set, letting
+  `verify_service` see pre-aggregated single-pubkey sets.
+
+Both kernels draw every shape from `compile_cache.ShapePlanner` menus
+(`plan_lanes` for batch axes, `plan_pks` for the ragged width) and compile
+through `CachedKernel`, so flush traffic shares the same bounded AOT
+program menu as the verify path.
+
+Backend economics mirror decompress.py: on the CPU backend the host
+oracle wins, so `device_enabled()` defaults the device path off unless
+running on an accelerator (`LTPU_AGG_DEVICE=1/0/auto` overrides).  Host
+and device paths are value-identical: same decompression oracle (the
+device kernel is differentially tested against it), the tree reduction
+computes the same sum as sequential addition, and compression is
+canonical — equal points always re-compress to equal bytes.
+"""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ref import curves as rc
+from . import bls as tb
+from . import compile_cache as cc
+from . import curve as cv
+from . import decompress as dc
+from . import fp
+from . import tower as tw
+
+
+def device_enabled():
+    """Run aggregation flushes on device?  `auto` says yes only off-CPU
+    (same measured economics as the decompress kernel)."""
+    mode = os.environ.get("LTPU_AGG_DEVICE", "auto")
+    if mode == "1":
+        return True
+    if mode == "0":
+        return False
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:  # noqa: BLE001 — no usable device: host path
+        return False
+
+
+def presum_enabled():
+    """Collapse multi-pubkey sets to one aggregate pubkey before
+    verify_service submission?  (`LTPU_AGG_PRESUM=1/0/auto`.)"""
+    mode = os.environ.get("LTPU_AGG_PRESUM", "auto")
+    if mode == "1":
+        return True
+    if mode == "0":
+        return False
+    return device_enabled()
+
+
+# ------------------------------------------------------ G2 segment sums
+
+
+def _g2_masked_sum_kernel(p, mask):
+    """(NLIMB, S, M) Jacobian G2 grid + (S, M) validity mask -> per-row
+    affine (x, y) + infinity flags.  Masked lanes zero Z (the complete
+    add absorbs infinity), so a row sums exactly its valid lanes."""
+    x, y, z = p
+    m = mask.astype(fp.I32)
+    z = (z[0] * m, z[1] * m)
+    s = cv.point_tree_sum(cv.F2_OPS, (x, y, z), axis=-1)
+    inf = cv.is_inf(cv.F2_OPS, s)
+    ax, ay = cv.to_affine_xy(cv.F2_OPS, s, tw.f2_inv)
+    return ax, ay, inf
+
+
+_jit_g2_masked_sum = cc.CachedKernel("agg_g2_masked_sum", _g2_masked_sum_kernel)
+
+
+def _f2_to_ints(c, inf):
+    """Host: Fp2 limb pair (NLIMB, S) -> list of (c0, c1) int pairs."""
+    c0 = cv._fp_host(c[0])
+    c1 = cv._fp_host(c[1])
+    return [None if i else (a, b) for i, a, b in zip(inf, c0, c1)]
+
+
+def _device_aggregate_segments(blobs, seg_of, n_segments):
+    pts, ok = dc.g2_decompress_batch(blobs, subgroup_check=True)
+    lanes = [[] for _ in range(n_segments)]
+    for lane, seg in enumerate(seg_of):
+        if ok[lane]:
+            lanes[seg].append(lane)
+    width = max((len(row) for row in lanes), default=1) or 1
+    planner = cc.get_planner()
+    M = planner.plan_pks(width)
+    S = planner.plan_lanes(n_segments)
+    idx = np.zeros((S, M), np.int32)
+    mask = np.zeros((S, M), np.int32)
+    for seg, row in enumerate(lanes):
+        idx[seg, : len(row)] = row
+        mask[seg, : len(row)] = 1
+    flat = jnp.asarray(idx.reshape(-1))
+    grid = jax.tree_util.tree_map(
+        lambda a: jnp.take(a, flat, axis=1).reshape(a.shape[0], S, M), pts
+    )
+    ax, ay, inf = _jit_g2_masked_sum(grid, jnp.asarray(mask))
+    infs = np.asarray(inf).reshape(-1)[:n_segments]
+    xs = _f2_to_ints(ax, infs)[:n_segments]
+    ys = _f2_to_ints(ay, infs)[:n_segments]
+    sums = [
+        None if (i or x is None) else (x, y) for i, x, y in zip(infs, xs, ys)
+    ]
+    return sums, np.asarray(ok)
+
+
+def _host_aggregate_segments(blobs, seg_of, n_segments):
+    ok = np.zeros(len(blobs), bool)
+    sums = [None] * n_segments
+    for i, (blob, seg) in enumerate(zip(blobs, seg_of)):
+        try:
+            p = rc.g2_decompress(bytes(blob), subgroup_check=True)
+        except Exception:  # noqa: BLE001 — undecodable = invalid lane
+            continue
+        ok[i] = True
+        sums[seg] = rc.g2_add(sums[seg], p)
+    return sums, ok
+
+
+def aggregate_segments(blobs, seg_of, n_segments):
+    """Batched decompress + per-segment aggregation of compressed G2
+    signatures.  `seg_of[i]` names the segment (pool entry) blob `i`
+    contributes to.  Returns (per-segment affine-int points — None for
+    empty/infinity — and a per-blob validity mask).  Every blob is
+    subgroup-checked exactly once, here."""
+    if not blobs:
+        return [None] * n_segments, np.zeros(0, bool)
+    if device_enabled():
+        return _device_aggregate_segments(blobs, seg_of, n_segments)
+    return _host_aggregate_segments(blobs, seg_of, n_segments)
+
+
+# ----------------------------------------------- G1 multi-scalar presum
+
+
+def _g1_sum_kernel(p):
+    s = cv.point_tree_sum(cv.FP_OPS, p, axis=-1)
+    inf = cv.is_inf(cv.FP_OPS, s)
+    ax, ay = cv.to_affine_xy(cv.FP_OPS, s, fp.inv)
+    return ax, ay, inf
+
+
+_jit_g1_sum = cc.CachedKernel("agg_g1_sum", _g1_sum_kernel)
+
+
+def _device_aggregate_pubkeys(rows):
+    planner = cc.get_planner()
+    width = max((len(r) for r in rows), default=1) or 1
+    S = planner.plan_sets(len(rows))
+    M = planner.plan_pks(width)
+    padded = list(rows) + [[]] * (S - len(rows))
+    grid = tb._g1_pad_dev(padded, M)
+    ax, ay, inf = _jit_g1_sum(grid)
+    infs = np.asarray(inf).reshape(-1)[: len(rows)]
+    xs = cv._fp_host(ax)[: len(rows)]
+    ys = cv._fp_host(ay)[: len(rows)]
+    return [
+        None if i else (x, y) for i, x, y in zip(infs, xs, ys)
+    ]
+
+
+def aggregate_pubkeys(rows):
+    """Per-row G1 aggregation of affine-int pubkeys (the multi-scalar
+    presum feeding verify_service pre-aggregated sets).  Rows gather
+    Montgomery limbs from the PK_CACHE; the host fallback is the oracle
+    sequential add — identical sums either way."""
+    if not rows:
+        return []
+    if device_enabled():
+        return _device_aggregate_pubkeys(rows)
+    out = []
+    for row in rows:
+        acc = None
+        for pk in row:
+            acc = rc.g1_add(acc, pk)
+        out.append(acc)
+    return out
+
+
+def kernel_specs():
+    """Names of this module's cached kernels (prewarm/introspection)."""
+    return ("agg_g2_masked_sum", "agg_g1_sum")

@@ -4,59 +4,76 @@ Holds gossip-verified operations between blocks and packs them for block
 production: `get_attestations` runs weighted max-cover over per-committee
 aggregates (lib.rs:248,330); slashings/exits dedup on the offending index
 and re-check slashability at extraction.
+
+Attestation aggregation is delegated to the **million-validator
+aggregation tier** (`lighthouse_tpu/aggregation/`): inserts are O(bytes)
+lazy accumulation of compressed signatures + uint8 bitsets, and the curve
+math runs in device-batched flushes triggered periodically or on-demand
+at every read below.
+
+Trust boundary: the old per-insert `g2_decompress(subgroup_check=False)`
+round-trip is gone entirely — signature points accumulated for batched
+aggregation are subgroup-checked exactly ONCE, batched, at flush time,
+before any aggregate built from them is returned to callers (block
+packing, the VC aggregate duty, or — through those — `verify_service`).
+Invalid contributions are dropped individually at that boundary; see
+aggregation/tier.py for the full policy.
 """
 
-from collections import defaultdict
+import numpy as np
 
-from ..ssz import hash_tree_root
+from ..aggregation import AggregationTier
 from ..state_processing import phase0 as sp
 from .max_cover import MaxCoverItem, maximum_cover
 
 
 def _bits_or(a, b):
-    return [x | y for x, y in zip(a, b)]
+    """uint8 vectorized OR (per-insert hot path — no Python element loop)."""
+    return np.bitwise_or(
+        np.asarray(list(a), dtype=np.uint8), np.asarray(list(b), dtype=np.uint8)
+    )
 
 
 def _bits_overlap(a, b):
-    return any(x & y for x, y in zip(a, b))
+    """uint8 vectorized AND-any (per-insert hot path)."""
+    return bool(
+        np.bitwise_and(
+            np.asarray(list(a), dtype=np.uint8),
+            np.asarray(list(b), dtype=np.uint8),
+        ).any()
+    )
 
 
 class OperationPool:
     def __init__(self, spec):
         self.spec = spec
-        # keyed by attestation data root -> list of (bits, attestation)
-        self.attestations = defaultdict(list)
+        self.aggregation = AggregationTier(spec)
         self.proposer_slashings = {}      # proposer index -> slashing
         self.attester_slashings = []
         self.voluntary_exits = {}         # validator index -> signed exit
         self.bls_to_execution_changes = {}  # validator index -> signed change
 
+    @property
+    def attestations(self):
+        """data root -> list of {"bits", "att", ...} entries (the tier's
+        map — same shape the naive pool exposed)."""
+        return self.aggregation.entries
+
     # ---------------------------------------------------------- insertion
 
     def insert_attestation(self, attestation):
-        """Naive aggregation: merge into an existing compatible aggregate
-        when bitsets are disjoint (naive_aggregation_pool.rs semantics),
-        else store alongside."""
-        from ..crypto.ref import bls as RB
-        from ..crypto.ref.curves import g2_compress, g2_decompress
+        """O(bytes) lazy accumulation: the tier picks the entry with the
+        naive pool's bits-only greedy disjoint-merge rule and defers the
+        curve math to the next batched flush."""
+        self.aggregation.insert(attestation)
 
-        key = hash_tree_root(attestation.data)
-        bits = list(attestation.aggregation_bits)
-        for entry in self.attestations[key]:
-            if not _bits_overlap(entry["bits"], bits):
-                agg = RB.aggregate(
-                    [
-                        g2_decompress(bytes(entry["att"].signature), subgroup_check=False),
-                        g2_decompress(bytes(attestation.signature), subgroup_check=False),
-                    ]
-                )
-                entry["att"].aggregation_bits = _bits_or(entry["bits"], bits)
-                entry["att"].signature = g2_compress(agg)
-                entry["bits"] = list(entry["att"].aggregation_bits)
-                return
-        self.attestations[key].append(
-            {"bits": bits, "att": attestation.copy()}
-        )
+    def maybe_flush(self):
+        """Periodic flush tick (threshold / interval policy) — wired into
+        the beacon processor's manager pass."""
+        return self.aggregation.maybe_flush()
+
+    def flush(self, trigger="manual"):
+        return self.aggregation.flush(trigger)
 
     def insert_proposer_slashing(self, slashing):
         self.proposer_slashings[
@@ -91,11 +108,13 @@ class OperationPool:
     def get_aggregate(self, data_root):
         """Best (most-participated) aggregate for an attestation-data root
         — the naive_aggregation_pool read the VC's aggregation duty uses
-        (GET /eth/v1/validator/aggregate_attestation)."""
-        entries = self.attestations.get(bytes(data_root), [])
+        (GET /eth/v1/validator/aggregate_attestation).  Flushes pending
+        contributions first so the returned signature is settled."""
+        self.aggregation.flush("read")
+        entries = self.aggregation.entries.get(bytes(data_root), [])
         if not entries:
             return None
-        best = max(entries, key=lambda e: sum(e["bits"]))
+        best = max(entries, key=lambda e: int(np.sum(e["bits"])))
         # copy: the pool keeps merging into the live entry (two-field
         # mutation) while API threads encode/re-insert the returned object
         return best["att"].copy()
@@ -104,10 +123,11 @@ class OperationPool:
         """Weighted max-cover packing (lib.rs get_attestations + AttMaxCover):
         cover = attesting validators not yet covered, weighted by base
         reward; prev/current epoch packed separately then concatenated."""
+        self.aggregation.flush("pack")
         current_epoch = sp.get_current_epoch(state, preset)
         prev_epoch = sp.get_previous_epoch(state, preset)
         items_cur, items_prev = [], []
-        for entries in self.attestations.values():
+        for entries in self.aggregation.entries.values():
             for entry in entries:
                 att = entry["att"]
                 data = att.data
@@ -172,8 +192,12 @@ class OperationPool:
 
     def snapshot(self):
         """SSZ-hex snapshot of every pooled op (persistence.rs
-        PersistedOperationPool)."""
+        PersistedOperationPool).  Pending-unflushed contributions are
+        emitted one-attestation-per-contribution, so restore's re-inserts
+        reproduce the exact accumulator state (same bits-only grouping)
+        without forcing a flush here."""
         from ..ssz import encode
+
         from ..types.containers import (
             AttesterSlashing,
             ProposerSlashing,
@@ -181,9 +205,11 @@ class OperationPool:
         )
 
         atts = []
-        for entries in self.attestations.values():
-            for e in entries:
-                atts.append(encode(type(e["att"]), e["att"]).hex())
+        for template, bits, sig in self.aggregation.iter_contributions():
+            att = template.copy()
+            att.aggregation_bits = [int(x) for x in bits]
+            att.signature = sig
+            atts.append(encode(type(att), att).hex())
         return {
             "attestations": atts,
             "proposer_slashings": {
@@ -211,10 +237,7 @@ class OperationPool:
         T = state_types(self.spec.preset)
         for blob in snap.get("attestations", []):
             att = decode(T.Attestation, bytes.fromhex(blob))
-            key = hash_tree_root(att.data)
-            self.attestations[key].append(
-                {"bits": list(att.aggregation_bits), "att": att}
-            )
+            self.aggregation.insert(att)
         for i, blob in snap.get("proposer_slashings", {}).items():
             self.proposer_slashings[int(i)] = decode(
                 ProposerSlashing, bytes.fromhex(blob)
@@ -232,16 +255,7 @@ class OperationPool:
         """Drop operations that can no longer be included (persistence.rs
         prune_all semantics)."""
         current_epoch = sp.get_current_epoch(state, preset)
-        for key in list(self.attestations):
-            kept = [
-                e
-                for e in self.attestations[key]
-                if e["att"].data.target.epoch + 1 >= current_epoch
-            ]
-            if kept:
-                self.attestations[key] = kept
-            else:
-                del self.attestations[key]
+        self.aggregation.prune(current_epoch)
         self.voluntary_exits = {
             i: e
             for i, e in self.voluntary_exits.items()

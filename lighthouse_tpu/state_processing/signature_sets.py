@@ -17,6 +17,8 @@ kernel performs the per-call G2 subgroup check exactly like blst
 (impls/blst.rs:73-77).
 """
 
+from functools import lru_cache
+
 from ..crypto.ref.bls import SignatureSet
 from ..crypto.ref.curves import g2_decompress
 from ..ssz import hash_tree_root, uint64
@@ -40,10 +42,18 @@ def _pubkey(get_pubkey, index):
     return pk
 
 
+@lru_cache(maxsize=4096)
+def _decompress_cached(signature_bytes):
+    """Decompression is deterministic and points are immutable tuples, so
+    recurring encodings (a re-gossiped aggregate, a replayed batch) skip
+    the ~ms host Fp2 square root on repeat sightings."""
+    return g2_decompress(signature_bytes, subgroup_check=False)
+
+
 def _sig(signature_bytes):
     if isinstance(signature_bytes, (bytes, bytearray)):
         try:
-            return g2_decompress(bytes(signature_bytes), subgroup_check=False)
+            return _decompress_cached(bytes(signature_bytes))
         except Exception as e:  # noqa: BLE001 — mirror DecodeError surface
             raise SignatureSetError(f"undecodable signature: {e}") from e
     return signature_bytes  # already an affine point / None

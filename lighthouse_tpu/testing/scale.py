@@ -21,17 +21,58 @@ FAR = 2**64 - 1
 MAX_EB = 32 * 10**9
 
 
-def make_scaled_state(n_validators, spec, epoch=4, participation=0.99, seed=0):
+def make_pubkey_pool(k=64, seed=0):
+    """(k, 48) uint8 array of DISTINCT VALID compressed G1 pubkeys —
+    generator multiples, built with k cheap incremental adds.  Scaled
+    registries tile this pool so pubkey-cache import (which dedupes by
+    encoding) and PK_CACHE gathers see real curve points at any N."""
+    from ..crypto.ref.curves import G1_GEN, g1_add, g1_compress
+
+    out = np.empty((k, 48), np.uint8)
+    p = G1_GEN
+    for i in range(k):
+        out[i] = np.frombuffer(g1_compress(p), np.uint8)
+        p = g1_add(p, G1_GEN)
+    return out
+
+
+def make_signature_pool(k=256):
+    """k distinct valid compressed G2 points (generator multiples via
+    incremental adds) — synthetic gossip signatures and selection
+    proofs.  Not signatures OVER anything: scale replays run against a
+    fake/verdict-free backend; the pool keeps every decompress path
+    (insert, flush, signature-set construction) on real curve points."""
+    from ..crypto.ref.curves import G2_GEN, g2_add, g2_compress
+
+    out = []
+    p = G2_GEN
+    for _ in range(k):
+        out.append(g2_compress(p))
+        p = g2_add(p, G2_GEN)
+    return out
+
+
+def make_scaled_state(n_validators, spec, epoch=4, participation=0.99, seed=0,
+                      pubkey_pool=None, fork="phase0"):
     """A BeaconState at the start of `epoch` with a full previous-epoch
-    attestation load at the given participation rate."""
+    attestation load at the given participation rate.
+
+    `pubkey_pool` (from `make_pubkey_pool`) tiles valid pubkeys across
+    the registry instead of random bytes; `fork="altair"` builds the
+    Altair container (dense participation flags, zero inactivity scores,
+    sync committees drawn from the registry) so sync-committee traffic
+    has a home."""
     preset = spec.preset
     T = state_types(preset)
     rng = np.random.default_rng(seed)
 
-    state = T.BeaconState()
+    state = T.BeaconStateAltair() if fork == "altair" else T.BeaconState()
     reg = state.validators
     cap = max(16, 1 << max(n_validators - 1, 1).bit_length())
-    reg.pubkey = rng.integers(0, 256, (cap, 48), dtype=np.int64).astype(np.uint8)
+    if pubkey_pool is not None:
+        reg.pubkey = pubkey_pool[np.arange(cap) % len(pubkey_pool)]
+    else:
+        reg.pubkey = rng.integers(0, 256, (cap, 48), dtype=np.int64).astype(np.uint8)
     reg.withdrawal_credentials = np.zeros((cap, 32), np.uint8)
     reg.effective_balance = np.full(cap, MAX_EB, np.uint64)
     reg.slashed = np.zeros(cap, bool)
@@ -74,8 +115,156 @@ def make_scaled_state(n_validators, spec, epoch=4, participation=0.99, seed=0):
     )
     state.justification_bits = [1, 1, 0, 0]
 
-    fill_epoch_attestations(state, prev_epoch, spec, participation, rng, target="previous")
+    if fork == "altair":
+        for name in ("previous_epoch_participation",
+                     "current_epoch_participation"):
+            part = getattr(state, name)
+            part._a = np.full(cap, 0b111, np.uint8)   # source|target|head
+            part._n = n_validators
+            part.dirty = set(range(n_validators))
+            part.rev += 1
+        scores = state.inactivity_scores
+        scores._a = np.zeros(cap, np.uint64)
+        scores._n = n_validators
+        scores.dirty = set(range(n_validators))
+        scores.rev += 1
+        size = preset.sync_committee_size
+        members = [bytes(reg.pubkey[i % n_validators]) for i in range(size)]
+        agg = bytes(reg.pubkey[0])
+        state.current_sync_committee = T.SyncCommittee(
+            pubkeys=members, aggregate_pubkey=agg
+        )
+        state.next_sync_committee = T.SyncCommittee(
+            pubkeys=members, aggregate_pubkey=agg
+        )
+    else:
+        fill_epoch_attestations(
+            state, prev_epoch, spec, participation, rng, target="previous"
+        )
     return state
+
+
+def make_epoch_traffic(state, spec, head_root, *, aggregates_per_committee=2,
+                       singles_per_committee=2, sync_slots=2, seed=0,
+                       sig_pool=None):
+    """Synthesize a full epoch of gossip-shaped traffic for the state's
+    current epoch: SignedAggregateAndProof batches (selection proofs
+    drawn from the valid-point pool so `_is_aggregator` passes),
+    unaggregated single-bit attestations from distinct validators (the
+    chain's observed-attester dedup admits each validator once per
+    epoch), and — on an Altair state — sync-committee messages for the
+    current committee.
+
+    Every signature/proof is a valid compressed G2 point from
+    `sig_pool`; `beacon_block_root`/`target.root` are `head_root` (the
+    only block fork choice knows on a fresh chain).  Returns
+    {"aggregates", "attestations", "sync_messages"}."""
+    import hashlib
+
+    from ..types.containers import (
+        AggregateAndProof,
+        SignedAggregateAndProof,
+        SyncCommitteeMessage,
+    )
+
+    preset = spec.preset
+    T = state_types(preset)
+    rng = np.random.default_rng(seed)
+    head_root = bytes(head_root)
+    epoch = int(state.slot) // preset.slots_per_epoch
+    cache = committees_for_epoch(state, epoch, preset)
+    target = Checkpoint(epoch=epoch, root=head_root)
+    source = state.current_justified_checkpoint
+    if sig_pool is None:
+        sig_pool = make_signature_pool(256)
+
+    proof_of = {}          # is_aggregator modulo -> passing proof
+
+    def proof_for(committee_len):
+        modulo = max(1, committee_len // 16)
+        if modulo not in proof_of:
+            proof_of[modulo] = next(
+                (
+                    cand for cand in sig_pool
+                    if int.from_bytes(
+                        hashlib.sha256(cand).digest()[:8], "little"
+                    ) % modulo == 0
+                ),
+                sig_pool[0],
+            )
+        return proof_of[modulo]
+
+    aggregates, singles = [], []
+    used_aggregators, used_attesters = set(), set()
+    si = 0
+    for slot in range(epoch * preset.slots_per_epoch,
+                      (epoch + 1) * preset.slots_per_epoch):
+        for index in range(cache.committees_per_slot):
+            committee = cache.committee(slot, index)
+            clen = len(committee)
+            data = AttestationData(
+                slot=slot, index=index, beacon_block_root=head_root,
+                source=source, target=target,
+            )
+            fresh = [int(v) for v in committee if int(v) not in used_aggregators]
+            for j in range(min(aggregates_per_committee, len(fresh))):
+                bits = (rng.random(clen) < 0.75).astype(int).tolist()
+                bits[j % clen] = 1
+                used_aggregators.add(fresh[j])
+                aggregates.append(SignedAggregateAndProof(
+                    message=AggregateAndProof(
+                        aggregator_index=fresh[j],
+                        aggregate=T.Attestation(
+                            aggregation_bits=bits, data=data,
+                            signature=sig_pool[si % len(sig_pool)],
+                        ),
+                        selection_proof=proof_for(clen),
+                    ),
+                    signature=sig_pool[(si + 1) % len(sig_pool)],
+                ))
+                si += 1
+            picked = 0
+            for pos in range(clen):
+                if picked == singles_per_committee:
+                    break
+                if int(committee[pos]) in used_attesters:
+                    continue
+                used_attesters.add(int(committee[pos]))
+                bits = [0] * clen
+                bits[pos] = 1
+                singles.append(T.Attestation(
+                    aggregation_bits=bits, data=data,
+                    signature=sig_pool[si % len(sig_pool)],
+                ))
+                si += 1
+                picked += 1
+
+    sync_messages = []
+    if hasattr(state, "current_sync_committee"):
+        from ..state_processing import altair
+
+        committee_indices = altair.sync_committee_validator_indices(
+            state, preset
+        )
+        base_slot = int(state.slot)
+        for off in range(sync_slots):
+            seen = set()
+            for vi in committee_indices:
+                vi = int(vi)
+                if vi in seen:
+                    continue
+                seen.add(vi)
+                sync_messages.append(SyncCommitteeMessage(
+                    slot=base_slot + off, beacon_block_root=head_root,
+                    validator_index=vi,
+                    signature=sig_pool[si % len(sig_pool)],
+                ))
+                si += 1
+    return {
+        "aggregates": aggregates,
+        "attestations": singles,
+        "sync_messages": sync_messages,
+    }
 
 
 def build_full_block(state, spec, participation=0.99, seed=1):

@@ -1,0 +1,357 @@
+"""Million-validator aggregation tier: differential property tests.
+
+The tier (`lighthouse_tpu/aggregation/`) replaces the naive pool's
+per-insert host curve math with O(bytes) lazy accumulation + batched
+flushes.  The frozen pre-tier pool (`testing/naive_pool`) is the oracle:
+for any seeded random insert/flush sequence over valid signatures, the
+settled tier state must be BYTE-identical to the naive pool's
+incremental aggregates.  On top of that: the flush-time trust boundary
+(invalid contributions dropped individually, exactly-once subgroup
+check), snapshot/restore of pending-unflushed state, the
+threshold/interval flush policy + env knobs, the numpy bits helpers,
+the pubkey presum, and the /lighthouse/aggregation route.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.aggregation import bits_of, bits_or, bits_overlap
+from lighthouse_tpu.aggregation.tier import AggregationTier
+from lighthouse_tpu.operation_pool import OperationPool
+from lighthouse_tpu.operation_pool.pool import _bits_or, _bits_overlap
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.testing.naive_pool import NaiveAggregationPool
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+from lighthouse_tpu.types.containers import AttestationData, Checkpoint
+from lighthouse_tpu.types.state import state_types
+
+SPEC = ChainSpec(preset=MinimalPreset)
+T = state_types(MinimalPreset)
+CLEN = 16
+
+
+@pytest.fixture(scope="module")
+def sig_pool():
+    from lighthouse_tpu.testing.scale import make_signature_pool
+
+    return make_signature_pool(32)
+
+
+def _data(index=0, root=b"\x11" * 32, slot=0):
+    return AttestationData(
+        slot=slot, index=index, beacon_block_root=root,
+        source=Checkpoint(epoch=0, root=b"\x00" * 32),
+        target=Checkpoint(epoch=0, root=root),
+    )
+
+
+def _att(bits, sig, data=None):
+    return T.Attestation(
+        aggregation_bits=list(bits), data=data or _data(), signature=sig
+    )
+
+
+def _single(i, sig, data=None, clen=CLEN):
+    bits = [0] * clen
+    bits[i] = 1
+    return _att(bits, sig, data)
+
+
+def _tier_pairs(pool):
+    """Same comparison surface as NaiveAggregationPool.packed_pairs."""
+    out = []
+    for entries in pool.attestations.values():
+        for e in entries:
+            out.append(
+                (tuple(int(b) for b in e["bits"]), bytes(e["att"].signature))
+            )
+    return sorted(out)
+
+
+# --------------------------------------------------- differential oracle
+
+
+def test_random_sequences_byte_identical_to_naive_pool(sig_pool):
+    """Seeded random insert/flush sequences — overlapping and disjoint
+    bitsets over several data roots, flushes interleaved at random —
+    settle to EXACTLY the bytes the old per-insert pool produced."""
+    rng = np.random.default_rng(0xA99)
+    for _round in range(3):
+        pool = OperationPool(SPEC)
+        naive = NaiveAggregationPool()
+        datas = [_data(index=i, root=bytes([0x20 + i]) * 32) for i in range(3)]
+        for _step in range(48):
+            data = datas[int(rng.integers(len(datas)))]
+            nbits = int(rng.integers(1, 6))
+            bits = [0] * CLEN
+            for pos in rng.choice(CLEN, size=nbits, replace=False):
+                bits[int(pos)] = 1
+            sig = sig_pool[int(rng.integers(len(sig_pool)))]
+            att = _att(bits, sig, data)
+            pool.insert_attestation(att)
+            naive.insert_attestation(att)
+            if rng.random() < 0.25:
+                pool.flush("test")  # interleaving must not change outcomes
+        pool.flush("final")
+        got = _tier_pairs(pool)
+        assert got == naive.packed_pairs()
+        assert pool.aggregation.pending == 0
+        assert pool.aggregation.invalid == 0
+
+
+def test_single_contribution_keeps_original_bytes(sig_pool):
+    """An entry settled from ONE contribution must keep the signature
+    bytes it arrived with — no decompress/re-compress round-trip."""
+    pool = OperationPool(SPEC)
+    pool.insert_attestation(_single(0, sig_pool[0]))
+    pool.flush("test")
+    [(_, sig)] = _tier_pairs(pool)
+    assert sig == bytes(sig_pool[0])
+
+
+# ------------------------------------------------ trust boundary (flush)
+
+
+def test_invalid_contribution_dropped_individually(sig_pool):
+    """One poisoned gossip message sharing an entry with honest
+    signatures is dropped ALONE at the flush boundary: the entry's bits
+    are recomputed from the valid contributions and its aggregate is the
+    sum of only those."""
+    from lighthouse_tpu.crypto.ref import bls as RB
+    from lighthouse_tpu.crypto.ref.curves import g2_compress, g2_decompress
+
+    pool = OperationPool(SPEC)
+    data = _data()
+    off_curve = bytes([0x80]) + b"\xff" * 95  # compression flag, no point
+    pool.insert_attestation(_single(0, sig_pool[0], data))
+    pool.insert_attestation(_single(1, off_curve, data))
+    pool.insert_attestation(_single(2, sig_pool[1], data))
+    assert pool.flush("test") == 3
+
+    entries = pool.attestations[hash_tree_root(data)]
+    assert len(entries) == 1
+    bits = [int(b) for b in entries[0]["bits"]]
+    assert bits == [1, 0, 1] + [0] * (CLEN - 3)
+    agg = RB.aggregate(
+        [g2_decompress(sig_pool[0]), g2_decompress(sig_pool[1])]
+    )
+    assert bytes(entries[0]["att"].signature) == g2_compress(agg)
+    assert [int(b) for b in entries[0]["att"].aggregation_bits] == bits
+    assert pool.aggregation.invalid == 1
+
+
+def test_all_invalid_entry_removed():
+    pool = OperationPool(SPEC)
+    data = _data()
+    pool.insert_attestation(_single(0, bytes([0x80]) + b"\xfe" * 95, data))
+    pool.insert_attestation(_single(1, bytes([0x80]) + b"\xfd" * 95, data))
+    pool.flush("test")
+    assert hash_tree_root(data) not in dict(pool.attestations)
+    assert pool.aggregation.invalid == 2
+    assert pool.get_aggregate(hash_tree_root(data)) is None
+
+
+def test_validated_entries_not_rechecked(sig_pool, monkeypatch):
+    """Exactly-once: a second flush must not re-decompress already
+    settled contributions."""
+    import lighthouse_tpu.crypto.tpu.aggregation as ta
+
+    pool = OperationPool(SPEC)
+    pool.insert_attestation(_single(0, sig_pool[0]))
+    pool.insert_attestation(_single(1, sig_pool[1]))
+    pool.flush("test")
+
+    def _boom(*a, **k):  # pragma: no cover — must never run
+        raise AssertionError("settled contributions were re-validated")
+
+    monkeypatch.setattr(ta, "aggregate_segments", _boom)
+    assert pool.flush("again") == 0
+
+
+# -------------------------------------------- snapshot/restore (pending)
+
+
+def test_snapshot_restore_roundtrips_pending_unflushed_state(sig_pool):
+    pool = OperationPool(SPEC)
+    d1, d2 = _data(index=0), _data(index=1, root=b"\x33" * 32)
+    pool.insert_attestation(_single(0, sig_pool[0], d1))
+    pool.insert_attestation(_single(1, sig_pool[1], d1))  # merges (disjoint)
+    pool.insert_attestation(_single(1, sig_pool[2], d1))  # overlaps: new entry
+    pool.insert_attestation(_single(3, sig_pool[3], d2))
+    assert pool.aggregation.pending == 4
+
+    snap = pool.snapshot()
+    # one synthetic attestation per pending contribution
+    assert len(snap["attestations"]) == 4
+
+    clone = OperationPool(SPEC)
+    clone.restore(snap)
+    assert clone.aggregation.pending == 4
+
+    def acc_state(p):
+        return {
+            key: [
+                (
+                    tuple(int(b) for b in e["bits"]),
+                    [
+                        (tuple(int(x) for x in b), bytes(s))
+                        for b, s in e["contribs"]
+                    ],
+                )
+                for e in entries
+            ]
+            for key, entries in p.attestations.items()
+        }
+
+    assert acc_state(clone) == acc_state(pool)
+    pool.flush("a")
+    clone.flush("b")
+    assert _tier_pairs(clone) == _tier_pairs(pool)
+
+
+def test_snapshot_after_flush_roundtrips_settled_state(sig_pool):
+    pool = OperationPool(SPEC)
+    d = _data()
+    pool.insert_attestation(_single(0, sig_pool[0], d))
+    pool.insert_attestation(_single(1, sig_pool[1], d))
+    pool.flush("test")
+    clone = OperationPool(SPEC)
+    clone.restore(pool.snapshot())
+    clone.flush("test")
+    assert _tier_pairs(clone) == _tier_pairs(pool)
+
+
+# ------------------------------------------------- flush policy + knobs
+
+
+def test_maybe_flush_threshold_and_interval_triggers(sig_pool):
+    tier = AggregationTier(SPEC)
+    tier.flush_threshold = 3
+    tier.flush_interval = 1e9
+    assert tier.maybe_flush() == 0  # nothing pending
+    for i in range(2):
+        tier.insert(_single(i, sig_pool[i]))
+    assert tier.maybe_flush() == 0  # below threshold, interval far away
+    tier.insert(_single(2, sig_pool[2]))
+    assert tier.maybe_flush() == 3
+    assert tier.flushes["threshold"] == 1
+
+    tier.flush_interval = 0.0  # interval always elapsed
+    # overlapping bit -> fresh entry: exactly one pending contribution
+    tier.insert(_single(0, sig_pool[3]))
+    assert tier.maybe_flush() == 1
+    assert tier.flushes["interval"] == 1
+    assert tier.maybe_flush() == 0
+
+
+def test_env_knobs_configure_flush_policy(monkeypatch):
+    monkeypatch.setenv("LTPU_AGG_FLUSH_INTERVAL", "7.5")
+    monkeypatch.setenv("LTPU_AGG_FLUSH_THRESHOLD", "77")
+    tier = AggregationTier(SPEC)
+    assert tier.flush_interval == 7.5
+    assert tier.flush_threshold == 77
+    stats = tier.stats()
+    assert stats["flush_interval_seconds"] == 7.5
+    assert stats["flush_threshold"] == 77
+
+
+def test_reads_flush_on_demand(sig_pool):
+    """`get_aggregate` settles pending contributions before returning
+    the best (most-participated) aggregate."""
+    from lighthouse_tpu.crypto.ref import bls as RB
+    from lighthouse_tpu.crypto.ref.curves import g2_compress, g2_decompress
+
+    pool = OperationPool(SPEC)
+    d = _data()
+    pool.insert_attestation(_single(0, sig_pool[0], d))
+    pool.insert_attestation(_single(1, sig_pool[1], d))  # merges -> 2 bits
+    pool.insert_attestation(_single(1, sig_pool[2], d))  # second entry, 1 bit
+    best = pool.get_aggregate(hash_tree_root(d))
+    assert pool.aggregation.pending == 0
+    assert sum(int(b) for b in best.aggregation_bits) == 2
+    agg = RB.aggregate(
+        [g2_decompress(sig_pool[0]), g2_decompress(sig_pool[1])]
+    )
+    assert bytes(best.signature) == g2_compress(agg)
+    assert pool.get_aggregate(b"\x99" * 32) is None
+
+
+# ------------------------------------------------------------ bits + API
+
+
+def test_bits_helpers_are_vectorized_uint8():
+    a, b = [1, 0, 1, 0], [0, 1, 1, 0]
+    for or_, overlap in ((_bits_or, _bits_overlap), (bits_or, bits_overlap)):
+        out = or_(a, b)
+        assert isinstance(out, np.ndarray) and out.dtype == np.uint8
+        assert list(out) == [1, 1, 1, 0]
+        assert overlap(a, b) is True
+        assert overlap([1, 0, 0, 0], [0, 1, 0, 0]) is False
+    assert bits_of((1, 0, 1)).dtype == np.uint8
+
+
+def test_stats_surface(sig_pool):
+    tier = AggregationTier(SPEC)
+    tier.insert(_single(0, sig_pool[0]))
+    s = tier.stats()
+    assert s["inserts"] == 1 and s["pending_contributions"] == 1
+    tier.flush("manual")
+    s = tier.stats()
+    assert s["pending_contributions"] == 0
+    assert s["flushes"] == {"manual": 1}
+    assert s["last_flush_batches"] == [1]
+    assert isinstance(s["device_enabled"], bool)
+    assert isinstance(s["presum_enabled"], bool)
+
+
+def test_presum_collapses_multi_pubkey_sets(monkeypatch):
+    """Host-path presum: a multi-pubkey set becomes one aggregate-pubkey
+    set (same signature/message), single-pubkey sets pass untouched,
+    and disabling it is the identity."""
+    from lighthouse_tpu.crypto.ref.bls import SignatureSet
+    from lighthouse_tpu.crypto.ref.curves import g1_add, g1_decompress
+    from lighthouse_tpu.testing.scale import make_pubkey_pool
+
+    monkeypatch.setenv("LTPU_AGG_PRESUM", "1")
+    monkeypatch.setenv("LTPU_AGG_DEVICE", "0")
+    tier = AggregationTier(SPEC)
+    pks = [g1_decompress(bytes(pk)) for pk in make_pubkey_pool(4)]
+    multi = SignatureSet("sig-sentinel", pks[:3], b"\x01" * 32)
+    single = SignatureSet("other", [pks[3]], b"\x02" * 32)
+    out = tier.maybe_presum([multi, single])
+    assert out[1] is single
+    assert len(out[0].pubkeys) == 1
+    assert out[0].pubkeys[0] == g1_add(g1_add(pks[0], pks[1]), pks[2])
+    assert out[0].signature == "sig-sentinel"
+    assert out[0].message == b"\x01" * 32
+    assert tier.presums == 1
+
+    monkeypatch.setenv("LTPU_AGG_PRESUM", "0")
+    assert tier.maybe_presum([multi])[0] is multi
+    assert tier.maybe_presum([]) == []
+
+
+def test_aggregation_http_route(sig_pool):
+    """GET /lighthouse/aggregation serves the tier stats."""
+    from lighthouse_tpu.api.http_api import BeaconApiServer
+    from lighthouse_tpu.beacon.chain import BeaconChain
+    from lighthouse_tpu.crypto.backend import SignatureVerifier
+    from lighthouse_tpu.testing.harness import Harness
+
+    h = Harness(8, SPEC)
+    chain = BeaconChain(h.state.copy(), SPEC,
+                        verifier=SignatureVerifier("fake"))
+    chain.op_pool.insert_attestation(_single(0, sig_pool[0]))
+    server = BeaconApiServer(chain).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/lighthouse/aggregation") as r:
+            data = json.load(r)["data"]
+        assert data["inserts"] == 1
+        assert data["pending_contributions"] == 1
+        assert data["flush_threshold"] >= 1
+    finally:
+        server.stop()

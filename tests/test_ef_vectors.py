@@ -1,0 +1,186 @@
+"""EF consensus-spec-tests `ssz_static` runner.
+
+Point ``LTPU_EF_TESTS_DIR`` at an extracted consensus-spec-tests
+release (the directory that contains ``tests/<config>/<fork>/...``, or
+the ``tests/`` directory itself) and this module sweeps every
+``ssz_static`` case for the container types this repo defines: each
+``serialized.ssz_snappy`` (raw snappy block format — decoded with the
+repo's own `network/snappy`, no python-snappy needed) must decode,
+re-encode to the identical bytes, and hash_tree_root to the value in
+``roots.yaml`` (parsed with a two-line scanner, no pyyaml needed).
+
+When the env var is unset the EF sweep skips cleanly; a synthetic
+self-test generates a miniature vector tree in tmp_path so tier-1
+always exercises the walker/decoder/root pipeline itself.
+"""
+
+import os
+import re
+
+import pytest
+
+from lighthouse_tpu.network import snappy
+from lighthouse_tpu.ssz import decode, encode, hash_tree_root
+from lighthouse_tpu.types import ChainSpec, MainnetPreset, MinimalPreset
+from lighthouse_tpu.types import containers as C
+from lighthouse_tpu.types.state import state_types
+
+EF_DIR = os.environ.get("LTPU_EF_TESTS_DIR")
+
+_PRESETS = {"mainnet": MainnetPreset, "minimal": MinimalPreset}
+_FORK_SUFFIX = {
+    "phase0": "",
+    "altair": "Altair",
+    "bellatrix": "Bellatrix",
+    "capella": "Capella",
+}
+# fork-invariant containers with no preset-dependent bounds
+_PLAIN = (
+    "Fork", "ForkData", "SigningData", "Checkpoint", "AttestationData",
+    "BeaconBlockHeader", "SignedBeaconBlockHeader", "ProposerSlashing",
+    "DepositMessage", "DepositData", "VoluntaryExit", "SignedVoluntaryExit",
+    "BLSToExecutionChange", "SignedBLSToExecutionChange",
+    "SyncAggregatorSelectionData", "SyncCommitteeMessage",
+)
+
+
+def resolve_type(config, fork, name):
+    """EF (config, fork, type name) -> this repo's SSZ class, or None
+    when the type isn't modeled (the sweep counts those as skips)."""
+    preset = _PRESETS.get(config)
+    suffix = _FORK_SUFFIX.get(fork)
+    if preset is None or suffix is None:
+        return None
+    T = state_types(preset)
+    cls = getattr(T, name + suffix, None)  # fork-versioned (BeaconState...)
+    if cls is None:
+        cls = getattr(T, name, None)       # preset-bound, fork-invariant
+    if cls is None and name in _PLAIN:
+        cls = getattr(C, name, None)
+    return cls
+
+
+def parse_roots_yaml(text):
+    m = re.search(r"root:\s*['\"]?(0x[0-9a-fA-F]{64})", text)
+    if not m:
+        raise ValueError("no root in roots.yaml")
+    return bytes.fromhex(m.group(1)[2:])
+
+
+def iter_cases(root_dir):
+    """Yield (config, fork, type_name, case_dir) for every ssz_static
+    case directory under `root_dir`."""
+    for dirpath, _dirnames, filenames in os.walk(root_dir):
+        if "serialized.ssz_snappy" not in filenames:
+            continue
+        parts = dirpath.replace(os.sep, "/").split("/")
+        if "ssz_static" not in parts:
+            continue
+        i = parts.index("ssz_static")
+        config = next((p for p in parts[:i] if p in _PRESETS), None)
+        fork = next((p for p in parts[:i] if p in _FORK_SUFFIX), None)
+        if config is None or fork is None or i + 1 >= len(parts):
+            continue
+        yield config, fork, parts[i + 1], dirpath
+
+
+def run_case(cls, case_dir):
+    """Decode → re-encode byte-identity → hash_tree_root match."""
+    with open(os.path.join(case_dir, "serialized.ssz_snappy"), "rb") as f:
+        raw = snappy.decompress(f.read())
+    value = decode(cls, raw)
+    again = encode(cls, value)
+    assert bytes(again) == bytes(raw), f"{case_dir}: re-encode mismatch"
+    with open(os.path.join(case_dir, "roots.yaml")) as f:
+        expected = parse_roots_yaml(f.read())
+    got = hash_tree_root(value)
+    assert bytes(got) == expected, f"{case_dir}: root mismatch"
+
+
+def sweep(root_dir):
+    ran, skipped, failures = 0, 0, []
+    for config, fork, name, case_dir in iter_cases(root_dir):
+        cls = resolve_type(config, fork, name)
+        if cls is None:
+            skipped += 1
+            continue
+        try:
+            run_case(cls, case_dir)
+            ran += 1
+        except Exception as e:  # noqa: BLE001 — collect, report together
+            failures.append(f"{case_dir}: {e}")
+    return ran, skipped, failures
+
+
+@pytest.mark.skipif(
+    not EF_DIR, reason="LTPU_EF_TESTS_DIR not set (EF vectors absent)"
+)
+def test_ef_ssz_static_sweep():
+    ran, skipped, failures = sweep(EF_DIR)
+    assert not failures, "\n".join(failures[:20])
+    assert ran > 0, f"no runnable ssz_static cases under {EF_DIR}"
+
+
+# ------------------------------------------- synthetic self-test (tier-1)
+
+
+def _write_case(base, config, fork, cls, name, value, mutate_root=False):
+    d = os.path.join(base, "tests", config, fork, "ssz_static", name,
+                     "ssz_random", "case_0")
+    os.makedirs(d)
+    blob = encode(cls, value)
+    with open(os.path.join(d, "serialized.ssz_snappy"), "wb") as f:
+        f.write(snappy.compress(bytes(blob)))
+    root = bytearray(hash_tree_root(value))
+    if mutate_root:
+        root[0] ^= 0xFF
+    with open(os.path.join(d, "roots.yaml"), "w") as f:
+        f.write("{root: '0x%s'}\n" % bytes(root).hex())
+    return d
+
+
+def test_runner_on_synthetic_vectors(tmp_path):
+    """The walker/decoder/root pipeline end-to-end against vectors this
+    test generates itself — covers the runner without the EF release."""
+    base = str(tmp_path)
+    T = state_types(MainnetPreset)
+    cp = C.Checkpoint(epoch=7, root=b"\xaa" * 32)
+    data = C.AttestationData(
+        slot=3, index=1, beacon_block_root=b"\x22" * 32,
+        source=C.Checkpoint(epoch=0, root=b"\x00" * 32),
+        target=C.Checkpoint(epoch=1, root=b"\x22" * 32),
+    )
+    att = T.Attestation(
+        aggregation_bits=[1, 0, 1, 1], data=data,
+        signature=b"\xc0" + b"\x00" * 95,
+    )
+    _write_case(base, "mainnet", "phase0", C.Checkpoint, "Checkpoint", cp)
+    _write_case(base, "mainnet", "phase0", C.AttestationData,
+                "AttestationData", data)
+    _write_case(base, "mainnet", "phase0", T.Attestation, "Attestation", att)
+    # an unmodeled type must count as a skip, not a failure
+    _write_case(base, "mainnet", "phase0", C.Checkpoint, "NotARealType", cp)
+
+    ran, skipped, failures = sweep(base)
+    assert (ran, skipped, failures) == (3, 1, [])
+
+    # a corrupted root must surface as a failure
+    _write_case(base, "minimal", "altair", C.Checkpoint, "Checkpoint", cp,
+                mutate_root=True)
+    ran, skipped, failures = sweep(base)
+    assert ran == 3 and len(failures) == 1
+    assert "root mismatch" in failures[0]
+
+
+def test_resolve_type_fork_and_preset_binding():
+    main_att = resolve_type("mainnet", "phase0", "Attestation")
+    mini_att = resolve_type("minimal", "phase0", "Attestation")
+    assert main_att is not None and mini_att is not None
+    assert main_att is not mini_att  # preset-bound bitlist bound differs
+    assert resolve_type("mainnet", "altair", "BeaconState") is state_types(
+        MainnetPreset
+    ).BeaconStateAltair
+    assert resolve_type("mainnet", "phase0", "Checkpoint") is C.Checkpoint
+    assert resolve_type("mainnet", "phase0", "NoSuchThing") is None
+    assert resolve_type("weird", "phase0", "Checkpoint") is None
+    assert resolve_type("mainnet", "deneb", "Checkpoint") is None

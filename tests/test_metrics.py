@@ -126,6 +126,7 @@ def test_metric_name_lint():
     carries non-empty help text — new metrics can't silently break
     scrapes.  Importing the metrics-bearing modules first makes the lint
     cover the real registry, not just this file's test metrics."""
+    import lighthouse_tpu.aggregation.tier  # noqa: F401 (aggregation tier)
     import lighthouse_tpu.beacon.beacon_processor  # noqa: F401
     import lighthouse_tpu.beacon.block_times_cache  # noqa: F401
     import lighthouse_tpu.beacon.validator_monitor  # noqa: F401
@@ -187,6 +188,19 @@ def test_metric_name_lint():
         "verify_remote_audit_failures_total",
         "verify_remote_tier",
         "verify_remote_breaker_state",
+    } <= names, sorted(names)
+    # the aggregation-tier families (ISSUE 9) must be registered and
+    # linted: O(bytes) insert counter, pending gauge, per-trigger flush
+    # counter, flush size/latency histograms, the invalid-drop counter,
+    # and the pubkey-presum counter
+    assert {
+        "aggregation_inserts_total",
+        "aggregation_pending_contributions",
+        "aggregation_flush_total",
+        "aggregation_flush_batch_size",
+        "aggregation_flush_seconds",
+        "aggregation_invalid_signatures_total",
+        "aggregation_pubkey_presums_total",
     } <= names, sorted(names)
 
 

@@ -1,0 +1,282 @@
+#!/usr/bin/env python
+"""Million-validator epoch-replay scenario (the ROADMAP "aggregation
+tier" deliverable).
+
+Builds an N-validator registry (valid pubkeys tiled from a small pool —
+`ValidatorPubkeyCache` dedupes by encoding, so boot stays O(registry)
+numpy + O(pool) curve math), boots a real `BeaconChain` over a
+fake-backend `VerificationService`, synthesizes a FULL EPOCH of gossip
+traffic (`testing/scale.make_epoch_traffic`: aggregate-and-proofs with
+passing selection proofs, distinct-validator unaggregated singles,
+sync-committee messages on Altair), and replays it through the real
+path: gossip gates → BeaconProcessor batches → verify_service →
+operation_pool aggregation tier → head recompute.
+
+Signatures are valid G2 curve points but not signatures OVER the
+messages — the backend is `fake`, as in every scale/BASELINE rig; this
+bench measures the aggregation/pipeline economics, not pairings.
+
+Also measures, in-process:
+
+  * ``agg_inserts_per_sec``      — the tier's O(bytes) insert rate;
+  * ``insert_baseline_per_sec``  — the frozen pre-tier pool
+    (`testing/naive_pool`) paying host decompress+add+compress per
+    insert (acceptance: tier ≥ 10× baseline);
+  * ``byte_identical``           — flushed tier output vs the naive
+    pool's incremental aggregate, compared as exact bytes;
+  * ``epoch_replay_seconds`` / ``flush_batch_sizes`` / ``peak_rss_mb``
+    and a full verdict account (every enqueued message must resolve —
+    lost == 0).
+
+Usage:
+    python tools/scale_bench.py [--validators 32768] [--fork altair]
+        [--aggs-per-committee 2] [--singles-per-committee 2]
+        [--insert-bench-n 192] [--json BENCH_SCALE.json]
+
+bench.py wires this into the tier-1 lane at a small N and into the
+``--scale`` lane at N=1,000,000, recording BENCH_SCALE.json and the
+verify_service keys of BENCH_PRIMARY.json.
+"""
+
+import argparse
+import json
+import os
+import resource
+import sys
+import time
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _drain(processor):
+    while processor.process_pending():
+        pass
+
+
+def _chunks(items, size):
+    for i in range(0, len(items), size):
+        yield items[i : i + size]
+
+
+def insert_microbench(state, spec, sig_pool, n):
+    """Tier insert rate vs the frozen naive pool on the same payload:
+    `n` disjoint single-bit attestations over one committee (the shape
+    that forces the naive pool's per-insert merge math every time)."""
+    from lighthouse_tpu.operation_pool import OperationPool
+    from lighthouse_tpu.ssz import hash_tree_root
+    from lighthouse_tpu.state_processing.committee_cache import (
+        committees_for_epoch,
+    )
+    from lighthouse_tpu.testing.naive_pool import NaiveAggregationPool
+    from lighthouse_tpu.types.containers import AttestationData, Checkpoint
+    from lighthouse_tpu.types.state import state_types
+
+    preset = spec.preset
+    T = state_types(preset)
+    epoch = int(state.slot) // preset.slots_per_epoch
+    cache = committees_for_epoch(state, epoch, preset)
+    slot = epoch * preset.slots_per_epoch
+    clen = len(cache.committee(slot, 0))
+    n = max(2, min(n, clen))
+    data = AttestationData(
+        slot=slot, index=0, beacon_block_root=b"\x22" * 32,
+        source=Checkpoint(epoch=0, root=b"\x00" * 32),
+        target=Checkpoint(epoch=epoch, root=b"\x22" * 32),
+    )
+    atts = []
+    for i in range(n):
+        bits = [0] * clen
+        bits[i] = 1
+        atts.append(T.Attestation(
+            aggregation_bits=bits, data=data,
+            signature=sig_pool[i % len(sig_pool)],
+        ))
+
+    naive = NaiveAggregationPool()
+    t0 = time.monotonic()
+    for a in atts:
+        naive.insert_attestation(a)
+    naive_s = time.monotonic() - t0
+
+    pool = OperationPool(spec)
+    t0 = time.monotonic()
+    for a in atts:
+        pool.insert_attestation(a)
+    tier_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    pool.flush("bench")
+    flush_s = time.monotonic() - t0
+
+    key = hash_tree_root(data)
+    tier_pairs = sorted(
+        (tuple(int(b) for b in e["bits"]), bytes(e["att"].signature))
+        for e in pool.attestations.get(key, [])
+    )
+    return {
+        "insert_bench_n": n,
+        "insert_baseline_per_sec": round(n / naive_s, 1),
+        "agg_inserts_per_sec": round(n / tier_s, 1),
+        "insert_speedup": round(naive_s / tier_s, 1),
+        "insert_flush_seconds": round(flush_s, 4),
+        "byte_identical": tier_pairs == naive.packed_pairs(),
+    }
+
+
+def run(args):
+    from lighthouse_tpu.beacon.beacon_processor import BeaconProcessor
+    from lighthouse_tpu.beacon.chain import BeaconChain
+    from lighthouse_tpu.crypto.backend import SignatureVerifier
+    from lighthouse_tpu.testing import scale
+    from lighthouse_tpu.types import ChainSpec, MainnetPreset
+    from lighthouse_tpu.verify_service import VerificationService
+
+    spec = ChainSpec(
+        preset=MainnetPreset,
+        altair_fork_epoch=0 if args.fork == "altair" else None,
+    )
+    preset = spec.preset
+
+    t0 = time.monotonic()
+    pubkey_pool = scale.make_pubkey_pool(args.pubkey_pool)
+    sig_pool = scale.make_signature_pool(args.sig_pool)
+    state = scale.make_scaled_state(
+        args.validators, spec, epoch=args.epoch, seed=args.seed,
+        pubkey_pool=pubkey_pool, fork=args.fork,
+    )
+    build_seconds = time.monotonic() - t0
+
+    t0 = time.monotonic()
+    service = VerificationService(SignatureVerifier("fake"))
+    chain = BeaconChain(state, spec, verifier=service)
+    processor = BeaconProcessor(chain)
+    boot_seconds = time.monotonic() - t0
+
+    head_root = bytes(chain.genesis_root)
+    t0 = time.monotonic()
+    traffic = scale.make_epoch_traffic(
+        chain.head_state, spec, head_root, seed=args.seed,
+        aggregates_per_committee=args.aggs_per_committee,
+        singles_per_committee=args.singles_per_committee,
+        sig_pool=sig_pool,
+    )
+    traffic_seconds = time.monotonic() - t0
+
+    bench = insert_microbench(
+        chain.head_state, spec, sig_pool, args.insert_bench_n
+    )
+
+    # ---------------------------------------------------- epoch replay
+    by_kind = Counter()
+    accepted = Counter()
+    reasons = Counter()
+
+    def _harvest():
+        # processor.results is a bounded audit deque (maxlen=4096) —
+        # consume it per chunk so verdict accounting survives rotation
+        while processor.results:
+            kind, ok, err = processor.results.popleft()
+            by_kind[kind] += 1
+            if ok:
+                accepted[kind] += 1
+            else:
+                reasons[str(err)[:60]] += 1
+
+    t0 = time.monotonic()
+    for chunk in _chunks(traffic["aggregates"], 2048):
+        for sa in chunk:
+            processor.enqueue_aggregate(sa)
+        _drain(processor)
+        _harvest()
+    for chunk in _chunks(traffic["attestations"], 8192):
+        for att in chunk:
+            processor.enqueue_attestation(att)
+        _drain(processor)
+        _harvest()
+    sync_results = []
+    for chunk in _chunks(traffic["sync_messages"], 2048):
+        sync_results.extend(chain.submit_sync_messages(chunk).resolve())
+    chain.op_pool.flush("epoch_end")
+    pack_state = chain.head_state.copy()
+    pack_state.slot = (args.epoch + 1) * preset.slots_per_epoch - 1
+    packed = chain.op_pool.get_attestations(pack_state, preset)
+    head = chain.recompute_head()
+    epoch_replay_seconds = time.monotonic() - t0
+
+    # ------------------------------------------------------ accounting
+    _harvest()
+    sync_ok = sum(1 for _, err in sync_results if err is None)
+    for _, err in sync_results:
+        if err is not None:
+            reasons[str(err)[:60]] += 1
+    lost = (
+        len(traffic["aggregates"]) - by_kind["aggregate"]
+        + len(traffic["attestations"]) - by_kind["attestation"]
+        + len(traffic["sync_messages"]) - len(sync_results)
+    )
+    tier = chain.op_pool.aggregation.stats()
+    out = {
+        "n_validators": args.validators,
+        "fork": args.fork,
+        "backend": "fake",
+        "platform": os.environ.get("JAX_PLATFORMS", ""),
+        "build_seconds": round(build_seconds, 2),
+        "boot_seconds": round(boot_seconds, 2),
+        "traffic_synthesis_seconds": round(traffic_seconds, 2),
+        "traffic": {
+            "aggregates": len(traffic["aggregates"]),
+            "attestations": len(traffic["attestations"]),
+            "sync_messages": len(traffic["sync_messages"]),
+        },
+        "epoch_replay_seconds": round(epoch_replay_seconds, 2),
+        "replay_msgs_per_sec": round(
+            (len(traffic["aggregates"]) + len(traffic["attestations"])
+             + len(traffic["sync_messages"]))
+            / max(epoch_replay_seconds, 1e-9), 1,
+        ),
+        "verdicts": {
+            "aggregate": {"resolved": by_kind["aggregate"],
+                          "accepted": accepted["aggregate"]},
+            "attestation": {"resolved": by_kind["attestation"],
+                            "accepted": accepted["attestation"]},
+            "sync": {"resolved": len(sync_results), "accepted": sync_ok},
+            "lost": lost,
+            "top_reject_reasons": dict(reasons.most_common(5)),
+        },
+        "packed_attestations": len(packed),
+        "head": head.hex() if isinstance(head, bytes) else str(head),
+        "flush_batch_sizes": tier["last_flush_batches"],
+        "aggregation": tier,
+        "peak_rss_mb": round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1
+        ),
+        **bench,
+    }
+    service.stop()
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--validators", type=int, default=32768)
+    ap.add_argument("--fork", choices=("phase0", "altair"), default="altair")
+    ap.add_argument("--epoch", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--aggs-per-committee", type=int, default=2)
+    ap.add_argument("--singles-per-committee", type=int, default=2)
+    ap.add_argument("--insert-bench-n", type=int, default=192)
+    ap.add_argument("--pubkey-pool", type=int, default=64)
+    ap.add_argument("--sig-pool", type=int, default=256)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args(argv)
+    out = run(args)
+    line = json.dumps(out)
+    print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
