@@ -1008,6 +1008,62 @@ def config_compile_cache():
     _CC_SUMMARY = summary
 
 
+def config_mesh():
+    """Mesh-sharded verification lane (ISSUE 10): run the verify-shaped
+    MeshPlan placement probe (tools/verify_service_bench.py --mesh-probe)
+    in CPU-pinned subprocesses under 1 and 8 virtual devices.  The
+    1-device ratio proves the no-op plan costs nothing; the 8-virtual-CPU
+    ratio documents the sharding overhead floor (expected <=1x — virtual
+    devices add collectives with no capacity; the crossover is a
+    real-hardware measurement).  Both ride BENCH_PRIMARY.json's
+    verify_service key under the regression guard."""
+    global _VS_SUMMARY
+    import subprocess
+
+    if not _fits(120.0, "mesh_lane"):
+        return
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "verify_service_bench.py")
+    probes = {}
+    for n in (1, 8):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS=f"--xla_force_host_platform_device_count={n}")
+        if n > 1:
+            env["LTPU_MESH"] = f"dp={n}"
+        else:
+            env.pop("LTPU_MESH", None)
+        try:
+            r = subprocess.run([sys.executable, path, "--mesh-probe"],
+                               capture_output=True, text=True, env=env,
+                               timeout=min(180.0, max(_left() - 10, 60)))
+            if r.returncode != 0:
+                raise RuntimeError(f"rc={r.returncode}: {r.stderr[-200:]}")
+            pt = json.loads(r.stdout.strip().splitlines()[-1])
+        except Exception as e:
+            note("mesh_probe_error", forced_devices=n, error=str(e)[:300])
+            continue
+        note("mesh_probe", forced_devices=n,
+             **{k: pt[k] for k in ("mesh_devices", "single_sets_per_sec",
+                                   "sharded_sets_per_sec",
+                                   "shard_overhead_ratio")})
+        probes[n] = pt
+    if not probes:
+        return
+    summary = {}
+    if 1 in probes:
+        # the acceptance number: a 1-device MeshPlan is a free no-op
+        summary["mesh_noop_overhead_ratio"] = probes[1][
+            "shard_overhead_ratio"]
+    if 8 in probes:
+        summary["mesh_devices"] = probes[8]["mesh_devices"]
+        summary["sharded_sets_per_sec"] = probes[8]["sharded_sets_per_sec"]
+        summary["shard_overhead_ratio"] = probes[8]["shard_overhead_ratio"]
+    if _VS_SUMMARY is None:
+        _VS_SUMMARY = summary
+    else:
+        _VS_SUMMARY.update(summary)
+
+
 def warm():
     """`python bench.py --warm`: populate the persistent XLA cache with
     the standard bucket shapes — the (2,2) smoke/entry shape, the
@@ -1131,11 +1187,12 @@ def main():
     # subprocess measurements to the front of the extras
     stages = (
         (config_device_retry, config_gossip_latency, config_native_shapes,
-         config5, config_aggregation, run_device_smoke_and_curve,
+         config5, config_aggregation, config_mesh,
+         run_device_smoke_and_curve,
          config_kernels, config1, config4, config_compile_cache)
         if _DEVICE_ALIVE else
         (config_gossip_latency, config_native_shapes, config5,
-         config_aggregation, config_device_retry,
+         config_aggregation, config_mesh, config_device_retry,
          run_device_smoke_and_curve, config_kernels, config1, config4,
          config_compile_cache)
     )

@@ -838,6 +838,20 @@ class _Handler(JsonHandler):
                 data["device_ready"] = bool(verifier.device_ready)
             return self._json({"data": data})
 
+        if path == "/lighthouse/mesh":
+            # verification mesh plan: dp×mp layout, per-device
+            # platform/kind inventory, sharded-vs-single launch
+            # counters, and the dispatcher's mesh-scaled batch knee
+            from ..crypto.tpu import sharding
+
+            data = sharding.get_mesh_plan().describe()
+            verifier = getattr(chain, "verifier", None)
+            if verifier is not None:
+                data["service_mesh_devices"] = int(
+                    getattr(verifier, "mesh_devices", 1) or 1
+                )
+            return self._json({"data": data})
+
         if path == "/lighthouse/logs/recent":
             # newest-first structured records from the flight recorder's
             # ring buffer; ?level= filters at-or-above, ?component= exact

@@ -82,6 +82,11 @@ class BeaconNode:
         verifier = self.chain.verifier
         if hasattr(verifier, "start") and hasattr(verifier, "submit"):
             verifier.start(self.executor)
+        # mesh discovery: log the plan once at startup so a node's
+        # sharded-vs-single layout is in the flight recorder (the
+        # prewarm below compiles over the SAME placed shapes, so the
+        # AOT menu matches what production launches will ask for)
+        self._log_mesh_plan(verifier)
         # admission-gated compile prewarm: close the service's device
         # gate BEFORE any worker can submit device work, then load the
         # canonical AOT menu in the background — the node serves traffic
@@ -158,6 +163,26 @@ class BeaconNode:
         return self
 
     # -------------------------------------------------- compile prewarm
+
+    def _log_mesh_plan(self, verifier):
+        """One startup line naming the verification mesh layout (only
+        when the backend is device-backed — host backends have no mesh
+        to discover)."""
+        if getattr(verifier, "backend", None) != "tpu":
+            return
+        try:
+            from ..crypto.tpu import sharding
+
+            d = sharding.get_mesh_plan().describe()
+            log.info(
+                "verification mesh: %s dp=%d mp=%d (%s, %d device(s), "
+                "fingerprint %s)",
+                "sharded" if d["sharded"] else "single-device",
+                d["dp"], d["mp"], d["reason"], d["total_devices"],
+                d["topology_fingerprint"],
+            )
+        except Exception as e:  # noqa: BLE001 — never block startup
+            log.debug("mesh discovery failed: %s", e)
 
     def _close_gate_for_prewarm(self, verifier):
         """Shut the device admission gate (construction-time).  Only

@@ -108,6 +108,20 @@ class SignatureVerifier:
             except Exception:
                 pass
 
+    @property
+    def mesh_devices(self):
+        """Devices in the active verification mesh plan (1 for every
+        host backend and for a single-device/disabled mesh).  The
+        verify_service dispatcher scales its batch knee by this."""
+        if self.backend != "tpu":
+            return 1
+        try:
+            from .tpu import sharding
+
+            return sharding.get_mesh_plan().n_devices
+        except Exception:  # noqa: BLE001 — no usable jax backend
+            return 1
+
     def prewarm(self, progress=None):
         """Load-or-compile the canonical device kernel menu ahead of
         admission (crypto/tpu/compile_cache.prewarm): with a populated

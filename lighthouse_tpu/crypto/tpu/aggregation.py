@@ -41,6 +41,7 @@ from . import compile_cache as cc
 from . import curve as cv
 from . import decompress as dc
 from . import fp
+from . import sharding as _shard
 from . import tower as tw
 
 
@@ -114,7 +115,12 @@ def _device_aggregate_segments(blobs, seg_of, n_segments):
     grid = jax.tree_util.tree_map(
         lambda a: jnp.take(a, flat, axis=1).reshape(a.shape[0], S, M), pts
     )
-    ax, ay, inf = _jit_g2_masked_sum(grid, jnp.asarray(mask))
+    # flush grids ride the same mesh placement as verify chunks: the
+    # segment axis (S, dp-rounded by the planner) shards on dp
+    plan = _shard.get_mesh_plan()
+    grid, _ = plan.place_batched(grid, axis=1)
+    mask_dev, _ = plan.place_batched(jnp.asarray(mask), axis=0)
+    ax, ay, inf = _jit_g2_masked_sum(grid, mask_dev)
     infs = np.asarray(inf).reshape(-1)[:n_segments]
     xs = _f2_to_ints(ax, infs)[:n_segments]
     ys = _f2_to_ints(ay, infs)[:n_segments]
@@ -170,6 +176,7 @@ def _device_aggregate_pubkeys(rows):
     M = planner.plan_pks(width)
     padded = list(rows) + [[]] * (S - len(rows))
     grid = tb._g1_pad_dev(padded, M)
+    grid, _ = _shard.get_mesh_plan().place_batched(grid, axis=1)
     ax, ay, inf = _jit_g1_sum(grid)
     infs = np.asarray(inf).reshape(-1)[: len(rows)]
     xs = cv._fp_host(ax)[: len(rows)]

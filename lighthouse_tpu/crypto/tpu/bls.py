@@ -45,6 +45,7 @@ from ...utils import metrics as _metrics
 from ...utils import tracing
 from ..constants import P, G1_X, G1_Y, RAND_BITS, DST_POP
 from . import compile_cache as cc
+from . import sharding as _shard
 from . import fp
 from . import tower as tw
 from . import curve as cv
@@ -398,17 +399,24 @@ def _prepare(sets, dst, min_sets=1, min_pks=1):
 
 
 def _trace_chunk(tr, host_prep_ms, t_dev0, n_sets, n_pad, per_set=False,
-                 overlap_ratio=0.0):
+                 overlap_ratio=0.0, shards=1):
     """Attach this chunk's host-prep/device split and pad occupancy to
     the current pipeline trace (utils/tracing.py) — the per-batch view
     of where device time goes that histograms can't give.
     `overlap_ratio`: fraction of this chunk's host prep that ran while
-    the device executed the previous chunk (0 on the serial path)."""
+    the device executed the previous chunk (0 on the serial path).
+    `shards`: devices this launch was split across (1 = single device);
+    `shard_lanes`/`shard_occupancy` give the per-device view of the
+    same padding economics."""
+    shards = max(int(shards), 1)
     tr.add_span(
         "device_chunk", t_dev0, _time.monotonic(),
         sets=n_sets, lanes=n_pad,
         pad_ratio=round(n_pad / max(n_sets, 1), 3),
         occupancy=round(n_sets / max(n_pad, 1), 3),
+        shards=shards,
+        shard_lanes=n_pad // shards,
+        shard_occupancy=round(n_sets / max(n_pad, 1), 3),
         host_prep_ms=round(host_prep_ms, 3),
         overlap_ratio=round(overlap_ratio, 3),
         per_set=per_set,
@@ -466,12 +474,18 @@ def execute_chunk(prepared, overlap_ratio=None):
         return False
     tr = tracing.current_trace()
     t_dev0 = _time.monotonic()
-    out = bool(_jit_batched(*prepared.args))
+    # mesh placement belongs to the DEVICE stage (it is the host->mesh
+    # transfer): a >1-device plan drops the padded pytree onto the
+    # dp/mp NamedSharding layout, a 1-device plan returns it untouched
+    plan = _shard.get_mesh_plan()
+    args, shards = plan.place_verify_args(prepared.args)
+    out = bool(_jit_batched(*args))
+    plan.note_occupancy(prepared.n_sets, prepared.n_pad, shards)
     if tr is not None:
         _trace_chunk(
             tr, (prepared.t_prep1 - prepared.t_prep0) * 1e3, t_dev0,
             prepared.n_sets, prepared.n_pad,
-            overlap_ratio=overlap_ratio or 0.0,
+            overlap_ratio=overlap_ratio or 0.0, shards=shards,
         )
     return out
 
@@ -547,10 +561,14 @@ def _per_set_chunk(sets, dst, min_sets=1, min_pks=1):
     sets, n_pad, pk, sig, u0, u1 = prep
     real = jnp.arange(n_pad) < len(sets)
     t1 = _time.monotonic()
-    _, out = _jit_per_set(pk, sig, u0, u1, real)
+    plan = _shard.get_mesh_plan()
+    args, shards = plan.place_verify_args((pk, sig, u0, u1, real))
+    _, out = _jit_per_set(*args)
     verdicts = [bool(v) for v in np.asarray(out)[: len(sets)]]
+    plan.note_occupancy(len(sets), n_pad, shards)
     if tr is not None:
-        _trace_chunk(tr, (t1 - t0) * 1e3, t1, len(sets), n_pad, per_set=True)
+        _trace_chunk(tr, (t1 - t0) * 1e3, t1, len(sets), n_pad,
+                     per_set=True, shards=shards)
     return verdicts
 
 
@@ -578,8 +596,14 @@ def example_chunk_args(n_pad, m_pad, dst=DST_POP):
 
 def kernel_specs(n_pad, m_pad, per_set=True):
     """(name, kernel_fn, example_args, shape_label) entries for the
-    compile cache's prewarm walk over one canonical shape."""
+    compile cache's prewarm walk over one canonical shape.  Example
+    args go through the SAME mesh placement as production chunks, so
+    on a sharded plan prewarm compiles (and the AOT cache serves) the
+    SPMD programs real launches will ask for."""
     batched_args, per_set_args = example_chunk_args(n_pad, m_pad)
+    plan = _shard.get_mesh_plan()
+    batched_args, _ = plan.place_verify_args(batched_args, count=False)
+    per_set_args, _ = plan.place_verify_args(per_set_args, count=False)
     label = f"{n_pad}x{m_pad}"
     specs = [
         ("bls_batched_verify", batched_verify_kernel, batched_args, label),

@@ -124,9 +124,27 @@ class ShapePlanner:
     g2-decompress batch axis, independent of pubkeys-per-set.  `LTPU_PREWARM_SHAPES`
     (`NxM,NxM,...`, default `{bucket}x1,{bucket}x2`) names the shapes
     prewarm compiles ahead of admission.
+
+    Mesh awareness: on a sharded mesh plan (sharding.MeshPlan) every
+    planned set/lane bucket is rounded UP to a multiple of the dp axis
+    (and the pubkey bucket to a multiple of mp), so `NamedSharding` can
+    split the batch axis evenly — the pow-2 menus already satisfy this
+    for pow-2 meshes, and an odd mesh just pads a little further.
     """
 
     def __init__(self, set_menu=None, pk_menu=None, prewarm=None):
+        # the dp/mp divisibility the sharded placement needs; a failure
+        # to consult the mesh (uninitialized backend) degrades to 1,
+        # i.e. exactly the pre-mesh planner behavior
+        try:
+            from . import sharding as _sharding
+
+            plan = _sharding.get_mesh_plan()
+            self.dp_multiple = plan.dp_multiple
+            self.mp_multiple = plan.mp_multiple
+        except Exception:  # noqa: BLE001
+            self.dp_multiple = 1
+            self.mp_multiple = 1
         bucket = max(1, int(os.environ.get("LTPU_MAX_SETS_BUCKET", "32")))
         max_pks = max(1, int(os.environ.get("LTPU_SHAPE_MAX_PKS", "4096")))
         raw = os.environ.get("LTPU_SHAPE_SETS_MENU")
@@ -166,18 +184,41 @@ class ShapePlanner:
         OFFMENU.inc()
         return _next_pow2(v)
 
+    def _axis_round(self, v, menu, multiple):
+        """Round a planned bucket up to `multiple` so a NamedSharding
+        axis splits evenly; prefer a menu entry that already satisfies
+        it (keeps the compiled-program set on the enumerable menu)."""
+        if multiple <= 1 or v % multiple == 0:
+            return v
+        v = ((v + multiple - 1) // multiple) * multiple
+        for entry in menu:
+            if entry >= v and entry % multiple == 0:
+                return entry
+        return v
+
     def plan_sets(self, n, floor=1):
         """Canonical set-axis lanes for an `n`-set chunk (floor: the
-        chunked paths pin every chunk of a batch to one shape)."""
-        return self._bucket_of(max(int(n), int(floor), 1), self.set_menu)
+        chunked paths pin every chunk of a batch to one shape).  On a
+        sharded mesh the bucket is a multiple of the dp axis."""
+        v = self._bucket_of(max(int(n), int(floor), 1), self.set_menu)
+        return self._axis_round(v, self.set_menu, self.dp_multiple)
 
     def plan_pks(self, m, floor=1):
-        """Canonical pubkey-axis lanes for a max-`m`-pubkey batch."""
-        return self._bucket_of(max(int(m), int(floor), 1), self.pk_menu)
+        """Canonical pubkey-axis lanes for a max-`m`-pubkey batch (a
+        multiple of the mp axis on a sharded mesh, so the pubkey split
+        divides evenly — a 1-pubkey bucket under mp>1 replicates
+        instead, handled at placement)."""
+        v = self._bucket_of(max(int(m), int(floor), 1), self.pk_menu)
+        if v >= self.mp_multiple:
+            v = self._axis_round(v, self.pk_menu, self.mp_multiple)
+        return v
 
     def plan_lanes(self, n):
-        """Canonical decompress-batch lanes for `n` signatures."""
-        return self._bucket_of(max(int(n), 1), self.lane_menu)
+        """Canonical decompress-batch lanes for `n` signatures (dp
+        multiple on a sharded mesh — the decompress batch axis shards
+        with the same placement as the verify set axis)."""
+        v = self._bucket_of(max(int(n), 1), self.lane_menu)
+        return self._axis_round(v, self.lane_menu, self.dp_multiple)
 
     def plan(self, n_sets, max_pks, min_sets=1, min_pks=1):
         return (self.plan_sets(n_sets, min_sets),
@@ -193,6 +234,8 @@ class ShapePlanner:
             "pk_menu": list(self.pk_menu),
             "lane_menu": list(self.lane_menu),
             "bucket": self.bucket,
+            "dp_multiple": self.dp_multiple,
+            "mp_multiple": self.mp_multiple,
             "prewarm": [f"{n}x{m}" for n, m in self.prewarm_menu],
             "programs_bounded_at": len(self.set_menu) * len(self.pk_menu),
         }
@@ -206,6 +249,8 @@ _PLANNER_ENV_KEYS = (
     "LTPU_MAX_SETS_BUCKET", "LTPU_SHAPE_MAX_PKS",
     "LTPU_SHAPE_SETS_MENU", "LTPU_SHAPE_PKS_MENU",
     "LTPU_SHAPE_LANES_MENU", "LTPU_PREWARM_SHAPES",
+    # the mesh knobs reshape the planner's dp/mp rounding too
+    "LTPU_MESH", "LTPU_MESH_DISABLE",
 )
 
 
@@ -280,12 +325,35 @@ def _default_cache_dir():
     return os.path.join(repo_root, ".compile_cache")
 
 
+def _leaf_sharding_tag(a):
+    """Per-leaf placement component of the cache key: a NamedSharding
+    over a >1-device mesh compiles a DIFFERENT (SPMD) program than the
+    same shapes unsharded, so the two must never share an entry.
+    Single-device/uncommitted leaves tag as '' — the unsharded key is
+    byte-identical to the pre-mesh layout of this signature."""
+    s = getattr(a, "sharding", None)
+    mesh = getattr(s, "mesh", None)
+    spec = getattr(s, "spec", None)
+    if mesh is None or spec is None:
+        return ""
+    try:
+        if mesh.size <= 1:
+            return ""
+        axes = ",".join(f"{k}{v}" for k, v in mesh.shape.items())
+    except Exception:  # noqa: BLE001 — exotic sharding: key on its repr
+        return str(s)
+    return f"{axes}|{spec}"
+
+
 def _shape_sig(args):
-    """Flattened (shape, dtype) signature of an argument pytree — the
-    part of the cache key that pins the canonical shape."""
+    """Flattened (shape, dtype, sharding) signature of an argument
+    pytree — the part of the cache key that pins the canonical shape
+    and its mesh placement."""
     leaves, treedef = jax.tree_util.tree_flatten(args)
     sig = tuple(
-        (tuple(getattr(a, "shape", ())), str(getattr(a, "dtype", type(a))))
+        (tuple(getattr(a, "shape", ())),
+         str(getattr(a, "dtype", type(a))),
+         _leaf_sharding_tag(a))
         for a in leaves
     )
     return sig, str(treedef)
@@ -319,11 +387,21 @@ class CompileCache:
     # -- keys ---------------------------------------------------------
 
     def fingerprint(self):
+        """Host + kernel-source key, suffixed with the LIVE topology tag
+        (device count + mesh axes, sharding.topology_fingerprint): a
+        blob compiled under one topology must read as absent under
+        another — even on the unsharded path, where a 1-device XLA:CPU
+        executable would otherwise silently load into (and serve) an
+        8-device process.  The host/source part is cached; the topology
+        part is recomputed so env-driven mesh changes (tests, bench
+        subprocesses) re-key immediately."""
         if self._fingerprint is None:
             self._fingerprint = (
                 _host_fingerprint() + "-" + _kernel_source_fingerprint()
             )
-        return self._fingerprint
+        from . import sharding as _sharding
+
+        return self._fingerprint + "-" + _sharding.topology_fingerprint()
 
     def _entry_path(self, name, shape_hash):
         return os.path.join(
@@ -390,7 +468,7 @@ class CompileCache:
     def _label_from_sig(sig):
         # first leaf's trailing dims name the shape well enough for
         # metrics ("(24, 32, 2)" -> "32x2"); fall back to the hash label
-        for shape, _ in sig:
+        for shape, *_ in sig:
             if len(shape) >= 2:
                 return "x".join(str(d) for d in shape[1:])
         return "scalar"

@@ -32,6 +32,7 @@ from ..constants import P
 from . import compile_cache as cc
 from . import curve as cv
 from . import fp
+from . import sharding as _shard
 from . import tower as tw
 
 
@@ -180,7 +181,12 @@ def g2_decompress_batch(blobs, subgroup_check=True):
     shape = (n_pad,)
     c0 = fp.to_mont_jit(jnp.asarray(fp.ints_to_array(c0s).reshape((fp.NLIMB,) + shape)))
     c1 = fp.to_mont_jit(jnp.asarray(fp.ints_to_array(c1s).reshape((fp.NLIMB,) + shape)))
-    (x, y, z), on_curve = _jit_decompress(c0, c1, jnp.asarray(y_big))
+    # the decompress pass shards its lane axis on dp like every other
+    # device program (plan_lanes is already dp-rounded by the planner)
+    plan = _shard.get_mesh_plan()
+    (c0, c1), _ = plan.place_batched((c0, c1), axis=1)
+    yb, _ = plan.place_batched(jnp.asarray(y_big), axis=0)
+    (x, y, z), on_curve = _jit_decompress(c0, c1, yb)
     ok = valid & (np.asarray(on_curve) | is_inf)
     # infinity lanes: zero Z (the kernel's Z is 1 everywhere)
     if is_inf.any():

@@ -1,0 +1,322 @@
+"""Mesh-aware batch placement — the multi-device production fast lane.
+
+The GSPMD multichip artifact (tests/test_sharding.py) proved that the
+verify kernels partition correctly under `NamedSharding`: XLA inserts the
+cross-mp psum for the pubkey aggregation tree and the cross-dp reduction
+for the blinded signature accumulation / multi-pairing product.  This
+module makes that layout a *production* path instead of a test artifact:
+
+* **MeshPlan** — discovered once per process (env-keyed rebuild like the
+  ShapePlanner): a dp×mp device mesh over `jax.devices()`.  `LTPU_MESH`
+  pins the layout explicitly (``dp=4,mp=2``, ``4x2``, or a bare device
+  count); unset, the plan is automatic — all devices on the dp (set)
+  axis when the backend is a real accelerator, and a 1-device no-op on
+  CPU (virtual host devices add collective overhead with no capacity —
+  the measured economics in ROADMAP's multichip item).  `LTPU_MESH_DISABLE=1`
+  forces the single-device plan everywhere.
+
+* **place_verify_args** — drops a prepared chunk's arg pytree onto the
+  mesh with `jax.device_put(leaf, NamedSharding(mesh, spec))`, choosing
+  the spec by leaf rank: 3-D pubkey grids `(limb, set, pk)` shard the
+  set axis on dp and (when divisible) the pk axis on mp; 2-D set-axis
+  leaves (signatures, hash-to-field, rands) shard on dp; 1-D lane masks
+  shard on dp directly.  Host prep (`prepare_chunk`) is untouched — the
+  PR-4 prep/device overlap and the PK_CACHE gather compose for free.
+  On a 1-device plan the call returns its inputs unchanged: the no-op
+  costs one attribute check, no placement, no new compiled programs.
+
+* **topology_fingerprint** — ``d<devices>dp<dp>mp<mp>``, appended to the
+  AOT compile-cache key so an executable compiled under one topology is
+  invisible (never mis-loaded) under another.
+
+The set-axis bucket divisibility the dp split needs (`n_pad % dp == 0`)
+is guaranteed upstream by `compile_cache.ShapePlanner` rounding every
+planned sets-bucket up to a multiple of the dp axis; a chunk that still
+arrives indivisible falls back to a single-device launch, counted in
+`verify_single_launches_total`.
+"""
+
+import os
+import threading
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ...utils import metrics as _metrics
+from ...utils.logging import get_logger
+
+log = get_logger("crypto")
+
+SHARDED_LAUNCHES = _metrics.counter(
+    "verify_sharded_launches_total",
+    "Device kernel launches placed across a >1-device mesh "
+    "(NamedSharding dp/mp layout)",
+)
+SINGLE_LAUNCHES = _metrics.counter(
+    "verify_single_launches_total",
+    "Device kernel launches on a single device (1-device mesh plan or "
+    "a batch axis indivisible by dp)",
+)
+SHARD_OCCUPANCY = _metrics.gauge(
+    "verify_shard_occupancy",
+    "Mean fraction of real (non-padding) signature sets per shard in "
+    "the most recent sharded verify launch",
+)
+
+_COUNT_LOCK = threading.Lock()
+_COUNTS = {"sharded": 0, "single": 0}
+
+
+def _note_launch(sharded):
+    with _COUNT_LOCK:
+        _COUNTS["sharded" if sharded else "single"] += 1
+    (SHARDED_LAUNCHES if sharded else SINGLE_LAUNCHES).inc()
+
+
+def launch_counts():
+    with _COUNT_LOCK:
+        return dict(_COUNTS)
+
+
+def parse_mesh_spec(raw):
+    """``dp=4,mp=2`` / ``4x2`` / ``8`` -> (dp, mp).  Raises ValueError
+    on malformed input (the caller logs and falls back to 1 device)."""
+    raw = (raw or "").strip().lower()
+    if not raw or raw == "auto":
+        return None
+    if "=" in raw:
+        dp, mp = 1, 1
+        for part in raw.replace(";", ",").split(","):
+            k, _, v = part.partition("=")
+            k, v = k.strip(), int(v)
+            if k == "dp":
+                dp = v
+            elif k == "mp":
+                mp = v
+            else:
+                raise ValueError(f"unknown mesh axis {k!r}")
+    elif "x" in raw:
+        a, b = raw.split("x")
+        dp, mp = int(a), int(b)
+    else:
+        dp, mp = int(raw), 1
+    if dp < 1 or mp < 1:
+        raise ValueError(f"bad mesh spec {raw!r}")
+    return dp, mp
+
+
+class MeshPlan:
+    """One process-wide decision: how verify batches land on devices.
+
+    `mesh is None` means the single-device plan — every placement helper
+    is an identity no-op and `topology_fingerprint` still records the
+    visible device count (the satellite-1 keying fix: a 1-device blob
+    must not load into an 8-device topology even when neither run
+    shards)."""
+
+    def __init__(self, devices, dp, mp, reason):
+        self.dp = int(dp)
+        self.mp = int(mp)
+        self.reason = reason
+        self.total_devices = len(devices)
+        if self.dp * self.mp > 1:
+            used = devices[: self.dp * self.mp]
+            self.mesh = Mesh(
+                np.array(used).reshape(self.dp, self.mp), ("dp", "mp")
+            )
+        else:
+            self.mesh = None
+
+    # -- shape --------------------------------------------------------
+
+    @property
+    def n_devices(self):
+        return self.dp * self.mp
+
+    @property
+    def sharded(self):
+        return self.mesh is not None
+
+    @property
+    def dp_multiple(self):
+        """The multiple every planned set-axis bucket must round up to
+        (ShapePlanner consults this)."""
+        return self.dp if self.sharded else 1
+
+    @property
+    def mp_multiple(self):
+        return self.mp if self.sharded else 1
+
+    # -- placement ----------------------------------------------------
+
+    def _verify_spec(self, leaf):
+        """PartitionSpec by leaf rank: (limb, set, pk) / (·, set) / (set,)."""
+        nd = len(leaf.shape)
+        if nd >= 3:
+            mp_ax = (
+                "mp" if self.mp > 1 and leaf.shape[2] % self.mp == 0 else None
+            )
+            return PartitionSpec(None, "dp", mp_ax)
+        if nd == 2:
+            return PartitionSpec(None, "dp")
+        return PartitionSpec("dp")
+
+    @staticmethod
+    def _set_axis_size(leaf):
+        nd = len(leaf.shape)
+        return leaf.shape[0] if nd == 1 else leaf.shape[1]
+
+    def place_verify_args(self, args, count=True):
+        """(placed_args, shards) for a prepared verify chunk's pytree.
+
+        Identity on a 1-device plan; falls back to identity (shards=1)
+        when the padded set axis is not divisible by dp — correctness
+        never depends on the mesh."""
+        if not self.sharded:
+            if count:
+                _note_launch(False)
+            return args, 1
+        leaves = jax.tree_util.tree_leaves(args)
+        if not leaves or any(
+            self._set_axis_size(a) % self.dp for a in leaves
+        ):
+            if count:
+                _note_launch(False)
+            return args, 1
+        placed = jax.tree_util.tree_map(
+            lambda a: jax.device_put(
+                a, NamedSharding(self.mesh, self._verify_spec(a))
+            ),
+            args,
+        )
+        if count:
+            _note_launch(True)
+        return placed, self.n_devices
+
+    def place_batched(self, tree, axis, count=False):
+        """Shard one batch axis of an arbitrary pytree on dp (the
+        aggregation flush grids and the decompress lane axis).  Identity
+        when single-device or indivisible."""
+        if not self.sharded:
+            return tree, 1
+        leaves = jax.tree_util.tree_leaves(tree)
+        if not leaves or any(
+            axis >= len(a.shape) or a.shape[axis] % self.dp for a in leaves
+        ):
+            return tree, 1
+
+        def spec_of(a):
+            parts = [None] * len(a.shape)
+            parts[axis] = "dp"
+            return PartitionSpec(*parts)
+
+        placed = jax.tree_util.tree_map(
+            lambda a: jax.device_put(
+                a, NamedSharding(self.mesh, spec_of(a))
+            ),
+            tree,
+        )
+        if count:
+            _note_launch(True)
+        return placed, self.n_devices
+
+    def note_occupancy(self, n_sets, n_pad, shards):
+        """Record the per-shard occupancy of a launch (bls trace spans
+        mirror the same numbers)."""
+        if shards > 1:
+            SHARD_OCCUPANCY.set(round(n_sets / max(n_pad, 1), 4))
+
+    # -- identity -----------------------------------------------------
+
+    def topology_fingerprint(self):
+        return f"d{self.total_devices}dp{self.dp}mp{self.mp}"
+
+    def describe(self):
+        try:
+            devices = [
+                {
+                    "id": int(d.id),
+                    "platform": d.platform,
+                    "kind": getattr(d, "device_kind", "?"),
+                }
+                for d in jax.devices()
+            ]
+        except Exception:  # noqa: BLE001 — uninitialized backend
+            devices = []
+        return {
+            "sharded": self.sharded,
+            "dp": self.dp,
+            "mp": self.mp,
+            "mesh_devices": self.n_devices,
+            "total_devices": self.total_devices,
+            "reason": self.reason,
+            "topology_fingerprint": self.topology_fingerprint(),
+            "devices": devices,
+            "launches": launch_counts(),
+        }
+
+
+def _build_plan():
+    if os.environ.get("LTPU_MESH_DISABLE", "0") == "1":
+        try:
+            devices = jax.devices()
+        except Exception:  # noqa: BLE001
+            devices = []
+        return MeshPlan(devices, 1, 1, "disabled (LTPU_MESH_DISABLE)")
+    try:
+        devices = jax.devices()
+    except Exception as e:  # noqa: BLE001 — no backend yet
+        return MeshPlan([], 1, 1, f"no devices ({str(e)[:60]})")
+    raw = os.environ.get("LTPU_MESH", "")
+    try:
+        spec = parse_mesh_spec(raw)
+    except (ValueError, TypeError) as e:
+        log.warning("bad LTPU_MESH=%r (%s); single-device plan", raw, e)
+        return MeshPlan(devices, 1, 1, f"bad LTPU_MESH ({e})")
+    if spec is not None:
+        dp, mp = spec
+        if dp * mp > len(devices):
+            log.warning(
+                "LTPU_MESH=%r wants %d devices, %d visible; "
+                "single-device plan", raw, dp * mp, len(devices),
+            )
+            return MeshPlan(devices, 1, 1, "mesh larger than host")
+        return MeshPlan(devices, dp, mp, f"LTPU_MESH={raw}")
+    # auto policy: shard across every device on a real accelerator;
+    # virtual CPU devices add collective overhead with no capacity
+    if len(devices) > 1 and devices[0].platform != "cpu":
+        return MeshPlan(devices, len(devices), 1, "auto (all devices on dp)")
+    if len(devices) > 1:
+        return MeshPlan(
+            devices, 1, 1, "auto (cpu virtual devices: single-device)"
+        )
+    return MeshPlan(devices, 1, 1, "auto (single device)")
+
+
+_PLAN = None
+_PLAN_ENV = None
+_PLAN_LOCK = threading.Lock()
+
+_MESH_ENV_KEYS = ("LTPU_MESH", "LTPU_MESH_DISABLE")
+
+
+def get_mesh_plan() -> MeshPlan:
+    """Process mesh plan, rebuilt if the mesh env knobs changed (tests
+    and bench tools monkeypatch them)."""
+    global _PLAN, _PLAN_ENV
+    env = tuple(os.environ.get(k) for k in _MESH_ENV_KEYS)
+    with _PLAN_LOCK:
+        if _PLAN is None or env != _PLAN_ENV:
+            _PLAN = _build_plan()
+            _PLAN_ENV = env
+        return _PLAN
+
+
+def topology_fingerprint():
+    """Device count + mesh axes for the AOT cache key.  Never raises —
+    an uninitialized backend reads as its own (non-matching) topology."""
+    try:
+        return get_mesh_plan().topology_fingerprint()
+    except Exception:  # noqa: BLE001
+        return "d0dp1mp1"

@@ -69,6 +69,11 @@ TARGET_BATCH = metrics.gauge(
     "Dispatch threshold (signature sets) — walked toward the measured "
     "fixed-cost/marginal-cost knee by the adaptive EWMA controller",
 )
+MESH_DEVICES = metrics.gauge(
+    "verify_service_mesh_devices",
+    "Devices in the verification mesh plan — target_batch/max_batch and "
+    "the adaptive controller bounds scale by this (the knee is per-device)",
+)
 OVERLAP_RATIO = metrics.gauge(
     "verify_service_overlap_ratio",
     "Mean fraction of host-prep time hidden behind device execution in "

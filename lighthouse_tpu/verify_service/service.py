@@ -259,7 +259,7 @@ class VerificationService:
                  breaker_probe_max=None,
                  shed_watermark=None, pipeline=True,
                  adaptive_batch=False, target_bounds=None,
-                 remote_pool=None):
+                 remote_pool=None, mesh_devices=None):
         self.verifier = verifier or SignatureVerifier("oracle")
         # remote verification fabric (remote.py): when attached, the
         # FIRST backend tier — remote pool, then local device, then
@@ -268,8 +268,20 @@ class VerificationService:
         # the local tiers, so the remote fabric can only ever ADD
         # capacity, never block the chain.
         self.remote_pool = remote_pool
-        self.target_batch = int(target_batch)
-        self.max_batch = max(int(max_batch), self.target_batch)
+        # mesh scaling: the dispatch knee is PER-DEVICE, so an N-device
+        # verification mesh should coalesce ~N× the sets before a
+        # launch.  Auto-discovered from the backend's mesh plan unless
+        # pinned by the caller; 1 everywhere the backend is unsharded.
+        if mesh_devices is None:
+            try:
+                mesh_devices = getattr(self.verifier, "mesh_devices", 1)
+            except Exception:  # noqa: BLE001 — duck-typed backends
+                mesh_devices = 1
+        self.mesh_devices = max(1, int(mesh_devices or 1))
+        self.target_batch = int(target_batch) * self.mesh_devices
+        self.max_batch = max(
+            int(max_batch) * self.mesh_devices, self.target_batch
+        )
         # two-stage host-prep/device pipeline for multi-chunk batches
         # (engages only when the backend exposes a plan_pipeline split)
         self.pipeline = bool(pipeline)
@@ -279,13 +291,22 @@ class VerificationService:
         # custom targets) keep exact dispatch semantics by default.
         self._controller = None
         if adaptive_batch:
-            lo, hi = target_bounds or (
-                min(DEFAULT_MIN_TARGET, self.target_batch), self.max_batch
-            )
+            if target_bounds is not None:
+                lo, hi = (
+                    target_bounds[0] * self.mesh_devices,
+                    target_bounds[1] * self.mesh_devices,
+                )
+            else:
+                lo, hi = (
+                    min(DEFAULT_MIN_TARGET * self.mesh_devices,
+                        self.target_batch),
+                    self.max_batch,
+                )
             self._controller = AdaptiveBatchController(
                 self.target_batch, lo, hi
             )
         M.TARGET_BATCH.set(self.target_batch)
+        M.MESH_DEVICES.set(self.mesh_devices)
         # queued-set depth at which sheddable classes start being
         # rejected (level 1); 4x this is level 2.  Default: several
         # device passes' worth of backlog.
@@ -1143,6 +1164,7 @@ class VerificationService:
             "circuit_state": self.breaker.state,
             "device_ready": self.device_ready,
             "target_batch": self.target_batch,
+            "mesh_devices": self.mesh_devices,
             "dispatcher_restarts": self.restarts,
             "overlap_ratio_mean": (
                 round(sum(overlaps) / len(overlaps), 4) if overlaps else 0.0

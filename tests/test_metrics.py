@@ -202,6 +202,15 @@ def test_metric_name_lint():
         "aggregation_invalid_signatures_total",
         "aggregation_pubkey_presums_total",
     } <= names, sorted(names)
+    # the mesh-sharded verification families (ISSUE 10) must be
+    # registered and linted: the dispatcher's mesh-size gauge, per-shard
+    # occupancy, and the sharded-vs-single launch counters
+    assert {
+        "verify_service_mesh_devices",
+        "verify_shard_occupancy",
+        "verify_sharded_launches_total",
+        "verify_single_launches_total",
+    } <= names, sorted(names)
 
 
 def test_verify_service_queue_depth_is_one_labeled_family():

@@ -96,6 +96,69 @@ def test_dp2_mp4_invalid_batch_rejected(batch8x2):
     assert ok is False
 
 
+def _oracle_sets(n, poison_at=None):
+    """n valid 2-pubkey sets; `poison_at` tampers that set's message so
+    its signature no longer verifies."""
+    rng = random.Random(23)
+    sks = [rng.randrange(1, 2**250) for _ in range(2)]
+    pks = [RB.sk_to_pk(sk) for sk in sks]
+    sets = []
+    for i in range(n):
+        msg = i.to_bytes(32, "big")
+        sig = RB.aggregate([RB.sign(sk, msg) for sk in sks])
+        if i == poison_at:
+            msg = b"\xff" * 32
+        sets.append(RB.SignatureSet(sig, pks, msg))
+    return sets
+
+
+def _pipeline_verdicts(sets, seed):
+    """Run the PRODUCTION plan_pipeline -> prepare_chunk ->
+    execute_chunk path with a deterministic blinding-scalar stream."""
+    draws = random.Random(seed)
+    plan = tb.plan_pipeline(sets, DST_POP,
+                            rng=lambda: draws.randrange(1, 2**64))
+    assert plan is not None
+    chunks, prepare, execute = plan
+    return [bool(execute(prepare(c))) for c in chunks]
+
+
+def test_production_pipeline_sharded_verdicts_match(monkeypatch):
+    """ISSUE 10 acceptance: the FULL production path (plan_pipeline ->
+    prepare_chunk -> execute_chunk, mesh placement inside the device
+    stage) yields identical chunk verdicts with and without sharding for
+    the same seeded batch."""
+    monkeypatch.setenv("LTPU_MAX_SETS_BUCKET", "8")
+    monkeypatch.delenv("LTPU_MESH", raising=False)
+    sets = _oracle_sets(16)
+    base = _pipeline_verdicts(sets, seed=7)
+    assert base == [True, True]
+    monkeypatch.setenv("LTPU_MESH", "dp=8")
+    from lighthouse_tpu.crypto.tpu import sharding
+
+    before = sharding.launch_counts()["sharded"]
+    sharded = _pipeline_verdicts(sets, seed=7)
+    assert sharded == base
+    # the sharded runs actually went through mesh placement
+    assert sharding.launch_counts()["sharded"] == before + 2
+
+
+def test_production_per_set_poison_attribution_sharded(monkeypatch):
+    """Poisoned-set attribution on a sharded batch: the per-set verdict
+    vector is identical to the unsharded one, False exactly at the
+    poisoned index."""
+    monkeypatch.setenv("LTPU_MAX_SETS_BUCKET", "8")
+    poison = 12                         # second chunk under bucket 8
+    sets = _oracle_sets(16, poison_at=poison)
+    monkeypatch.delenv("LTPU_MESH", raising=False)
+    base = tb.verify_signature_sets_per_set(sets)
+    monkeypatch.setenv("LTPU_MESH", "dp=8")
+    sharded = tb.verify_signature_sets_per_set(sets)
+    want = [i != poison for i in range(len(sets))]
+    assert base == want
+    assert sharded == want
+
+
 def test_per_set_kernel_dp8_sharded(batch8x2):
     """Per-set verdict kernel under dp sharding: verdicts match unsharded."""
     pk, sig, u0, u1, _, _ = batch8x2

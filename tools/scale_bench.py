@@ -269,6 +269,16 @@ def main(argv=None):
     ap.add_argument("--sig-pool", type=int, default=256)
     ap.add_argument("--json", default=None)
     args = ap.parse_args(argv)
+    # mesh/device inventory header for bench JSON provenance (bench.py
+    # parses only the LAST stdout line; earlier lines are free)
+    try:
+        from lighthouse_tpu.crypto.tpu import sharding
+
+        mesh = sharding.get_mesh_plan().describe()
+        mesh.pop("launches", None)
+    except Exception as e:  # noqa: BLE001 — provenance, not correctness
+        mesh = {"error": str(e)[:120]}
+    print(json.dumps({"header": "mesh", "mesh": mesh}), flush=True)
     out = run(args)
     line = json.dumps(out)
     print(line)

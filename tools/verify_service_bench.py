@@ -12,10 +12,20 @@ By default the backend is a stub with a device-shaped latency model
 DISPATCHER, not BLS math, and runs in seconds.  --backend native|oracle
 verifies one real signature set repeatedly through the real seam.
 
+`--mesh-probe` is a different instrument: it times a toy verify-shaped
+device reduction through the MeshPlan placement path (sharded when
+`LTPU_MESH`/the device inventory says so, identity on a 1-device plan)
+against the same kernel launched raw, and reports the ratio.  On a
+1-device mesh the ratio proves the MeshPlan no-op costs nothing; under
+`--xla_force_host_platform_device_count=8` + `LTPU_MESH=dp=8` it
+documents the virtual-CPU sharding overhead (expected <=1x — the
+crossover is a real-hardware measurement).
+
 Usage:
     python tools/verify_service_bench.py
     python tools/verify_service_bench.py --rates 200,1000,5000 --submitters 16
     python tools/verify_service_bench.py --backend native
+    python tools/verify_service_bench.py --mesh-probe
 """
 
 import argparse
@@ -96,6 +106,69 @@ class StubVerifier:
         sets = list(sets)
         self.verify_signature_sets(sets)
         return [True] * len(sets)
+
+
+def mesh_header():
+    """Active mesh/device inventory for bench JSON provenance (one
+    header line; never raises — a missing jax backend reports itself)."""
+    try:
+        from lighthouse_tpu.crypto.tpu import sharding
+
+        d = sharding.get_mesh_plan().describe()
+        return {
+            "sharded": d["sharded"], "dp": d["dp"], "mp": d["mp"],
+            "mesh_devices": d["mesh_devices"],
+            "total_devices": d["total_devices"],
+            "reason": d["reason"],
+            "devices": d["devices"],
+        }
+    except Exception as e:  # noqa: BLE001 — provenance, not correctness
+        return {"error": str(e)[:120]}
+
+
+def run_mesh_probe(iters=30, warmup=5, n_sets=256):
+    """Toy verify-shaped reduction, raw jit vs MeshPlan placement.
+
+    The kernel has the verify arg shape ((limb, set, pk) int32, set-axis
+    reduction) but none of the pairing compile tax, so the probe times
+    PLACEMENT + LAUNCH overhead in seconds, not minutes."""
+    import jax
+    import jax.numpy as jnp
+
+    from lighthouse_tpu.crypto.tpu import sharding
+
+    plan = sharding.get_mesh_plan()
+    jk = jax.jit(lambda a: (a * a).sum(axis=(0, 2)))
+    x = jnp.ones((24, n_sets, 2), jnp.int32)
+
+    def sets_per_sec(through_plan):
+        def launch():
+            a = x
+            if through_plan:
+                (a,), _ = plan.place_verify_args((x,), count=False)
+            return jk(a).block_until_ready()
+
+        for _ in range(warmup):
+            launch()
+        t0 = time.monotonic()
+        for _ in range(iters):
+            launch()
+        return n_sets * iters / (time.monotonic() - t0)
+
+    single = sets_per_sec(False)
+    sharded = sets_per_sec(True)
+    return {
+        "tool": "verify_service_bench",
+        "mode": "mesh_probe",
+        "mesh": mesh_header(),
+        "mesh_devices": plan.n_devices,
+        "probe_sets": n_sets,
+        "single_sets_per_sec": round(single, 1),
+        "sharded_sets_per_sec": round(sharded, 1),
+        "shard_overhead_ratio": (
+            round(sharded / single, 4) if single else 0.0
+        ),
+    }
 
 
 def _real_backend(name):
@@ -205,8 +278,16 @@ def main(argv=None):
                     help="A/B the dispatcher's host-prep/device pipeline")
     ap.add_argument("--adaptive", action="store_true",
                     help="enable the adaptive target_batch controller")
+    ap.add_argument("--mesh-probe", action="store_true",
+                    help="time the MeshPlan placement path against a raw "
+                         "jit launch instead of running the load sweep")
     args = ap.parse_args(argv)
 
+    if args.mesh_probe:
+        print(json.dumps(run_mesh_probe()))
+        return 0
+
+    print(json.dumps({"header": "mesh", "mesh": mesh_header()}), flush=True)
     if args.backend == "stub":
         verifier = StubVerifier(args.fixed_ms, args.per_set_us,
                                 args.prep_ms, args.prep_per_set_us,
