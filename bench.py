@@ -1111,8 +1111,28 @@ def warm():
     print(json.dumps({"warmed": True, "left_s": round(_left(), 1)}))
 
 
+def _lint_preflight():
+    """Invariant lint BEFORE any bench lane burns kernel time: a
+    discipline regression (a plain jit site, logging under a lock, an
+    unwaivered thread spawn) fails fast here instead of surfacing as a
+    mystery perf cliff an hour in.  BENCH_NO_LINT=1 bypasses."""
+    if os.environ.get("BENCH_NO_LINT"):
+        return
+    from lighthouse_tpu import analysis
+
+    report = analysis.run_analysis()
+    if not report["clean"]:
+        sys.stderr.write(analysis.format_report(report) + "\n")
+        sys.stderr.write(
+            "bench preflight: invariant lint failed (tools/lint.py); "
+            "fix or waiver with justification, or BENCH_NO_LINT=1\n"
+        )
+        sys.exit(2)
+
+
 def main():
     global _DETAILS_PATH
+    _lint_preflight()
     if "--warm" in sys.argv:
         _DETAILS_PATH = "BENCH_WARM.json"
         warm()

@@ -43,7 +43,7 @@ from collections import defaultdict
 
 import numpy as np
 
-from ..utils import metrics
+from ..utils import locks, metrics
 
 INSERTS = metrics.counter(
     "aggregation_inserts_total",
@@ -103,7 +103,13 @@ class AggregationTier:
     def __init__(self, spec):
         self.spec = spec
         self.entries = defaultdict(list)
-        self._lock = threading.RLock()
+        self._lock = locks.rlock("aggregation.entries")
+        # serializes flushes against each other WITHOUT blocking
+        # inserts: the entry lock above is held only to snapshot and
+        # to commit, never across the batched kernel launch
+        # (lock-discipline: device work under the insert lock would
+        # stall every gossip insert for the length of an XLA pass)
+        self._flush_lock = locks.lock("aggregation.flush")
         self.pending = 0
         self.inserts = 0
         self.invalid = 0
@@ -175,57 +181,90 @@ class AggregationTier:
         from ..crypto.tpu import aggregation as ta
 
         t0 = time.monotonic()
-        with self._lock:
-            if not self.pending:
-                self._last_flush = time.monotonic()
-                return 0
-            work, blobs, seg_of = [], [], []
-            for key, entries in self.entries.items():
-                for entry in entries:
-                    if entry["validated"]:
-                        continue
-                    seg = len(work)
-                    work.append((key, entry))
-                    for b, sig in entry["contribs"]:
-                        blobs.append(sig)
-                        seg_of.append(seg)
-            if not blobs:
-                self.pending = 0
-                PENDING.set(0)
-                self._last_flush = time.monotonic()
-                return 0
+        with self._flush_lock:
+            # -- snapshot (entry lock held, O(pending) bookkeeping only)
+            with self._lock:
+                if not self.pending:
+                    self._last_flush = time.monotonic()
+                    return 0
+                work, blobs, seg_of = [], [], []
+                for key, entries in self.entries.items():
+                    for entry in entries:
+                        if entry["validated"]:
+                            continue
+                        seg = len(work)
+                        contribs = list(entry["contribs"])
+                        work.append((key, entry, len(contribs)))
+                        for b, sig in contribs:
+                            blobs.append(sig)
+                            seg_of.append(seg)
+                if not blobs:
+                    self.pending = 0
+                    PENDING.set(0)
+                    self._last_flush = time.monotonic()
+                    return 0
 
+            # -- launch (NO entry lock: inserts keep landing; anything
+            #    appended past the snapshotted length stays pending and
+            #    settles on the next flush)
             sums, ok = ta.aggregate_segments(blobs, seg_of, len(work))
 
-            pos = 0
-            dropped = 0
-            for seg, (key, entry) in enumerate(work):
-                contribs = entry["contribs"]
-                k = len(contribs)
-                good = [c for c, o in zip(contribs, ok[pos : pos + k]) if o]
-                pos += k
-                dropped += k - len(good)
-                if not good:
-                    self.entries[key].remove(entry)
-                    continue
-                merged = good[0][0]
-                for b, _ in good[1:]:
-                    merged = np.bitwise_or(merged, b)
-                sig = good[0][1] if len(good) == 1 else g2_compress(sums[seg])
-                entry["bits"] = merged
-                entry["contribs"] = [(merged, sig)]
-                entry["validated"] = True
-                entry["att"].aggregation_bits = [int(x) for x in merged]
-                entry["att"].signature = sig
-            for key in [k for k, v in self.entries.items() if not v]:
-                del self.entries[key]
-            settled = len(blobs)
-            self.pending = 0
-            self.invalid += dropped
-            self.flushes[trigger] += 1
-            self.flush_batches = (self.flush_batches + [settled])[-32:]
-            self._last_flush = time.monotonic()
-        PENDING.set(0)
+            # -- commit (entry lock re-held)
+            with self._lock:
+                pos = 0
+                dropped = 0
+                for seg, (key, entry, k) in enumerate(work):
+                    contribs = entry["contribs"]
+                    settled_c, tail = contribs[:k], contribs[k:]
+                    good = [
+                        c for c, o in zip(settled_c, ok[pos : pos + k]) if o
+                    ]
+                    pos += k
+                    dropped += k - len(good)
+                    live = self.entries.get(key, ())
+                    if not any(e is entry for e in live):
+                        continue      # pruned while the kernel ran
+                    if not good and not tail:
+                        self.entries[key] = [
+                            e for e in live if e is not entry
+                        ]
+                        continue
+                    new_contribs = list(tail)
+                    if good:
+                        merged = good[0][0]
+                        for b, _ in good[1:]:
+                            merged = np.bitwise_or(merged, b)
+                        sig = (
+                            good[0][1] if len(good) == 1
+                            else g2_compress(sums[seg])
+                        )
+                        new_contribs = [(merged, sig)] + new_contribs
+                        if not tail:
+                            entry["att"].aggregation_bits = [
+                                int(x) for x in merged
+                            ]
+                            entry["att"].signature = sig
+                    entry["contribs"] = new_contribs
+                    bits = new_contribs[0][0]
+                    for b, _ in new_contribs[1:]:
+                        bits = np.bitwise_or(bits, b)
+                    entry["bits"] = bits
+                    entry["validated"] = not tail
+                for key in [k for k, v in self.entries.items() if not v]:
+                    del self.entries[key]
+                settled = len(blobs)
+                self.pending = sum(
+                    len(e["contribs"])
+                    for entries in self.entries.values()
+                    for e in entries
+                    if not e["validated"]
+                )
+                self.invalid += dropped
+                self.flushes[trigger] += 1
+                self.flush_batches = (self.flush_batches + [settled])[-32:]
+                self._last_flush = time.monotonic()
+                pending_now = self.pending
+        PENDING.set(pending_now)
         FLUSHES.with_labels(trigger).inc()
         FLUSH_BATCH.observe(settled)
         FLUSH_SECONDS.observe(time.monotonic() - t0)

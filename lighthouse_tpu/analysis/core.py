@@ -1,0 +1,279 @@
+"""Rule-plugin static-analysis core: AST walk, findings, waiver ledger.
+
+A Rule inspects one parsed module at a time and yields Findings.  The
+runner parses each file exactly once, hands the tree to every rule
+whose ``applies_to`` accepts the path, then settles the findings
+against the waiver ledger:
+
+- a waiver is ``{"rule", "path", "match", "justification"}`` — it
+  covers findings of that rule, in that file, whose flagged source
+  line contains ``match`` (substring; line numbers drift, code doesn't)
+- the justification is MANDATORY and non-empty; a waiver without one
+  is itself an error finding (rule ``waiver-ledger``)
+- a waiver that matched nothing is STALE and also a finding — fixed
+  code must shed its waiver, the ledger can only shrink honestly
+
+Everything is stdlib (ast + json): the lint must run in the bare
+container, in CI, and inside tier-1 with zero new dependencies.
+"""
+
+import ast
+import json
+import os
+from pathlib import Path
+
+# package root being analyzed (…/lighthouse_tpu) and its repo parent
+PACKAGE_ROOT = Path(__file__).resolve().parent.parent
+
+
+class Finding:
+    """One rule violation at one source location (machine-readable)."""
+
+    __slots__ = ("rule", "path", "line", "col", "message", "snippet",
+                 "waived", "justification")
+
+    def __init__(self, rule, path, line, col, message, snippet=""):
+        self.rule = rule
+        self.path = str(path)
+        self.line = int(line)
+        self.col = int(col)
+        self.message = message
+        self.snippet = snippet
+        self.waived = False
+        self.justification = None
+
+    def to_dict(self):
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "snippet": self.snippet,
+            "waived": self.waived,
+            "justification": self.justification,
+        }
+
+    def __repr__(self):
+        flag = " [waived]" if self.waived else ""
+        return f"{self.path}:{self.line}: [{self.rule}]{flag} {self.message}"
+
+
+class Rule:
+    """Base plugin: subclass, set ``name``/``description``, implement
+    ``check(tree, path, lines)`` yielding Findings.  ``applies_to``
+    scopes the rule (default: every package file)."""
+
+    name = "abstract"
+    description = ""
+
+    def applies_to(self, relpath):
+        return True
+
+    def check(self, tree, relpath, lines):
+        raise NotImplementedError
+
+    # ---- helpers shared by the concrete rules
+
+    @staticmethod
+    def call_name(node):
+        """Terminal name of a Call's func: ``a.b.c(...)`` -> ``c``,
+        ``f(...)`` -> ``f``, anything else -> None."""
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            return fn.attr
+        if isinstance(fn, ast.Name):
+            return fn.id
+        return None
+
+    @staticmethod
+    def receiver_name(node):
+        """Terminal name of the object a method is called on:
+        ``self._queue.get()`` -> ``_queue``; plain calls -> None."""
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            return None
+        obj = fn.value
+        if isinstance(obj, ast.Attribute):
+            return obj.attr
+        if isinstance(obj, ast.Name):
+            return obj.id
+        return None
+
+    @staticmethod
+    def dotted(node):
+        """Best-effort dotted path of an expression: ``jax.jit`` ->
+        "jax.jit", ``self._lock`` -> "self._lock"."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+        return ".".join(reversed(parts)) if parts else ""
+
+    def finding(self, relpath, node, message, lines):
+        line = getattr(node, "lineno", 0)
+        snippet = ""
+        if 0 < line <= len(lines):
+            snippet = lines[line - 1].strip()[:120]
+        return Finding(self.name, relpath, line,
+                       getattr(node, "col_offset", 0), message, snippet)
+
+
+_RULES = {}
+
+
+def register_rule(cls):
+    """Plugin decorator: ``@register_rule`` on a Rule subclass makes it
+    part of every run.  Re-registration under the same name is an
+    error — two rules sharing a name would silently split the ledger."""
+    if cls.name in _RULES and type(_RULES[cls.name]) is not cls:
+        raise ValueError(f"duplicate rule name {cls.name!r}")
+    _RULES[cls.name] = cls()
+    return cls
+
+
+def all_rules():
+    return dict(_RULES)
+
+
+# ---------------------------------------------------------------- waivers
+
+def default_waivers_path():
+    return Path(__file__).resolve().parent / "waivers.json"
+
+
+def load_waivers(path=None):
+    """Load the ledger; returns (waivers, errors) where errors are
+    Findings for malformed entries (missing/empty justification or a
+    missing required key)."""
+    path = Path(path) if path is not None else default_waivers_path()
+    if not path.exists():
+        return [], []
+    raw = json.loads(path.read_text())
+    waivers, errors = [], []
+    for i, w in enumerate(raw):
+        missing = [k for k in ("rule", "path", "match") if not w.get(k)]
+        if missing or not str(w.get("justification", "")).strip():
+            why = (f"missing keys {missing}" if missing
+                   else "empty justification")
+            errors.append(Finding(
+                "waiver-ledger", str(path), i + 1, 0,
+                f"waiver #{i} ({w.get('rule')}:{w.get('path')}) is "
+                f"invalid: {why} — every waiver must name the rule, "
+                f"the file, a match substring, and a justification",
+            ))
+            continue
+        w = dict(w)
+        w["_used"] = False
+        waivers.append(w)
+    return waivers, errors
+
+
+def _settle(findings, waivers, waiver_errors, waivers_path):
+    """Mark findings waived, surface stale waivers, return the report."""
+    for f in findings:
+        for w in waivers:
+            if (w["rule"] == f.rule
+                    and w["path"] == f.path
+                    and w["match"] in (f.snippet or "")):
+                f.waived = True
+                f.justification = w["justification"]
+                w["_used"] = True
+                break
+    stale = [
+        Finding(
+            "waiver-ledger", str(waivers_path), 0, 0,
+            f"stale waiver ({w['rule']}:{w['path']}:{w['match']!r}) "
+            f"matched no finding — the violation is gone, remove the "
+            f"waiver",
+        )
+        for w in waivers if not w["_used"]
+    ]
+    active = [f for f in findings if not f.waived]
+    waived = [f for f in findings if f.waived]
+    return {
+        "findings": active,
+        "waived": waived,
+        "waiver_errors": list(waiver_errors) + stale,
+        "clean": not active and not waiver_errors and not stale,
+    }
+
+
+# ------------------------------------------------------------------ runs
+
+# directories never analyzed (caches, vendored bytecode)
+_SKIP_DIRS = {"__pycache__"}
+
+
+def _iter_files(root):
+    for path in sorted(Path(root).rglob("*.py")):
+        if _SKIP_DIRS.intersection(path.parts):
+            continue
+        yield path
+
+
+def run_analysis(root=None, rules=None, waivers_path=None):
+    """Run every (or the named) rule over the package tree; returns the
+    settled report dict (see ``_settle``).  ``root`` defaults to the
+    installed ``lighthouse_tpu`` package."""
+    root = Path(root) if root is not None else PACKAGE_ROOT
+    selected = all_rules()
+    if rules is not None:
+        unknown = set(rules) - set(selected)
+        if unknown:
+            raise ValueError(f"unknown rules: {sorted(unknown)}")
+        selected = {k: v for k, v in selected.items() if k in rules}
+    wpath = (Path(waivers_path) if waivers_path is not None
+             else default_waivers_path())
+    waiver_list, waiver_errors = load_waivers(wpath)
+    if rules is not None:
+        waiver_list = [w for w in waiver_list if w["rule"] in selected]
+
+    findings = []
+    for path in _iter_files(root):
+        rel = path.relative_to(root).as_posix()
+        applicable = [r for r in selected.values() if r.applies_to(rel)]
+        if not applicable:
+            continue
+        source = path.read_text()
+        try:
+            tree = ast.parse(source, filename=str(path))
+        except SyntaxError as e:
+            findings.append(Finding(
+                "parse", rel, e.lineno or 0, 0,
+                f"file does not parse: {e.msg}",
+            ))
+            continue
+        lines = source.splitlines()
+        for rule in applicable:
+            findings.extend(rule.check(tree, rel, lines))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return _settle(findings, waiver_list, waiver_errors, wpath)
+
+
+def analyze_source(source, rule_name, relpath="synthetic.py"):
+    """Run ONE rule over a source string — the unit-test seam: each
+    rule's tests feed a synthetic violation and assert it's flagged
+    without touching the real tree or the ledger."""
+    rule = all_rules()[rule_name]
+    tree = ast.parse(source)
+    return list(rule.check(tree, relpath, source.splitlines()))
+
+
+def format_report(report, root=None):
+    """Human-readable lint output (the CLI's default mode)."""
+    out = []
+    for f in report["findings"]:
+        out.append(f"{f.path}:{f.line}: [{f.rule}] {f.message}")
+        if f.snippet:
+            out.append(f"    {f.snippet}")
+    for f in report["waiver_errors"]:
+        out.append(f"{f.path}: [{f.rule}] {f.message}")
+    out.append(
+        f"{len(report['findings'])} finding(s), "
+        f"{len(report['waived'])} waived, "
+        f"{len(report['waiver_errors'])} ledger error(s)"
+    )
+    return "\n".join(out)

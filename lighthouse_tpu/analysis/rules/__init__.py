@@ -1,0 +1,15 @@
+"""Rule plugins — importing this package registers every rule.
+
+Adding an invariant = adding one module here with a ``@register_rule``
+class; the core, the CLI, tier-1 and the bench preflight pick it up
+with no further wiring.
+"""
+
+from . import (  # noqa: F401
+    jit_discipline,
+    lock_discipline,
+    metric_registration,
+    print_hygiene,
+    seeded_rng,
+    thread_discipline,
+)

@@ -1,0 +1,121 @@
+"""lock-discipline: no blocking I/O or device launches under a lock.
+
+The invariant PR 3 established the hard way ("shed/drop WARNs emit
+OUTSIDE the service/processor locks — handler I/O must never stall the
+dispatch path"), generalized: inside a ``with <lock>`` body, flag
+
+- logging calls (handler I/O, stdlib logging's own locks)
+- ``time.sleep``
+- ``os.fsync`` / ``os.fdatasync`` (storage stalls)
+- socket operations (sendall/sendto/recv/recvfrom/accept/connect)
+- blocking-queue get/put (receiver named ``*_q`` / ``*queue(s)``)
+- device launches (kernel entrypoints: a first-time XLA compile under
+  a lock wedges every contender for minutes)
+
+"Lock" is recognized by name: a with-item whose expression's terminal
+name contains lock/mutex or is a condition variable (cv/cond…).
+Nested function bodies are NOT scanned — a closure defined under a
+lock runs later, outside it.  Scope: production modules (``testing/``
+excluded); ``utils/logging.py``'s own handler internals are the one
+place where emission IS the protected operation — waivered there, not
+special-cased here.
+"""
+
+import ast
+import re
+
+from ..core import Rule, register_rule
+
+_LOCK_NAME = re.compile(r"(?i)(lock|mutex)|(^|_)(cv|cond|condition)$")
+_QUEUE_NAME = re.compile(r"(?i)(^|_)(q|queue)s?$")
+
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error",
+                "exception", "critical"}
+_LOG_RECEIVERS = re.compile(r"(?i)(^|_)(log|logger)$|^logging$")
+_SOCKET_METHODS = {"sendall", "sendto", "recv", "recvfrom", "accept",
+                   "connect", "create_connection"}
+_DEVICE_CALLS = {"execute_chunk", "aggregate_segments",
+                 "aggregate_pubkeys", "g2_decompress_batch",
+                 "to_mont_jit", "device_put", "block_until_ready",
+                 "compile_prewarm"}
+
+
+def is_lock_expr(expr):
+    """Does this with-item expression look like a lock acquisition?"""
+    node = expr
+    # `with lock_for(x):` / `with self._lock_of(k):` — call form
+    if isinstance(node, ast.Call):
+        node = node.func
+    name = None
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    return bool(name and _LOCK_NAME.search(name))
+
+
+@register_rule
+class LockDiscipline(Rule):
+    name = "lock-discipline"
+    description = ("no logging/sleep/fsync/socket/blocking-queue/"
+                   "device-launch calls inside `with <lock>` bodies")
+
+    def applies_to(self, relpath):
+        return not relpath.startswith("testing/")
+
+    def check(self, tree, relpath, lines):
+        findings = []
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            held = [self.dotted(i.context_expr) or
+                    self.dotted(getattr(i.context_expr, "func", i.context_expr))
+                    for i in node.items
+                    if is_lock_expr(i.context_expr)]
+            if not held:
+                continue
+            for call in self._calls_in_body(node.body):
+                why = self._classify(call)
+                if why:
+                    findings.append(self.finding(
+                        relpath, call,
+                        f"{why} inside `with {held[0]}` — blocking work "
+                        f"under a lock stalls every contender "
+                        f"(PR 3 invariant)", lines,
+                    ))
+        return findings
+
+    def _calls_in_body(self, body):
+        """Every Call in the with body, NOT descending into nested
+        function/lambda definitions (those run outside the lock) and
+        not re-entering nested with-blocks' own lock scopes (they are
+        visited by the outer walk; calls under them still count for
+        THIS lock, so we do descend into them)."""
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _classify(self, call):
+        cname = self.call_name(call)
+        recv = self.receiver_name(call)
+        dotted = self.dotted(call.func)
+        if (cname in _LOG_METHODS and recv
+                and _LOG_RECEIVERS.search(recv)):
+            return "logging call"
+        if dotted == "time.sleep":
+            return "time.sleep"
+        if dotted in ("os.fsync", "os.fdatasync"):
+            return f"{dotted} call"
+        if cname in _SOCKET_METHODS:
+            return f"socket .{cname}()"
+        if cname in ("get", "put") and recv and _QUEUE_NAME.search(recv):
+            return f"blocking queue .{cname}()"
+        if cname in _DEVICE_CALLS:
+            return f"device launch {cname}()"
+        return None

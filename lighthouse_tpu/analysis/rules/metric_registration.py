@@ -1,0 +1,99 @@
+"""metric-registration: every registration site survives a scrape.
+
+The static generalization of the prometheus-naming lint that lived in
+``tests/test_metrics.py`` (which now wraps this rule plus its runtime
+registry assertions).  At every ``metrics.counter/gauge/histogram(...)``
+call site:
+
+- the name must be a string LITERAL (the registry stays enumerable by
+  reading the source) matching ``[a-zA-Z_:][a-zA-Z0-9_:]*``
+- help text (second positional or ``help=``) must be a non-empty
+  literal — a metric the operator can't read is a metric nobody trusts
+- ``labels=`` elements must be literal, valid, non-reserved label
+  names (``__``-prefixed names are Prometheus-internal)
+- counters must end in ``_total`` (exposition convention the existing
+  families all follow)
+"""
+
+import ast
+import re
+
+from ..core import Rule, register_rule
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+_LABEL_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*")
+_KINDS = {"counter", "gauge", "histogram"}
+
+
+@register_rule
+class MetricRegistration(Rule):
+    name = "metric-registration"
+    description = ("metrics.counter/gauge/histogram sites use literal "
+                   "prometheus-valid names, non-empty help, valid "
+                   "labels; counters end in _total")
+
+    def check(self, tree, relpath, lines):
+        findings = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute) and fn.attr in _KINDS
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "metrics"):
+                continue
+            kind = fn.attr
+            findings.extend(self._check_site(node, kind, relpath, lines))
+        return findings
+
+    def _check_site(self, node, kind, relpath, lines):
+        out = []
+
+        def flag(msg):
+            out.append(self.finding(relpath, node, msg, lines))
+
+        name = node.args[0] if node.args else None
+        for kw in node.keywords:
+            if kw.arg == "name":
+                name = kw.value
+        if not (isinstance(name, ast.Constant)
+                and isinstance(name.value, str)):
+            flag(f"metrics.{kind}() name is not a string literal — "
+                 f"the registry must stay enumerable from source")
+            return out
+        if not _NAME_RE.fullmatch(name.value):
+            flag(f"metric name {name.value!r} fails the prometheus "
+                 f"naming regex")
+        if kind == "counter" and not name.value.endswith("_total"):
+            flag(f"counter {name.value!r} does not end in _total "
+                 f"(exposition convention)")
+
+        help_node = node.args[1] if len(node.args) > 1 else None
+        for kw in node.keywords:
+            if kw.arg == "help":
+                help_node = kw.value
+        if not (isinstance(help_node, ast.Constant)
+                and isinstance(help_node.value, str)
+                and help_node.value.strip()):
+            flag(f"metric {name.value!r} has missing/empty help text "
+                 f"— scrapes ship `# HELP`, operators read it")
+
+        for kw in node.keywords:
+            if kw.arg != "labels":
+                continue
+            if not isinstance(kw.value, (ast.Tuple, ast.List)):
+                flag(f"metric {name.value!r} labels= is not a literal "
+                     f"tuple/list")
+                continue
+            for el in kw.value.elts:
+                if not (isinstance(el, ast.Constant)
+                        and isinstance(el.value, str)):
+                    flag(f"metric {name.value!r} has a non-literal "
+                         f"label name")
+                elif not _LABEL_RE.fullmatch(el.value):
+                    flag(f"metric {name.value!r}: bad label "
+                         f"{el.value!r}")
+                elif el.value.startswith("__"):
+                    flag(f"metric {name.value!r}: label {el.value!r} "
+                         f"is reserved (double underscore)")
+        return out

@@ -1,0 +1,44 @@
+"""print-hygiene: daemon code logs through the flight recorder.
+
+The AST port of the regex lint that lived in ``tests/test_logging.py``
+(now a thin wrapper over this rule): stdout writes are invisible to
+``/lighthouse/logs``, carry no severity, and never reach the rotated
+logfile.  A bare ``print(...)`` call in a daemon module is a finding;
+CLI/tool surfaces where print IS the interface (``cli.py``) are
+exempt by scope, anything else needs a waiver naming the interface.
+
+AST beats the old regex: docstrings, comments and string literals
+containing "print(" can no longer trip it, and aliased calls can't
+hide from it inside parentheses.
+"""
+
+import ast
+
+from ..core import Rule, register_rule
+
+# CLI/tool output surfaces where print() IS the interface
+ALLOWLIST = {"cli.py"}
+
+
+@register_rule
+class PrintHygiene(Rule):
+    name = "print-hygiene"
+    description = ("no bare print() in daemon modules — log through "
+                   "utils.logging.get_logger")
+
+    def applies_to(self, relpath):
+        return relpath not in ALLOWLIST
+
+    def check(self, tree, relpath, lines):
+        findings = []
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                findings.append(self.finding(
+                    relpath, node,
+                    "bare print() in a daemon module — use "
+                    "utils.logging.get_logger (stdout is invisible to "
+                    "/lighthouse/logs and the rotated logfile)", lines,
+                ))
+        return findings

@@ -1,0 +1,71 @@
+"""seeded-rng: fault-injection and audit paths draw reproducibly.
+
+PR 5/8's contract: failpoint storms and untrusted-verdict audits are
+REPLAYABLE — every probabilistic decision draws from a per-name
+``random.Random(f"{seed}:{name}")`` under ``LTPU_FAILPOINTS_SEED``,
+never from the module-level ``random`` functions (shared global state:
+any library call perturbs the stream) and never seeded from wall time.
+
+Scope: the failpoint/audit/retry modules only (``utils/failpoints.py``,
+``utils/retries.py``, ``verify_service/remote.py``).  Flags:
+
+- any use of a module-level ``random.<fn>`` — called OR passed as a
+  callback (``rng=random.random`` smuggles the global stream in);
+  ``random.Random(...)`` construction is the sanctioned path
+- ``random.seed(...)`` anywhere (reseeding the global stream)
+- ``time.time()`` used as a seed argument to ``random.Random``
+
+The deliberate module-rng sites (retry/hedge jitter — PR 8 documents
+timing jitter must NOT consume the audit stream) are waivered with
+that justification, not silently allowed.
+"""
+
+import ast
+
+from ..core import Rule, register_rule
+
+_SCOPED = ("utils/failpoints.py", "utils/retries.py",
+           "verify_service/remote.py")
+
+
+@register_rule
+class SeededRng(Rule):
+    name = "seeded-rng"
+    description = ("failpoint/audit paths use the seeded per-name "
+                   "RNG, never module-level random/time seeding")
+
+    def applies_to(self, relpath):
+        return relpath in _SCOPED
+
+    def check(self, tree, relpath, lines):
+        findings = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute):
+                if (isinstance(node.value, ast.Name)
+                        and node.value.id == "random"
+                        and node.attr != "Random"):
+                    if node.attr == "seed":
+                        msg = ("random.seed() reseeds the GLOBAL "
+                               "stream — construct a per-name "
+                               "random.Random instead")
+                    else:
+                        msg = (f"module-level random.{node.attr} in a "
+                               f"failpoint/audit path — draws must "
+                               f"come from the seeded per-name Random "
+                               f"so storms replay (PR 5 invariant)")
+                    findings.append(self.finding(relpath, node, msg,
+                                                 lines))
+            elif (isinstance(node, ast.Call)
+                    and self.dotted(node.func) == "random.Random"):
+                for arg in node.args:
+                    for sub in ast.walk(arg):
+                        if (isinstance(sub, ast.Call)
+                                and self.dotted(sub.func) == "time.time"):
+                            findings.append(self.finding(
+                                relpath, node,
+                                "random.Random(time.time()) — a "
+                                "wall-time seed is unreplayable; "
+                                "derive from LTPU_FAILPOINTS_SEED + "
+                                "the site name", lines,
+                            ))
+        return findings

@@ -1,0 +1,71 @@
+"""thread-discipline: every production thread is daemon + supervised.
+
+PR 5/6's contract: worker loops stamp a watchdog heartbeat and expose
+a generation-bumped restart hook; every spawned thread is ``daemon=``
+so a wedged worker can never block interpreter exit.  Statically:
+
+- ``threading.Thread(...)`` must pass ``daemon=True`` (a literal; a
+  variable or a missing keyword needs a waiver saying why)
+- a Thread spawn in a module with no watchdog linkage (no mention of
+  ``watchdog``/``heartbeat``/``executor.spawn`` anywhere in the file)
+  is flagged as unsupervised — short-lived or join-at-shutdown server
+  threads are waivered with that justification, long-running loops get
+  registered
+
+Scope: production modules — ``testing/`` and ``cli.py`` excluded
+(tools and fixtures spawn throwaway threads by design).
+"""
+
+import ast
+
+from ..core import Rule, register_rule
+
+
+@register_rule
+class ThreadDiscipline(Rule):
+    name = "thread-discipline"
+    description = ("threading.Thread sites are daemon=True and "
+                   "watchdog-supervised (or waivered)")
+
+    def applies_to(self, relpath):
+        return not relpath.startswith("testing/") and relpath != "cli.py"
+
+    def check(self, tree, relpath, lines):
+        findings = []
+        blob = "\n".join(lines)
+        supervised_module = ("watchdog" in blob or "heartbeat" in blob
+                             or "executor.spawn" in blob)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if self.dotted(node.func) not in ("threading.Thread",
+                                              "Thread"):
+                continue
+            daemon = None
+            for kw in node.keywords:
+                if kw.arg == "daemon":
+                    daemon = kw.value
+            if daemon is None:
+                findings.append(self.finding(
+                    relpath, node,
+                    "threading.Thread without daemon= — a wedged "
+                    "worker must never block interpreter exit "
+                    "(pass daemon=True or waiver with the join "
+                    "strategy)", lines,
+                ))
+            elif not (isinstance(daemon, ast.Constant)
+                      and daemon.value is True):
+                findings.append(self.finding(
+                    relpath, node,
+                    "threading.Thread daemon= is not the literal True "
+                    "— a computed daemon flag hides non-daemon spawns "
+                    "(waiver with where the flag is decided)", lines,
+                ))
+            if not supervised_module:
+                findings.append(self.finding(
+                    relpath, node,
+                    "thread spawned in a module with no watchdog "
+                    "linkage — register a heartbeat/restart hook or "
+                    "waiver with the lifecycle (PR 5 invariant)", lines,
+                ))
+        return findings

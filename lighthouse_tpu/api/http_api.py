@@ -852,6 +852,15 @@ class _Handler(JsonHandler):
                 )
             return self._json({"data": data})
 
+        if path == "/lighthouse/locks":
+            # runtime lock-order witness: per-site acquisition counts,
+            # the recorded order graph, detected lock-order cycles and
+            # held-too-long stalls (enable with LTPU_LOCK_WITNESS=1;
+            # honest {"enabled": false} shell otherwise)
+            from ..utils import locks as ltpu_locks
+
+            return self._json({"data": ltpu_locks.report()})
+
         if path == "/lighthouse/logs/recent":
             # newest-first structured records from the flight recorder's
             # ring buffer; ?level= filters at-or-above, ?component= exact

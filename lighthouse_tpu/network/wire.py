@@ -34,7 +34,7 @@ from collections import OrderedDict
 
 from ..ssz import Bytes4, Bytes32, Container, decode, encode, uint64
 from ..types.spec import compute_fork_data_root
-from ..utils import failpoints
+from ..utils import failpoints, locks
 from . import snappy
 from .gossip import GossipKind, PeerScore, PeerTopicScores
 from .gossip import topic_matches as _tm
@@ -195,7 +195,7 @@ class PubkeyDecodeCache:
         self.hits = 0
         self.misses = 0
         self._entries = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = locks.lock("wire.pubkey_cache")
 
     def decompress(self, data):
         data = bytes(data)
@@ -433,7 +433,7 @@ class _Peer:
         self.topic_scores = PeerTopicScores()
         self.status = None           # remote StatusMessage
         self.metadata_seq = 0
-        self._wlock = threading.Lock()
+        self._wlock = locks.lock("wire.peer.write")
         self._alive = True
         self.tx = None               # CipherState after noise handshake
         self.rx = None
@@ -533,11 +533,11 @@ class WireNode:
         self._addr_fails = {}          # addr -> consecutive dial failures
         self.banned_ids = set()
         self._seen = OrderedDict()     # message id -> None (gossip dedup)
-        self._seen_lock = threading.Lock()
+        self._seen_lock = locks.lock("wire.seen")
         self._req_id = 0
         self._pending = {}             # req_id -> [event, result, code, ...]
         self._resp_frames = 0          # streamed response frames seen
-        self._lock = threading.Lock()
+        self._lock = locks.lock("wire.node")
         self.codec = None
         if chain is not None:
             self.codec = GossipCodec(chain.preset)
@@ -571,7 +571,7 @@ class WireNode:
         # pass that unblocks after restart_heartbeat_thread must not
         # mutate mesh/_mcache/_iwant_served concurrently with its
         # replacement (the BeaconNode slot-timer tick-lock pattern)
-        self._hb_tick_lock = threading.Lock()
+        self._hb_tick_lock = locks.lock("wire.heartbeat_tick")
         self.heartbeat_restarts = 0
         self.reader_stall_budget = 60.0
         self._accept_thread = threading.Thread(

@@ -1,0 +1,309 @@
+"""Runtime lock-order witness: FreeBSD-witness-style race/deadlock
+detection for the verification stack, zero-cost when off.
+
+Every adopted lock site constructs through the factories here:
+
+    self._lock = locks.lock("verify_service.work")
+    self._mu   = locks.rlock("aggregation.tier")
+
+With ``LTPU_LOCK_WITNESS`` unset (production default) the factories
+return PLAIN ``threading.Lock``/``RLock`` objects — no wrapper, no
+branch on the hot path, identity-testable in tier-1.  With
+``LTPU_LOCK_WITNESS=1`` they return instrumented wrappers that feed a
+process-wide witness:
+
+- **lock-order graph**: each thread carries a stack of held lock
+  names; acquiring B while holding A records the edge A→B.  An edge
+  whose reverse path already exists is a lock-order CYCLE — the
+  classic AB/BA deadlock, caught the first time the two orders ever
+  run, no interleaving luck required (the FreeBSD witness(4) idea)
+- **held-too-long stalls**: a lock held past
+  ``LTPU_LOCK_WITNESS_STALL_MS`` (default 500) when released is
+  recorded with its hold time — the runtime complement of the static
+  lock-discipline rule (blocking work under a lock)
+
+Reporting: ``lighthouse_lock_witness_*`` metric families and the
+``GET /lighthouse/locks`` route (``report()`` here).  The witness's
+own bookkeeping uses one plain internal mutex held only for dict
+updates — never while acquiring a user lock, never while logging — so
+it cannot deadlock the locks it watches.  ``utils/metrics.py`` and
+``utils/logging.py`` internals are deliberately NOT adopted: the
+witness reports through them.
+
+Lock names are SITE names (one per lock role, not per instance):
+order is a property of the code path, exactly like witness(4) keys on
+lock classes.
+"""
+
+import os
+import threading
+import time
+from collections import deque
+
+from . import metrics
+
+ACQUIRES = metrics.counter(
+    "lighthouse_lock_witness_acquisitions_total",
+    "Instrumented lock acquisitions seen by the lock-order witness",
+    labels=("name",),
+)
+CYCLES = metrics.counter(
+    "lighthouse_lock_witness_cycles_total",
+    "Distinct lock-order cycles (potential deadlocks) detected",
+)
+STALLS = metrics.counter(
+    "lighthouse_lock_witness_stalls_total",
+    "Lock holds that exceeded the stall budget at release",
+    labels=("name",),
+)
+HELD_SECONDS = metrics.histogram(
+    "lighthouse_lock_witness_held_seconds",
+    "Hold time of instrumented locks (witness mode only)",
+    buckets=(0.0001, 0.001, 0.01, 0.1, 0.5, 2.0),
+)
+
+
+def enabled():
+    """Witness mode is decided per lock CONSTRUCTION (env read here),
+    so a process started with LTPU_LOCK_WITNESS=1 instruments every
+    adopted site and an unset env costs literally nothing."""
+    return os.environ.get("LTPU_LOCK_WITNESS", "") not in ("", "0")
+
+
+def stall_budget_s():
+    return float(os.environ.get("LTPU_LOCK_WITNESS_STALL_MS", "500")) / 1e3
+
+
+class Witness:
+    """Process-wide order graph + stall ledger (injectable clock and
+    stall budget for deterministic tests)."""
+
+    def __init__(self, stall_s=None, clock=time.monotonic):
+        self._mu = threading.Lock()      # plain by design: see module doc
+        self._tls = threading.local()
+        self._clock = clock
+        self.stall_s = stall_budget_s() if stall_s is None else float(stall_s)
+        self._acquires = {}              # name -> count
+        self._edges = {}                 # name -> set(successors)
+        self._edge_where = {}            # (a, b) -> first example
+        self.cycles = deque(maxlen=64)   # cycle reports (rare, bounded)
+        self.stalls = deque(maxlen=256)  # stall reports (bounded ring)
+
+    # ------------------------------------------------------- thread state
+
+    def _stack(self):
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    # ---------------------------------------------------------- recording
+
+    def note_acquired(self, name):
+        st = self._stack()
+        held = [n for n, _ in st]
+        cycle = None
+        with self._mu:
+            self._acquires[name] = self._acquires.get(name, 0) + 1
+            for h in held:
+                if h == name:
+                    continue            # re-entrant (RLock) same-site hold
+                succ = self._edges.setdefault(h, set())
+                if name in succ:
+                    continue            # known edge, already vetted
+                path = self._path(name, h)
+                if path is not None:
+                    cycle = {
+                        "edge": [h, name],
+                        "reverse_path": path,
+                        "thread": threading.current_thread().name,
+                        "held": held,
+                    }
+                    self.cycles.append(cycle)
+                succ.add(name)
+                self._edge_where[(h, name)] = {
+                    "thread": threading.current_thread().name,
+                    "held": held,
+                }
+        st.append((name, self._clock()))
+        ACQUIRES.with_labels(name).inc()
+        if cycle is not None:
+            CYCLES.inc()
+            # WARN outside the witness mutex (lock-discipline applies
+            # to the witness itself); lazy import keeps utils.logging
+            # free to import locks if it ever wants to
+            from .logging import get_logger
+
+            get_logger("locks").warning(
+                "lock-order cycle: acquiring %s while holding %s "
+                "reverses established order %s",
+                name, cycle["edge"][0],
+                " -> ".join(cycle["reverse_path"]),
+                thread=cycle["thread"],
+            )
+
+    def note_released(self, name):
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i][0] == name:
+                _, t0 = st.pop(i)
+                break
+        else:
+            return                      # release of an unseen acquire
+        dt = self._clock() - t0
+        HELD_SECONDS.observe(dt)
+        if dt > self.stall_s:
+            with self._mu:
+                self.stalls.append({
+                    "name": name,
+                    "held_seconds": round(dt, 4),
+                    "budget_seconds": self.stall_s,
+                    "thread": threading.current_thread().name,
+                })
+            STALLS.with_labels(name).inc()
+
+    def _path(self, src, dst):
+        """DFS: names reachable src -> dst through recorded edges;
+        returns the path (src..dst) or None.  Called under _mu; the
+        graph is tiny (one node per lock SITE)."""
+        stack = [(src, [src])]
+        seen = set()
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            if node in seen:
+                continue
+            seen.add(node)
+            for nxt in self._edges.get(node, ()):
+                stack.append((nxt, path + [nxt]))
+        return None
+
+    # ---------------------------------------------------------- reporting
+
+    def report(self):
+        with self._mu:
+            return {
+                "enabled": True,
+                "stall_budget_ms": round(self.stall_s * 1e3, 3),
+                "locks": dict(self._acquires),
+                "edges": sorted(
+                    [a, b] for a, succ in self._edges.items() for b in succ
+                ),
+                "cycles": list(self.cycles),
+                "stalls": list(self.stalls),
+            }
+
+
+class _WitnessBase:
+    """Shared wrapper plumbing; subclasses pick the inner lock.  The
+    wrapper is Condition-compatible: acquire/release/__enter__/__exit__
+    plus locked(), which is all threading.Condition needs from a
+    non-recursive lock."""
+
+    def __init__(self, name, witness, inner):
+        self._name = name
+        self._witness = witness
+        self._inner = inner
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._witness.note_acquired(self._name)
+        return ok
+
+    def release(self):
+        self._witness.note_released(self._name)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self._name!r} {self._inner!r}>"
+
+
+class WitnessLock(_WitnessBase):
+    def __init__(self, name, witness, inner=None):
+        super().__init__(name, witness, inner or threading.Lock())
+
+
+class WitnessRLock(_WitnessBase):
+    def __init__(self, name, witness, inner=None):
+        super().__init__(name, witness, inner or threading.RLock())
+
+    # Condition(RLock) compatibility: delegate the recursion-aware
+    # save/restore protocol, keeping the witness stack in step
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        # RLock._release_save drops EVERY recursion level; pop the
+        # witness stack until this name is gone so wait() never reads
+        # as "held"
+        st = self._witness._stack()
+        while any(n == self._name for n, _ in st):
+            self._witness.note_released(self._name)
+        return self._inner._release_save()
+
+    def _acquire_restore(self, state):
+        self._inner._acquire_restore(state)
+        self._witness.note_acquired(self._name)
+
+
+_GLOBAL = None
+_GLOBAL_MU = threading.Lock()
+
+
+def get_witness():
+    global _GLOBAL
+    with _GLOBAL_MU:
+        if _GLOBAL is None:
+            _GLOBAL = Witness()
+        return _GLOBAL
+
+
+def reset_witness():
+    """Drop the process witness (tests); the next instrumented lock
+    construction or report() builds a fresh graph."""
+    global _GLOBAL
+    with _GLOBAL_MU:
+        _GLOBAL = None
+
+
+def lock(name, witness=None):
+    """A mutex for the named site: plain threading.Lock when the
+    witness is off (identity — zero overhead), an instrumented wrapper
+    when on.  ``witness=`` forces instrumentation (tests)."""
+    if witness is not None:
+        return WitnessLock(name, witness)
+    if not enabled():
+        return threading.Lock()
+    return WitnessLock(name, get_witness())
+
+
+def rlock(name, witness=None):
+    if witness is not None:
+        return WitnessRLock(name, witness)
+    if not enabled():
+        return threading.RLock()
+    return WitnessRLock(name, get_witness())
+
+
+def report():
+    """The /lighthouse/locks payload — honest about being off."""
+    if not enabled():
+        return {
+            "enabled": False,
+            "stall_budget_ms": round(stall_budget_s() * 1e3, 3),
+            "locks": {}, "edges": [], "cycles": [], "stalls": [],
+        }
+    return get_witness().report()

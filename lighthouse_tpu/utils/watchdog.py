@@ -21,6 +21,7 @@ staleness each sweep observed.
 import threading
 import time
 
+from . import locks
 from . import logging as ltpu_logging
 from . import metrics, tracing
 from .logging import get_logger
@@ -72,7 +73,7 @@ class Watchdog:
         self.interval = float(interval)
         self._clock = clock
         self._targets = {}
-        self._lock = threading.Lock()
+        self._lock = locks.lock("watchdog.targets")
         self._stop = threading.Event()
         self._thread = None
         # name -> the evidence captured at the last stale detection

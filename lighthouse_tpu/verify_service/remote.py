@@ -56,7 +56,7 @@ import threading
 import time
 from queue import Empty, Queue
 
-from ..utils import failpoints
+from ..utils import failpoints, locks
 from ..utils.logging import get_logger
 from ..utils.retries import RetryPolicy
 from . import metrics as M
@@ -94,7 +94,7 @@ class RemoteTarget:
                  breaker_cooldown=DEFAULT_BREAKER_COOLDOWN_S,
                  clock=time.monotonic):
         self.name = str(name)
-        self.lock = threading.Lock()
+        self.lock = locks.lock("remote.target")
         self.breaker = CircuitBreaker(
             breaker_threshold, breaker_cooldown, clock=clock,
             state_gauge=M.REMOTE_BREAKER.with_labels(self.name),
@@ -159,7 +159,7 @@ class _Job:
         self.result = None
         self.winner = None
         self.event = threading.Event()
-        self.lock = threading.Lock()
+        self.lock = locks.lock("remote.job")
         self.duplicates = 0
 
     def offer(self, verdicts, target):
@@ -204,7 +204,7 @@ class WireTransport:
     def __init__(self, wire):
         self.wire = wire
         self._peers = {}   # target -> dialed peer id
-        self._lock = threading.Lock()
+        self._lock = locks.lock("remote.transport")
 
     def _peer_for(self, target):
         if target in self.wire.peers:
@@ -284,7 +284,7 @@ class RemoteVerifierPool:
         # intact, and `verify_batch`'s bounded wait means callers never
         # block past the budget either way
         self._jobs = Queue()
-        self._lock = threading.Lock()
+        self._lock = locks.lock("remote.pool")
         self._worker = None
         self._gen = 0
         self._stopped = False

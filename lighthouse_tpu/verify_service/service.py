@@ -32,7 +32,7 @@ from collections import deque
 from queue import Empty, Queue
 
 from ..crypto.backend import SignatureVerifier
-from ..utils import failpoints, tracing
+from ..utils import failpoints, locks, tracing
 from ..utils.logging import get_logger
 from . import metrics as M
 from .circuit import OPEN, CircuitBreaker
@@ -328,7 +328,7 @@ class VerificationService:
         # the nearest-deadline peek is O(log n), not a full-queue scan
         self._deadline_heap = []
         self._req_seq = itertools.count()
-        self._cv = threading.Condition()
+        self._cv = threading.Condition(locks.lock("verify_service.cv"))
         self._thread = None
         self._executor = None
         self._stopped = False
@@ -348,7 +348,7 @@ class VerificationService:
         # one (the breaker, _device_event and the adaptive controller
         # are single-dispatcher state by contract) — the replacement
         # blocks until the old thread's in-flight batch resolves
-        self._work_lock = threading.Lock()
+        self._work_lock = locks.lock("verify_service.work")
 
         # admission warm gate: while a compile prewarm is in flight
         # (BeaconNode.start kicks one before the dispatcher may touch

@@ -35,3 +35,20 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running device tests")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # Under LTPU_LOCK_WITNESS=1 the whole suite doubles as a lock-order
+    # soak: any production AB/BA inversion recorded by the global
+    # witness fails the run here.  (Deliberate cycles in
+    # tests/test_analysis.py use private Witness instances and never
+    # touch the global graph.)
+    from lighthouse_tpu.utils import locks
+
+    if not locks.enabled():
+        return
+    cycles = locks.report().get("cycles", [])
+    if cycles:
+        session.exitstatus = 1
+        print(f"\nlock witness recorded {len(cycles)} lock-order "
+              f"cycle(s) during the suite: {cycles}")

@@ -1,0 +1,444 @@
+"""Invariant lint suite + runtime lock-order witness (ISSUE 11).
+
+Two halves:
+
+1. static — every analysis rule flags its synthetic violation, the
+   waiver ledger demands justifications and rots loudly (stale waivers
+   are findings), and the REPO ITSELF is clean: ``tools/lint.py`` over
+   the live tree exits 0 with zero unjustified waivers.  That last
+   test is the tier-1 wiring the ISSUE asks for.
+
+2. runtime — the env-gated lock witness detects a seeded AB/BA
+   lock-order cycle and a held-too-long stall, stays identical to
+   ``threading.Lock`` when disabled, and serves ``GET
+   /lighthouse/locks``.
+"""
+
+import json
+import subprocess
+import sys
+import threading
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from lighthouse_tpu import analysis
+from lighthouse_tpu.utils import locks
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ------------------------------------------------------------ framework
+
+
+def test_all_five_issue_rules_plus_migrated_lints_registered():
+    names = set(analysis.all_rules())
+    assert {
+        "lock-discipline", "jit-discipline", "thread-discipline",
+        "seeded-rng", "metric-registration",   # the ISSUE's five
+        "print-hygiene",                       # migrated from test_logging
+    } <= names
+
+
+def test_repo_is_clean_with_zero_unjustified_waivers():
+    """THE acceptance gate: all rules over the live package — no
+    unwaived findings, no ledger errors (a waiver missing its
+    justification or matching nothing is a ledger error)."""
+    report = analysis.run_analysis()
+    assert report["clean"], analysis.format_report(report)
+    # and every shipped waiver really carries a justification
+    waivers, errors = analysis.load_waivers()
+    assert not errors
+    assert waivers, "ledger unexpectedly empty"
+    assert all(w["justification"].strip() for w in waivers)
+
+
+# ------------------------------------------------- per-rule violations
+
+
+def _msgs(findings):
+    return " | ".join(f.message for f in findings)
+
+
+def test_lock_discipline_flags_each_category():
+    src = '''
+import os, time
+class S:
+    def work(self):
+        with self._lock:
+            log.warning("under lock")
+            time.sleep(0.1)
+            os.fsync(fd)
+            sock.sendall(b"x")
+            self._prep_queue.get()
+            execute_chunk(plan)
+'''
+    found = analysis.analyze_source(src, "lock-discipline")
+    text = _msgs(found)
+    assert len(found) == 6, text
+    for needle in ("logging call", "time.sleep", "os.fsync",
+                   "socket .sendall()", "blocking queue .get()",
+                   "device launch execute_chunk()"):
+        assert needle in text
+
+
+def test_lock_discipline_negative_space():
+    src = '''
+import time
+class S:
+    def ok(self):
+        log.warning("outside any lock")
+        with self._lock:
+            self.count += 1          # plain mutation: fine
+            cb = lambda: log.error("runs later, lock released")
+            def later():
+                time.sleep(1)        # closure body runs outside
+        with self._cv:
+            self._cv.wait(0.1)       # cv.wait RELEASES the lock
+        time.sleep(0.1)
+'''
+    assert analysis.analyze_source(src, "lock-discipline") == []
+
+
+def test_jit_discipline_flags_plain_jit_pad_and_next_pow2():
+    src = '''
+import jax, jnp
+_k = jax.jit(kernel)
+def _next_pow2(n):
+    return 1 << (n - 1).bit_length()
+x = jnp.pad(a, p)
+m = _next_pow2(8)
+'''
+    found = analysis.analyze_source(
+        src, "jit-discipline", relpath="crypto/tpu/newkernel.py"
+    )
+    text = _msgs(found)
+    assert len(found) == 4, text
+    assert "plain jax.jit" in text
+    assert "reintroduced" in text
+    assert "jnp.pad site" in text
+    # same source inside compile_cache.py (the owner) is exempt from
+    # the jit/_next_pow2 bans; outside crypto/tpu/ nothing applies
+    owner = analysis.analyze_source(
+        src, "jit-discipline", relpath="crypto/tpu/compile_cache.py"
+    )
+    assert all("jax.jit" not in f.message and "pow2" not in f.message
+               for f in owner)
+    rule = analysis.all_rules()["jit-discipline"]
+    assert not rule.applies_to("beacon/chain.py")
+
+
+def test_thread_discipline_flags_undaemoned_and_unsupervised():
+    src = '''
+import threading
+t = threading.Thread(target=run)
+u = threading.Thread(target=run, daemon=flag)
+'''
+    found = analysis.analyze_source(src, "thread-discipline")
+    text = _msgs(found)
+    assert "without daemon=" in text
+    assert "not the literal True" in text
+    assert "no watchdog linkage" in text
+    clean = analysis.analyze_source('''
+import threading
+# heartbeat registered with the watchdog below
+t = threading.Thread(target=run, daemon=True)
+''', "thread-discipline")
+    assert clean == []
+
+
+def test_seeded_rng_flags_module_random_in_scoped_files():
+    src = '''
+import random, time
+p = random.random()
+cb = random.choice
+random.seed(4)
+r = random.Random(time.time())
+ok = random.Random("seed:name")
+'''
+    found = analysis.analyze_source(
+        src, "seeded-rng", relpath="utils/failpoints.py"
+    )
+    text = _msgs(found)
+    assert sum("module-level random." in m.message for m in found) == 2
+    assert "reseeds the GLOBAL stream" in text
+    assert "wall-time seed" in text
+    # outside the failpoint/audit scope the rule does not apply
+    rule = analysis.all_rules()["seeded-rng"]
+    assert not rule.applies_to("beacon/chain.py")
+
+
+def test_metric_registration_flags_bad_sites():
+    src = '''
+from .utils import metrics
+a = metrics.counter("bad name!", "help")
+b = metrics.counter("events_total", "")
+c = metrics.gauge("depth", "ok", labels=("__reserved",))
+d = metrics.counter("things", "counted")
+e = metrics.histogram(NAME, "dynamic")
+'''
+    found = analysis.analyze_source(src, "metric-registration")
+    text = _msgs(found)
+    assert "fails the prometheus naming regex" in text
+    assert "missing/empty help" in text
+    assert "reserved (double underscore)" in text
+    assert "does not end in _total" in text
+    assert "not a string literal" in text
+    clean = analysis.analyze_source(
+        'm = metrics.counter("good_total", "has help",'
+        ' labels=("class",))\n',
+        "metric-registration",
+    )
+    assert clean == []
+
+
+def test_print_hygiene_flags_print_outside_cli():
+    found = analysis.analyze_source(
+        'print("hello")\n', "print-hygiene", relpath="beacon/chain.py"
+    )
+    assert len(found) == 1
+    assert not analysis.all_rules()["print-hygiene"].applies_to("cli.py")
+
+
+# ------------------------------------------------------ waiver ledger
+
+
+def _mini_tree(tmp_path, source):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "daemon.py").write_text(source)
+    return pkg
+
+
+def test_waiver_suppresses_with_justification_and_rots_loudly(tmp_path):
+    pkg = _mini_tree(tmp_path, 'print("x")\n')
+    wpath = tmp_path / "waivers.json"
+
+    # unwaived -> finding
+    r = analysis.run_analysis(root=pkg, waivers_path=wpath)
+    assert len(r["findings"]) == 1 and not r["clean"]
+
+    # justified waiver -> clean, finding reported as waived
+    wpath.write_text(json.dumps([{
+        "rule": "print-hygiene", "path": "daemon.py",
+        "match": 'print("x")',
+        "justification": "synthetic test module",
+    }]))
+    r = analysis.run_analysis(root=pkg, waivers_path=wpath)
+    assert r["clean"] and len(r["waived"]) == 1
+    assert r["waived"][0].justification == "synthetic test module"
+
+    # a waiver with NO justification is a ledger error, not a pass
+    wpath.write_text(json.dumps([{
+        "rule": "print-hygiene", "path": "daemon.py",
+        "match": 'print("x")', "justification": "  ",
+    }]))
+    r = analysis.run_analysis(root=pkg, waivers_path=wpath)
+    assert not r["clean"]
+    assert any("justification" in f.message for f in r["waiver_errors"])
+
+    # fixing the code makes the waiver STALE — also not clean
+    (pkg / "daemon.py").write_text("x = 1\n")
+    wpath.write_text(json.dumps([{
+        "rule": "print-hygiene", "path": "daemon.py",
+        "match": 'print("x")', "justification": "now stale",
+    }]))
+    r = analysis.run_analysis(root=pkg, waivers_path=wpath)
+    assert not r["clean"]
+    assert any("stale waiver" in f.message for f in r["waiver_errors"])
+
+
+# ------------------------------------------------------------ lint CLI
+
+
+def _run_lint(*args):
+    return subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint.py"), *args],
+        capture_output=True, text=True, cwd=REPO,
+    )
+
+
+def test_lint_cli_exits_zero_on_repo():
+    """tools/lint.py over the live repo: exit 0 (tier-1 wiring)."""
+    proc = _run_lint()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout
+
+
+def test_lint_cli_exits_nonzero_on_synthetic_violations(tmp_path):
+    """One synthetic violation of each of the five ISSUE rules, laid
+    out in a throwaway tree — the CLI must exit nonzero on every rule
+    and say why in --json."""
+    pkg = tmp_path / "pkg"
+    (pkg / "crypto" / "tpu").mkdir(parents=True)
+    (pkg / "utils").mkdir()
+    (pkg / "svc.py").write_text(
+        "class S:\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            log.warning('io under lock')\n"
+    )
+    (pkg / "crypto" / "tpu" / "k.py").write_text(
+        "import jax\n_j = jax.jit(f)\n"
+    )
+    (pkg / "spawn.py").write_text(
+        "import threading\nt = threading.Thread(target=f)\n"
+    )
+    (pkg / "utils" / "failpoints.py").write_text(
+        "import random\np = random.random()\n"
+    )
+    (pkg / "m.py").write_text(
+        "from .utils import metrics\n"
+        "c = metrics.counter('hits', '')\n"
+    )
+    wpath = tmp_path / "waivers.json"
+    wpath.write_text("[]")
+    proc = _run_lint("--root", str(pkg), "--waivers", str(wpath),
+                     "--json")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    rules_hit = {f["rule"] for f in payload["findings"]}
+    assert {"lock-discipline", "jit-discipline", "thread-discipline",
+            "seeded-rng", "metric-registration"} <= rules_hit
+    # and each rule alone still fails the tree
+    for rule in sorted(rules_hit):
+        solo = _run_lint("--root", str(pkg), "--waivers", str(wpath),
+                         "--rule", rule)
+        assert solo.returncode == 1, (rule, solo.stdout)
+
+
+# ----------------------------------------------------- runtime witness
+
+
+def test_witness_off_is_identity_to_threading_primitives(monkeypatch):
+    """Acceptance: the disabled path adds NO wrapper — the factories
+    hand back the exact stdlib primitives."""
+    monkeypatch.delenv("LTPU_LOCK_WITNESS", raising=False)
+    assert not locks.enabled()
+    assert type(locks.lock("x")) is type(threading.Lock())
+    assert type(locks.rlock("x")) is type(threading.RLock())
+
+
+def test_witness_detects_seeded_ab_ba_cycle():
+    """Thread 1 takes A then B; thread 2 later takes B then A.  No
+    actual deadlock ever happens (the threads run sequentially) — the
+    witness flags the ORDER inversion, which is the point: the bug is
+    caught the first time both orders ever run."""
+    w = locks.Witness(stall_s=60.0)
+    a = locks.WitnessLock("lock.a", w)
+    b = locks.WitnessLock("lock.b", w)
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=ab, daemon=True)
+    t1.start(); t1.join()
+    assert w.report()["cycles"] == []     # one order alone is fine
+
+    t2 = threading.Thread(target=ba, daemon=True)
+    t2.start(); t2.join()
+    rep = w.report()
+    assert len(rep["cycles"]) == 1, rep["cycles"]
+    cyc = rep["cycles"][0]
+    assert cyc["edge"] == ["lock.b", "lock.a"]
+    assert cyc["reverse_path"][0] == "lock.a"
+    assert cyc["reverse_path"][-1] == "lock.b"
+    assert ["lock.a", "lock.b"] in rep["edges"]
+
+
+def test_witness_detects_held_too_long_stall():
+    """Deterministic stall via an injected clock: a hold that 'lasts'
+    2.0s against a 0.5s budget is recorded with its duration."""
+    now = [0.0]
+
+    def clock():
+        return now[0]
+
+    w = locks.Witness(stall_s=0.5, clock=clock)
+    lk = locks.WitnessLock("slow.section", w)
+    with lk:
+        now[0] += 2.0
+    rep = w.report()
+    assert len(rep["stalls"]) == 1
+    stall = rep["stalls"][0]
+    assert stall["name"] == "slow.section"
+    assert stall["held_seconds"] == pytest.approx(2.0)
+    # a fast hold records nothing
+    with lk:
+        now[0] += 0.01
+    assert len(w.report()["stalls"]) == 1
+
+
+def test_witness_rlock_reentrancy_is_not_a_cycle():
+    w = locks.Witness(stall_s=60.0)
+    r = locks.WitnessRLock("agg.entries", w)
+    with r:
+        with r:               # re-entrant same-site hold
+            pass
+    rep = w.report()
+    assert rep["cycles"] == []
+    assert rep["edges"] == []
+    assert rep["locks"]["agg.entries"] == 2
+
+
+def test_witnessed_lock_drives_a_condition_variable():
+    """The wrapper satisfies threading.Condition's lock protocol —
+    wait() releases (the witness stack empties) and re-acquires."""
+    w = locks.Witness(stall_s=60.0)
+    cv = threading.Condition(locks.WitnessLock("svc.cv", w))
+    fired = []
+
+    def waiter():
+        with cv:
+            while not fired:
+                cv.wait(timeout=5.0)
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    with cv:
+        fired.append(1)
+        cv.notify()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert w.report()["locks"]["svc.cv"] >= 2
+
+
+def test_locks_route_serves_witness_report(monkeypatch):
+    """GET /lighthouse/locks — disabled shell by default; with the
+    witness armed the route serves the live graph."""
+    from lighthouse_tpu.api.http_api import BeaconApiServer
+    from lighthouse_tpu.beacon.chain import BeaconChain
+    from lighthouse_tpu.testing.harness import Harness
+    from lighthouse_tpu.types import ChainSpec, MinimalPreset
+
+    h = Harness(8, ChainSpec(preset=MinimalPreset))
+    chain = BeaconChain(h.state.copy(), ChainSpec(preset=MinimalPreset))
+    server = BeaconApiServer(chain).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        monkeypatch.delenv("LTPU_LOCK_WITNESS", raising=False)
+        with urllib.request.urlopen(base + "/lighthouse/locks") as r:
+            data = json.load(r)["data"]
+        assert data["enabled"] is False
+        assert data["cycles"] == []
+
+        monkeypatch.setenv("LTPU_LOCK_WITNESS", "1")
+        locks.reset_witness()
+        with locks.lock("route.test"):
+            pass
+        with urllib.request.urlopen(base + "/lighthouse/locks") as r:
+            data = json.load(r)["data"]
+        assert data["enabled"] is True
+        assert data["locks"].get("route.test") == 1
+        assert data["stall_budget_ms"] > 0
+    finally:
+        server.stop()
+        locks.reset_witness()

@@ -122,10 +122,13 @@ def test_gauge_inc_dec_threadsafe():
 
 
 def test_metric_name_lint():
-    """Every registered metric matches the Prometheus naming regex and
-    carries non-empty help text — new metrics can't silently break
-    scrapes.  Importing the metrics-bearing modules first makes the lint
-    cover the real registry, not just this file's test metrics."""
+    """Thin wrapper since PR 11: the naming/help/label lint itself
+    lives in lighthouse_tpu/analysis (the metric-registration rule
+    checks every `metrics.counter/gauge/histogram` SOURCE site, so a
+    bad family fails even in modules no test imports).  Kept here: the
+    rule invocation plus the runtime registry checks the rule can't
+    see — registered kinds and the per-subsystem family presence
+    assertions below."""
     import lighthouse_tpu.aggregation.tier  # noqa: F401 (aggregation tier)
     import lighthouse_tpu.beacon.beacon_processor  # noqa: F401
     import lighthouse_tpu.beacon.block_times_cache  # noqa: F401
@@ -133,21 +136,20 @@ def test_metric_name_lint():
     import lighthouse_tpu.crypto.tpu.bls  # noqa: F401 (pubkey-cache counters)
     import lighthouse_tpu.crypto.tpu.compile_cache  # noqa: F401 (AOT cache)
     import lighthouse_tpu.utils.failpoints  # noqa: F401 (hit counters)
+    import lighthouse_tpu.utils.locks  # noqa: F401 (lock-witness families)
     import lighthouse_tpu.utils.retries  # noqa: F401 (retry outcomes)
     import lighthouse_tpu.utils.watchdog  # noqa: F401 (restart counters)
     import lighthouse_tpu.verify_service.metrics  # noqa: F401
 
-    name_re = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
-    label_re = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*")
+    from lighthouse_tpu import analysis
+
+    report = analysis.run_analysis(rules=["metric-registration"])
+    assert report["clean"], analysis.format_report(report)
+
     registered = metrics.all_metrics()
     assert len(registered) > 10
     for name, kind, help_text, labels in registered:
-        assert name_re.fullmatch(name), f"bad metric name {name!r}"
         assert kind in ("counter", "gauge", "histogram"), (name, kind)
-        assert help_text and help_text.strip(), f"{name} has empty help"
-        for label in labels:
-            assert label_re.fullmatch(label), f"{name}: bad label {label!r}"
-            assert not label.startswith("__"), f"{name}: reserved {label!r}"
     # the fast-path families must be registered (and therefore linted):
     # pubkey-cache hit/miss counters, the adaptive-batch gauge, and the
     # pipeline-overlap gauge all ship with this subsystem
@@ -210,6 +212,15 @@ def test_metric_name_lint():
         "verify_shard_occupancy",
         "verify_sharded_launches_total",
         "verify_single_launches_total",
+    } <= names, sorted(names)
+    # the lock-witness families (ISSUE 11) must be registered and
+    # linted: per-site acquisition counts, detected order cycles,
+    # held-too-long stalls, and the hold-time histogram
+    assert {
+        "lighthouse_lock_witness_acquisitions_total",
+        "lighthouse_lock_witness_cycles_total",
+        "lighthouse_lock_witness_stalls_total",
+        "lighthouse_lock_witness_held_seconds",
     } <= names, sorted(names)
 
 

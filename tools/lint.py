@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Run the repo's invariant lint suite (lighthouse_tpu/analysis).
+
+Exit status:
+  0  — zero unwaived findings and a healthy waiver ledger
+  1  — unwaived findings, stale waivers, or waivers missing their
+       mandatory justification
+
+Usage:
+  python tools/lint.py                # human output, all rules
+  python tools/lint.py --json        # machine-readable findings
+  python tools/lint.py --rule lock-discipline --rule jit-discipline
+  python tools/lint.py --list-rules
+  python tools/lint.py --root path/to/pkg --waivers path/to/waivers.json
+
+Wired into tier-1 (tests/test_analysis.py runs this over the repo) and
+the bench.py preflight (a discipline regression fails the bench before
+it burns an hour of kernel time).
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from lighthouse_tpu import analysis  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", action="store_true",
+                    help="emit machine-readable findings JSON")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="list registered rules and exit")
+    ap.add_argument("--root", default=None,
+                    help="package root to analyze (default: the "
+                         "installed lighthouse_tpu)")
+    ap.add_argument("--waivers", default=None,
+                    help="waiver ledger path (default: the package's "
+                         "analysis/waivers.json)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name, rule in sorted(analysis.all_rules().items()):
+            print(f"{name:24s} {rule.description}")
+        return 0
+
+    report = analysis.run_analysis(
+        root=args.root, rules=args.rule, waivers_path=args.waivers
+    )
+    if args.json:
+        print(json.dumps({
+            "clean": report["clean"],
+            "findings": [f.to_dict() for f in report["findings"]],
+            "waived": [f.to_dict() for f in report["waived"]],
+            "waiver_errors": [f.to_dict()
+                              for f in report["waiver_errors"]],
+        }, indent=2))
+    else:
+        print(analysis.format_report(report))
+    return 0 if report["clean"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
