@@ -214,6 +214,18 @@ def _emit_primary(value, final=False, backend="tpu-kernel", platform=None):
                       "cache_hit_rate", "shapes")
             if k in _CC_SUMMARY
         }
+    try:
+        # the per-kernel profile registry's roll-up (top wall-time
+        # sinks, per-kernel totals, launch counters) rides along so a
+        # primary regression can be attributed to a kernel without
+        # rerunning the bench under a profiler
+        from lighthouse_tpu.crypto.tpu import profile as _kp
+
+        kp = _kp.get_registry().summary()
+        if kp.get("kernels"):
+            rec["kernel_profile"] = kp
+    except Exception:
+        pass
     line = json.dumps(rec)
     print(line, flush=True)
     try:

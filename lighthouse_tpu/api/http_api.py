@@ -838,6 +838,15 @@ class _Handler(JsonHandler):
                 data["device_ready"] = bool(verifier.device_ready)
             return self._json({"data": data})
 
+        if path == "/lighthouse/profile":
+            # per-kernel performance profile: wall-time EWMA/histogram
+            # per (kernel, canonical shape, mesh topology), joined with
+            # the XLA cost_analysis numbers, pad-waste ratios, and the
+            # sharded-vs-single launch counters
+            from ..crypto.tpu import profile
+
+            return self._json({"data": profile.get_registry().snapshot()})
+
         if path == "/lighthouse/mesh":
             # verification mesh plan: dp×mp layout, per-device
             # platform/kind inventory, sharded-vs-single launch

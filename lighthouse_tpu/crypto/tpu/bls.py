@@ -460,6 +460,19 @@ def prepare_chunk(sets, dst=DST_POP, rng=None, min_sets=1, min_pks=1):
     return c
 
 
+def _note_pad(kernel, args, n_sets, n_pad):
+    """Feed the launch's pad occupancy to the kernel profile registry
+    under the SAME (kernel, shape) key the CachedKernel timing uses —
+    the label derives from the launched args, so the join is exact."""
+    try:
+        from . import profile
+
+        label = cc.CompileCache._label_from_sig(cc._shape_sig(args)[0])
+        profile.get_registry().record_pad(kernel, label, n_sets, n_pad)
+    except Exception:
+        pass
+
+
 def execute_chunk(prepared, overlap_ratio=None):
     """DEVICE stage: launch the batched kernel on a prepared chunk and
     block for the verdict.  A structurally invalid chunk is False without
@@ -481,6 +494,7 @@ def execute_chunk(prepared, overlap_ratio=None):
     args, shards = plan.place_verify_args(prepared.args)
     out = bool(_jit_batched(*args))
     plan.note_occupancy(prepared.n_sets, prepared.n_pad, shards)
+    _note_pad("bls_batched_verify", args, prepared.n_sets, prepared.n_pad)
     if tr is not None:
         _trace_chunk(
             tr, (prepared.t_prep1 - prepared.t_prep0) * 1e3, t_dev0,
@@ -566,6 +580,7 @@ def _per_set_chunk(sets, dst, min_sets=1, min_pks=1):
     _, out = _jit_per_set(*args)
     verdicts = [bool(v) for v in np.asarray(out)[: len(sets)]]
     plan.note_occupancy(len(sets), n_pad, shards)
+    _note_pad("bls_per_set_verify", args, len(sets), n_pad)
     if tr is not None:
         _trace_chunk(tr, (t1 - t0) * 1e3, t1, len(sets), n_pad,
                      per_set=True, shards=shards)

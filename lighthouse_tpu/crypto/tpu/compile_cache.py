@@ -454,6 +454,17 @@ class CompileCache:
                     "kernel": name, "shape": label, "source": how,
                     "ms": round(ms, 1),
                 }
+            # join the static XLA cost model onto the profile key once,
+            # at the moment the executable enters the process — launches
+            # then only pay the wall-clock sample
+            try:
+                from . import profile
+
+                profile.get_registry().record_cost(
+                    name, label, profile.extract_cost(exe)
+                )
+            except Exception:
+                pass
             return exe
         finally:
             with self._lock:
@@ -667,7 +678,7 @@ class CachedKernel:
     def __call__(self, *args):
         cache = get_cache()
         if not cache.enabled:
-            return self._jit(*args)
+            return self._timed(self._jit, args, "jit")
         try:
             exe = cache.load_or_compile(self.name, self.fn, args)
         except Exception as e:
@@ -675,12 +686,37 @@ class CachedKernel:
                 "compile-cache path failed for %s (%s); plain jit",
                 self.name, str(e)[:120],
             )
-            return self._jit(*args)
+            return self._timed(self._jit, args, "jit")
         # execute OUTSIDE the fallback: only CACHE machinery failures
         # degrade to plain jit — a device fault during execution must
         # propagate to the circuit-breaker seam immediately, not
         # trigger a blocking inline recompile on the dispatch path
-        return exe(*args)
+        return self._timed(exe, args, "aot")
+
+    def _timed(self, runner, args, source):
+        """Execute and feed the profile registry: wall time around the
+        call INCLUDING block_until_ready, so the registry records device
+        wall rather than async-dispatch wall.  Profiling failures never
+        fail a launch — the result is already in hand."""
+        t0 = time.monotonic()
+        out = runner(*args)
+        try:
+            jax.block_until_ready(out)
+        except Exception:
+            pass                     # non-array outputs: dispatch wall
+        wall = time.monotonic() - t0
+        try:
+            from . import profile
+
+            sig, _ = _shape_sig(args)
+            profile.get_registry().record_launch(
+                self.name, CompileCache._label_from_sig(sig), wall,
+                source=source,
+            )
+        except Exception as e:
+            log.debug("kernel profile record failed for %s: %s",
+                      self.name, str(e)[:120])
+        return out
 
 
 # ---------------------------------------------------------------- prewarm

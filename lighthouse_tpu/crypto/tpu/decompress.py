@@ -187,6 +187,16 @@ def g2_decompress_batch(blobs, subgroup_check=True):
     (c0, c1), _ = plan.place_batched((c0, c1), axis=1)
     yb, _ = plan.place_batched(jnp.asarray(y_big), axis=0)
     (x, y, z), on_curve = _jit_decompress(c0, c1, yb)
+    # profile-registry pad join: n real blobs rode n_pad planned lanes
+    try:
+        from . import profile
+
+        label = cc.CompileCache._label_from_sig(
+            cc._shape_sig((c0, c1, yb))[0]
+        )
+        profile.get_registry().record_pad("g2_decompress", label, n, n_pad)
+    except Exception:
+        pass
     ok = valid & (np.asarray(on_curve) | is_inf)
     # infinity lanes: zero Z (the kernel's Z is 1 everywhere)
     if is_inf.any():

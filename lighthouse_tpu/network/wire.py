@@ -222,21 +222,39 @@ PK_DECODE_CACHE = PubkeyDecodeCache()
 _VERIFY_CLASSES = ("block", "aggregate", "attestation", "discovery")
 _VERIFY_CLASS_INDEX = {name: i for i, name in enumerate(_VERIFY_CLASSES)}
 
+# distributed-tracing extension limits: the trace-context block on
+# VERIFY_REQ and the server span-timing block on VERIFY_RESP are both
+# bounded so a hostile frame can't buy allocation with them
+_TRACE_FLAG = 0x80                # priority-byte bit 7 = has trace ctx
+MAX_TRACE_ID_BYTES = 64
+MAX_TRACE_SPANS = 32
+MAX_TRACE_SPAN_NAME = 48
 
-def encode_verify_request(sets, priority="attestation", deadline_ms=250):
+
+def encode_verify_request(sets, priority="attestation", deadline_ms=250,
+                          trace_ctx=None):
     """Serialize a SignatureSet batch for the VERIFY_REQ frame.
 
     Layout: u8 priority || u32 deadline_ms || u16 n_sets, then per set:
     u8 flags (bit0 = has signature) || [96B compressed G2 signature] ||
     32B message || u16 n_pubkeys || n × 48B compressed G1 pubkeys.
     Points travel compressed (the canonical 2G2T-style outsourcing
-    interface: constant-size elements, verifier-side decompression)."""
+    interface: constant-size elements, verifier-side decompression).
+
+    `trace_ctx` is an OPTIONAL (trace_id, origin_node) pair: when set,
+    bit 7 of the priority byte is raised and a trailing block
+    ``u8 id_len || id || u8 origin_len || origin`` (utf-8) follows the
+    sets — the serving node opens a child trace under it and ships its
+    span timings back on the response.  Without it the encoding is
+    byte-identical to the pre-tracing frame."""
     from ..crypto.ref import curves as _curves
 
     sets = list(sets)
     if not sets or len(sets) > MAX_VERIFY_SETS:
         raise WireError(f"batch of {len(sets)} sets outside [1, {MAX_VERIFY_SETS}]")
     cls = _VERIFY_CLASS_INDEX.get(priority, 2)
+    if trace_ctx is not None:
+        cls |= _TRACE_FLAG
     out = [struct.pack("<BIH", cls, max(0, int(deadline_ms)), len(sets))]
     for s in sets:
         msg = bytes(s.message)
@@ -253,6 +271,11 @@ def encode_verify_request(sets, priority="attestation", deadline_ms=250):
         out.append(struct.pack("<H", len(pks)))
         for pk in pks:
             out.append(_curves.g1_compress(pk))
+    if trace_ctx is not None:
+        tid, origin = trace_ctx
+        tid = str(tid).encode()[:MAX_TRACE_ID_BYTES]
+        origin = str(origin).encode()[:MAX_TRACE_ID_BYTES]
+        out.append(bytes([len(tid)]) + tid + bytes([len(origin)]) + origin)
     payload = b"".join(out)
     if len(payload) > MAX_VERIFY_BODY:
         raise WireError(f"encoded batch {len(payload)}B exceeds {MAX_VERIFY_BODY}B cap")
@@ -260,7 +283,8 @@ def encode_verify_request(sets, priority="attestation", deadline_ms=250):
 
 
 def decode_verify_request(payload):
-    """Parse a VERIFY_REQ payload -> (sets, priority, deadline_s).
+    """Parse a VERIFY_REQ payload -> (sets, priority, deadline_s,
+    trace_ctx) where trace_ctx is (trace_id, origin_node) or None.
 
     Every bound is enforced BEFORE the allocation it guards and every
     malformed encoding raises the typed WireError (surfaced to the peer
@@ -274,6 +298,8 @@ def decode_verify_request(payload):
     if len(payload) < 7:
         raise WireError("truncated verify request header")
     cls, deadline_ms, n_sets = struct.unpack("<BIH", payload[:7])
+    has_ctx = bool(cls & _TRACE_FLAG)
+    cls &= ~_TRACE_FLAG
     if cls >= len(_VERIFY_CLASSES):
         raise WireError(f"unknown priority class {cls}")
     if not 0 < n_sets <= MAX_VERIFY_SETS:
@@ -315,35 +341,110 @@ def decode_verify_request(payload):
             except ValueError as e:
                 raise WireError(f"bad pubkey encoding: {e}") from e
         sets.append(SignatureSet(sig, pks, msg))
+    trace_ctx = None
+    if has_ctx:
+        id_len = take(1, "trace id length")[0]
+        if id_len > MAX_TRACE_ID_BYTES:
+            raise WireError(f"trace id {id_len}B exceeds cap")
+        tid = take(id_len, "trace id")
+        origin_len = take(1, "trace origin length")[0]
+        if origin_len > MAX_TRACE_ID_BYTES:
+            raise WireError(f"trace origin {origin_len}B exceeds cap")
+        origin = take(origin_len, "trace origin")
+        try:
+            trace_ctx = (tid.decode(), origin.decode())
+        except UnicodeDecodeError as e:
+            raise WireError(f"bad trace context encoding: {e}") from e
     if pos != end:
         raise WireError(f"{end - pos} trailing bytes after verify request")
-    return sets, _VERIFY_CLASSES[cls], deadline_ms / 1e3
+    return sets, _VERIFY_CLASSES[cls], deadline_ms / 1e3, trace_ctx
 
 
-def encode_verify_response(verdicts, load_hint=0):
+def encode_verify_response(verdicts, load_hint=0, server_trace=None):
     """u16 n_sets || u32 load_hint (the verifier's queued-set depth, the
-    client's placement signal) || ceil(n/8) verdict bitmap bytes."""
+    client's placement signal) || ceil(n/8) verdict bitmap bytes.
+
+    `server_trace` is an OPTIONAL (server_trace_id, spans) pair — spans
+    are (name, start_us, dur_us) tuples relative to the server's serve
+    start — appended as ``u8 id_len || id || u8 n_spans || per span:
+    u8 name_len || name || u32 start_us || u32 dur_us``.  Only attached
+    when the request carried a trace context, so a context-less caller
+    always sees the legacy fixed-size layout."""
     n = len(verdicts)
     bitmap = bytearray((n + 7) // 8)
     for i, v in enumerate(verdicts):
         if v:
             bitmap[i // 8] |= 1 << (i % 8)
-    return struct.pack("<HI", n, max(0, int(load_hint))) + bytes(bitmap)
+    out = struct.pack("<HI", n, max(0, int(load_hint))) + bytes(bitmap)
+    if server_trace is not None:
+        tid, spans = server_trace
+        tid = str(tid).encode()[:MAX_TRACE_ID_BYTES]
+        tail = [bytes([len(tid)]) + tid]
+        spans = list(spans)[:MAX_TRACE_SPANS]
+        tail.append(bytes([len(spans)]))
+        u32max = (1 << 32) - 1
+        for name, start_us, dur_us in spans:
+            nm = str(name).encode()[:MAX_TRACE_SPAN_NAME]
+            tail.append(bytes([len(nm)]) + nm + struct.pack(
+                "<II",
+                min(max(0, int(start_us)), u32max),
+                min(max(0, int(dur_us)), u32max),
+            ))
+        out += b"".join(tail)
+    return out
 
 
 def decode_verify_response(payload):
-    """Parse a VERIFY_RESP payload -> (verdicts, load_hint)."""
+    """Parse a VERIFY_RESP payload -> (verdicts, load_hint,
+    server_trace) where server_trace is None or a
+    {"trace_id", "spans": [(name, start_us, dur_us), ...]} dict."""
     if len(payload) < 6:
         raise WireError("truncated verify response header")
     n, load = struct.unpack("<HI", payload[:6])
     if n > MAX_VERIFY_SETS:
         raise WireError(f"{n} verdicts exceeds {MAX_VERIFY_SETS}")
-    bitmap = payload[6:]
-    if len(bitmap) != (n + 7) // 8:
+    bm_len = (n + 7) // 8
+    bitmap = payload[6:6 + bm_len]
+    if len(bitmap) != bm_len:
         raise WireError(
             f"verdict bitmap {len(bitmap)}B for {n} sets"
         )
-    return [bool(bitmap[i // 8] >> (i % 8) & 1) for i in range(n)], load
+    verdicts = [bool(bitmap[i // 8] >> (i % 8) & 1) for i in range(n)]
+    rest = payload[6 + bm_len:]
+    if not rest:
+        return verdicts, load, None
+    pos, end = 0, len(rest)
+
+    def take(k, what):
+        nonlocal pos
+        if pos + k > end:
+            raise WireError(f"truncated verify response ({what})")
+        chunk = rest[pos:pos + k]
+        pos += k
+        return chunk
+
+    id_len = take(1, "server trace id length")[0]
+    if id_len > MAX_TRACE_ID_BYTES:
+        raise WireError(f"server trace id {id_len}B exceeds cap")
+    tid = take(id_len, "server trace id")
+    n_spans = take(1, "span count")[0]
+    if n_spans > MAX_TRACE_SPANS:
+        raise WireError(f"{n_spans} server spans exceeds {MAX_TRACE_SPANS}")
+    spans = []
+    for _ in range(n_spans):
+        nm_len = take(1, "span name length")[0]
+        if nm_len > MAX_TRACE_SPAN_NAME:
+            raise WireError(f"span name {nm_len}B exceeds cap")
+        nm = take(nm_len, "span name")
+        start_us, dur_us = struct.unpack("<II", take(8, "span timing"))
+        try:
+            spans.append((nm.decode(), start_us, dur_us))
+        except UnicodeDecodeError as e:
+            raise WireError(f"bad span name encoding: {e}") from e
+    if pos != end:
+        raise WireError(f"{end - pos} trailing bytes after verify response")
+    return verdicts, load, {"trace_id": tid.decode(errors="replace"),
+                            "spans": spans}
 
 
 class GossipCodec:
@@ -525,6 +626,13 @@ class WireNode:
         self.peer_id = peer_id or hashlib.sha256(
             struct.pack("dQ", time.time(), id(self))
         ).hexdigest()[:16]
+        # node-unique trace ids: pin the tracing prefix to this node's
+        # wire identity so cross-node span stitching is unambiguous
+        # (last WireNode wins in multi-node test processes — ids stay
+        # unique either way via the shared counter)
+        from ..utils import tracing as _tracing
+
+        _tracing.set_node_id(self.peer_id)
         self.attnets = attnets
         self.metadata_seq = 1
         self.handlers = {}             # topic -> handler(from_peer, obj)
@@ -648,9 +756,11 @@ class WireNode:
             target=self._reader_loop, args=(peer,), daemon=True
         )
         t.start()
-        deadline = time.time() + timeout
+        # monotonic deadline: an NTP step mid-handshake must neither
+        # expire this wait instantly nor immortalize it
+        deadline = time.monotonic() + timeout
         while peer.peer_id is None and peer._alive:
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 peer.close()
                 raise WireError("handshake timeout")
             time.sleep(0.005)
@@ -901,7 +1011,10 @@ class WireNode:
         with self._seen_lock:
             if mid in self._seen:
                 return False
-            self._seen[mid] = time.time()
+            # monotonic: the stamp only ever feeds window DELTAS (the
+            # mesh-delivery check), and a wall-clock step would widen
+            # or collapse the window for every in-cache id
+            self._seen[mid] = time.monotonic()
             while len(self._seen) > SEEN_CACHE_SIZE:
                 self._seen.popitem(last=False)
             return True
@@ -1217,7 +1330,7 @@ class WireNode:
             # over garbage (code-review r4 finding).  The decompress cost
             # is bounded by the gossip_publish rate limiter above.
             if in_mesh and (
-                time.time() - first_seen <= self.MESH_DELIVERY_WINDOW_S
+                time.monotonic() - first_seen <= self.MESH_DELIVERY_WINDOW_S
             ):
                 try:
                     payload = snappy.decompress(compressed)
@@ -1566,10 +1679,19 @@ class WireNode:
         """Verifier-role server: charge the quota off the fixed-size
         header, decode, submit into the local VerificationService under
         its normal priority/shed/admission semantics, and answer per-set
-        verdicts + a load hint."""
+        verdicts + a load hint.
+
+        When the request carries a trace context the serve runs under a
+        CHILD trace of the caller's: the service dispatcher attaches its
+        queue_wait/batch/kernel spans to it, and the response ships the
+        span timings (relative to serve start) back so the client
+        stitches one end-to-end distributed trace."""
+        from ..utils import tracing
         from ..verify_service.service import QueueFullError
 
         verdicts, load = [], 0
+        t_serve0 = time.monotonic()
+        serve_trace = None
         try:
             # chaos seam: `error` is a crashing verifier handler
             # (surfaces as R_SERVER_ERROR), `delay` a slow verifier —
@@ -1589,15 +1711,31 @@ class WireNode:
                     f"{n_sets} sets outside [1, {MAX_VERIFY_SETS}]"
                 )
             self.limiter.check(peer.peer_id, "verify_batch", n_sets)
-            sets, priority, deadline_s = decode_verify_request(payload)
+            sets, priority, deadline_s, trace_ctx = decode_verify_request(
+                payload
+            )
             service = self._verify_backend()
             if service is None:
                 code = R_RESOURCE_UNAVAILABLE   # not serving this role
             else:
-                fut = service.submit(
-                    sets, priority=priority, deadline=deadline_s,
-                    want_per_set=True,
-                )
+                if trace_ctx is not None:
+                    # child trace under the propagated context: the
+                    # dispatcher appends its stage spans to it (submit
+                    # captures the current trace), and they ship back
+                    # on the response
+                    serve_trace = tracing.start_trace(
+                        "verify_serve", parent_trace_id=trace_ctx[0],
+                        origin=trace_ctx[1], peer=peer.peer_id,
+                        priority=priority, sets=len(sets),
+                    )
+                    serve_trace.add_span(
+                        "serve_decode", t_serve0, time.monotonic()
+                    )
+                with tracing.use(serve_trace):
+                    fut = service.submit(
+                        sets, priority=priority, deadline=deadline_s,
+                        want_per_set=True,
+                    )
                 verdicts = fut.result(timeout=deadline_s + 30.0)
                 if getattr(verdicts, "shed", False):
                     # shed means DROPPED: all-False placeholders must
@@ -1619,13 +1757,31 @@ class WireNode:
         except Exception:
             verdicts, code = [], R_SERVER_ERROR
         try:
-            resp = encode_verify_response(verdicts, load)
+            server_trace = None
+            if serve_trace is not None:
+                serve_trace.finish(code=code)
+                server_trace = (
+                    serve_trace.trace_id,
+                    [
+                        (name, (s - t_serve0) * 1e6, (e - s) * 1e6)
+                        for name, s, e, _ in serve_trace.snapshot_spans()
+                    ],
+                )
+                try:
+                    from ..verify_service import metrics as _vsm
+
+                    _vsm.TRACE_SERVED.inc()
+                except Exception:  # noqa: BLE001 — metrics never gate serving
+                    pass
+            resp = encode_verify_response(verdicts, load, server_trace)
             # chaos seam: a byzantine verifier — `corrupt` flips verdict
-            # bits in the bitmap (the tail of the payload), which the
-            # client's random-recombination audit must catch
+            # bits in the bitmap ONLY (between the fixed header and the
+            # span-timing tail), which the client's random-recombination
+            # audit must catch
+            bm_end = 6 + (len(verdicts) + 7) // 8
             resp = resp[:6] + failpoints.hit(
-                "remote.verdict_corrupt", data=resp[6:]
-            )
+                "remote.verdict_corrupt", data=resp[6:bm_end]
+            ) + resp[bm_end:]
             peer.send_frame(
                 VERIFY_RESP, struct.pack("<IB", rid, code) + resp
             )
@@ -1654,7 +1810,9 @@ class WireNode:
 
     def request_verify_batch(self, peer_id, payload, timeout=5.0):
         """Send one encoded batch-verify request (encode_verify_request
-        output); returns (verdicts, load_hint).  Raises PeerRateLimited
+        output); returns (verdicts, load_hint, server_trace) — the last
+        None unless the request carried a trace context and the server
+        shipped its span timings back.  Raises PeerRateLimited
         when the verifier shed or refused the batch, WireError on every
         other failure — the remote client's tiering treats both as
         'this target cannot serve the batch now'."""

@@ -210,9 +210,9 @@ class Simulator:
         # frames may still be in TCP buffers / reader threads when the
         # processor queues momentarily empty
         idle = 0
-        deadline = time.time() + 10.0
+        deadline = time.monotonic() + 10.0
         while idle < 8:
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 # a silent give-up would surface later as a bogus
                 # consensus divergence — fail HERE, diagnosably
                 raise RuntimeError(

@@ -21,9 +21,19 @@ Span timestamps are time.monotonic() seconds; each trace additionally
 records one wall-clock timestamp at creation for display.  Spans may
 start before the trace was created (a queued request's submit time) —
 their relative start_ms is simply negative.
+
+Trace ids are NODE-UNIQUE strings ``<node>-<seq>``: the counter alone
+is process-local and collides the moment two nodes' traces meet (the
+remote verification fabric stitches server spans into client traces,
+and an ambiguous id would join the wrong pair).  The node component
+defaults to a random token and can be pinned to an operator-meaningful
+name with `set_node_id` (the wire node does this with its peer id).
+`/lighthouse/logs` joins are by-equality on the full string, so they
+keep working unchanged.
 """
 
 import itertools
+import os
 import threading
 import time
 from collections import deque
@@ -36,6 +46,33 @@ _BUF_LOCK = threading.Lock()
 _NEXT_ID = itertools.count(1)
 _TLS = threading.local()
 
+_NODE_LOCK = threading.Lock()
+_NODE_ID = None
+
+
+def node_id():
+    """This process's trace-id prefix (lazily drawn random token until
+    `set_node_id` pins something meaningful)."""
+    global _NODE_ID
+    with _NODE_LOCK:
+        if _NODE_ID is None:
+            _NODE_ID = os.urandom(4).hex()
+        return _NODE_ID
+
+
+def set_node_id(nid):
+    """Pin the node component of new trace ids (idempotent overwrite;
+    already-issued ids keep their old prefix).  Sanitized to keep ids
+    join- and URL-friendly."""
+    global _NODE_ID
+    nid = "".join(
+        c for c in str(nid) if c.isalnum() or c in "._"
+    )[:32] or None
+    with _NODE_LOCK:
+        if nid is not None:
+            _NODE_ID = nid
+    return _NODE_ID
+
 
 class Trace:
     __slots__ = (
@@ -44,7 +81,7 @@ class Trace:
     )
 
     def __init__(self, kind, **attrs):
-        self.trace_id = next(_NEXT_ID)
+        self.trace_id = f"{node_id()}-{next(_NEXT_ID)}"
         self.kind = kind
         self.attrs = dict(attrs)
         self.spans = []          # (name, start, end, attrs)
@@ -82,6 +119,12 @@ class Trace:
     def span_names(self):
         with self._lock:
             return [s[0] for s in self.spans]
+
+    def snapshot_spans(self):
+        """Consistent (name, start, end, attrs) snapshot — the wire
+        serve path reads this to ship span timings back to the caller."""
+        with self._lock:
+            return list(self.spans)
 
     def to_dict(self):
         with self._lock:

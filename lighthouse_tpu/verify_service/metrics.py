@@ -129,3 +129,28 @@ REMOTE_BREAKER = metrics.gauge(
     "2=half_open",
     labels=("target",),
 )
+
+# ---- distributed tracing across the wire fabric ----
+TRACE_CTX_SENT = metrics.counter(
+    "verify_trace_ctx_propagated_total",
+    "Remote batch-verify calls that carried a trace context on the "
+    "VERIFY_REQ frame (the server opens a child trace under it)",
+    labels=("target",),
+)
+TRACE_SERVED = metrics.counter(
+    "verify_trace_served_total",
+    "Inbound VERIFY_REQ batches served under a propagated trace "
+    "context (the response shipped the server's span timings back)",
+)
+TRACE_STITCHED = metrics.counter(
+    "verify_trace_stitched_total",
+    "Remote batches whose server span timings were stitched into the "
+    "submitter-side verify_batch trace (one end-to-end trace at "
+    "/lighthouse/tracing)",
+)
+TRACE_REMOTE_SPANS = metrics.counter(
+    "verify_trace_remote_spans_total",
+    "Propagated server spans stitched into client traces, per remote "
+    "target (hedged duplicates counted under their own target)",
+    labels=("target",),
+)

@@ -148,19 +148,35 @@ class RemoteTarget:
 
 class _Job:
     """One batch riding the hedged dispatch: first verdict wins,
-    duplicates are acknowledged but ignored (idempotent resolution)."""
+    duplicates are acknowledged but ignored (idempotent resolution).
 
-    __slots__ = ("sets", "priority", "result", "winner", "event", "lock",
-                 "duplicates")
+    `calls` accumulates one record per issued (hedged) call — target,
+    hedge index, client-side rpc window, and the server's propagated
+    span timings when the transport carried a trace context — so the
+    submitter can stitch EVERY tier's view, duplicates included, into
+    one end-to-end trace."""
 
-    def __init__(self, sets, priority):
+    __slots__ = ("sets", "priority", "trace_ctx", "result", "winner",
+                 "event", "lock", "duplicates", "calls")
+
+    def __init__(self, sets, priority, trace_ctx=None):
         self.sets = sets
         self.priority = priority
+        self.trace_ctx = trace_ctx
         self.result = None
         self.winner = None
         self.event = threading.Event()
         self.lock = locks.lock("remote.job")
         self.duplicates = 0
+        self.calls = []
+
+    def note_call(self, record):
+        with self.lock:
+            self.calls.append(record)
+
+    def call_records(self):
+        with self.lock:
+            return list(self.calls)
 
     def offer(self, verdicts, target):
         """Deliver one target's verdicts; False when a faster tier
@@ -186,12 +202,15 @@ class _Job:
 
 class InProcessTransport:
     """Test/bench transport: target name -> callable(sets, priority,
-    deadline_s) returning (verdicts, load_hint)."""
+    deadline_s) returning (verdicts, load_hint) — or (verdicts,
+    load_hint, server_trace) from trace-aware backends (the wire
+    transport's 3-tuple shape; the pool accepts either)."""
 
     def __init__(self, backends):
         self.backends = dict(backends)
 
-    def call(self, target, sets, priority, deadline_s, timeout):
+    def call(self, target, sets, priority, deadline_s, timeout,
+             trace_ctx=None):
         return self.backends[target](sets, priority, deadline_s)
 
 
@@ -223,12 +242,16 @@ class WireTransport:
             self._peers[target] = pid
         return pid
 
-    def call(self, target, sets, priority, deadline_s, timeout):
+    def call(self, target, sets, priority, deadline_s, timeout,
+             trace_ctx=None):
         from ..network import wire as W
 
         payload = W.encode_verify_request(
-            sets, priority=priority, deadline_ms=int(deadline_s * 1e3)
+            sets, priority=priority, deadline_ms=int(deadline_s * 1e3),
+            trace_ctx=trace_ctx,
         )
+        if trace_ctx is not None:
+            M.TRACE_CTX_SENT.with_labels(target).inc()
         return self.wire.request_verify_batch(
             self._peer_for(target), payload, timeout=timeout
         )
@@ -301,11 +324,19 @@ class RemoteVerifierPool:
 
     # ------------------------------------------------------------ public
 
-    def verify_batch(self, sets, priority="attestation"):
+    def verify_batch(self, sets, priority="attestation", trace_ctx=None,
+                     report=None):
         """Place one batch on the remote tier.  Returns the per-set
         verdict list on a remote (and audit-clean) verdict, or None when
         the batch should run on the local tiers instead — no admissible
-        target, total hedge budget exhausted, or a failed audit."""
+        target, total hedge budget exhausted, or a failed audit.
+
+        `trace_ctx` (trace_id, origin) propagates on every issued call
+        so serving nodes open child traces and ship span timings back;
+        `report`, when a dict, is filled with the per-call records
+        (hedged duplicates included), duplicate count, winner, and the
+        client-side audit window — the submitter stitches these into
+        its own trace."""
         sets = list(sets)
         if not sets or self._stopped or not self.targets:
             return None
@@ -313,7 +344,7 @@ class RemoteVerifierPool:
         if not order:
             return None
         self._ensure_worker()
-        job = _Job(sets, priority)
+        job = _Job(sets, priority, trace_ctx=trace_ctx)
         with self._lock:
             self.jobs_submitted += 1
         self._jobs.put(job)
@@ -321,23 +352,36 @@ class RemoteVerifierPool:
         # a wedged worker or a black-holed call degrades to the local
         # tiers instead of stalling the service dispatcher
         budget = self.hedge_budget * (len(order) + 1) + 0.5
-        if not job.event.wait(budget) or job.result is None:
+        resolved = job.event.wait(budget)
+        try:
+            if not resolved or job.result is None:
+                with self._lock:
+                    self.jobs_local += 1
+                return None
+            verdicts = job.result
+            if len(verdicts) != len(sets):
+                self._distrust(job.winner, "verdict count mismatch")
+                with self._lock:
+                    self.jobs_local += 1
+                return None
+            audited = self._should_audit(job.priority)
+            a0 = self._clock()
+            if audited and not self._audit(job):
+                with self._lock:
+                    self.jobs_local += 1
+                return None
+            if report is not None and audited:
+                report["audit"] = (a0, self._clock())
             with self._lock:
-                self.jobs_local += 1
-            return None
-        verdicts = job.result
-        if len(verdicts) != len(sets):
-            self._distrust(job.winner, "verdict count mismatch")
-            with self._lock:
-                self.jobs_local += 1
-            return None
-        if self._should_audit(job.priority) and not self._audit(job):
-            with self._lock:
-                self.jobs_local += 1
-            return None
-        with self._lock:
-            self.jobs_remote += 1
-        return verdicts
+                self.jobs_remote += 1
+            return verdicts
+        finally:
+            if report is not None:
+                report["calls"] = job.call_records()
+                report["duplicates"] = job.duplicates
+                report["winner"] = (
+                    job.winner.name if job.winner is not None else None
+                )
 
     def has_admissible_target(self):
         """Read-only placement peek (no breaker transitions)."""
@@ -478,7 +522,7 @@ class RemoteVerifierPool:
                     target.name, self.hedge_budget * 1e3,
                 )
             th = threading.Thread(
-                target=self._call_target, args=(job, target),
+                target=self._call_target, args=(job, target, i),
                 name=f"remote_verify_call_{target.name}", daemon=True,
             )
             th.start()
@@ -490,7 +534,7 @@ class RemoteVerifierPool:
         # batch back to the local path
         job.event.wait(self.hedge_budget)
 
-    def _call_target(self, job, target):
+    def _call_target(self, job, target, hedge=0):
         t0 = time.monotonic()
         try:
             # chaos seam: `error` fails this target's call (a dead or
@@ -511,16 +555,29 @@ class RemoteVerifierPool:
                 max_delay=0.25, deadline=call_timeout * self.retry_attempts,
                 retry_on=(Exception,), rng=random.random,
             )
-            verdicts, load = policy.call(
+            res = policy.call(
                 self.transport.call, target.name, job.sets, job.priority,
                 self.hedge_budget, call_timeout,
                 target=f"remote_verify:{target.name}",
+                trace_ctx=job.trace_ctx,
             )
+            # transports answer (verdicts, load) or, when the request
+            # carried a trace context, (verdicts, load, server_trace)
+            if len(res) == 3:
+                verdicts, load, server = res
+            else:
+                verdicts, load = res
+                server = None
         except Exception as e:
             M.REMOTE_RPC.with_labels(target.name).observe(
                 time.monotonic() - t0
             )
             target.record_failure()
+            job.note_call({
+                "target": target.name, "hedge": hedge,
+                "t0": t0, "t1": time.monotonic(),
+                "error": str(e)[:120],
+            })
             log.debug("remote verify call to %s failed: %s",
                       target.name, str(e)[:200])
             return
@@ -529,9 +586,19 @@ class RemoteVerifierPool:
         if not isinstance(verdicts, list) or len(verdicts) != len(job.sets):
             # a shape lie is a failure, not a verdict
             target.record_failure()
+            job.note_call({
+                "target": target.name, "hedge": hedge,
+                "t0": t0, "t1": time.monotonic(),
+                "error": "verdict shape mismatch",
+            })
             return
         target.record_success(dt, load)
-        job.offer(verdicts, target)
+        won = job.offer(verdicts, target)
+        job.note_call({
+            "target": target.name, "hedge": hedge,
+            "t0": t0, "t1": t0 + dt,
+            "server": server, "winner": won, "duplicate": not won,
+        })
 
     # ------------------------------------------------------------- audit
 

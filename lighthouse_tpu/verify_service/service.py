@@ -966,9 +966,27 @@ class VerificationService:
         pool = self.remote_pool
         # the most urgent class present rides the whole coalesced batch
         cls = min(reqs, key=lambda r: _CLASS_INDEX[r.cls]).cls
+        attrs = {
+            "sets": len(all_sets),
+            "requests": len(reqs),
+            "coalesced": len(reqs) > 1,
+            "classes": sorted({r.cls for r in reqs}),
+            "backend": "remote",
+        }
+        # the batch trace is created BEFORE the pool call so its id can
+        # ride the VERIFY_REQ frames: serving nodes open child traces
+        # under it and ship their span timings back for stitching.  On a
+        # remote miss the unfinished trace is simply dropped (finish()
+        # publishes; we never call it) — the local path starts its own.
+        bt = tracing.start_trace("verify_batch", **attrs)
+        report = {}
         t0 = time.monotonic()
         try:
-            verdicts = pool.verify_batch(all_sets, priority=cls)
+            verdicts = pool.verify_batch(
+                all_sets, priority=cls,
+                trace_ctx=(bt.trace_id, tracing.node_id()),
+                report=report,
+            )
         except Exception:
             log.exception(
                 "remote verify tier failed hard; local tiers take the batch"
@@ -978,17 +996,14 @@ class VerificationService:
             return False
         t1 = time.monotonic()
         M.REMOTE_TIER.set(0)
-        attrs = {
-            "sets": len(all_sets),
-            "requests": len(reqs),
-            "coalesced": len(reqs) > 1,
-            "classes": sorted({r.cls for r in reqs}),
-            "backend": "remote",
-        }
-        bt = tracing.start_trace("verify_batch", **attrs)
         bt.add_span("queue_wait", min(r.submitted for r in reqs), now)
         bt.add_span("kernel", t0, t1, backend="remote")
-        bt.finish(ok=all(verdicts))
+        self._stitch_remote_spans(bt, reqs, report)
+        bt.finish(
+            ok=all(verdicts),
+            winner=report.get("winner"),
+            hedged_duplicates=report.get("duplicates", 0),
+        )
         self._attach_spans(reqs, now, t0, t1, attrs)
         pos = 0
         for r in reqs:
@@ -996,6 +1011,58 @@ class VerificationService:
             pos += len(r.sets)
             self._resolve(r, mine if r.per_set else all(mine))
         return True
+
+    def _stitch_remote_spans(self, bt, reqs, report):
+        """Merge the pool's per-call records — the winning call AND its
+        hedged duplicates, each tagged with its target and hedge index —
+        into the batch trace, rebasing each server span at that call's
+        local send time (cross-node clock skew rides on the assumption
+        that the RPC round trip bounds it; good enough for attribution).
+        Submitter traces get the same spans, so one /lighthouse/tracing
+        row reads end-to-end: client queue_wait -> rpc -> server
+        serve_decode/queue_wait/batch/kernel -> audit."""
+        calls = report.get("calls") or []
+        stitched_any = False
+        for call in calls:
+            tag = {
+                "target": call.get("target"),
+                "hedge": call.get("hedge", 0),
+                "duplicate": bool(call.get("duplicate")),
+            }
+            if call.get("error"):
+                bt.add_span(
+                    "remote.rpc", call["t0"], call["t1"],
+                    error=call["error"], **tag,
+                )
+                continue
+            bt.add_span("remote.rpc", call["t0"], call["t1"], **tag)
+            server = call.get("server")
+            if not server:
+                continue
+            stitched_any = True
+            base = call["t0"]
+            for name, start_us, dur_us in server.get("spans", ()):
+                s = base + start_us / 1e6
+                bt.add_span(
+                    f"remote.{name}", s, s + dur_us / 1e6,
+                    server_trace=server.get("trace_id"), **tag,
+                )
+                M.TRACE_REMOTE_SPANS.with_labels(
+                    str(call.get("target"))
+                ).inc()
+        audit = report.get("audit")
+        if audit is not None:
+            bt.add_span("audit", audit[0], audit[1], backend="host")
+        if stitched_any:
+            M.TRACE_STITCHED.inc()
+        # the same stitched view lands on each submitter's trace, so a
+        # request-level trace also reads end-to-end
+        for r in reqs:
+            if r.trace is None:
+                continue
+            for name, s, e, a in bt.snapshot_spans():
+                if name.startswith("remote.") or name == "audit":
+                    r.trace.add_span(name, s, e, **a)
 
     def _dispatch(self, reqs):
         now = time.monotonic()

@@ -1,0 +1,309 @@
+"""Distributed tracing across the wire fabric: the VERIFY_REQ trace-
+context block, the VERIFY_RESP server span-timing block, the serving
+side's child trace, hedged-duplicate tagging, and the end-to-end
+acceptance — one remote verify batch through RemoteVerifyFabric reads
+as ONE stitched trace (client spans + propagated server spans) at
+/lighthouse/tracing."""
+
+import time
+
+import pytest
+
+from lighthouse_tpu.crypto.backend import SignatureVerifier
+from lighthouse_tpu.crypto.ref import bls
+from lighthouse_tpu.network import wire as W
+from lighthouse_tpu.network.wire import WireError, WireNode
+from lighthouse_tpu.state_processing.genesis import interop_keypairs
+from lighthouse_tpu.testing.simulator import RemoteVerifyFabric
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+from lighthouse_tpu.utils import tracing
+from lighthouse_tpu.verify_service import (
+    InProcessTransport,
+    RemoteVerifierPool,
+    VerificationService,
+)
+from lighthouse_tpu.verify_service.remote import _Job
+
+SPEC = ChainSpec(preset=MinimalPreset)
+
+
+def probe_sets(n, tag=0x31):
+    msg = bytes([tag]) * 32
+    return [
+        bls.SignatureSet(bls.sign(sk, msg), [pk], msg)
+        for sk, pk in interop_keypairs(n)
+    ]
+
+
+# ------------------------------------------------------------- request codec
+
+
+def test_request_trace_ctx_roundtrip_and_legacy_byte_identity():
+    sets = probe_sets(2)
+    plain = W.encode_verify_request(sets, priority="block", deadline_ms=100)
+    traced = W.encode_verify_request(
+        sets, priority="block", deadline_ms=100,
+        trace_ctx=("nodeA-17", "nodeA"),
+    )
+    # without a context the frame is byte-identical to the legacy
+    # layout; with one, ONLY the flag bit and the trailing block differ
+    assert plain[0] & W._TRACE_FLAG == 0
+    assert traced[0] == plain[0] | W._TRACE_FLAG
+    tail = b"\x08nodeA-17\x05nodeA"
+    assert traced[1:] == plain[1:] + tail
+
+    dec, priority, deadline, ctx = W.decode_verify_request(traced)
+    assert ctx == ("nodeA-17", "nodeA")
+    assert priority == "block" and len(dec) == 2
+    # long ids are truncated to the cap, not rejected, on encode
+    big = W.encode_verify_request(sets, trace_ctx=("x" * 200, "y" * 200))
+    _, _, _, ctx = W.decode_verify_request(big)
+    assert ctx == ("x" * W.MAX_TRACE_ID_BYTES, "y" * W.MAX_TRACE_ID_BYTES)
+
+
+def test_request_trace_ctx_malformed():
+    sets = probe_sets(1)
+    traced = W.encode_verify_request(sets, trace_ctx=("tid-1", "origin"))
+    # every truncation of the ctx block is a typed error
+    plain_len = len(W.encode_verify_request(sets))
+    for cut in range(plain_len, len(traced)):
+        with pytest.raises(WireError):
+            W.decode_verify_request(traced[:cut])
+    # trailing garbage after the ctx block
+    with pytest.raises(WireError):
+        W.decode_verify_request(traced + b"\x00")
+    # flag raised but no ctx block at all
+    plain = W.encode_verify_request(sets)
+    flagged = bytes([plain[0] | W._TRACE_FLAG]) + plain[1:]
+    with pytest.raises(WireError):
+        W.decode_verify_request(flagged)
+    # non-utf8 trace id bytes
+    bad = flagged + b"\x02\xff\xfe\x00"
+    with pytest.raises(WireError):
+        W.decode_verify_request(bad)
+
+
+# ------------------------------------------------------------ response codec
+
+
+def test_response_span_block_roundtrip():
+    spans = [("serve_decode", 120, 80), ("queue_wait", 200, 1500),
+             ("kernel", 1700, 2500)]
+    resp = W.encode_verify_response(
+        [True, False, True], load_hint=3, server_trace=("srv-9", spans)
+    )
+    verdicts, load, st = W.decode_verify_response(resp)
+    assert verdicts == [True, False, True] and load == 3
+    assert st == {"trace_id": "srv-9", "spans": spans}
+    # span names truncate to the cap; timings clamp into u32
+    resp = W.encode_verify_response(
+        [True], server_trace=("s", [("n" * 200, -5, 1 << 40)])
+    )
+    _, _, st = W.decode_verify_response(resp)
+    assert st["spans"] == [
+        ("n" * W.MAX_TRACE_SPAN_NAME, 0, (1 << 32) - 1)
+    ]
+    # the span list is capped, not unbounded
+    resp = W.encode_verify_response(
+        [True], server_trace=("s", [("a", 0, 1)] * 100)
+    )
+    _, _, st = W.decode_verify_response(resp)
+    assert len(st["spans"]) == W.MAX_TRACE_SPANS
+
+
+def test_response_span_block_truncations():
+    resp = W.encode_verify_response(
+        [True, True], server_trace=("srv-1", [("kernel", 10, 20)])
+    )
+    base = len(W.encode_verify_response([True, True]))
+    # cutting at exactly `base` is the VALID legacy shape (no tail);
+    # every partial tail beyond it is a typed error
+    _, _, st = W.decode_verify_response(resp[:base])
+    assert st is None
+    for cut in range(base + 1, len(resp)):
+        with pytest.raises(WireError):
+            W.decode_verify_response(resp[:cut])
+    with pytest.raises(WireError):
+        W.decode_verify_response(resp + b"\x00")
+
+
+# ---------------------------------------------------------------- serve side
+
+
+def test_serve_opens_child_trace_and_ships_spans_back():
+    """A VERIFY_REQ carrying a trace context comes back with the
+    server's serve_decode + queue_wait/batch/kernel span timings, and
+    the serving node published a verify_serve trace tied to the
+    propagated parent id."""
+    service = VerificationService(SignatureVerifier("fake"), target_batch=4)
+    server = WireNode(None, accept_any_fork=True, peer_id="vhost_tr",
+                      verify_service=service)
+    client = WireNode(None, accept_any_fork=True, peer_id="vclient_tr")
+    tracing.clear()
+    try:
+        pid = client.dial("127.0.0.1", server.port)
+        payload = W.encode_verify_request(
+            probe_sets(2, tag=0x61), trace_ctx=("clientnode-42", "clientnode")
+        )
+        verdicts, _load, st = client.request_verify_batch(
+            pid, payload, timeout=10.0
+        )
+        assert verdicts == [True, True]
+        assert st is not None
+        names = [s[0] for s in st["spans"]]
+        assert names[0] == "serve_decode"
+        for expected in ("queue_wait", "batch", "kernel"):
+            assert expected in names, names
+        # timings are serve-relative microseconds, monotone windows
+        for _name, start_us, dur_us in st["spans"]:
+            assert start_us >= 0 and dur_us >= 0
+        # the serving node published the child trace under the parent id
+        serves = [t for t in tracing.recent()
+                  if t["kind"] == "verify_serve"]
+        assert serves, "server did not publish a verify_serve trace"
+        assert serves[0]["attrs"]["parent_trace_id"] == "clientnode-42"
+        assert serves[0]["trace_id"] == st["trace_id"]
+        # a context-less request still gets the legacy response shape
+        plain = W.encode_verify_request(probe_sets(1, tag=0x62))
+        _, _, st2 = client.request_verify_batch(pid, plain, timeout=10.0)
+        assert st2 is None
+    finally:
+        client.stop()
+        server.stop()
+        service.stop()
+
+
+# -------------------------------------------------------- hedged duplicates
+
+
+def test_hedged_duplicate_calls_are_tagged_per_target():
+    """Both calls of a hedged pair land: the first offer wins, the
+    second is recorded as a duplicate — each call record keeps its own
+    target name, hedge index, and rpc window."""
+    sets = probe_sets(1)
+    backends = {
+        "fast": lambda s, p, d: ([True] * len(s), 0),
+        "slow": lambda s, p, d: ([True] * len(s), 7,
+                                 {"trace_id": "slow-1", "spans": []}),
+    }
+    pool = RemoteVerifierPool(
+        ["fast", "slow"], InProcessTransport(backends), hedge_budget=0.05
+    )
+    try:
+        job = _Job(sets, "block", trace_ctx=("orig-1", "orig"))
+        fast, slow = pool.targets
+        pool._call_target(job, fast, hedge=0)
+        pool._call_target(job, slow, hedge=1)
+        records = job.call_records()
+        assert [r["target"] for r in records] == ["fast", "slow"]
+        assert records[0]["winner"] and not records[0]["duplicate"]
+        assert records[1]["duplicate"] and not records[1]["winner"]
+        assert records[0]["hedge"] == 0 and records[1]["hedge"] == 1
+        # the 3-tuple transport shape carries the server block through
+        assert records[1]["server"]["trace_id"] == "slow-1"
+        assert job.duplicates == 1
+    finally:
+        pool.stop()
+
+
+def test_verify_batch_fills_report_with_call_records():
+    sets = probe_sets(2)
+    backends = {"only": lambda s, p, d: ([True] * len(s), 0)}
+    pool = RemoteVerifierPool(
+        ["only"], InProcessTransport(backends), hedge_budget=0.2
+    )
+    try:
+        report = {}
+        verdicts = pool.verify_batch(
+            sets, priority="attestation",
+            trace_ctx=("t-1", "n"), report=report,
+        )
+        assert verdicts == [True, True]
+        assert report["winner"] == "only"
+        assert report["duplicates"] == 0
+        assert len(report["calls"]) == 1
+        rec = report["calls"][0]
+        assert rec["target"] == "only" and rec["winner"]
+        assert rec["t1"] >= rec["t0"]
+    finally:
+        pool.stop()
+
+
+# -------------------------------------------------------------- acceptance
+
+
+def test_remote_batch_reads_as_one_stitched_trace():
+    """THE tentpole acceptance: a hedged remote batch through the wire
+    fabric produces one verify_batch trace holding the client-side
+    spans AND the propagated server spans (queue_wait/batch/kernel),
+    hedge-tagged per target."""
+    f = RemoteVerifyFabric(SPEC, n_hosts=2)
+    try:
+        tracing.clear()
+        f.hosts[0].wire.verify_serve_delay = 1.5   # force a hedge
+        try:
+            sets = f.probe_sets(tag=9)
+            fut = f.submit_probe(sets)
+            f.assert_no_lost_verdicts(fut, len(sets))
+        finally:
+            f.hosts[0].wire.verify_serve_delay = 0.0
+        assert f.pool().snapshot()["hedges"] >= 1
+        # all sim nodes share this process's tracing ring: find the
+        # remote-backend batch trace the submitting node published
+        batches = [
+            t for t in tracing.recent()
+            if t["kind"] == "verify_batch"
+            and t["attrs"].get("backend") == "remote"
+        ]
+        assert batches, "no remote verify_batch trace published"
+        bt = batches[0]
+        names = [s["name"] for s in bt["spans"]]
+        # client-side spans
+        assert "queue_wait" in names and "kernel" in names
+        # the winning call's rpc window and its propagated server spans
+        for expected in ("remote.rpc", "remote.queue_wait",
+                         "remote.batch", "remote.kernel"):
+            assert expected in names, names
+        remote_spans = [s for s in bt["spans"]
+                        if s["name"].startswith("remote.")]
+        for s in remote_spans:
+            attrs = s.get("attrs", {})
+            assert "target" in attrs and "hedge" in attrs, s
+            assert attrs["duplicate"] is False     # the winner's view
+        # the slow host was hedged over: the winning spans carry a
+        # hedge index >= 1 and every server span names its child trace
+        assert any(
+            s["attrs"]["hedge"] >= 1 for s in remote_spans
+        ), remote_spans
+        assert all(
+            "server_trace" in s["attrs"] for s in remote_spans
+            if s["name"] != "remote.rpc"
+        ), remote_spans
+        assert bt["attrs"].get("winner") is not None
+    finally:
+        f.stop()
+
+
+def test_profile_endpoint_serves_rows_after_fabric_workload(tmp_path):
+    """GET /lighthouse/profile contract, one layer down: after a verify
+    workload the registry snapshot (what the route serves verbatim)
+    has per-(kernel, shape, topology) rows — on the fake backend the
+    registry may legitimately be empty, so this asserts the snapshot
+    SHAPE and the launch counters, not device rows."""
+    from lighthouse_tpu.crypto.tpu import profile
+
+    reg = profile.ProfileRegistry(str(tmp_path / "kernel_profile.json"))
+    old = profile.get_registry()
+    profile.set_registry(reg)
+    try:
+        f = RemoteVerifyFabric(SPEC, n_hosts=1)
+        try:
+            fut = f.submit_probe(f.probe_sets(tag=11))
+            fut.result(timeout=15.0)
+        finally:
+            f.stop()
+        snap = profile.get_registry().snapshot()
+        assert set(snap) >= {"schema", "topology", "launch_counts", "rows"}
+        assert isinstance(snap["rows"], list)
+    finally:
+        profile.set_registry(old)

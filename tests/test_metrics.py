@@ -222,6 +222,21 @@ def test_metric_name_lint():
         "lighthouse_lock_witness_stalls_total",
         "lighthouse_lock_witness_held_seconds",
     } <= names, sorted(names)
+    # the fleet-observability families (ISSUE 12) must be registered
+    # and linted: trace-context propagation/serve/stitch counters and
+    # the per-kernel profile registry gauges
+    from lighthouse_tpu.crypto.tpu import profile  # noqa: F401 — registers
+
+    names = {name for name, _, _, _ in metrics.all_metrics()}
+    assert {
+        "verify_trace_ctx_propagated_total",
+        "verify_trace_served_total",
+        "verify_trace_stitched_total",
+        "verify_trace_remote_spans_total",
+        "kernel_profile_launches_total",
+        "kernel_profile_wall_ms",
+        "kernel_profile_pad_waste_ratio",
+    } <= names, sorted(names)
 
 
 def test_verify_service_queue_depth_is_one_labeled_family():

@@ -63,11 +63,11 @@ def test_verify_request_roundtrip_uses_pubkey_decode_cache():
     payload = W.encode_verify_request(sets, priority="block",
                                       deadline_ms=250)
     h0, m0 = W.PK_DECODE_CACHE.hits, W.PK_DECODE_CACHE.misses
-    dec1, priority, deadline = W.decode_verify_request(payload)
+    dec1, priority, deadline, _ctx = W.decode_verify_request(payload)
     assert priority == "block" and abs(deadline - 0.25) < 1e-9
     assert [s.message for s in dec1] == [s.message for s in sets]
     # same pubkeys again: pure cache hits this time
-    dec2, _, _ = W.decode_verify_request(payload)
+    dec2, _, _, _ = W.decode_verify_request(payload)
     assert W.PK_DECODE_CACHE.hits >= h0 + 2
     # decoded sets actually verify (points survived the trip)
     v = SignatureVerifier("fake")
@@ -80,7 +80,7 @@ def test_verify_codec_signatureless_sets():
     base = probe_sets(1)[0]
     s = bls.SignatureSet(None, base.pubkeys, base.message)
     payload = W.encode_verify_request([s])
-    dec, _, _ = W.decode_verify_request(payload)
+    dec, _, _, _ = W.decode_verify_request(payload)
     assert dec[0].signature is None
     assert dec[0].message == base.message
 

@@ -1,0 +1,143 @@
+"""utils/tracing.py unit coverage: span ordering in to_dict, the ring
+buffer (recent/depth/clear, overwrite under concurrent writers), the
+thread-local use() stack, and node-unique trace ids."""
+
+import threading
+
+import pytest
+
+from lighthouse_tpu.utils import tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_ring():
+    tracing.clear()
+    yield
+    tracing.clear()
+
+
+def test_to_dict_preserves_span_insertion_order():
+    tr = tracing.start_trace("unit", tag="x")
+    t0 = tr.t_start
+    # deliberately appended OUT of chronological order: to_dict must
+    # report insertion order (the pipeline's causal order), not sort
+    tr.add_span("kernel", t0 + 0.020, t0 + 0.030)
+    tr.add_span("queue_wait", t0 - 0.005, t0 + 0.010, cls="block")
+    tr.add_span("batch", t0 + 0.010, t0 + 0.020)
+    d = tr.to_dict()
+    assert [s["name"] for s in d["spans"]] == [
+        "kernel", "queue_wait", "batch",
+    ]
+    # a span may start before the trace (queued submit): negative rel ms
+    qw = d["spans"][1]
+    assert qw["start_ms"] < 0
+    assert qw["attrs"] == {"cls": "block"}
+    assert d["attrs"]["tag"] == "x"
+    assert d["duration_ms"] >= 30.0 - 1e-6
+
+
+def test_recent_limit_and_order():
+    for i in range(5):
+        tracing.start_trace("unit", seq=i).finish()
+    got = tracing.recent(limit=3)
+    assert len(got) == 3
+    # most-recent-first
+    assert [t["attrs"]["seq"] for t in got] == [4, 3, 2]
+    assert len(tracing.recent()) == 5
+    assert tracing.recent(limit=0) == []
+
+
+def test_depth_and_clear():
+    assert tracing.depth() == 0
+    unfinished = tracing.start_trace("unit")
+    assert tracing.depth() == 0          # unpublished until finish()
+    unfinished.finish()
+    tracing.start_trace("unit").finish()
+    assert tracing.depth() == 2
+    tracing.clear()
+    assert tracing.depth() == 0
+
+
+def test_finish_is_idempotent_but_still_merges_attrs():
+    tr = tracing.start_trace("unit")
+    tr.finish(ok=True)
+    tr.finish(ok=False, late=1)          # no double publish
+    assert tracing.depth() == 1
+    d = tracing.recent()[0]
+    assert d["attrs"] == {"ok": False, "late": 1}
+
+
+def test_use_stack_and_none_noop():
+    assert tracing.current_trace() is None
+    with tracing.use(None):
+        assert tracing.current_trace() is None
+    outer = tracing.start_trace("outer")
+    inner = tracing.start_trace("inner")
+    with tracing.use(outer):
+        assert tracing.current_trace() is outer
+        with tracing.use(inner):
+            assert tracing.current_trace() is inner
+        assert tracing.current_trace() is outer
+    assert tracing.current_trace() is None
+
+
+def test_ring_overwrites_oldest_under_concurrent_writers():
+    """CAPACITY is a hard bound: hammering the ring from several threads
+    keeps exactly the newest CAPACITY traces, no exceptions raised."""
+    n_threads, per_thread = 8, tracing.CAPACITY
+    errors = []
+
+    def writer(k):
+        try:
+            for i in range(per_thread):
+                tr = tracing.start_trace("unit", writer=k, i=i)
+                tr.add_span("work", tr.t_start, tr.t_start + 0.001)
+                tr.finish()
+        except Exception as e:          # pragma: no cover - fail loudly
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=writer, args=(k,)) for k in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert tracing.depth() == tracing.CAPACITY
+    got = tracing.recent()
+    assert len(got) == tracing.CAPACITY
+    # every survivor is intact and ids are unique
+    ids = {t["trace_id"] for t in got}
+    assert len(ids) == tracing.CAPACITY
+
+
+def test_trace_ids_are_node_prefixed_and_unique():
+    a = tracing.start_trace("unit")
+    b = tracing.start_trace("unit")
+    node = tracing.node_id()
+    assert a.trace_id != b.trace_id
+    assert a.trace_id.startswith(node + "-")
+    assert b.trace_id.startswith(node + "-")
+
+
+def test_set_node_id_sanitizes_and_applies_to_new_traces():
+    old = tracing.node_id()
+    try:
+        got = tracing.set_node_id("host-1:9000/x")
+        # ':' '/' '-' stripped, alnum and '._' kept
+        assert got == "host19000x"
+        assert tracing.start_trace("unit").trace_id.startswith("host19000x-")
+        # empty-after-sanitize input is ignored, not applied
+        assert tracing.set_node_id("///") == "host19000x"
+    finally:
+        tracing.set_node_id(old)
+
+
+def test_snapshot_spans_returns_copy():
+    tr = tracing.start_trace("unit")
+    tr.add_span("a", tr.t_start, tr.t_start + 0.001)
+    snap = tr.snapshot_spans()
+    assert [s[0] for s in snap] == ["a"]
+    snap.append(("bogus", 0, 0, {}))
+    assert tr.span_names() == ["a"]

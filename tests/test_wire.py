@@ -483,9 +483,10 @@ def test_verify_request_codec_fuzz_truncations():
     payload = encode_verify_request(_probe_sets(2), priority="aggregate",
                                     deadline_ms=50)
     # full payload decodes
-    sets, priority, deadline = decode_verify_request(payload)
+    sets, priority, deadline, ctx = decode_verify_request(payload)
     assert len(sets) == 2 and priority == "aggregate"
     assert abs(deadline - 0.05) < 1e-9
+    assert ctx is None              # no trace context was attached
     # every proper prefix is a typed error (step 7 keeps runtime sane
     # while still crossing every field boundary)
     for cut in range(0, len(payload), 7):
@@ -550,8 +551,9 @@ def test_verify_response_codec_negative():
     import struct as _struct
 
     resp = encode_verify_response([True, False, True, True], load_hint=9)
-    verdicts, load = decode_verify_response(resp)
+    verdicts, load, server_trace = decode_verify_response(resp)
     assert verdicts == [True, False, True, True] and load == 9
+    assert server_trace is None     # no span block was attached
     for cut in range(len(resp)):
         with pytest.raises(WE):
             decode_verify_response(resp[:cut])
@@ -583,8 +585,8 @@ def test_garbage_verify_req_answers_typed_error_and_connection_survives():
         from lighthouse_tpu.network.wire import encode_verify_request
 
         payload = encode_verify_request(_probe_sets(2, tag=0x44))
-        verdicts, _load = client.request_verify_batch(pid, payload,
-                                                      timeout=10.0)
+        verdicts, _load, _st = client.request_verify_batch(pid, payload,
+                                                           timeout=10.0)
         assert verdicts == [True, True]
         assert pid in client.peers
     finally:
@@ -618,8 +620,8 @@ def test_verify_serve_inflight_cap_refuses_excess():
             client.request_verify_batch(pid, payload, timeout=5.0)
         for _ in range(held):
             server._verify_slots.release()
-        verdicts, _load = client.request_verify_batch(pid, payload,
-                                                      timeout=10.0)
+        verdicts, _load, _st = client.request_verify_batch(pid, payload,
+                                                           timeout=10.0)
         assert verdicts == [True]
     finally:
         client.stop()

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Summarize the per-kernel performance profile registry.
+
+Reads the kernel_profile.json the profile registry persists beside the
+AOT compile cache (crypto/tpu/profile.py) — or any registry snapshot
+saved from `GET /lighthouse/profile` — and prints:
+
+  * the per-(kernel, shape, topology) table: launches, wall EWMA /
+    mean / min / max, pad-waste ratio, flops and bytes from the XLA
+    cost model
+  * the top-N wall-time sinks
+  * the cost-model fit: measured mean wall vs. static flops per row
+    (GFLOP/s column); a kernel whose throughput falls far off its
+    siblings stopped tracking its arithmetic — look for a layout or
+    padding regression
+
+Exit status:
+  0 — registry read and summarized
+  1 — registry missing, malformed, or EMPTY (no rows): with --json
+      this is the machine contract CI scripts key off, so an empty
+      profile is an error, not a vacuous success
+
+Usage:
+  python tools/profile_report.py                    # default registry
+  python tools/profile_report.py --path p.json --top 10
+  python tools/profile_report.py --json             # machine-readable
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _load_rows(path):
+    """(rows, error) from a registry file; rows is None on failure."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return None, f"no kernel profile at {path}"
+    except (OSError, ValueError) as e:
+        return None, f"unreadable kernel profile {path}: {e}"
+    if not isinstance(data, dict):
+        return None, "malformed kernel profile: top level is not an object"
+    rows = data.get("rows")
+    if not isinstance(rows, list):
+        return None, "malformed kernel profile: missing 'rows' list"
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict) or not {
+            "kernel", "shape", "topology", "launches", "total_ms",
+        } <= set(row):
+            return None, f"malformed kernel profile: bad row {i}"
+    if not rows:
+        return None, "kernel profile is empty (no launches recorded)"
+    return rows, None
+
+
+def _gflops(row):
+    """Measured GFLOP/s from the static cost join, None without one."""
+    cost = row.get("cost") or {}
+    flops = cost.get("flops")
+    launches = row.get("launches") or 0
+    if not flops or not launches or not row.get("total_ms"):
+        return None
+    mean_s = row["total_ms"] / launches / 1e3
+    if mean_s <= 0:
+        return None
+    return flops / mean_s / 1e9
+
+
+def summarize(rows, top=5):
+    rows = sorted(rows, key=lambda r: -r["total_ms"])
+    out = {
+        "rows": rows,
+        "top_sinks": [
+            {"kernel": r["kernel"], "shape": r["shape"],
+             "topology": r["topology"], "total_ms": r["total_ms"],
+             "launches": r["launches"]}
+            for r in rows[:top]
+        ],
+        "cost_fit": [
+            {"kernel": r["kernel"], "shape": r["shape"],
+             "gflops": round(g, 3)}
+            for r in rows
+            if (g := _gflops(r)) is not None
+        ],
+        "total_wall_ms": round(sum(r["total_ms"] for r in rows), 3),
+        "total_launches": sum(r["launches"] for r in rows),
+    }
+    return out
+
+
+def _fmt(v, nd=2):
+    if v is None:
+        return "-"
+    return f"{v:.{nd}f}"
+
+
+def print_table(summary):
+    hdr = (f"{'kernel':<22} {'shape':<12} {'topology':<12} "
+           f"{'launches':>8} {'ewma_ms':>9} {'mean_ms':>9} "
+           f"{'pad_waste':>9} {'GFLOP/s':>9}")
+    print(hdr)
+    print("-" * len(hdr))
+    for r in summary["rows"]:
+        mean = (r["total_ms"] / r["launches"]) if r["launches"] else None
+        print(
+            f"{r['kernel']:<22} {r['shape']:<12} {r['topology']:<12} "
+            f"{r['launches']:>8} {_fmt(r.get('ewma_ms')):>9} "
+            f"{_fmt(mean):>9} {_fmt(r.get('pad_waste_ratio'), 3):>9} "
+            f"{_fmt(_gflops(r), 1):>9}"
+        )
+    print()
+    print(f"top {len(summary['top_sinks'])} wall-time sinks:")
+    for i, s in enumerate(summary["top_sinks"], 1):
+        print(f"  {i}. {s['kernel']}@{s['shape']} [{s['topology']}] "
+              f"{s['total_ms']:.1f} ms over {s['launches']} launches")
+    print(f"total: {summary['total_wall_ms']:.1f} ms across "
+          f"{summary['total_launches']} launches")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--path", default=None,
+                    help="registry JSON path (default: the process "
+                         "default beside the AOT compile cache)")
+    ap.add_argument("--top", type=int, default=5,
+                    help="top-N wall-time sinks to highlight")
+    ap.add_argument("--json", action="store_true",
+                    help="emit machine-readable summary JSON")
+    args = ap.parse_args(argv)
+
+    path = args.path
+    if path is None:
+        from lighthouse_tpu.crypto.tpu.profile import _default_path
+
+        path = _default_path()
+    rows, err = _load_rows(path)
+    if rows is None:
+        if args.json:
+            print(json.dumps({"error": err}))
+        else:
+            print(f"error: {err}", file=sys.stderr)
+        return 1
+    summary = summarize(rows, top=args.top)
+    if args.json:
+        print(json.dumps(summary, indent=1, sort_keys=True))
+    else:
+        print_table(summary)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
