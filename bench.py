@@ -107,6 +107,7 @@ _PRIMARY = None   # best sets/sec so far; flushed incrementally + on SIGTERM
 _COMPILE_EST = 240.0   # refined after the first measured compile
 _VS_SUMMARY = None     # verify_service coalescing sweep (ROADMAP item d)
 _CC_SUMMARY = None     # compile-cache cold-vs-cached measurement (ISSUE 6)
+_SOAK_SUMMARY = None   # multi-epoch adversarial soak gates (ISSUE 13)
 
 
 def _load_prior_primary():
@@ -142,6 +143,19 @@ def _regression_exit_code(final_value, platform):
          prior=prior["value"], current=round(final_value, 2),
          platform=platform,
          threshold=round(0.9 * float(prior["value"]), 2))
+    return 1
+
+
+def _soak_exit_code():
+    """The soak gates ride the same guard: a main-lane run whose soak
+    rider FAILED a hard gate (lost verdicts, RSS creep, head stall,
+    state-root divergence) must not ship green on throughput alone.
+    BENCH_NO_REGRESSION_GUARD=1 bypasses, same as the primary guard."""
+    if os.environ.get("BENCH_NO_REGRESSION_GUARD"):
+        return 0
+    if _SOAK_SUMMARY is None or _SOAK_SUMMARY.get("gates_passed", True):
+        return 0
+    note("soak_regression", failed_gates=_SOAK_SUMMARY.get("failed_gates"))
     return 1
 
 
@@ -214,6 +228,12 @@ def _emit_primary(value, final=False, backend="tpu-kernel", platform=None):
                       "cache_hit_rate", "shapes")
             if k in _CC_SUMMARY
         }
+    if _SOAK_SUMMARY is not None:
+        # the soak gates ride the guarded artifact so a robustness
+        # regression (lost verdicts, RSS creep, stalled head, state-root
+        # divergence) is tracked next to the throughput it could
+        # otherwise hide behind
+        rec["soak"] = _SOAK_SUMMARY
     try:
         # the per-kernel profile registry's roll-up (top wall-time
         # sinks, per-kernel totals, launch counters) rides along so a
@@ -878,6 +898,76 @@ def config_aggregation(n_validators=None, json_path=None):
         _VS_SUMMARY.update(summary)
 
 
+def config_soak(epochs=None, json_path=None):
+    """Multi-epoch adversarial soak lane: tools/soak_bench.py in a
+    CPU-pinned subprocess — validator churn, forced reorgs, and a
+    checkpoint-sync backfill racer against live import under the phased
+    failpoint storm, hard-gated (zero lost verdicts, flat RSS, head-stall
+    budget, byte-identical state roots vs the no-fault control).  The
+    default form rides every bench and merges a `soak` key into
+    BENCH_PRIMARY.json; `--soak` runs ONLY this lane and records
+    BENCH_SOAK.json.  A failed gate fails the run via _soak_exit_code."""
+    global _SOAK_SUMMARY
+    import subprocess
+
+    n = int(os.environ.get("BENCH_SOAK_VALIDATORS", "2048"))
+    n_epochs = epochs or int(os.environ.get("BENCH_SOAK_EPOCHS", "6"))
+    # measured on this rig: 2048 validators x 6 epochs = 43 s soak +
+    # 27 s no-fault control; the estimate stays ~2x conservative
+    est = 60.0 + n_epochs * n / 150.0
+    if not _fits(est, "soak"):
+        return None
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "soak_bench.py"),
+           "--validators", str(n), "--epochs", str(n_epochs),
+           "--json", json_path or "BENCH_SOAK.json"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                           timeout=max(600.0, 4 * est))
+    except subprocess.TimeoutExpired:
+        note("soak_error", error="timeout", validators=n, epochs=n_epochs)
+        return None
+    try:
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        gates = out["gates"]
+    except Exception:
+        note("soak_error", rc=r.returncode, validators=n,
+             stderr=r.stderr[-300:])
+        return None
+    backfill = out.get("backfill") or {}
+    note("soak", validators=n, epochs=n_epochs,
+         gates_passed=out["gates_passed"], gates=gates,
+         per_epoch_rss_bytes=out["per_epoch_rss_bytes"],
+         rss_growth_pct=out["rss_growth_pct"],
+         lost_verdicts=out["lost_verdicts"],
+         max_head_stall_s=out["max_head_stall_s"],
+         reorgs_survived=out["reorgs_survived"],
+         imported_blocks=out["imported_blocks"],
+         backfill=out.get("backfill"),
+         soak_seconds=out["soak_seconds"],
+         control_seconds=out["control_seconds"])
+    _SOAK_SUMMARY = {
+        "epochs": out["epochs"],
+        "validators": out["n_validators"],
+        "per_epoch_rss_bytes": out["per_epoch_rss_bytes"],
+        "rss_growth_pct": out["rss_growth_pct"],
+        "lost_verdicts": out["lost_verdicts"],
+        "max_head_stall_s": out["max_head_stall_s"],
+        "reorgs_survived": out["reorgs_survived"],
+        "backfill_races": backfill.get("races", 0),
+        "backfill_replays_match_live": backfill.get(
+            "all_replays_match_live", False),
+        "gates_passed": out["gates_passed"],
+    }
+    if not out["gates_passed"]:
+        _SOAK_SUMMARY["failed_gates"] = [
+            k for k, v in gates.items() if not v
+        ]
+    return r.returncode
+
+
 def config_kernels():
     """mont_mul candidate shoot-out: f32-HIGHEST GEMM vs int32 einsum vs
     the fused Pallas kernel, one jit each on a wide batch — a single
@@ -1157,6 +1247,14 @@ def main():
         config_aggregation(n_validators=1_000_000,
                            json_path="BENCH_SCALE.json")
         return 0
+    if "--soak" in sys.argv:
+        # the multi-epoch adversarial soak scenario ONLY: records
+        # BENCH_SOAK.json and the run details; the exit code follows the
+        # soak gates so a gate failure can't ship green
+        _DETAILS_PATH = "BENCH_SOAK_DETAILS.json"
+        _install_term_handler()
+        rc = config_soak(json_path="BENCH_SOAK.json")
+        return 1 if rc is None else rc
     _install_term_handler()
     note("platform", platform=jax.devices()[0].platform, note=_PLATFORM_NOTE,
          bucket=BUCKET, budget_s=BUDGET_S)
@@ -1219,12 +1317,12 @@ def main():
     # subprocess measurements to the front of the extras
     stages = (
         (config_device_retry, config_gossip_latency, config_native_shapes,
-         config5, config_aggregation, config_mesh,
+         config5, config_aggregation, config_soak, config_mesh,
          run_device_smoke_and_curve,
          config_kernels, config1, config4, config_compile_cache)
         if _DEVICE_ALIVE else
         (config_gossip_latency, config_native_shapes, config5,
-         config_aggregation, config_mesh, config_device_retry,
+         config_aggregation, config_soak, config_mesh, config_device_retry,
          run_device_smoke_and_curve, config_kernels, config1, config4,
          config_compile_cache)
     )
@@ -1258,12 +1356,12 @@ def main():
                 "note": "no config completed within budget",
             }
         ), flush=True)
-        return 0
+        return _soak_exit_code()
     _emit_primary(primary, final=True)
     return _regression_exit_code(
         _PRIMARY if _PRIMARY is not None else primary,
         _PRIMARY_PLATFORM or jax.devices()[0].platform,
-    )
+    ) or _soak_exit_code()
 
 
 if __name__ == "__main__":

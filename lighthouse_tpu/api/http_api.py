@@ -335,6 +335,16 @@ class _Handler(JsonHandler):
                 "meta": {"count": len(peers)},
             })
         if path == "/metrics":
+            # refresh the RSS + structure-depth gauges at scrape time so
+            # the exposition always carries current values (the soak's
+            # flat-RSS gate and an operator's dashboard read the same
+            # numbers)
+            from ..utils import process_metrics
+
+            try:
+                process_metrics.sample(chain)
+            except Exception:  # noqa: BLE001 — a scrape must never 500
+                pass
             return self._text(metrics.gather())
         if path == "/eth/v1/beacon/genesis":
             st = chain.store.get_state(chain.genesis_root)

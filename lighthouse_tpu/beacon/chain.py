@@ -135,6 +135,9 @@ class BeaconChain:
             genesis_state, genesis_root, self.preset
         )
         self.genesis_root = genesis_root
+        # hot-state pruning watermark: only finality ADVANCING past the
+        # anchor triggers _prune_finalized
+        self._pruned_finalized_epoch = self.fork_choice.store.finalized_checkpoint[0]
 
         # store seam: anything with put/get_block, put/get_state
         # (beacon/store.py HotColdStore or a bare MemoryStore)
@@ -197,11 +200,21 @@ class BeaconChain:
     def on_tick(self, slot):
         """timer/src/lib.rs per_slot_task: advance wall-clock slot and
         prune the bounded gossip caches."""
+        prev_epoch = self.current_slot // self.preset.slots_per_epoch
         self.current_slot = max(self.current_slot, int(slot))
         self.fork_choice.on_tick(self.current_slot)
         self.sync_pool.prune(self.current_slot)
         self.block_times_cache.prune(self.current_slot)
         self._slasher_tick()
+        epoch = self.current_slot // self.preset.slots_per_epoch
+        if epoch > prev_epoch:
+            # epoch boundary: churn re-key — validators that exited by
+            # this epoch release their device limb-cache entries (one
+            # numpy scan over the head registry per epoch)
+            try:
+                self.pubkey_cache.rekey_for_churn(self.head_state, epoch)
+            except Exception:  # noqa: BLE001 — hygiene must not stall the clock
+                pass
         # observed-* filters only matter for current/previous epoch
         horizon_epoch = self.current_slot // self.preset.slots_per_epoch - 2
         horizon_slot = self.current_slot - 2 * self.preset.slots_per_epoch
@@ -568,7 +581,34 @@ class BeaconChain:
         )
         self.recompute_head()
         self.op_pool.prune(post_state, self.preset)
+        self._prune_finalized()
         return sig_verified.block_root
+
+    def _prune_finalized(self):
+        """Hot-store + proto-array hygiene on finalization advance
+        (migrate.rs background migration / proto_array maybe_prune, done
+        inline): drop fork-choice nodes and stored STATES not descended
+        from the new finalized checkpoint.  Blocks are never pruned —
+        historical blocks keep serving backfill and replay; full states
+        are the O(state-size) term that would otherwise grow without
+        bound on a long-running chain.  No-op until finality actually
+        advances past the anchor, so non-finalizing tests see an
+        unchanged store."""
+        fin_epoch, fin_root = self.fork_choice.store.finalized_checkpoint
+        if fin_epoch <= self._pruned_finalized_epoch:
+            return
+        if fin_root not in self.fork_choice.proto.indices:
+            return          # finalized block not imported yet (sync edge)
+        self._pruned_finalized_epoch = fin_epoch
+        self.fork_choice.prune()
+        if hasattr(self.store, "prune_states"):
+            keep = set(self.fork_choice.proto.indices.keys())
+            keep.add(self.head_root)
+            # the anchor state is load-bearing forever: from_store
+            # restore and light-client bootstrap both read it by
+            # genesis_root no matter how far finality has advanced
+            keep.add(self.genesis_root)
+            self.store.prune_states(keep)
 
     def _serve_light_clients(self, block):
         """Feed the light-client server on import: the block's

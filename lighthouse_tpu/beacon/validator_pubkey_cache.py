@@ -28,6 +28,7 @@ class ValidatorPubkeyCache:
         self._points = []          # affine int G1 points, index = validator index
         self._path = path
         self._validate = validate  # "device" (batched kernel) | "host" (oracle)
+        self._retired = set()      # indices whose exit was already re-keyed
         if path and os.path.exists(path):
             self._load()
 
@@ -80,6 +81,40 @@ class ValidatorPubkeyCache:
                 for p in pts:
                     f.write(g1_compress(p))
         return range(start, len(self._points))
+
+    def rekey_for_churn(self, state, current_epoch):
+        """Validator-churn re-key: drop the device limb-cache
+        (`bls.PK_CACHE`) entries of validators that have exited by
+        `current_epoch`.  The index->point mapping here stays append-only
+        (historical blocks signed by exited validators must keep
+        verifying — the reference cache never evicts either), but the
+        hot Montgomery-limb LRU would otherwise pin dead keys at full
+        churn for the rest of the process: over a long soak that is both
+        a capacity leak and a stale-entry hazard if an encoding is ever
+        re-registered.  Idempotent per index.  Returns
+        (n_newly_exited, n_limb_entries_dropped)."""
+        reg = state.validators
+        n = min(len(reg), len(self._points))
+        exit_arr = getattr(reg, "exit_epoch", None)
+        if isinstance(exit_arr, np.ndarray):
+            idx = np.flatnonzero(exit_arr[:n] <= np.uint64(current_epoch))
+            exited = [int(i) for i in idx if int(i) not in self._retired]
+        else:
+            exited = [
+                i for i in range(n)
+                if i not in self._retired
+                and int(reg[i].exit_epoch) <= int(current_epoch)
+            ]
+        if not exited:
+            return 0, 0
+        keys = []
+        for i in exited:
+            self._retired.add(i)
+            p = self._points[i]
+            if p is not None:
+                keys.append(tb.PK_CACHE.key_of(p))
+        dropped = tb.PK_CACHE.invalidate(keys)
+        return len(exited), dropped
 
     def _load(self):
         data = open(self._path, "rb").read()

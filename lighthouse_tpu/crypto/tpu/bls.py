@@ -145,6 +145,20 @@ class PubkeyLimbCache:
         with self._lock:
             self._entries.clear()
 
+    def invalidate(self, keys):
+        """Drop entries by 48-byte compressed encoding — the validator
+        churn hook: an exited validator's limbs must not pin LRU
+        capacity for the rest of the process lifetime.  Unknown keys
+        are ignored (tiled test registries share encodings between
+        validators, so an invalidated key a live validator still uses
+        simply refills on the next miss).  Returns the count dropped."""
+        dropped = 0
+        with self._lock:
+            for k in keys:
+                if self._entries.pop(bytes(k), None) is not None:
+                    dropped += 1
+        return dropped
+
     def stats(self):
         with self._lock:
             hits, misses, size = self.hits, self.misses, len(self._entries)

@@ -182,6 +182,14 @@ class ProfileRegistry:
 
     # -- reading ------------------------------------------------------
 
+    def key_count(self):
+        """Distinct (kernel, shape, topology) keys held — the leak-watch
+        depth surface (`lighthouse_structure_depth{structure=
+        "profile_registry"}`): an unbounded-shape workload shows up here
+        before it shows up as RSS."""
+        with self._lock:
+            return len(self._entries)
+
     def rows(self):
         """Per-(kernel, shape, topology) stat dicts, most total time
         first — the /lighthouse/profile payload."""

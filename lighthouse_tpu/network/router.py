@@ -116,8 +116,11 @@ class Router:
                 chain.store.put_block(chain.genesis_root, b)
                 total += 1
 
+        from ..utils import failpoints
+
         batch_slots = batch_epochs * chain.preset.slots_per_epoch
         while next_top > 0:
+            failpoints.hit("backfill.replay")
             start = max(0, next_top - batch_slots)
             blocks = self.reqresp.blocks_by_range(
                 self.peer_id, peer_id, start, next_top - start

@@ -267,6 +267,79 @@ def make_epoch_traffic(state, spec, head_root, *, aggregates_per_committee=2,
     }
 
 
+class _NewValidator:
+    """Attribute bag matching the `Validator` container surface the
+    registry's append() reads — the churn helper's deposit shape."""
+
+    __slots__ = ("pubkey", "withdrawal_credentials", "effective_balance",
+                 "slashed", "activation_eligibility_epoch",
+                 "activation_epoch", "exit_epoch", "withdrawable_epoch")
+
+    def __init__(self, pubkey, epoch):
+        self.pubkey = pubkey
+        self.withdrawal_credentials = b"\x00" * 32
+        self.effective_balance = MAX_EB
+        self.slashed = False
+        self.activation_eligibility_epoch = epoch
+        self.activation_epoch = epoch
+        self.exit_epoch = FAR
+        self.withdrawable_epoch = FAR
+
+
+def churn_registry(state, spec, *, epoch, exits=0, deposits=0,
+                   pubkey_pool=None, seed=0):
+    """Epoch-to-epoch validator churn on a scaled state: mark `exits`
+    active validators exited AT `epoch` (they leave the active set for
+    `epoch` onward — `is_active_validator` is activation <= e < exit)
+    and append `deposits` fresh validators activated at `epoch`.
+
+    This is the soak's continuation seam: churned registries re-shuffle
+    every later epoch's committees, grow the chain's
+    `ValidatorPubkeyCache` (the `_import_new_pubkeys` path), and make
+    exited validators' `bls.PK_CACHE` limb entries stale (the
+    `rekey_for_churn` path).  Registry-tracking sidecar lists
+    (balances, Altair participation / inactivity scores) are extended in
+    step so epoch processing stays consistent.  Spec churn limits are
+    deliberately NOT modeled — the rig synthesizes the post-churn
+    registry directly, as `make_scaled_state` does at boot.
+
+    Returns (exited_indices, new_index_range)."""
+    rng = np.random.default_rng(seed)
+    reg = state.validators
+    n = len(reg)
+    active = np.flatnonzero(
+        (reg.activation_epoch[:n] <= np.uint64(epoch))
+        & (reg.exit_epoch[:n] > np.uint64(epoch))
+    )
+    exits = int(min(exits, max(len(active) - 1, 0)))
+    exited = (
+        np.sort(rng.choice(active, size=exits, replace=False))
+        if exits else np.empty(0, np.int64)
+    )
+    for i in exited:
+        i = int(i)
+        reg.exit_epoch[i] = epoch
+        reg.withdrawable_epoch[i] = epoch + getattr(
+            spec, "min_validator_withdrawability_delay", 256
+        )
+        reg.dirty.add(i)
+    if exits:
+        reg.rev += 1
+
+    if pubkey_pool is None:
+        pubkey_pool = make_pubkey_pool(16)
+    new_start = n
+    for j in range(int(deposits)):
+        pk = bytes(pubkey_pool[(n + j) % len(pubkey_pool)])
+        reg.append(_NewValidator(pk, int(epoch)))
+        state.balances.append(MAX_EB)
+        if hasattr(state, "inactivity_scores"):
+            state.inactivity_scores.append(0)
+            state.previous_epoch_participation.append(0)
+            state.current_epoch_participation.append(0)
+    return [int(i) for i in exited], range(new_start, len(reg))
+
+
 def build_full_block(state, spec, participation=0.99, seed=1):
     """An unsigned full-load block for the state's current slot: one
     attestation per committee of the previous slot, full bits — the

@@ -309,6 +309,124 @@ def snapshot() -> dict:
     return {name: fp.state() for name, fp in fps}
 
 
+# ------------------------------------------------------- phased schedules
+
+
+def parse_schedule(text):
+    """Parse a phased fault schedule:
+
+        "1:remote.rpc=error(0.4),remote.serve=delay(10);2-3:store.put=delay(2)"
+
+    -> [{"start": 1, "end": 1, "points": {"remote.rpc": "error(0.4)",
+         "remote.serve": "delay(10)"}}, ...]
+
+    A phase is ``<window>:<name>=<spec>[,<name>=<spec>...]``; the window
+    is one phase unit (``2``) or an inclusive range (``2-4``), in
+    whatever unit the driver advances with (the soak uses epoch
+    indices).  Phases are ``;``-separated and may overlap — later
+    phases override earlier ones for the units they share.  Every
+    window, name, and spec is validated BEFORE anything is returned
+    (the configure-time analogue of the _load_env contract: a typo'd
+    storm must fail loudly, not arm a partial or empty one)."""
+    phases = []
+    for part in str(text).split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        window, sep, body = part.partition(":")
+        if not sep or not body.strip():
+            raise ValueError(f"malformed schedule phase {part!r} "
+                             "(want '<unit>[-<unit>]:<name>=<spec>,...')")
+        window = window.strip()
+        lo, dash, hi = window.partition("-")
+        try:
+            start = int(lo)
+            end = int(hi) if dash else start
+        except ValueError:
+            raise ValueError(
+                f"non-integer schedule window {window!r}") from None
+        if start < 0 or end < start:
+            raise ValueError(f"bad schedule window {window!r}")
+        points = {}
+        for entry in body.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            if "=" not in entry:
+                raise ValueError(f"malformed schedule entry {entry!r}")
+            name, spec = entry.split("=", 1)
+            name, spec = name.strip(), spec.strip()
+            with _REG_LOCK:
+                known = name in _REG
+            if not known:
+                raise ValueError(f"unknown failpoint {name!r} in schedule")
+            parse_spec(spec)
+            points[name] = spec
+        if not points:
+            raise ValueError(f"empty schedule phase {part!r}")
+        phases.append({"start": start, "end": end, "points": points})
+    return phases
+
+
+class PhaseSchedule:
+    """Time-windowed fault storms: arm failpoints only while the driver
+    is inside a phase's window, and DISARM them on the way out — so a
+    soak asserts recovery after the storm, not just survival during it.
+
+    The driver owns the clock: call ``enter(unit)`` once per unit
+    (epoch, round, ...); failpoints armed by a previous ``enter`` whose
+    window no longer covers ``unit`` are configured off.  With a
+    ``seed``, ``seed_all`` runs at construction so the whole scheduled
+    storm replays deterministically (the LTPU_FAILPOINTS_SEED
+    contract)."""
+
+    def __init__(self, phases, seed=None):
+        if isinstance(phases, str):
+            phases = parse_schedule(phases)
+        self.phases = list(phases)
+        self.unit = None
+        self._armed = {}        # name -> spec armed by this schedule
+        if seed is not None:
+            seed_all(seed)
+
+    def settings_at(self, unit):
+        """Merged {name: spec} active at `unit` (later phases win)."""
+        out = {}
+        for ph in self.phases:
+            if ph["start"] <= unit <= ph["end"]:
+                out.update(ph["points"])
+        return out
+
+    def enter(self, unit):
+        """Advance the schedule clock to `unit`: arm the phases covering
+        it, disarm what this schedule armed that no longer applies.
+        Returns the active {name: spec} map."""
+        want = self.settings_at(unit)
+        for name in list(self._armed):
+            if name not in want:
+                configure(name, "off")
+                del self._armed[name]
+        for name, spec in want.items():
+            if self._armed.get(name) != spec:
+                configure(name, spec)
+                self._armed[name] = spec
+        self.unit = unit
+        if want:
+            log.info("failpoint schedule unit %s: %s", unit, want)
+        return dict(want)
+
+    def exit(self):
+        """Disarm everything this schedule armed (end of the run)."""
+        for name in list(self._armed):
+            configure(name, "off")
+        self._armed.clear()
+        self.unit = None
+
+    def describe(self):
+        """JSON-shaped view of the schedule (bench artifacts / docs)."""
+        return [dict(ph, points=dict(ph["points"])) for ph in self.phases]
+
+
 # ------------------------------------------------------- well-known sites
 # Declared here so the GET route lists every site even before its module
 # is imported; the wiring lives at the sites themselves.
@@ -334,6 +452,9 @@ declare("remote.serve",
 declare("remote.verdict_corrupt",
         "remote verify response verdict bitmap, pre-send (corrupt "
         "flips verdicts — the byzantine-verifier injection)")
+declare("backfill.replay",
+        "historical backfill replay loop (testing/soak BackfillRacer, "
+        "per backfill batch)")
 
 
 def _load_env():

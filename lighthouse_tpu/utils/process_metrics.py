@@ -1,0 +1,89 @@
+"""Process-level leak observability: RSS + per-structure depth gauges.
+
+The multi-epoch soak's flat-RSS gate needs two things a one-epoch bench
+never did: the CURRENT resident set (not the `getrusage` high-water
+mark, which can only ever grow and therefore can't show a flat line),
+and per-structure depths so a drift attributes to the accumulator that
+caused it instead of a bisection session.  Every structure the PR 1-12
+stack accumulates into long-term is sampled here:
+
+    op_pool_entries    aggregation-tier entries (operation_pool/pool.py)
+    pk_cache           PubkeyLimbCache keys (crypto/tpu/bls.PK_CACHE)
+    pubkey_cache       chain ValidatorPubkeyCache points (append-only)
+    tracing_ring       finished traces buffered (utils/tracing)
+    profile_registry   (kernel, shape, topology) keys (crypto/tpu/profile)
+    block_times_cache  roots tracked by the chain BlockTimesCache
+
+`sample(chain)` refreshes the gauges AND returns the values, so the
+soak gate and the `/metrics` scrape read the same numbers — no
+shelling out to `ps`.
+"""
+
+import os
+
+from . import metrics
+
+RSS = metrics.gauge(
+    "lighthouse_process_rss_bytes",
+    "Current resident set size of this process (/proc/self/statm; "
+    "falls back to the getrusage peak where /proc is unavailable)",
+)
+
+DEPTH = metrics.gauge(
+    "lighthouse_structure_depth",
+    "Entries held by leak-prone long-lived structures (operation pool, "
+    "pubkey caches, tracing ring, profile registry, block-times cache) "
+    "— the attribution surface behind the flat-RSS soak gate",
+    labels=("structure",),
+)
+
+_PAGE = 4096
+try:
+    _PAGE = os.sysconf("SC_PAGE_SIZE")
+except (AttributeError, ValueError, OSError):
+    pass
+
+
+def read_rss_bytes():
+    """Current RSS in bytes.  /proc/self/statm field 2 is resident
+    pages; non-Linux hosts degrade to the getrusage peak (documented in
+    the gauge help — a peak can gate "never grew past X" but not
+    "returned to baseline")."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * _PAGE
+    except (OSError, ValueError, IndexError):
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+
+def structure_depths(chain=None):
+    """{structure: entry count} for every tracked accumulator.  The
+    process-wide structures are always present; chain-owned ones need
+    the `chain` argument (the soak and `/metrics` both pass it)."""
+    from ..crypto.tpu import bls as tb
+    from ..crypto.tpu.profile import get_registry
+    from . import tracing
+
+    depths = {
+        "pk_cache": len(tb.PK_CACHE),
+        "tracing_ring": tracing.depth(),
+        "profile_registry": get_registry().key_count(),
+    }
+    if chain is not None:
+        depths["op_pool_entries"] = chain.op_pool.aggregation.stats()["entries"]
+        depths["pubkey_cache"] = len(chain.pubkey_cache)
+        depths["block_times_cache"] = len(chain.block_times_cache)
+    return depths
+
+
+def sample(chain=None):
+    """Refresh the RSS + depth gauges; returns
+    {"rss_bytes": ..., "depths": {...}} (the soak's per-epoch record)."""
+    rss = read_rss_bytes()
+    RSS.set(rss)
+    depths = structure_depths(chain)
+    for name, v in depths.items():
+        DEPTH.with_labels(name).set(v)
+    return {"rss_bytes": rss, "depths": depths}

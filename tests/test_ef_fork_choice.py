@@ -1,0 +1,584 @@
+"""EF consensus-spec-tests `fork_choice` handler runner.
+
+Point ``LTPU_EF_TESTS_DIR`` at an extracted consensus-spec-tests release
+(as for tests/test_ef_vectors.py) and this module sweeps every
+``fork_choice`` case for the forks this repo models (phase0, altair):
+the anchor state/block boot a `ForkChoice`, then ``steps.yaml`` drives
+ticks, block imports (through the real state-transition with signature
+verification), attestations, and attester slashings, checking head
+root/slot, store checkpoints, and proposer boost after every ``checks``
+step.  ``valid: false`` steps must be rejected without poisoning the
+store.
+
+steps.yaml is parsed with a small YAML-subset reader (block maps/lists,
+inline flow maps, quoted scalars — the shapes the EF generator emits),
+so no pyyaml dependency; ``*.ssz_snappy`` payloads decode through the
+repo's own `network/snappy`.
+
+When the env var is unset the EF sweep skips cleanly; synthetic
+self-tests generate miniature vector trees (real interop-signed blocks,
+a vote-driven reorg, an equivocation slashing, a `valid: false` future
+block) in tmp_path so tier-1 always exercises the parser → decoder →
+fork-choice pipeline itself, including its ability to DETECT a wrong
+expectation.
+"""
+
+import os
+
+import pytest
+
+from lighthouse_tpu.fork_choice.fork_choice import ForkChoice, ForkChoiceError
+from lighthouse_tpu.network import snappy
+from lighthouse_tpu.ssz import decode, encode, hash_tree_root
+from lighthouse_tpu.state_processing import phase0
+from lighthouse_tpu.state_processing.phase0 import (
+    BlockSignatureStrategy,
+    per_block_processing,
+    process_slots,
+)
+from lighthouse_tpu.testing.harness import Harness
+from lighthouse_tpu.types import ChainSpec, MainnetPreset, MinimalPreset
+from lighthouse_tpu.types import containers as C
+from lighthouse_tpu.types.state import state_types
+
+EF_DIR = os.environ.get("LTPU_EF_TESTS_DIR")
+
+_PRESETS = {"mainnet": MainnetPreset, "minimal": MinimalPreset}
+# fork -> (type suffix, ChainSpec kwargs); execution-fork handlers need
+# an engine in the STF and are counted as skips for now
+_FORK_SPECS = {
+    "phase0": ("", {}),
+    "altair": ("Altair", {"altair_fork_epoch": 0}),
+}
+
+
+# --------------------------------------------------- YAML subset reader
+
+
+def _parse_flow(s, i):
+    """Inline flow map: ``{slot: 3, root: '0x..'}`` (possibly nested)."""
+    assert s[i] == "{", s
+    out, i = {}, i + 1
+    while True:
+        while i < len(s) and s[i] in " ,":
+            i += 1
+        if s[i] == "}":
+            return out, i + 1
+        j = s.index(":", i)
+        key = s[i:j].strip().strip("'\"")
+        i = j + 1
+        while s[i] == " ":
+            i += 1
+        if s[i] == "{":
+            out[key], i = _parse_flow(s, i)
+        elif s[i] in "'\"":
+            q = s[i]
+            j = s.index(q, i + 1)
+            out[key] = s[i + 1 : j]
+            i = j + 1
+        else:
+            j = i
+            while j < len(s) and s[j] not in ",}":
+                j += 1
+            out[key] = _scalar(s[i:j])
+            i = j
+
+
+def _scalar(tok):
+    tok = tok.strip()
+    if not tok or tok in ("null", "~"):
+        return None
+    if tok[0] == "{":
+        return _parse_flow(tok, 0)[0]
+    if tok[0] in "'\"":
+        return tok[1:-1]
+    if tok.lower() == "true":
+        return True
+    if tok.lower() == "false":
+        return False
+    try:
+        return int(tok)
+    except ValueError:
+        return tok
+
+
+def parse_yaml(text):
+    """Block-style lists/maps by indentation + the scalars above — the
+    subset the EF steps.yaml/meta.yaml files use."""
+    lines = []
+    for raw in text.splitlines():
+        if not raw.strip() or raw.lstrip().startswith("#"):
+            continue
+        lines.append((len(raw) - len(raw.lstrip(" ")), raw.strip()))
+    if not lines:
+        return None
+    return _parse_node(lines, 0)[0]
+
+
+def _parse_node(lines, i):
+    if lines[i][1].startswith("- ") or lines[i][1] == "-":
+        return _parse_list(lines, i, lines[i][0])
+    return _parse_map(lines, i, lines[i][0])
+
+
+def _parse_map(lines, i, indent):
+    out = {}
+    while (
+        i < len(lines)
+        and lines[i][0] == indent
+        and not lines[i][1].startswith("- ")
+    ):
+        key, _, rest = lines[i][1].partition(":")
+        key = key.strip().strip("'\"")
+        rest = rest.strip()
+        i += 1
+        if rest:
+            out[key] = _scalar(rest)
+        elif i < len(lines) and lines[i][0] > indent:
+            out[key], i = _parse_node(lines, i)
+        else:
+            out[key] = None
+    return out, i
+
+
+def _parse_list(lines, i, indent):
+    out = []
+    while i < len(lines) and lines[i][0] == indent and lines[i][1].startswith("-"):
+        content = lines[i][1][1:].strip()
+        if not content:
+            i += 1
+            val, i = _parse_node(lines, i)
+            out.append(val)
+        elif ":" in content and content[0] not in "'\"{[":
+            # "- key: value" item; remaining keys sit one level deeper
+            key, _, rest = content.partition(":")
+            item, rest = {}, rest.strip()
+            k = key.strip().strip("'\"")
+            i += 1
+            if rest:
+                item[k] = _scalar(rest)
+            elif i < len(lines) and lines[i][0] > indent:
+                item[k], i = _parse_node(lines, i)
+            else:
+                item[k] = None
+            if (
+                i < len(lines)
+                and lines[i][0] > indent
+                and not lines[i][1].startswith("- ")
+            ):
+                more, i = _parse_map(lines, i, lines[i][0])
+                item.update(more)
+            out.append(item)
+        else:
+            out.append(_scalar(content))
+            i += 1
+    return out, i
+
+
+# ------------------------------------------------------------ the runner
+
+
+def _hex(b):
+    return "0x" + bytes(b).hex()
+
+
+class ForkChoiceCaseRunner:
+    """One EF fork_choice case: anchor boot + step interpreter.
+
+    Blocks run through the full state transition (`process_slots` +
+    `per_block_processing`, signatures verified) before reaching
+    `ForkChoice.on_block` — the EF vectors' invalid-block cases cover
+    both STF and fork-choice rejections, and both must leave the store
+    untouched (all mutation happens on a copy, committed only on
+    success)."""
+
+    def __init__(self, spec, anchor_state, anchor_block,
+                 strategy=BlockSignatureStrategy.VERIFY_BULK):
+        self.spec = spec
+        self.preset = spec.preset
+        self.strategy = strategy
+        self.genesis_time = int(anchor_state.genesis_time)
+        root = bytes(hash_tree_root(anchor_block))
+        self.fc = ForkChoice.from_anchor(anchor_state, root, self.preset)
+        self.states = {root: anchor_state.copy()}
+        self.time = (
+            self.genesis_time + int(anchor_state.slot) * spec.seconds_per_slot
+        )
+
+    def tick(self, t):
+        self.time = int(t)
+        self.fc.on_tick(
+            (self.time - self.genesis_time) // self.spec.seconds_per_slot
+        )
+
+    def block(self, signed):
+        parent = self.states.get(bytes(signed.message.parent_root))
+        if parent is None:
+            raise ForkChoiceError("unknown parent state")
+        state = parent.copy()
+        if int(state.slot) < int(signed.message.slot):
+            state = process_slots(
+                state, int(signed.message.slot), self.preset, spec=self.spec
+            )
+        per_block_processing(
+            state, signed, self.spec, signature_strategy=self.strategy
+        )
+        if bytes(signed.message.state_root) != bytes(hash_tree_root(state)):
+            raise ForkChoiceError("block state root mismatch")
+        root = bytes(hash_tree_root(signed.message))
+        self.fc.on_block(
+            self.fc.store.current_slot, signed.message, root, state
+        )
+        self.states[root] = state
+
+    def attestation(self, att):
+        # committees come from a state of the attested branch (no churn
+        # inside a case, so any stored state of the epoch agrees)
+        ref = self.states.get(bytes(att.data.beacon_block_root))
+        if ref is None:
+            ref = next(iter(self.states.values()))
+        indexed = phase0.get_indexed_attestation(ref, att, self.preset)
+        self.fc.on_attestation(self.fc.store.current_slot, indexed)
+
+    def attester_slashing(self, slashing):
+        self.fc.on_attester_slashing(slashing)
+
+    def checks(self, want):
+        """Compare the store against a ``checks`` map; returns mismatch
+        strings (empty = pass) and the count of unsupported check keys."""
+        bad, skipped = [], 0
+        for key, val in want.items():
+            if key == "time":
+                if int(val) != self.time:
+                    bad.append(f"time: want {val}, got {self.time}")
+            elif key == "genesis_time":
+                if int(val) != self.genesis_time:
+                    bad.append(f"genesis_time: want {val}")
+            elif key == "head":
+                root = bytes(self.fc.get_head())
+                node = self.fc.proto.nodes[self.fc.proto.indices[root]]
+                if "root" in val and val["root"] != _hex(root):
+                    bad.append(f"head root: want {val['root']}, got {_hex(root)}")
+                if "slot" in val and int(val["slot"]) != int(node.slot):
+                    bad.append(f"head slot: want {val['slot']}, got {node.slot}")
+            elif key in ("justified_checkpoint", "finalized_checkpoint"):
+                epoch, root = (
+                    self.fc.store.justified_checkpoint
+                    if key == "justified_checkpoint"
+                    else self.fc.store.finalized_checkpoint
+                )
+                if int(val["epoch"]) != epoch or val["root"] != _hex(root):
+                    bad.append(
+                        f"{key}: want ({val['epoch']}, {val['root']}), "
+                        f"got ({epoch}, {_hex(root)})"
+                    )
+            elif key == "proposer_boost_root":
+                got = self.fc.store.proposer_boost_root or bytes(32)
+                if val != _hex(got):
+                    bad.append(f"proposer_boost_root: want {val}, got {_hex(got)}")
+            else:
+                skipped += 1  # e.g. viable_for_head_roots_and_weights
+        return bad, skipped
+
+
+def _read_ssz(case_dir, name, cls):
+    with open(os.path.join(case_dir, name + ".ssz_snappy"), "rb") as f:
+        return decode(cls, snappy.decompress(f.read()))
+
+
+def run_case(config, fork, case_dir):
+    """Returns (mismatches, skipped_steps) for one case directory."""
+    preset = _PRESETS[config]
+    suffix, spec_kwargs = _FORK_SPECS[fork]
+    spec = ChainSpec(preset=preset, **spec_kwargs)
+    T = state_types(preset)
+
+    anchor_state = _read_ssz(case_dir, "anchor_state",
+                             getattr(T, "BeaconState" + suffix))
+    anchor_block = _read_ssz(case_dir, "anchor_block",
+                             getattr(T, "BeaconBlock" + suffix))
+    with open(os.path.join(case_dir, "steps.yaml")) as f:
+        steps = parse_yaml(f.read())
+
+    runner = ForkChoiceCaseRunner(spec, anchor_state, anchor_block)
+    signed_cls = getattr(T, "SignedBeaconBlock" + suffix)
+    bad, skipped = [], 0
+    for n, step in enumerate(steps):
+        label = f"{case_dir} step {n}"
+        if "checks" in step:
+            b, s = runner.checks(step["checks"])
+            bad += [f"{label}: {m}" for m in b]
+            skipped += s
+        elif "tick" in step:
+            runner.tick(step["tick"])
+        elif "block" in step or "attestation" in step or \
+                "attester_slashing" in step:
+            kind = next(k for k in
+                        ("block", "attestation", "attester_slashing")
+                        if k in step)
+            obj = _read_ssz(case_dir, step[kind], {
+                "block": signed_cls,
+                "attestation": T.Attestation,
+                "attester_slashing": C.AttesterSlashing,
+            }[kind])
+            want_valid = step.get("valid", True)
+            try:
+                getattr(runner, kind)(obj)
+                ok = True
+            except Exception:  # noqa: BLE001 — STF and fork-choice rejects
+                ok = False
+            if ok != want_valid:
+                bad.append(f"{label}: {kind} valid={ok}, want {want_valid}")
+        else:
+            skipped += 1  # pow_block / payload_status / override steps
+    return bad, skipped
+
+
+def iter_cases(root_dir):
+    for dirpath, _dirnames, filenames in os.walk(root_dir):
+        if "steps.yaml" not in filenames or "anchor_state.ssz_snappy" not in filenames:
+            continue
+        parts = dirpath.replace(os.sep, "/").split("/")
+        if "fork_choice" not in parts:
+            continue
+        config = next((p for p in parts if p in _PRESETS), None)
+        fork = next((p for p in parts if p in _FORK_SPECS), None)
+        if config is None:
+            continue
+        yield config, fork, dirpath
+
+
+def sweep(root_dir):
+    ran, skipped, failures = 0, 0, []
+    for config, fork, case_dir in iter_cases(root_dir):
+        if fork is None:
+            skipped += 1   # execution forks: STF needs an engine here
+            continue
+        try:
+            bad, _ = run_case(config, fork, case_dir)
+            failures += bad
+            ran += 1
+        except Exception as e:  # noqa: BLE001 — collect, report together
+            failures.append(f"{case_dir}: {e}")
+    return ran, skipped, failures
+
+
+@pytest.mark.skipif(
+    not EF_DIR, reason="LTPU_EF_TESTS_DIR not set (EF vectors absent)"
+)
+@pytest.mark.slow
+def test_ef_fork_choice_sweep():
+    ran, skipped, failures = sweep(EF_DIR)
+    assert not failures, "\n".join(failures[:20])
+    assert ran > 0, f"no runnable fork_choice cases under {EF_DIR}"
+
+
+# ------------------------------------------- synthetic self-test (tier-1)
+
+SPEC = ChainSpec(preset=MinimalPreset)
+T = state_types(MinimalPreset)
+SPD = SPEC.seconds_per_slot
+
+
+def _write_ssz(case_dir, name, value):
+    with open(os.path.join(case_dir, name + ".ssz_snappy"), "wb") as f:
+        f.write(snappy.compress(bytes(encode(type(value), value))))
+
+
+def _emit_steps(steps):
+    """Serialize the generator's step list in the EF block style the
+    parser consumes (mixed '- key: value' items, nested checks maps,
+    inline flow maps for roots — the real release's shapes)."""
+    lines = []
+    for step in steps:
+        first = True
+        for key, val in step.items():
+            prefix = "- " if first else "  "
+            first = False
+            if isinstance(val, dict):
+                lines.append(f"{prefix}{key}:")
+                for ck, cv in val.items():
+                    if isinstance(cv, dict):
+                        inner = ", ".join(
+                            f"{k}: {v!r}" if isinstance(v, str) else f"{k}: {v}"
+                            for k, v in cv.items()
+                        )
+                        lines.append(f"    {ck}: {{{inner}}}")
+                    elif isinstance(cv, str):
+                        lines.append(f"    {ck}: '{cv}'")
+                    else:
+                        lines.append(f"    {ck}: {cv}")
+            elif isinstance(val, bool):
+                lines.append(f"{prefix}{key}: {str(val).lower()}")
+            elif isinstance(val, str):
+                lines.append(f"{prefix}{key}: {val}")
+            else:
+                lines.append(f"{prefix}{key}: {val}")
+    return "\n".join(lines) + "\n"
+
+
+def _genesis_anchor(h):
+    state = h.state.copy()
+    block = T.BeaconBlock(
+        slot=0, proposer_index=0, parent_root=bytes(32),
+        state_root=hash_tree_root(state), body=T.BeaconBlockBody(),
+    )
+    return state, block
+
+
+def _build_reorg_case(base, corrupt_final_head=False):
+    """A five-block story: linear growth, a two-branch race where the
+    later block wins by proposer boost, votes flip it back, and an
+    attester slashing zeroes the voters (tie-break head).  Returns the
+    case dir; with `corrupt_final_head` the last expectation is wrong
+    (the self-test for mismatch DETECTION)."""
+    name = "corrupted" if corrupt_final_head else "reorg"
+    case_dir = os.path.join(
+        base, "tests", "minimal", "phase0", "fork_choice", "get_head",
+        "pyspec_tests", name,
+    )
+    os.makedirs(case_dir)
+
+    h = Harness(8, SPEC)
+    anchor_state, anchor_block = _genesis_anchor(h)
+    anchor_root = bytes(hash_tree_root(anchor_block))
+    _write_ssz(case_dir, "anchor_state", anchor_state)
+    _write_ssz(case_dir, "anchor_block", anchor_block)
+
+    steps = [
+        {"checks": {"head": {"slot": 0, "root": _hex(anchor_root)},
+                    "genesis_time": 0}},
+    ]
+
+    def emit_block(signed, valid=True):
+        fname = "block_" + _hex(hash_tree_root(signed.message))[:18]
+        _write_ssz(case_dir, fname, signed)
+        step = {"block": fname}
+        if not valid:
+            step["valid"] = False
+        steps.append(step)
+
+    # slot 1: linear head advance
+    steps.append({"tick": 1 * SPD})
+    b1 = h.produce_block(1)
+    b1_root = h.process_block(b1)
+    state1 = h.state.copy()
+    emit_block(b1)
+    steps.append({"checks": {
+        "head": {"slot": 1, "root": _hex(b1_root)},
+        "time": 1 * SPD,
+        "proposer_boost_root": _hex(b1_root),
+    }})
+
+    # branch A: slot 2 on b1;  branch B: slot 3 on b1 (skips slot 2, so
+    # a different proposer — arrives in its own slot and takes the boost)
+    b2 = h.produce_block(2)
+    b2_root = h.process_block(b2)
+    state2 = h.state.copy()
+    h.state = state1.copy()
+    b3 = h.produce_block(3)
+    b3_root = h.process_block(b3)
+
+    steps.append({"tick": 3 * SPD})
+    emit_block(b2)
+    emit_block(b3)
+    steps.append({"checks": {"head": {"slot": 3, "root": _hex(b3_root)}}})
+
+    # a full committee votes branch A at slot 3; the vote queues for one
+    # slot, the boost dies at the tick, and the head flips to b2
+    h.state = state2.copy()
+    atts = h.attest_slot(state2, 3, b2_root)
+    voters = sorted(
+        int(i) for i in phase0.get_attesting_indices(
+            state2, atts[0].data, atts[0].aggregation_bits, SPEC.preset
+        )
+    )
+    for k, att in enumerate(atts):
+        fname = f"attestation_{k}_" + _hex(hash_tree_root(att.data))[:14]
+        _write_ssz(case_dir, fname, att)
+        steps.append({"attestation": fname})
+    steps.append({"tick": 4 * SPD})
+    steps.append({"checks": {
+        "head": {"slot": 2, "root": _hex(b2_root)},
+        "proposer_boost_root": _hex(bytes(32)),
+        # from_anchor seeds the store justified checkpoint AT the anchor
+        # (weak-subjectivity semantics) and nothing has advanced it
+        "justified_checkpoint": {"epoch": 0, "root": _hex(anchor_root)},
+    }})
+
+    # a future-slot block must be rejected and leave the store untouched
+    h.state = state2.copy()
+    b6 = h.produce_block(6)
+    emit_block(b6, valid=False)
+    steps.append({"checks": {"head": {"slot": 2, "root": _hex(b2_root)}}})
+
+    # slash the branch-A voters: their standing votes zero out and the
+    # b2/b3 sibling race falls back to the higher-root tie-break
+    slashing = h.make_attester_slashing(voters)
+    _write_ssz(case_dir, "attester_slashing_votersA", slashing)
+    steps.append({"attester_slashing": "attester_slashing_votersA"})
+    tie_winner = max(
+        (b2_root, 2), (b3_root, 3), key=lambda rs: bytes(rs[0])
+    )
+    if corrupt_final_head:
+        tie_winner = (b"\xde\xad" + bytes(30), 2)
+    steps.append({"checks": {
+        "head": {"slot": tie_winner[1], "root": _hex(tie_winner[0])},
+    }})
+
+    with open(os.path.join(case_dir, "steps.yaml"), "w") as f:
+        f.write(_emit_steps(steps))
+    return case_dir
+
+
+def test_yaml_subset_reader():
+    text = (
+        "# comment\n"
+        "- {tick: 0}\n"
+        "- checks:\n"
+        "    time: 12\n"
+        "    head: {slot: 1, root: '0xaa', deep: {x: 2}}\n"
+        "- block: block_0xbeef\n"
+        "  valid: false\n"
+        "- attestation: att_0\n"
+    )
+    steps = parse_yaml(text)
+    assert steps[0] == {"tick": 0}
+    assert steps[1]["checks"]["time"] == 12
+    assert steps[1]["checks"]["head"] == {
+        "slot": 1, "root": "0xaa", "deep": {"x": 2},
+    }
+    assert steps[2] == {"block": "block_0xbeef", "valid": False}
+    assert steps[3] == {"attestation": "att_0"}
+
+
+def test_runner_on_synthetic_fork_choice_vectors(tmp_path):
+    base = str(tmp_path)
+    _build_reorg_case(base)
+    ran, skipped, failures = sweep(base)
+    assert (ran, failures) == (1, []), failures
+
+    # the runner must also CATCH a wrong expectation, not just pass
+    _build_reorg_case(base, corrupt_final_head=True)
+    ran, _skipped, failures = sweep(base)
+    assert ran == 2 and len(failures) == 1
+    assert "head root" in failures[0]
+
+
+def test_fork_choice_case_dir_discovery(tmp_path):
+    # non-fork_choice dirs with the same files must not be swept up
+    d = os.path.join(str(tmp_path), "tests", "minimal", "phase0",
+                     "other_handler", "case_0")
+    os.makedirs(d)
+    for fname in ("steps.yaml", "anchor_state.ssz_snappy"):
+        with open(os.path.join(d, fname), "wb") as f:
+            f.write(b"")
+    assert list(iter_cases(str(tmp_path))) == []
+    # execution-fork cases are counted as skips, not failures
+    d2 = os.path.join(str(tmp_path), "tests", "mainnet", "bellatrix",
+                      "fork_choice", "on_merge_block", "case_0")
+    os.makedirs(d2)
+    for fname in ("steps.yaml", "anchor_state.ssz_snappy"):
+        with open(os.path.join(d2, fname), "wb") as f:
+            f.write(b"")
+    assert sweep(str(tmp_path)) == (0, 1, [])

@@ -1,0 +1,499 @@
+#!/usr/bin/env python
+"""Multi-epoch adversarial soak: churn, reorgs, and backfill racing live
+import under sustained load (the ROADMAP robustness deliverable).
+
+Extends the scale rig (tools/scale_bench.py: one synthetic epoch against
+a frozen head) into EPOCH-TO-EPOCH CONTINUATION: every slot produces and
+imports a real block on the scaled state, every epoch synthesizes a full
+gossip load (aggregates, singles, sync messages) and pushes it through
+the real path — gossip gates → BeaconProcessor batches → verify_service
+(remote pool first tier) → aggregation tier → head recompute — while the
+adversarial machinery runs:
+
+  * validator churn between epochs (deposits + exits re-keying
+    `ValidatorPubkeyCache` and invalidating `bls.PK_CACHE` limbs);
+  * forced reorgs mid-epoch (late competing block + committee votes
+    flipping the head through fork choice);
+  * a checkpoint-synced second node backfilling history on a worker
+    thread while live blocks feed it concurrently (final epoch), with a
+    payload-pruned `BlockReplayer` reconstruction check;
+  * a PHASED failpoint schedule (`utils/failpoints.parse_schedule`)
+    arming fault storms per epoch — e.g. a remote-verifier flap in epoch
+    1 that must recover, not merely be survived.
+
+Hard gates (the JSON carries a ``gates`` map; the process exits 1 when
+any fails):
+
+  * ``zero_lost_verdicts``   — every enqueued message resolves;
+  * ``rss_flat``             — final-epoch RSS within --rss-tolerance
+                               (default 10%) of the epoch-1 baseline;
+  * ``head_stall_budget``    — no slot's produce+import+head latency
+                               exceeded --stall-budget seconds;
+  * ``reorgs_survived``      — every scheduled reorg actually flipped
+                               the head (>= 2 by default);
+  * ``backfill_replay``      — the raced checkpoint node's replayed
+                               window matches the live chain's stored
+                               state root byte-for-byte;
+  * ``state_root_vs_control``— the post-soak head state root is
+                               byte-identical to a NO-FAULT control
+                               replay with the same seeds.
+
+Signatures are valid G2 curve points but not signatures over the
+messages (fake backend, as in every scale rig); state transitions,
+state roots, fork choice, and the store races are fully real.
+
+Usage:
+    python tools/soak_bench.py [--validators 2048] [--epochs 3]
+        [--schedule "1:remote.rpc=error(0.5);2:backfill.replay=delay(5)"]
+        [--json BENCH_SOAK.json]
+"""
+
+import argparse
+import gc
+import json
+import os
+import sys
+import time
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_SCHEDULE = (
+    "3:remote.rpc=error(0.5);"
+    "5:backfill.replay=delay(5),verify.dispatch=delay(1)"
+)
+
+
+def _drain(processor):
+    while processor.process_pending():
+        pass
+
+
+def _chunks(items, size):
+    for i in range(0, len(items), size):
+        yield items[i : i + size]
+
+
+def _bucket_by_slot(traffic):
+    """Per-slot feed order: the epoch's synthetic traffic, delivered at
+    the slot it attests (scale_bench feeds a whole epoch at once; the
+    soak's clock actually advances)."""
+    aggs, atts, syncs = {}, {}, {}
+    for sa in traffic["aggregates"]:
+        aggs.setdefault(int(sa.message.aggregate.data.slot), []).append(sa)
+    for a in traffic["attestations"]:
+        atts.setdefault(int(a.data.slot), []).append(a)
+    for m in traffic["sync_messages"]:
+        syncs.setdefault(int(m.slot), []).append(m)
+    return aggs, atts, syncs
+
+
+def _warmup(args, spec, state, pubkey_pool, sig_pool):
+    """One epoch of soak-shaped work on a DISPOSABLE chain built from a
+    copy of the anchor: fills the process-wide warm-up costs (allocator
+    arenas, jit/dispatch caches, committee caches, tracing ring) before
+    the measured epochs, so the flat-RSS gate compares steady state to
+    steady state instead of to a cold interpreter."""
+    from lighthouse_tpu.beacon.beacon_processor import BeaconProcessor
+    from lighthouse_tpu.beacon.chain import BeaconChain
+    from lighthouse_tpu.crypto.backend import SignatureVerifier
+    from lighthouse_tpu.testing import scale, soak
+
+    spe = spec.preset.slots_per_epoch
+    chain = BeaconChain(state.copy(), spec, verifier=SignatureVerifier("fake"))
+    processor = BeaconProcessor(chain)
+    traffic = scale.make_epoch_traffic(
+        chain.head_state, spec, bytes(chain.head_root), seed=args.seed,
+        sig_pool=sig_pool,
+        aggregates_per_committee=args.aggs_per_committee,
+        singles_per_committee=args.singles_per_committee,
+    )
+    start = int(chain.head_state.slot)
+    for slot in range(start + 1, start + spe):
+        chain.on_tick(slot)
+        chain.process_block(soak.produce_block(chain, slot, sig_pool, si=slot))
+        chain.recompute_head()
+    for sa in traffic["aggregates"]:
+        processor.enqueue_aggregate(sa)
+    for a in traffic["attestations"]:
+        processor.enqueue_attestation(a)
+    _drain(processor)
+    processor.results.clear()
+    for chunk in _chunks(traffic["sync_messages"], 2048):
+        chain.submit_sync_messages(chunk).resolve()
+    soak.apply_churn(
+        chain, epoch=args.anchor_epoch + 1, exits=args.churn_exits,
+        deposits=args.churn_deposits, pubkey_pool=pubkey_pool,
+        seed=args.seed,
+    )
+    gc.collect()
+
+
+def run_soak(args, schedule_text, *, with_racer=True, warmup=True):
+    """One full soak run; `schedule_text=None` is the no-fault control
+    replay (same seeds, same churn/reorg/traffic — only the fault
+    schedule and the side-band backfill racer differ, neither of which
+    touches main-chain state)."""
+    from lighthouse_tpu.beacon.beacon_processor import BeaconProcessor
+    from lighthouse_tpu.beacon.chain import BeaconChain
+    from lighthouse_tpu.crypto.backend import SignatureVerifier
+    from lighthouse_tpu.ssz import hash_tree_root
+    from lighthouse_tpu.testing import scale, soak
+    from lighthouse_tpu.types import ChainSpec, MinimalPreset
+    from lighthouse_tpu.utils import failpoints, process_metrics
+    from lighthouse_tpu.verify_service import VerificationService
+    from lighthouse_tpu.verify_service.remote import (
+        InProcessTransport,
+        RemoteVerifierPool,
+    )
+
+    spec = ChainSpec(preset=MinimalPreset, altair_fork_epoch=0)
+    preset = spec.preset
+    spe = preset.slots_per_epoch
+
+    t0 = time.monotonic()
+    pubkey_pool = scale.make_pubkey_pool(args.pubkey_pool)
+    sig_pool = scale.make_signature_pool(args.sig_pool)
+    state = scale.make_scaled_state(
+        args.validators, spec, epoch=args.anchor_epoch, seed=args.seed,
+        pubkey_pool=pubkey_pool, fork="altair",
+    )
+    soak.pin_anchor_checkpoints(state, preset)
+    build_seconds = time.monotonic() - t0
+
+    if warmup:
+        _warmup(args, spec, state, pubkey_pool, sig_pool)
+
+    def remote_backend(sets, priority, deadline_s):
+        return [True] * len(sets), 0.0
+
+    pool = RemoteVerifierPool(
+        ["soak-remote"],
+        InProcessTransport({"soak-remote": remote_backend}),
+        audit_rate=0.0,
+    )
+    service = VerificationService(SignatureVerifier("fake"), remote_pool=pool)
+    chain = BeaconChain(state, spec, verifier=service)
+    processor = BeaconProcessor(chain)
+
+    schedule = (
+        failpoints.PhaseSchedule(schedule_text, seed=args.seed)
+        if schedule_text else None
+    )
+
+    # reorg plan: one mid-epoch flip per epoch after the first (>= 2
+    # forced reorgs at the default --epochs 3)
+    reorg_slots = {
+        (args.anchor_epoch + e) * spe + args.reorg_offset
+        for e in range(1, args.epochs)
+    }
+
+    by_kind, accepted, reasons = Counter(), Counter(), Counter()
+
+    def _harvest():
+        while processor.results:
+            kind, ok, err = processor.results.popleft()
+            by_kind[kind] += 1
+            if ok:
+                accepted[kind] += 1
+            else:
+                reasons[str(err)[:60]] += 1
+
+    def _feed(aggs, atts, syncs):
+        enqueued = {"aggregate": 0, "attestation": 0, "sync": 0}
+        resolved_sync = 0
+        for chunk in _chunks(aggs, 2048):
+            for sa in chunk:
+                processor.enqueue_aggregate(sa)
+            enqueued["aggregate"] += len(chunk)
+            _drain(processor)
+            _harvest()
+        for chunk in _chunks(atts, 8192):
+            for a in chunk:
+                processor.enqueue_attestation(a)
+            enqueued["attestation"] += len(chunk)
+            _drain(processor)
+            _harvest()
+        for chunk in _chunks(syncs, 2048):
+            enqueued["sync"] += len(chunk)
+            resolved_sync += len(chain.submit_sync_messages(chunk).resolve())
+        return enqueued, resolved_sync
+
+    def _import_slot(slot, si):
+        """Produce + import + head recompute for one slot; returns the
+        wall-clock latency of the whole advance (the stall metric)."""
+        t = time.monotonic()
+        chain.on_tick(slot)
+        blk = soak.produce_block(
+            chain, slot, sig_pool, si=si, pack_pool=chain.op_pool
+        )
+        root = chain.process_block(blk)
+        chain.recompute_head()
+        dt = time.monotonic() - t
+        if chain.head_root != root:
+            raise RuntimeError(f"head did not advance to slot-{slot} block")
+        return blk, root, dt
+
+    epochs_out = []
+    reorgs_survived = 0
+    max_stall = 0.0
+    total_enqueued = Counter()
+    total_resolved = Counter()
+    racer = None
+    racer_results = []
+    imported_blocks = 0
+
+    t_soak = time.monotonic()
+    for e in range(args.epochs):
+        if schedule is not None:
+            schedule.enter(e)
+        abs_epoch = args.anchor_epoch + e
+        epoch_start = abs_epoch * spe
+        e_lost_before = dict(by_kind)
+
+        # the last --racer-epochs epochs each run a backfill racer:
+        # checkpoint-sync a fresh node off the CURRENT head, backfill
+        # history on a thread, and feed it every live block below.  One
+        # racer per epoch (not one total) keeps the checkpoint node's
+        # allocator footprint inside the steady-state RSS baseline —
+        # and races the store three times instead of once.
+        if with_racer and e >= args.epochs - args.racer_epochs:
+            racer = soak.BackfillRacer(chain, chain.head_state.copy())
+            racer.start()
+
+        # first slot of the epoch (the anchor already occupies the
+        # anchor epoch's start slot)
+        first_slots = []
+        if int(chain.head_state.slot) < epoch_start:
+            first_slots.append(epoch_start)
+        for slot in first_slots:
+            blk, root, dt = _import_slot(slot, si=slot)
+            max_stall = max(max_stall, dt)
+            imported_blocks += 1
+            if racer is not None:
+                racer.feed(blk, slot)
+
+        traffic = scale.make_epoch_traffic(
+            chain.head_state, spec, bytes(chain.head_root),
+            seed=args.seed + e, sig_pool=sig_pool,
+            aggregates_per_committee=args.aggs_per_committee,
+            singles_per_committee=args.singles_per_committee,
+        )
+        aggs_by, atts_by, syncs_by = _bucket_by_slot(traffic)
+        enq = Counter()
+        res_sync = 0
+
+        # traffic attesting the epoch-start slot lands immediately
+        enq0, rs0 = _feed(
+            aggs_by.get(epoch_start, []), atts_by.get(epoch_start, []),
+            syncs_by.get(epoch_start, []),
+        )
+        enq.update(enq0)
+        res_sync += rs0
+
+        for slot in range(epoch_start + 1, epoch_start + spe):
+            if slot in reorg_slots:
+                old, new = soak.force_reorg(
+                    chain, sig_pool, si=slot, pack_pool=chain.op_pool
+                )
+                if new != old:
+                    reorgs_survived += 1
+                imported_blocks += 1
+                if racer is not None:
+                    fork_blk = chain.store.get_block(new)
+                    racer.feed(fork_blk, slot)
+            else:
+                blk, root, dt = _import_slot(slot, si=slot)
+                max_stall = max(max_stall, dt)
+                imported_blocks += 1
+                if racer is not None:
+                    racer.feed(blk, slot)
+            enq_s, rs = _feed(
+                aggs_by.get(slot, []), atts_by.get(slot, []),
+                syncs_by.get(slot, []),
+            )
+            enq.update(enq_s)
+            res_sync += rs
+
+        chain.op_pool.flush("epoch_end")
+        if racer is not None:
+            racer_results.append(racer.finish())
+            racer = None
+
+        # churn between epochs: exits + deposits re-keying the pubkey
+        # caches and re-shuffling later committees.  Never applied after
+        # the final epoch — the control-replay root comparison and the
+        # racer's STF replay both pin the unchurned final state.
+        churn = None
+        if e < args.epochs - 1:
+            churn = soak.apply_churn(
+                chain, epoch=abs_epoch + 1, exits=args.churn_exits,
+                deposits=args.churn_deposits, pubkey_pool=pubkey_pool,
+                seed=args.seed + e,
+            )
+
+        _harvest()
+        resolved = {
+            "aggregate": by_kind["aggregate"] - e_lost_before.get("aggregate", 0),
+            "attestation": by_kind["attestation"]
+            - e_lost_before.get("attestation", 0),
+            "sync": res_sync,
+        }
+        total_enqueued.update(enq)
+        total_resolved.update(resolved)
+        gc.collect()    # sample live heap, not collectible garbage
+        sampled = process_metrics.sample(chain)
+        epochs_out.append({
+            "epoch": abs_epoch,
+            "head_slot": int(chain.head_state.slot),
+            "enqueued": dict(enq),
+            "resolved": resolved,
+            "lost": sum(enq.values()) - sum(resolved.values()),
+            "rss_bytes": sampled["rss_bytes"],
+            "depths": sampled["depths"],
+            "churn": (
+                {"exited": len(churn["exited"]),
+                 "deposited": churn["deposited"],
+                 "limbs_dropped": churn["limbs_dropped"]}
+                if churn else None
+            ),
+        })
+    soak_seconds = time.monotonic() - t_soak
+
+    if schedule is not None:
+        schedule.exit()
+    head_state_root = hash_tree_root(chain.head_state)
+    tier = chain.op_pool.aggregation.stats()
+    service.stop()
+
+    lost = sum(total_enqueued.values()) - sum(total_resolved.values())
+    return {
+        "epochs": epochs_out,
+        "soak_seconds": round(soak_seconds, 2),
+        "build_seconds": round(build_seconds, 2),
+        "imported_blocks": imported_blocks,
+        "reorgs_survived": reorgs_survived,
+        "max_head_stall_s": round(max_stall, 3),
+        "lost_verdicts": lost,
+        "top_reject_reasons": dict(reasons.most_common(5)),
+        "backfill": {
+            "races": len(racer_results),
+            "backfilled": sum(r["backfilled"] for r in racer_results),
+            "live_fed": sum(r["live_fed"] for r in racer_results),
+            "history_replayed": sum(
+                r["history_replayed"] for r in racer_results
+            ),
+            "all_replays_match_live": bool(racer_results) and all(
+                r["replay_root_matches_live"] for r in racer_results
+            ),
+        } if racer_results else None,
+        "head_slot": int(chain.head_state.slot),
+        "head_state_root": head_state_root.hex(),
+        "aggregation": tier,
+    }
+
+
+def run(args):
+    fault = run_soak(args, args.schedule, with_racer=True)
+    control = run_soak(args, None, with_racer=False, warmup=False)
+
+    rss_by_epoch = [e["rss_bytes"] for e in fault["epochs"]]
+    # RSS baseline: the first STEADY-STATE epoch.  The chain needs ~3
+    # epochs of on-chain participation before finality starts advancing
+    # and _prune_finalized caps the hot-state set; comparing against a
+    # pre-finality ramp epoch would gate allocator warm-up + the
+    # unavoidable finalized-to-head state window, not leaks.
+    base_idx = min(args.rss_baseline_epoch, len(rss_by_epoch) - 1)
+    baseline = rss_by_epoch[base_idx]
+    final = rss_by_epoch[-1]
+    gates = {
+        "zero_lost_verdicts": fault["lost_verdicts"] == 0,
+        "rss_flat": final <= baseline * (1.0 + args.rss_tolerance),
+        "head_stall_budget": fault["max_head_stall_s"] <= args.stall_budget,
+        "reorgs_survived": fault["reorgs_survived"] >= min(2, args.epochs - 1),
+        "backfill_replay": bool(
+            fault["backfill"]
+            and fault["backfill"]["all_replays_match_live"]
+        ),
+        "state_root_vs_control": (
+            fault["head_state_root"] == control["head_state_root"]
+        ),
+    }
+    return {
+        "n_validators": args.validators,
+        "epochs": args.epochs,
+        "backend": "fake",
+        "platform": os.environ.get("JAX_PLATFORMS", ""),
+        "schedule": args.schedule,
+        "per_epoch_rss_bytes": rss_by_epoch,
+        "rss_baseline_epoch": base_idx,
+        "rss_growth_pct": round((final - baseline) / baseline * 100.0, 2),
+        "lost_verdicts": fault["lost_verdicts"],
+        "max_head_stall_s": fault["max_head_stall_s"],
+        "stall_budget_s": args.stall_budget,
+        "reorgs_survived": fault["reorgs_survived"],
+        "imported_blocks": fault["imported_blocks"],
+        "backfill": fault["backfill"],
+        "soak_seconds": fault["soak_seconds"],
+        "control_seconds": control["soak_seconds"],
+        "head_state_root": fault["head_state_root"],
+        "control_state_root": control["head_state_root"],
+        "per_epoch": fault["epochs"],
+        "top_reject_reasons": fault["top_reject_reasons"],
+        "gates": gates,
+        "gates_passed": all(gates.values()),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--validators", type=int, default=2048)
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--anchor-epoch", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--schedule", default=DEFAULT_SCHEDULE)
+    ap.add_argument("--stall-budget", type=float, default=10.0,
+                    help="max seconds a single slot's produce+import+head "
+                         "advance may take")
+    ap.add_argument("--rss-tolerance", type=float, default=0.10,
+                    help="allowed fractional RSS growth, final epoch vs "
+                         "the steady-state baseline epoch")
+    ap.add_argument("--rss-baseline-epoch", type=int, default=3,
+                    help="epoch index (0-based) whose RSS is the flatness "
+                         "baseline — the first epoch after finality "
+                         "starts pruning hot states")
+    ap.add_argument("--reorg-offset", type=int, default=4,
+                    help="slot offset inside each reorg epoch")
+    ap.add_argument("--racer-epochs", type=int, default=3,
+                    help="run the backfill-vs-live racer in each of the "
+                         "last N epochs")
+    ap.add_argument("--churn-exits", type=int, default=8)
+    ap.add_argument("--churn-deposits", type=int, default=8)
+    ap.add_argument("--aggs-per-committee", type=int, default=1)
+    ap.add_argument("--singles-per-committee", type=int, default=1)
+    ap.add_argument("--pubkey-pool", type=int, default=64)
+    ap.add_argument("--sig-pool", type=int, default=128)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args(argv)
+
+    # mesh/device inventory header (bench.py parses only the LAST line)
+    try:
+        from lighthouse_tpu.crypto.tpu import sharding
+
+        mesh = sharding.get_mesh_plan().describe()
+        mesh.pop("launches", None)
+    except Exception as e:  # noqa: BLE001 — provenance, not correctness
+        mesh = {"error": str(e)[:120]}
+    print(json.dumps({"header": "mesh", "mesh": mesh}), flush=True)
+
+    out = run(args)
+    line = json.dumps(out)
+    print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(line + "\n")
+    return 0 if out["gates_passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
