@@ -1216,8 +1216,11 @@ def warm():
 def _lint_preflight():
     """Invariant lint BEFORE any bench lane burns kernel time: a
     discipline regression (a plain jit site, logging under a lock, an
-    unwaivered thread spawn) fails fast here instead of surfacing as a
-    mystery perf cliff an hour in.  BENCH_NO_LINT=1 bypasses."""
+    unwaivered thread spawn, a guarded-state race) fails fast here
+    instead of surfacing as a mystery perf cliff an hour in —
+    run_analysis() covers the package-scope rules too, so the
+    cross-file race detector rides the same gate.  BENCH_NO_LINT=1
+    bypasses."""
     if os.environ.get("BENCH_NO_LINT"):
         return
     from lighthouse_tpu import analysis

@@ -123,6 +123,12 @@ class AggregationTier:
             os.environ.get("LTPU_AGG_FLUSH_THRESHOLD", "1024")
         )
         self._last_flush = time.monotonic()
+        # lockset checker (LTPU_RACE_WITNESS=1; no-op otherwise): all
+        # entry/pending mutations must hold the entry lock — the
+        # dynamic complement of the PR-11 flush fix (snapshot under
+        # lock, launch outside, commit under lock)
+        locks.guarded(self, "entries", "aggregation.entries")
+        locks.guarded(self, "pending", "aggregation.entries")
 
     # ------------------------------------------------------------ insert
 
@@ -135,6 +141,8 @@ class AggregationTier:
         bits = bits_of(attestation.aggregation_bits)
         sig = bytes(attestation.signature)
         with self._lock:
+            locks.access(self, "entries", "write")
+            locks.access(self, "pending", "write")
             self.inserts += 1
             for entry in self.entries[key]:
                 if not np.bitwise_and(entry["bits"], bits).any():
@@ -184,6 +192,8 @@ class AggregationTier:
         with self._flush_lock:
             # -- snapshot (entry lock held, O(pending) bookkeeping only)
             with self._lock:
+                locks.access(self, "entries", "read")
+                locks.access(self, "pending", "read")
                 if not self.pending:
                     self._last_flush = time.monotonic()
                     return 0
@@ -211,6 +221,8 @@ class AggregationTier:
 
             # -- commit (entry lock re-held)
             with self._lock:
+                locks.access(self, "entries", "write")
+                locks.access(self, "pending", "write")
                 pos = 0
                 dropped = 0
                 for seg, (key, entry, k) in enumerate(work):
@@ -311,6 +323,8 @@ class AggregationTier:
         """Drop entries that can no longer be included; pending counts
         follow the surviving contributions."""
         with self._lock:
+            locks.access(self, "entries", "write")
+            locks.access(self, "pending", "write")
             for key in list(self.entries):
                 kept = [
                     e
@@ -335,6 +349,7 @@ class AggregationTier:
         round-trips pending-unflushed state exactly (restore re-inserts,
         and the bits-only grouping rule reproduces the entries)."""
         with self._lock:
+            locks.access(self, "entries", "read")
             for entries in self.entries.values():
                 for entry in entries:
                     for b, sig in entry["contribs"]:

@@ -28,6 +28,7 @@ from .core import (  # noqa: F401
     Rule,
     all_rules,
     analyze_source,
+    analyze_sources,
     default_waivers_path,
     format_report,
     load_waivers,

@@ -27,12 +27,18 @@ PACKAGE_ROOT = Path(__file__).resolve().parent.parent
 
 
 class Finding:
-    """One rule violation at one source location (machine-readable)."""
+    """One rule violation at one source location (machine-readable).
+
+    ``guard``/``roots`` are set only by package-scope rules: the
+    inferred lock a racy access should have held, and the pair of
+    concurrency roots that can race on it — so ``--json`` consumers can
+    triage a race without re-deriving the cross-file evidence."""
 
     __slots__ = ("rule", "path", "line", "col", "message", "snippet",
-                 "waived", "justification")
+                 "waived", "justification", "guard", "roots")
 
-    def __init__(self, rule, path, line, col, message, snippet=""):
+    def __init__(self, rule, path, line, col, message, snippet="",
+                 guard=None, roots=None):
         self.rule = rule
         self.path = str(path)
         self.line = int(line)
@@ -41,6 +47,8 @@ class Finding:
         self.snippet = snippet
         self.waived = False
         self.justification = None
+        self.guard = guard
+        self.roots = list(roots) if roots else None
 
     def to_dict(self):
         return {
@@ -52,6 +60,8 @@ class Finding:
             "snippet": self.snippet,
             "waived": self.waived,
             "justification": self.justification,
+            "guard": self.guard,
+            "roots": self.roots,
         }
 
     def __repr__(self):
@@ -62,15 +72,24 @@ class Finding:
 class Rule:
     """Base plugin: subclass, set ``name``/``description``, implement
     ``check(tree, path, lines)`` yielding Findings.  ``applies_to``
-    scopes the rule (default: every package file)."""
+    scopes the rule (default: every package file).
+
+    Rules with ``package_scope = True`` run in pass 2 instead: they
+    implement ``check_package(index)`` and receive the whole-package
+    ``PackageIndex`` (symbol table, call graph, concurrency roots)
+    built from every tree pass 1 already parsed."""
 
     name = "abstract"
     description = ""
+    package_scope = False
 
     def applies_to(self, relpath):
         return True
 
     def check(self, tree, relpath, lines):
+        raise NotImplementedError
+
+    def check_package(self, index):
         raise NotImplementedError
 
     # ---- helpers shared by the concrete rules
@@ -231,12 +250,15 @@ def run_analysis(root=None, rules=None, waivers_path=None):
     if rules is not None:
         waiver_list = [w for w in waiver_list if w["rule"] in selected]
 
+    file_rules = [r for r in selected.values() if not r.package_scope]
+    pkg_rules = [r for r in selected.values() if r.package_scope]
+
+    # pass 1: parse each file exactly once; per-file rules run on the
+    # tree immediately, and the same tree feeds the package index
     findings = []
+    indexed = []
     for path in _iter_files(root):
         rel = path.relative_to(root).as_posix()
-        applicable = [r for r in selected.values() if r.applies_to(rel)]
-        if not applicable:
-            continue
         source = path.read_text()
         try:
             tree = ast.parse(source, filename=str(path))
@@ -247,8 +269,19 @@ def run_analysis(root=None, rules=None, waivers_path=None):
             ))
             continue
         lines = source.splitlines()
-        for rule in applicable:
-            findings.extend(rule.check(tree, rel, lines))
+        for rule in file_rules:
+            if rule.applies_to(rel):
+                findings.extend(rule.check(tree, rel, lines))
+        if pkg_rules and any(r.applies_to(rel) for r in pkg_rules):
+            indexed.append((rel, tree, lines))
+
+    # pass 2: whole-package rules see the cross-file index
+    if pkg_rules:
+        from . import index as index_mod
+        pkg_index = index_mod.build_index(indexed)
+        for rule in pkg_rules:
+            findings.extend(rule.check_package(pkg_index))
+
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return _settle(findings, waiver_list, waiver_errors, wpath)
 
@@ -256,10 +289,27 @@ def run_analysis(root=None, rules=None, waivers_path=None):
 def analyze_source(source, rule_name, relpath="synthetic.py"):
     """Run ONE rule over a source string — the unit-test seam: each
     rule's tests feed a synthetic violation and assert it's flagged
-    without touching the real tree or the ledger."""
+    without touching the real tree or the ledger.  Package-scope rules
+    are routed through a one-file package automatically."""
     rule = all_rules()[rule_name]
+    if rule.package_scope:
+        return analyze_sources({relpath: source}, rule_name)
     tree = ast.parse(source)
     return list(rule.check(tree, relpath, source.splitlines()))
+
+
+def analyze_sources(sources, rule_name):
+    """Run ONE package-scope rule over a synthetic multi-file package:
+    ``sources`` maps relpath -> source text.  The cross-file seam the
+    race-detector fixtures use (spawn in one module, write in another)."""
+    from . import index as index_mod
+    rule = all_rules()[rule_name]
+    modules = [
+        (rel, ast.parse(src), src.splitlines())
+        for rel, src in sorted(sources.items())
+    ]
+    pkg_index = index_mod.build_index(modules)
+    return list(rule.check_package(pkg_index))
 
 
 def format_report(report, root=None):

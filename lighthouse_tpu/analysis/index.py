@@ -1,0 +1,280 @@
+"""Whole-package index pass: symbol table, call graph, concurrency roots.
+
+PR 11's rules are per-file — they can say *what happens inside a lock*
+but not *whether shared state is locked at all*, because that question
+spans files: the writer lives in one module, the thread that makes the
+write racy is spawned in another.  This module is the first pass of the
+two-pass analysis: every parsed tree is folded into one ``PackageIndex``
+holding
+
+- **symbols**: per module, the classes (with their methods) and
+  module-level functions, each keyed by a qualified name
+  ``relpath::Class.method`` / ``relpath::func``
+- **a lightweight call graph**: edges resolved conservatively —
+  ``self.m()`` to the same class, bare ``f()`` to the same module, and
+  ``alias.f()`` through the module's import table (``from . import x``,
+  ``import a.b as c``).  Unresolvable receivers contribute no edge:
+  the graph under-approximates, so reachability findings never rest on
+  a guessed edge.
+- **concurrency roots**: the places a second thread of control enters
+  the package — ``threading.Thread(target=...)`` spawns, executor
+  ``spawn``/``submit`` calls, watchdog ``restart_*`` generation hooks,
+  and timer/heartbeat loop methods.  Each root names the function it
+  runs, so "reachable from two roots" is a BFS, not a guess.
+
+The index is pure stdlib-ast bookkeeping; rules that declare
+``package_scope = True`` receive it (plus the per-file lines for
+snippets) instead of a single tree.
+"""
+
+import ast
+import re
+
+# method-name patterns that are themselves thread entry points even
+# without a visible Thread(...) spawn: watchdog generation-restart hooks
+# run on the watchdog thread, timer/heartbeat loops on their own
+_ROOT_METHOD = re.compile(r"^restart_|(_loop|_heartbeat|heartbeat_loop|"
+                          r"timer_loop)$")
+# executor/submit spellings that hand their first argument to a worker
+_SPAWN_CALLS = {"spawn", "submit", "run_in_thread", "call_soon_threadsafe"}
+
+
+class FunctionInfo:
+    """One function or method: where it is, what it calls, how it
+    accesses state (attribute/global reads+writes are filled in by the
+    guarded-state rule's visitor, which walks with lock context)."""
+
+    __slots__ = ("qualname", "module", "cls", "name", "node", "calls")
+
+    def __init__(self, qualname, module, cls, name, node):
+        self.qualname = qualname
+        self.module = module
+        self.cls = cls            # class name or None for module funcs
+        self.name = name
+        self.node = node
+        self.calls = []           # raw (receiver, callee_name) pairs
+
+    def __repr__(self):
+        return f"<fn {self.qualname}>"
+
+
+class Root:
+    """One concurrency root: a place a new thread of control starts,
+    and the function it runs."""
+
+    __slots__ = ("root_id", "target", "kind", "module", "line")
+
+    def __init__(self, root_id, target, kind, module, line):
+        self.root_id = root_id    # human-readable "module:kind@line"
+        self.target = target      # qualname of the function it runs
+        self.kind = kind          # thread | executor | watchdog | loop
+        self.module = module
+        self.line = line
+
+    def __repr__(self):
+        return f"<root {self.root_id} -> {self.target}>"
+
+
+class PackageIndex:
+    """The product of pass 1 over every parsed module."""
+
+    def __init__(self):
+        self.functions = {}       # qualname -> FunctionInfo
+        self.classes = {}         # (module, cls) -> {method name}
+        self.module_funcs = {}    # module -> {name -> qualname}
+        self.imports = {}         # module -> {alias -> module relpath guess}
+        self.roots = []           # [Root]
+        self.trees = {}           # module -> (tree, lines)
+        self._reach = None        # qualname -> {root_id} (lazy)
+
+    # ------------------------------------------------------------ build
+
+    def add_module(self, relpath, tree, lines):
+        self.trees[relpath] = (tree, lines)
+        self.module_funcs.setdefault(relpath, {})
+        imports = self.imports.setdefault(relpath, {})
+        for node in tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    imports[a.asname or a.name.split(".")[0]] = \
+                        a.name.replace(".", "/") + ".py"
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    # `from . import failpoints` / `from ..utils import x`
+                    # — resolve RELATIVE to this module's directory, one
+                    # package level per extra dot
+                    if node.level:
+                        parts = relpath.split("/")[:-1]
+                        up = node.level - 1
+                        base = parts[: len(parts) - up] if up else parts
+                        mod = "/".join(
+                            base + ([node.module.replace(".", "/")]
+                                    if node.module else [])
+                        )
+                    else:
+                        mod = (node.module or "").replace(".", "/")
+                    imports[a.asname or a.name] = (
+                        (mod + "/" if mod else "") + a.name + ".py"
+                    )
+        self._index_scope(relpath, None, tree.body)
+        self._find_roots(relpath, tree)
+
+    def _index_scope(self, module, cls, body):
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                self.classes[(module, node.name)] = {
+                    n.name for n in node.body
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                }
+                self._index_scope(module, node.name, node.body)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = (f"{module}::{cls}.{node.name}" if cls
+                        else f"{module}::{node.name}")
+                fi = FunctionInfo(qual, module, cls, node.name, node)
+                self.functions[qual] = fi
+                if cls is None:
+                    self.module_funcs[module][node.name] = qual
+                for call in ast.walk(node):
+                    if isinstance(call, ast.Call):
+                        fi.calls.append(_call_edge(call))
+
+    # ------------------------------------------------------------- roots
+
+    def _find_roots(self, module, tree):
+        # roots come in two shapes: explicit spawn CALLS anywhere in the
+        # module, and root-shaped METHOD NAMES (restart hooks, loops)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                self._root_from_call(module, node)
+        for qual, fi in list(self.functions.items()):
+            if fi.module != module or fi.cls is None:
+                continue
+            if _ROOT_METHOD.search(fi.name):
+                kind = ("watchdog" if fi.name.startswith("restart_")
+                        else "loop")
+                self.roots.append(Root(
+                    f"{module}:{kind}:{fi.cls}.{fi.name}",
+                    qual, kind, module, fi.node.lineno,
+                ))
+
+    def _root_from_call(self, module, call):
+        callee = _terminal_name(call.func)
+        target_expr = None
+        kind = None
+        if callee == "Thread":
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    target_expr = kw.value
+            kind = "thread"
+        elif callee in _SPAWN_CALLS and call.args:
+            target_expr = call.args[0]
+            kind = "executor"
+        if target_expr is None:
+            return
+        target = self._resolve_target(module, target_expr)
+        if target is None:
+            return
+        self.roots.append(Root(
+            f"{module}:{kind}@{call.lineno}", target, kind, module,
+            call.lineno,
+        ))
+
+    def _resolve_target(self, module, expr):
+        """`target=self._loop` -> the enclosing module's Class._loop if
+        exactly one class defines it; `target=func` -> module func."""
+        if isinstance(expr, ast.Attribute):
+            name = expr.attr
+            owners = [
+                cls for (mod, cls), methods in self.classes.items()
+                if mod == module and name in methods
+            ]
+            if len(owners) == 1:
+                return f"{module}::{owners[0]}.{name}"
+            return None
+        if isinstance(expr, ast.Name):
+            return self.module_funcs.get(module, {}).get(expr.id)
+        return None
+
+    # ------------------------------------------------------ reachability
+
+    def resolve_call(self, caller, receiver, callee):
+        """One conservative edge: self-method, module function, or an
+        imported module's function.  None when unresolvable."""
+        if callee is None:
+            return None
+        if receiver == "self" and caller.cls is not None:
+            if callee in self.classes.get((caller.module, caller.cls), ()):
+                return f"{caller.module}::{caller.cls}.{callee}"
+            return None
+        if receiver is None:
+            return self.module_funcs.get(caller.module, {}).get(callee)
+        target_mod = self.imports.get(caller.module, {}).get(receiver)
+        if target_mod:
+            return self.module_funcs.get(target_mod, {}).get(callee)
+        return None
+
+    def reachable_roots(self):
+        """{qualname -> set(root_id)}: which concurrency roots reach
+        each function through the (under-approximate) call graph."""
+        if self._reach is not None:
+            return self._reach
+        # a name-based root (loop/watchdog heuristic) that targets the
+        # same function as an explicit spawn is the SAME thread seen
+        # twice — drop it so one thread never counts as two racing roots
+        spawned = {r.target for r in self.roots
+                   if r.kind in ("thread", "executor")}
+        live_roots = [r for r in self.roots
+                      if r.kind in ("thread", "executor")
+                      or r.target not in spawned]
+        succ = {}
+        for qual, fi in self.functions.items():
+            edges = set()
+            for receiver, callee in fi.calls:
+                tgt = self.resolve_call(fi, receiver, callee)
+                if tgt is not None:
+                    edges.add(tgt)
+            succ[qual] = edges
+        reach = {qual: set() for qual in self.functions}
+        for root in live_roots:
+            if root.target not in reach:
+                continue
+            stack = [root.target]
+            while stack:
+                q = stack.pop()
+                if root.root_id in reach[q]:
+                    continue
+                reach[q].add(root.root_id)
+                stack.extend(succ.get(q, ()))
+        self._reach = reach
+        return reach
+
+
+def _terminal_name(fn):
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _call_edge(call):
+    """(receiver, callee) of one Call: `self.m()` -> ("self", "m"),
+    `f()` -> (None, "f"), `mod.f()` -> ("mod", "f"), else (?, None)."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return (None, fn.id)
+    if isinstance(fn, ast.Attribute):
+        obj = fn.value
+        if isinstance(obj, ast.Name):
+            return (obj.id, fn.attr)
+        if isinstance(obj, ast.Attribute):
+            return (obj.attr, fn.attr)
+    return (None, None)
+
+
+def build_index(modules):
+    """modules: iterable of (relpath, tree, lines) -> PackageIndex."""
+    idx = PackageIndex()
+    for relpath, tree, lines in modules:
+        idx.add_module(relpath, tree, lines)
+    return idx

@@ -6,6 +6,7 @@ with no further wiring.
 """
 
 from . import (  # noqa: F401
+    guarded_state,
     jit_discipline,
     lock_discipline,
     metric_registration,

@@ -1,0 +1,373 @@
+"""guarded-state: cross-file lockset lint — infer guards, flag races.
+
+The per-file rules check what happens *inside* a lock; this one checks
+whether shared state is locked *at all*.  Two steps over the package
+index:
+
+1. **Guard inference.**  For every class, each mutable ``self._*``
+   attribute's non-``__init__`` writes are tallied against the locks
+   held at the write (``with self._lock:`` / ``with self._cv:`` bodies,
+   tracked per statement).  A lock is THE guard of an attribute when at
+   least two writes hold it and a strict majority of writes do — the
+   Eraser candidate-lockset idea, settled statically.  Module-level
+   mutable globals (``_REG = {}``) are inferred the same way against
+   module-level locks.
+
+2. **Race flagging.**  A write to an inferred-guarded attribute without
+   the guard held is a finding — but only when the attribute is
+   reachable from two or more *distinct concurrency roots* (thread
+   spawns, executor submits, watchdog ``restart_*`` hooks, timer/
+   heartbeat loops; code only ever touched by one thread of control
+   cannot race).  A *check-then-act* pair — an unguarded read in an
+   ``if``/``while`` test followed by a guarded write of the same
+   attribute in the same function — is flagged too: taking the lock
+   after the check is the classic TOCTOU shape.  Findings carry the
+   inferred guard and the two racing roots (``Finding.guard`` /
+   ``Finding.roots``) so ``--json`` consumers can triage.
+
+Convention honored: methods named ``*_locked`` assert "caller holds
+the guard" — their accesses are excluded from both inference and
+flagging (the PR-3 dispatcher idiom).  The call graph and root set
+both under-approximate, so a finding always rests on evidence the
+source actually shows; waivers go through the mandatory-justification
+ledger like every other rule.
+"""
+
+import ast
+
+from ..core import Finding, Rule, register_rule
+from .lock_discipline import _LOCK_NAME
+
+# method names that mutate their receiver container in place
+_MUTATORS = {
+    "append", "appendleft", "add", "extend", "insert", "update",
+    "setdefault", "pop", "popleft", "popitem", "remove", "discard",
+    "clear", "rotate", "sort", "reverse",
+}
+# free functions that mutate their FIRST argument (heapq protocol)
+_ARG_MUTATORS = {"heappush", "heappop", "heapreplace", "heappushpop"}
+# constructors whose result is shared-mutable state worth tracking
+_MUTABLE_CTORS = {
+    "dict", "list", "set", "deque", "defaultdict", "OrderedDict",
+    "Counter",
+}
+
+
+class _Access:
+    __slots__ = ("key", "kind", "line", "held", "qual", "module",
+                 "in_test", "is_init", "caller_locked")
+
+    def __init__(self, key, kind, line, held, qual, module, in_test,
+                 is_init, caller_locked):
+        self.key = key            # ("attr", module, cls, name) |
+        self.kind = kind          # ("global", module, name)
+        self.line = line          # "read" | "write"
+        self.held = held
+        self.qual = qual
+        self.module = module
+        self.in_test = in_test
+        self.is_init = is_init
+        self.caller_locked = caller_locked
+
+
+def _guard_name(expr):
+    """Canonical guard name of a with-item, or None if it isn't a
+    lock: ``with self._lock:`` -> "self._lock", ``with _REG_LOCK:`` ->
+    "_REG_LOCK" (``cls.`` folds onto ``self.``)."""
+    node = expr
+    if isinstance(node, ast.Call):
+        node = node.func
+    dotted = Rule.dotted(node)
+    if not dotted:
+        return None
+    last = dotted.rsplit(".", 1)[-1]
+    if not _LOCK_NAME.search(last):
+        return None
+    if dotted.startswith("cls."):
+        dotted = "self." + dotted[len("cls."):]
+    return dotted
+
+
+def _module_globals(tree):
+    """(mutable global names, lock global names) assigned at module
+    top level."""
+    mutable, locks = set(), set()
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        for t in targets:
+            if _LOCK_NAME.search(t.id):
+                locks.add(t.id)
+            elif _is_mutable_ctor(value):
+                mutable.add(t.id)
+    return mutable, locks
+
+
+def _is_mutable_ctor(value):
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Call):
+        name = Rule.call_name(value)
+        return name in _MUTABLE_CTORS
+    return False
+
+
+@register_rule
+class GuardedState(Rule):
+    name = "guarded-state"
+    description = (
+        "a write (or check-then-act pair) reached an inferred-guarded "
+        "attribute or mutable global from two concurrency roots "
+        "without the lock that guards its other writes"
+    )
+    package_scope = True
+
+    def applies_to(self, relpath):
+        return not relpath.startswith("testing/")
+
+    # ------------------------------------------------------------- run
+
+    def check_package(self, index):
+        reach = index.reachable_roots()
+        accesses = []
+        globals_by_mod = {}
+        for module, (tree, _lines) in index.trees.items():
+            globals_by_mod[module] = _module_globals(tree)
+        for fi in index.functions.values():
+            self._collect(fi, globals_by_mod[fi.module][0], accesses)
+
+        by_key = {}
+        for a in accesses:
+            by_key.setdefault(a.key, []).append(a)
+
+        findings = []
+        for key, accs in sorted(by_key.items()):
+            guard = self._infer_guard(accs)
+            if guard is None:
+                continue
+            roots_of = {}
+            for a in accs:
+                if a.is_init:
+                    continue
+                roots_of[a] = frozenset(reach.get(a.qual) or ("<main>",))
+            all_roots = set().union(*roots_of.values()) if roots_of else set()
+            if len(all_roots) < 2:
+                continue
+            findings.extend(self._flag(key, guard, accs, roots_of,
+                                       index, all_roots))
+        return findings
+
+    # ------------------------------------------------------- inference
+
+    def _infer_guard(self, accs):
+        writes = [a for a in accs
+                  if a.kind == "write" and not a.is_init
+                  and not a.caller_locked]
+        if len(writes) < 2:
+            return None
+        tally = {}
+        for w in writes:
+            for g in w.held:
+                tally[g] = tally.get(g, 0) + 1
+        best = max(tally, key=tally.get, default=None)
+        if best is None:
+            return None
+        n = tally[best]
+        if n >= 2 and n * 2 > len(writes):
+            return best
+        return None
+
+    # --------------------------------------------------------- flagging
+
+    def _flag(self, key, guard, accs, roots_of, index, all_roots):
+        findings = []
+        seen = set()
+        label = (f"{key[2]}.{key[3]}" if key[0] == "attr" else key[2])
+        lines = index.trees[key[1]][1]
+        for a in accs:
+            if a.is_init or a.caller_locked or guard in a.held:
+                continue
+            mine = roots_of.get(a, frozenset())
+            # the racing pair: the first root that reaches THIS access,
+            # and the first OTHER root that reaches the attribute
+            r1 = sorted(mine)[0] if mine else "<main>"
+            rest = sorted(all_roots - {r1})
+            pair = [r1, rest[0]]
+            if a.kind == "write":
+                if (a.key, a.line, "write") in seen:
+                    continue
+                seen.add((a.key, a.line, "write"))
+                findings.append(self._race_finding(
+                    key[1], a.line, lines, guard, pair,
+                    f"write to {label} without inferred guard "
+                    f"`{guard}` — other writes hold it; racy between "
+                    f"{pair[0]} and {pair[1]}",
+                ))
+            elif a.in_test:
+                # check-then-act: unguarded read decides, a LATER
+                # guarded write in the same function acts — the lock
+                # taken after the check cannot make the check true
+                acted = any(
+                    w.kind == "write" and w.qual == a.qual
+                    and w.line > a.line and guard in w.held
+                    for w in accs
+                )
+                if not acted or (a.key, a.line, "cta") in seen:
+                    continue
+                seen.add((a.key, a.line, "cta"))
+                findings.append(self._race_finding(
+                    key[1], a.line, lines, guard, pair,
+                    f"check-then-act on {label}: tested without "
+                    f"inferred guard `{guard}`, then written under it "
+                    f"— the check can go stale; racy between "
+                    f"{pair[0]} and {pair[1]}",
+                ))
+        return findings
+
+    def _race_finding(self, relpath, line, lines, guard, roots, message):
+        snippet = ""
+        if 0 < line <= len(lines):
+            snippet = lines[line - 1].strip()[:120]
+        return Finding(self.name, relpath, line, 0, message,
+                       snippet, guard=guard, roots=roots)
+
+    # ------------------------------------------------------- collection
+
+    def _collect(self, fi, mutable_globals, out):
+        """Walk one function with held-lock context, appending _Access
+        records for every self._* / module-global touch."""
+        is_init = fi.cls is not None and fi.name in ("__init__", "__new__")
+        caller_locked = fi.name.endswith("_locked")
+        declared_global = {
+            n for node in ast.walk(fi.node)
+            if isinstance(node, ast.Global) for n in node.names
+        }
+
+        def emit(key, kind, line, held, in_test=False):
+            out.append(_Access(key, kind, line, held, fi.qualname,
+                               fi.module, in_test, is_init,
+                               caller_locked))
+
+        def container_key(node):
+            # unwrap subscripts: mutating `self._queues[fp]` IS
+            # mutating the state `_queues` guards
+            while isinstance(node, ast.Subscript):
+                node = node.value
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in ("self", "cls")
+                    and node.attr.startswith("_")
+                    and not _LOCK_NAME.search(node.attr)):
+                return ("attr", fi.module, fi.cls or "<module>", node.attr)
+            if isinstance(node, ast.Name) and node.id in mutable_globals:
+                return ("global", fi.module, node.id)
+            return None
+
+        def record_writes(target, held):
+            if isinstance(target, (ast.Tuple, ast.List)):
+                for el in target.elts:
+                    record_writes(el, held)
+                return
+            if isinstance(target, ast.Starred):
+                record_writes(target.value, held)
+                return
+            key = None
+            if isinstance(target, ast.Attribute):
+                key = container_key(target)
+            elif isinstance(target, ast.Subscript):
+                key = container_key(target.value)
+            elif isinstance(target, ast.Name):
+                # rebinding a module global only counts with `global X`
+                if target.id in declared_global:
+                    key = container_key(target)
+            if key is not None:
+                emit(key, "write", target.lineno, held)
+
+        def record_expr(expr, held, in_test=False):
+            for node in ast.walk(expr):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                    continue
+                if isinstance(node, ast.Call):
+                    cname = self.call_name(node)
+                    if cname in _MUTATORS and isinstance(
+                            node.func, ast.Attribute):
+                        key = container_key(node.func.value)
+                        if key is not None:
+                            emit(key, "write", node.lineno, held)
+                    elif cname in _ARG_MUTATORS and node.args:
+                        key = container_key(node.args[0])
+                        if key is not None:
+                            emit(key, "write", node.lineno, held)
+                key = container_key(node)
+                if key is not None and isinstance(
+                        getattr(node, "ctx", None), ast.Load):
+                    emit(key, "read", node.lineno, held, in_test=in_test)
+
+        def record_stmt(s, held):
+            if isinstance(s, ast.Assign):
+                for t in s.targets:
+                    record_writes(t, held)
+                record_expr(s.value, held)
+            elif isinstance(s, ast.AugAssign):
+                record_writes(s.target, held)
+                record_expr(s.value, held)
+                key = (container_key(s.target)
+                       if isinstance(s.target, ast.Attribute)
+                       else container_key(getattr(s.target, "value", s.target)
+                                          if isinstance(s.target,
+                                                        ast.Subscript)
+                                          else s.target))
+                if key is not None:
+                    emit(key, "read", s.target.lineno, held)
+            elif isinstance(s, ast.AnnAssign):
+                record_writes(s.target, held)
+                if s.value is not None:
+                    record_expr(s.value, held)
+            elif isinstance(s, ast.Delete):
+                for t in s.targets:
+                    record_writes(t, held)
+            else:
+                for value in ast.iter_child_nodes(s):
+                    if isinstance(value, ast.expr):
+                        record_expr(value, held)
+
+        def walk(stmts, held):
+            for s in stmts:
+                if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                    continue    # nested defs run in another context
+                if isinstance(s, (ast.With, ast.AsyncWith)):
+                    inner = set(held)
+                    for item in s.items:
+                        g = _guard_name(item.context_expr)
+                        if g:
+                            inner.add(g)
+                    walk(s.body, frozenset(inner))
+                elif isinstance(s, (ast.If, ast.While)):
+                    record_expr(s.test, held, in_test=True)
+                    walk(s.body, held)
+                    walk(s.orelse, held)
+                elif isinstance(s, (ast.For, ast.AsyncFor)):
+                    record_expr(s.iter, held)
+                    record_writes(s.target, held)
+                    walk(s.body, held)
+                    walk(s.orelse, held)
+                elif isinstance(s, ast.Try):
+                    walk(s.body, held)
+                    for h in s.handlers:
+                        walk(h.body, held)
+                    walk(s.orelse, held)
+                    walk(s.finalbody, held)
+                else:
+                    record_stmt(s, held)
+
+        walk(fi.node.body, frozenset())

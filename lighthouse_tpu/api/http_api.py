@@ -880,6 +880,15 @@ class _Handler(JsonHandler):
 
             return self._json({"data": ltpu_locks.report()})
 
+        if path == "/lighthouse/races":
+            # Eraser-style lockset checker: registered guarded fields,
+            # their shared/reported state, and any candidate-lockset
+            # violations (enable with LTPU_RACE_WITNESS=1; honest
+            # {"enabled": false} shell otherwise)
+            from ..utils import locks as ltpu_locks
+
+            return self._json({"data": ltpu_locks.race_report()})
+
         if path == "/lighthouse/logs/recent":
             # newest-first structured records from the flight recorder's
             # ring buffer; ?level= filters at-or-above, ?component= exact

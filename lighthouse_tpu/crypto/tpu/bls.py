@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 
 from ...utils import failpoints as _failpoints
+from ...utils import locks as _locks
 from ...utils import metrics as _metrics
 from ...utils import tracing
 from ..constants import P, G1_X, G1_Y, RAND_BITS, DST_POP
@@ -101,9 +102,13 @@ class PubkeyLimbCache:
             capacity = int(_os.environ.get("LTPU_PUBKEY_CACHE_SIZE", "131072"))
         self.capacity = max(1, int(capacity))
         self._entries = OrderedDict()     # key bytes -> (2, NLIMB) int32
-        self._lock = _threading.Lock()
+        # through the witness factory: adopted by the lock-order
+        # witness AND the lockset checker (prep thread + dispatcher +
+        # churn invalidation all mutate the LRU concurrently)
+        self._lock = _locks.lock("bls.pk_cache")
         self.hits = 0
         self.misses = 0
+        _locks.guarded(self, "_entries", "bls.pk_cache")
 
     @staticmethod
     def key_of(pk):
@@ -121,6 +126,7 @@ class PubkeyLimbCache:
         """(2, NLIMB) int32 Montgomery limbs of (x, y), cached."""
         k = self.key_of(pk)
         with self._lock:
+            _locks.access(self, "_entries", "write")
             e = self._entries.get(k)
             if e is not None:
                 self._entries.move_to_end(k)
@@ -130,6 +136,7 @@ class PubkeyLimbCache:
             return e
         e = np.stack([fp.int_to_mont_limbs(pk[0]), fp.int_to_mont_limbs(pk[1])])
         with self._lock:
+            _locks.access(self, "_entries", "write")
             self.misses += 1
             self._entries[k] = e
             while len(self._entries) > self.capacity:
@@ -143,6 +150,7 @@ class PubkeyLimbCache:
 
     def clear(self):
         with self._lock:
+            _locks.access(self, "_entries", "write")
             self._entries.clear()
 
     def invalidate(self, keys):
@@ -154,6 +162,7 @@ class PubkeyLimbCache:
         simply refills on the next miss).  Returns the count dropped."""
         dropped = 0
         with self._lock:
+            _locks.access(self, "_entries", "write")
             for k in keys:
                 if self._entries.pop(bytes(k), None) is not None:
                     dropped += 1

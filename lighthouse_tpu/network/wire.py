@@ -682,6 +682,13 @@ class WireNode:
         self._hb_tick_lock = locks.lock("wire.heartbeat_tick")
         self.heartbeat_restarts = 0
         self.reader_stall_budget = 60.0
+        # lockset checker (LTPU_RACE_WITNESS=1; no-op otherwise): peer
+        # table and pending-request mutations must hold the node lock.
+        # Reads stay lock-free `list(self.peers.values())` snapshots —
+        # only WRITE sites are instrumented, matching the GIL-atomic
+        # read contract documented on the broadcast path.
+        locks.guarded(self, "peers", "wire.node")
+        locks.guarded(self, "_pending", "wire.node")
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True
         )
@@ -820,8 +827,14 @@ class WireNode:
         peer.peer_id = peer_id
         peer.status = status
         peer.listen_addr = (peer.addr[0], listen_port)
-        existing = self.peers.get(peer_id)
-        self.peers[peer_id] = peer
+        # table mutation under the node lock: reader threads, the
+        # accept loop, and the heartbeat reaper all register/evict
+        # concurrently — an unlocked dict put here can drop a racing
+        # eviction (close() runs outside: socket teardown blocks)
+        with self._lock:
+            locks.access(self, "peers", "write")
+            existing = self.peers.get(peer_id)
+            self.peers[peer_id] = peer
         if existing is not None and existing is not peer:
             existing.close()
         self.known_addrs.add(peer.listen_addr)
@@ -922,15 +935,21 @@ class WireNode:
                 log.debug("peer %s dropped: %s", peer.peer_id, e)
         finally:
             peer.close()
-            if self.peers.get(peer.peer_id) is peer:
-                del self.peers[peer.peer_id]
-                self.limiter.forget(peer.peer_id)
-            # fail anything still waiting on this peer
+            # evict + fail under ONE node-lock hold: the check-then-del
+            # on the peer table ran unlocked before, so a reader's
+            # eviction could race _register_peer's put for the same id
             with self._lock:
+                locks.access(self, "peers", "write")
+                evicted = self.peers.get(peer.peer_id) is peer
+                if evicted:
+                    del self.peers[peer.peer_id]
+                locks.access(self, "_pending", "write")
                 for rec in self._pending.values():
                     if rec[3] is peer and not rec[0].is_set():
                         rec[2] = R_SERVER_ERROR
                         rec[0].set()
+            if evicted:
+                self.limiter.forget(peer.peer_id)
 
     # --------------------------------------------------------- dispatch
 
@@ -1104,8 +1123,12 @@ class WireNode:
                 # repeats this cleanup harmlessly when (if) the stuck
                 # dispatch finally returns and the loop exits on _alive
                 peer.close()
-                if self.peers.get(peer.peer_id) is peer:
-                    del self.peers[peer.peer_id]
+                with self._lock:
+                    locks.access(self, "peers", "write")
+                    evicted = self.peers.get(peer.peer_id) is peer
+                    if evicted:
+                        del self.peers[peer.peer_id]
+                if evicted:
                     self.limiter.forget(peer.peer_id)
 
     # mesh-quality thresholds (gossipsub_scoring_parameters.rs role):
@@ -1424,6 +1447,7 @@ class WireNode:
         except failpoints.FailpointError as e:
             raise WireError(f"injected req/resp fault: {e}") from e
         with self._lock:
+            locks.access(self, "_pending", "write")
             self._req_id += 1
             rid = self._req_id
             # [event, chunks, code, peer, per-seq chunk accumulator,
@@ -1446,6 +1470,7 @@ class WireNode:
             return rec[1], rec[2]
         finally:
             with self._lock:
+                locks.access(self, "_pending", "write")
                 self._pending.pop(rid, None)
 
     def _on_request(self, peer, body):
@@ -1822,6 +1847,7 @@ class WireNode:
         if len(payload) > MAX_VERIFY_BODY:
             raise WireError("verify batch exceeds size cap")
         with self._lock:
+            locks.access(self, "_pending", "write")
             self._req_id += 1
             rid = self._req_id
             rec = [threading.Event(), None, None, peer, {}, None, "verify"]
@@ -1837,6 +1863,7 @@ class WireNode:
             return rec[1]
         finally:
             with self._lock:
+                locks.access(self, "_pending", "write")
                 self._pending.pop(rid, None)
 
     # ------------------------------------------------- rpc client calls

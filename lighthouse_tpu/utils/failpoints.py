@@ -206,8 +206,12 @@ _ARMED = 0
 def _recount():
     global _ARMED
     with _REG_LOCK:
-        fps = list(_REG.values())
-    _ARMED = sum(1 for fp in fps if fp.mode != "off")
+        # count AND publish under the registry lock: two concurrent
+        # configure() calls racing the assignment could publish a stale
+        # count (hit()'s read stays deliberately lock-free — a torn
+        # read there only costs one extra dict lookup, never a wrong
+        # verdict)
+        _ARMED = sum(1 for fp in _REG.values() if fp.mode != "off")
 
 
 def declare(name, description="") -> Failpoint:
@@ -282,8 +286,11 @@ def seed_all(seed):
     """Reseed every failpoint's RNG from (seed, name) — one call makes a
     probabilistic fault storm reproducible."""
     global _SEED
-    _SEED = str(seed)
     with _REG_LOCK:
+        # publish the seed under the registry lock so a concurrent
+        # declare() can't reseed a new failpoint from the value this
+        # call is about to replace
+        _SEED = str(seed)
         fps = list(_REG.values())
     for fp in fps:
         fp.reseed(_SEED)

@@ -22,8 +22,20 @@ process-wide witness:
   recorded with its hold time — the runtime complement of the static
   lock-discipline rule (blocking work under a lock)
 
-Reporting: ``lighthouse_lock_witness_*`` metric families and the
-``GET /lighthouse/locks`` route (``report()`` here).  The witness's
+With ``LTPU_RACE_WITNESS=1`` (which implies lock mode — the checker
+needs the held-stacks) an Eraser-style lockset checker rides on top:
+``guarded(obj, "field", lock)`` registers which lock the code CLAIMS
+protects a field, instrumented ``access(obj, "field", kind)`` calls
+intersect the accessor's held-set with the field's candidate lockset,
+and a write that empties the candidates is a race report — no single
+registered lock was held across all accesses.  First-owner-thread
+accesses are exempt (construction can't race), read-only sharing
+never reports.
+
+Reporting: ``lighthouse_lock_witness_*`` / ``lighthouse_race_witness_*``
+metric families and the ``GET /lighthouse/locks`` /
+``GET /lighthouse/races`` routes (``report()`` / ``race_report()``
+here).  The witness's
 own bookkeeping uses one plain internal mutex held only for dict
 updates — never while acquiring a user lock, never while logging — so
 it cannot deadlock the locks it watches.  ``utils/metrics.py`` and
@@ -38,6 +50,7 @@ lock classes.
 import os
 import threading
 import time
+import weakref
 from collections import deque
 
 from . import metrics
@@ -61,13 +74,45 @@ HELD_SECONDS = metrics.histogram(
     "Hold time of instrumented locks (witness mode only)",
     buckets=(0.0001, 0.001, 0.01, 0.1, 0.5, 2.0),
 )
+RACE_ACCESSES = metrics.counter(
+    "lighthouse_race_witness_accesses_total",
+    "Instrumented shared-field accesses seen by the lockset checker",
+    labels=("field",),
+)
+RACE_REPORTS = metrics.counter(
+    "lighthouse_race_witness_reports_total",
+    "Fields whose candidate lockset emptied (Eraser-style race report)",
+    labels=("field",),
+)
+RACE_GUARDED = metrics.gauge(
+    "lighthouse_race_witness_guarded_fields",
+    "Fields currently registered with the lockset checker",
+)
 
 
 def enabled():
     """Witness mode is decided per lock CONSTRUCTION (env read here),
     so a process started with LTPU_LOCK_WITNESS=1 instruments every
-    adopted site and an unset env costs literally nothing."""
-    return os.environ.get("LTPU_LOCK_WITNESS", "") not in ("", "0")
+    adopted site and an unset env costs literally nothing.  Race mode
+    implies lock mode: the lockset checker reads each accessor's
+    held-set off the witness thread stacks, which only exist when the
+    factories hand out instrumented wrappers."""
+    return (os.environ.get("LTPU_LOCK_WITNESS", "") not in ("", "0")
+            or race_enabled())
+
+
+def race_enabled():
+    """Eraser-mode: ``LTPU_RACE_WITNESS=1``.  Cached so the hot no-op
+    path of ``access()`` is one module-global read; tests that flip
+    the env call ``reset_witness()`` to re-read it."""
+    global _RACE_MODE
+    if _RACE_MODE is None:
+        _RACE_MODE = os.environ.get(
+            "LTPU_RACE_WITNESS", "") not in ("", "0")
+    return _RACE_MODE
+
+
+_RACE_MODE = None
 
 
 def stall_budget_s():
@@ -195,6 +240,136 @@ class Witness:
             }
 
 
+class RaceChecker:
+    """Eraser-style lockset checker riding on the witness held-stacks.
+
+    ``register(obj, field, guards)`` seeds the field's CANDIDATE
+    lockset with the guards the code claims protect it; every
+    instrumented ``note_access`` then intersects the candidates with
+    the accessing thread's held-set.  State machine per field, after
+    Savage et al.'s Eraser:
+
+    - **exclusive**: all accesses so far came from the first-owner
+      thread — construction and single-threaded warm-up never refine
+      (this is what keeps ``__init__`` writes from false-positives)
+    - **shared**: a second thread touched the field; every access now
+      intersects.  Reads alone never report (read-shared data is fine).
+    - **report**: the candidate set is EMPTY and a write has happened —
+      no single registered lock was held across all accesses, i.e. the
+      locking discipline the registration claimed does not hold.  One
+      report per field (the first interleaving that proves it), kept in
+      a bounded ring.
+
+    The checker's own mutex is plain and held only for dict updates —
+    same non-deadlock discipline as the witness."""
+
+    def __init__(self, witness=None):
+        self._mu = threading.Lock()
+        self._witness = witness
+        self._fields = {}           # (objid, field) -> state dict
+        self.reports = deque(maxlen=128)
+
+    def _held_names(self):
+        w = self._witness if self._witness is not None else get_witness()
+        return {n for n, _ in w._stack()}
+
+    def register(self, obj, field, guards):
+        key = (id(obj), field)
+        with self._mu:
+            st = self._fields.get(key)
+            if st is None:
+                st = self._fields[key] = {
+                    "label": f"{type(obj).__name__}.{field}",
+                    "guards": set(),
+                    "candidates": None,   # None until first access
+                    "owner": None,
+                    "shared": False,
+                    "modified": False,
+                    "reported": False,
+                }
+            st["guards"].update(guards)
+            if st["candidates"] is not None:
+                st["candidates"].update(guards)
+            RACE_GUARDED.set(len(self._fields))
+        try:
+            # drop the state with the object so a recycled id() can't
+            # alias a dead field's lockset
+            weakref.finalize(obj, self._forget, key)
+        except TypeError:
+            pass                    # non-weakrefable: lives forever
+
+    def _forget(self, key):
+        with self._mu:
+            self._fields.pop(key, None)
+            RACE_GUARDED.set(len(self._fields))
+
+    def note_access(self, obj, field, kind):
+        key = (id(obj), field)
+        st = self._fields.get(key)
+        if st is None:
+            return                  # unregistered: not our problem
+        tid = threading.get_ident()
+        report = None
+        with self._mu:
+            if st["candidates"] is None:
+                st["candidates"] = set(st["guards"])
+            if st["owner"] is None:
+                st["owner"] = tid
+            if tid == st["owner"] and not st["shared"]:
+                return              # first-owner exclusive phase
+            st["shared"] = True
+            if kind == "write":
+                st["modified"] = True
+            held = self._held_names()
+            st["candidates"] &= held
+            if (not st["candidates"] and st["modified"]
+                    and not st["reported"]):
+                st["reported"] = True
+                report = {
+                    "field": st["label"],
+                    "kind": kind,
+                    "registered_guards": sorted(st["guards"]),
+                    "held": sorted(held),
+                    "thread": threading.current_thread().name,
+                }
+                self.reports.append(report)
+        RACE_ACCESSES.with_labels(st["label"]).inc()
+        if report is not None:
+            RACE_REPORTS.with_labels(st["label"]).inc()
+            # WARN outside the checker mutex, same as the cycle path
+            from .logging import get_logger
+
+            get_logger("locks").warning(
+                "lockset violation: %s accessed (%s) with no "
+                "registered guard held — candidates emptied "
+                "(registered %s, held %s)",
+                report["field"], kind,
+                ",".join(report["registered_guards"]) or "-",
+                ",".join(report["held"]) or "-",
+                thread=report["thread"],
+            )
+
+    def report(self):
+        with self._mu:
+            return {
+                "enabled": True,
+                "guarded_fields": len(self._fields),
+                "fields": sorted(
+                    (
+                        {
+                            "field": st["label"],
+                            "guards": sorted(st["guards"]),
+                            "shared": st["shared"],
+                            "reported": st["reported"],
+                        }
+                        for st in self._fields.values()
+                    ),
+                    key=lambda d: d["field"],
+                ),
+                "reports": list(self.reports),
+            }
+
+
 class _WitnessBase:
     """Shared wrapper plumbing; subclasses pick the inner lock.  The
     wrapper is Condition-compatible: acquire/release/__enter__/__exit__
@@ -260,6 +435,7 @@ class WitnessRLock(_WitnessBase):
 
 
 _GLOBAL = None
+_RACE_GLOBAL = None
 _GLOBAL_MU = threading.Lock()
 
 
@@ -271,12 +447,58 @@ def get_witness():
         return _GLOBAL
 
 
+def get_race_checker():
+    global _RACE_GLOBAL
+    with _GLOBAL_MU:
+        if _RACE_GLOBAL is None:
+            _RACE_GLOBAL = RaceChecker()
+        return _RACE_GLOBAL
+
+
 def reset_witness():
-    """Drop the process witness (tests); the next instrumented lock
-    construction or report() builds a fresh graph."""
-    global _GLOBAL
+    """Drop the process witness AND race checker (tests); the next
+    instrumented lock construction or report() builds fresh state, and
+    the race-mode env cache is re-read."""
+    global _GLOBAL, _RACE_GLOBAL, _RACE_MODE
     with _GLOBAL_MU:
         _GLOBAL = None
+        _RACE_GLOBAL = None
+        _RACE_MODE = None
+
+
+def _guard_names(guard):
+    """Accept a site name, an instrumented wrapper, or (off mode) a
+    plain lock; iterables of those register several candidates."""
+    if isinstance(guard, str):
+        return (guard,)
+    if isinstance(guard, _WitnessBase):
+        return (guard._name,)
+    if isinstance(guard, (tuple, list, set, frozenset)):
+        names = []
+        for g in guard:
+            names.extend(_guard_names(g))
+        return tuple(names)
+    return (f"<unnamed {type(guard).__name__}>",)
+
+
+def guarded(obj, field, guard):
+    """Register ``obj.<field>`` with the lockset checker: the code
+    claims ``guard`` (a ``locks.lock``/``rlock`` wrapper or site name;
+    several may be registered) protects it.  No-op unless
+    ``LTPU_RACE_WITNESS=1`` — adoption sites call this unconditionally
+    from ``__init__`` at zero production cost."""
+    if not race_enabled():
+        return
+    get_race_checker().register(obj, field, _guard_names(guard))
+
+
+def access(obj, field, kind="write"):
+    """Instrumented access to a ``guarded`` field: intersects the
+    calling thread's held-set with the field's candidate lockset.
+    One cached-flag read when race mode is off."""
+    if not race_enabled():
+        return
+    get_race_checker().note_access(obj, field, kind)
 
 
 def lock(name, witness=None):
@@ -307,3 +529,15 @@ def report():
             "locks": {}, "edges": [], "cycles": [], "stalls": [],
         }
     return get_witness().report()
+
+
+def race_report():
+    """The /lighthouse/races payload — honest about being off."""
+    if not race_enabled():
+        return {
+            "enabled": False,
+            "guarded_fields": 0,
+            "fields": [],
+            "reports": [],
+        }
+    return get_race_checker().report()

@@ -349,6 +349,12 @@ class VerificationService:
         # are single-dispatcher state by contract) — the replacement
         # blocks until the old thread's in-flight batch resolves
         self._work_lock = locks.lock("verify_service.work")
+        # lockset checker (LTPU_RACE_WITNESS=1; no-op otherwise): every
+        # queue-state mutation must hold the cv lock.  `heartbeat` is
+        # deliberately NOT registered — it is a single-writer monotonic
+        # stamp read racily by the watchdog on purpose.
+        for field in ("_queues", "_queued_sets", "_deadline_heap"):
+            locks.guarded(self, field, "verify_service.cv")
 
         # admission warm gate: while a compile prewarm is in flight
         # (BeaconNode.start kicks one before the dispatcher may touch
@@ -491,6 +497,9 @@ class VerificationService:
             if len(self._queues[idx]) >= self.queue_caps[cls]:
                 M.ADMISSION_REJECTED.inc()
                 raise QueueFullError(f"{cls} queue at capacity")
+            locks.access(self, "_queues", "write")
+            locks.access(self, "_deadline_heap", "write")
+            locks.access(self, "_queued_sets", "write")
             self._queues[idx].append(req)
             heapq.heappush(
                 self._deadline_heap,
@@ -676,10 +685,12 @@ class VerificationService:
         queue heads alone are not enough) — an O(log n) peek with lazy
         deletion of dispatched entries, where the old full scan was
         O(total queued requests) per dispatcher tick."""
+        locks.access(self, "_queued_sets", "read")
         if self._queued_sets == 0:
             # every heap entry is necessarily stale now — drop them so an
             # idle service doesn't retain resolved requests (and their
             # signature sets) until the next submit
+            locks.access(self, "_deadline_heap", "write")
             self._deadline_heap.clear()
             return None
         # prune BEFORE the target-batch early return: under sustained
@@ -698,6 +709,7 @@ class VerificationService:
         whole heap when stale entries buried behind a live minimum come
         to dominate (requests dispatch in priority order, not deadline
         order, so burial is possible)."""
+        locks.access(self, "_deadline_heap", "write")
         heap = self._deadline_heap
         while heap and heap[0][2].dispatched:
             heapq.heappop(heap)
@@ -710,6 +722,8 @@ class VerificationService:
     def _form_batch_locked(self):
         """Pop requests in priority order up to max_batch sets.  Requests
         are atomic (never split); an oversized request dispatches alone."""
+        locks.access(self, "_queues", "write")
+        locks.access(self, "_queued_sets", "write")
         reqs = []
         n = 0
         for idx, cls in enumerate(PRIORITY_CLASSES):
@@ -729,6 +743,9 @@ class VerificationService:
         return reqs
 
     def _fail_pending_locked(self):
+        locks.access(self, "_queues", "write")
+        locks.access(self, "_deadline_heap", "write")
+        locks.access(self, "_queued_sets", "write")
         err = ServiceStopped("verification service stopped")
         for idx, cls in enumerate(PRIORITY_CLASSES):
             q = self._queues[idx]

@@ -52,3 +52,13 @@ def pytest_sessionfinish(session, exitstatus):
         session.exitstatus = 1
         print(f"\nlock witness recorded {len(cycles)} lock-order "
               f"cycle(s) during the suite: {cycles}")
+    # Under LTPU_RACE_WITNESS=1 the suite is also a lockset soak: any
+    # guarded field whose candidate lockset emptied on a write is a
+    # race in production code.  (Deliberate violations in tests use
+    # private RaceChecker instances.)
+    if locks.race_enabled():
+        races = locks.race_report().get("reports", [])
+        if races:
+            session.exitstatus = 1
+            print(f"\nrace witness recorded {len(races)} lockset "
+                  f"violation(s) during the suite: {races}")

@@ -315,9 +315,14 @@ def test_witness_off_is_identity_to_threading_primitives(monkeypatch):
     """Acceptance: the disabled path adds NO wrapper — the factories
     hand back the exact stdlib primitives."""
     monkeypatch.delenv("LTPU_LOCK_WITNESS", raising=False)
-    assert not locks.enabled()
-    assert type(locks.lock("x")) is type(threading.Lock())
-    assert type(locks.rlock("x")) is type(threading.RLock())
+    monkeypatch.delenv("LTPU_RACE_WITNESS", raising=False)
+    locks.reset_witness()           # race mode also implies wrappers
+    try:
+        assert not locks.enabled()
+        assert type(locks.lock("x")) is type(threading.Lock())
+        assert type(locks.rlock("x")) is type(threading.RLock())
+    finally:
+        locks.reset_witness()       # un-cache the mode for later tests
 
 
 def test_witness_detects_seeded_ab_ba_cycle():
@@ -425,6 +430,8 @@ def test_locks_route_serves_witness_report(monkeypatch):
     try:
         base = f"http://127.0.0.1:{server.port}"
         monkeypatch.delenv("LTPU_LOCK_WITNESS", raising=False)
+        monkeypatch.delenv("LTPU_RACE_WITNESS", raising=False)
+        locks.reset_witness()       # race mode also implies wrappers
         with urllib.request.urlopen(base + "/lighthouse/locks") as r:
             data = json.load(r)["data"]
         assert data["enabled"] is False

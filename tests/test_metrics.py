@@ -237,6 +237,14 @@ def test_metric_name_lint():
         "kernel_profile_wall_ms",
         "kernel_profile_pad_waste_ratio",
     } <= names, sorted(names)
+    # the race-witness families (ISSUE 14) must be registered and
+    # linted: instrumented-access / lockset-violation counters and the
+    # registered-field gauge
+    assert {
+        "lighthouse_race_witness_accesses_total",
+        "lighthouse_race_witness_reports_total",
+        "lighthouse_race_witness_guarded_fields",
+    } <= names, sorted(names)
 
 
 def test_verify_service_queue_depth_is_one_labeled_family():

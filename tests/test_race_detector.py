@@ -1,0 +1,399 @@
+"""Cross-file race detector (ISSUE 14): guarded-state lint + lockset
+checker.
+
+Two halves, mirroring the rule itself:
+
+1. static — the `guarded-state` package-scope rule infers per-class
+   guards from majority-of-writes evidence and flags unguarded writes
+   and check-then-act pairs, but ONLY when the state is reachable from
+   two distinct concurrency roots.  Fixture pairs: racy flagged /
+   guarded clean / single-root clean / TOCTOU flagged / cross-module
+   global flagged through the import map.
+
+2. dynamic — the Eraser-style lockset checker riding the witness
+   held-stacks: the pre-PR-11 aggregation flush shape (commit without
+   the entry lock from a second thread) is reported, the fixed
+   snapshot→launch→commit shape is silent, the first-owner exclusive
+   phase and read-only sharing never report, and ``GET
+   /lighthouse/races`` serves the report.
+"""
+
+import json
+import subprocess
+import sys
+import threading
+import urllib.request
+from pathlib import Path
+
+from lighthouse_tpu import analysis
+from lighthouse_tpu.utils import locks
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ------------------------------------------------------- static: rule
+
+
+RACY_SRC = '''
+import threading
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+
+    def start(self):
+        t = threading.Thread(target=self.worker)
+        t.start()
+
+    def worker(self):
+        with self._lock:
+            self._entries["a"] = 1
+        with self._lock:
+            self._entries.pop("a", None)
+
+    def racy_touch(self):
+        self._entries["b"] = 2
+'''
+
+
+def test_rule_registered_with_description():
+    rules = analysis.all_rules()
+    assert "guarded-state" in rules
+    assert rules["guarded-state"].package_scope
+    assert "inferred-guarded" in rules["guarded-state"].description
+
+
+def test_unguarded_write_flagged_with_guard_and_roots():
+    found = analysis.analyze_source(RACY_SRC, "guarded-state")
+    assert len(found) == 1, [f.message for f in found]
+    f = found[0]
+    assert "without inferred guard" in f.message
+    assert f.guard == "self._lock"
+    # the racing pair: this access's root and one OTHER root
+    assert len(f.roots) == 2
+    assert "<main>" in f.roots
+    assert any("thread" in r for r in f.roots)
+    d = f.to_dict()
+    assert d["guard"] == "self._lock" and d["roots"] == f.roots
+
+
+def test_guarded_everywhere_is_clean():
+    src = RACY_SRC.replace(
+        '        self._entries["b"] = 2',
+        '        with self._lock:\n            self._entries["b"] = 2',
+    )
+    assert analysis.analyze_source(src, "guarded-state") == []
+
+
+def test_single_root_is_clean():
+    """Two-root reachability is REQUIRED: the same unguarded write in
+    a package with no spawns (and no root-shaped method names) cannot
+    race and must not be flagged."""
+    src = '''
+import threading
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+
+    def fill(self):
+        with self._lock:
+            self._entries["a"] = 1
+        with self._lock:
+            self._entries.pop("a", None)
+
+    def drain(self):
+        self._entries["b"] = 2
+'''
+    assert analysis.analyze_source(src, "guarded-state") == []
+
+
+def test_check_then_act_flagged():
+    src = '''
+import threading
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+
+    def start(self):
+        threading.Thread(target=self.put).start()
+
+    def put(self):
+        with self._lock:
+            self._entries["a"] = 1
+        with self._lock:
+            self._entries["b"] = 2
+
+    def maybe_add(self):
+        if "c" not in self._entries:
+            with self._lock:
+                self._entries["c"] = 3
+'''
+    found = analysis.analyze_source(src, "guarded-state")
+    assert len(found) == 1, [f.message for f in found]
+    assert "check-then-act" in found[0].message
+    assert found[0].guard == "self._lock"
+
+
+def test_locked_suffix_methods_are_exempt():
+    """`*_locked` methods assert caller-holds-guard — their bare
+    writes are excluded from both inference and flagging."""
+    src = RACY_SRC.replace("def racy_touch", "def touch_locked")
+    assert analysis.analyze_source(src, "guarded-state") == []
+
+
+def test_cross_module_global_flagged_via_import_map():
+    """Spawn in one module, guarded global in another: the call graph
+    resolves `reg.put` through the relative import, so the thread root
+    reaches reg.py and the bare `fast_set` write is flagged there."""
+    reg = '''
+import threading
+
+_REG_LOCK = threading.Lock()
+_TABLE = {}
+
+def put(name, val):
+    with _REG_LOCK:
+        _TABLE[name] = val
+
+def drop(name):
+    with _REG_LOCK:
+        _TABLE.pop(name, None)
+
+def fast_set(name, val):
+    _TABLE[name] = val
+'''
+    svc = '''
+import threading
+from . import reg
+
+def serve():
+    threading.Thread(target=pump).start()
+
+def pump():
+    reg.put("x", 1)
+'''
+    found = analysis.analyze_sources({"reg.py": reg, "svc.py": svc},
+                                     "guarded-state")
+    assert len(found) == 1, [f.message for f in found]
+    assert found[0].path == "reg.py"
+    assert found[0].guard == "_REG_LOCK"
+    assert len(found[0].roots) == 2
+
+
+def test_repo_is_clean_under_guarded_state():
+    """Acceptance: the rule runs clean over the live package with no
+    new waivers (the burn-down FIXED the real findings)."""
+    report = analysis.run_analysis(rules=["guarded-state"])
+    assert report["clean"], analysis.format_report(report)
+    assert not report["waived"]
+
+
+# ------------------------------------------------- dynamic: lockset
+
+
+class _Tier:
+    pass
+
+
+def _checker():
+    w = locks.Witness()
+    lk = locks.WitnessLock("agg.entries", w)
+    chk = locks.RaceChecker(witness=w)
+    tier = _Tier()
+    chk.register(tier, "entries", ("agg.entries",))
+    return lk, chk, tier
+
+
+def test_first_owner_exclusive_phase_never_reports():
+    _lk, chk, tier = _checker()
+    for _ in range(4):
+        chk.note_access(tier, "entries", "write")   # bare, single thread
+    assert chk.report()["reports"] == []
+
+
+def test_read_only_sharing_never_reports():
+    _lk, chk, tier = _checker()
+    chk.note_access(tier, "entries", "read")
+    t = threading.Thread(
+        target=lambda: chk.note_access(tier, "entries", "read"))
+    t.start()
+    t.join()
+    rep = chk.report()
+    assert rep["reports"] == []
+    assert rep["fields"][0]["shared"] is True
+
+
+def test_fixed_flush_shape_is_silent():
+    """The post-PR-11 aggregation flush: snapshot under the entry
+    lock, launch outside, commit back under the lock — every shared
+    access holds `agg.entries`, so the candidate set never empties."""
+    lk, chk, tier = _checker()
+    entries = {}
+    with lk:
+        entries["a"] = 1
+        chk.note_access(tier, "entries", "write")
+
+    def flush():
+        with lk:
+            snap = dict(entries)
+            chk.note_access(tier, "entries", "read")
+        total = sum(snap.values())        # "launch" outside the lock
+        with lk:
+            entries["agg"] = total
+            chk.note_access(tier, "entries", "write")
+
+    t = threading.Thread(target=flush)
+    t.start()
+    t.join()
+    rep = chk.report()
+    assert rep["reports"] == []
+    assert rep["guarded_fields"] == 1
+
+
+def test_broken_flush_shape_is_reported():
+    """The pre-PR-11 shape: the flush path mutates the entry table
+    with NO lock held while the insert path holds `agg.entries` — the
+    intersection empties on a write and the checker reports once."""
+    lk, chk, tier = _checker()
+    entries = {}
+    with lk:
+        entries["a"] = 1
+        chk.note_access(tier, "entries", "write")
+
+    def broken_flush():
+        entries["agg"] = 0                # no lock: the bug
+        chk.note_access(tier, "entries", "write")
+
+    t = threading.Thread(target=broken_flush, name="flush")
+    t.start()
+    t.join()
+    reports = chk.report()["reports"]
+    assert len(reports) == 1, reports
+    r = reports[0]
+    assert r["field"] == "_Tier.entries"
+    assert r["registered_guards"] == ["agg.entries"]
+    assert r["held"] == []
+    assert r["thread"] == "flush"
+    # one report per field — further bare writes don't re-report
+    chk.note_access(tier, "entries", "write")
+    assert len(chk.report()["reports"]) == 1
+
+
+def test_module_api_is_noop_when_disabled(monkeypatch):
+    monkeypatch.delenv("LTPU_RACE_WITNESS", raising=False)
+    locks.reset_witness()
+    try:
+        obj = _Tier()
+        locks.guarded(obj, "entries", "agg.entries")   # no-op
+        locks.access(obj, "entries", "write")          # no-op
+        rep = locks.race_report()
+        assert rep["enabled"] is False
+        assert rep["reports"] == []
+    finally:
+        locks.reset_witness()
+
+
+def test_module_api_registers_under_env(monkeypatch):
+    """LTPU_RACE_WITNESS=1 arms the global checker; a violation-free
+    locked workload registers fields and stays silent (this is the
+    shape the tier-1 zero-report gate asserts suite-wide)."""
+    monkeypatch.setenv("LTPU_RACE_WITNESS", "1")
+    locks.reset_witness()
+    try:
+        obj = _Tier()
+        lk = locks.lock("test.race_env")
+        locks.guarded(obj, "entries", lk)
+        with lk:
+            locks.access(obj, "entries", "write")
+        t = threading.Thread(target=lambda: (
+            lk.acquire(),
+            locks.access(obj, "entries", "write"),
+            lk.release()))
+        t.start()
+        t.join()
+        rep = locks.race_report()
+        assert rep["enabled"] is True
+        assert rep["guarded_fields"] >= 1
+        assert rep["reports"] == []
+    finally:
+        locks.reset_witness()
+
+
+def test_races_route_serves_report(monkeypatch):
+    """GET /lighthouse/races — disabled shell by default."""
+    from lighthouse_tpu.api.http_api import BeaconApiServer
+    from lighthouse_tpu.beacon.chain import BeaconChain
+    from lighthouse_tpu.testing.harness import Harness
+    from lighthouse_tpu.types import ChainSpec, MinimalPreset
+
+    h = Harness(8, ChainSpec(preset=MinimalPreset))
+    chain = BeaconChain(h.state.copy(), ChainSpec(preset=MinimalPreset))
+    server = BeaconApiServer(chain).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        monkeypatch.delenv("LTPU_RACE_WITNESS", raising=False)
+        locks.reset_witness()
+        with urllib.request.urlopen(base + "/lighthouse/races") as r:
+            data = json.load(r)["data"]
+        assert data["enabled"] is False
+        assert data["reports"] == []
+    finally:
+        server.stop()
+        locks.reset_witness()
+
+
+# ------------------------------------------------ lint CLI: pruning
+
+
+def _run_lint(*args):
+    return subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint.py"), *args],
+        capture_output=True, text=True, cwd=REPO,
+    )
+
+
+def test_prune_waivers_reports_and_applies(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "daemon.py").write_text('print("x")\n')
+    wpath = tmp_path / "waivers.json"
+    wpath.write_text(json.dumps([
+        {"rule": "print-hygiene", "path": "daemon.py",
+         "match": 'print("x")', "justification": "still live"},
+        {"rule": "print-hygiene", "path": "daemon.py",
+         "match": 'print("gone")', "justification": "was fixed"},
+        {"rule": "print-hygiene", "path": "ghost.py",
+         "match": "x", "justification": "file deleted"},
+    ]))
+
+    # report-only: stale entries fail the run, ledger untouched
+    proc = _run_lint("--prune-waivers", "--root", str(pkg),
+                     "--waivers", str(wpath))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "3 waiver(s) checked, 2 stale" in proc.stdout
+    assert len(json.loads(wpath.read_text())) == 3
+
+    # --json carries the stale reasons
+    proc = _run_lint("--prune-waivers", "--json", "--root", str(pkg),
+                     "--waivers", str(wpath))
+    rep = json.loads(proc.stdout)
+    assert {w["stale_reason"] for w in rep["stale"]} == {
+        "file gone", "match substring on no source line"}
+
+    # --apply deletes the stale entries and exits 0
+    proc = _run_lint("--prune-waivers", "--apply", "--root", str(pkg),
+                     "--waivers", str(wpath))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    kept = json.loads(wpath.read_text())
+    assert len(kept) == 1 and kept[0]["match"] == 'print("x")'
+
+
+def test_repo_ledger_has_no_stale_waivers():
+    proc = _run_lint("--prune-waivers")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert ", 0 stale" in proc.stdout

@@ -12,6 +12,8 @@ Usage:
   python tools/lint.py --rule lock-discipline --rule jit-discipline
   python tools/lint.py --list-rules
   python tools/lint.py --root path/to/pkg --waivers path/to/waivers.json
+  python tools/lint.py --prune-waivers          # report stale entries
+  python tools/lint.py --prune-waivers --apply  # and delete them
 
 Wired into tier-1 (tests/test_analysis.py runs this over the repo) and
 the bench.py preflight (a discipline regression fails the bench before
@@ -26,6 +28,42 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from lighthouse_tpu import analysis  # noqa: E402
+from lighthouse_tpu.analysis.core import PACKAGE_ROOT  # noqa: E402
+
+
+def prune_waivers(root=None, waivers_path=None, apply=False):
+    """Stale-waiver burn-down as a command: a ledger entry whose
+    ``match`` substring no longer appears on any source line of its
+    ``path`` (or whose file is gone) waives nothing — the violation was
+    fixed and the waiver must shed.  Returns the report dict; with
+    ``apply`` the stale entries are deleted from the ledger in place."""
+    root = Path(root) if root else PACKAGE_ROOT
+    wpath = (Path(waivers_path) if waivers_path
+             else analysis.default_waivers_path())
+    entries = json.loads(wpath.read_text()) if wpath.exists() else []
+    kept, stale = [], []
+    for w in entries:
+        target = root / str(w.get("path", ""))
+        reason = None
+        if not target.exists():
+            reason = "file gone"
+        else:
+            lines = target.read_text().splitlines()
+            if not any(str(w.get("match", "")) in ln for ln in lines):
+                reason = "match substring on no source line"
+        if reason is None:
+            kept.append(w)
+        else:
+            stale.append({**w, "stale_reason": reason})
+    if apply and stale:
+        wpath.write_text(json.dumps(kept, indent=2) + "\n")
+    return {
+        "waivers_path": str(wpath),
+        "checked": len(entries),
+        "kept": len(kept),
+        "stale": stale,
+        "applied": bool(apply and stale),
+    }
 
 
 def main(argv=None):
@@ -42,12 +80,32 @@ def main(argv=None):
     ap.add_argument("--waivers", default=None,
                     help="waiver ledger path (default: the package's "
                          "analysis/waivers.json)")
+    ap.add_argument("--prune-waivers", action="store_true",
+                    help="report ledger entries whose match substring "
+                         "no longer appears in their file")
+    ap.add_argument("--apply", action="store_true",
+                    help="with --prune-waivers: delete the stale "
+                         "entries from the ledger")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for name, rule in sorted(analysis.all_rules().items()):
             print(f"{name:24s} {rule.description}")
         return 0
+
+    if args.prune_waivers:
+        rep = prune_waivers(root=args.root, waivers_path=args.waivers,
+                            apply=args.apply)
+        if args.json:
+            print(json.dumps(rep, indent=2))
+        else:
+            for w in rep["stale"]:
+                print(f"stale: {w['rule']}:{w['path']}:{w['match']!r} "
+                      f"({w['stale_reason']})")
+            print(f"{rep['checked']} waiver(s) checked, "
+                  f"{len(rep['stale'])} stale"
+                  + (", deleted" if rep["applied"] else ""))
+        return 1 if rep["stale"] and not args.apply else 0
 
     report = analysis.run_analysis(
         root=args.root, rules=args.rule, waivers_path=args.waivers
