@@ -108,6 +108,7 @@ _COMPILE_EST = 240.0   # refined after the first measured compile
 _VS_SUMMARY = None     # verify_service coalescing sweep (ROADMAP item d)
 _CC_SUMMARY = None     # compile-cache cold-vs-cached measurement (ISSUE 6)
 _SOAK_SUMMARY = None   # multi-epoch adversarial soak gates (ISSUE 13)
+_OVERLAY_SUMMARY = None   # aggregation overlay tree-vs-flat (ISSUE 15)
 
 
 def _load_prior_primary():
@@ -156,6 +157,21 @@ def _soak_exit_code():
     if _SOAK_SUMMARY is None or _SOAK_SUMMARY.get("gates_passed", True):
         return 0
     note("soak_regression", failed_gates=_SOAK_SUMMARY.get("failed_gates"))
+    return 1
+
+
+def _overlay_exit_code():
+    """The overlay lane's one hard gate: zero lost contributions.  A
+    run whose tree dropped a validator's attestation bit must not ship
+    green on throughput alone (same bypass env as the other guards)."""
+    if os.environ.get("BENCH_NO_REGRESSION_GUARD"):
+        return 0
+    if _OVERLAY_SUMMARY is None:
+        return 0
+    if _OVERLAY_SUMMARY.get("contributions_lost", 0) == 0:
+        return 0
+    note("overlay_regression",
+         contributions_lost=_OVERLAY_SUMMARY["contributions_lost"])
     return 1
 
 
@@ -234,6 +250,10 @@ def _emit_primary(value, final=False, backend="tpu-kernel", platform=None):
         # divergence) is tracked next to the throughput it could
         # otherwise hide behind
         rec["soak"] = _SOAK_SUMMARY
+    if _OVERLAY_SUMMARY is not None:
+        # tree-vs-flat traffic economics + the zero-lost-contributions
+        # gate ride along so the overlay's trajectory is guarded too
+        rec["overlay"] = _OVERLAY_SUMMARY
     try:
         # the per-kernel profile registry's roll-up (top wall-time
         # sinks, per-kernel totals, launch counters) rides along so a
@@ -968,6 +988,50 @@ def config_soak(epochs=None, json_path=None):
     return r.returncode
 
 
+def config_overlay(json_path=None):
+    """Aggregation-overlay lane: tools/overlay_bench.py in a CPU-pinned
+    subprocess — an 8-node Wonderboom tree settling edge-injected
+    attestations vs the flat-gossip baseline, byte-identity checked in
+    the same run, plus an interior-death re-home timing.  Merges an
+    `overlay` key into BENCH_PRIMARY.json; contributions_lost != 0
+    fails the run via _overlay_exit_code."""
+    global _OVERLAY_SUMMARY
+    import subprocess
+
+    est = 45.0
+    if not _fits(est, "overlay"):
+        return
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "overlay_bench.py"),
+           "--nodes", os.environ.get("BENCH_OVERLAY_NODES", "8"),
+           "--atts", os.environ.get("BENCH_OVERLAY_ATTS", "48")]
+    if json_path:
+        cmd += ["--json", json_path]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                           timeout=max(180.0, 4 * est))
+    except subprocess.TimeoutExpired:
+        note("overlay_error", error="timeout")
+        return
+    try:
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+    except Exception:
+        note("overlay_error", rc=r.returncode, stderr=r.stderr[-300:])
+        return
+    note("overlay", **out)
+    _OVERLAY_SUMMARY = {
+        "nodes": out["nodes"],
+        "atts": out["atts"],
+        "overlay_traffic_reduction": out["overlay_traffic_reduction"],
+        "contributions_lost": out["contributions_lost"],
+        "settle_seconds": out["settle_seconds"],
+        "rehome_seconds": out["rehome_seconds"],
+        "rehomes": out["rehomes"],
+    }
+
+
 def config_kernels():
     """mont_mul candidate shoot-out: f32-HIGHEST GEMM vs int32 einsum vs
     the fused Pallas kernel, one jit each on a wide batch — a single
@@ -1320,12 +1384,13 @@ def main():
     # subprocess measurements to the front of the extras
     stages = (
         (config_device_retry, config_gossip_latency, config_native_shapes,
-         config5, config_aggregation, config_soak, config_mesh,
+         config5, config_aggregation, config_soak, config_overlay, config_mesh,
          run_device_smoke_and_curve,
          config_kernels, config1, config4, config_compile_cache)
         if _DEVICE_ALIVE else
         (config_gossip_latency, config_native_shapes, config5,
-         config_aggregation, config_soak, config_mesh, config_device_retry,
+         config_aggregation, config_soak, config_overlay, config_mesh,
+         config_device_retry,
          run_device_smoke_and_curve, config_kernels, config1, config4,
          config_compile_cache)
     )
@@ -1359,12 +1424,12 @@ def main():
                 "note": "no config completed within budget",
             }
         ), flush=True)
-        return _soak_exit_code()
+        return _soak_exit_code() or _overlay_exit_code()
     _emit_primary(primary, final=True)
     return _regression_exit_code(
         _PRIMARY if _PRIMARY is not None else primary,
         _PRIMARY_PLATFORM or jax.devices()[0].platform,
-    ) or _soak_exit_code()
+    ) or _soak_exit_code() or _overlay_exit_code()
 
 
 if __name__ == "__main__":

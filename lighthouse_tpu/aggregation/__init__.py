@@ -2,6 +2,10 @@
 compressed signature contributions with device-batched flushes (see
 tier.py for the trust boundary and flush policy)."""
 
+from .overlay import AggregationOverlay
 from .tier import AggregationTier, bits_of, bits_or, bits_overlap
 
-__all__ = ["AggregationTier", "bits_of", "bits_or", "bits_overlap"]
+__all__ = [
+    "AggregationOverlay", "AggregationTier",
+    "bits_of", "bits_or", "bits_overlap",
+]

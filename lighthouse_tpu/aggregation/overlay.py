@@ -1,0 +1,693 @@
+"""Wonderboom-style distributed aggregation overlay (PAPERS.md:
+"Efficient, and Censorship-Resilient Signature Aggregation for Million
+Scale Consensus").
+
+PR 9 made aggregation cheap at ONE node; this subsystem makes the
+*network* aggregate.  Wire-fabric nodes are arranged into a k-ary
+aggregation tree per committee key: edge nodes accumulate raw gossip
+attestations with the tier's O(bytes) lazy insert, settle partial
+aggregates on the existing flush cadence, and push them upstream as
+AGG_PUSH frames (compressed partial + packed participation bitset +
+committee key).  Interior nodes merge disjoint partials **bits-only**
+(the pool's `_bits_or`/`_bits_overlap` — no curve math anywhere between
+the edges and the root) and forward; the per-key root feeds received
+partials into its own AggregationTier, whose device-batched flush and
+verify_service/packing paths run exactly as today.  Point addition is
+associative and compression canonical, so the root's settled bytes are
+byte-identical to single-node aggregation of the same traffic — N
+nodes' gossip firehose becomes O(log N) aggregate traffic.
+
+Censorship resilience per the paper:
+
+  * **Deterministic topology from peer ids.**  For each committee key,
+    members are ordered by sha256(member_id || key); index 0 is the
+    root and node i's parent candidates are ((i-1)//k + j) mod i —
+    every candidate has a lower index, so pushes strictly converge.
+    The tree is rebuilt whenever membership changes, and differs per
+    key so no single node is the root for all traffic.
+  * **Redundant parents.**  Every non-root pushes each partial to its
+    first `LTPU_OVERLAY_PARENTS` (default 2) usable candidates; pushes
+    are idempotent first-write-wins per (committee, bitset-subset), so
+    the duplicate arriving over the second path costs one store lookup.
+  * **Audited aggregators (the PR-8 2G2T seam, bits-only).**  Every
+    AGG_ACK carries sha256(key || bitmap || sig) of the bytes the
+    receiver STORED; the child recomputes it from its own bytes.  A
+    mismatch — an equivocating aggregator re-writing partials — trips
+    the per-parent breaker OPEN for the quarantine cooldown
+    (verify_service/remote machinery, reused) and the child re-homes to
+    its next candidate, re-pushing everything unacked: zero lost
+    contributions.  A *suppressing* parent (drops/timeouts) trips the
+    same breaker through ordinary failures; seeded audit probes
+    (`probe` pushes of already-acked partials) catch after-the-fact
+    store corruption.  Equal-bitset partials with different signatures
+    are stored side by side as conflict evidence — the root tier's
+    batched subgroup check at flush drops whichever is invalid, so an
+    equivocator cannot occupy an honest partial's first-write slot.
+"""
+
+import hashlib
+import os
+import random
+import threading
+import time
+
+import numpy as np
+
+from ..network.wire import (
+    WireError,
+    PeerRateLimited,
+    agg_push_digest,
+    encode_agg_push,
+)
+from ..utils import failpoints, locks, metrics, tracing
+from ..utils.logging import get_logger
+from ..verify_service.remote import RemoteTarget, quarantine_target
+from .tier import bits_of, bits_or
+
+log = get_logger("overlay")
+
+MEMBERS = metrics.gauge(
+    "aggregation_overlay_members",
+    "Members currently enrolled in this node's aggregation tree",
+)
+PARTIALS = metrics.gauge(
+    "aggregation_overlay_pending_partials",
+    "Stored partials not yet acked by any usable parent",
+)
+PUSHES = metrics.counter(
+    "aggregation_overlay_pushes_total",
+    "Upstream partial pushes by outcome (ok/refused/error/equivocation)",
+    labels=("outcome",),
+)
+RECEIVED = metrics.counter(
+    "aggregation_overlay_received_total",
+    "Inbound partials by outcome (accepted/duplicate/covered/conflict)",
+    labels=("outcome",),
+)
+PUSH_BYTES = metrics.counter(
+    "aggregation_overlay_push_bytes_total",
+    "AGG_PUSH payload bytes sent upstream",
+)
+REHOMES = metrics.counter(
+    "aggregation_overlay_rehomes_total",
+    "Partials redirected to a backup parent (primary dead/quarantined)",
+)
+QUARANTINES = metrics.counter(
+    "aggregation_overlay_quarantines_total",
+    "Parent aggregators quarantined after a failed store-digest audit",
+)
+REBUILDS = metrics.counter(
+    "aggregation_overlay_topology_rebuilds_total",
+    "Deterministic tree rebuilds on membership change",
+)
+
+_LOCAL = "<local>"
+# guaranteed-undecodable G2 bytes (infinity flag with a nonzero body):
+# the chaos equivocator writes these so the root flush provably drops
+# them instead of packing a wrong-but-valid point
+_CORRUPT_SIG = b"\xff" * 96
+
+
+class _Partial:
+    """One stored partial aggregate: the pending-table row shared by
+    edge (own settled exports), interior (received, forwarded) and root
+    (received, tier-merged) roles."""
+
+    __slots__ = (
+        "key", "bits", "bitmap", "sig", "data", "data_ssz", "origin",
+        "digest", "acked", "rehomed", "trace_id", "recorded_at",
+    )
+
+    def __init__(self, key, bits, bitmap, sig, data, data_ssz, origin,
+                 digest, trace_id, recorded_at):
+        self.key = key
+        self.bits = bits            # uint8 row, one byte per participant
+        self.bitmap = bitmap        # packed wire form (store-key part)
+        self.sig = sig              # as stored (the audit commits to it)
+        self.data = data            # decoded AttestationData template
+        self.data_ssz = data_ssz
+        self.origin = origin        # peer id, _LOCAL, or "restore"
+        self.digest = digest        # sha256(key || bitmap || sig-as-stored)
+        self.acked = set()          # parent ids that acked with a good digest
+        self.rehomed = set()        # backup parents already counted as rehomes
+        self.trace_id = trace_id    # stitches edge->interior->root hops
+        self.recorded_at = recorded_at
+
+
+def _pack_bits(bits):
+    bitmap = bytearray((len(bits) + 7) // 8)
+    for i, b in enumerate(bits):
+        if b:
+            bitmap[i >> 3] |= 1 << (i & 7)
+    return bytes(bitmap)
+
+
+class AggregationOverlay:
+    """Per-node overlay agent: owns the pending-partial store, the
+    deterministic topology, per-parent health (RemoteTarget breakers)
+    and the push/audit tick.  Attached to the WireNode as
+    `wire.overlay` — inbound AGG_PUSH frames land in `on_push` on
+    reader threads; `tick()` runs on the beacon processor's pending
+    loop next to the tier's `maybe_flush`."""
+
+    def __init__(self, wire, tier, members=(), dial=(), parents=None,
+                 fanout=None, push_timeout=None, audit_rate=None,
+                 breaker_threshold=None, breaker_cooldown=None,
+                 quarantine_cooldown=None, ttl=None, seed=None,
+                 clock=time.monotonic):
+        self.wire = wire
+        self.tier = tier
+        self.node_id = wire.peer_id
+        env = os.environ.get
+        self.parents_n = max(1, int(
+            parents if parents is not None else env("LTPU_OVERLAY_PARENTS", "2")
+        ))
+        self.fanout = max(2, int(
+            fanout if fanout is not None else env("LTPU_OVERLAY_FANOUT", "3")
+        ))
+        self.push_timeout = float(
+            push_timeout if push_timeout is not None
+            else env("LTPU_OVERLAY_PUSH_TIMEOUT", "3.0")
+        )
+        self.audit_rate = float(
+            audit_rate if audit_rate is not None
+            else env("LTPU_OVERLAY_AUDIT_RATE", "0.1")
+        )
+        self.breaker_threshold = int(
+            breaker_threshold if breaker_threshold is not None
+            else env("LTPU_OVERLAY_BREAKER_THRESHOLD", "3")
+        )
+        self.breaker_cooldown = float(
+            breaker_cooldown if breaker_cooldown is not None
+            else env("LTPU_OVERLAY_BREAKER_COOLDOWN", "5.0")
+        )
+        self.quarantine_cooldown = float(
+            quarantine_cooldown if quarantine_cooldown is not None
+            else env("LTPU_OVERLAY_QUARANTINE_COOLDOWN", "300.0")
+        )
+        # acked partials are kept this long for idempotence/audit, then
+        # pruned; unacked partials never expire (zero-loss contract —
+        # they leave through a successful push or a snapshot/restore)
+        self.ttl = float(ttl if ttl is not None else env("LTPU_OVERLAY_TTL", "384.0"))
+        self._clock = clock
+        seed = seed if seed is not None else env("LTPU_FAILPOINTS_SEED", "0")
+        self._rng = random.Random(f"{seed}:overlay.audit")
+        self._lock = locks.lock("overlay.state")
+        self.members = [self.node_id]   # sorted; always includes self
+        self.partials = {}              # key -> [_Partial] (first-write-wins)
+        self._targets = {}              # parent id -> RemoteTarget
+        self._dial_state = {
+            tuple(addr): {"pid": None, "next_try": 0.0} for addr in dial
+        }
+        self.counters = {
+            "pushes": {}, "received": {}, "rehomes": 0, "quarantines": 0,
+            "conflicts": 0, "rebuilds": 0, "push_bytes": 0, "audits": 0,
+        }
+        # chaos switch (per-node analogue of the process-global
+        # `overlay.store_corrupt` failpoint): an equivocating aggregator
+        # that re-writes every partial it stores
+        self.corrupt_store = False
+        locks.guarded(self, "partials", "overlay.state")
+        locks.guarded(self, "members", "overlay.state")
+        locks.guarded(self, "_targets", "overlay.state")
+        locks.guarded(self, "counters", "overlay.state")
+        if members:
+            self.set_members(members)
+        wire.overlay = self
+
+    # ------------------------------------------------------- membership
+
+    def set_members(self, ids):
+        """Adopt a member set (self is always included) and rebuild the
+        deterministic topology if it changed."""
+        new = sorted(set(map(str, ids)) | {self.node_id})
+        with self._lock:
+            locks.access(self, "members", "write")
+            if new == self.members:
+                return False
+            self.members = new
+            locks.access(self, "counters", "write")
+            self.counters["rebuilds"] += 1
+        MEMBERS.set(len(new))
+        REBUILDS.inc()
+        return True
+
+    def _order(self, key):
+        """Members ordered for `key`: sha256(id || key) — deterministic
+        across nodes, different per committee so root load spreads."""
+        members = self.members    # atomic ref read (list replaced whole)
+        return sorted(
+            members, key=lambda m: hashlib.sha256(m.encode() + key).digest()
+        )
+
+    def parent_candidates(self, key):
+        """Full parent preference list for this node under `key`: the
+        k-ary primary first, then successive fallbacks — all at lower
+        tree index, so re-homing can never create a cycle.  Empty for
+        the root."""
+        order = self._order(key)
+        try:
+            i = order.index(self.node_id)
+        except ValueError:
+            return []
+        if i == 0:
+            return []
+        first = (i - 1) // self.fanout
+        out, seen = [], set()
+        for j in range(i):
+            c = (first + j) % i
+            if c not in seen:
+                seen.add(c)
+                out.append(order[c])
+        return out
+
+    def children_for(self, key):
+        """Ids whose primary parent set under `key` includes this node
+        (stats/role only — children choose parents, not vice versa)."""
+        order = self._order(key)
+        if self.node_id not in order:
+            return []
+        mine = order.index(self.node_id)
+        out = []
+        for idx in range(1, len(order)):
+            first = (idx - 1) // self.fanout
+            prims = {(first + j) % idx for j in range(min(self.parents_n, idx))}
+            if mine in prims:
+                out.append(order[idx])
+        return out
+
+    def role(self, key):
+        order = self._order(key)
+        if order and order[0] == self.node_id:
+            return "root"
+        return "interior" if self.children_for(key) else "edge"
+
+    def _pending_locked(self):
+        """Records still owed upstream: unacked AND this node has a
+        parent for the key (a root's records settle into its own tier,
+        there is nowhere to push them)."""
+        n = 0
+        for key, records in self.partials.items():
+            if not self.parent_candidates(key):
+                continue
+            n += sum(1 for r in records if not r.acked)
+        return n
+
+    def _target(self, pid):
+        with self._lock:
+            locks.access(self, "_targets", "write")
+            t = self._targets.get(pid)
+            if t is None:
+                t = RemoteTarget(
+                    f"overlay:{pid}",
+                    breaker_threshold=self.breaker_threshold,
+                    breaker_cooldown=self.breaker_cooldown,
+                    clock=self._clock,
+                )
+                self._targets[pid] = t
+            return t
+
+    # ---------------------------------------------------- receive (wire)
+
+    def on_push(self, from_peer, frame):
+        """Inbound AGG_PUSH (wire reader thread).  Returns (code,
+        stored-digest) for the AGG_ACK.  Raises WireError for semantic
+        garbage — answered R_INVALID_REQUEST upstream, connection
+        survives."""
+        from ..ssz import decode as ssz_decode, hash_tree_root
+        from ..types.containers import AttestationData
+
+        t0 = time.monotonic()
+        try:
+            data = ssz_decode(AttestationData, frame["data_ssz"])
+        except Exception as e:
+            raise WireError(f"undecodable attestation data: {e}") from e
+        if bytes(hash_tree_root(data)) != frame["key"]:
+            raise WireError("committee key does not match attestation data")
+        tctx = frame.get("trace_ctx")
+        outcome, rec = self._record(
+            frame["key"], frame["data_ssz"], data,
+            np.asarray(frame["bits"], dtype=np.uint8), frame["sig"],
+            origin=from_peer, trace_id=tctx[0] if tctx else None,
+        )
+        if tctx is not None:
+            tr = tracing.start_trace(
+                "overlay_recv", parent_trace_id=tctx[0], origin=tctx[1],
+                key=frame["key"].hex()[:16], outcome=outcome,
+                role=self.role(frame["key"]), probe=frame.get("probe", False),
+            )
+            tr.add_span("overlay_store", t0, time.monotonic())
+            tr.finish()
+        with self._lock:
+            locks.access(self, "counters", "write")
+            c = self.counters["received"]
+            c[outcome] = c.get(outcome, 0) + 1
+        RECEIVED.with_labels(outcome).inc()
+        from ..network.wire import R_SUCCESS
+
+        return R_SUCCESS, rec.digest if rec is not None else agg_push_digest(
+            frame["key"], frame["bits"], frame["sig"]
+        )
+
+    def _record(self, key, data_ssz, data, bits, sig, origin, trace_id=None):
+        """First-write-wins store insert.  Outcomes:
+
+          accepted   new partial stored (forwarded/tier-merged later)
+          duplicate  exact (key, bitmap, sig) already stored
+          covered    bits are a strict subset of a stored partial
+          conflict   equal bitmap, different signature — both kept as
+                     equivocation evidence (root flush drops the bad one)
+        """
+        bitmap = _pack_bits(bits)
+        sig = bytes(sig)
+        stored_sig = sig
+        if self.corrupt_store:
+            stored_sig = _CORRUPT_SIG
+        stored_sig = failpoints.hit("overlay.store_corrupt", data=stored_sig)
+        digest = agg_push_digest(key, bits, stored_sig)
+        is_root = self.role(key) == "root"
+        now = self._clock()
+        conflict = False
+        with self._lock:
+            locks.access(self, "partials", "write")
+            records = self.partials.setdefault(key, [])
+            for r in records:
+                if r.bitmap == bitmap and r.sig == stored_sig:
+                    return "duplicate", r
+                if r.bitmap == bitmap:
+                    conflict = True
+                    continue
+                sup = bits_of(r.bits)
+                if len(sup) == len(bits) and np.array_equal(
+                    bits_or(sup, bits), sup
+                ) and not np.array_equal(sup, bits):
+                    # incoming | stored == stored, and not equal:
+                    # strictly covered by an already-stored partial
+                    return "covered", None
+            if trace_id is None:
+                trace_id = f"{tracing.node_id()}-ovl-{len(records)}-{key.hex()[:8]}"
+            rec = _Partial(
+                key, bits, bitmap, stored_sig, data, data_ssz, origin,
+                digest, trace_id, now,
+            )
+            records.append(rec)
+            locks.access(self, "counters", "write")
+            if conflict:
+                self.counters["conflicts"] += 1
+            pending = self._pending_locked()
+        PARTIALS.set(pending)
+        # root role: merge into the local tier OUTSIDE the store lock
+        # (insert takes the tier's entry lock; keep the order
+        # overlay.state -> aggregation.entries one-way) — the tier's
+        # flush settles it through the device kernels exactly as a
+        # locally-gossiped attestation would
+        if is_root and origin != _LOCAL:
+            self.tier.merge_partial(self._template(data, bits, stored_sig),
+                                    bits, stored_sig)
+        return ("conflict" if conflict else "accepted"), rec
+
+    def _template(self, data, bits, sig):
+        from ..types.state import state_types
+
+        T = state_types(self.tier.spec.preset)
+        return T.Attestation(
+            aggregation_bits=[int(x) for x in bits],
+            data=data,
+            signature=bytes(sig),
+        )
+
+    # ------------------------------------------------------------- tick
+
+    def tick(self):
+        """One overlay pass: dial configured members, export locally
+        settled partials, push/forward pending partials upstream, run
+        one seeded audit probe, prune aged acked records.  Returns the
+        number of successful pushes."""
+        self._dial_tick()
+        self._export_tick()
+        pushed = self._push_tick()
+        self._audit_tick()
+        self._prune_tick()
+        return pushed
+
+    def _dial_tick(self):
+        changed = False
+        now = self._clock()
+        for addr, st in self._dial_state.items():
+            pid = st["pid"]
+            if pid is not None and pid in self.wire.peers:
+                continue
+            if now < st["next_try"]:
+                continue
+            try:
+                st["pid"] = self.wire.dial(addr[0], int(addr[1]), timeout=2.0)
+                changed = True
+            except (WireError, OSError):
+                st["next_try"] = now + 5.0
+        if changed or self._dial_state:
+            ids = {st["pid"] for st in self._dial_state.values() if st["pid"]}
+            if ids:
+                self.set_members(set(self.members) | ids)
+
+    def _export_tick(self):
+        """Locally settled tier entries enter the store as _LOCAL
+        partials (the edge role; on the root they are already in the
+        tier and only recorded for idempotence/stats)."""
+        from ..ssz import encode, hash_tree_root
+        from ..types.containers import AttestationData
+
+        for att, bits, sig in self.tier.export_partials():
+            key = bytes(hash_tree_root(att.data))
+            self._record(
+                key, bytes(encode(AttestationData, att.data)), att.data,
+                bits, sig, origin=_LOCAL,
+            )
+
+    def _usable(self, pid):
+        if pid not in self.wire.peers:
+            return False
+        t = self._target(pid)
+        with t.lock:
+            return not t.quarantined and t.breaker.allow_device()
+
+    def _push_tick(self):
+        """Push every partial to its first `parents_n` usable parent
+        candidates (redundant parents).  Snapshot under the store lock;
+        all wire I/O outside it."""
+        with self._lock:
+            locks.access(self, "partials", "read")
+            todo = [
+                rec
+                for records in self.partials.values()
+                for rec in records
+            ]
+        pushed = 0
+        for rec in todo:
+            cands = self.parent_candidates(rec.key)
+            if not cands:
+                continue   # root for this key
+            primaries = set(cands[: self.parents_n])
+            effective = [p for p in cands if self._usable(p)][: self.parents_n]
+            for pid in effective:
+                if pid in rec.acked:
+                    continue
+                if pid not in primaries and pid not in rec.rehomed:
+                    with self._lock:
+                        locks.access(self, "counters", "write")
+                        rec.rehomed.add(pid)
+                        self.counters["rehomes"] += 1
+                    REHOMES.inc()
+                if self._push_one(rec, pid):
+                    pushed += 1
+        with self._lock:
+            locks.access(self, "partials", "read")
+            pending = self._pending_locked()
+        PARTIALS.set(pending)
+        return pushed
+
+    def _push_one(self, rec, pid, probe=False):
+        """One AGG_PUSH to one parent, with the digest audit on the ACK.
+        Never called under the store lock (wire I/O + breaker waits)."""
+        payload = encode_agg_push(
+            rec.key, rec.data_ssz, rec.bits, rec.sig, probe=probe,
+            trace_ctx=(rec.trace_id, tracing.node_id()),
+        )
+        target = self._target(pid)
+        tr = tracing.start_trace(
+            "overlay_push", parent_trace_id=rec.trace_id,
+            key=rec.key.hex()[:16], to=pid, probe=probe,
+        )
+        t0 = time.monotonic()
+        outcome = "error"
+        try:
+            failpoints.hit("overlay.push")
+            digest = self.wire.push_aggregate(
+                pid, payload, timeout=self.push_timeout
+            )
+        except PeerRateLimited:
+            outcome = "refused"
+            target.record_failure()
+        except (WireError, ConnectionError, OSError,
+                failpoints.FailpointError):
+            outcome = "error"
+            target.record_failure()
+        else:
+            expected = agg_push_digest(rec.key, rec.bits, rec.sig)
+            if digest != expected:
+                outcome = "equivocation"
+                self._quarantine(pid, "store digest mismatch")
+            else:
+                outcome = "ok"
+                target.record_success(time.monotonic() - t0, 0)
+                with self._lock:
+                    locks.access(self, "partials", "write")
+                    rec.acked.add(pid)
+        finally:
+            tr.add_span("agg_push", t0, time.monotonic(), outcome=outcome)
+            tr.finish(outcome=outcome)
+        with self._lock:
+            locks.access(self, "counters", "write")
+            c = self.counters["pushes"]
+            c[outcome] = c.get(outcome, 0) + 1
+            self.counters["push_bytes"] += len(payload)
+            if probe:
+                self.counters["audits"] += 1
+        PUSHES.with_labels(outcome).inc()
+        PUSH_BYTES.inc(len(payload))
+        return outcome == "ok"
+
+    def _audit_tick(self):
+        """Seeded 2G2T-style recombination probe: re-push one random
+        already-acked partial and require the parent's stored digest to
+        still match — catches an aggregator that corrupted its store
+        AFTER acking honestly."""
+        if self.audit_rate <= 0 or self._rng.random() >= self.audit_rate:
+            return
+        with self._lock:
+            locks.access(self, "partials", "read")
+            pairs = [
+                (rec, pid)
+                for records in self.partials.values()
+                for rec in records
+                for pid in rec.acked
+            ]
+        if not pairs:
+            return
+        rec, pid = pairs[self._rng.randrange(len(pairs))]
+        if self._usable(pid):
+            self._push_one(rec, pid, probe=True)
+
+    def _quarantine(self, pid, why):
+        target = self._target(pid)
+        quarantine_target(
+            target, self.quarantine_cooldown,
+            f"overlay audit: {why}", log=log,
+        )
+        with self._lock:
+            locks.access(self, "partials", "write")
+            # an equivocator's acks are worthless: re-push everything it
+            # claimed to hold to the re-homed parent set
+            for records in self.partials.values():
+                for rec in records:
+                    rec.acked.discard(pid)
+            locks.access(self, "counters", "write")
+            self.counters["quarantines"] += 1
+        QUARANTINES.inc()
+
+    def _prune_tick(self):
+        now = self._clock()
+        with self._lock:
+            locks.access(self, "partials", "write")
+            for key in list(self.partials):
+                owed = bool(self.parent_candidates(key))
+                kept = [
+                    r for r in self.partials[key]
+                    if (owed and not r.acked)
+                    or now - r.recorded_at < self.ttl
+                ]
+                if kept:
+                    self.partials[key] = kept
+                else:
+                    del self.partials[key]
+
+    # ------------------------------------------------- snapshot/restore
+
+    def snapshot(self):
+        """SSZ-hex synthetic attestations, one per partial not yet
+        acked by any parent (the PR-9 tier snapshot rule lifted to the
+        overlay store): a restarted interior node re-records and
+        re-pushes everything it had not handed upstream — nothing is
+        lost with the process."""
+        from ..ssz import encode
+
+        out = []
+        with self._lock:
+            locks.access(self, "partials", "read")
+            records = [
+                r
+                for key, rs in self.partials.items()
+                if self.parent_candidates(key)   # root records already
+                for r in rs                      # live in the tier snapshot
+                if not r.acked
+            ]
+        for rec in records:
+            att = self._template(rec.data, rec.bits, rec.sig)
+            out.append(bytes(encode(type(att), att)).hex())
+        return out
+
+    def restore(self, snap):
+        """Re-record snapshotted partials (restore origin: tier-merged
+        if this node is now the key's root, pushed upstream otherwise)."""
+        from ..ssz import decode, encode, hash_tree_root
+        from ..types.containers import AttestationData
+        from ..types.state import state_types
+
+        T = state_types(self.tier.spec.preset)
+        n = 0
+        for blob in snap or []:
+            att = decode(T.Attestation, bytes.fromhex(blob))
+            bits = bits_of(att.aggregation_bits)
+            self._record(
+                bytes(hash_tree_root(att.data)),
+                bytes(encode(AttestationData, att.data)),
+                att.data, bits, bytes(att.signature), origin="restore",
+            )
+            n += 1
+        return n
+
+    # ------------------------------------------------------------ stats
+
+    def stats(self):
+        with self._lock:
+            locks.access(self, "partials", "read")
+            total = sum(len(rs) for rs in self.partials.values())
+            pending = self._pending_locked()
+            keys = list(self.partials)
+            counters = {
+                k: (dict(v) if isinstance(v, dict) else v)
+                for k, v in self.counters.items()
+            }
+            targets = list(self._targets.values())
+            members = list(self.members)
+        sample = []
+        for key in keys[:3]:
+            cands = self.parent_candidates(key)
+            sample.append({
+                "key": key.hex(),
+                "role": self.role(key),
+                "parents": cands[: self.parents_n],
+                "children": self.children_for(key),
+            })
+        return {
+            "enabled": True,
+            "node": self.node_id,
+            "members": members,
+            "parents_redundancy": self.parents_n,
+            "fanout": self.fanout,
+            "partials": total,
+            "pending": pending,
+            "committee_keys": len(keys),
+            "sample_topology": sample,
+            "targets": [t.snapshot() for t in targets],
+            **counters,
+        }

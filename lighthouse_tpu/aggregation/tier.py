@@ -355,6 +355,44 @@ class AggregationTier:
                     for b, sig in entry["contribs"]:
                         yield entry["att"], b, sig
 
+    # ------------------------------------------------- overlay partials
+
+    def export_partials(self):
+        """Settled partial aggregates for the distributed aggregation
+        overlay: flush first (so every export carries canonical settled
+        signature bytes — the overlay's idempotence and audit digests
+        key on them), then snapshot every validated entry under the
+        entry lock.  Returns [(template attestation, uint8 bits, sig
+        bytes)] — one partial per settled entry, no curve math here.
+
+        Read-only with respect to the pool: the entries stay live for
+        local block packing; the overlay dedups re-exports by (committee
+        key, bitset) so pushing the same settled partial every tick
+        costs one store lookup upstream, not re-aggregation."""
+        self.flush("export")
+        out = []
+        with self._lock:
+            locks.access(self, "entries", "read")
+            for entries in self.entries.values():
+                for entry in entries:
+                    if not entry["validated"] or len(entry["contribs"]) != 1:
+                        continue
+                    bits, sig = entry["contribs"][0]
+                    out.append((entry["att"], np.array(bits, copy=True), sig))
+        return out
+
+    def merge_partial(self, template, bits, sig):
+        """Ingest one partial aggregate received from the overlay as a
+        synthetic attestation (the PR-9 snapshot rule: bits + settled
+        sig on the template).  Rides the normal O(bytes) insert, so the
+        bits-only grouping — and therefore the flushed settled bytes —
+        is identical to having seen the raw traffic locally."""
+        att = template.copy()
+        att.aggregation_bits = [int(x) for x in bits]
+        att.signature = bytes(sig)
+        self.insert(att)
+        return att
+
     def stats(self):
         with self._lock:
             from ..crypto.tpu import aggregation as ta

@@ -833,6 +833,18 @@ class _Handler(JsonHandler):
             # and the device/flush-policy knobs in force
             self._json({"data": chain.op_pool.aggregation.stats()})
             return True
+        if path == "/lighthouse/overlay":
+            # distributed aggregation overlay: membership, per-key
+            # topology sample (role/parents/children), pending-partial
+            # depth, push/receive/rehome/quarantine counters, and the
+            # per-parent breaker states — the operator view of "where do
+            # my partials go and which aggregator is benched"
+            overlay = getattr(chain, "overlay", None)
+            if overlay is None:
+                self._json({"data": {"enabled": False}})
+                return True
+            self._json({"data": overlay.stats()})
+            return True
         if path == "/lighthouse/compile-cache":
             # compile-lifecycle status: the persistent AOT executable
             # cache (hits/misses/loaded programs), the canonical shape

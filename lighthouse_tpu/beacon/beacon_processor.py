@@ -157,6 +157,12 @@ class BeaconProcessor:
         pool = getattr(self.chain, "op_pool", None)
         if pool is not None and hasattr(pool, "maybe_flush"):
             pool.maybe_flush()
+        # distributed aggregation overlay: export freshly settled
+        # partials and push them upstream on the same cadence (the tick
+        # is a no-op sweep when nothing settled and all parents acked)
+        overlay = getattr(self.chain, "overlay", None)
+        if overlay is not None:
+            overlay.tick()
         return handled
 
     def _process_block_event(self, ev):

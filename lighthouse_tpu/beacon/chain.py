@@ -1321,13 +1321,34 @@ class BeaconChain:
 
     # -------------------------------------------------------- persistence
 
+    def attach_overlay(self, overlay):
+        """Enroll the distributed aggregation overlay: the processor's
+        pending tick drives it, persist() snapshots its unsettled
+        partials, and a snapshot taken before this attach (from_store on
+        a restarted node) is replayed now so nothing is lost across the
+        restart."""
+        self.overlay = overlay
+        pending = getattr(self, "_pending_overlay_partials", None)
+        if pending:
+            overlay.restore(pending)
+        self._pending_overlay_partials = None
+        return overlay
+
     def persist(self):
         """PersistedBeaconChain + PersistedForkChoice + PersistedOperationPool
         (beacon_chain/src/persisted_*.rs, operation_pool/persistence.rs):
         everything needed to resume after restart goes into store meta."""
         if not hasattr(self.store, "put_meta"):
             return False
-        self.store.put_meta("persisted_op_pool", self.op_pool.snapshot())
+        pool_snap = self.op_pool.snapshot()
+        overlay = getattr(self, "overlay", None)
+        if overlay is not None:
+            # pending overlay partials ride the op-pool snapshot (one
+            # synthetic attestation per contribution not yet handed
+            # upstream — the PR-9 tier snapshot rule at the overlay
+            # layer), so a restarted interior aggregator loses nothing
+            pool_snap["overlay_partials"] = overlay.snapshot()
+        self.store.put_meta("persisted_op_pool", pool_snap)
         fc = self.fork_choice
         nodes = [
             {
@@ -1438,6 +1459,9 @@ class BeaconChain:
         pool = store.get_meta("persisted_op_pool")
         if pool is not None:
             chain.op_pool.restore(pool)
+            # the overlay (if any) is attached later by the builder —
+            # its pending partials wait on the chain until then
+            chain._pending_overlay_partials = pool.get("overlay_partials")
         return chain
 
     def on_invalid_execution_payload(self, block_root):

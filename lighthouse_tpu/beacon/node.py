@@ -411,6 +411,7 @@ class ClientBuilder:
         self._disc_port = 0
         self._disc_sk = None
         self._remote_verifiers = None   # None = read LTPU_REMOTE_VERIFIERS
+        self._overlay = None            # None = read LTPU_OVERLAY
 
     def genesis_state(self, state):
         self._genesis_state = state
@@ -471,6 +472,14 @@ class ClientBuilder:
         as the first backend tier; an empty list disables the fabric
         even when LTPU_REMOTE_VERIFIERS is set."""
         self._remote_verifiers = list(targets)
+        return self
+
+    def aggregation_overlay(self, peers):
+        """Enroll this node in the distributed aggregation overlay with
+        the given static host:port member endpoints (the Wonderboom
+        tree, aggregation/overlay.py); an empty list disables the
+        overlay even when LTPU_OVERLAY is set."""
+        self._overlay = list(peers)
         return self
 
     def build(self) -> BeaconNode:
@@ -562,6 +571,26 @@ class ClientBuilder:
                 verify_service.attach_remote(RemoteVerifierPool(
                     targets, WireTransport(wire),
                     audit_verifier=SignatureVerifier("native"),
+                ))
+
+            # distributed aggregation overlay (aggregation/overlay.py):
+            # member endpoints from the builder, else LTPU_OVERLAY
+            # (comma-separated host:port).  The overlay rides this
+            # node's own wire and feeds the op-pool's aggregation tier.
+            overlay_peers = self._overlay
+            if overlay_peers is None:
+                env = os.environ.get("LTPU_OVERLAY", "")
+                overlay_peers = [t.strip() for t in env.split(",")
+                                 if t.strip()]
+            if overlay_peers:
+                from ..aggregation import AggregationOverlay
+
+                dial = []
+                for ep in overlay_peers:
+                    host, _, port = ep.rpartition(":")
+                    dial.append((host or "127.0.0.1", int(port)))
+                chain.attach_overlay(AggregationOverlay(
+                    wire, chain.op_pool.aggregation, dial=dial,
                 ))
         discovery = None
         if self._disc_boot is not None and wire is not None:

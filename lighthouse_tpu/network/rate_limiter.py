@@ -47,6 +47,10 @@ DEFAULT_QUOTAS = {
     # block-count charging): one giant batch costs what many small ones
     # do, so a single client cannot monopolize the verifier host
     "verify_batch": Quota(8192, 10.0),
+    # aggregation-overlay pushes: one token per partial — generous
+    # (redundant parents re-push settled partials every flush tick) but
+    # bounded, so a hostile child cannot spin an interior node's store
+    "agg_push": Quota(4096, 10.0),
 }
 
 

@@ -59,6 +59,8 @@ IHAVE = 13     # lazy gossip: message ids I hold for <topic> (to non-mesh)
 IWANT = 14     # lazy gossip: send me these message ids
 VERIFY_REQ = 15   # batch-verify request: compressed SignatureSet batch
 VERIFY_RESP = 16  # batch-verify response: per-set verdicts + load hint
+AGG_PUSH = 17     # aggregation overlay: partial aggregate + bitset upstream
+AGG_ACK = 18      # aggregation overlay: push acknowledgement + stored digest
 
 # mesh degree bounds (gossipsub D / D_lo / D_hi; service/gossipsub defaults)
 MESH_D = 6
@@ -110,6 +112,18 @@ MAX_VERIFY_SETS = 1024            # sets per batch-verify request
 MAX_VERIFY_PUBKEYS = 512          # pubkeys per signature set
 MAX_VERIFY_BODY = 1 << 22         # encoded request payload bytes (4 MiB)
 MAX_VERIFY_INFLIGHT = 8           # concurrent verify-serve threads
+
+# aggregation-overlay codec caps (same contract as the verify caps: a
+# malformed AGG_PUSH raises typed WireError and is answered
+# R_INVALID_REQUEST — the connection survives; only unaddressable floods
+# past the body cap drop it)
+MAX_AGG_BITS = 1 << 12            # participation flags per partial
+MAX_AGG_DATA = 1 << 10            # SSZ AttestationData template bytes
+MAX_AGG_PUSH_BODY = 1 << 13      # encoded push payload bytes (8 KiB)
+AGG_SIG_LEN = 96                  # compressed G2 partial aggregate
+AGG_DIGEST_LEN = 32               # sha256 store digest in the ACK
+AGG_F_PROBE = 0x01                # audit re-push: answer from the store
+AGG_F_TRACE = 0x02                # trace context appended (id + origin)
 
 
 class StatusMessage(Container):
@@ -447,6 +461,141 @@ def decode_verify_response(payload):
                             "spans": spans}
 
 
+def encode_agg_push(key, data_ssz, bits, sig, probe=False, trace_ctx=None):
+    """AGG_PUSH payload: one partial aggregate travelling up the
+    aggregation overlay.
+
+      flags:u8 || key:32 || data_len:u16 || data_ssz
+      || n_bits:u16 || bitmap:ceil(n/8) || sig:96 [|| trace tail]
+
+    `key` is the committee key (hash_tree_root of the AttestationData),
+    `data_ssz` the SSZ-encoded AttestationData template, `bits` the 0/1
+    participation flags (packed 8-per-byte on the wire), `sig` the
+    settled compressed partial aggregate.  `trace_ctx` = (trace_id,
+    origin) stitches the edge->interior->root hop chain into one
+    distributed trace."""
+    bits = [int(b) & 1 for b in bits]
+    n = len(bits)
+    if not 0 < n <= MAX_AGG_BITS:
+        raise WireError(f"{n} participation bits outside [1, {MAX_AGG_BITS}]")
+    key = bytes(key)
+    if len(key) != AGG_DIGEST_LEN:
+        raise WireError(f"committee key must be 32 bytes, got {len(key)}")
+    data_ssz = bytes(data_ssz)
+    if not 0 < len(data_ssz) <= MAX_AGG_DATA:
+        raise WireError(
+            f"attestation data {len(data_ssz)}B outside [1, {MAX_AGG_DATA}]"
+        )
+    sig = bytes(sig)
+    if len(sig) != AGG_SIG_LEN:
+        raise WireError(f"partial signature must be 96 bytes, got {len(sig)}")
+    bitmap = bytearray((n + 7) // 8)
+    for i, b in enumerate(bits):
+        if b:
+            bitmap[i >> 3] |= 1 << (i & 7)
+    flags = AGG_F_PROBE if probe else 0
+    tail = b""
+    if trace_ctx is not None:
+        tid = str(trace_ctx[0]).encode()
+        origin = str(trace_ctx[1]).encode()
+        if len(tid) > MAX_TRACE_ID_BYTES or len(origin) > MAX_TRACE_ID_BYTES:
+            raise WireError("overlay trace context exceeds id cap")
+        flags |= AGG_F_TRACE
+        tail = (
+            struct.pack("<B", len(tid)) + tid
+            + struct.pack("<B", len(origin)) + origin
+        )
+    body = (
+        struct.pack("<B", flags) + key
+        + struct.pack("<H", len(data_ssz)) + data_ssz
+        + struct.pack("<H", n) + bytes(bitmap)
+        + sig + tail
+    )
+    if len(body) > MAX_AGG_PUSH_BODY:
+        raise WireError(
+            f"AGG_PUSH payload {len(body)}B exceeds {MAX_AGG_PUSH_BODY}"
+        )
+    return body
+
+
+def decode_agg_push(payload):
+    """Inverse of encode_agg_push with the verify-codec trust contract:
+    bounds are checked BEFORE any allocation they justify, every
+    malformed shape raises WireError (answered R_INVALID_REQUEST — the
+    connection survives), trailing bytes are an error."""
+    end = len(payload)
+    if end > MAX_AGG_PUSH_BODY:
+        raise WireError(
+            f"AGG_PUSH payload {end}B exceeds {MAX_AGG_PUSH_BODY}"
+        )
+    pos = 0
+
+    def take(k, what):
+        nonlocal pos
+        if pos + k > end:
+            raise WireError(f"truncated AGG_PUSH ({what})")
+        chunk = payload[pos:pos + k]
+        pos += k
+        return chunk
+
+    flags = take(1, "flags")[0]
+    if flags & ~(AGG_F_PROBE | AGG_F_TRACE):
+        raise WireError(f"unknown AGG_PUSH flag bits 0x{flags:02x}")
+    key = bytes(take(AGG_DIGEST_LEN, "committee key"))
+    (data_len,) = struct.unpack("<H", take(2, "data length"))
+    if not 0 < data_len <= MAX_AGG_DATA:
+        raise WireError(
+            f"attestation data {data_len}B outside [1, {MAX_AGG_DATA}]"
+        )
+    data_ssz = bytes(take(data_len, "attestation data"))
+    (n,) = struct.unpack("<H", take(2, "bit count"))
+    if not 0 < n <= MAX_AGG_BITS:
+        raise WireError(f"{n} participation bits outside [1, {MAX_AGG_BITS}]")
+    bitmap = take((n + 7) // 8, "participation bitmap")
+    if n & 7 and bitmap[-1] >> (n & 7):
+        raise WireError("bitmap sets bits past the declared length")
+    bits = [(bitmap[i >> 3] >> (i & 7)) & 1 for i in range(n)]
+    if not any(bits):
+        raise WireError("empty participation bitset")
+    sig = bytes(take(AGG_SIG_LEN, "partial signature"))
+    trace_ctx = None
+    if flags & AGG_F_TRACE:
+        id_len = take(1, "trace id length")[0]
+        if id_len > MAX_TRACE_ID_BYTES:
+            raise WireError(f"trace id {id_len}B exceeds cap")
+        tid = bytes(take(id_len, "trace id")).decode(errors="replace")
+        o_len = take(1, "trace origin length")[0]
+        if o_len > MAX_TRACE_ID_BYTES:
+            raise WireError(f"trace origin {o_len}B exceeds cap")
+        origin = bytes(take(o_len, "trace origin")).decode(errors="replace")
+        trace_ctx = (tid, origin)
+    if pos != end:
+        raise WireError(f"{end - pos} trailing bytes after AGG_PUSH payload")
+    return {
+        "key": key,
+        "data_ssz": data_ssz,
+        "bits": bits,
+        "sig": sig,
+        "probe": bool(flags & AGG_F_PROBE),
+        "trace_ctx": trace_ctx,
+    }
+
+
+def agg_push_digest(key, bits, sig):
+    """The store digest an honest receiver commits to in its AGG_ACK:
+    sha256 over the canonical (key, packed bitmap, sig) triple AS
+    STORED.  The pushing child recomputes it from its own bytes — a
+    mismatch is equivocation evidence (the 2G2T audit seam, bits-only)."""
+    bits = [int(b) & 1 for b in bits]
+    bitmap = bytearray((len(bits) + 7) // 8)
+    for i, b in enumerate(bits):
+        if b:
+            bitmap[i >> 3] |= 1 << (i & 7)
+    return hashlib.sha256(
+        bytes(key) + struct.pack("<H", len(bits)) + bytes(bitmap) + bytes(sig)
+    ).digest()
+
+
 class GossipCodec:
     """topic prefix -> SSZ encode/decode of the gossip payloads
     (types/pubsub.rs PubsubMessage::decode)."""
@@ -588,6 +737,13 @@ class WireNode:
         # many client nodes.  None on both counts -> not a verifier;
         # requests are answered R_RESOURCE_UNAVAILABLE.
         self.verify_service = verify_service
+        # aggregation-overlay role: inbound AGG_PUSH partials feed this
+        # AggregationOverlay (attached by the node builder / fabric);
+        # None -> not enrolled, pushes are answered R_RESOURCE_UNAVAILABLE.
+        # Overlay frames are only ever SENT to enrolled members, so a
+        # legacy peer never sees frame types it would drop the
+        # connection over.
+        self.overlay = None
         # per-host serve slowdown (seconds) — the chaos harness's
         # per-target analogue of the process-global `remote.serve`
         # delay failpoint (simulator slow-verifier scenario)
@@ -1001,6 +1157,10 @@ class WireNode:
             self._on_verify_req(peer, body)
         elif ftype == VERIFY_RESP:
             self._on_verify_resp(peer, body)
+        elif ftype == AGG_PUSH:
+            self._on_agg_push(peer, body)
+        elif ftype == AGG_ACK:
+            self._on_agg_ack(peer, body)
         elif ftype == GOODBYE_FRAME:
             peer.close()
         else:
@@ -1832,6 +1992,87 @@ class WireNode:
             rec[1] = decode_verify_response(body[5:])
         rec[2] = code
         rec[0].set()
+
+    # ------------------------------------------- aggregation overlay role
+
+    def _on_agg_push(self, peer, body):
+        """AGG_PUSH dispatch (reader thread): unlike VERIFY_REQ the
+        overlay store insert is O(bytes) bits-only bookkeeping — no
+        curve math, no kernel — so it serves inline.  Every addressable
+        failure answers a typed AGG_ACK and the connection survives;
+        only an unaddressable flood past the body cap drops it."""
+        if len(body) < 4:
+            raise WireError("truncated aggregation push")
+        if len(body) > MAX_AGG_PUSH_BODY + 4:
+            raise WireError("aggregation push exceeds size cap")
+        rid = struct.unpack("<I", body[:4])[0]
+        digest = b"\x00" * AGG_DIGEST_LEN
+        try:
+            if self.overlay is None:
+                code = R_RESOURCE_UNAVAILABLE   # not enrolled in a tree
+            else:
+                self.limiter.check(peer.peer_id, "agg_push", 1)
+                frame = decode_agg_push(body[4:])
+                code, digest = self.overlay.on_push(peer.peer_id, frame)
+        except RateLimited:
+            code = R_RESOURCE_UNAVAILABLE
+            self._score(peer, -5.0)
+        except WireError:
+            code = R_INVALID_REQUEST
+            self._score(peer, -5.0)
+        except Exception:
+            code = R_SERVER_ERROR
+        try:
+            peer.send_frame(AGG_ACK, struct.pack("<IB", rid, code) + digest)
+        except (ConnectionError, OSError):
+            pass   # pusher gone; its timeout handles the rest
+
+    def _on_agg_ack(self, peer, body):
+        """Client side: complete the pending overlay push."""
+        if len(body) != 5 + AGG_DIGEST_LEN:
+            raise WireError("bad aggregation ack length")
+        rid, code = struct.unpack("<IB", body[:5])
+        with self._lock:
+            rec = self._pending.get(rid)
+        # unknown/expired rid, an impersonating peer, or a peer
+        # answering a verify/rpc request with an overlay frame
+        if rec is None or rec[3] is not peer or rec[6] != "agg":
+            return
+        rec[1] = bytes(body[5:])
+        rec[2] = code
+        rec[0].set()
+
+    def push_aggregate(self, peer_id, payload, timeout=5.0):
+        """Send one encoded overlay push (encode_agg_push output) and
+        wait for the AGG_ACK.  Returns the receiver's 32-byte store
+        digest.  Raises PeerRateLimited when the receiver refused
+        (quota / not enrolled), WireError on every other failure —
+        timeout and disconnect included — so the overlay's per-parent
+        breaker sees one failure currency."""
+        peer = self.peers.get(peer_id)
+        if peer is None:
+            raise WireError(f"not connected to {peer_id}")
+        if len(payload) > MAX_AGG_PUSH_BODY:
+            raise WireError("aggregation push exceeds size cap")
+        with self._lock:
+            locks.access(self, "_pending", "write")
+            self._req_id += 1
+            rid = self._req_id
+            rec = [threading.Event(), None, None, peer, {}, None, "agg"]
+            self._pending[rid] = rec
+        try:
+            peer.send_frame(AGG_PUSH, struct.pack("<I", rid) + payload)
+            if not rec[0].wait(timeout):
+                raise WireError("aggregation push timed out")
+            if rec[2] == R_RESOURCE_UNAVAILABLE:
+                raise PeerRateLimited("aggregation push refused (quota/role)")
+            if rec[2] != R_SUCCESS or rec[1] is None:
+                raise WireError(f"aggregation push failed: code {rec[2]}")
+            return rec[1]
+        finally:
+            with self._lock:
+                locks.access(self, "_pending", "write")
+                self._pending.pop(rid, None)
 
     def request_verify_batch(self, peer_id, payload, timeout=5.0):
         """Send one encoded batch-verify request (encode_verify_request

@@ -430,3 +430,244 @@ class RemoteVerifyFabric:
         assert quarantined and quarantined[0].breaker.state == OPEN, snap
         self.step_and_check()
         return snap
+
+
+class OverlayNode:
+    """One aggregation-overlay member: a chainless boot-node WireNode
+    plus its own AggregationTier and AggregationOverlay — the tree role
+    (edge/interior/root per committee key) without a chain."""
+
+    def __init__(self, name, spec, **overlay_kw):
+        from ..aggregation import AggregationOverlay, AggregationTier
+        from ..network.wire import WireNode
+
+        self.name = name
+        self.wire = WireNode(
+            None, accept_any_fork=True, peer_id=name, quotas={}
+        )
+        self.tier = AggregationTier(spec)
+        self.tier.flush_interval = 0.0   # settle every tick (test cadence)
+        self.overlay = AggregationOverlay(self.wire, self.tier, **overlay_kw)
+
+    def stop(self):
+        self.wire.stop()
+
+
+class OverlayFabric:
+    """Chaos harness for the distributed aggregation overlay
+    (aggregation/overlay.py): n mesh-connected OverlayNodes with full
+    static membership, plus scenario methods that kill, corrupt and
+    partition interior aggregators mid-tree.  Every scenario asserts
+    the acceptance invariant — ZERO lost contributions (every injected
+    attestation's bit reaches the root's settled aggregate) — and the
+    clean/loss/partition paths additionally assert that the root tier's
+    settled bytes are byte-identical to single-node aggregation of the
+    same traffic (a reference tier fed every raw attestation)."""
+
+    def __init__(self, spec=None, n=5, fanout=2, parents=2, seed=7,
+                 breaker_threshold=2, breaker_cooldown=0.4,
+                 quarantine_cooldown=30.0, audit_rate=0.0):
+        from ..aggregation import AggregationTier
+        from ..testing.scale import make_signature_pool
+        from ..types import ChainSpec, MinimalPreset
+        from ..types.containers import AttestationData, Checkpoint
+
+        self.spec = spec or ChainSpec(preset=MinimalPreset)
+        self.T = state_types(self.spec.preset)
+        self._Data, self._Checkpoint = AttestationData, Checkpoint
+        self.nodes = [
+            OverlayNode(
+                f"agg{i}", self.spec, parents=parents, fanout=fanout,
+                audit_rate=audit_rate, seed=seed, push_timeout=0.75,
+                breaker_threshold=breaker_threshold,
+                breaker_cooldown=breaker_cooldown,
+                quarantine_cooldown=quarantine_cooldown,
+            )
+            for i in range(n)
+        ]
+        self.ids = [node.name for node in self.nodes]
+        for a in self.nodes:          # mesh: any (child, parent) works
+            for b in self.nodes:
+                if a is not b:
+                    a.wire.dial("127.0.0.1", b.wire.port)
+        for node in self.nodes:
+            node.overlay.set_members(self.ids)
+        self.reference = AggregationTier(self.spec)
+        self.sigs = make_signature_pool(64)
+        self.clen = 16
+
+    def stop(self):
+        for node in self.nodes:
+            node.stop()
+
+    # ---------------------------------------------------------- plumbing
+
+    def data(self, index=0, slot=0, root=b"\x42" * 32):
+        return self._Data(
+            slot=slot, index=index, beacon_block_root=root,
+            source=self._Checkpoint(epoch=0, root=b"\x00" * 32),
+            target=self._Checkpoint(epoch=0, root=root),
+        )
+
+    def key_of(self, data):
+        from ..ssz import hash_tree_root
+
+        return bytes(hash_tree_root(data))
+
+    def attestation(self, i, data):
+        bits = [0] * self.clen
+        bits[i] = 1
+        return self.T.Attestation(
+            aggregation_bits=bits, data=data, signature=self.sigs[i]
+        )
+
+    def by_role(self, key, role):
+        return [n for n in self.nodes if n.overlay.role(key) == role]
+
+    def root_node(self, key):
+        return self.by_role(key, "root")[0]
+
+    def inject(self, data, n_atts, skip=()):
+        """One single-bit attestation per validator, spread round-robin
+        over the non-root, non-skipped nodes (edge gossip arrival); the
+        reference tier sees every raw attestation."""
+        key = self.key_of(data)
+        sinks = [
+            node for node in self.nodes
+            if node.overlay.role(key) != "root" and node.name not in skip
+        ]
+        for i in range(n_atts):
+            att = self.attestation(i, data)
+            self.reference.insert(att)
+            sinks[i % len(sinks)].tier.insert(att)
+        return key
+
+    def tick_all(self):
+        for node in self.nodes:
+            node.overlay.tick()
+
+    def settle(self, key, want_bits, deadline=15.0, skip=()):
+        """Tick until the root's settled coverage for `key` reaches
+        `want_bits` (the zero-lost-contributions half); returns the
+        root's settled (bits, sig) pairs."""
+        root = self.root_node(key)
+        t0 = time.monotonic()
+        while True:
+            for node in self.nodes:
+                if node.name not in skip:
+                    node.overlay.tick()
+            root.tier.flush("settle-check")
+            covered = set()
+            for e in root.tier.entries.get(key, []):
+                covered |= {i for i, b in enumerate(e["bits"]) if int(b)}
+            if covered == set(want_bits):
+                return self.pairs(root.tier, key)
+            assert time.monotonic() - t0 < deadline, (
+                f"contributions lost: root covers {sorted(covered)}, "
+                f"want {sorted(set(want_bits))}"
+            )
+            time.sleep(0.02)
+
+    @staticmethod
+    def pairs(tier, key):
+        out = []
+        for e in tier.entries.get(key, []):
+            out.append((
+                tuple(int(b) for b in e["bits"]),
+                bytes(e["att"].signature),
+            ))
+        return sorted(out)
+
+    def assert_byte_identical(self, root_pairs, key):
+        self.reference.flush("reference")
+        ref_pairs = self.pairs(self.reference, key)
+        assert root_pairs == ref_pairs, (
+            "root settled bytes diverge from single-node aggregation:\n"
+            f"  root: {root_pairs!r}\n  ref:  {ref_pairs!r}"
+        )
+
+    # ---------------------------------------------------------- scenarios
+
+    def scenario_clean_tree(self, n_atts=12):
+        """Happy path: every contribution climbs the tree and the root's
+        settled bytes are byte-identical to single-node aggregation."""
+        key = self.inject(self.data(index=0), n_atts)
+        pairs = self.settle(key, range(n_atts))
+        self.assert_byte_identical(pairs, key)
+        return pairs
+
+    def scenario_aggregator_loss(self, n_atts=12):
+        """Interior aggregator dies mid-tree: its children's pushes fail,
+        the per-parent breaker trips, and every partial re-homes to the
+        backup parent — zero lost contributions, bytes still identical."""
+        key = self.inject(self.data(index=1), n_atts, skip=())
+        interior = self.by_role(key, "interior")[0]
+        # one tick seeds partials (some acked by the doomed interior,
+        # some not), then the interior vanishes with whatever it holds
+        self.tick_all()
+        interior.stop()
+        pairs = self.settle(key, range(n_atts), skip={interior.name})
+        self.assert_byte_identical(pairs, key)
+        rehomes = sum(
+            n.overlay.stats()["rehomes"] for n in self.nodes
+            if n.name != interior.name
+        )
+        assert rehomes >= 1, "loss of an interior parent must re-home"
+        return pairs
+
+    def scenario_equivocating_aggregator(self, n_atts=8):
+        """Byzantine interior aggregator re-writes every partial it
+        stores: children catch the store-digest mismatch on the AGG_ACK
+        (the 2G2T audit seam), quarantine it (breaker forced OPEN) and
+        re-home — zero lost contributions; the corrupted partials it
+        forwards are dropped individually by the root tier's flush-time
+        subgroup check."""
+        from ..verify_service.circuit import OPEN
+
+        data = self.data(index=2)
+        key = self.key_of(data)
+        # the byzantine node holds no honest local traffic — honest
+        # contributions only flow THROUGH it (suppressing its own
+        # attestation is its prerogative, not a lost contribution)
+        evil = self.by_role(key, "interior")[0]
+        evil.overlay.corrupt_store = True
+        self.inject(data, n_atts, skip={evil.name})
+        self.settle(key, range(n_atts))
+        catchers = [
+            n for n in self.nodes
+            if n.overlay.stats()["quarantines"] >= 1
+        ]
+        assert catchers, "no child caught the equivocating aggregator"
+        caught = catchers[0].overlay._target(evil.name)
+        assert caught.quarantined and caught.breaker.state == OPEN, (
+            caught.snapshot()
+        )
+        return catchers
+
+    def scenario_partition_heal(self, n_atts=10):
+        """Partition + heal: every upstream push fails (overlay.push
+        armed), partials pend at the edges with breakers OPEN; after the
+        heal the cooldown expires and everything drains to the root —
+        zero lost contributions, bytes identical."""
+        from ..utils import failpoints
+
+        key = self.inject(self.data(index=3), n_atts)
+        failpoints.configure("overlay.push", "error")
+        try:
+            for _ in range(4):
+                self.tick_all()
+            root = self.root_node(key)
+            root.tier.flush("partitioned")
+            assert key not in root.tier.entries, (
+                "partition leaked partials to the root"
+            )
+            pending = sum(
+                n.overlay.stats()["pending"] for n in self.nodes
+            )
+            assert pending >= 1, "partials must pend across the partition"
+        finally:
+            failpoints.reset()
+        time.sleep(self.nodes[0].overlay.breaker_cooldown + 0.05)
+        pairs = self.settle(key, range(n_atts))
+        self.assert_byte_identical(pairs, key)
+        return pairs

@@ -146,6 +146,25 @@ class RemoteTarget:
             }
 
 
+def quarantine_target(target, cooldown, why, log=log):
+    """Quarantine one RemoteTarget: breaker forced OPEN for `cooldown`
+    seconds and the target flagged until a post-cooldown probe succeeds
+    (record_success clears the flag once the breaker re-CLOSEs).
+
+    Shared by the remote-verify audit path and the aggregation
+    overlay's 2G2T store-digest audit — both catch the same class of
+    adversary (an intermediary re-writing or suppressing work it acked)
+    and both exile it through the same machinery."""
+    with target.lock:
+        target.audit_failures += 1
+        target.quarantined = True
+        target.breaker.force_open(cooldown=cooldown)
+    log.warning(
+        "%s QUARANTINED after failed audit (%s)",
+        target.name, why, quarantine_cooldown_s=cooldown,
+    )
+
+
 class _Job:
     """One batch riding the hedged dispatch: first verdict wins,
     duplicates are acknowledged but ignored (idempotent resolution).
@@ -662,16 +681,7 @@ class RemoteVerifierPool:
         if target is None:
             return
         M.REMOTE_AUDIT_FAILURES.with_labels(target.name).inc()
-        with target.lock:
-            target.audit_failures += 1
-            target.quarantined = True
-            target.breaker.force_open(cooldown=self.quarantine_cooldown)
-        log.warning(
-            "remote verifier %s QUARANTINED after failed audit (%s); "
-            "its batches re-verify locally",
-            target.name, why,
-            quarantine_cooldown_s=self.quarantine_cooldown,
-        )
+        quarantine_target(target, self.quarantine_cooldown, why)
 
     def _distrust(self, target, why):
         if target is None:

@@ -1,0 +1,282 @@
+"""EF consensus-spec-tests `operations` test-format runner.
+
+Point ``LTPU_EF_TESTS_DIR`` at an extracted consensus-spec-tests
+release (as for tests/test_ef_vectors.py and test_ef_fork_choice.py)
+and this module sweeps every ``operations`` case for the forks this
+repo models (phase0, altair): ``pre.ssz_snappy`` is decoded into the
+fork's BeaconState, the operation file is fed through the repo's own
+per-operation handler with signature verification ON (sets collected
+and batch-verified through crypto/ref/bls), and the mutated state's
+hash_tree_root must equal ``post.ssz_snappy``.  An absent post file
+means the operation MUST be rejected (an exception from the handler or
+a failed signature batch).
+
+Handlers covered: attestation (fork-dispatched phase0/altair),
+attester_slashing, proposer_slashing (altair slashing quotient where
+applicable), voluntary_exit, deposit, block_header (op file ``block``),
+sync_aggregate (altair).  Execution-fork cases and handlers this repo
+does not model (execution_payload, withdrawals, ...) are counted as
+skips, never failures.
+
+``*.ssz_snappy`` decodes through the repo's own `network/snappy`; when
+the env var is unset the sweep skips cleanly and synthetic self-tests
+generate miniature vector trees (real interop-signed slashings, an
+invalid op without a post file, a corrupted post) in tmp_path so tier-1
+always exercises the discovery → decoder → handler → comparison
+pipeline itself, including its ability to DETECT a wrong expectation.
+"""
+
+import os
+
+import pytest
+
+from lighthouse_tpu.crypto.ref import bls
+from lighthouse_tpu.network import snappy
+from lighthouse_tpu.ssz import decode, encode, hash_tree_root
+from lighthouse_tpu.state_processing import altair, phase0
+from lighthouse_tpu.types import ChainSpec, MainnetPreset, MinimalPreset
+from lighthouse_tpu.types import containers as C
+from lighthouse_tpu.types.state import state_types
+
+EF_DIR = os.environ.get("LTPU_EF_TESTS_DIR")
+
+_PRESETS = {"mainnet": MainnetPreset, "minimal": MinimalPreset}
+_FORK_SPECS = {
+    "phase0": ("", {}),
+    "altair": ("Altair", {"altair_fork_epoch": 0}),
+}
+# handler directory name -> the operation's ssz_snappy file stem
+_OP_FILES = {
+    "attestation": "attestation",
+    "attester_slashing": "attester_slashing",
+    "proposer_slashing": "proposer_slashing",
+    "deposit": "deposit",
+    "voluntary_exit": "voluntary_exit",
+    "block_header": "block",
+    "sync_aggregate": "sync_aggregate",
+}
+
+
+def _read_ssz(case_dir, name, cls):
+    with open(os.path.join(case_dir, name + ".ssz_snappy"), "rb") as f:
+        return decode(cls, snappy.decompress(f.read()))
+
+
+def _op_type(T, suffix, fork, handler):
+    return {
+        "attestation": lambda: T.Attestation,
+        "attester_slashing": lambda: C.AttesterSlashing,
+        "proposer_slashing": lambda: C.ProposerSlashing,
+        "deposit": lambda: C.Deposit,
+        "voluntary_exit": lambda: C.SignedVoluntaryExit,
+        "block_header": lambda: getattr(T, "BeaconBlock" + suffix),
+        "sync_aggregate": lambda: T.SyncAggregate,
+    }[handler]()
+
+
+def apply_operation(state, handler, op, spec, fork):
+    """Run one handler with verification ON; raises on any rejection
+    (structure assert or failed signature batch)."""
+    sets = []
+    get_pubkey = phase0._registry_pubkey_closure(state)
+    quotient = (
+        altair.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR
+        if fork == "altair"
+        else phase0.MIN_SLASHING_PENALTY_QUOTIENT
+    )
+    if handler == "attestation":
+        mod = altair if fork == "altair" else phase0
+        mod.process_attestation(state, op, spec, True, sets, get_pubkey)
+    elif handler == "proposer_slashing":
+        phase0.process_proposer_slashing(
+            state, op, spec, True, sets, get_pubkey,
+            slashing_quotient=quotient,
+        )
+    elif handler == "attester_slashing":
+        phase0.process_attester_slashing(
+            state, op, spec, True, sets, get_pubkey,
+            slashing_quotient=quotient,
+        )
+    elif handler == "voluntary_exit":
+        phase0.process_voluntary_exit(state, op, spec, True, sets, get_pubkey)
+    elif handler == "deposit":
+        mod = altair if fork == "altair" else phase0
+        mod.process_deposit(state, op, spec)
+    elif handler == "block_header":
+        phase0.process_block_header(state, op, spec.preset)
+    elif handler == "sync_aggregate":
+        altair.process_sync_aggregate(state, op, spec, True, sets, get_pubkey)
+    else:  # pragma: no cover — iter_cases filters to _OP_FILES
+        raise ValueError(f"unknown handler {handler}")
+    if sets:
+        assert bls.verify_signature_sets(sets), "signature batch invalid"
+
+
+def run_case(config, fork, handler, case_dir):
+    """Returns a list of mismatch strings for one case directory."""
+    preset = _PRESETS[config]
+    suffix, spec_kwargs = _FORK_SPECS[fork]
+    spec = ChainSpec(preset=preset, **spec_kwargs)
+    T = state_types(preset)
+
+    state = _read_ssz(case_dir, "pre", getattr(T, "BeaconState" + suffix))
+    op = _read_ssz(case_dir, _OP_FILES[handler],
+                   _op_type(T, suffix, fork, handler))
+    has_post = os.path.exists(os.path.join(case_dir, "post.ssz_snappy"))
+    try:
+        apply_operation(state, handler, op, spec, fork)
+        ok = True
+    except Exception:  # noqa: BLE001 — handler rejects are the contract
+        ok = False
+    if not has_post:
+        return [] if not ok else [f"{case_dir}: invalid op accepted"]
+    if not ok:
+        return [f"{case_dir}: valid op rejected"]
+    post = _read_ssz(case_dir, "post", getattr(T, "BeaconState" + suffix))
+    if bytes(hash_tree_root(state)) != bytes(hash_tree_root(post)):
+        return [f"{case_dir}: post-state root mismatch"]
+    return []
+
+
+def iter_cases(root_dir):
+    for dirpath, _dirnames, filenames in os.walk(root_dir):
+        if "pre.ssz_snappy" not in filenames:
+            continue
+        parts = dirpath.replace(os.sep, "/").split("/")
+        if "operations" not in parts:
+            continue
+        idx = parts.index("operations")
+        handler = parts[idx + 1] if idx + 1 < len(parts) else None
+        config = next((p for p in parts if p in _PRESETS), None)
+        fork = next((p for p in parts if p in _FORK_SPECS), None)
+        if config is None:
+            continue
+        yield config, fork, handler, dirpath
+
+
+def sweep(root_dir):
+    ran, skipped, failures = 0, 0, []
+    for config, fork, handler, case_dir in iter_cases(root_dir):
+        if fork is None or handler not in _OP_FILES:
+            skipped += 1   # execution forks / unmodelled handlers
+            continue
+        if fork == "phase0" and handler == "sync_aggregate":
+            skipped += 1
+            continue
+        try:
+            failures += run_case(config, fork, handler, case_dir)
+            ran += 1
+        except Exception as e:  # noqa: BLE001 — collect, report together
+            failures.append(f"{case_dir}: {e}")
+    return ran, skipped, failures
+
+
+@pytest.mark.skipif(
+    not EF_DIR, reason="LTPU_EF_TESTS_DIR not set (EF vectors absent)"
+)
+@pytest.mark.slow
+def test_ef_operations_sweep():
+    ran, skipped, failures = sweep(EF_DIR)
+    assert not failures, "\n".join(failures[:20])
+    assert ran > 0, f"no runnable operations cases under {EF_DIR}"
+
+
+# ------------------------------------------- synthetic self-test (tier-1)
+
+SPEC = ChainSpec(preset=MinimalPreset)
+
+
+def _write_ssz(case_dir, name, value):
+    os.makedirs(case_dir, exist_ok=True)
+    with open(os.path.join(case_dir, name + ".ssz_snappy"), "wb") as f:
+        f.write(snappy.compress(bytes(encode(type(value), value))))
+
+
+def _synthetic_tree(tmp_path, corrupt_post=False):
+    """A miniature operations vector tree: a valid proposer slashing, a
+    valid attester slashing, and an INVALID proposer slashing (identical
+    headers, no post file).  `corrupt_post` writes the pre state as the
+    valid case's post — the sweep must catch the lie."""
+    from lighthouse_tpu.testing.harness import Harness
+
+    root = os.path.join(tmp_path, "tests", "minimal", "phase0")
+    h = Harness(8, SPEC)
+
+    def emit(handler, op_name, op, case, valid=True):
+        case_dir = os.path.join(
+            root, "operations", handler, "pyspec_tests", case
+        )
+        pre = h.state.copy()
+        _write_ssz(case_dir, "pre", pre)
+        _write_ssz(case_dir, op_name, op)
+        if not valid:
+            return
+        post = h.state.copy()
+        apply_operation(post, handler, op, SPEC, "phase0")
+        _write_ssz(case_dir, "post", pre if corrupt_post else post)
+
+    emit("proposer_slashing", "proposer_slashing",
+         h.make_proposer_slashing(0), "valid_double_proposal")
+    emit("attester_slashing", "attester_slashing",
+         h.make_attester_slashing([1, 2]), "valid_double_vote")
+    from lighthouse_tpu.types.containers import ProposerSlashing
+
+    same = h.make_proposer_slashing(3)
+    emit("proposer_slashing", "proposer_slashing",
+         ProposerSlashing(signed_header_1=same.signed_header_1,
+                          signed_header_2=same.signed_header_1),
+         "invalid_identical_headers", valid=False)
+    return tmp_path
+
+
+def test_runner_on_synthetic_operations_vectors(tmp_path):
+    root = _synthetic_tree(str(tmp_path))
+    ran, skipped, failures = sweep(root)
+    assert failures == [], failures
+    assert ran == 3 and skipped == 0
+
+
+def test_runner_detects_wrong_post_state(tmp_path):
+    """The comparison must have teeth: a post file that does not match
+    the handler's output is reported, not absorbed."""
+    root = _synthetic_tree(str(tmp_path), corrupt_post=True)
+    _ran, _skipped, failures = sweep(root)
+    assert any("post-state root mismatch" in f for f in failures), failures
+
+
+def test_runner_rejects_tampered_signature(tmp_path):
+    """A slashing whose signature bytes are flipped must fail the
+    signature batch and read as 'invalid op accepted' (it has a post
+    file claiming validity)."""
+    from lighthouse_tpu.testing.harness import Harness
+
+    h = Harness(8, SPEC)
+    slashing = h.make_proposer_slashing(4)
+    good_post = h.state.copy()
+    apply_operation(good_post, "proposer_slashing", slashing, SPEC, "phase0")
+    slashing.signed_header_1.signature = (
+        bytes(slashing.signed_header_2.signature)
+    )
+    case_dir = os.path.join(
+        str(tmp_path), "tests", "minimal", "phase0", "operations",
+        "proposer_slashing", "pyspec_tests", "tampered_sig",
+    )
+    _write_ssz(case_dir, "pre", h.state.copy())
+    _write_ssz(case_dir, "proposer_slashing", slashing)
+    _write_ssz(case_dir, "post", good_post)
+    _ran, _skipped, failures = sweep(str(tmp_path))
+    assert any("valid op rejected" in f for f in failures), failures
+
+
+def test_case_dir_discovery(tmp_path):
+    """Only operations case dirs with a pre state are discovered, and
+    the handler/config/fork labels come from the path."""
+    root = _synthetic_tree(str(tmp_path))
+    cases = list(iter_cases(root))
+    assert len(cases) == 3
+    assert all(cfg == "minimal" and fork == "phase0"
+               for cfg, fork, _h, _d in cases)
+    handlers = sorted(h for _c, _f, h, _d in cases)
+    assert handlers == [
+        "attester_slashing", "proposer_slashing", "proposer_slashing"
+    ]

@@ -1,0 +1,366 @@
+"""Distributed aggregation overlay (aggregation/overlay.py): the
+Wonderboom-style multi-hop aggregation tree over the wire fabric.
+
+Covers the deterministic per-committee topology, the first-write-wins
+partial store (duplicate / covered / conflict outcomes), the chaos
+scenarios from the acceptance criteria (aggregator loss mid-tree,
+equivocating aggregator caught + quarantined + children re-homed,
+partition + heal — each with ZERO lost contributions and, where no
+byzantine bytes are in play, root settled bytes byte-identical to
+single-node aggregation), snapshot/restore of pending partials through
+chain persistence, the builder/env enrollment path, and the
+/lighthouse/overlay operator route.
+"""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.testing.simulator import OverlayFabric, OverlayNode
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+
+SPEC = ChainSpec(preset=MinimalPreset)
+
+
+@pytest.fixture
+def fabric():
+    fab = OverlayFabric(n=6)
+    yield fab
+    fab.stop()
+
+
+# ------------------------------------------------------------- topology
+
+
+def test_topology_deterministic_and_acyclic(fabric):
+    """Every member computes the SAME tree for a key, every parent
+    candidate sits at a strictly lower tree index (so re-homing can
+    never cycle), and walking first-choice parents from any node
+    terminates at the root."""
+    key = fabric.key_of(fabric.data(index=0))
+    orders = {tuple(n.overlay._order(key)) for n in fabric.nodes}
+    assert len(orders) == 1, "members disagree on the tree"
+    order = list(orders.pop())
+    for node in fabric.nodes:
+        i = order.index(node.name)
+        cands = node.overlay.parent_candidates(key)
+        if i == 0:
+            assert cands == [], "the root has no parents"
+            continue
+        assert cands, "every non-root has at least one candidate"
+        assert all(order.index(p) < i for p in cands)
+        # first-choice walk reaches the root
+        cur, hops = node, 0
+        while cur.overlay.parent_candidates(key):
+            nxt = cur.overlay.parent_candidates(key)[0]
+            cur = next(n for n in fabric.nodes if n.name == nxt)
+            hops += 1
+            assert hops <= len(fabric.nodes)
+        assert cur.name == order[0]
+
+
+def test_topology_rebuilds_on_membership_change(fabric):
+    node = fabric.nodes[0]
+    before = node.overlay.stats()["rebuilds"]
+    assert node.overlay.set_members(fabric.ids) is False   # unchanged
+    assert node.overlay.set_members(fabric.ids + ["agg_new"]) is True
+    assert node.overlay.stats()["rebuilds"] == before + 1
+    assert "agg_new" in node.overlay.members
+
+
+def test_root_load_spreads_across_keys(fabric):
+    """The per-key sha256 ordering makes different committees elect
+    different roots — no single node is the root for all traffic."""
+    roots = {
+        fabric.root_node(fabric.key_of(fabric.data(index=i))).name
+        for i in range(12)
+    }
+    assert len(roots) > 1, "one node rooted every committee"
+
+
+# ------------------------------------------------ first-write-wins store
+
+
+def test_record_outcomes_duplicate_covered_conflict(fabric):
+    node = fabric.nodes[0]
+    data = fabric.data(index=4)
+    key = fabric.key_of(data)
+    from lighthouse_tpu.ssz import encode
+    from lighthouse_tpu.types.containers import AttestationData
+
+    dssz = bytes(encode(AttestationData, data))
+    big = np.array([1, 1, 1, 0, 0, 0, 0, 0], dtype=np.uint8)
+    sub = np.array([1, 1, 0, 0, 0, 0, 0, 0], dtype=np.uint8)
+    other = np.array([0, 0, 0, 1, 0, 0, 0, 0], dtype=np.uint8)
+    s1, s2 = fabric.sigs[0], fabric.sigs[1]
+    rec = node.overlay._record
+    assert rec(key, dssz, data, big, s1, origin="p1")[0] == "accepted"
+    assert rec(key, dssz, data, big, s1, origin="p2")[0] == "duplicate"
+    assert rec(key, dssz, data, sub, s2, origin="p1")[0] == "covered"
+    assert rec(key, dssz, data, other, s2, origin="p1")[0] == "accepted"
+    # equal bits, different signature: equivocation evidence, BOTH kept
+    outcome, kept = rec(key, dssz, data, big, s2, origin="p3")
+    assert outcome == "conflict" and kept is not None
+    assert node.overlay.stats()["conflicts"] == 1
+    assert len(node.overlay.partials[key]) == 3
+
+
+# ------------------------------------------------------ chaos scenarios
+
+
+def test_clean_tree_byte_identical(fabric):
+    fabric.scenario_clean_tree()
+
+
+def test_aggregator_loss_zero_lost_contributions(fabric):
+    fabric.scenario_aggregator_loss()
+
+
+def test_equivocating_aggregator_quarantined(fabric):
+    fabric.scenario_equivocating_aggregator()
+
+
+def test_partition_heal_zero_lost_contributions(fabric):
+    fabric.scenario_partition_heal()
+
+
+def test_audit_probe_catches_late_store_corruption(fabric):
+    """An aggregator that acks honestly and corrupts its store LATER is
+    caught by the seeded probe re-push (2G2T recombination audit): the
+    probe's ack digest no longer matches and the parent is quarantined."""
+    data = fabric.data(index=5)
+    key = fabric.key_of(data)
+    evil = fabric.by_role(key, "interior")[0]
+    fabric.inject(data, 6, skip={evil.name})
+    fabric.settle(key, range(6))
+    # flip AFTER everything settled and acked: rewrite the stored sigs
+    evil.overlay.corrupt_store = True
+    with evil.overlay._lock:
+        for records in evil.overlay.partials.values():
+            for r in records:
+                r.sig = b"\xff" * 96
+                from lighthouse_tpu.network.wire import agg_push_digest
+
+                r.digest = agg_push_digest(r.key, r.bits, r.sig)
+    # force the probe draw on every child tick until someone catches it
+    for node in fabric.nodes:
+        node.overlay.audit_rate = 1.0
+    for _ in range(8):
+        fabric.tick_all()
+        if any(n.overlay.stats()["quarantines"] for n in fabric.nodes):
+            break
+    catchers = [
+        n for n in fabric.nodes if n.overlay.stats()["quarantines"] >= 1
+    ]
+    assert catchers, "the audit probe never caught the corrupted store"
+
+
+# -------------------------------------------------- snapshot / restore
+
+
+def test_snapshot_restore_roundtrips_pending_partials():
+    """An unsettled partial survives a node restart: snapshot emits one
+    synthetic attestation per unacked contribution, restore re-records
+    it, and the reborn node pushes it upstream — nothing lost."""
+    fab = OverlayFabric(n=2)
+    try:
+        edge, other = fab.nodes
+        data = fab.data(index=0)
+        key = fab.key_of(data)
+        # make sure the EDGE holds the partial (re-key roles if needed)
+        if edge.overlay.role(key) == "root":
+            edge, other = other, edge
+        edge.tier.insert(fab.attestation(3, data))
+        fab.reference.insert(fab.attestation(3, data))
+        # export locally, but no push yet: snapshot before any tick I/O
+        edge.overlay._export_tick()
+        snap = edge.overlay.snapshot()
+        assert len(snap) == 1
+        # the node dies with its wire; a fresh process takes its place
+        edge.stop()
+        reborn = OverlayNode("agg_reborn", fab.spec, parents=1, fanout=2,
+                             audit_rate=0.0, seed=9, push_timeout=0.75)
+        try:
+            reborn.wire.dial("127.0.0.1", other.wire.port)
+            members = [reborn.name, other.name]
+            reborn.overlay.set_members(members)
+            other.overlay.set_members(members)
+            assert reborn.overlay.restore(snap) == 1
+            # drive only the two live nodes until the key's root settles
+            root = (reborn if reborn.overlay.role(key) == "root" else other)
+            import time as _time
+
+            t0 = _time.monotonic()
+            while True:
+                reborn.overlay.tick()
+                other.overlay.tick()
+                root.tier.flush("settle")
+                entries = root.tier.entries.get(key, [])
+                if any(int(e["bits"][3]) for e in entries):
+                    break
+                assert _time.monotonic() - t0 < 10.0, "contribution lost"
+                _time.sleep(0.02)
+        finally:
+            reborn.stop()
+    finally:
+        fab.stop()
+
+
+def test_chain_persist_roundtrips_overlay_partials(tmp_path):
+    """Chain-level persistence: pending overlay partials ride the
+    persisted op-pool snapshot and replay into a freshly attached
+    overlay after from_store (the builder's attach path)."""
+    from lighthouse_tpu.beacon.chain import BeaconChain
+    from lighthouse_tpu.beacon.store import FileKV, HotColdStore
+    from lighthouse_tpu.crypto.backend import SignatureVerifier
+    from lighthouse_tpu.testing.harness import Harness
+
+    path = os.path.join(tmp_path, "node.db")
+    store = HotColdStore(FileKV(path), SPEC)
+    h = Harness(8, SPEC)
+    chain = BeaconChain(
+        h.state.copy(), SPEC, store=store, verifier=SignatureVerifier("fake")
+    )
+    fab = OverlayFabric(n=2)
+    try:
+        node = fab.nodes[0]
+        data = fab.data(index=0)
+        key = fab.key_of(data)
+        if node.overlay.role(key) == "root":
+            node = fab.nodes[1]
+        node.tier.insert(fab.attestation(2, data))
+        node.overlay._export_tick()
+        chain.attach_overlay(node.overlay)
+        assert chain.persist()
+        store.close()
+
+        store2 = HotColdStore(FileKV(path), SPEC)
+        chain2 = BeaconChain.from_store(
+            store2, SPEC, verifier=SignatureVerifier("fake")
+        )
+        assert chain2._pending_overlay_partials, "partials not persisted"
+        node2 = fab.nodes[1] if node is fab.nodes[0] else fab.nodes[0]
+        before = sum(
+            len(rs) for rs in node2.overlay.partials.values()
+        )
+        chain2.attach_overlay(node2.overlay)
+        assert chain2.overlay is node2.overlay
+        assert chain2._pending_overlay_partials is None
+        after = sum(len(rs) for rs in node2.overlay.partials.values())
+        assert after == before + 1
+        store2.close()
+    finally:
+        fab.stop()
+
+
+# ------------------------------------------------- builder + http route
+
+
+def test_builder_env_enrolls_overlay(monkeypatch):
+    """LTPU_OVERLAY=host:port enrolls the node: the overlay is attached
+    to the chain AND to the wire, dialing the configured member."""
+    from lighthouse_tpu.beacon.node import ClientBuilder
+    from lighthouse_tpu.network.wire import WireNode
+    from lighthouse_tpu.testing.harness import Harness
+
+    peer = WireNode(None, accept_any_fork=True, peer_id="agg_peer",
+                    quotas={})
+    h = Harness(8, SPEC)
+    monkeypatch.setenv("LTPU_OVERLAY", f"127.0.0.1:{peer.port}")
+    node = (
+        ClientBuilder(SPEC)
+        .genesis_state(h.state.copy())
+        .crypto_backend("fake")
+        .network(port=0)
+        .build()
+    )
+    try:
+        overlay = node.chain.overlay
+        assert overlay is not None
+        assert node.wire.overlay is overlay
+        overlay.tick()
+        assert peer.peer_id in overlay.members
+    finally:
+        node.stop()
+        peer.stop()
+
+
+def test_builder_explicit_empty_disables_overlay(monkeypatch):
+    from lighthouse_tpu.beacon.node import ClientBuilder
+    from lighthouse_tpu.testing.harness import Harness
+
+    monkeypatch.setenv("LTPU_OVERLAY", "127.0.0.1:1")
+    h = Harness(8, SPEC)
+    node = (
+        ClientBuilder(SPEC)
+        .genesis_state(h.state.copy())
+        .crypto_backend("fake")
+        .network(port=0)
+        .aggregation_overlay([])
+        .build()
+    )
+    try:
+        assert getattr(node.chain, "overlay", None) is None
+        assert node.wire.overlay is None
+    finally:
+        node.stop()
+
+
+def test_overlay_http_route():
+    """GET /lighthouse/overlay: honest {"enabled": false} without an
+    overlay, full topology/stats surface with one."""
+    from lighthouse_tpu.api.http_api import BeaconApiServer
+    from lighthouse_tpu.beacon.chain import BeaconChain
+    from lighthouse_tpu.crypto.backend import SignatureVerifier
+    from lighthouse_tpu.testing.harness import Harness
+
+    h = Harness(8, SPEC)
+    chain = BeaconChain(h.state.copy(), SPEC,
+                        verifier=SignatureVerifier("fake"))
+    server = BeaconApiServer(chain).start()
+    fab = OverlayFabric(n=2)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/lighthouse/overlay") as r:
+            assert json.load(r)["data"] == {"enabled": False}
+        chain.attach_overlay(fab.nodes[0].overlay)
+        with urllib.request.urlopen(base + "/lighthouse/overlay") as r:
+            data = json.load(r)["data"]
+        assert data["enabled"] is True
+        assert data["node"] == "agg0"
+        assert sorted(data["members"]) == ["agg0", "agg1"]
+        assert data["parents_redundancy"] >= 1
+        assert "pushes" in data and "received" in data
+    finally:
+        fab.stop()
+        server.stop()
+
+
+# -------------------------------------------------------- trace stitch
+
+
+def test_overlay_spans_stitch_into_one_lineage():
+    """Every hop trace (overlay_push at the child, overlay_recv at the
+    parent) carries parent_trace_id = the partial's origin trace — one
+    attestation's edge->interior->root path reads as a single stitched
+    lineage in /lighthouse/traces."""
+    from lighthouse_tpu.utils import tracing
+
+    fab = OverlayFabric(n=5)
+    try:
+        key = fab.inject(fab.data(index=0), 6)
+        fab.settle(key, range(6))
+        traces = tracing.recent(512)
+        pushes = [t for t in traces if t["kind"] == "overlay_push"]
+        recvs = [t for t in traces if t["kind"] == "overlay_recv"]
+        assert pushes and recvs
+        lineages = {t["attrs"]["parent_trace_id"] for t in pushes}
+        # every receive stitches back to a push lineage
+        assert all(
+            t["attrs"]["parent_trace_id"] in lineages for t in recvs
+        )
+    finally:
+        fab.stop()

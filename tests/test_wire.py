@@ -693,3 +693,225 @@ def test_verify_resp_frame_cannot_complete_rpc_request():
         assert not ver_rec[0].is_set() and ver_rec[1] is None
     finally:
         node.stop()
+
+# ------------------------------------------- aggregation overlay frames
+
+
+def _agg_fixture():
+    """A valid AGG_PUSH payload built from a real AttestationData and a
+    real compressed G2 point (the overlay checks key == htr(data))."""
+    from lighthouse_tpu.ssz import encode, hash_tree_root
+    from lighthouse_tpu.testing.scale import make_signature_pool
+    from lighthouse_tpu.types.containers import AttestationData, Checkpoint
+
+    data = AttestationData(
+        slot=0, index=0, beacon_block_root=b"\x21" * 32,
+        source=Checkpoint(epoch=0, root=b"\x00" * 32),
+        target=Checkpoint(epoch=0, root=b"\x21" * 32),
+    )
+    key = bytes(hash_tree_root(data))
+    bits = [1, 0, 1, 0, 0, 0, 0, 0, 0, 1]
+    sig = make_signature_pool(1)[0]
+    return key, bytes(encode(AttestationData, data)), bits, sig
+
+
+def test_agg_push_codec_roundtrip_and_fuzz_truncations():
+    """Every truncated prefix of a valid AGG_PUSH raises the typed
+    WireError; the full payload round-trips every field including the
+    trace tail; trailing garbage is as malformed as a truncation."""
+    from lighthouse_tpu.network.wire import decode_agg_push, encode_agg_push
+
+    key, data_ssz, bits, sig = _agg_fixture()
+    payload = encode_agg_push(key, data_ssz, bits, sig, probe=True,
+                              trace_ctx=("edge0-7", "agg-edge0"))
+    frame = decode_agg_push(payload)
+    assert frame["key"] == key and frame["data_ssz"] == data_ssz
+    assert frame["bits"] == bits and frame["sig"] == sig
+    assert frame["probe"] is True
+    assert frame["trace_ctx"] == ("edge0-7", "agg-edge0")
+    for cut in range(0, len(payload), 7):
+        with pytest.raises(WireError):
+            decode_agg_push(payload[:cut])
+    with pytest.raises(WireError):
+        decode_agg_push(payload + b"\x00")
+
+
+def test_agg_push_codec_rejects_malformed():
+    import struct as _struct
+
+    from lighthouse_tpu.network.wire import (
+        MAX_AGG_BITS,
+        MAX_AGG_DATA,
+        WireError as WE,
+        decode_agg_push,
+        encode_agg_push,
+    )
+
+    key, data_ssz, bits, sig = _agg_fixture()
+    good = encode_agg_push(key, data_ssz, bits, sig)
+    # unknown flag bits
+    with pytest.raises(WE):
+        decode_agg_push(b"\xf0" + good[1:])
+    # oversized declared data length
+    bad_dl = good[:33] + _struct.pack("<H", MAX_AGG_DATA + 1) + good[35:]
+    with pytest.raises(WE):
+        decode_agg_push(bad_dl)
+    # bit count past the cap
+    off = 33 + 2 + len(data_ssz)
+    bad_n = good[:off] + _struct.pack("<H", MAX_AGG_BITS + 1) + good[off + 2:]
+    with pytest.raises(WE):
+        decode_agg_push(bad_n)
+    # bitmap padding bits set past the declared length
+    pad = bytearray(good)
+    pad[off + 2 + 1] |= 0x80          # bit 15 of a 10-bit bitmap
+    with pytest.raises(WE):
+        decode_agg_push(bytes(pad))
+    # empty participation bitset
+    empty = bytearray(good)
+    empty[off + 2] = 0
+    empty[off + 2 + 1] = 0
+    with pytest.raises(WE):
+        decode_agg_push(bytes(empty))
+    # encode-side guards: bad key/sig/data/bit shapes never hit the wire
+    with pytest.raises(WE):
+        encode_agg_push(key[:-1], data_ssz, bits, sig)
+    with pytest.raises(WE):
+        encode_agg_push(key, data_ssz, bits, sig[:-1])
+    with pytest.raises(WE):
+        encode_agg_push(key, b"", bits, sig)
+    with pytest.raises(WE):
+        encode_agg_push(key, data_ssz, [], sig)
+    with pytest.raises(WE):
+        encode_agg_push(key, data_ssz, [1] * (MAX_AGG_BITS + 1), sig)
+
+
+def test_agg_push_digest_commits_to_every_field():
+    """The store digest (the 2G2T audit commitment) must move when any
+    of (key, bits, sig) moves — a receiver cannot swap one component
+    and keep a matching ACK."""
+    from lighthouse_tpu.network.wire import agg_push_digest
+
+    key, _data, bits, sig = _agg_fixture()
+    d = agg_push_digest(key, bits, sig)
+    assert d != agg_push_digest(b"\x00" * 32, bits, sig)
+    assert d != agg_push_digest(key, [1, 1] + bits[2:], sig)
+    assert d != agg_push_digest(key, bits, b"\x01" * 96)
+    assert d == agg_push_digest(key, list(bits), bytes(sig))
+
+
+def test_garbage_agg_push_answers_typed_error_and_connection_survives():
+    """A malformed AGG_PUSH body gets R_INVALID_REQUEST (WireError
+    client-side) instead of dropping the reader; the SAME connection
+    then lands a well-formed push, and a duplicate of that push is
+    idempotent — same stored digest, one tier merge."""
+    from lighthouse_tpu.aggregation import AggregationOverlay, AggregationTier
+    from lighthouse_tpu.network.wire import agg_push_digest, encode_agg_push
+
+    server = WireNode(None, accept_any_fork=True, peer_id="agg_srv",
+                      quotas={})
+    tier = AggregationTier(SPEC)
+    AggregationOverlay(server, tier, audit_rate=0.0, seed=3)
+    client = WireNode(None, accept_any_fork=True, peer_id="agg_cli",
+                      quotas={})
+    try:
+        pid = client.dial("127.0.0.1", server.port)
+        with pytest.raises(WireError):
+            client.push_aggregate(pid, b"\xfe" * 48, timeout=5.0)
+        key, data_ssz, bits, sig = _agg_fixture()
+        payload = encode_agg_push(key, data_ssz, bits, sig)
+        d1 = client.push_aggregate(pid, payload, timeout=5.0)
+        assert d1 == agg_push_digest(key, bits, sig)
+        # duplicate push: first-write-wins echoes the SAME stored digest
+        d2 = client.push_aggregate(pid, payload, timeout=5.0)
+        assert d2 == d1
+        recv = server.overlay.stats()["received"]
+        assert recv == {"accepted": 1, "duplicate": 1}
+        assert pid in client.peers
+        # exactly one tier entry with exactly one pending contribution
+        (entries,) = tier.entries.values()
+        assert len(entries) == 1 and len(entries[0]["contribs"]) == 1
+    finally:
+        client.stop()
+        server.stop()
+
+
+def test_agg_push_semantic_garbage_rejected_typed():
+    """Codec-valid but semantically wrong pushes (key not matching the
+    attestation data, undecodable data) are WireErrors, not drops."""
+    from lighthouse_tpu.aggregation import AggregationOverlay, AggregationTier
+    from lighthouse_tpu.network.wire import encode_agg_push
+
+    server = WireNode(None, accept_any_fork=True, peer_id="agg_srv2",
+                      quotas={})
+    AggregationOverlay(server, AggregationTier(SPEC), audit_rate=0.0, seed=3)
+    client = WireNode(None, accept_any_fork=True, peer_id="agg_cli2",
+                      quotas={})
+    try:
+        pid = client.dial("127.0.0.1", server.port)
+        key, data_ssz, bits, sig = _agg_fixture()
+        with pytest.raises(WireError):
+            client.push_aggregate(
+                pid, encode_agg_push(b"\x13" * 32, data_ssz, bits, sig),
+                timeout=5.0,
+            )
+        with pytest.raises(WireError):
+            client.push_aggregate(
+                pid, encode_agg_push(key, b"\x00" * 7, bits, sig),
+                timeout=5.0,
+            )
+        assert pid in client.peers
+    finally:
+        client.stop()
+        server.stop()
+
+
+def test_agg_push_refused_when_not_enrolled():
+    """A node with no overlay attached answers R_RESOURCE_UNAVAILABLE
+    (surfaced as PeerRateLimited) — a legacy-role peer is never
+    crashed by the new frames, and never dropped for them."""
+    from lighthouse_tpu.network.wire import PeerRateLimited, encode_agg_push
+
+    server = WireNode(None, accept_any_fork=True, peer_id="agg_srv3",
+                      quotas={})
+    client = WireNode(None, accept_any_fork=True, peer_id="agg_cli3",
+                      quotas={})
+    try:
+        pid = client.dial("127.0.0.1", server.port)
+        key, data_ssz, bits, sig = _agg_fixture()
+        with pytest.raises(PeerRateLimited):
+            client.push_aggregate(
+                pid, encode_agg_push(key, data_ssz, bits, sig), timeout=5.0
+            )
+        assert pid in client.peers
+    finally:
+        client.stop()
+        server.stop()
+
+
+def test_agg_ack_frame_cannot_complete_other_kinds():
+    """Kind-tag isolation for the new frame pair: an AGG_ACK whose rid
+    matches an in-flight rpc request is ignored, and an rpc RESPONSE
+    cannot complete an agg push record."""
+    import struct as _struct
+    import threading as _threading
+
+    from lighthouse_tpu.network.wire import R_SUCCESS
+
+    node = WireNode(None, accept_any_fork=True, peer_id="agg_kind")
+    try:
+        peer = object()
+        rpc_rec = [_threading.Event(), None, None, peer, {}, None, "rpc"]
+        node._pending[71] = rpc_rec
+        node._on_agg_ack(
+            peer, _struct.pack("<IB", 71, R_SUCCESS) + b"\x00" * 32
+        )
+        assert not rpc_rec[0].is_set() and rpc_rec[1] is None
+        agg_rec = [_threading.Event(), None, None, peer, {}, None, "agg"]
+        node._pending[72] = agg_rec
+        node._on_response(
+            peer,
+            _struct.pack("<IBII", 72, R_SUCCESS, 0, 1) + snappy.compress(b"x"),
+        )
+        assert not agg_rec[0].is_set() and agg_rec[1] is None
+    finally:
+        node.stop()
