@@ -109,6 +109,7 @@ _VS_SUMMARY = None     # verify_service coalescing sweep (ROADMAP item d)
 _CC_SUMMARY = None     # compile-cache cold-vs-cached measurement (ISSUE 6)
 _SOAK_SUMMARY = None   # multi-epoch adversarial soak gates (ISSUE 13)
 _OVERLAY_SUMMARY = None   # aggregation overlay tree-vs-flat (ISSUE 15)
+_SERVE_SUMMARY = None     # light-client serving tier swarm (ISSUE 16)
 
 
 def _load_prior_primary():
@@ -172,6 +173,20 @@ def _overlay_exit_code():
         return 0
     note("overlay_regression",
          contributions_lost=_OVERLAY_SUMMARY["contributions_lost"])
+    return 1
+
+
+def _serve_exit_code():
+    """The serving-tier lane's hard gates: the coalesce storm resolved
+    from ONE chain read, no stale bytes served after the forced reorg,
+    no head event lost across it, and no corrupted body ever served.
+    A run that fails any of those must not ship green on throughput
+    alone (same bypass env as the other guards)."""
+    if os.environ.get("BENCH_NO_REGRESSION_GUARD"):
+        return 0
+    if _SERVE_SUMMARY is None or _SERVE_SUMMARY.get("gates_passed", True):
+        return 0
+    note("serve_regression", failed_gates=_SERVE_SUMMARY.get("failed_gates"))
     return 1
 
 
@@ -254,6 +269,11 @@ def _emit_primary(value, final=False, backend="tpu-kernel", platform=None):
         # tree-vs-flat traffic economics + the zero-lost-contributions
         # gate ride along so the overlay's trajectory is guarded too
         rec["overlay"] = _OVERLAY_SUMMARY
+    if _SERVE_SUMMARY is not None:
+        # the read-path numbers (served/s, p99, coalesce/hit rates) and
+        # their zero-loss gates ride the guarded artifact so the
+        # serving tier's trajectory is tracked across PRs
+        rec["serve"] = _SERVE_SUMMARY
     try:
         # the per-kernel profile registry's roll-up (top wall-time
         # sinks, per-kernel totals, launch counters) rides along so a
@@ -1032,6 +1052,64 @@ def config_overlay(json_path=None):
     }
 
 
+def config_serve(json_path=None):
+    """Serving-tier lane: tools/client_swarm_bench.py in a CPU-pinned
+    subprocess — a 10k-client read swarm with a barrier-released
+    coalesce storm, a mid-swarm forced reorg, a wedged-subscriber SSE
+    fan-out pass, and a cache-corruption chaos check.  Merges a `serve`
+    key into BENCH_PRIMARY.json; any failed hard gate (stale bytes,
+    lost head events, corrupted bytes served, >1 chain read under the
+    storm) fails the run via _serve_exit_code."""
+    global _SERVE_SUMMARY
+    import subprocess
+
+    est = 60.0
+    if not _fits(est, "serve"):
+        return
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "client_swarm_bench.py"),
+           "--clients", os.environ.get("BENCH_SERVE_CLIENTS", "10000"),
+           "--requests", os.environ.get("BENCH_SERVE_REQUESTS", "20000")]
+    if json_path:
+        cmd += ["--json", json_path]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                           timeout=max(240.0, 4 * est))
+    except subprocess.TimeoutExpired:
+        note("serve_error", error="timeout")
+        return
+    try:
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+    except Exception:
+        note("serve_error", rc=r.returncode, stderr=r.stderr[-300:])
+        return
+    note("serve", **out)
+    gates = {
+        "coalesce_single_read": out["coalesce_chain_reads"] == 1,
+        "no_stale_after_reorg": out["reorg_stale_served"] == 0,
+        "no_lost_sse_head_events": out["sse_lost_head_events"] == 0,
+        "no_corrupt_served": out["corrupt_served"] == 0,
+    }
+    _SERVE_SUMMARY = {
+        "clients": out["clients"],
+        "requests": out["requests"],
+        "served_per_sec": out["served_per_sec"],
+        "p99_ms": out["p99_ms"],
+        "cache_hit_rate": out["cache_hit_rate"],
+        "coalesce_ratio": out["coalesce_ratio"],
+        "coalesce_inflight": out["coalesce_inflight"],
+        "coalesce_chain_reads": out["coalesce_chain_reads"],
+        "sse_subscribers": out["subscribers"],
+        "gates_passed": all(gates.values()),
+    }
+    if not _SERVE_SUMMARY["gates_passed"]:
+        _SERVE_SUMMARY["failed_gates"] = [
+            k for k, v in gates.items() if not v
+        ]
+
+
 def config_kernels():
     """mont_mul candidate shoot-out: f32-HIGHEST GEMM vs int32 einsum vs
     the fused Pallas kernel, one jit each on a wide batch — a single
@@ -1384,13 +1462,13 @@ def main():
     # subprocess measurements to the front of the extras
     stages = (
         (config_device_retry, config_gossip_latency, config_native_shapes,
-         config5, config_aggregation, config_soak, config_overlay, config_mesh,
-         run_device_smoke_and_curve,
+         config5, config_aggregation, config_soak, config_overlay,
+         config_serve, config_mesh, run_device_smoke_and_curve,
          config_kernels, config1, config4, config_compile_cache)
         if _DEVICE_ALIVE else
         (config_gossip_latency, config_native_shapes, config5,
-         config_aggregation, config_soak, config_overlay, config_mesh,
-         config_device_retry,
+         config_aggregation, config_soak, config_overlay, config_serve,
+         config_mesh, config_device_retry,
          run_device_smoke_and_curve, config_kernels, config1, config4,
          config_compile_cache)
     )
@@ -1424,12 +1502,12 @@ def main():
                 "note": "no config completed within budget",
             }
         ), flush=True)
-        return _soak_exit_code() or _overlay_exit_code()
+        return _soak_exit_code() or _overlay_exit_code() or _serve_exit_code()
     _emit_primary(primary, final=True)
     return _regression_exit_code(
         _PRIMARY if _PRIMARY is not None else primary,
         _PRIMARY_PLATFORM or jax.devices()[0].platform,
-    ) or _soak_exit_code() or _overlay_exit_code()
+    ) or _soak_exit_code() or _overlay_exit_code() or _serve_exit_code()
 
 
 if __name__ == "__main__":

@@ -73,6 +73,58 @@ class _Handler(JsonHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _raw_json(self, body, code=200):
+        """Pre-serialized JSON bytes (the serving tier's frozen bodies
+        — same envelope `_json` would have produced)."""
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _client_id(self):
+        """Admission-control identity: the peer address (a reverse
+        proxy would substitute its client header here)."""
+        addr = getattr(self, "client_address", None)
+        return addr[0] if addr else "local"
+
+    def _serve(self, klass, route_key, compute, pinned_root=None):
+        """Route a cacheable read through the serving tier when one is
+        attached (cache -> single-flight -> compute, shed mapped to
+        429); the legacy direct path otherwise.  `compute` returns the
+        response BYTES (serve.responses.json_bytes) and raises
+        LookupError when the body's not-available condition holds."""
+        from ..verify_service.service import LoadShedError
+
+        tier = getattr(self.chain, "serve_tier", None)
+        try:
+            if tier is None:
+                return self._raw_json(compute())
+            body = tier.respond(self._client_id(), klass, route_key,
+                                compute, pinned_root=pinned_root)
+        except LookupError as e:
+            return self._err(404, str(e))
+        except LoadShedError as e:
+            return self._err(429, str(e))
+        return self._raw_json(body)
+
+    def _sse_handoff(self, register):
+        """Hand this connection's socket to the sharded SSE broadcaster
+        and return — no handler thread parked per subscriber.  The
+        socket is detached from the server machinery (which would
+        otherwise SHUT_WR it as the handler exits) and owned by the
+        broadcaster from here on."""
+        import socket as _socket
+
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+        self.wfile.flush()
+        sock = _socket.socket(fileno=self.connection.detach())
+        self.close_connection = True
+        register(sock)
+
     def _canonical_root_at_slot(self, slot):
         """Walk the canonical chain back from head to the block at or
         before `slot` (block_id.rs slot resolution)."""
@@ -512,23 +564,22 @@ class _Handler(JsonHandler):
             r"/eth/v1/beacon/states/([^/]+)/finality_checkpoints", path
         )
         if m:
-            st, _ = self._resolve_state(m.group(1))
+            st, root = self._resolve_state(m.group(1))
             if st is None:
                 return self._err(404, "state not found")
+            from ..serve import responses as serve_responses
 
-            def ckpt(c):
-                return {"epoch": str(int(c.epoch)), "root": _hex(c.root)}
-
-            return self._json(
-                {
-                    "data": {
-                        "previous_justified": ckpt(
-                            st.previous_justified_checkpoint
-                        ),
-                        "current_justified": ckpt(st.current_justified_checkpoint),
-                        "finalized": ckpt(st.finalized_checkpoint),
-                    }
-                }
+            # keyed on the RESOLVED state root: the body is a pure
+            # function of the root, so the frozen bytes can never go
+            # stale ("head" re-resolves per request, then hits the
+            # pinned entry)
+            return self._serve(
+                "finality",
+                ("/eth/v1/beacon/states/finality_checkpoints",),
+                lambda: serve_responses.json_bytes(
+                    serve_responses.finality_checkpoints_body(st)
+                ),
+                pinned_root=root,
             )
 
         m = re.fullmatch(
@@ -574,20 +625,21 @@ class _Handler(JsonHandler):
             # list form: the canonical head header, or the header at
             # EXACTLY ?slot= (empty list for skipped slots — the
             # at-or-before resolver serves block_id semantics, not this
-            # filter; review r5)
+            # filter; review r5).  Head-keyed in the serving tier: a
+            # reorg flips the head root and re-keys the frozen bytes.
+            from ..serve import responses as serve_responses
+            from ..serve.tier import KEY_HEADERS_HEAD
+
             chain_ = self.chain
             want_slot = int(q["slot"][0]) if "slot" in q else None
-            target = (self._canonical_root_at_slot(want_slot)
-                      if want_slot is not None else chain_.head_root)
-            blk = chain_.store.get_block(target) if target else None
-            if blk is None or (want_slot is not None
-                               and int(blk.message.slot) != want_slot):
-                return self._json({"data": []})
-            return self._json({"data": [{
-                "root": _hex(target),
-                "canonical": True,
-                "header": {"message": self._header_json(blk.message)},
-            }]})
+            route_key = (KEY_HEADERS_HEAD if want_slot is None
+                         else ("/eth/v1/beacon/headers", want_slot))
+            return self._serve(
+                "head", route_key,
+                lambda: serve_responses.json_bytes(
+                    serve_responses.headers_body(chain_, want_slot)
+                ),
+            )
 
         m = re.fullmatch(r"/eth/v1/beacon/headers/([^/]+)", path)
         if m:
@@ -699,84 +751,73 @@ class _Handler(JsonHandler):
 
         m = re.fullmatch(r"/eth/v1/beacon/light_client/bootstrap/(0x[0-9a-f]+)", path)
         if m:
-            from ..light_client import (
-                LightClientError,
-                bootstrap_from_state,
-                light_client_types,
-            )
-            from ..ssz import encode as _enc
+            from ..light_client import LightClientError
+            from ..serve import responses as serve_responses
 
             root = bytes.fromhex(m.group(1)[2:])
-            state = chain.store.get_state(root)
-            if state is None:
+            if chain.store.get_state(root) is None:
                 return self._err(404, "unknown block root")
+
+            def compute():
+                body = serve_responses.bootstrap_body(chain, root)
+                if body is None:
+                    raise LookupError("unknown block root")
+                return serve_responses.json_bytes(body)
+
             try:
-                boot = bootstrap_from_state(state, chain.preset)
+                # pinned on the requested root: a bootstrap is a pure
+                # function of its state, immune to head churn
+                return self._serve(
+                    "proof", ("/eth/v1/beacon/light_client/bootstrap",),
+                    compute, pinned_root=root,
+                )
             except LightClientError as e:
                 return self._err(400, str(e))
-            LT = light_client_types(chain.preset)
-            return self._json(
-                {"data": {"ssz": "0x" + _enc(LT.LightClientBootstrap, boot).hex()}}
-            )
 
         if path == "/eth/v1/beacon/light_client/updates":
-            from ..light_client import light_client_types
-            from ..ssz import encode as _enc
+            from ..serve import responses as serve_responses
 
-            srv = chain.light_client_server
-            if srv is None:
-                return self._json({"data": []})
             start = int(q["start_period"][0])
             count = min(int(q.get("count", ["1"])[0]), 128)
-            LT = light_client_types(chain.preset)
-            return self._json(
-                {
-                    "data": [
-                        {"ssz": "0x" + _enc(LT.LightClientUpdate, u).hex()}
-                        for u in srv.updates_range(start, count)
-                    ]
-                }
+            return self._serve(
+                "proof",
+                ("/eth/v1/beacon/light_client/updates", start, count),
+                lambda: serve_responses.json_bytes(
+                    serve_responses.updates_body(chain, start, count)
+                ),
             )
 
         if path == "/eth/v1/beacon/light_client/finality_update":
-            from ..light_client import light_client_types
-            from ..ssz import encode as _enc
+            from ..serve import responses as serve_responses
+            from ..serve.tier import KEY_FINALITY_UPDATE
 
             srv = chain.light_client_server
             if srv is None or srv.latest_finality_update is None:
                 return self._err(404, "no finality update available")
-            LT = light_client_types(chain.preset)
-            return self._json(
-                {
-                    "data": {
-                        "ssz": "0x"
-                        + _enc(
-                            LT.LightClientFinalityUpdate,
-                            srv.latest_finality_update,
-                        ).hex()
-                    }
-                }
-            )
+
+            def compute():
+                body = serve_responses.finality_update_body(chain)
+                if body is None:
+                    raise LookupError("no finality update available")
+                return serve_responses.json_bytes(body)
+
+            return self._serve("proof", KEY_FINALITY_UPDATE, compute)
 
         if path == "/eth/v1/beacon/light_client/optimistic_update":
-            from ..light_client import light_client_types
-            from ..ssz import encode as _enc
+            from ..serve import responses as serve_responses
+            from ..serve.tier import KEY_OPTIMISTIC_UPDATE
 
             srv = chain.light_client_server
             if srv is None or srv.latest_optimistic_update is None:
                 return self._err(404, "no optimistic update available")
-            LT = light_client_types(chain.preset)
-            return self._json(
-                {
-                    "data": {
-                        "ssz": "0x"
-                        + _enc(
-                            LT.LightClientOptimisticUpdate,
-                            srv.latest_optimistic_update,
-                        ).hex()
-                    }
-                }
-            )
+
+            def compute():
+                body = serve_responses.optimistic_update_body(chain)
+                if body is None:
+                    raise LookupError("no optimistic update available")
+                return serve_responses.json_bytes(body)
+
+            return self._serve("proof", KEY_OPTIMISTIC_UPDATE, compute)
 
         m = re.fullmatch(r"/eth/v1/beacon/rewards/blocks/([^/]+)", path)
         if m:
@@ -845,6 +886,18 @@ class _Handler(JsonHandler):
                 return True
             self._json({"data": overlay.stats()})
             return True
+        if path == "/lighthouse/serve":
+            # light-client serving tier: cache hit/miss/prune counters,
+            # coalescing depth, admission/shed state, and the per-shard
+            # SSE fan-out view (honest {"enabled": false} shell when
+            # LTPU_SERVE=0 or the node runs without an API tier)
+            tier = getattr(chain, "serve_tier", None)
+            if tier is None:
+                return self._json({"data": {"enabled": False}})
+            data = tier.stats()
+            data["enabled"] = True
+            return self._json({"data": data})
+
         if path == "/lighthouse/compile-cache":
             # compile-lifecycle status: the persistent AOT executable
             # cache (hits/misses/loaded programs), the canonical shape
@@ -936,6 +989,14 @@ class _Handler(JsonHandler):
             except ValueError as e:
                 return self._err(400, str(e))
             component = q.get("component", [None])[0]
+            tier = getattr(chain, "serve_tier", None)
+            if tier is not None:
+                label = self._client_id()
+                return self._sse_handoff(
+                    lambda sock: tier.subscribe_logs(
+                        sock, floor=floor, component=component, label=label
+                    )
+                )
             sub = ltpu_logging.subscribe()
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
@@ -1027,6 +1088,14 @@ class _Handler(JsonHandler):
             topics = q.get("topics", ["head", "block"])
             if isinstance(topics, list) and len(topics) == 1:
                 topics = topics[0].split(",")
+            tier = getattr(chain, "serve_tier", None)
+            if tier is not None:
+                label = self._client_id()
+                return self._sse_handoff(
+                    lambda sock: tier.subscribe_events(
+                        sock, topics, label=label
+                    )
+                )
             sub = chain.events.subscribe(kinds=topics)
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")

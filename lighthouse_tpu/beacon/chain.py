@@ -172,6 +172,7 @@ class BeaconChain:
         self.light_client_server = None   # created on first altair import
         self.slasher = None               # attached via attach_slasher()
         self.builder = None               # attached via attach_builder()
+        self.serve_tier = None            # attached via attach_serve_tier()
         self.proposer_preparations = {}   # validator index -> fee recipient
         self._advanced_head = None   # (head_root, slot, state) pre-advance
 
@@ -601,14 +602,19 @@ class BeaconChain:
             return          # finalized block not imported yet (sync edge)
         self._pruned_finalized_epoch = fin_epoch
         self.fork_choice.prune()
+        keep = set(self.fork_choice.proto.indices.keys())
+        keep.add(self.head_root)
+        # the anchor state is load-bearing forever: from_store
+        # restore and light-client bootstrap both read it by
+        # genesis_root no matter how far finality has advanced
+        keep.add(self.genesis_root)
         if hasattr(self.store, "prune_states"):
-            keep = set(self.fork_choice.proto.indices.keys())
-            keep.add(self.head_root)
-            # the anchor state is load-bearing forever: from_store
-            # restore and light-client bootstrap both read it by
-            # genesis_root no matter how far finality has advanced
-            keep.add(self.genesis_root)
             self.store.prune_states(keep)
+        if self.serve_tier is not None:
+            # frozen response bodies for roots that just left fork
+            # choice are unreachable by key; reclaim them on the same
+            # finality watermark the store prunes on
+            self.serve_tier.prune(keep)
 
     def _serve_light_clients(self, block):
         """Feed the light-client server on import: the block's
@@ -637,6 +643,11 @@ class BeaconChain:
             int(block.slot),
             finalized_header,
         )
+        if self.serve_tier is not None:
+            # even a non-head import can improve the best updates —
+            # bump the serving tier's generation so frozen light-client
+            # bytes built from the old server state become unreachable
+            self.serve_tier.note_light_client_update()
         # node wiring can gossip the fresh updates onward
         cb = getattr(self, "on_light_client_update", None)
         if cb is not None:
@@ -1294,6 +1305,13 @@ class BeaconChain:
                     "previous": old_root.hex(),
                 },
             )
+            if self.serve_tier is not None:
+                # re-key the response caches on the new head ROOT (a
+                # reorg at the same slot flips the root, so stale bytes
+                # become unreachable) and kick the warmer
+                self.serve_tier.on_head_change(
+                    head_root, int(new_state.slot)
+                )
             # engine fcU on head change (execution_layer forkchoiceUpdated)
             if self.execution_engine is not None and hasattr(
                 new_state, "latest_execution_payload_header"
@@ -1320,6 +1338,14 @@ class BeaconChain:
             )
 
     # -------------------------------------------------------- persistence
+
+    def attach_serve_tier(self, tier):
+        """Enroll the light-client serving tier (lighthouse_tpu/serve):
+        head changes re-key its response caches, light-client imports
+        bump its generation, and finality pruning reclaims its frozen
+        bodies — all through the hooks above."""
+        self.serve_tier = tier
+        return tier
 
     def attach_overlay(self, overlay):
         """Enroll the distributed aggregation overlay: the processor's

@@ -77,6 +77,10 @@ class BeaconNode:
     def start(self):
         if self.api_server is not None:
             self.api_server.start()
+        if self.chain.serve_tier is not None:
+            # read-path serving tier: event/log pumps + cache warmer
+            # (lighthouse_tpu/serve; its workers stamp heartbeats)
+            self.chain.serve_tier.start()
         # the verification dispatcher runs supervised like every other
         # service loop (it would also lazy-start on first submit)
         verifier = self.chain.verifier
@@ -245,6 +249,8 @@ class BeaconNode:
     def stop(self):
         self.watchdog.stop()
         self.executor.shutdown("node stop")
+        if self.chain.serve_tier is not None:
+            self.chain.serve_tier.stop()
         pool = getattr(self.chain.verifier, "remote_pool", None)
         if pool is not None:
             pool.stop()
@@ -516,6 +522,15 @@ class ClientBuilder:
             if self._http_port is not None
             else None
         )
+        if api_server is not None and \
+                os.environ.get("LTPU_SERVE", "1") not in ("", "0"):
+            # light-client serving tier (lighthouse_tpu/serve): response
+            # caches + coalescing + sharded SSE fan-out behind the API.
+            # Chains built without an API server (most tests) keep
+            # serve_tier=None and the legacy per-request paths.
+            from ..serve import ServeTier
+
+            chain.attach_serve_tier(ServeTier(chain))
         clock = self._clock or SystemSlotClock(
             int(self._genesis_state.genesis_time), self.spec.seconds_per_slot
         )

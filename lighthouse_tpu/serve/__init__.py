@@ -1,0 +1,34 @@
+"""Light-client serving tier: per-head response caches, request
+coalescing, sharded SSE fan-out, and read-path admission control.
+
+The read-path mirror of the write path's verify_service: compute once
+per (head root, generation), coalesce identical in-flight reads, fan
+immutable bytes out wide under a shed ladder.  See tier.ServeTier for
+the composition and the README "Light-client serving tier" section for
+the operator knobs (`LTPU_SERVE_*`).
+"""
+
+from .admission import SHED_LEVEL, AdmissionGate, ServeQuotaError, ServeShedError
+from .broadcast import SseBroadcaster
+from .cache import ResponseCache
+from .coalesce import SingleFlight
+from .tier import (
+    KEY_FINALITY_UPDATE,
+    KEY_HEADERS_HEAD,
+    KEY_OPTIMISTIC_UPDATE,
+    ServeTier,
+)
+
+__all__ = [
+    "AdmissionGate",
+    "KEY_FINALITY_UPDATE",
+    "KEY_HEADERS_HEAD",
+    "KEY_OPTIMISTIC_UPDATE",
+    "ResponseCache",
+    "SHED_LEVEL",
+    "ServeQuotaError",
+    "ServeShedError",
+    "ServeTier",
+    "SingleFlight",
+    "SseBroadcaster",
+]

@@ -1,0 +1,161 @@
+"""Admission & quota for the read path.
+
+Two gates, same shed machinery as the write path
+(verify_service/service.py):
+
+* **Per-client token buckets** — each client id gets `LTPU_SERVE_QPS`
+  tokens/second with an `LTPU_SERVE_BURST` reservoir; an empty bucket
+  raises `ServeQuotaError` (a `LoadShedError`, so any caller that
+  already handles write-path shed handles this too → HTTP 429).
+* **Shed-by-class overload ladder** — when the tier's in-flight count
+  crosses the watermark, low-value read classes are rejected before any
+  chain read happens.  The ladder mirrors `SHED_LEVEL` on the write
+  path: proofs shed first (level 1), head events next (level 2),
+  finality queries never — a light client that can still learn finality
+  can re-sync everything else later.
+
+Shed decisions are made under the lock; the WARN is emitted OUTSIDE it
+(the write path's exact discipline — the log handler does I/O that
+must never stall every submitter).
+"""
+
+import os
+import time
+
+from ..utils import locks
+from ..utils.logging import get_logger
+from ..verify_service.service import LoadShedError
+from . import metrics as M
+
+log = get_logger("serve")
+
+# read-path shed ladder: the overload level at which each class is
+# rejected before computing.  "finality" is deliberately absent — the
+# finality query is the read-path analogue of a block on the write path.
+SHED_LEVEL = {"proof": 1, "head": 2}
+
+DEFAULT_QPS = 50.0
+DEFAULT_BURST = 100.0
+DEFAULT_WATERMARK = 256      # in-flight requests where level 1 begins
+MAX_TRACKED_CLIENTS = 65536  # bucket table bound (FIFO-evicted beyond)
+
+
+class ServeQuotaError(LoadShedError):
+    """A client's token bucket is empty — the request is dropped, not
+    queued (429 at the HTTP surface)."""
+
+
+class ServeShedError(LoadShedError):
+    """Overload policy rejected the request class before computing."""
+
+
+class _Bucket:
+    __slots__ = ("tokens", "stamp")
+
+    def __init__(self, burst, now):
+        self.tokens = burst
+        self.stamp = now
+
+
+class AdmissionGate:
+    """Token buckets + the overload ladder, one shared lock."""
+
+    def __init__(self, qps=None, burst=None, watermark=None,
+                 clock=time.monotonic):
+        self.qps = float(os.environ.get("LTPU_SERVE_QPS", DEFAULT_QPS)
+                         if qps is None else qps)
+        self.burst = float(os.environ.get("LTPU_SERVE_BURST", DEFAULT_BURST)
+                           if burst is None else burst)
+        self.watermark = int(DEFAULT_WATERMARK
+                             if watermark is None else watermark)
+        self._clock = clock
+        self._lock = locks.lock("serve.admission")
+        self._buckets = {}          # client id -> _Bucket
+        self._inflight = 0
+        locks.guarded(self, "_buckets", self._lock)
+        locks.guarded(self, "_inflight", self._lock)
+
+    # ------------------------------------------------------------ ladder
+
+    def _overload_level_locked(self):
+        """0 healthy; 1 past the in-flight watermark (shed proofs);
+        2 at 4x the watermark (shed head reads too) — the read-path
+        mirror of the write path's backlog ladder."""
+        if self._inflight >= 4 * self.watermark:
+            return 2
+        if self._inflight >= self.watermark:
+            return 1
+        return 0
+
+    # --------------------------------------------------------- admission
+
+    def admit(self, client_id, klass):
+        """Gate one request; raises ServeShedError / ServeQuotaError.
+        On success the request is counted in flight — the caller MUST
+        pair this with `release()`."""
+        shed_at = SHED_LEVEL.get(klass)
+        now = self._clock()
+        warn = None
+        with self._lock:
+            locks.access(self, "_inflight", "read")
+            level = self._overload_level_locked()
+            if shed_at is not None and level >= shed_at:
+                warn = ("shed", level, self._inflight)
+            else:
+                locks.access(self, "_buckets", "write")
+                bucket = self._buckets.get(client_id)
+                if bucket is None:
+                    while len(self._buckets) >= MAX_TRACKED_CLIENTS:
+                        self._buckets.pop(next(iter(self._buckets)))
+                    bucket = self._buckets[client_id] = _Bucket(
+                        self.burst, now)
+                else:
+                    bucket.tokens = min(
+                        self.burst,
+                        bucket.tokens + (now - bucket.stamp) * self.qps,
+                    )
+                    bucket.stamp = now
+                if bucket.tokens < 1.0:
+                    warn = ("quota", level, self._inflight)
+                else:
+                    bucket.tokens -= 1.0
+                    locks.access(self, "_inflight", "write")
+                    self._inflight += 1
+        if warn is None:
+            M.REQUESTS.with_labels(klass).inc()
+            return
+        reason, level, inflight = warn
+        M.SHED.with_labels(klass).inc()
+        if reason == "shed":
+            log.warning_rate_limited(
+                f"serve_shed:{klass}", 1.0,
+                "shedding %s read traffic under overload",
+                klass, overload_level=level, inflight=inflight,
+            )
+            raise ServeShedError(
+                f"{klass} reads shed under overload (level {level})"
+            )
+        log.warning_rate_limited(
+            f"serve_quota:{client_id}", 5.0,
+            "client over read quota", client=str(client_id), klass=klass,
+        )
+        raise ServeQuotaError(f"client {client_id} over {klass} read quota")
+
+    def release(self):
+        with self._lock:
+            locks.access(self, "_inflight", "write")
+            self._inflight -= 1
+
+    # --------------------------------------------------------- reporting
+
+    def stats(self):
+        with self._lock:
+            locks.access(self, "_inflight", "read")
+            return {
+                "inflight": self._inflight,
+                "overload_level": self._overload_level_locked(),
+                "tracked_clients": len(self._buckets),
+                "qps": self.qps,
+                "burst": self.burst,
+                "watermark": self.watermark,
+            }

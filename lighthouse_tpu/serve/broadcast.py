@@ -1,0 +1,288 @@
+"""Sharded SSE fan-out: worker-pool broadcast with per-client bounded
+queues.
+
+The legacy SSE routes parked one handler thread per subscriber and
+wrote to the socket from the handler loop with no bound — one wedged
+client stalled its own event drain and (through the broadcaster queue
+it stopped reading) degraded everyone.  Here the HTTP handler hands the
+connection's socket to the broadcaster and returns; clients are hashed
+across shards, each shard owned by one daemon worker that drains every
+client's bounded frame queue with a short socket timeout:
+
+* a frame is rendered to bytes ONCE per event by the publisher, then
+  enqueued per matching client — fan-out is a deque append, not a
+  per-client re-serialization;
+* a client whose bounded queue overflows (it stopped reading; TCP
+  backpressure reached us) is disconnected with a counted drop
+  (`serve_sse_dropped_total{reason="slow"}`) — it can never stall the
+  publish pass or any other subscriber;
+* sockets are NON-blocking: a full kernel buffer costs the worker
+  nothing (the client is marked choked and retried after `RETRY_S`
+  instead of blocking the pass), so one wedged subscriber adds zero
+  latency to its shard-mates;
+* all socket I/O happens OUTSIDE the shard lock (the lock-discipline
+  invariant); the lock is held only for deque/dict updates.
+
+Each shard worker stamps a heartbeat every pass so the node watchdog
+can supervise the pool like any other worker loop.
+"""
+
+import threading
+import time
+
+from ..utils import failpoints, locks
+from . import metrics as M
+
+DEFAULT_SHARDS = 4
+DEFAULT_QUEUE = 256          # frames buffered per client before drop
+KEEPALIVE_S = 1.0            # SSE comment ping to idle subscribers
+RETRY_S = 0.05               # choked-client (full kernel buffer) retry
+KEEPALIVE_FRAME = b": keepalive\n\n"
+
+
+class SseClient:
+    """One subscriber: a dup'd socket plus its bounded frame queue.
+    `kinds`/`predicate` select which published frames it receives;
+    predicates run under the shard lock and MUST be pure."""
+
+    __slots__ = ("sock", "kinds", "predicate", "frames", "pending",
+                 "alive", "label", "last_tx", "delivered")
+
+    def __init__(self, sock, kinds=None, predicate=None, label=""):
+        self.sock = sock
+        self.kinds = frozenset(kinds) if kinds is not None else None
+        self.predicate = predicate
+        self.frames = []
+        self.pending = b""
+        self.alive = True
+        self.label = label
+        self.last_tx = time.monotonic()
+        self.delivered = 0
+
+    def wants(self, topic, meta):
+        if self.kinds is not None and topic not in self.kinds:
+            return False
+        if self.predicate is not None:
+            return bool(self.predicate(topic, meta))
+        return True
+
+
+class _Shard:
+    """One worker's slice of the subscriber population."""
+
+    def __init__(self, idx, queue_cap):
+        self.idx = idx
+        self.queue_cap = int(queue_cap)
+        self._lock = locks.lock("serve.sse")
+        self._cv = threading.Condition(self._lock)
+        self._clients = []
+        self._stopping = False
+        self.heartbeat = time.monotonic()
+        self.thread = threading.Thread(
+            target=self._run, name=f"sse-shard-{idx}", daemon=True)
+
+    # ------------------------------------------------------- membership
+
+    def add(self, client):
+        client.sock.setblocking(False)
+        with self._cv:
+            locks.access(self, "_clients", "write")
+            self._clients.append(client)
+            self._cv.notify()
+
+    def _detach(self, client):
+        """Remove under the lock; returns whether it was still attached
+        (exactly-once disconnect accounting)."""
+        with self._cv:
+            locks.access(self, "_clients", "write")
+            if client not in self._clients:
+                return False
+            self._clients.remove(client)
+            client.alive = False
+        return True
+
+    # ---------------------------------------------------------- publish
+
+    def publish(self, topic, frame, meta):
+        """Enqueue `frame` for every matching subscriber; queue-overflow
+        victims are collected under the lock and disconnected outside
+        it.  Returns the number of clients the frame was queued for."""
+        slow = []
+        queued = 0
+        with self._cv:
+            locks.access(self, "_clients", "read")
+            for c in self._clients:
+                if not c.wants(topic, meta):
+                    continue
+                if len(c.frames) >= self.queue_cap:
+                    slow.append(c)
+                    continue
+                c.frames.append(frame)
+                queued += 1
+            if queued:
+                self._cv.notify()
+        for c in slow:
+            self.disconnect(c, "slow")
+        return queued
+
+    def disconnect(self, client, reason):
+        if not self._detach(client):
+            return
+        M.SSE_DROPPED.with_labels(reason).inc()
+        M.SSE_CLIENTS.dec()
+        try:
+            client.sock.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------ worker loop
+
+    def _run(self):
+        while True:
+            with self._cv:
+                if self._stopping:
+                    return
+                now = time.monotonic()
+                work = []
+                choked = False
+                for c in self._clients:
+                    if c.pending and now - c.last_tx < RETRY_S:
+                        # kernel buffer was full last attempt: let it
+                        # drain instead of burning a send per pass
+                        choked = True
+                        continue
+                    if c.pending or c.frames:
+                        buf = c.pending + b"".join(c.frames)
+                        c.frames.clear()
+                        c.pending = b""
+                        work.append((c, buf))
+                if not work:
+                    self._cv.wait(timeout=RETRY_S if choked
+                                  else KEEPALIVE_S / 2)
+            self.heartbeat = now = time.monotonic()
+            for c, buf in work:
+                self._send(c, buf)
+            if not work:
+                self._keepalive(now)
+
+    def _keepalive(self, now):
+        with self._cv:
+            locks.access(self, "_clients", "read")
+            idle = [c for c in self._clients
+                    if now - c.last_tx >= KEEPALIVE_S
+                    and not c.pending and not c.frames]
+        for c in idle:
+            self._send(c, KEEPALIVE_FRAME, keepalive=True)
+
+    def _send(self, client, buf, keepalive=False):
+        """Non-blocking socket write OUTSIDE the shard lock.  A full
+        kernel buffer sends 0 bytes and costs nothing; unsent bytes go
+        back as `pending` ahead of any frames enqueued meanwhile, so
+        ordering is preserved."""
+        try:
+            buf = failpoints.hit("serve.sse", data=buf)
+            sent = client.sock.send(buf)
+        except (BlockingIOError, InterruptedError, TimeoutError):
+            sent = 0
+        except OSError:
+            self.disconnect(client, "error")
+            return
+        except failpoints.FailpointError:
+            self.disconnect(client, "error")
+            return
+        client.last_tx = time.monotonic()
+        if sent >= len(buf):
+            if not keepalive:
+                client.delivered += 1
+                M.SSE_EVENTS.inc()
+            return
+        rest = buf[sent:]
+        if keepalive:
+            rest = b""          # keepalives are droppable filler
+        with self._cv:
+            if client.alive:
+                client.pending = rest
+                if rest:
+                    self._cv.notify()
+
+    def stop(self):
+        with self._cv:
+            self._stopping = True
+            clients = list(self._clients)
+            self._clients = []
+            self._cv.notify_all()
+        for c in clients:
+            c.alive = False
+            try:
+                c.sock.close()
+            except OSError:
+                pass
+        M.SSE_CLIENTS.dec(len(clients))
+
+    def snapshot(self):
+        with self._cv:
+            locks.access(self, "_clients", "read")
+            return {
+                "clients": len(self._clients),
+                "queued_frames": sum(len(c.frames) for c in self._clients),
+                "heartbeat_age_s": round(
+                    time.monotonic() - self.heartbeat, 3),
+            }
+
+
+class SseBroadcaster:
+    """Shard owner: hashes subscribers across `n_shards` worker-owned
+    shards and fans every published frame out to all of them."""
+
+    def __init__(self, n_shards=DEFAULT_SHARDS, queue_cap=DEFAULT_QUEUE):
+        n_shards = max(1, int(n_shards))
+        self.shards = [_Shard(i, queue_cap) for i in range(n_shards)]
+        self._next = 0
+        self._lock = locks.lock("serve.sse.assign")
+        self._started = False
+        locks.guarded(self, "_next", self._lock)
+
+    def _ensure_started(self):
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
+        for sh in self.shards:
+            sh.thread.start()
+
+    def subscribe(self, sock, kinds=None, predicate=None, label=""):
+        """Register a (dup'd) socket; returns the SseClient handle."""
+        self._ensure_started()
+        client = SseClient(sock, kinds=kinds, predicate=predicate,
+                           label=label)
+        with self._lock:
+            locks.access(self, "_next", "write")
+            shard = self.shards[self._next % len(self.shards)]
+            self._next += 1
+        shard.add(client)
+        M.SSE_CLIENTS.inc()
+        return client
+
+    def publish(self, topic, frame, meta=None):
+        """Fan one pre-rendered frame out; returns subscribers queued."""
+        return sum(sh.publish(topic, frame, meta) for sh in self.shards)
+
+    def disconnect(self, client, reason="closed"):
+        for sh in self.shards:
+            sh.disconnect(client, reason)
+
+    def client_count(self):
+        return sum(sh.snapshot()["clients"] for sh in self.shards)
+
+    def stop(self):
+        for sh in self.shards:
+            sh.stop()
+        for sh in self.shards:
+            if sh.thread.is_alive():
+                sh.thread.join(timeout=1.0)
+
+    def stats(self):
+        return {
+            "shards": [sh.snapshot() for sh in self.shards],
+            "clients": self.client_count(),
+        }

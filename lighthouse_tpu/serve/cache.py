@@ -1,0 +1,91 @@
+"""Per-head immutable response cache.
+
+Bodies are keyed on (head_root, generation, route_key) — the head ROOT,
+never the slot number, so a reorg that flips the head at the same slot
+can never serve bytes computed against the orphaned branch.  The
+generation is a light-client-update counter: imports that change the
+best updates without moving the head (non-canonical blocks still feed
+`LightClientServer`) bump it, invalidating the light-client bodies
+while the head root stays put.
+
+Every entry stores a sha256 alongside the frozen bytes, computed BEFORE
+the `serve.cache` failpoint runs on the blob — so a corrupt-mode
+injection (or a real bit-rot) is caught by the byte-identity check on
+read and the entry is recomputed, never served.
+
+Pruned at finality with the same keep-set `_prune_finalized` computes
+for the store: any root no longer in fork choice is unreachable and its
+frozen bodies can never be requested correctly again.
+"""
+
+import hashlib
+
+from ..utils import failpoints, locks
+from . import metrics as M
+
+
+class ResponseCache:
+    """head-root-keyed frozen response bodies with checksum integrity."""
+
+    def __init__(self, max_entries=4096):
+        self._lock = locks.lock("serve.cache")
+        self._entries = {}          # (root, gen, route_key) -> (blob, sha)
+        self.max_entries = int(max_entries)
+        locks.guarded(self, "_entries", self._lock)
+
+    def get(self, root, gen, route_key):
+        """The frozen bytes, or None on miss.  A checksum mismatch
+        (corruption) drops the entry and reads as a miss — the caller
+        recomputes, so corrupted bytes are never served."""
+        key = (root, gen, route_key)
+        with self._lock:
+            locks.access(self, "_entries", "read")
+            entry = self._entries.get(key)
+        if entry is None:
+            M.CACHE_MISSES.inc()
+            return None
+        blob, sha = entry
+        if hashlib.sha256(blob).digest() != sha:
+            with self._lock:
+                locks.access(self, "_entries", "write")
+                self._entries.pop(key, None)
+                M.CACHE_ENTRIES.set(len(self._entries))
+            M.INTEGRITY_FAILURES.inc()
+            M.CACHE_MISSES.inc()
+            return None
+        M.CACHE_HITS.inc()
+        return blob
+
+    def put(self, root, gen, route_key, blob):
+        """Freeze `blob` for (root, gen, route_key).  The checksum is
+        taken before the failpoint so an injected corruption lands in
+        the stored bytes but not the digest — get() then catches it."""
+        sha = hashlib.sha256(blob).digest()
+        blob = failpoints.hit("serve.cache", data=blob)
+        with self._lock:
+            locks.access(self, "_entries", "write")
+            while len(self._entries) >= self.max_entries:
+                # FIFO via dict insertion order: oldest frozen body goes
+                self._entries.pop(next(iter(self._entries)))
+                M.CACHE_PRUNED.inc()
+            self._entries[(root, gen, route_key)] = (blob, sha)
+            M.CACHE_ENTRIES.set(len(self._entries))
+
+    def prune(self, keep_roots):
+        """Drop every entry whose head root left fork choice (the
+        finality watermark keep-set).  Returns the number dropped."""
+        keep = set(keep_roots)
+        with self._lock:
+            locks.access(self, "_entries", "write")
+            dead = [k for k in self._entries if k[0] not in keep]
+            for k in dead:
+                del self._entries[k]
+            M.CACHE_ENTRIES.set(len(self._entries))
+        if dead:
+            M.CACHE_PRUNED.inc(len(dead))
+        return len(dead)
+
+    def __len__(self):
+        with self._lock:
+            locks.access(self, "_entries", "read")
+            return len(self._entries)

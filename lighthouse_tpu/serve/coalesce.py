@@ -1,0 +1,103 @@
+"""Request coalescing: a single-flight table for identical in-flight
+reads.
+
+N concurrent requests for the same (route, params, head root) key cost
+ONE chain/state read: the first caller becomes the leader and computes;
+everyone else parks on the flight's event and shares the leader's
+result.  Resolution is first-write-wins (`_Flight.offer`, the same
+idiom as verify_service/remote.py's `_Job.offer`) so a late or
+duplicate resolution can never clobber the value waiters already read.
+"""
+
+import threading
+
+from ..utils import locks
+from . import metrics as M
+
+
+class _Flight:
+    """One in-flight computation; first-write-wins resolution."""
+
+    __slots__ = ("event", "value", "error", "lock", "joiners")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value = None
+        self.error = None
+        self.lock = locks.lock("serve.flight")
+        self.joiners = 0
+
+    def offer(self, value):
+        """Deliver the computed value; False when the flight already
+        resolved (the duplicate is dropped, never re-resolved)."""
+        with self.lock:
+            if self.event.is_set():
+                return False
+            self.value = value
+        self.event.set()
+        return True
+
+    def fail(self, error):
+        with self.lock:
+            if self.event.is_set():
+                return False
+            self.error = error
+        self.event.set()
+        return True
+
+    def result(self, timeout):
+        if not self.event.wait(timeout):
+            raise TimeoutError("coalesced request leader never resolved")
+        if self.error is not None:
+            raise self.error
+        return self.value
+
+
+class SingleFlight:
+    """Key -> in-flight computation table.
+
+    `run(key, compute)` either leads (computes, resolves, returns) or
+    joins (waits on the leader's flight).  The leader removes the
+    flight from the table BEFORE resolving it, so a request arriving
+    after resolution starts a fresh computation instead of reading a
+    value of unknown age.
+    """
+
+    def __init__(self, wait_timeout=30.0):
+        self._lock = locks.lock("serve.coalesce")
+        self._flights = {}
+        self.wait_timeout = float(wait_timeout)
+        locks.guarded(self, "_flights", self._lock)
+
+    def run(self, key, compute):
+        """Returns (value, coalesced): `coalesced` is True when this
+        call shared another caller's read."""
+        with self._lock:
+            locks.access(self, "_flights", "write")
+            flight = self._flights.get(key)
+            if flight is None:
+                flight = self._flights[key] = _Flight()
+                leader = True
+            else:
+                flight.joiners += 1
+                leader = False
+        if not leader:
+            M.COALESCED.inc()
+            return flight.result(self.wait_timeout), True
+        try:
+            value = compute()
+        except BaseException as e:
+            with self._lock:
+                locks.access(self, "_flights", "write")
+                self._flights.pop(key, None)
+            flight.fail(e)
+            raise
+        with self._lock:
+            locks.access(self, "_flights", "write")
+            self._flights.pop(key, None)
+        flight.offer(value)
+        return value, False
+
+    def inflight(self):
+        with self._lock:
+            return len(self._flights)

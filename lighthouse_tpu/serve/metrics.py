@@ -1,0 +1,64 @@
+"""Metric families for the light-client serving tier.
+
+All families carry the `serve_` prefix so the analysis
+metric-registration lint and the /metrics scrape group the read-path
+tier the way `verify_service_*` groups the write path.
+"""
+
+from ..utils import metrics
+
+REQUESTS = metrics.counter(
+    "serve_requests_total",
+    "Read-path requests admitted to the serving tier",
+    labels=("class",),
+)
+SHED = metrics.counter(
+    "serve_shed_total",
+    "Read-path requests rejected by admission/quota, by class",
+    labels=("class",),
+)
+CACHE_HITS = metrics.counter(
+    "serve_cache_hits_total",
+    "Responses served as frozen bytes from the per-head response cache",
+)
+CACHE_MISSES = metrics.counter(
+    "serve_cache_misses_total",
+    "Responses that had to be computed from chain state",
+)
+COALESCED = metrics.counter(
+    "serve_coalesced_total",
+    "Requests that joined another caller's in-flight computation "
+    "instead of reading chain state themselves",
+)
+CACHE_ENTRIES = metrics.gauge(
+    "serve_cache_entries",
+    "Frozen response bodies currently cached across all head roots",
+)
+CACHE_PRUNED = metrics.counter(
+    "serve_cache_pruned_total",
+    "Cache entries dropped by the finality watermark / reorg pruning",
+)
+INTEGRITY_FAILURES = metrics.counter(
+    "serve_cache_integrity_failures_total",
+    "Cached bodies that failed the byte-identity checksum and were "
+    "recomputed instead of served",
+)
+SSE_CLIENTS = metrics.gauge(
+    "serve_sse_clients",
+    "SSE subscribers currently registered with the broadcaster",
+)
+SSE_EVENTS = metrics.counter(
+    "serve_sse_events_total",
+    "Events fanned out by the sharded SSE broadcaster",
+)
+SSE_DROPPED = metrics.counter(
+    "serve_sse_dropped_total",
+    "SSE subscribers disconnected by the broadcaster, by reason "
+    "(slow = bounded queue overflow, error = socket failure)",
+    labels=("reason",),
+)
+REQUEST_SECONDS = metrics.histogram(
+    "serve_request_seconds",
+    "Serving-tier request latency (admission through response bytes)",
+    labels=("class",),
+)

@@ -1,0 +1,154 @@
+"""Shared response-body builders for the cacheable read routes.
+
+Both the HTTP routes (api/http_api.py) and the serving tier's cache
+warmers build their bodies HERE, and both serialize through
+`json_bytes` — the same `json.dumps(obj).encode()` the JsonHandler
+`_json` envelope uses.  Byte-identity between the cached and uncached
+paths is therefore by construction, not by test luck: there is exactly
+one place each body shape is written down.
+
+Builders return the response body dict, or None when the route's
+existing not-found / not-available condition holds (the route answers
+with its legacy 4xx; errors are never cached).
+"""
+
+import json
+
+from ..ssz import encode as ssz_encode
+from ..ssz import hash_tree_root
+
+
+def json_bytes(obj):
+    """The exact serialization JsonHandler._json performs."""
+    return json.dumps(obj).encode()
+
+
+def hex_bytes(b):
+    return "0x" + bytes(b).hex()
+
+
+def canonical_root_at_slot(chain, slot):
+    """Canonical chain walk back from head to the block at or before
+    `slot` (block_id.rs slot resolution — shared with the handler)."""
+    root = chain.head_root
+    while root is not None:
+        blk = chain.store.get_block(root)
+        if blk is None:
+            return chain.genesis_root if slot == 0 else None
+        if int(blk.message.slot) <= slot:
+            return root
+        root = bytes(blk.message.parent_root)
+    return None
+
+
+def header_json(msg):
+    return {
+        "slot": str(int(msg.slot)),
+        "proposer_index": str(int(msg.proposer_index)),
+        "parent_root": hex_bytes(msg.parent_root),
+        "state_root": hex_bytes(msg.state_root),
+        "body_root": hex_bytes(hash_tree_root(msg.body)),
+    }
+
+
+# --------------------------------------------------- light-client bodies
+
+
+def finality_update_body(chain):
+    from ..light_client import light_client_types
+
+    srv = chain.light_client_server
+    if srv is None or srv.latest_finality_update is None:
+        return None
+    LT = light_client_types(chain.preset)
+    return {
+        "data": {
+            "ssz": "0x"
+            + ssz_encode(
+                LT.LightClientFinalityUpdate,
+                srv.latest_finality_update,
+            ).hex()
+        }
+    }
+
+
+def optimistic_update_body(chain):
+    from ..light_client import light_client_types
+
+    srv = chain.light_client_server
+    if srv is None or srv.latest_optimistic_update is None:
+        return None
+    LT = light_client_types(chain.preset)
+    return {
+        "data": {
+            "ssz": "0x"
+            + ssz_encode(
+                LT.LightClientOptimisticUpdate,
+                srv.latest_optimistic_update,
+            ).hex()
+        }
+    }
+
+
+def updates_body(chain, start, count):
+    from ..light_client import light_client_types
+
+    srv = chain.light_client_server
+    if srv is None:
+        return {"data": []}
+    LT = light_client_types(chain.preset)
+    return {
+        "data": [
+            {"ssz": "0x" + ssz_encode(LT.LightClientUpdate, u).hex()}
+            for u in srv.updates_range(start, count)
+        ]
+    }
+
+
+def bootstrap_body(chain, root):
+    """None on unknown root; propagates LightClientError (the route's
+    400 path) — only a successfully built bootstrap is cacheable."""
+    from ..light_client import bootstrap_from_state, light_client_types
+
+    state = chain.store.get_state(root)
+    if state is None:
+        return None
+    boot = bootstrap_from_state(state, chain.preset)
+    LT = light_client_types(chain.preset)
+    return {
+        "data": {
+            "ssz": "0x" + ssz_encode(LT.LightClientBootstrap, boot).hex()
+        }
+    }
+
+
+# ---------------------------------------------------- chain-query bodies
+
+
+def finality_checkpoints_body(state):
+    def ckpt(c):
+        return {"epoch": str(int(c.epoch)), "root": hex_bytes(c.root)}
+
+    return {
+        "data": {
+            "previous_justified": ckpt(state.previous_justified_checkpoint),
+            "current_justified": ckpt(state.current_justified_checkpoint),
+            "finalized": ckpt(state.finalized_checkpoint),
+        }
+    }
+
+
+def headers_body(chain, want_slot=None):
+    """The /eth/v1/beacon/headers list form: head header, or the header
+    at EXACTLY `want_slot` (empty list for skipped slots)."""
+    target = (canonical_root_at_slot(chain, want_slot)
+              if want_slot is not None else chain.head_root)
+    blk = chain.store.get_block(target) if target else None
+    if blk is None or (want_slot is not None
+                       and int(blk.message.slot) != want_slot):
+        return {"data": []}
+    return {"data": [{
+        "root": hex_bytes(target),
+        "canonical": True,
+        "header": {"message": header_json(blk.message)},
+    }]}

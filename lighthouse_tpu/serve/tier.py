@@ -1,0 +1,345 @@
+"""The light-client serving tier: admission -> cache -> single-flight
+-> chain, plus the sharded SSE fan-out.
+
+Sits between the HTTP surface (api/http_api.py) and the beacon chain.
+Read requests flow::
+
+    respond(client, class, key, compute)
+        admission gate (token bucket + shed ladder)   -> 429 on shed
+        response cache  (head_root, generation, key)  -> frozen bytes
+        single-flight   (identical in-flight queries) -> ONE compute()
+        compute()       the route's chain/state read  -> cached + served
+
+Cache keying rule: the head ROOT (never the slot number) plus a
+light-client **generation** counter bumped on every import that feeds
+`LightClientServer` — a reorg flips the root, a non-head import that
+improves the best update bumps the generation, and either way stale
+frozen bytes become unreachable rather than merely suspect.  Routes
+pinned to an explicit state root (bootstraps, finality checkpoints by
+root) pass `pinned_root` and skip the generation: their bodies are a
+pure function of the root.
+
+The chain drives invalidation through three hooks (beacon/chain.py):
+`on_head_change` (recompute_head), `note_light_client_update`
+(_serve_light_clients), and `prune` (the `_prune_finalized` keep-set
+watermark).
+
+A warm daemon precomputes the standard head bodies on each head change
+so the slot-boundary herd finds frozen bytes instead of racing the
+first computation; it shares the single-flight table with live
+requests, so a request arriving mid-warm coalesces with the warmer.
+Both pump threads (chain events, live log records) and the warmer
+stamp heartbeats for watchdog supervision.
+"""
+
+import os
+import queue
+import threading
+import time
+
+from ..utils import failpoints, locks, tracing
+from ..utils import logging as ltpu_logging
+from ..utils.logging import get_logger
+from . import metrics as M
+from . import responses
+from .admission import AdmissionGate
+from .broadcast import SseBroadcaster
+from .cache import ResponseCache
+from .coalesce import SingleFlight
+
+log = get_logger("serve")
+
+failpoints.declare("serve.cache",
+                   "serving-tier response cache store (corrupt exercises "
+                   "the byte-identity integrity check)")
+failpoints.declare("serve.coalesce",
+                   "single-flight leader computation, before the chain read")
+failpoints.declare("serve.sse",
+                   "SSE broadcaster socket write path (per send)")
+
+# route keys shared between the HTTP routes and the head-change warmer —
+# both sides MUST use the same literal or the warm entry is unreachable
+KEY_FINALITY_UPDATE = ("/eth/v1/beacon/light_client/finality_update",)
+KEY_OPTIMISTIC_UPDATE = ("/eth/v1/beacon/light_client/optimistic_update",)
+KEY_HEADERS_HEAD = ("/eth/v1/beacon/headers", None)
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return int(default)
+
+
+class ServeTier:
+    """One per node; attached to the chain by the builder
+    (`chain.attach_serve_tier`)."""
+
+    def __init__(self, chain, cache_max=None, sse_shards=None,
+                 sse_queue=None, qps=None, burst=None, watermark=None,
+                 warm=None):
+        self.chain = chain
+        self.cache = ResponseCache(
+            max_entries=(_env_int("LTPU_SERVE_CACHE_MAX", 4096)
+                         if cache_max is None else int(cache_max)))
+        self.flights = SingleFlight()
+        self.admission = AdmissionGate(qps=qps, burst=burst,
+                                       watermark=watermark)
+        self.broadcaster = SseBroadcaster(
+            n_shards=(_env_int("LTPU_SERVE_SSE_SHARDS", 4)
+                      if sse_shards is None else int(sse_shards)),
+            queue_cap=(_env_int("LTPU_SERVE_SSE_QUEUE", 256)
+                       if sse_queue is None else int(sse_queue)))
+        self.warm_enabled = (
+            os.environ.get("LTPU_SERVE_WARM", "1") not in ("", "0")
+            if warm is None else bool(warm))
+
+        self._lock = locks.lock("serve.tier")
+        self._gen = 0
+        self._head_root = chain.head_root
+        self._head_slot = int(chain.head_state.slot)
+        locks.guarded(self, "_gen", self._lock)
+        locks.guarded(self, "_head_root", self._lock)
+        locks.guarded(self, "_head_slot", self._lock)
+
+        self._stop_flag = threading.Event()
+        self._warm_cv = threading.Condition(locks.lock("serve.warm"))
+        self._warm_pending = None
+        locks.guarded(self, "_warm_pending", self._warm_cv)
+        self.heartbeat = time.monotonic()
+
+        self._event_sub = None
+        self._log_sub = None
+        self._threads = []
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self):
+        """Start the pumps + warmer (idempotent)."""
+        if self._threads:
+            return self
+        self._event_sub = self.chain.events.subscribe()
+        self._log_sub = ltpu_logging.subscribe()
+        self._threads = [
+            threading.Thread(target=self._event_loop, name="serve-events",
+                             daemon=True),
+            threading.Thread(target=self._log_loop, name="serve-logs",
+                             daemon=True),
+        ]
+        if self.warm_enabled:
+            self._threads.append(
+                threading.Thread(target=self._warm_loop, name="serve-warm",
+                                 daemon=True))
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self):
+        self._stop_flag.set()
+        with self._warm_cv:
+            self._warm_cv.notify_all()
+        if self._event_sub is not None:
+            self.chain.events.unsubscribe(self._event_sub)
+        if self._log_sub is not None:
+            ltpu_logging.unsubscribe(self._log_sub)
+        for t in self._threads:
+            if t.is_alive():
+                t.join(timeout=1.0)
+        self.broadcaster.stop()
+
+    # ------------------------------------------------------------ requests
+
+    def head_key(self):
+        """(head_root, generation) the next request will be keyed on."""
+        with self._lock:
+            locks.access(self, "_head_root", "read")
+            locks.access(self, "_gen", "read")
+            return self._head_root, self._gen
+
+    def respond(self, client_id, klass, route_key, compute,
+                pinned_root=None):
+        """Admission-gated cached read; returns frozen response bytes.
+        Raises LoadShedError subclasses when the request is shed (the
+        HTTP surface maps those to 429)."""
+        self.admission.admit(client_id, klass)
+        try:
+            with M.REQUEST_SECONDS.with_labels(klass).start_timer():
+                return self._fetch(route_key, compute,
+                                   pinned_root=pinned_root, klass=klass)
+        finally:
+            self.admission.release()
+
+    def _fetch(self, route_key, compute, pinned_root=None, klass="serve"):
+        if pinned_root is not None:
+            root, gen = pinned_root, 0
+        else:
+            root, gen = self.head_key()
+        blob = self.cache.get(root, gen, route_key)
+        if blob is not None:
+            return blob
+
+        def lead():
+            failpoints.hit("serve.coalesce")
+            tr = tracing.start_trace("serve", route=str(route_key[0]),
+                                     klass=klass)
+            with tracing.use(tr):
+                with tr.span("compute"):
+                    body = compute()
+            tr.finish()
+            self.cache.put(root, gen, route_key, body)
+            return body
+
+        blob, _ = self.flights.run((root, gen, route_key), lead)
+        return blob
+
+    # ------------------------------------------------------- chain hooks
+
+    def on_head_change(self, head_root, slot):
+        """recompute_head hook: re-key the cache on the new head root
+        and hand the warmer its next target."""
+        with self._lock:
+            locks.access(self, "_head_root", "write")
+            locks.access(self, "_head_slot", "write")
+            self._head_root = head_root
+            self._head_slot = int(slot)
+        if self.warm_enabled:
+            with self._warm_cv:
+                locks.access(self, "_warm_pending", "write")
+                self._warm_pending = head_root
+                self._warm_cv.notify()
+
+    def note_light_client_update(self):
+        """_serve_light_clients hook: a (possibly non-head) import
+        changed the light-client server's bodies — bump the generation
+        so the frozen light-client bytes become unreachable."""
+        with self._lock:
+            locks.access(self, "_gen", "write")
+            self._gen += 1
+
+    def prune(self, keep_roots):
+        """_prune_finalized hook: drop frozen bodies for roots that
+        left fork choice."""
+        return self.cache.prune(keep_roots)
+
+    # ------------------------------------------------------------ warming
+
+    def _warm_set(self):
+        chain = self.chain
+        return (
+            (KEY_FINALITY_UPDATE, "proof",
+             lambda: responses.finality_update_body(chain)),
+            (KEY_OPTIMISTIC_UPDATE, "proof",
+             lambda: responses.optimistic_update_body(chain)),
+            (KEY_HEADERS_HEAD, "head",
+             lambda: responses.headers_body(chain)),
+        )
+
+    def _warm_loop(self):
+        while True:
+            with self._warm_cv:
+                while (self._warm_pending is None
+                       and not self._stop_flag.is_set()):
+                    self._warm_cv.wait(timeout=0.5)
+                if self._stop_flag.is_set():
+                    return
+                locks.access(self, "_warm_pending", "write")
+                self._warm_pending = None
+            self.heartbeat = time.monotonic()
+            for route_key, klass, build in self._warm_set():
+                if self._stop_flag.is_set():
+                    return
+                try:
+                    self._fetch(route_key, self._body_bytes(build),
+                                klass=klass)
+                except Exception:  # noqa: BLE001 — warming is best-effort
+                    log.debug("serve warm miss", route=str(route_key[0]))
+
+    @staticmethod
+    def _body_bytes(build):
+        def compute():
+            body = build()
+            if body is None:
+                raise LookupError("body not available yet")
+            return responses.json_bytes(body)
+        return compute
+
+    # --------------------------------------------------------------- pumps
+
+    def _event_loop(self):
+        """Drain the chain event broadcaster into the sharded SSE
+        fan-out: ONE frame render per event, however many subscribers."""
+        sub = self._event_sub
+        events = self.chain.events
+        while not self._stop_flag.is_set():
+            try:
+                kind, payload = sub.get(timeout=0.5)
+            except queue.Empty:
+                self.heartbeat = time.monotonic()
+                continue
+            frame = events.sse_frame(kind, payload)
+            self.broadcaster.publish(kind, frame, meta=payload)
+            self.heartbeat = time.monotonic()
+
+    def _log_loop(self):
+        """Drain live log records into the fan-out under topic "log";
+        per-client level/component filters run in the broadcaster."""
+        sub = self._log_sub
+        while not self._stop_flag.is_set():
+            try:
+                rec = sub.get(timeout=0.5)
+            except queue.Empty:
+                self.heartbeat = time.monotonic()
+                continue
+            frame = ltpu_logging.sse_frame(rec)
+            self.broadcaster.publish("log", frame, meta=rec)
+            self.heartbeat = time.monotonic()
+
+    # ----------------------------------------------------- SSE subscribers
+
+    def subscribe_events(self, sock, topics, label=""):
+        return self.broadcaster.subscribe(sock, kinds=topics, label=label)
+
+    def subscribe_logs(self, sock, floor=0, component=None, label=""):
+        def want(topic, rec):
+            if ltpu_logging.LEVELS.get(rec["level"], 0) < floor:
+                return False
+            if component is not None and rec["component"] != component:
+                return False
+            return True
+
+        return self.broadcaster.subscribe(sock, kinds=("log",),
+                                          predicate=want, label=label)
+
+    # ------------------------------------------------------------ reporting
+
+    def stats(self):
+        root, gen = self.head_key()
+        with self._lock:
+            locks.access(self, "_head_slot", "read")
+            head_slot = self._head_slot
+        slow = M.SSE_DROPPED.with_labels("slow").value
+        err = M.SSE_DROPPED.with_labels("error").value
+        return {
+            "head": {
+                "root": responses.hex_bytes(root) if root else None,
+                "slot": head_slot,
+                "generation": gen,
+            },
+            "cache": {
+                "entries": len(self.cache),
+                "max_entries": self.cache.max_entries,
+                "hits": M.CACHE_HITS.value,
+                "misses": M.CACHE_MISSES.value,
+                "pruned": M.CACHE_PRUNED.value,
+                "integrity_failures": M.INTEGRITY_FAILURES.value,
+            },
+            "coalesce": {
+                "joined": M.COALESCED.value,
+                "inflight": self.flights.inflight(),
+            },
+            "admission": self.admission.stats(),
+            "sse": dict(self.broadcaster.stats(),
+                        dropped={"slow": slow, "error": err},
+                        events=M.SSE_EVENTS.value),
+            "warm": self.warm_enabled,
+        }

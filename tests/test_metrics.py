@@ -245,6 +245,27 @@ def test_metric_name_lint():
         "lighthouse_race_witness_reports_total",
         "lighthouse_race_witness_guarded_fields",
     } <= names, sorted(names)
+    # the serving-tier families (ISSUE 16) must be registered and
+    # linted: admitted/shed request counters, cache hit/miss/prune and
+    # integrity-failure counters, the coalescing counter, SSE fan-out
+    # client/event/drop families, and the request-latency histogram
+    import lighthouse_tpu.serve.metrics  # noqa: F401 — registers
+
+    names = {name for name, _, _, _ in metrics.all_metrics()}
+    assert {
+        "serve_requests_total",
+        "serve_shed_total",
+        "serve_cache_hits_total",
+        "serve_cache_misses_total",
+        "serve_coalesced_total",
+        "serve_cache_entries",
+        "serve_cache_pruned_total",
+        "serve_cache_integrity_failures_total",
+        "serve_sse_clients",
+        "serve_sse_events_total",
+        "serve_sse_dropped_total",
+        "serve_request_seconds",
+    } <= names, sorted(names)
 
 
 def test_verify_service_queue_depth_is_one_labeled_family():

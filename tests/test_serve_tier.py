@@ -1,0 +1,618 @@
+"""Light-client serving tier (ISSUE 16): per-head response caches,
+request coalescing, sharded SSE fan-out, and read-path admission.
+
+Covers the tentpole acceptance seams at unit + integration level:
+
+  * single-flight coalescing — N concurrent identical reads cost ONE
+    compute; a leader failure propagates and clears the flight;
+  * cache byte-identity — frozen bytes keyed on the head ROOT plus the
+    light-client generation; a `serve.cache` corrupt injection is
+    caught by the sha256 check on read and NEVER served (chaos case);
+  * admission — per-client token buckets (quota -> 429 at the surface)
+    and the shed-by-class ladder: proofs shed before head reads, head
+    reads before finality queries, finality never;
+  * sharded SSE fan-out — a wedged never-reading subscriber is
+    disconnected with a counted drop while fast subscribers keep
+    receiving every event (the legacy one-thread-per-SSE-client hazard,
+    satellite b);
+  * reorg safety — `soak.force_reorg` flips the head: stale head-root
+    entries become unreachable (never served) and a tier SSE subscriber
+    sees exactly ONE reorg'd head event (satellite d);
+  * finality pruning — `_prune_finalized`'s keep-set drops frozen
+    bodies for roots that left fork choice;
+  * the HTTP surface — cached responses byte-identical to the
+    tier-less legacy path, `GET /lighthouse/serve` stats shape, and
+    the broadcaster-backed `/eth/v1/events` + `/lighthouse/logs`.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.api.http_api import BeaconApiServer
+from lighthouse_tpu.beacon.chain import BeaconChain
+from lighthouse_tpu.crypto.backend import SignatureVerifier
+from lighthouse_tpu.serve import (
+    KEY_HEADERS_HEAD,
+    AdmissionGate,
+    ResponseCache,
+    ServeQuotaError,
+    ServeShedError,
+    ServeTier,
+    SingleFlight,
+    SseBroadcaster,
+)
+from lighthouse_tpu.serve import metrics as SM
+from lighthouse_tpu.serve import responses as serve_responses
+from lighthouse_tpu.testing import scale, soak
+from lighthouse_tpu.testing.harness import Harness
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+from lighthouse_tpu.utils import failpoints
+
+SPEC = ChainSpec(preset=MinimalPreset)
+ALTAIR = ChainSpec(preset=MinimalPreset, altair_fork_epoch=0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+@pytest.fixture(scope="module")
+def pk_pool():
+    return scale.make_pubkey_pool(16)
+
+
+@pytest.fixture(scope="module")
+def sig_pool():
+    return scale.make_signature_pool(32)
+
+
+def _boot_chain(pk_pool, n=64, epoch=1, seed=0):
+    state = scale.make_scaled_state(
+        n, ALTAIR, epoch=epoch, seed=seed, pubkey_pool=pk_pool,
+        fork="altair",
+    )
+    soak.pin_anchor_checkpoints(state, ALTAIR.preset)
+    return BeaconChain(state, ALTAIR, verifier=SignatureVerifier("fake"))
+
+
+def _advance(chain, sig_pool, n_slots):
+    start = int(chain.head_state.slot)
+    for slot in range(start + 1, start + 1 + n_slots):
+        chain.on_tick(slot)
+        blk = soak.produce_block(chain, slot, sig_pool, si=slot)
+        chain.process_block(blk)
+        chain.recompute_head()
+
+
+def _read_frames(sock, want, deadline=10.0):
+    """Drain SSE frames (split on the blank line) until `want` non-
+    comment frames arrive or the deadline passes."""
+    sock.settimeout(0.25)
+    buf, frames = b"", []
+    t_end = time.monotonic() + deadline
+    while len(frames) < want and time.monotonic() < t_end:
+        try:
+            chunk = sock.recv(65536)
+        except TimeoutError:
+            continue
+        if not chunk:
+            break
+        buf += chunk
+        if buf.startswith(b"HTTP/"):
+            if b"\r\n\r\n" not in buf:
+                continue
+            buf = buf.split(b"\r\n\r\n", 1)[1]   # strip response headers
+        while b"\n\n" in buf:
+            frame, buf = buf.split(b"\n\n", 1)
+            if frame.startswith(b"event:"):
+                frames.append(frame)
+    return frames
+
+
+# ------------------------------------------------------------- coalescing
+
+
+def test_single_flight_coalesces_identical_reads():
+    sf = SingleFlight()
+    computes = []
+    go = threading.Event()
+
+    def compute():
+        computes.append(1)
+        go.wait(5.0)
+        return b"frozen"
+
+    results = []
+
+    def worker():
+        results.append(sf.run("k", compute))
+
+    joined_before = SM.COALESCED.value
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    # let every non-leader reach the join path before resolving
+    deadline = time.monotonic() + 5.0
+    while SM.COALESCED.value - joined_before < 7 \
+            and time.monotonic() < deadline:
+        time.sleep(0.005)
+    go.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert len(computes) == 1, "8 identical in-flight reads, ONE compute"
+    assert len(results) == 8
+    assert all(v == b"frozen" for v, _ in results)
+    assert sum(1 for _, coalesced in results if coalesced) == 7
+    assert SM.COALESCED.value - joined_before == 7
+    assert sf.inflight() == 0, "flight removed after resolution"
+
+
+def test_single_flight_leader_error_propagates_and_clears():
+    sf = SingleFlight()
+
+    def boom():
+        raise RuntimeError("chain read failed")
+
+    with pytest.raises(RuntimeError, match="chain read failed"):
+        sf.run("k", boom)
+    assert sf.inflight() == 0
+    # a fresh computation works after the failure
+    assert sf.run("k", lambda: b"ok")[0] == b"ok"
+
+
+# ------------------------------------------------------------------ cache
+
+
+def test_cache_roundtrip_keying_and_fifo_eviction():
+    cache = ResponseCache(max_entries=2)
+    cache.put(b"r1", 0, ("/route",), b"body-1")
+    assert cache.get(b"r1", 0, ("/route",)) == b"body-1"
+    # the generation is part of the key: a light-client update bump
+    # makes the frozen bytes unreachable without touching the root
+    assert cache.get(b"r1", 1, ("/route",)) is None
+    cache.put(b"r2", 0, ("/route",), b"body-2")
+    cache.put(b"r3", 0, ("/route",), b"body-3")   # evicts the oldest
+    assert len(cache) == 2
+    assert cache.get(b"r1", 0, ("/route",)) is None
+    assert cache.get(b"r3", 0, ("/route",)) == b"body-3"
+
+
+def test_cache_prune_drops_dead_roots():
+    cache = ResponseCache()
+    cache.put(b"live", 0, ("/a",), b"x")
+    cache.put(b"dead", 0, ("/a",), b"y")
+    cache.put(b"dead", 3, ("/b",), b"z")
+    assert cache.prune({b"live"}) == 2
+    assert len(cache) == 1
+    assert cache.get(b"live", 0, ("/a",)) == b"x"
+
+
+def test_cache_corruption_caught_never_served():
+    """Chaos case (satellite c): `serve.cache` corrupt mode lands a
+    flipped byte in the stored blob but not the digest — the read-side
+    sha256 check drops the entry and reads as a miss, so corrupted
+    bytes are NEVER served."""
+    cache = ResponseCache()
+    fails_before = SM.INTEGRITY_FAILURES.value
+    failpoints.configure("serve.cache", "corrupt(1.0)")
+    cache.put(b"r", 0, ("/route",), b"the true bytes")
+    assert cache.get(b"r", 0, ("/route",)) is None
+    assert SM.INTEGRITY_FAILURES.value - fails_before == 1
+    assert len(cache) == 0, "corrupted entry dropped"
+    # disarmed: the recompute path stores and serves clean bytes
+    failpoints.reset()
+    cache.put(b"r", 0, ("/route",), b"the true bytes")
+    assert cache.get(b"r", 0, ("/route",)) == b"the true bytes"
+
+
+def test_tier_recomputes_through_corruption(pk_pool):
+    """End-to-end: with the cache failpoint corrupting every store, the
+    tier serves the computed bytes both times (cache miss -> integrity
+    miss -> recompute), never the poisoned blob."""
+    chain = _boot_chain(pk_pool)
+    tier = ServeTier(chain, warm=False, qps=1000, burst=1000)
+    compute = lambda: serve_responses.json_bytes(   # noqa: E731
+        serve_responses.headers_body(chain))
+    truth = compute()
+    failpoints.configure("serve.cache", "corrupt(1.0)")
+    assert tier.respond("c", "head", KEY_HEADERS_HEAD, compute) == truth
+    assert tier.respond("c", "head", KEY_HEADERS_HEAD, compute) == truth
+
+
+# -------------------------------------------------------------- admission
+
+
+def test_admission_quota_bucket_refill():
+    now = [0.0]
+    gate = AdmissionGate(qps=2.0, burst=2.0, watermark=1000,
+                         clock=lambda: now[0])
+    gate.admit("client-a", "head")
+    gate.admit("client-a", "head")
+    with pytest.raises(ServeQuotaError):
+        gate.admit("client-a", "head")
+    # an unrelated client has its own bucket
+    gate.admit("client-b", "head")
+    # half a second refills one token at 2 qps
+    now[0] += 0.5
+    gate.admit("client-a", "head")
+    with pytest.raises(ServeQuotaError):
+        gate.admit("client-a", "head")
+
+
+def test_admission_shed_ladder_proofs_before_head_before_finality():
+    gate = AdmissionGate(qps=1e9, burst=1e9, watermark=2)
+    shed_before = {k: SM.SHED.with_labels(k).value
+                   for k in ("proof", "head", "finality")}
+    # below the watermark everything is admitted
+    gate.admit("c0", "proof")
+    gate.admit("c1", "head")
+    assert gate.stats()["overload_level"] == 1
+    # level 1: proofs shed, head + finality still served
+    with pytest.raises(ServeShedError):
+        gate.admit("c2", "proof")
+    gate.admit("c3", "head")
+    gate.admit("c4", "finality")
+    # push in-flight to 4x the watermark -> level 2: head sheds too
+    while gate.stats()["inflight"] < 8:
+        gate.admit(f"f{gate.stats()['inflight']}", "finality")
+    assert gate.stats()["overload_level"] == 2
+    with pytest.raises(ServeShedError):
+        gate.admit("c5", "head")
+    # finality queries are NEVER shed
+    gate.admit("c6", "finality")
+    assert SM.SHED.with_labels("proof").value - shed_before["proof"] == 1
+    assert SM.SHED.with_labels("head").value - shed_before["head"] == 1
+    assert SM.SHED.with_labels("finality").value \
+        - shed_before["finality"] == 0
+    # release drains the ladder back to healthy
+    for _ in range(9):
+        gate.release()
+    assert gate.stats()["overload_level"] == 0
+
+
+# ---------------------------------------------------------- SSE fan-out
+
+
+def test_broadcaster_drops_wedged_client_fast_client_unaffected():
+    """Satellite (b): a subscriber that never reads cannot stall the
+    fan-out — its bounded queue overflows, it is disconnected with a
+    counted `slow` drop, and the fast subscriber receives EVERY
+    event."""
+    bcast = SseBroadcaster(n_shards=1, queue_cap=4)
+    slow_before = SM.SSE_DROPPED.with_labels("slow").value
+    wedged_srv, _wedged_peer = socket.socketpair()
+    fast_srv, fast_peer = socket.socketpair()
+    # shrink the wedged socket's kernel buffer so TCP-style backpressure
+    # reaches the broadcaster within a few frames
+    wedged_srv.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4096)
+    try:
+        bcast.subscribe(wedged_srv, label="wedged")
+        bcast.subscribe(fast_srv, label="fast")
+        assert bcast.client_count() == 2
+
+        received = []
+
+        def drain():
+            buf = b""
+            fast_peer.settimeout(0.25)
+            t_end = time.monotonic() + 15.0
+            while len(received) < 40 and time.monotonic() < t_end:
+                try:
+                    chunk = fast_peer.recv(65536)
+                except TimeoutError:
+                    continue
+                buf += chunk
+                while b"\n\n" in buf:
+                    frame, buf = buf.split(b"\n\n", 1)
+                    if frame.startswith(b"event:"):
+                        received.append(frame)
+
+        reader = threading.Thread(target=drain, daemon=True)
+        reader.start()
+        pad = b": " + b"p" * 16384 + b"\n"
+        for i in range(40):
+            frame = b"event: x\ndata: %d\n%s\n" % (i, pad)
+            bcast.publish("x", frame)
+            time.sleep(0.01)
+        reader.join(timeout=20.0)
+
+        assert len(received) == 40, "fast client got every event"
+        assert [int(f.split(b"data: ")[1].split(b"\n")[0]) for f in
+                received] == list(range(40)), "in order, none lost"
+        assert bcast.client_count() == 1, "wedged client disconnected"
+        assert SM.SSE_DROPPED.with_labels("slow").value - slow_before == 1
+    finally:
+        bcast.stop()
+        for s in (wedged_srv, _wedged_peer, fast_srv, fast_peer):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def test_broadcaster_topic_and_predicate_filtering():
+    bcast = SseBroadcaster(n_shards=2, queue_cap=16)
+    srv, peer = socket.socketpair()
+    try:
+        bcast.subscribe(
+            srv, kinds=("head",),
+            predicate=lambda topic, meta: meta["slot"] % 2 == 0,
+        )
+        for slot in range(4):
+            bcast.publish("head", b"event: head\ndata: %d\n\n" % slot,
+                          meta={"slot": slot})
+        bcast.publish("block", b"event: block\ndata: 9\n\n",
+                      meta={"slot": 8})
+        frames = _read_frames(peer, want=2, deadline=5.0)
+        assert [f.split(b"data: ")[1] for f in frames] == [b"0", b"2"]
+    finally:
+        bcast.stop()
+        for s in (srv, peer):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+# ------------------------------------------------------------ reorg safety
+
+
+def test_reorg_flips_cache_key_stale_bytes_unreachable(pk_pool, sig_pool):
+    """Satellite (d): the cache key is the head ROOT — after
+    `force_reorg` flips the head, the pre-reorg frozen bytes are
+    unreachable and the served body names the NEW head."""
+    chain = _boot_chain(pk_pool)
+    tier = ServeTier(chain, warm=False, qps=1e6, burst=1e6)
+    chain.attach_serve_tier(tier)
+    _advance(chain, sig_pool, 3)
+
+    computes = []
+
+    def compute():
+        computes.append(1)
+        return serve_responses.json_bytes(
+            serve_responses.headers_body(chain))
+
+    before = tier.respond("c", "head", KEY_HEADERS_HEAD, compute)
+    assert tier.respond("c", "head", KEY_HEADERS_HEAD, compute) == before
+    assert len(computes) == 1, "second read was a cache hit"
+    assert serve_responses.hex_bytes(chain.head_root).encode() in before
+
+    old, new = soak.force_reorg(chain, sig_pool, si=7)
+    assert new != old and chain.head_root == new
+
+    after = tier.respond("c", "head", KEY_HEADERS_HEAD, compute)
+    assert len(computes) == 2, "reorg re-keyed the cache: recompute"
+    assert after != before
+    assert serve_responses.hex_bytes(new).encode() in after
+    assert serve_responses.hex_bytes(old).encode() not in after
+
+    # the finality keep-set prune drops the orphaned root's entries
+    assert len(tier.cache) == 2
+    assert tier.prune({new}) == 1
+    assert len(tier.cache) == 1
+    # ... and the surviving entry still hits
+    assert tier.respond("c", "head", KEY_HEADERS_HEAD, compute) == after
+    assert len(computes) == 2
+
+
+def test_reorg_sse_subscribers_see_exactly_one_head_event(
+        pk_pool, sig_pool):
+    """Satellite (d): across a forced reorg a tier SSE subscriber sees
+    exactly ONE reorg'd head event, carrying the new head root."""
+    chain = _boot_chain(pk_pool)
+    tier = ServeTier(chain, warm=False, qps=1e6, burst=1e6)
+    chain.attach_serve_tier(tier)
+    _advance(chain, sig_pool, 3)
+    tier.start()
+    srv, peer = socket.socketpair()
+    try:
+        tier.subscribe_events(srv, ["head"], label="test")
+        time.sleep(0.1)   # subscription settled before the flip
+        old, new = soak.force_reorg(chain, sig_pool, si=9)
+        assert new != old
+        frames = _read_frames(peer, want=1, deadline=10.0)
+        assert len(frames) == 1
+        payload = json.loads(frames[0].split(b"data: ")[1])
+        assert payload["block"] == new.hex()
+        assert payload["previous"] == old.hex()
+        # no duplicate head event trails in (keepalives are filtered)
+        assert _read_frames(peer, want=1, deadline=1.5) == []
+    finally:
+        tier.stop()
+        for s in (srv, peer):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def test_light_client_generation_bumps_on_import(pk_pool, sig_pool):
+    """`_serve_light_clients` bumps the tier generation on every import
+    that feeds the LightClientServer, so frozen light-client bodies go
+    stale even when the head root does not move."""
+    chain = _boot_chain(pk_pool)
+    tier = ServeTier(chain, warm=False)
+    chain.attach_serve_tier(tier)
+    _, gen0 = tier.head_key()
+    _advance(chain, sig_pool, 1)
+    _, gen1 = tier.head_key()
+    assert gen1 > gen0
+
+
+# ------------------------------------------------------------ HTTP surface
+
+
+@pytest.fixture(scope="module")
+def served_api():
+    """One chain, one server; legacy bytes captured BEFORE the tier is
+    attached so the byte-identity comparison runs against the same
+    process, same chain, same serialization path."""
+    h = Harness(8, SPEC)
+    chain = BeaconChain(h.state.copy(), SPEC,
+                        verifier=SignatureVerifier("fake"))
+    for _ in range(2):
+        slot = h.state.slot + 1
+        block = h.produce_block(slot)
+        h.process_block(block, strategy="no_verification")
+        chain.on_tick(slot)
+        chain.process_block(block)
+    chain.recompute_head()
+    server = BeaconApiServer(chain).start()
+    base = f"http://127.0.0.1:{server.port}"
+
+    def get_bytes(path):
+        with urllib.request.urlopen(base + path, timeout=5) as r:
+            return r.read()
+
+    legacy = {
+        path: get_bytes(path)
+        for path in (
+            "/eth/v1/beacon/headers",
+            "/eth/v1/beacon/headers?slot=1",
+            "/eth/v1/beacon/states/head/finality_checkpoints",
+            "/eth/v1/beacon/light_client/updates?start_period=0&count=1",
+        )
+    }
+    tier = ServeTier(chain, warm=False, qps=1e6, burst=1e6)
+    chain.attach_serve_tier(tier)
+    tier.start()
+    yield chain, base, tier, legacy, get_bytes
+    tier.stop()
+    server.stop()
+
+
+def test_http_cached_bytes_byte_identical_to_legacy(served_api):
+    chain, base, tier, legacy, get_bytes = served_api
+    hits_before = SM.CACHE_HITS.value
+    for path, want in legacy.items():
+        assert get_bytes(path) == want, f"{path}: tier miss != legacy"
+        assert get_bytes(path) == want, f"{path}: tier HIT != legacy"
+    assert SM.CACHE_HITS.value - hits_before >= len(legacy)
+
+
+def test_http_serve_stats_route(served_api):
+    chain, base, tier, legacy, get_bytes = served_api
+    data = json.loads(get_bytes("/lighthouse/serve"))["data"]
+    assert data["enabled"] is True
+    assert data["head"]["root"] == "0x" + bytes(chain.head_root).hex()
+    assert data["head"]["generation"] >= 0
+    assert data["cache"]["max_entries"] == tier.cache.max_entries
+    assert {"hits", "misses", "pruned", "integrity_failures"} \
+        <= set(data["cache"])
+    assert {"joined", "inflight"} <= set(data["coalesce"])
+    assert {"inflight", "overload_level", "qps", "burst", "watermark"} \
+        <= set(data["admission"])
+    assert len(data["sse"]["shards"]) == len(tier.broadcaster.shards)
+    assert {"slow", "error"} <= set(data["sse"]["dropped"])
+
+
+def test_http_serve_stats_disabled_shell():
+    h = Harness(8, SPEC)
+    chain = BeaconChain(h.state.copy(), SPEC,
+                        verifier=SignatureVerifier("fake"))
+    server = BeaconApiServer(chain).start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/lighthouse/serve"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            assert json.load(r)["data"] == {"enabled": False}
+    finally:
+        server.stop()
+
+
+def test_http_quota_exhaustion_is_429():
+    h = Harness(8, SPEC)
+    chain = BeaconChain(h.state.copy(), SPEC,
+                        verifier=SignatureVerifier("fake"))
+    chain.attach_serve_tier(ServeTier(chain, warm=False, qps=0.0,
+                                      burst=2.0))
+    server = BeaconApiServer(chain).start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/eth/v1/beacon/headers"
+        for _ in range(2):
+            with urllib.request.urlopen(url, timeout=5) as r:
+                assert r.status == 200
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(url, timeout=5)
+        assert e.value.code == 429
+        body = json.loads(e.value.read())
+        assert body["code"] == 429 and "quota" in body["message"]
+    finally:
+        server.stop()
+
+
+def test_http_events_stream_with_wedged_client(served_api):
+    """Satellite (b) at the HTTP surface: `/eth/v1/events` rides the
+    broadcaster — a subscriber that never reads does not stall a fast
+    subscriber's delivery."""
+    chain, base, tier, legacy, get_bytes = served_api
+    host, port = base.removeprefix("http://").split(":")
+    req = (b"GET /eth/v1/events?topics=head HTTP/1.1\r\n"
+           b"Host: x\r\nAccept: text/event-stream\r\n\r\n")
+
+    wedged = socket.create_connection((host, int(port)), timeout=5)
+    fast = socket.create_connection((host, int(port)), timeout=5)
+    try:
+        wedged.sendall(req)
+        fast.sendall(req)
+        # wait for both subscriptions to land in the broadcaster
+        deadline = time.monotonic() + 5.0
+        while tier.broadcaster.client_count() < 2 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert tier.broadcaster.client_count() >= 2
+
+        for slot in (101, 102, 103):
+            chain.events.publish("head", {"slot": slot, "block": "ab",
+                                          "previous": "cd"})
+        frames = _read_frames(fast, want=3, deadline=10.0)
+        assert len(frames) == 3
+        slots = [json.loads(f.split(b"data: ")[1])["slot"] for f in frames]
+        assert slots == [101, 102, 103]
+    finally:
+        for s in (wedged, fast):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def test_http_logs_stream_via_broadcaster(served_api):
+    """`/lighthouse/logs` rides the broadcaster with the per-client
+    level/component filters applied as a pure predicate."""
+    from lighthouse_tpu.utils.logging import get_logger
+
+    chain, base, tier, legacy, get_bytes = served_api
+    host, port = base.removeprefix("http://").split(":")
+    sock = socket.create_connection((host, int(port)), timeout=5)
+    try:
+        sock.sendall(b"GET /lighthouse/logs?level=warning&component="
+                     b"servetest HTTP/1.1\r\nHost: x\r\n\r\n")
+        deadline = time.monotonic() + 5.0
+        while tier.broadcaster.client_count() < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        log = get_logger("servetest")
+        log.info("below the floor")                # filtered: level
+        get_logger("other").warning("wrong component")   # filtered
+        log.warning("the one that passes", slot=7)
+        frames = _read_frames(sock, want=1, deadline=10.0)
+        assert len(frames) == 1
+        rec = json.loads(frames[0].split(b"data: ", 1)[1])
+        assert rec["component"] == "servetest"
+        assert rec["level"] == "warning"
+        assert "passes" in rec["msg"]
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass

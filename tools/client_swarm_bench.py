@@ -1,0 +1,340 @@
+#!/usr/bin/env python
+"""Light-client serving-tier swarm bench: million-client read traffic
+against one ServeTier (the ISSUE 16 read-path deliverable).
+
+Boots a small real chain (scale rig state, fake signature backend —
+state transitions, fork choice, and the serve hooks are fully real),
+attaches a `ServeTier`, and drives it through four phases:
+
+  1. **Coalesce storm** — a barrier releases >= `--inflight` identical
+     queries simultaneously against an empty cache; the leader's
+     compute is counted: the storm MUST resolve from ONE chain read.
+  2. **Swarm** — `--clients` simulated light clients (distinct
+     admission identities round-robined over a worker pool) hammer the
+     cacheable read routes; per-request latency is recorded and the
+     tier's hit/coalesce counters are read back.  Mid-swarm a
+     `soak.force_reorg` flips the head: every response served after
+     the flip must name the NEW head root (stale frozen bytes are
+     unreachable by keying, `reorg_stale_served` MUST be 0).
+  3. **SSE fan-out** — `--subscribers` socketpair subscribers (one of
+     them wedged, never reading) ride a second forced reorg: every
+     healthy subscriber sees the reorg'd head event exactly once
+     (`sse_lost_head_events` MUST be 0) and the wedged one is dropped
+     with a counted `slow` disconnect, not a stalled shard.
+  4. **Chaos** — the `serve.cache` failpoint corrupts every store;
+     served bytes must still be byte-identical to the direct compute
+     (the sha256 integrity check catches the poison on read).
+
+The last stdout line is a single JSON object (the bench.py
+`config_serve` lane parses exactly that).
+
+Usage:
+    python tools/client_swarm_bench.py
+    python tools/client_swarm_bench.py --clients 100000 --requests 40000
+"""
+
+import argparse
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _boot(n_validators, seed=0):
+    from lighthouse_tpu.beacon.chain import BeaconChain
+    from lighthouse_tpu.crypto.backend import SignatureVerifier
+    from lighthouse_tpu.testing import scale, soak
+    from lighthouse_tpu.types import ChainSpec, MinimalPreset
+
+    spec = ChainSpec(preset=MinimalPreset, altair_fork_epoch=0)
+    pk_pool = scale.make_pubkey_pool(16)
+    sig_pool = scale.make_signature_pool(32)
+    state = scale.make_scaled_state(
+        n_validators, spec, epoch=1, seed=seed, pubkey_pool=pk_pool,
+        fork="altair",
+    )
+    soak.pin_anchor_checkpoints(state, spec.preset)
+    chain = BeaconChain(state, spec, verifier=SignatureVerifier("fake"))
+    return chain, sig_pool
+
+
+def _advance(chain, sig_pool, n_slots):
+    from lighthouse_tpu.testing import soak
+
+    start = int(chain.head_state.slot)
+    for slot in range(start + 1, start + 1 + n_slots):
+        chain.on_tick(slot)
+        chain.process_block(soak.produce_block(chain, slot, sig_pool,
+                                               si=slot))
+        chain.recompute_head()
+
+
+def _headers_compute(chain):
+    from lighthouse_tpu.serve import responses
+
+    return lambda: responses.json_bytes(responses.headers_body(chain))
+
+
+def coalesce_storm(tier, chain, inflight):
+    """>= `inflight` identical queries released by a barrier against an
+    empty cache key; returns (chain_reads, joined)."""
+    from lighthouse_tpu.serve.tier import KEY_HEADERS_HEAD
+
+    computes = []
+    gate = threading.Event()
+    base = _headers_compute(chain)
+
+    def compute():
+        computes.append(1)
+        gate.wait(10.0)
+        return base()
+
+    barrier = threading.Barrier(inflight + 1)
+    joined = []
+
+    def worker(i):
+        barrier.wait(30.0)
+        _, coalesced = tier.flights.run(
+            (b"storm-root", 0, KEY_HEADERS_HEAD), compute)
+        if coalesced:
+            joined.append(1)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(inflight)]
+    for t in threads:
+        t.start()
+    barrier.wait(30.0)          # all workers in run() territory together
+    time.sleep(0.2)             # let the stragglers reach the join path
+    gate.set()
+    for t in threads:
+        t.join(timeout=30.0)
+    return len(computes), len(joined)
+
+
+def swarm(tier, chain, sig_pool, n_clients, n_requests, workers):
+    """The read swarm: distinct client identities round-robined over a
+    worker pool, one forced reorg at the halfway mark."""
+    from lighthouse_tpu.serve import responses
+    from lighthouse_tpu.serve.tier import KEY_HEADERS_HEAD
+    from lighthouse_tpu.testing import soak
+
+    compute = _headers_compute(chain)
+    latencies = []
+    lat_lock = threading.Lock()
+    stale_served = [0]
+    reorg_done = threading.Event()
+    new_root_hex = [None]
+    served = [0]
+    next_req = [0]
+    seq_lock = threading.Lock()
+
+    def worker():
+        local_lat = []
+        while True:
+            with seq_lock:
+                i = next_req[0]
+                if i >= n_requests:
+                    break
+                next_req[0] = i + 1
+            client = f"client-{i % n_clients}"
+            t0 = time.perf_counter()
+            body = tier.respond(client, "head", KEY_HEADERS_HEAD, compute)
+            local_lat.append(time.perf_counter() - t0)
+            if reorg_done.is_set() and new_root_hex[0] is not None:
+                if new_root_hex[0].encode() not in body:
+                    stale_served[0] += 1
+        with lat_lock:
+            latencies.extend(local_lat)
+            served[0] += len(local_lat)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(workers)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    # flip the head mid-swarm: requests in flight straddle the reorg
+    while next_req[0] < n_requests // 2:
+        time.sleep(0.001)
+    old, new = soak.force_reorg(chain, sig_pool, si=7)
+    new_root_hex[0] = responses.hex_bytes(new)
+    reorg_done.set()
+    for t in threads:
+        t.join(timeout=120.0)
+    wall = time.monotonic() - t0
+
+    latencies.sort()
+    p99 = latencies[int(len(latencies) * 0.99)] if latencies else 0.0
+    p50 = latencies[len(latencies) // 2] if latencies else 0.0
+    return {
+        "served": served[0],
+        "wall_seconds": round(wall, 3),
+        "served_per_sec": round(served[0] / wall, 1) if wall else 0.0,
+        "p50_ms": round(p50 * 1e3, 3),
+        "p99_ms": round(p99 * 1e3, 3),
+        "reorg_stale_served": stale_served[0],
+        "reorg_flipped": bool(new != old),
+    }
+
+
+def sse_fanout(tier, chain, sig_pool, n_subscribers):
+    """Socketpair subscribers (one wedged) across a forced reorg: count
+    the reorg'd head event at every healthy subscriber."""
+    from lighthouse_tpu.serve import metrics as SM
+    from lighthouse_tpu.testing import soak
+
+    pairs = []
+    for i in range(n_subscribers):
+        srv, peer = socket.socketpair()
+        if i == 0:
+            # the wedged subscriber: tiny kernel buffer, never read
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4096)
+        tier.subscribe_events(srv, ["head"], label=f"sub-{i}")
+        pairs.append((srv, peer))
+    time.sleep(0.2)   # subscriptions settled before the flip
+
+    slow_before = SM.SSE_DROPPED.with_labels("slow").value
+    old, new = soak.force_reorg(chain, sig_pool, si=9)
+
+    def count_head_frames(sock, deadline=15.0):
+        sock.settimeout(0.25)
+        buf, count = b"", 0
+        t_end = time.monotonic() + deadline
+        while count < 1 and time.monotonic() < t_end:
+            try:
+                chunk = sock.recv(65536)
+            except TimeoutError:
+                continue
+            if not chunk:
+                break
+            buf += chunk
+            count += buf.count(b"event: head")
+            buf = buf[buf.rfind(b"\n\n") + 2:] if b"\n\n" in buf else buf
+        return count
+
+    lost = 0
+    duplicated = 0
+    for i, (srv, peer) in enumerate(pairs):
+        if i == 0:
+            continue            # the wedged one is expected to drop
+        got = count_head_frames(peer)
+        if got == 0:
+            lost += 1
+        elif got > 1:
+            duplicated += 1
+    for srv, peer in pairs:
+        try:
+            peer.close()
+        except OSError:
+            pass
+    return {
+        "subscribers": n_subscribers,
+        "sse_lost_head_events": lost,
+        "sse_duplicated_head_events": duplicated,
+        "wedged_dropped": int(
+            SM.SSE_DROPPED.with_labels("slow").value - slow_before),
+        "reorg_flipped": bool(new != old),
+    }
+
+
+def chaos(tier, chain):
+    """serve.cache corrupt(1.0): every stored blob is poisoned, every
+    served body must still equal the direct compute."""
+    from lighthouse_tpu.utils import failpoints
+
+    compute = _headers_compute(chain)
+    truth = compute()
+    route = ("/chaos/headers",)
+    failpoints.configure("serve.cache", "corrupt(1.0)")
+    try:
+        mismatches = 0
+        for _ in range(8):
+            if tier.respond("chaos", "head", route, compute) != truth:
+                mismatches += 1
+    finally:
+        failpoints.configure("serve.cache", "off")
+    from lighthouse_tpu.serve import metrics as SM
+
+    return {
+        "corrupt_served": mismatches,
+        "integrity_catches": SM.INTEGRITY_FAILURES.value,
+    }
+
+
+def run(args):
+    from lighthouse_tpu.serve import ServeTier
+    from lighthouse_tpu.serve import metrics as SM
+
+    chain, sig_pool = _boot(args.validators, seed=args.seed)
+    _advance(chain, sig_pool, 3)
+    tier = ServeTier(chain, warm=False, qps=1e9, burst=1e9,
+                     watermark=1 << 30)
+    chain.attach_serve_tier(tier)
+    tier.start()
+    try:
+        reads, joined = coalesce_storm(tier, chain, args.inflight)
+
+        hits0 = SM.CACHE_HITS.value
+        misses0 = SM.CACHE_MISSES.value
+        joined0 = SM.COALESCED.value
+        sw = swarm(tier, chain, sig_pool, args.clients, args.requests,
+                   args.workers)
+        hits = SM.CACHE_HITS.value - hits0
+        misses = SM.CACHE_MISSES.value - misses0
+        sw["cache_hit_rate"] = round(hits / max(hits + misses, 1), 4)
+        sw["coalesce_ratio"] = round(
+            (SM.COALESCED.value - joined0) / max(sw["served"], 1), 4)
+
+        sse = sse_fanout(tier, chain, sig_pool, args.subscribers)
+        ch = chaos(tier, chain)
+    finally:
+        tier.stop()
+
+    return {
+        "validators": args.validators,
+        "clients": args.clients,
+        "requests": args.requests,
+        "workers": args.workers,
+        "coalesce_inflight": args.inflight,
+        "coalesce_chain_reads": reads,
+        "coalesce_joined": joined,
+        **sw,
+        **sse,
+        **ch,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--validators", type=int, default=64)
+    ap.add_argument("--clients", type=int, default=10000,
+                    help="distinct simulated client identities")
+    ap.add_argument("--requests", type=int, default=20000)
+    ap.add_argument("--workers", type=int, default=16)
+    ap.add_argument("--inflight", type=int, default=128,
+                    help="barrier-released identical in-flight queries")
+    ap.add_argument("--subscribers", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", default=None,
+                    help="also write the result object to this path")
+    args = ap.parse_args(argv)
+
+    out = run(args)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1)
+    print(json.dumps(out), flush=True)
+    # hard gates: ONE chain read under the storm, no stale bytes after
+    # the reorg, no lost head events, no corrupted byte ever served
+    ok = (out["coalesce_chain_reads"] == 1
+          and out["reorg_stale_served"] == 0
+          and out["sse_lost_head_events"] == 0
+          and out["corrupt_served"] == 0)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
