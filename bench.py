@@ -110,6 +110,7 @@ _CC_SUMMARY = None     # compile-cache cold-vs-cached measurement (ISSUE 6)
 _SOAK_SUMMARY = None   # multi-epoch adversarial soak gates (ISSUE 13)
 _OVERLAY_SUMMARY = None   # aggregation overlay tree-vs-flat (ISSUE 15)
 _SERVE_SUMMARY = None     # light-client serving tier swarm (ISSUE 16)
+_WIRE_SCALE_SUMMARY = None   # wire connection-scaling baseline (ISSUE 17)
 
 
 def _load_prior_primary():
@@ -274,6 +275,10 @@ def _emit_primary(value, final=False, backend="tpu-kernel", platform=None):
         # their zero-loss gates ride the guarded artifact so the
         # serving tier's trajectory is tracked across PRs
         rec["serve"] = _SERVE_SUMMARY
+    if _WIRE_SCALE_SUMMARY is not None:
+        # per-connection economics of the thread-per-peer wire fabric:
+        # the baseline the event-loop reactor refactor diffs against
+        rec["wire_scale"] = _WIRE_SCALE_SUMMARY
     try:
         # the per-kernel profile registry's roll-up (top wall-time
         # sinks, per-kernel totals, launch counters) rides along so a
@@ -1110,6 +1115,52 @@ def config_serve(json_path=None):
         ]
 
 
+def config_wire_scale(json_path=None):
+    """Wire connection-scaling lane: tools/wire_scale_bench.py in a
+    CPU-pinned subprocess — one hub WireNode vs raw-socket client
+    sweeps, recording RSS-per-connection, thread count, and p99
+    frame-dispatch latency through the fleet telemetry chokepoint.
+    Merges a `wire_scale` key into BENCH_PRIMARY.json: the BEFORE
+    number the thread-per-peer -> event-loop reactor refactor
+    (ROADMAP) will be diffed against."""
+    global _WIRE_SCALE_SUMMARY
+    import subprocess
+
+    est = 60.0
+    if not _fits(est, "wire_scale"):
+        return
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "wire_scale_bench.py"),
+           "--peers", os.environ.get("BENCH_WIRE_PEERS", "256,1024"),
+           "--pings", os.environ.get("BENCH_WIRE_PINGS", "10")]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                           timeout=max(240.0, 4 * est))
+    except subprocess.TimeoutExpired:
+        note("wire_scale_error", error="timeout")
+        return
+    try:
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+    except Exception:
+        note("wire_scale_error", rc=r.returncode, stderr=r.stderr[-300:])
+        return
+    note("wire_scale", **out)
+    _WIRE_SCALE_SUMMARY = {
+        "model": out["model"],
+        "max_peers": out["max_peers"],
+        "rss_per_conn_bytes": out["rss_per_conn_bytes"],
+        "threads": out["threads"],
+        "dispatch_p99_ms": out["dispatch_p99_ms"],
+        "sweep": [
+            {k: s[k] for k in ("peers", "rss_per_conn_bytes", "threads",
+                               "dispatch_p99_ms", "frames_per_s")}
+            for s in out.get("sweep", [])
+        ],
+    }
+
+
 def config_kernels():
     """mont_mul candidate shoot-out: f32-HIGHEST GEMM vs int32 einsum vs
     the fused Pallas kernel, one jit each on a wide batch — a single
@@ -1463,12 +1514,13 @@ def main():
     stages = (
         (config_device_retry, config_gossip_latency, config_native_shapes,
          config5, config_aggregation, config_soak, config_overlay,
-         config_serve, config_mesh, run_device_smoke_and_curve,
+         config_serve, config_wire_scale, config_mesh,
+         run_device_smoke_and_curve,
          config_kernels, config1, config4, config_compile_cache)
         if _DEVICE_ALIVE else
         (config_gossip_latency, config_native_shapes, config5,
          config_aggregation, config_soak, config_overlay, config_serve,
-         config_mesh, config_device_retry,
+         config_wire_scale, config_mesh, config_device_retry,
          run_device_smoke_and_curve, config_kernels, config1, config4,
          config_compile_cache)
     )

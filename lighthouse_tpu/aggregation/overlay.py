@@ -657,6 +657,17 @@ class AggregationOverlay:
 
     # ------------------------------------------------------------ stats
 
+    def depths(self):
+        """Light counts for process_metrics depth gauges / fleet
+        digests — no topology walk, unlike stats()."""
+        with self._lock:
+            locks.access(self, "partials", "read")
+            return {
+                "partials": sum(len(rs) for rs in self.partials.values()),
+                "pending": self._pending_locked(),
+                "committee_keys": len(self.partials),
+            }
+
     def stats(self):
         with self._lock:
             locks.access(self, "partials", "read")

@@ -31,6 +31,7 @@ JSON shapes follow the beacon-APIs spec):
 import json
 import re
 import threading
+import time
 from http.server import ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -391,13 +392,21 @@ class _Handler(JsonHandler):
             # the exposition always carries current values (the soak's
             # flat-RSS gate and an operator's dashboard read the same
             # numbers)
+            from ..fleet import metrics as fleet_metrics
             from ..utils import process_metrics
 
+            t0 = time.monotonic()
             try:
                 process_metrics.sample(chain)
             except Exception:  # noqa: BLE001 — a scrape must never 500
                 pass
-            return self._text(metrics.gather())
+            text = metrics.gather()
+            # scrape self-observability: stamped AFTER gather(), so the
+            # gauges describe the PREVIOUS scrape (a scrape cannot time
+            # its own render) — documented in the family help
+            fleet_metrics.SCRAPE_SECONDS.set(round(time.monotonic() - t0, 6))
+            fleet_metrics.SCRAPE_BYTES.set(len(text.encode()))
+            return self._text(text)
         if path == "/eth/v1/beacon/genesis":
             st = chain.store.get_state(chain.genesis_root)
             return self._json(
@@ -897,6 +906,47 @@ class _Handler(JsonHandler):
             data = tier.stats()
             data["enabled"] = True
             return self._json({"data": data})
+
+        if path == "/lighthouse/fleet":
+            # fleet health plane: the merged per-peer table — local
+            # connection counters joined with each peer's TELEM_PUSH
+            # digest (honest {"enabled": false} shell when the plane is
+            # off, LTPU_FLEET=0)
+            fleet = getattr(chain, "fleet", None)
+            if fleet is None:
+                return self._json({"data": {"enabled": False}})
+            wire = getattr(self.server, "wire", None)
+            data = fleet.telemetry.fleet_table(wire=wire)
+            data["enabled"] = True
+            return self._json({"data": data})
+        if path == "/lighthouse/slo":
+            # burn-rate SLO engine: per-spec state (ok/warn/breach),
+            # fast+slow window burn rates, bound/budget, sample depth
+            fleet = getattr(chain, "fleet", None)
+            if fleet is None:
+                return self._json({"data": {"enabled": False}})
+            data = fleet.slo.snapshot()
+            data["enabled"] = True
+            return self._json({"data": data})
+        if path == "/lighthouse/incidents":
+            # the bounded incident-bundle ring, newest first
+            fleet = getattr(chain, "fleet", None)
+            if fleet is None:
+                return self._json({"data": {"enabled": False}})
+            return self._json({"data": {
+                "enabled": True,
+                "directory": fleet.incidents.directory,
+                "ring": fleet.incidents.ring,
+                "bundles": fleet.incidents.list(),
+            }})
+        m = re.fullmatch(r"/lighthouse/incidents/([A-Za-z0-9_.-]+)", path)
+        if m:
+            fleet = getattr(chain, "fleet", None)
+            bundle = (fleet.incidents.get(m.group(1))
+                      if fleet is not None else None)
+            if bundle is None:
+                return self._err(404, f"unknown incident {m.group(1)}")
+            return self._json({"data": bundle})
 
         if path == "/lighthouse/compile-cache":
             # compile-lifecycle status: the persistent AOT executable

@@ -173,6 +173,7 @@ class BeaconChain:
         self.slasher = None               # attached via attach_slasher()
         self.builder = None               # attached via attach_builder()
         self.serve_tier = None            # attached via attach_serve_tier()
+        self.fleet = None                 # attached via attach_fleet()
         self.proposer_preparations = {}   # validator index -> fee recipient
         self._advanced_head = None   # (head_root, slot, state) pre-advance
 
@@ -1359,6 +1360,13 @@ class BeaconChain:
             overlay.restore(pending)
         self._pending_overlay_partials = None
         return overlay
+
+    def attach_fleet(self, fleet):
+        """Enroll the fleet health plane (lighthouse_tpu/fleet): wire
+        telemetry, the burn-rate SLO engine, and incident-bundle
+        capture all read chain-owned surfaces through this handle."""
+        self.fleet = fleet
+        return fleet
 
     def persist(self):
         """PersistedBeaconChain + PersistedForkChoice + PersistedOperationPool

@@ -161,6 +161,11 @@ class BeaconNode:
                 restart=self.wire.restart_heartbeat_thread,
                 budget=self.watchdog_budget,
             )
+        if self.chain.fleet is not None:
+            # fleet health plane last: its hooks read the fully-wired
+            # node (breaker trips + watchdog dumps -> incident bundles)
+            self.chain.fleet.install_hooks(self)
+            self.chain.fleet.start()
         self.watchdog.start(self.executor)
         if warming:
             log.info("compile prewarm running; device admission gated")
@@ -248,6 +253,8 @@ class BeaconNode:
 
     def stop(self):
         self.watchdog.stop()
+        if self.chain.fleet is not None:
+            self.chain.fleet.stop()
         self.executor.shutdown("node stop")
         if self.chain.serve_tier is not None:
             self.chain.serve_tier.stop()
@@ -607,6 +614,13 @@ class ClientBuilder:
                 chain.attach_overlay(AggregationOverlay(
                     wire, chain.op_pool.aggregation, dial=dial,
                 ))
+        if os.environ.get("LTPU_FLEET", "1") not in ("", "0"):
+            # fleet health plane (lighthouse_tpu/fleet): wire telemetry
+            # hub + burn-rate SLO engine + incident-bundle ring.  The
+            # plane is observe-only — LTPU_FLEET=0 removes every tap.
+            from ..fleet import FleetPlane
+
+            chain.attach_fleet(FleetPlane(chain=chain, wire=wire))
         discovery = None
         if self._disc_boot is not None and wire is not None:
             import secrets

@@ -1,0 +1,133 @@
+"""Fleet health plane: wire telemetry + SLO engine + incident bundles.
+
+The `FleetPlane` is the one object a node owns; it wires the three
+parts together:
+
+    TelemetryHub      per-connection wire counters fed by network/wire's
+                      frame chokepoint, plus TELEM_PUSH digests from
+                      peers; served at GET /lighthouse/fleet
+    SloEngine         burn-rate evaluation of declarative objectives on
+                      a heartbeat ticker; GET /lighthouse/slo
+    IncidentManager   one joined diagnostic bundle per breach / breaker
+                      trip / watchdog restart; GET /lighthouse/incidents
+
+Wiring is all optional attach points: `wire.telemetry = hub` turns the
+wire chokepoint on, `breaker.on_trip` / `watchdog.on_dump` route
+existing failure signals into incident capture, and the SLO engine's
+`on_breach` is the third trigger.  TELEM_PUSH frames are only SENT
+when LTPU_TELEM=1 (mixed fleets: a legacy peer never sees frame type
+19), on the engine ticker every LTPU_TELEM_INTERVAL seconds.
+"""
+
+import logging
+import os
+import time
+
+from .incident import IncidentManager
+from .slo import SloEngine, default_specs
+from .telemetry import TelemetryHub
+
+__all__ = ["FleetPlane", "TelemetryHub", "SloEngine", "IncidentManager",
+           "default_specs"]
+
+log = logging.getLogger("lighthouse_tpu.fleet")
+
+
+def _telem_enabled():
+    return os.environ.get("LTPU_TELEM", "0") == "1"
+
+
+def _telem_interval():
+    try:
+        return float(os.environ.get("LTPU_TELEM_INTERVAL", "") or 15.0)
+    except ValueError:
+        return 15.0
+
+
+class FleetPlane:
+    """Owner of the hub + SLO engine + incident ring for one node."""
+
+    def __init__(self, chain=None, wire=None, specs=None,
+                 incident_dir=None, clock=time.monotonic):
+        self.chain = chain
+        self.wire = wire
+        self.telemetry = TelemetryHub(clock=clock)
+        self.incidents = IncidentManager(directory=incident_dir,
+                                         clock=clock)
+        self.incidents.telemetry = self.telemetry
+        self.incidents.chain = chain
+        if specs is None:
+            specs = default_specs(chain) if chain is not None else []
+        self.slo = SloEngine(specs, clock=clock)
+        self.incidents.slo = self.slo
+        self.slo.on_breach.append(self._on_breach)
+        self._last_push = None
+        if _telem_enabled() and wire is not None:
+            self.slo.on_tick.append(self._push_telemetry)
+        if wire is not None:
+            wire.telemetry = self.telemetry
+
+    # --------------------------------------------------------- triggers
+
+    def _on_breach(self, name, snapshot):
+        spec = snapshot.get("specs", {}).get(name, {})
+        self.incidents.capture(
+            "slo_breach", detail=name,
+            extra={"slo": name, "burn": spec.get("burn"),
+                   "value": spec.get("value")})
+
+    def install_hooks(self, node):
+        """Route the pre-existing failure signals into incident
+        capture: verify-breaker trips and watchdog restarts."""
+        verifier = getattr(getattr(node, "chain", None), "verifier", None)
+        breaker = getattr(verifier, "breaker", None)
+        if breaker is not None:
+            breaker.on_trip = lambda b: self.incidents.capture(
+                "breaker_trip", detail=b.name)
+        watchdog = getattr(node, "watchdog", None)
+        if watchdog is not None:
+            watchdog.on_dump = lambda name: self.incidents.capture(
+                "watchdog_restart", detail=name)
+        return self
+
+    # ---------------------------------------------------------- pushing
+
+    def _push_telemetry(self):
+        """On the SLO ticker (LTPU_TELEM=1 only): ship this node's
+        digest to every connected peer that will have it.  Refusals
+        (legacy peers, quota) are per-peer non-fatal."""
+        wire = self.wire
+        if wire is None:
+            return
+        now = time.monotonic()
+        interval = _telem_interval()
+        if self._last_push is not None and now - self._last_push < interval:
+            return
+        self._last_push = now
+        digest = self.telemetry.local_digest(chain=self.chain, wire=wire)
+        for peer_id in list(wire.peers):
+            try:
+                wire.push_telemetry(peer_id, digest=digest)
+            except Exception:  # noqa: BLE001 — best-effort fan-out
+                log.debug("telemetry push to %s failed", peer_id,
+                          exc_info=True)
+
+    # -------------------------------------------------------- lifecycle
+
+    def start(self):
+        self.slo.start()
+        return self
+
+    def stop(self):
+        self.slo.stop()
+
+    def snapshot(self):
+        return {
+            "slo": self.slo.snapshot(),
+            "incidents": self.incidents.list(),
+            "telemetry": {
+                "connections": self.telemetry.conn_count(),
+                "digests": self.telemetry.digest_count(),
+                "push_enabled": _telem_enabled(),
+            },
+        }

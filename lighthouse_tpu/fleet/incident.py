@@ -1,0 +1,247 @@
+"""Auto-captured incident bundles: one joined diagnostic per event.
+
+When an SLO transitions into BREACH, a watchdog restarts a stalled
+thread, or the verify circuit breaker trips, the IncidentManager
+captures ONE bundle joining every observability surface the repo has —
+the tracing ring, recent flight-recorder logs, the kernel-profile
+snapshot, lock/race witness state, failpoint arming, per-peer fleet
+telemetry, the SLO snapshot, and process depths — so a soak/chaos
+failure is diagnosable after the fact instead of only while watching.
+
+Bundles are schema-tagged JSON written atomically (tmp + os.replace,
+the kernel_profile.json idiom) into `<compile-cache-dir>/incidents/`
+as a bounded ring of N files; oldest is deleted when the ring is full.
+Symptom storms are deduped: a capture request landing within the
+cooldown of the previous capture is folded into that bundle's
+`coalesced` list instead of minting a new file — the same root event
+must yield one bundle, not one per symptom.
+
+Knobs: LTPU_INCIDENT_DIR, LTPU_INCIDENT_RING (default 8),
+LTPU_INCIDENT_COOLDOWN_S (default 30).
+"""
+
+import json
+import logging
+import os
+import time
+
+from ..crypto.tpu import compile_cache
+from ..utils import locks
+from . import metrics as M
+
+log = logging.getLogger("lighthouse_tpu.fleet.incident")
+
+SCHEMA = "lighthouse-tpu/incident-bundle/v1"
+
+TRACE_LIMIT = 32      # recent traces captured per bundle
+LOG_LIMIT = 64        # recent flight-recorder records per bundle
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return int(default)
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return float(default)
+
+
+def default_directory():
+    env = os.environ.get("LTPU_INCIDENT_DIR")
+    if env:
+        return env
+    return os.path.join(compile_cache._default_cache_dir(), "incidents")
+
+
+class IncidentManager:
+    """Bounded on-disk ring of diagnostic bundles."""
+
+    def __init__(self, directory=None, ring=None, cooldown_s=None,
+                 clock=time.monotonic):
+        self.directory = directory or default_directory()
+        self.ring = int(ring if ring is not None
+                        else _env_int("LTPU_INCIDENT_RING", 8))
+        self.cooldown_s = float(
+            cooldown_s if cooldown_s is not None
+            else _env_float("LTPU_INCIDENT_COOLDOWN_S", 30.0))
+        self._clock = clock
+        self._lock = locks.lock("fleet.incidents")
+        self._seq = 0
+        self._last_capture = None    # (mono ts, incident id)
+        locks.guarded(self, "_seq", self._lock)
+        # joined surfaces, attached by the FleetPlane (all optional)
+        self.telemetry = None
+        self.slo = None
+        self.chain = None
+        os.makedirs(self.directory, exist_ok=True)
+        with self._lock:
+            locks.access(self, "_seq", "write")
+            self._seq = self._scan_seq()
+
+    # -------------------------------------------------------- ring I/O
+
+    def _scan_seq(self):
+        best = 0
+        for name in self._files():
+            try:
+                best = max(best, int(name.split("-")[1]))
+            except (IndexError, ValueError):
+                continue
+        return best
+
+    def _files(self):
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        return sorted(n for n in names
+                      if n.startswith("incident-") and n.endswith(".json"))
+
+    def _path(self, incident_id):
+        return os.path.join(self.directory, incident_id + ".json")
+
+    def _write(self, bundle):
+        path = self._path(bundle["id"])
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(bundle, f, sort_keys=True, default=str)
+        os.replace(tmp, path)
+
+    def _trim(self):
+        files = self._files()
+        while len(files) > self.ring:
+            victim = files.pop(0)
+            try:
+                os.unlink(os.path.join(self.directory, victim))
+            except OSError:
+                pass
+        M.FLEET_INCIDENT_RING.set(len(files))
+        return len(files)
+
+    # -------------------------------------------------------- sections
+
+    def _sections(self):
+        """Every joined surface, each guarded — a broken section must
+        not lose the bundle (it records its own error string instead)."""
+        out = {}
+
+        def grab(name, fn):
+            try:
+                out[name] = fn()
+            except Exception as exc:  # noqa: BLE001 — capture must survive
+                out[name] = {"error": f"{type(exc).__name__}: {exc}"}
+
+        from ..crypto.tpu import profile
+        from ..utils import failpoints, process_metrics, tracing
+        from ..utils import logging as ltpu_logging
+
+        grab("traces", lambda: tracing.recent(TRACE_LIMIT))
+        grab("logs", lambda: ltpu_logging.recent(limit=LOG_LIMIT))
+        grab("log_severity_totals", ltpu_logging.severity_totals)
+        grab("kernel_profile",
+             lambda: profile.get_registry().snapshot())
+        grab("locks", locks.report)
+        grab("races", locks.race_report)
+        grab("failpoints", failpoints.snapshot)
+        grab("process", lambda: {
+            "rss_bytes": process_metrics.read_rss_bytes(),
+            "depths": process_metrics.structure_depths(self.chain),
+        })
+        if self.telemetry is not None:
+            grab("telemetry", lambda: self.telemetry.fleet_table())
+        if self.slo is not None:
+            grab("slo", lambda: self.slo.snapshot())
+        return out
+
+    # ---------------------------------------------------------- capture
+
+    def capture(self, cause, detail="", extra=None):
+        """Capture one bundle (or coalesce into the previous one when
+        inside the cooldown).  Returns the incident id."""
+        now = self._clock()
+        with self._lock:
+            locks.access(self, "_seq", "write")
+            last = self._last_capture
+            if (last is not None and self.cooldown_s > 0
+                    and now - last[0] < self.cooldown_s):
+                coalesce_into = last[1]
+            else:
+                coalesce_into = None
+                self._seq += 1
+                seq = self._seq
+                incident_id = f"incident-{seq:06d}-{cause}"
+                self._last_capture = (now, incident_id)
+        if coalesce_into is not None:
+            self._coalesce(coalesce_into, cause, detail, now)
+            return coalesce_into
+        bundle = {
+            "schema": SCHEMA,
+            "id": incident_id,
+            "seq": seq,
+            "cause": cause,
+            "detail": detail,
+            "captured_at_unix": time.time(),
+            "captured_at_mono": now,
+            "coalesced": [],
+            "extra": extra or {},
+            "sections": self._sections(),
+        }
+        self._write(bundle)
+        depth = self._trim()
+        M.FLEET_INCIDENTS.with_labels(cause).inc()
+        log.error("incident bundle captured: %s (cause=%s detail=%s, "
+                  "ring %d/%d)", incident_id, cause, detail, depth,
+                  self.ring)
+        return incident_id
+
+    def _coalesce(self, incident_id, cause, detail, now):
+        """Fold a within-cooldown symptom into the existing bundle."""
+        bundle = self.get(incident_id)
+        if bundle is None:
+            return
+        bundle.setdefault("coalesced", []).append({
+            "cause": cause,
+            "detail": detail,
+            "at_mono": now,
+            "at_unix": time.time(),
+        })
+        self._write(bundle)
+        M.FLEET_INCIDENTS_COALESCED.inc()
+        log.warning("incident %s: coalesced follow-up (cause=%s "
+                    "detail=%s)", incident_id, cause, detail)
+
+    # ------------------------------------------------------------ reads
+
+    def list(self):
+        """Newest-first summaries for GET /lighthouse/incidents."""
+        out = []
+        for name in reversed(self._files()):
+            bundle = self.get(name[:-len(".json")])
+            if bundle is None:
+                continue
+            out.append({
+                "id": bundle.get("id"),
+                "cause": bundle.get("cause"),
+                "detail": bundle.get("detail"),
+                "captured_at_unix": bundle.get("captured_at_unix"),
+                "coalesced": len(bundle.get("coalesced", [])),
+                "sections": sorted(bundle.get("sections", {})),
+            })
+        return out
+
+    def get(self, incident_id):
+        if "/" in incident_id or incident_id in (".", ".."):
+            return None
+        try:
+            with open(self._path(incident_id), encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def ring_depth(self):
+        return len(self._files())

@@ -1,0 +1,118 @@
+"""Metric families for the fleet health plane.
+
+Three prefixes, mirroring the plane's three parts (ISSUE 17):
+
+    wire_conn_*   per-connection wire telemetry (network/wire.py feeds
+                  these through the TelemetryHub chokepoint)
+    fleet_*       cross-node health: TELEM_PUSH digests, incident
+                  bundles
+    slo_*         the burn-rate SLO engine's states and rates
+
+plus the /metrics scrape's own self-observability gauges — the scrape
+is itself a collector pass and must be accountable like one.  All
+names are literal and linted by the analysis metric-registration rule.
+"""
+
+from ..utils import metrics
+
+# ------------------------------------------------------ wire telemetry
+
+CONN_OPEN = metrics.gauge(
+    "wire_conn_open",
+    "Live wire connections currently tracked by the telemetry hub",
+)
+CONN_RECONNECTS = metrics.counter(
+    "wire_conn_reconnects_total",
+    "Re-established wire connections (same peer id seen again after a "
+    "disconnect)",
+)
+CONN_BYTES = metrics.counter(
+    "wire_conn_bytes_total",
+    "Wire frame bytes moved, by direction (frame type byte + body; "
+    "excludes the uvarint length prefix and noise framing overhead)",
+    labels=("direction",),
+)
+CONN_FRAMES = metrics.counter(
+    "wire_conn_frames_total",
+    "Wire frames moved, by frame type and direction",
+    labels=("type", "direction"),
+)
+CONN_DISPATCH_SECONDS = metrics.histogram(
+    "wire_conn_dispatch_seconds",
+    "Frame-dispatch latency on the reader path (decode + handler, the "
+    "event-loop reactor ROADMAP item's before/after number)",
+)
+CONN_READER_QUEUE_BYTES = metrics.gauge(
+    "wire_conn_reader_queue_bytes",
+    "Bytes waiting in kernel receive buffers across tracked "
+    "connections at the last fleet-table snapshot (reader backlog: "
+    "frames accepted by TCP but not yet dispatched)",
+)
+
+# ----------------------------------------------------- fleet telemetry
+
+FLEET_PEERS = metrics.gauge(
+    "fleet_peers",
+    "Peers with a fleet health digest on record (TELEM_PUSH senders)",
+)
+FLEET_TELEM_FRAMES = metrics.counter(
+    "fleet_telem_frames_total",
+    "TELEM_PUSH digest frames, by direction and result "
+    "(ok / invalid / refused)",
+    labels=("direction", "result"),
+)
+FLEET_INCIDENTS = metrics.counter(
+    "fleet_incidents_total",
+    "Incident bundles captured, by cause (slo_breach / breaker_trip / "
+    "watchdog_restart / manual)",
+    labels=("cause",),
+)
+FLEET_INCIDENTS_COALESCED = metrics.counter(
+    "fleet_incidents_coalesced_total",
+    "Capture requests folded into an existing bundle because they "
+    "landed inside the dedupe cooldown of the previous capture — the "
+    "same root event must yield ONE bundle, not one per symptom",
+)
+FLEET_INCIDENT_RING = metrics.gauge(
+    "fleet_incident_ring",
+    "Incident bundles currently retained in the bounded on-disk ring",
+)
+
+# ----------------------------------------------------------- SLO engine
+
+SLO_STATE = metrics.gauge(
+    "slo_state",
+    "Per-SLO alert state (0 = ok, 1 = warn, 2 = breach) from the "
+    "multi-window burn-rate evaluator",
+    labels=("slo",),
+)
+SLO_BURN_RATE = metrics.gauge(
+    "slo_burn_rate",
+    "Error-budget burn rate per SLO and window (1.0 = burning exactly "
+    "the allowed budget; the fast window pages, the slow window "
+    "confirms)",
+    labels=("slo", "window"),
+)
+SLO_EVALUATIONS = metrics.counter(
+    "slo_evaluations_total",
+    "SLO evaluator ticks completed",
+)
+SLO_BREACHES = metrics.counter(
+    "slo_breaches_total",
+    "Transitions into BREACH, per SLO (each one captures an incident "
+    "bundle)",
+    labels=("slo",),
+)
+
+# ------------------------------------------- scrape self-observability
+
+SCRAPE_SECONDS = metrics.gauge(
+    "lighthouse_metrics_scrape_seconds",
+    "Wall time of the PREVIOUS /metrics scrape (gauge refresh + "
+    "exposition render); one scrape behind by construction, since a "
+    "scrape cannot time its own render",
+)
+SCRAPE_BYTES = metrics.gauge(
+    "lighthouse_metrics_scrape_bytes",
+    "Exposition size in bytes of the previous /metrics scrape",
+)

@@ -1,0 +1,334 @@
+"""Declarative SLOs with multi-window burn-rate alerting.
+
+An `SloSpec` names a probe over an existing surface (verify p99
+queue-wait, head-import stall, serve cache hit rate, breaker state,
+SSE slow disconnects) and an objective: at most `budget` fraction of
+evaluation ticks may violate the bound.  The `SloEngine` samples every
+spec on a ticker and keeps a per-spec window of (timestamp, violated)
+samples, from which it computes the **burn rate** per window:
+
+    burn(window) = violated_time_in_window / (window_s * budget)
+
+where violated_time is `violations * interval_s` — time the ticker has
+not yet covered counts as good, so a freshly started engine does not
+page.  Burn 1.0 means the error budget is being consumed exactly at
+the rate that exhausts it over the SLO period; the classic
+multi-window rule pages only when BOTH a fast window (default 5 m)
+and a slow window (default 1 h) burn hot, which filters blips without
+missing sustained regressions:
+
+    BREACH  if fast >= breach_factor and slow >= 1.0
+    WARN    elif fast >= warn_factor
+    OK      otherwise
+
+State transitions are logged, exported as the `slo_state` /
+`slo_burn_rate` gauges, and a transition INTO breach fires the
+`on_breach` callbacks — the incident-bundle trigger.  Knobs:
+LTPU_SLO_FAST, LTPU_SLO_SLOW, LTPU_SLO_INTERVAL (seconds).
+"""
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+
+from ..utils import locks
+from . import metrics as M
+
+log = logging.getLogger("lighthouse_tpu.fleet.slo")
+
+OK = 0
+WARN = 1
+BREACH = 2
+
+_STATE_NAMES = {OK: "ok", WARN: "warn", BREACH: "breach"}
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return float(default)
+
+
+class SloSpec:
+    """One objective: `probe()` -> value, compared against `bound`.
+
+    kind="upper" violates when value > bound; kind="lower" when
+    value < bound.  A probe returning None (surface not ready, not
+    enough data) contributes no sample for that tick.  `budget` is the
+    tolerated violating fraction of the SLO period (0.05 = 5%).
+    """
+
+    def __init__(self, name, probe, bound, kind="upper", budget=0.05,
+                 warn_factor=1.0, breach_factor=4.0, description=""):
+        if kind not in ("upper", "lower"):
+            raise ValueError(f"bad SLO kind {kind!r}")
+        if not 0.0 < budget <= 1.0:
+            raise ValueError(f"bad SLO budget {budget!r}")
+        self.name = name
+        self.probe = probe
+        self.bound = float(bound)
+        self.kind = kind
+        self.budget = float(budget)
+        self.warn_factor = float(warn_factor)
+        self.breach_factor = float(breach_factor)
+        self.description = description
+
+    def violation(self, value):
+        if self.kind == "upper":
+            return value > self.bound
+        return value < self.bound
+
+
+class _SpecState:
+    __slots__ = ("spec", "samples", "state", "last_value", "burns",
+                 "transitions")
+
+    def __init__(self, spec):
+        self.spec = spec
+        self.samples = deque()       # (mono ts, violated bool)
+        self.state = OK
+        self.last_value = None
+        self.burns = {}              # window name -> burn rate
+        self.transitions = 0
+
+
+class SloEngine:
+    """Ticker evaluating SloSpecs with fast+slow burn-rate windows."""
+
+    def __init__(self, specs, clock=time.monotonic, fast_window_s=None,
+                 slow_window_s=None, interval_s=None):
+        self._clock = clock
+        self.fast_window_s = float(
+            fast_window_s if fast_window_s is not None
+            else _env_float("LTPU_SLO_FAST", 300.0))
+        self.slow_window_s = float(
+            slow_window_s if slow_window_s is not None
+            else _env_float("LTPU_SLO_SLOW", 3600.0))
+        self.interval_s = float(
+            interval_s if interval_s is not None
+            else _env_float("LTPU_SLO_INTERVAL", 15.0))
+        if self.fast_window_s > self.slow_window_s:
+            raise ValueError("fast window must not exceed slow window")
+        self._lock = locks.lock("fleet.slo")
+        self._specs = {}
+        locks.guarded(self, "_specs", self._lock)
+        with self._lock:
+            locks.access(self, "_specs", "write")
+            for spec in specs:
+                if spec.name in self._specs:
+                    raise ValueError(f"duplicate SLO name {spec.name!r}")
+                self._specs[spec.name] = _SpecState(spec)
+        self.on_breach = []          # callbacks: fn(spec_name, snapshot)
+        self.on_tick = []            # callbacks: fn() after each sweep
+        self.heartbeat = self._clock()
+        self._stop = threading.Event()
+        self._thread = None
+        self.ticks = 0
+
+    # ------------------------------------------------------- evaluation
+
+    def _burn(self, st, now, window_s):
+        """Budget burn over the trailing window; uncovered time is
+        good time, so burn can only climb as evidence accumulates."""
+        cutoff = now - window_s
+        violated = sum(1 for t, v in st.samples if v and t >= cutoff)
+        violated_time = violated * self.interval_s
+        return violated_time / (window_s * st.spec.budget)
+
+    def evaluate_once(self):
+        """One sweep: probe every spec, update windows, map states.
+        Callbacks (breach hooks) fire OUTSIDE the engine lock."""
+        now = self._clock()
+        breached = []
+        with self._lock:
+            locks.access(self, "_specs", "read")
+            states = list(self._specs.values())
+        for st in states:
+            spec = st.spec
+            try:
+                value = spec.probe()
+            except Exception:  # noqa: BLE001 — a probe must not kill the tick
+                value = None
+            if value is None:
+                continue
+            violated = bool(spec.violation(float(value)))
+            st.last_value = float(value)
+            st.samples.append((now, violated))
+            cutoff = now - self.slow_window_s
+            while st.samples and st.samples[0][0] < cutoff:
+                st.samples.popleft()
+            fast = self._burn(st, now, self.fast_window_s)
+            slow = self._burn(st, now, self.slow_window_s)
+            st.burns = {"fast": round(fast, 4), "slow": round(slow, 4)}
+            if fast >= spec.breach_factor and slow >= 1.0:
+                new = BREACH
+            elif fast >= spec.warn_factor:
+                new = WARN
+            else:
+                new = OK
+            old, st.state = st.state, new
+            M.SLO_STATE.with_labels(spec.name).set(new)
+            M.SLO_BURN_RATE.with_labels(spec.name, "fast").set(fast)
+            M.SLO_BURN_RATE.with_labels(spec.name, "slow").set(slow)
+            if new != old:
+                st.transitions += 1
+                log.warning(
+                    "slo %s: %s -> %s (value=%s fast=%.2f slow=%.2f)",
+                    spec.name, _STATE_NAMES[old], _STATE_NAMES[new],
+                    st.last_value, fast, slow)
+                if new == BREACH:
+                    M.SLO_BREACHES.with_labels(spec.name).inc()
+                    breached.append(spec.name)
+        self.ticks += 1
+        self.heartbeat = now
+        M.SLO_EVALUATIONS.inc()
+        for name in breached:
+            snap = self.snapshot()
+            for cb in list(self.on_breach):
+                try:
+                    cb(name, snap)
+                except Exception:  # noqa: BLE001
+                    log.exception("slo on_breach callback failed")
+        for cb in list(self.on_tick):
+            try:
+                cb()
+            except Exception:  # noqa: BLE001
+                log.exception("slo on_tick callback failed")
+        return breached
+
+    def snapshot(self):
+        """JSON view for GET /lighthouse/slo and incident bundles."""
+        with self._lock:
+            locks.access(self, "_specs", "read")
+            states = list(self._specs.values())
+        specs = {}
+        worst = OK
+        for st in states:
+            worst = max(worst, st.state)
+            specs[st.spec.name] = {
+                "state": _STATE_NAMES[st.state],
+                "value": st.last_value,
+                "bound": st.spec.bound,
+                "kind": st.spec.kind,
+                "budget": st.spec.budget,
+                "burn": dict(st.burns),
+                "samples": len(st.samples),
+                "transitions": st.transitions,
+                "description": st.spec.description,
+            }
+        return {
+            "state": _STATE_NAMES[worst],
+            "ticks": self.ticks,
+            "interval_s": self.interval_s,
+            "windows": {"fast_s": self.fast_window_s,
+                        "slow_s": self.slow_window_s},
+            "specs": specs,
+        }
+
+    # ----------------------------------------------------------- ticker
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        # supervised by the node watchdog via the heartbeat stamp
+        self._thread = threading.Thread(
+            target=self._run, name="slo-engine", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.evaluate_once()
+            except Exception:  # noqa: BLE001 — ticker must survive
+                log.exception("slo evaluation tick failed")
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._thread = None
+
+
+def default_specs(chain):
+    """The stock objectives over the surfaces this repo already has.
+    Every probe is best-effort: a missing subsystem yields None and the
+    spec simply never samples."""
+
+    def verify_queue_p99():
+        verifier = getattr(chain, "verifier", None)
+        if verifier is None:
+            return None
+        try:
+            return float(verifier.stats()["queue_wait_p99_ms"])
+        except Exception:  # noqa: BLE001
+            return None
+
+    def head_import_stall():
+        try:
+            return float(max(
+                0, int(chain.current_slot) - int(chain.head_state.slot)))
+        except Exception:  # noqa: BLE001
+            return None
+
+    def serve_cache_hit_rate():
+        tier = getattr(chain, "serve_tier", None)
+        if tier is None:
+            return None
+        try:
+            s = tier.stats()["cache"]
+            total = s["hits"] + s["misses"]
+            if total < 16:           # not enough traffic to judge
+                return None
+            return s["hits"] / total
+        except Exception:  # noqa: BLE001
+            return None
+
+    def breaker_open():
+        verifier = getattr(chain, "verifier", None)
+        breaker = getattr(verifier, "breaker", None)
+        if breaker is None:
+            return None
+        return 1.0 if breaker.state != 0 else 0.0
+
+    # SSE slow disconnects: per-tick delta of the serve-tier's counted
+    # `slow` drops (a rising count means subscribers are being shed)
+    prev_slow = [None]
+
+    def sse_slow_disconnects():
+        tier = getattr(chain, "serve_tier", None)
+        if tier is None:
+            return None
+        try:
+            from ..serve import metrics as serve_metrics
+
+            slow = float(serve_metrics.SSE_DROPPED.with_labels("slow").value)
+        except Exception:  # noqa: BLE001
+            return None
+        last, prev_slow[0] = prev_slow[0], slow
+        if last is None:
+            return None
+        return slow - last
+
+    return [
+        SloSpec("verify_queue_wait", verify_queue_p99, bound=250.0,
+                budget=0.05, breach_factor=4.0,
+                description="verify_service p99 queue wait <= 250 ms"),
+        SloSpec("head_import", head_import_stall, bound=2.0,
+                budget=0.05, breach_factor=4.0,
+                description="head within 2 slots of wall clock"),
+        SloSpec("serve_cache_hit", serve_cache_hit_rate, bound=0.5,
+                kind="lower", budget=0.05, breach_factor=4.0,
+                description="light-client cache hit rate >= 0.5"),
+        SloSpec("breaker_open", breaker_open, bound=0.5,
+                budget=0.02, breach_factor=4.0,
+                description="verify breaker closed (state == 0)"),
+        SloSpec("sse_slow_disconnects", sse_slow_disconnects, bound=0.0,
+                budget=0.05, breach_factor=4.0,
+                description="no SSE subscribers shed as slow per tick"),
+    ]

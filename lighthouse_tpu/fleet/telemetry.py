@@ -1,0 +1,296 @@
+"""Per-connection wire telemetry + cross-node health digests.
+
+The TelemetryHub is the ONE chokepoint `network/wire.py` feeds: the
+reader loop reports every dispatched frame (`on_frame_in`), the writer
+every sent frame (`on_frame_out`), and the handshake/teardown paths
+report connect/disconnect — each call is a dict lookup plus a few
+integer bumps under one uncontended lock, cheap enough for the frame
+path.  On top of the per-connection counters the hub stores the health
+digests peers ship over the TELEM_PUSH frame and merges both into the
+per-peer fleet table `GET /lighthouse/fleet` serves.
+
+The hub is OPTIONAL: `WireNode.telemetry` is None unless a FleetPlane
+(or a test) attaches one, and every wire-side call is `is not None`
+guarded — a node without the fleet plane pays one attribute read per
+frame.
+"""
+
+import struct
+import time
+from collections import deque
+
+from ..utils import locks
+from . import metrics as M
+
+# wire frame-type names for the `wire_conn_frames_total{type=...}`
+# label; MUST stay aligned with the network/wire.py constants
+# (tests/test_fleet.py asserts the mapping matches)
+FRAME_NAMES = {
+    1: "hello", 2: "subscribe", 3: "unsubscribe", 4: "publish",
+    5: "request", 6: "response", 7: "goodbye", 8: "ping", 9: "pong",
+    10: "peers", 11: "graft", 12: "prune", 13: "ihave", 14: "iwant",
+    15: "verify_req", 16: "verify_resp", 17: "agg_push", 18: "agg_ack",
+    19: "telem_push", 20: "telem_ack",
+}
+
+DISPATCH_RING = 512          # recent dispatch latencies kept per peer
+DIGEST_TTL_S = 120.0         # a digest older than this reads as stale
+EWMA_ALPHA = 0.3             # verify-throughput smoothing
+
+
+def _frame_name(ftype):
+    return FRAME_NAMES.get(ftype, "other")
+
+
+class ConnStats:
+    """Counters for one peer's connection(s).  Mutated only through the
+    hub (under its lock); snapshots are taken the same way."""
+
+    __slots__ = ("peer_id", "connected_at", "connects", "alive",
+                 "bytes_in", "bytes_out", "frames_in", "frames_out",
+                 "dispatch_s")
+
+    def __init__(self, peer_id, now):
+        self.peer_id = peer_id
+        self.connected_at = now      # monotonic; reset on reconnect
+        self.connects = 1
+        self.alive = True
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self.frames_in = {}          # frame-type name -> count
+        self.frames_out = {}
+        self.dispatch_s = deque(maxlen=DISPATCH_RING)
+
+    def snapshot(self, now):
+        lat = sorted(self.dispatch_s)
+
+        def pct(p):
+            return lat[min(int(p * len(lat)), len(lat) - 1)] if lat else 0.0
+
+        return {
+            "alive": self.alive,
+            "age_s": round(now - self.connected_at, 3),
+            "reconnects": self.connects - 1,
+            "bytes_in": self.bytes_in,
+            "bytes_out": self.bytes_out,
+            "frames_in": dict(self.frames_in),
+            "frames_out": dict(self.frames_out),
+            "dispatch": {
+                "recent": len(lat),
+                "p50_ms": round(pct(0.50) * 1e3, 4),
+                "p99_ms": round(pct(0.99) * 1e3, 4),
+            },
+        }
+
+
+def _recv_pending_bytes(sock):
+    """Bytes sitting in one socket's kernel receive buffer (FIONREAD):
+    the thread-per-peer stand-in for a reader queue depth — frames TCP
+    accepted that the reader has not dispatched yet."""
+    try:
+        import fcntl
+        import termios
+
+        buf = fcntl.ioctl(sock.fileno(), termios.FIONREAD, b"\x00" * 4)
+        return struct.unpack("i", buf)[0]
+    except (OSError, ValueError, ImportError):
+        return 0
+
+
+class TelemetryHub:
+    """Per-peer connection stats + received TELEM_PUSH digests."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._lock = locks.lock("fleet.telemetry")
+        self._conns = {}             # peer_id -> ConnStats
+        self._digests = {}           # peer_id -> (digest dict, mono ts)
+        self._last_local = None      # the digest we last built/shipped
+        self._tp_prev = None         # (mono ts, sets_submitted_total)
+        self._tp_ewma = 0.0
+        locks.guarded(self, "_conns", self._lock)
+        locks.guarded(self, "_digests", self._lock)
+
+    # -------------------------------------------------- wire chokepoint
+
+    def on_connect(self, peer_id):
+        now = self._clock()
+        with self._lock:
+            locks.access(self, "_conns", "write")
+            st = self._conns.get(peer_id)
+            if st is None:
+                self._conns[peer_id] = ConnStats(peer_id, now)
+            else:
+                st.connects += 1
+                st.connected_at = now
+                st.alive = True
+        if st is not None:
+            M.CONN_RECONNECTS.inc()
+        M.CONN_OPEN.inc()
+
+    def on_disconnect(self, peer_id):
+        with self._lock:
+            locks.access(self, "_conns", "write")
+            st = self._conns.get(peer_id)
+            if st is None or not st.alive:
+                return
+            st.alive = False
+        M.CONN_OPEN.dec()
+
+    def on_frame_in(self, peer_id, ftype, nbytes, dispatch_s):
+        name = _frame_name(ftype)
+        with self._lock:
+            locks.access(self, "_conns", "write")
+            st = self._conns.get(peer_id)
+            if st is None:
+                st = self._conns[peer_id] = ConnStats(peer_id, self._clock())
+            st.bytes_in += nbytes
+            st.frames_in[name] = st.frames_in.get(name, 0) + 1
+            st.dispatch_s.append(dispatch_s)
+        M.CONN_BYTES.with_labels("in").inc(nbytes)
+        M.CONN_FRAMES.with_labels(name, "in").inc()
+        M.CONN_DISPATCH_SECONDS.observe(dispatch_s)
+
+    def on_frame_out(self, peer_id, ftype, nbytes):
+        name = _frame_name(ftype)
+        with self._lock:
+            locks.access(self, "_conns", "write")
+            st = self._conns.get(peer_id)
+            if st is None:
+                st = self._conns[peer_id] = ConnStats(peer_id, self._clock())
+            st.bytes_out += nbytes
+            st.frames_out[name] = st.frames_out.get(name, 0) + 1
+        M.CONN_BYTES.with_labels("out").inc(nbytes)
+        M.CONN_FRAMES.with_labels(name, "out").inc()
+
+    # ------------------------------------------------------ digest side
+
+    def record_digest(self, peer_id, digest):
+        with self._lock:
+            locks.access(self, "_digests", "write")
+            self._digests[peer_id] = (dict(digest), self._clock())
+            n = len(self._digests)
+        M.FLEET_PEERS.set(n)
+
+    def digest_count(self):
+        with self._lock:
+            locks.access(self, "_digests", "read")
+            return len(self._digests)
+
+    def conn_count(self):
+        with self._lock:
+            locks.access(self, "_conns", "read")
+            return sum(1 for s in self._conns.values() if s.alive)
+
+    def local_digest(self, chain=None, wire=None):
+        """This node's compact health digest — the TELEM_PUSH payload.
+        Flat {str: float}: breaker state, queue depth, verify p99 and
+        throughput EWMA, RSS, head slot, serve/overlay depths."""
+        from ..utils import process_metrics
+
+        d = {"rss_bytes": float(process_metrics.read_rss_bytes())}
+        verifier = getattr(chain, "verifier", None) if chain else None
+        if verifier is not None and hasattr(verifier, "breaker"):
+            d["breaker_state"] = float(verifier.breaker.state)
+            d["verify_queued_sets"] = float(
+                getattr(verifier, "_queued_sets", 0))
+            try:
+                stats = verifier.stats()
+                d["verify_queue_p99_ms"] = float(stats["queue_wait_p99_ms"])
+            except Exception:  # noqa: BLE001 — a digest is best-effort
+                pass
+            d["verify_throughput_ewma"] = self._throughput_ewma()
+        if chain is not None:
+            try:
+                d["head_slot"] = float(chain.head_state.slot)
+                d["slots_behind"] = float(max(
+                    0, int(chain.current_slot) - int(chain.head_state.slot)))
+            except Exception:  # noqa: BLE001
+                pass
+            tier = getattr(chain, "serve_tier", None)
+            if tier is not None:
+                d["serve_cache_entries"] = float(len(tier.cache))
+                d["sse_clients"] = float(tier.broadcaster.client_count())
+            overlay = getattr(chain, "overlay", None)
+            if overlay is not None and hasattr(overlay, "depths"):
+                od = overlay.depths()
+                d["overlay_pending"] = float(od["pending"])
+        if wire is not None:
+            d["wire_peers"] = float(len(wire.peers))
+        with self._lock:
+            self._last_local = dict(d)
+        return d
+
+    def _throughput_ewma(self):
+        """Verify throughput (sets/s) smoothed over digest builds, off
+        the cumulative sets-submitted counter."""
+        from ..verify_service import metrics as vsm
+
+        now = self._clock()
+        total = vsm.SETS_SUBMITTED.value
+        prev = self._tp_prev
+        self._tp_prev = (now, total)
+        if prev is None or now <= prev[0]:
+            return round(self._tp_ewma, 3)
+        rate = max(0.0, (total - prev[1]) / (now - prev[0]))
+        self._tp_ewma += EWMA_ALPHA * (rate - self._tp_ewma)
+        return round(self._tp_ewma, 3)
+
+    # ------------------------------------------------------ fleet table
+
+    def fleet_table(self, wire=None):
+        """The merged per-peer view `GET /lighthouse/fleet` serves:
+        connection counters joined with the latest digest per peer,
+        plus reader-backlog bytes sampled from the live sockets."""
+        now = self._clock()
+        with self._lock:
+            locks.access(self, "_conns", "read")
+            conns = {pid: st.snapshot(now) for pid, st in self._conns.items()}
+            locks.access(self, "_digests", "read")
+            digests = {pid: (dict(dg), ts)
+                       for pid, (dg, ts) in self._digests.items()}
+            local = dict(self._last_local) if self._last_local else None
+        backlog_total = 0
+        if wire is not None:
+            for pid, peer in list(wire.peers.items()):
+                pending = _recv_pending_bytes(peer.sock)
+                backlog_total += pending
+                if pid in conns:
+                    conns[pid]["reader_queue_bytes"] = pending
+            M.CONN_READER_QUEUE_BYTES.set(backlog_total)
+        peers = {}
+        for pid in sorted(set(conns) | set(digests)):
+            entry = {"conn": conns.get(pid)}
+            dg = digests.get(pid)
+            if dg is not None:
+                age = round(now - dg[1], 3)
+                entry["digest"] = dg[0]
+                entry["digest_age_s"] = age
+                entry["digest_stale"] = age > DIGEST_TTL_S
+            peers[pid] = entry
+        return {
+            "node": wire.peer_id if wire is not None else None,
+            "peers": peers,
+            "connections": sum(1 for c in conns.values() if c["alive"]),
+            "digests": len(digests),
+            "reader_queue_bytes": backlog_total,
+            "local_digest": local,
+        }
+
+    def dispatch_stats(self):
+        """Aggregate dispatch-latency percentiles over every tracked
+        connection (the wire_scale bench's p99 read)."""
+        with self._lock:
+            locks.access(self, "_conns", "read")
+            lat = sorted(
+                s for st in self._conns.values() for s in st.dispatch_s
+            )
+
+        def pct(p):
+            return lat[min(int(p * len(lat)), len(lat) - 1)] if lat else 0.0
+
+        return {
+            "count": len(lat),
+            "p50_ms": round(pct(0.50) * 1e3, 4),
+            "p99_ms": round(pct(0.99) * 1e3, 4),
+        }

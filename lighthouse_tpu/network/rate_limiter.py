@@ -51,6 +51,10 @@ DEFAULT_QUOTAS = {
     # (redundant parents re-push settled partials every flush tick) but
     # bounded, so a hostile child cannot spin an interior node's store
     "agg_push": Quota(4096, 10.0),
+    # fleet health digests: one per peer per ticker interval is the
+    # honest rate (~15 s); 60/10 s tolerates reconnect bursts while a
+    # digest-spamming peer is refused R_RESOURCE_UNAVAILABLE
+    "telem_push": Quota(60, 10.0),
 }
 
 

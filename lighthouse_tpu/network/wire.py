@@ -26,6 +26,7 @@ and the simulator run unchanged over real sockets.
 
 import hashlib
 import logging
+import math
 import socket
 import struct
 import threading
@@ -61,6 +62,8 @@ VERIFY_REQ = 15   # batch-verify request: compressed SignatureSet batch
 VERIFY_RESP = 16  # batch-verify response: per-set verdicts + load hint
 AGG_PUSH = 17     # aggregation overlay: partial aggregate + bitset upstream
 AGG_ACK = 18      # aggregation overlay: push acknowledgement + stored digest
+TELEM_PUSH = 19   # fleet telemetry: compact health digest (flag-gated)
+TELEM_ACK = 20    # fleet telemetry: digest acknowledgement
 
 # mesh degree bounds (gossipsub D / D_lo / D_hi; service/gossipsub defaults)
 MESH_D = 6
@@ -124,6 +127,16 @@ AGG_SIG_LEN = 96                  # compressed G2 partial aggregate
 AGG_DIGEST_LEN = 32               # sha256 store digest in the ACK
 AGG_F_PROBE = 0x01                # audit re-push: answer from the store
 AGG_F_TRACE = 0x02                # trace context appended (id + origin)
+
+# fleet-telemetry codec caps (same trust contract again: a malformed
+# TELEM_PUSH raises typed WireError, answered R_INVALID_REQUEST, and
+# the connection survives).  TELEM_PUSH frames are only ever SENT when
+# LTPU_TELEM=1 — a legacy peer never sees frame type 19, exactly like
+# overlay frames are only sent to enrolled members.
+TELEM_VERSION = 1                 # digest schema version byte
+MAX_TELEM_ENTRIES = 48            # key/value pairs per digest
+MAX_TELEM_KEY = 48                # UTF-8 bytes per metric key
+MAX_TELEM_BODY = 4096             # encoded digest payload bytes
 
 
 class StatusMessage(Container):
@@ -596,6 +609,80 @@ def agg_push_digest(key, bits, sig):
     ).digest()
 
 
+def encode_telem_push(digest):
+    """TELEM_PUSH payload: one node's compact health digest.
+
+      version:u8 || n:u16 || n * (key_len:u8 || key || value:f64le)
+
+    `digest` is a flat {str: number} mapping (breaker state, queue
+    depths, RSS, head slot, verify throughput EWMA, ...).  Keys ride
+    sorted so equal digests encode byte-identically."""
+    items = sorted(digest.items())
+    if not 0 < len(items) <= MAX_TELEM_ENTRIES:
+        raise WireError(
+            f"{len(items)} telemetry entries outside [1, {MAX_TELEM_ENTRIES}]"
+        )
+    parts = [struct.pack("<BH", TELEM_VERSION, len(items))]
+    for key, value in items:
+        kb = str(key).encode()
+        if not 0 < len(kb) <= MAX_TELEM_KEY:
+            raise WireError(f"telemetry key {key!r} outside [1, {MAX_TELEM_KEY}]B")
+        v = float(value)
+        if not math.isfinite(v):
+            raise WireError(f"non-finite telemetry value for {key!r}")
+        parts.append(struct.pack("<B", len(kb)) + kb + struct.pack("<d", v))
+    body = b"".join(parts)
+    if len(body) > MAX_TELEM_BODY:
+        raise WireError(f"TELEM_PUSH payload {len(body)}B exceeds {MAX_TELEM_BODY}")
+    return body
+
+
+def decode_telem_push(payload):
+    """Inverse of encode_telem_push under the verify-codec trust
+    contract: caps checked before any allocation they justify, every
+    malformed shape (unknown version, oversized/duplicate/non-UTF-8
+    keys, non-finite values, truncation, trailing bytes) raises
+    WireError — answered R_INVALID_REQUEST, the connection survives."""
+    end = len(payload)
+    if end > MAX_TELEM_BODY:
+        raise WireError(f"TELEM_PUSH payload {end}B exceeds {MAX_TELEM_BODY}")
+    pos = 0
+
+    def take(k, what):
+        nonlocal pos
+        if pos + k > end:
+            raise WireError(f"truncated TELEM_PUSH ({what})")
+        chunk = payload[pos:pos + k]
+        pos += k
+        return chunk
+
+    version, n = struct.unpack("<BH", take(3, "header"))
+    if version != TELEM_VERSION:
+        raise WireError(f"unknown TELEM_PUSH version {version}")
+    if not 0 < n <= MAX_TELEM_ENTRIES:
+        raise WireError(
+            f"{n} telemetry entries outside [1, {MAX_TELEM_ENTRIES}]"
+        )
+    digest = {}
+    for _ in range(n):
+        klen = take(1, "key length")[0]
+        if not 0 < klen <= MAX_TELEM_KEY:
+            raise WireError(f"telemetry key length {klen} outside [1, {MAX_TELEM_KEY}]")
+        try:
+            key = bytes(take(klen, "key")).decode()
+        except UnicodeDecodeError as e:
+            raise WireError("telemetry key is not UTF-8") from e
+        if key in digest:
+            raise WireError(f"duplicate telemetry key {key!r}")
+        (value,) = struct.unpack("<d", take(8, "value"))
+        if not math.isfinite(value):
+            raise WireError(f"non-finite telemetry value for {key!r}")
+        digest[key] = value
+    if pos != end:
+        raise WireError(f"{end - pos} trailing bytes after TELEM_PUSH payload")
+    return digest
+
+
 class GossipCodec:
     """topic prefix -> SSZ encode/decode of the gossip payloads
     (types/pubsub.rs PubsubMessage::decode)."""
@@ -698,6 +785,7 @@ class _Peer:
 
     def send_frame(self, ftype, body):
         frame = bytes([ftype]) + body
+        size = len(frame)           # plaintext size (pre-encryption)
         try:
             with self._wlock:
                 if self.tx is not None:
@@ -708,6 +796,11 @@ class _Peer:
             # must be DROPPED, not allowed to wedge the sending thread
             self.close()
             raise ConnectionError(str(e)) from e
+        # telemetry tap OUTSIDE the write lock: one attr read when the
+        # fleet plane is off, one counter bump when it's on
+        telem = self.node.telemetry
+        if telem is not None and self.peer_id is not None:
+            telem.on_frame_out(self.peer_id, ftype, size)
 
     def send_raw(self, payload):
         """Plaintext uvarint frame — handshake messages only."""
@@ -744,6 +837,13 @@ class WireNode:
         # legacy peer never sees frame types it would drop the
         # connection over.
         self.overlay = None
+        # fleet health plane (lighthouse_tpu/fleet): a TelemetryHub
+        # attached here turns on the per-frame chokepoint taps and
+        # TELEM_PUSH serving; None -> zero-cost attribute reads and
+        # inbound digests answered R_RESOURCE_UNAVAILABLE.  TELEM_PUSH
+        # is only ever SENT under LTPU_TELEM=1 (same mixed-fleet
+        # contract as overlay frames).
+        self.telemetry = None
         # per-host serve slowdown (seconds) — the chaos harness's
         # per-target analogue of the process-global `remote.serve`
         # delay failpoint (simulator slow-verifier scenario)
@@ -994,6 +1094,9 @@ class WireNode:
         if existing is not None and existing is not peer:
             existing.close()
         self.known_addrs.add(peer.listen_addr)
+        telem = self.telemetry
+        if telem is not None:
+            telem.on_connect(peer_id)
         return True
 
     def _exchange_peers(self, peer):
@@ -1079,11 +1182,21 @@ class WireNode:
                             peer.send_frame(SUBSCRIBE, topic.encode())
                     self._exchange_peers(peer)
                     continue
-                peer.dispatch_started = time.monotonic()
+                t0 = time.monotonic()
+                peer.dispatch_started = t0
                 try:
                     self._dispatch(peer, ftype, body)
                 finally:
                     peer.dispatch_started = None
+                    # THE per-frame telemetry chokepoint: every inbound
+                    # frame (any type, success or typed failure) passes
+                    # here exactly once with its dispatch latency
+                    telem = self.telemetry
+                    if telem is not None:
+                        telem.on_frame_in(
+                            peer.peer_id, ftype, len(frame),
+                            time.monotonic() - t0,
+                        )
         except Exception as e:
             # any malformed frame is peer fault (struct/unicode/snappy/
             # index errors included) — drop the connection, never the node
@@ -1106,6 +1219,9 @@ class WireNode:
                         rec[0].set()
             if evicted:
                 self.limiter.forget(peer.peer_id)
+                telem = self.telemetry
+                if telem is not None:
+                    telem.on_disconnect(peer.peer_id)
 
     # --------------------------------------------------------- dispatch
 
@@ -1161,6 +1277,10 @@ class WireNode:
             self._on_agg_push(peer, body)
         elif ftype == AGG_ACK:
             self._on_agg_ack(peer, body)
+        elif ftype == TELEM_PUSH:
+            self._on_telem_push(peer, body)
+        elif ftype == TELEM_ACK:
+            self._on_telem_ack(peer, body)
         elif ftype == GOODBYE_FRAME:
             peer.close()
         else:
@@ -2069,6 +2189,97 @@ class WireNode:
             if rec[2] != R_SUCCESS or rec[1] is None:
                 raise WireError(f"aggregation push failed: code {rec[2]}")
             return rec[1]
+        finally:
+            with self._lock:
+                locks.access(self, "_pending", "write")
+                self._pending.pop(rid, None)
+
+    # --------------------------------------------- fleet telemetry role
+
+    def _on_telem_push(self, peer, body):
+        """TELEM_PUSH dispatch (reader thread): record the pushing
+        peer's health digest into the attached TelemetryHub.  Serves
+        inline — the store is a dict put.  Same failure contract as
+        AGG_PUSH: every addressable failure answers a typed TELEM_ACK
+        and the connection survives; only an unaddressable flood past
+        the body cap drops it."""
+        from ..fleet import metrics as fleet_metrics
+
+        if len(body) < 4:
+            raise WireError("truncated telemetry push")
+        if len(body) > MAX_TELEM_BODY + 4:
+            raise WireError("telemetry push exceeds size cap")
+        rid = struct.unpack("<I", body[:4])[0]
+        result = "ok"
+        try:
+            if self.telemetry is None:
+                code = R_RESOURCE_UNAVAILABLE   # fleet plane not attached
+                result = "refused"
+            else:
+                self.limiter.check(peer.peer_id, "telem_push", 1)
+                digest = decode_telem_push(body[4:])
+                self.telemetry.record_digest(peer.peer_id, digest)
+                code = R_SUCCESS
+        except RateLimited:
+            code = R_RESOURCE_UNAVAILABLE
+            result = "refused"
+            self._score(peer, -5.0)
+        except WireError:
+            code = R_INVALID_REQUEST
+            result = "invalid"
+            self._score(peer, -5.0)
+        except Exception:
+            code = R_SERVER_ERROR
+            result = "invalid"
+        fleet_metrics.FLEET_TELEM_FRAMES.with_labels("in", result).inc()
+        try:
+            peer.send_frame(TELEM_ACK, struct.pack("<IB", rid, code))
+        except (ConnectionError, OSError):
+            pass   # pusher gone; its timeout handles the rest
+
+    def _on_telem_ack(self, peer, body):
+        """Client side: complete the pending telemetry push."""
+        if len(body) != 5:
+            raise WireError("bad telemetry ack length")
+        rid, code = struct.unpack("<IB", body[:5])
+        with self._lock:
+            rec = self._pending.get(rid)
+        if rec is None or rec[3] is not peer or rec[6] != "telem":
+            return
+        rec[2] = code
+        rec[0].set()
+
+    def push_telemetry(self, peer_id, digest=None, timeout=5.0):
+        """Ship this node's health digest to one peer and wait for the
+        TELEM_ACK.  `digest` defaults to the attached hub's local
+        digest.  Raises PeerRateLimited when the receiver refused
+        (quota / no fleet plane), WireError on every other failure."""
+        from ..fleet import metrics as fleet_metrics
+
+        peer = self.peers.get(peer_id)
+        if peer is None:
+            raise WireError(f"not connected to {peer_id}")
+        if digest is None:
+            if self.telemetry is None:
+                raise WireError("no telemetry hub attached")
+            digest = self.telemetry.local_digest(chain=self.chain, wire=self)
+        payload = encode_telem_push(digest)
+        with self._lock:
+            locks.access(self, "_pending", "write")
+            self._req_id += 1
+            rid = self._req_id
+            rec = [threading.Event(), None, None, peer, {}, None, "telem"]
+            self._pending[rid] = rec
+        try:
+            peer.send_frame(TELEM_PUSH, struct.pack("<I", rid) + payload)
+            if not rec[0].wait(timeout):
+                raise WireError("telemetry push timed out")
+            if rec[2] == R_RESOURCE_UNAVAILABLE:
+                raise PeerRateLimited("telemetry push refused (quota/role)")
+            if rec[2] != R_SUCCESS:
+                raise WireError(f"telemetry push failed: code {rec[2]}")
+            fleet_metrics.FLEET_TELEM_FRAMES.with_labels("out", "ok").inc()
+            return True
         finally:
             with self._lock:
                 locks.access(self, "_pending", "write")

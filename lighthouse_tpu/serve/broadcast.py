@@ -225,6 +225,8 @@ class _Shard:
             return {
                 "clients": len(self._clients),
                 "queued_frames": sum(len(c.frames) for c in self._clients),
+                "choked": sum(1 for c in self._clients
+                              if c.frames or c.pending),
                 "heartbeat_age_s": round(
                     time.monotonic() - self.heartbeat, 3),
             }

@@ -14,6 +14,14 @@ stack accumulates into long-term is sampled here:
     profile_registry   (kernel, shape, topology) keys (crypto/tpu/profile)
     block_times_cache  roots tracked by the chain BlockTimesCache
 
+and the structures that landed after PR 13:
+
+    serve_cache_entries      light-client response cache (serve/tier.py)
+    sse_subscribers          live SSE clients across shards
+    sse_choked               SSE clients with queued backlog right now
+    overlay_pending_partials unsettled (slot, committee) stores
+    incident_ring            on-disk fleet incident bundles retained
+
 `sample(chain)` refreshes the gauges AND returns the values, so the
 soak gate and the `/metrics` scrape read the same numbers — no
 shelling out to `ps`.
@@ -75,6 +83,18 @@ def structure_depths(chain=None):
         depths["op_pool_entries"] = chain.op_pool.aggregation.stats()["entries"]
         depths["pubkey_cache"] = len(chain.pubkey_cache)
         depths["block_times_cache"] = len(chain.block_times_cache)
+        tier = getattr(chain, "serve_tier", None)
+        if tier is not None:
+            depths["serve_cache_entries"] = len(tier.cache)
+            shards = [sh.snapshot() for sh in tier.broadcaster.shards]
+            depths["sse_subscribers"] = sum(s["clients"] for s in shards)
+            depths["sse_choked"] = sum(s.get("choked", 0) for s in shards)
+        overlay = getattr(chain, "overlay", None)
+        if overlay is not None and hasattr(overlay, "depths"):
+            depths["overlay_pending_partials"] = overlay.depths()["pending"]
+        fleet = getattr(chain, "fleet", None)
+        if fleet is not None:
+            depths["incident_ring"] = fleet.incidents.ring_depth()
     return depths
 
 

@@ -78,6 +78,9 @@ class Watchdog:
         self._thread = None
         # name -> the evidence captured at the last stale detection
         self.last_dumps = {}
+        # fleet incident hook: called with the target name after each
+        # stale-dump (the restart is an incident worth a bundle)
+        self.on_dump = None
 
     def register(self, name, heartbeat, restart, budget=5.0,
                  busy=None, busy_budget=None):
@@ -167,6 +170,12 @@ class Watchdog:
             trace_ring=tracing.depth(),
             components=sorted({r["component"] for r in records[:16]}),
         )
+        hook = self.on_dump
+        if hook is not None:
+            try:
+                hook(t.name)
+            except Exception:  # noqa: BLE001 — sweep must survive
+                log.exception("watchdog on_dump hook failed for %s", t.name)
 
     # --------------------------------------------------------- lifecycle
 

@@ -63,6 +63,9 @@ class CircuitBreaker:
         # inflate the device trip counter
         self._state_gauge = state_gauge
         self._device_metrics = state_gauge is None
+        # fleet incident hook: called with this breaker on each CLOSED/
+        # HALF_OPEN -> OPEN transition (never on open-to-open refreshes)
+        self.on_trip = None
         self._write_state_metric(CLOSED)
 
     def _write_state_metric(self, state):
@@ -85,6 +88,13 @@ class CircuitBreaker:
                 consecutive_failures=self.consecutive_failures,
                 cooldown_s=self.cooldown,
             )
+            hook = self.on_trip
+            if hook is not None:
+                try:
+                    hook(self)
+                except Exception:  # noqa: BLE001 — a trip hook must not
+                    log.exception(  # break the dispatcher loop
+                        "%s breaker on_trip hook failed", self.name)
         elif state == HALF_OPEN:
             log.info(
                 "%s circuit breaker half-open: probing with one "

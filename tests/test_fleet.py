@@ -1,0 +1,558 @@
+"""Fleet health plane (ISSUE 17): wire telemetry hub, burn-rate SLO
+engine, incident bundles, TELEM_PUSH over live connections, and the
+/lighthouse/fleet//slo//incidents routes."""
+
+import json
+import struct
+import time
+import urllib.request
+from types import SimpleNamespace
+
+import pytest
+
+from lighthouse_tpu.fleet import FleetPlane
+from lighthouse_tpu.fleet.incident import SCHEMA, IncidentManager
+from lighthouse_tpu.fleet.slo import BREACH, OK, WARN, SloEngine, SloSpec
+from lighthouse_tpu.fleet.telemetry import FRAME_NAMES, TelemetryHub
+from lighthouse_tpu.network.wire import (
+    PeerRateLimited,
+    TELEM_PUSH,
+    WireNode,
+)
+
+
+def _wait(cond, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ----------------------------------------------------- frame-name map
+
+
+def test_frame_names_aligned_with_wire_constants():
+    """FRAME_NAMES must mirror the network/wire.py frame constants —
+    a renumbered or new frame type must update the telemetry label."""
+    from lighthouse_tpu.network import wire
+
+    expected = {
+        wire.HELLO: "hello", wire.SUBSCRIBE: "subscribe",
+        wire.UNSUBSCRIBE: "unsubscribe", wire.PUBLISH: "publish",
+        wire.REQUEST: "request", wire.RESPONSE: "response",
+        wire.GOODBYE_FRAME: "goodbye", wire.PING: "ping",
+        wire.PONG: "pong", wire.PEERS: "peers", wire.GRAFT: "graft",
+        wire.PRUNE: "prune", wire.IHAVE: "ihave", wire.IWANT: "iwant",
+        wire.VERIFY_REQ: "verify_req", wire.VERIFY_RESP: "verify_resp",
+        wire.AGG_PUSH: "agg_push", wire.AGG_ACK: "agg_ack",
+        wire.TELEM_PUSH: "telem_push", wire.TELEM_ACK: "telem_ack",
+    }
+    assert FRAME_NAMES == expected
+
+
+# ------------------------------------------------- burn-rate SLO math
+
+
+def _engine(value_box, clock, **spec_kw):
+    kw = dict(bound=0.0, kind="upper", budget=0.25,
+              warn_factor=1.0, breach_factor=2.0)
+    kw.update(spec_kw)
+    spec = SloSpec("probe", lambda: value_box[0], **kw)
+    return SloEngine([spec], clock=clock, fast_window_s=60.0,
+                     slow_window_s=300.0, interval_s=10.0), spec
+
+
+def _tick(engine, clock):
+    clock.advance(10.0)
+    return engine.evaluate_once()
+
+
+def _state(engine):
+    return engine._specs["probe"].state
+
+
+def test_burn_rate_multiwindow_transitions_with_injected_clock():
+    """Worked example (interval 10 s, fast 60 s, slow 300 s, budget
+    0.25, warn at 1x, breach at 4x-fast AND 1x-slow with
+    breach_factor=2): 5 good ticks, 10 violating ticks, then recovery.
+
+    burn_fast = viol_in_60s * 10 / (60 * 0.25)  = 0.667 per violation
+    burn_slow = viol_in_300s * 10 / (300 * 0.25) = 0.133 per violation
+    """
+    clock = FakeClock()
+    value = [0.0]
+    engine, _ = _engine(value, clock)
+    breach_calls = []
+    engine.on_breach.append(lambda name, snap: breach_calls.append(name))
+
+    for _ in range(5):                       # good: t=10..50
+        assert _tick(engine, clock) == []
+        assert _state(engine) == OK
+
+    value[0] = 1.0                           # violating: t=60..150
+    _tick(engine, clock)                     # 1 viol -> fast 0.667
+    assert _state(engine) == OK
+    _tick(engine, clock)                     # 2 viol -> fast 1.333
+    assert _state(engine) == WARN
+    assert engine._specs["probe"].burns["fast"] == pytest.approx(
+        1.3333, abs=1e-3)
+    _tick(engine, clock)                     # 3 viol -> fast 2.0 but
+    assert _state(engine) == WARN            # slow 0.4: gate holds
+    assert engine._specs["probe"].burns["slow"] == pytest.approx(
+        0.4, abs=1e-3)
+    for _ in range(4):                       # viol 4..7: slow still <1
+        _tick(engine, clock)
+        assert _state(engine) == WARN
+    breached = _tick(engine, clock)          # 8th viol at t=130:
+    assert breached == ["probe"]             # fast 4.667, slow 1.067
+    assert _state(engine) == BREACH
+    assert engine._specs["probe"].burns["slow"] == pytest.approx(
+        1.0667, abs=1e-3)
+    for _ in range(2):                       # viol 9..10: stays hot,
+        assert _tick(engine, clock) == []    # no re-fire inside BREACH
+        assert _state(engine) == BREACH
+
+    value[0] = 0.0                           # recovery: t=160..
+    for _ in range(4):                       # fast drains 6,5,4,3 viol
+        _tick(engine, clock)                 # -> 4.0,3.33,2.67,2.0:
+        assert _state(engine) == BREACH      # still >= 2x fast, 1x slow
+    _tick(engine, clock)                     # 2 viol -> fast 1.333
+    assert _state(engine) == WARN
+    _tick(engine, clock)                     # 1 viol -> fast 0.667
+    assert _state(engine) == OK
+
+    assert breach_calls == ["probe"]         # exactly ONE page
+    snap = engine.snapshot()
+    assert snap["state"] == "ok"
+    assert snap["specs"]["probe"]["transitions"] == 4  # ok>warn>breach>warn>ok
+
+
+def test_slo_probe_none_skips_sample_and_errors_do_not_kill_tick():
+    clock = FakeClock()
+    bad = SloSpec("broken", lambda: 1 / 0, bound=1.0)
+    silent = SloSpec("silent", lambda: None, bound=1.0)
+    live = SloSpec("live", lambda: 0.0, bound=1.0)
+    engine = SloEngine([bad, silent, live], clock=clock,
+                       fast_window_s=60.0, slow_window_s=300.0,
+                       interval_s=10.0)
+    clock.advance(10.0)
+    assert engine.evaluate_once() == []
+    snap = engine.snapshot()
+    assert snap["specs"]["broken"]["samples"] == 0
+    assert snap["specs"]["silent"]["samples"] == 0
+    assert snap["specs"]["live"]["samples"] == 1
+    assert snap["state"] == "ok"
+
+
+def test_slo_uncovered_time_counts_as_good():
+    """A freshly started engine must not page off one bad sample: the
+    window denominator is wall-window, not covered-time."""
+    clock = FakeClock()
+    value = [1.0]
+    engine, _ = _engine(value, clock)
+    _tick(engine, clock)
+    assert _state(engine) == OK
+    assert engine._specs["probe"].burns["fast"] < 1.0
+
+
+def test_slo_rejects_bad_configs():
+    with pytest.raises(ValueError):
+        SloSpec("x", lambda: 0, bound=1.0, kind="sideways")
+    with pytest.raises(ValueError):
+        SloSpec("x", lambda: 0, bound=1.0, budget=0.0)
+    with pytest.raises(ValueError):
+        SloEngine([SloSpec("a", lambda: 0, bound=1.0),
+                   SloSpec("a", lambda: 0, bound=1.0)],
+                  fast_window_s=60.0, slow_window_s=300.0,
+                  interval_s=10.0)
+    with pytest.raises(ValueError):
+        SloEngine([], fast_window_s=600.0, slow_window_s=300.0,
+                  interval_s=10.0)
+
+
+# ------------------------------------------------------ incident ring
+
+
+def test_incident_capture_writes_schema_tagged_bundle(tmp_path):
+    mgr = IncidentManager(directory=str(tmp_path), ring=4, cooldown_s=0.0)
+    iid = mgr.capture("test_cause", detail="unit", extra={"k": 1})
+    bundle = mgr.get(iid)
+    assert bundle["schema"] == SCHEMA
+    assert bundle["cause"] == "test_cause"
+    assert bundle["extra"] == {"k": 1}
+    for section in ("traces", "logs", "log_severity_totals",
+                    "kernel_profile", "locks", "races", "failpoints",
+                    "process"):
+        assert section in bundle["sections"], section
+    # on-disk file round-trips as JSON
+    files = [p for p in tmp_path.iterdir() if p.suffix == ".json"]
+    assert len(files) == 1
+    assert json.loads(files[0].read_text())["id"] == iid
+    # summaries list newest-first with section names
+    listing = mgr.list()
+    assert listing[0]["id"] == iid
+    assert "process" in listing[0]["sections"]
+
+
+def test_incident_ring_trims_oldest(tmp_path):
+    mgr = IncidentManager(directory=str(tmp_path), ring=3, cooldown_s=0.0)
+    ids = [mgr.capture("flood", detail=str(i)) for i in range(5)]
+    assert mgr.ring_depth() == 3
+    kept = {b["id"] for b in (mgr.get(i) for i in ids) if b is not None}
+    assert kept == set(ids[2:])              # oldest two evicted
+    assert mgr.get(ids[0]) is None
+
+
+def test_incident_cooldown_coalesces_symptom_storm(tmp_path):
+    clock = FakeClock()
+    mgr = IncidentManager(directory=str(tmp_path), ring=8,
+                          cooldown_s=30.0, clock=clock)
+    first = mgr.capture("breaker_trip", detail="device")
+    clock.advance(5.0)
+    again = mgr.capture("slo_breach", detail="verify_queue_wait")
+    assert again == first                    # folded, not a new file
+    assert mgr.ring_depth() == 1
+    bundle = mgr.get(first)
+    assert [c["cause"] for c in bundle["coalesced"]] == ["slo_breach"]
+    clock.advance(31.0)                      # cooldown expired
+    second = mgr.capture("slo_breach", detail="head_import")
+    assert second != first
+    assert mgr.ring_depth() == 2
+
+
+def test_incident_get_rejects_path_traversal(tmp_path):
+    mgr = IncidentManager(directory=str(tmp_path), ring=2, cooldown_s=0.0)
+    assert mgr.get("../../etc/passwd") is None
+    assert mgr.get("..") is None
+
+
+def test_incident_seq_resumes_across_restart(tmp_path):
+    mgr = IncidentManager(directory=str(tmp_path), ring=8, cooldown_s=0.0)
+    first = mgr.capture("a")
+    mgr2 = IncidentManager(directory=str(tmp_path), ring=8, cooldown_s=0.0)
+    second = mgr2.capture("b")
+    assert int(first.split("-")[1]) < int(second.split("-")[1])
+
+
+# ------------------------------------------------------ chaos scenario
+
+
+def test_chaos_failpoint_storm_yields_exactly_one_joined_bundle(tmp_path):
+    """Acceptance: a failpoint storm that trips the verify breaker must
+    produce exactly ONE incident bundle, and its logs, failpoint state
+    and fleet telemetry must join in that bundle."""
+    from lighthouse_tpu.utils import failpoints
+    from lighthouse_tpu.utils import logging as ltpu_logging
+    from lighthouse_tpu.verify_service.circuit import CircuitBreaker
+
+    ltpu_logging.recorder()                  # arm the flight recorder
+    plane = FleetPlane(incident_dir=str(tmp_path))
+    plane.telemetry.on_connect("chaos-peer")
+    plane.telemetry.record_digest("chaos-peer", {"rss_bytes": 1.0})
+    breaker = CircuitBreaker(threshold=3, cooldown=60.0, name="device")
+    node = SimpleNamespace(
+        chain=SimpleNamespace(verifier=SimpleNamespace(breaker=breaker)),
+        watchdog=None)
+    plane.install_hooks(node)
+    failpoints.configure("verify.dispatch", "error(1.0)")
+    try:
+        for _ in range(6):                   # storm: re-fails past the
+            breaker.record_failure()         # threshold stay in OPEN
+        plane.incidents.capture("slo_breach", detail="verify_queue_wait")
+    finally:
+        failpoints.configure("verify.dispatch", "off")
+    assert breaker.state == 1                # OPEN
+    assert plane.incidents.ring_depth() == 1
+    [iid] = [b["id"] for b in plane.incidents.list()]
+    bundle = plane.incidents.get(iid)
+    assert bundle["cause"] == "breaker_trip"
+    assert bundle["detail"] == "device"
+    # the in-cooldown SLO symptom coalesced instead of minting a file
+    assert [c["cause"] for c in bundle["coalesced"]] == ["slo_breach"]
+    # joined: the trip warning is in the captured flight-recorder logs
+    logs = bundle["sections"]["logs"]
+    assert any("circuit breaker tripped" in r["msg"] for r in logs)
+    # joined: the armed failpoint shows in the failpoint section
+    fps = bundle["sections"]["failpoints"]
+    assert fps["verify.dispatch"]["mode"] == "error"
+    # joined: the fleet telemetry table carries the connected peer
+    telem = bundle["sections"]["telemetry"]
+    assert "chaos-peer" in telem["peers"]
+    assert telem["peers"]["chaos-peer"]["digest"] == {"rss_bytes": 1.0}
+    # joined: the SLO snapshot rode along
+    assert "specs" in bundle["sections"]["slo"]
+
+
+# --------------------------------------------------- telemetry hub
+
+
+def test_hub_counters_and_fleet_table_merge():
+    clock = FakeClock()
+    hub = TelemetryHub(clock=clock)
+    hub.on_connect("peer-a")
+    hub.on_frame_in("peer-a", 8, 32, 0.001)
+    hub.on_frame_in("peer-a", 8, 32, 0.003)
+    hub.on_frame_out("peer-a", 9, 16)
+    hub.record_digest("peer-a", {"head_slot": 7.0})
+    hub.record_digest("peer-b", {"head_slot": 9.0})   # digest, no conn
+    clock.advance(1.0)
+    table = hub.fleet_table()
+    a = table["peers"]["peer-a"]
+    assert a["conn"]["frames_in"] == {"ping": 2}
+    assert a["conn"]["frames_out"] == {"pong": 1}
+    assert a["conn"]["bytes_in"] == 64
+    assert a["digest"] == {"head_slot": 7.0}
+    assert a["digest_stale"] is False
+    assert table["peers"]["peer-b"]["conn"] is None
+    assert table["connections"] == 1
+    assert table["digests"] == 2
+    # reconnect bumps the counter and resets the epoch
+    hub.on_disconnect("peer-a")
+    hub.on_connect("peer-a")
+    assert hub.fleet_table()["peers"]["peer-a"]["conn"]["reconnects"] == 1
+
+
+def test_hub_digest_staleness_via_ttl():
+    clock = FakeClock()
+    hub = TelemetryHub(clock=clock)
+    hub.record_digest("p", {"x": 1.0})
+    clock.advance(121.0)
+    assert hub.fleet_table()["peers"]["p"]["digest_stale"] is True
+
+
+def test_dispatch_stats_percentiles():
+    hub = TelemetryHub()
+    for ms in range(1, 101):
+        hub.on_frame_in("p", 4, 10, ms / 1000.0)
+    stats = hub.dispatch_stats()
+    assert stats["count"] == 100
+    assert stats["p50_ms"] == pytest.approx(51.0, abs=2.0)
+    assert stats["p99_ms"] == pytest.approx(100.0, abs=2.0)
+
+
+# ------------------------------------------ TELEM_PUSH over the wire
+
+
+def test_telem_push_lands_digest_in_receiver_hub():
+    server = WireNode(None, accept_any_fork=True, peer_id="telem-srv")
+    server.telemetry = TelemetryHub()
+    client = WireNode(None, accept_any_fork=True, peer_id="telem-cli")
+    try:
+        pid = client.dial("127.0.0.1", server.port)
+        digest = {"head_slot": 42.0, "breaker_state": 0.0}
+        assert client.push_telemetry(pid, digest=digest) is True
+        table = server.telemetry.fleet_table()
+        assert table["peers"]["telem-cli"]["digest"] == digest
+        # the receiver's per-conn counters see the telem frames (the
+        # frame-in record lands after dispatch returns, so poll)
+        assert _wait(lambda: server.telemetry.fleet_table()["peers"]
+                     ["telem-cli"]["conn"]["frames_in"]
+                     .get("telem_push") == 1)
+        conn = server.telemetry.fleet_table()["peers"]["telem-cli"]["conn"]
+        assert conn["frames_out"].get("telem_ack") == 1
+    finally:
+        client.stop()
+        server.stop()
+
+
+def test_telem_push_to_unattached_receiver_refused_not_dropped():
+    """A peer without a fleet plane answers R_RESOURCE_UNAVAILABLE —
+    surfaced as PeerRateLimited — and the connection stays usable."""
+    server = WireNode(None, accept_any_fork=True, peer_id="legacy-srv")
+    client = WireNode(None, accept_any_fork=True, peer_id="telem-cli2")
+    try:
+        pid = client.dial("127.0.0.1", server.port)
+        with pytest.raises(PeerRateLimited):
+            client.push_telemetry(pid, digest={"x": 1.0}, timeout=5.0)
+        assert pid in client.peers
+        assert client.request_metadata(pid) is not None
+    finally:
+        client.stop()
+        server.stop()
+
+
+def test_garbage_telem_push_nacked_and_connection_survives():
+    """A malformed digest body gets a typed nack (never a dropped
+    reader); the SAME connection then lands a well-formed push."""
+    server = WireNode(None, accept_any_fork=True, peer_id="telem-srv3")
+    server.telemetry = TelemetryHub()
+    client = WireNode(None, accept_any_fork=True, peer_id="telem-cli3")
+    try:
+        pid = client.dial("127.0.0.1", server.port)
+        peer = client.peers[pid]
+        peer.send_frame(TELEM_PUSH, struct.pack("<I", 777) + b"\xff\xff\xff")
+        assert _wait(lambda: server.telemetry.fleet_table()["peers"]
+                     .get("telem-cli3", {}).get("conn", {})
+                     .get("frames_in", {}).get("telem_push") == 1)
+        assert server.telemetry.digest_count() == 0
+        # connection survives: a valid push now lands
+        assert client.push_telemetry(pid, digest={"ok": 1.0}) is True
+        assert server.telemetry.digest_count() == 1
+    finally:
+        client.stop()
+        server.stop()
+
+
+def test_telem_push_quota_enforced():
+    from lighthouse_tpu.network.rate_limiter import Quota
+
+    server = WireNode(None, accept_any_fork=True, peer_id="telem-srv4",
+                      quotas={"telem_push": Quota(2, 1000.0)})
+    server.telemetry = TelemetryHub()
+    client = WireNode(None, accept_any_fork=True, peer_id="telem-cli4")
+    try:
+        pid = client.dial("127.0.0.1", server.port)
+        assert client.push_telemetry(pid, digest={"a": 1.0}) is True
+        assert client.push_telemetry(pid, digest={"a": 2.0}) is True
+        with pytest.raises(PeerRateLimited):
+            client.push_telemetry(pid, digest={"a": 3.0})
+        assert pid in client.peers           # refused, not dropped
+    finally:
+        client.stop()
+        server.stop()
+
+
+# --------------------------------------------------------- HTTP routes
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return json.load(r)
+
+
+def test_fleet_routes_serve_live_plane(tmp_path):
+    from lighthouse_tpu.api.http_api import BeaconApiServer
+    from lighthouse_tpu.beacon.chain import BeaconChain
+    from lighthouse_tpu.crypto.backend import SignatureVerifier
+    from lighthouse_tpu.testing.harness import Harness
+    from lighthouse_tpu.types import ChainSpec, MinimalPreset
+
+    spec = ChainSpec(preset=MinimalPreset)
+    h = Harness(8, spec)
+    chain = BeaconChain(h.state.copy(), spec,
+                        verifier=SignatureVerifier("fake"))
+    plane = FleetPlane(chain=chain, incident_dir=str(tmp_path))
+    chain.attach_fleet(plane)
+    plane.telemetry.on_connect("route-peer")
+    plane.slo.evaluate_once()
+    iid = plane.incidents.capture("test_route", detail="http")
+    server = BeaconApiServer(chain).start()
+    try:
+        fleet = _get(server.port, "/lighthouse/fleet")["data"]
+        assert fleet["enabled"] is True
+        assert "route-peer" in fleet["peers"]
+        slo = _get(server.port, "/lighthouse/slo")["data"]
+        assert slo["enabled"] is True
+        assert slo["ticks"] == 1
+        assert set(slo["specs"]) == {
+            "verify_queue_wait", "head_import", "serve_cache_hit",
+            "breaker_open", "sse_slow_disconnects"}
+        inc = _get(server.port, "/lighthouse/incidents")["data"]
+        assert inc["enabled"] is True
+        assert [b["id"] for b in inc["bundles"]] == [iid]
+        bundle = _get(server.port, f"/lighthouse/incidents/{iid}")["data"]
+        assert bundle["schema"] == SCHEMA
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(server.port, "/lighthouse/incidents/no-such-bundle")
+        assert err.value.code == 404
+        # /metrics self-observability: the scrape stamps its own cost
+        # gauges, which ride the NEXT scrape's text
+        from lighthouse_tpu.fleet import metrics as fleet_metrics
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics",
+                timeout=10) as r:
+            text = r.read().decode()
+        assert fleet_metrics.SCRAPE_BYTES.value == len(text.encode())
+        assert fleet_metrics.SCRAPE_SECONDS.value > 0.0
+        assert "lighthouse_metrics_scrape_seconds" in text
+        assert "slo_state" in text
+    finally:
+        server.stop()
+
+
+def test_fleet_routes_honest_disabled_shell():
+    from lighthouse_tpu.api.http_api import BeaconApiServer
+    from lighthouse_tpu.beacon.chain import BeaconChain
+    from lighthouse_tpu.crypto.backend import SignatureVerifier
+    from lighthouse_tpu.testing.harness import Harness
+    from lighthouse_tpu.types import ChainSpec, MinimalPreset
+
+    spec = ChainSpec(preset=MinimalPreset)
+    h = Harness(8, spec)
+    chain = BeaconChain(h.state.copy(), spec,
+                        verifier=SignatureVerifier("fake"))
+    chain.fleet = None
+    server = BeaconApiServer(chain).start()
+    try:
+        for path in ("/lighthouse/fleet", "/lighthouse/slo",
+                     "/lighthouse/incidents"):
+            assert _get(server.port, path)["data"] == {"enabled": False}
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(server.port, "/lighthouse/incidents/anything")
+        assert err.value.code == 404
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------- plane + structure
+
+
+def test_fleet_plane_breach_captures_incident(tmp_path):
+    clock = FakeClock()
+    bad = [1.0]
+    specs = [SloSpec("always_bad", lambda: bad[0], bound=0.0,
+                     budget=0.25, warn_factor=1.0, breach_factor=2.0)]
+    plane = FleetPlane(specs=specs, incident_dir=str(tmp_path),
+                       clock=clock)
+    plane.slo.fast_window_s = 60.0
+    plane.slo.slow_window_s = 300.0
+    plane.slo.interval_s = 10.0
+    for _ in range(10):
+        clock.advance(10.0)
+        plane.slo.evaluate_once()
+    assert plane.incidents.ring_depth() == 1
+    [summary] = plane.incidents.list()
+    assert summary["cause"] == "slo_breach"
+    assert summary["detail"] == "always_bad"
+    bundle = plane.incidents.get(summary["id"])
+    assert bundle["extra"]["slo"] == "always_bad"
+    assert bundle["extra"]["burn"]["fast"] >= 2.0
+
+
+def test_structure_depths_cover_fleet_and_serve_surfaces(tmp_path):
+    from lighthouse_tpu.beacon.chain import BeaconChain
+    from lighthouse_tpu.crypto.backend import SignatureVerifier
+    from lighthouse_tpu.testing.harness import Harness
+    from lighthouse_tpu.types import ChainSpec, MinimalPreset
+    from lighthouse_tpu.utils.process_metrics import structure_depths
+
+    spec = ChainSpec(preset=MinimalPreset)
+    h = Harness(8, spec)
+    chain = BeaconChain(h.state.copy(), spec,
+                        verifier=SignatureVerifier("fake"))
+    from lighthouse_tpu.serve import ServeTier
+
+    chain.attach_serve_tier(ServeTier(chain, warm=False))
+    chain.attach_fleet(FleetPlane(chain=chain,
+                                  incident_dir=str(tmp_path)))
+    depths = structure_depths(chain)
+    assert depths["incident_ring"] == 0
+    assert depths["serve_cache_entries"] == 0
+    assert depths["sse_subscribers"] == 0
+    chain.fleet.incidents.capture("depth_probe")
+    assert structure_depths(chain)["incident_ring"] == 1

@@ -266,6 +266,32 @@ def test_metric_name_lint():
         "serve_sse_dropped_total",
         "serve_request_seconds",
     } <= names, sorted(names)
+    # the fleet health-plane families (ISSUE 17) must be registered and
+    # linted: per-connection wire counters, TELEM_PUSH traffic, the SLO
+    # state/burn-rate gauges, the incident-bundle counters, and the
+    # /metrics scrape self-observability gauges
+    import lighthouse_tpu.fleet.metrics  # noqa: F401 — registers
+
+    names = {name for name, _, _, _ in metrics.all_metrics()}
+    assert {
+        "wire_conn_open",
+        "wire_conn_reconnects_total",
+        "wire_conn_bytes_total",
+        "wire_conn_frames_total",
+        "wire_conn_dispatch_seconds",
+        "wire_conn_reader_queue_bytes",
+        "fleet_peers",
+        "fleet_telem_frames_total",
+        "fleet_incidents_total",
+        "fleet_incidents_coalesced_total",
+        "fleet_incident_ring",
+        "slo_state",
+        "slo_burn_rate",
+        "slo_evaluations_total",
+        "slo_breaches_total",
+        "lighthouse_metrics_scrape_seconds",
+        "lighthouse_metrics_scrape_bytes",
+    } <= names, sorted(names)
 
 
 def test_verify_service_queue_depth_is_one_labeled_family():

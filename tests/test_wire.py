@@ -799,6 +799,82 @@ def test_agg_push_digest_commits_to_every_field():
     assert d == agg_push_digest(key, list(bits), bytes(sig))
 
 
+def test_telem_push_codec_roundtrip_and_fuzz_truncations():
+    """Every truncated prefix of a valid TELEM_PUSH digest raises the
+    typed WireError; the full payload round-trips with sorted keys;
+    trailing garbage is as malformed as a truncation."""
+    from lighthouse_tpu.network.wire import (
+        decode_telem_push,
+        encode_telem_push,
+    )
+
+    digest = {"rss_bytes": 123456.0, "breaker_state": 0.0,
+              "head_slot": 42.0, "verify_queue_p99_ms": 1.5}
+    payload = encode_telem_push(digest)
+    assert decode_telem_push(payload) == digest
+    # equal digests encode byte-identically (keys ride sorted)
+    assert encode_telem_push(dict(reversed(list(digest.items())))) == payload
+    for cut in range(len(payload)):
+        with pytest.raises(WireError):
+            decode_telem_push(payload[:cut])
+    with pytest.raises(WireError):
+        decode_telem_push(payload + b"\x00")
+
+
+def test_telem_push_codec_rejects_malformed():
+    import math as _math
+    import struct as _struct
+
+    from lighthouse_tpu.network.wire import (
+        MAX_TELEM_BODY,
+        MAX_TELEM_ENTRIES,
+        MAX_TELEM_KEY,
+        WireError as WE,
+        decode_telem_push,
+        encode_telem_push,
+    )
+
+    good = encode_telem_push({"a": 1.0})
+    # unknown schema version
+    with pytest.raises(WE):
+        decode_telem_push(b"\x02" + good[1:])
+    # zero and over-cap entry counts
+    with pytest.raises(WE):
+        decode_telem_push(good[:1] + _struct.pack("<H", 0))
+    with pytest.raises(WE):
+        decode_telem_push(
+            good[:1] + _struct.pack("<H", MAX_TELEM_ENTRIES + 1) + good[3:])
+    # zero-length key
+    with pytest.raises(WE):
+        decode_telem_push(good[:3] + b"\x00" + good[4:])
+    # non-UTF-8 key bytes
+    bad_key = good[:3] + b"\x01\xff" + good[5:]
+    with pytest.raises(WE):
+        decode_telem_push(bad_key)
+    # duplicate keys
+    entry = good[3:]
+    dup = good[:1] + _struct.pack("<H", 2) + entry + entry
+    with pytest.raises(WE):
+        decode_telem_push(dup)
+    # non-finite value on the wire
+    nan = good[:3] + b"\x01a" + _struct.pack("<d", float("nan"))
+    with pytest.raises(WE):
+        decode_telem_push(nan)
+    # body cap checked before any allocation it justifies
+    with pytest.raises(WE):
+        decode_telem_push(b"\x01" + b"\x00" * (MAX_TELEM_BODY + 4))
+    # encode-side guards: bad digests never hit the wire
+    with pytest.raises(WE):
+        encode_telem_push({})
+    with pytest.raises(WE):
+        encode_telem_push({"k%d" % i: 0.0
+                           for i in range(MAX_TELEM_ENTRIES + 1)})
+    with pytest.raises(WE):
+        encode_telem_push({"x" * (MAX_TELEM_KEY + 1): 0.0})
+    with pytest.raises(WE):
+        encode_telem_push({"inf": _math.inf})
+
+
 def test_garbage_agg_push_answers_typed_error_and_connection_survives():
     """A malformed AGG_PUSH body gets R_INVALID_REQUEST (WireError
     client-side) instead of dropping the reader; the SAME connection

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Fleet health report: the soak/chaos post-mortem tool (ISSUE 17).
+
+Two input modes:
+
+  URL mode     `--url http://127.0.0.1:5052` fetches /lighthouse/fleet,
+               /lighthouse/slo and /lighthouse/incidents from a live
+               node and renders the per-peer table, the SLO states, and
+               the latest incident-bundle summary.
+  bundle mode  `--bundle path/to/incident-000001-....json` renders one
+               saved bundle: cause, coalesced symptoms, and per-section
+               record counts — what happened, from the file alone.
+
+`--json` prints the machine-readable report instead; the exit code is
+1 when any SLO is in BREACH (CI gate for soak runs), 0 otherwise.
+
+Usage:
+    python tools/fleet_report.py --url http://127.0.0.1:5052
+    python tools/fleet_report.py --bundle .compile_cache/incidents/incident-000001-slo_breach.json --json
+"""
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fetch(base, path):
+    try:
+        with urllib.request.urlopen(base.rstrip("/") + path, timeout=10) as r:
+            return json.load(r).get("data")
+    except Exception as e:  # noqa: BLE001 — partial reports still render
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+
+
+def report_from_url(base):
+    fleet = _fetch(base, "/lighthouse/fleet")
+    slo = _fetch(base, "/lighthouse/slo")
+    incidents = _fetch(base, "/lighthouse/incidents")
+    return {"mode": "url", "url": base, "fleet": fleet, "slo": slo,
+            "incidents": incidents}
+
+
+def report_from_bundle(path):
+    with open(path, encoding="utf-8") as f:
+        bundle = json.load(f)
+    sections = {}
+    for name, payload in (bundle.get("sections") or {}).items():
+        if isinstance(payload, list):
+            sections[name] = {"records": len(payload)}
+        elif isinstance(payload, dict):
+            if "error" in payload and len(payload) == 1:
+                sections[name] = {"error": payload["error"]}
+            else:
+                sections[name] = {"keys": len(payload)}
+        else:
+            sections[name] = {"type": type(payload).__name__}
+    return {
+        "mode": "bundle",
+        "path": path,
+        "schema": bundle.get("schema"),
+        "id": bundle.get("id"),
+        "cause": bundle.get("cause"),
+        "detail": bundle.get("detail"),
+        "captured_at_unix": bundle.get("captured_at_unix"),
+        "coalesced": bundle.get("coalesced", []),
+        "sections": sections,
+        "slo": (bundle.get("sections") or {}).get("slo"),
+    }
+
+
+def _breached(report):
+    slo = report.get("slo") or {}
+    if slo.get("state") == "breach":
+        return True
+    for st in (slo.get("specs") or {}).values():
+        if isinstance(st, dict) and st.get("state") == "breach":
+            return True
+    return False
+
+
+def render(report, out=sys.stdout):
+    w = out.write
+    if report["mode"] == "url":
+        fleet = report.get("fleet") or {}
+        slo = report.get("slo") or {}
+        incidents = report.get("incidents") or {}
+        w(f"fleet report — {report['url']}\n")
+        if not fleet.get("enabled", False):
+            w("  fleet plane: disabled (LTPU_FLEET=0 or error)\n")
+        else:
+            w(f"  node {fleet.get('node')} — "
+              f"{fleet.get('connections', 0)} connection(s), "
+              f"{fleet.get('digests', 0)} digest(s)\n")
+            for pid, entry in sorted((fleet.get("peers") or {}).items()):
+                conn = entry.get("conn") or {}
+                line = (f"    {pid:<18} alive={conn.get('alive')} "
+                        f"age={conn.get('age_s', 0):>8}s "
+                        f"in={_fmt_bytes(conn.get('bytes_in', 0)):>9} "
+                        f"out={_fmt_bytes(conn.get('bytes_out', 0)):>9} "
+                        f"p99={conn.get('dispatch', {}).get('p99_ms', 0)}ms")
+                dg = entry.get("digest")
+                if dg:
+                    stale = " STALE" if entry.get("digest_stale") else ""
+                    line += (f" | head={dg.get('head_slot', '?')} "
+                             f"breaker={dg.get('breaker_state', '?')} "
+                             f"rss={_fmt_bytes(dg.get('rss_bytes', 0))}"
+                             f"{stale}")
+                w(line + "\n")
+        w(f"  slo: {slo.get('state', 'unknown')}"
+          f" ({slo.get('ticks', 0)} tick(s))\n")
+        for name, st in sorted((slo.get("specs") or {}).items()):
+            burn = st.get("burn") or {}
+            w(f"    {name:<24} {st.get('state', '?'):<7} "
+              f"value={st.get('value')} bound={st.get('bound')} "
+              f"fast={burn.get('fast')} slow={burn.get('slow')}\n")
+        bundles = incidents.get("bundles") or []
+        w(f"  incidents: {len(bundles)} in ring\n")
+        for b in bundles[:3]:
+            w(f"    {b.get('id')} cause={b.get('cause')} "
+              f"detail={b.get('detail')} "
+              f"coalesced={b.get('coalesced', 0)}\n")
+    else:
+        w(f"incident bundle — {report['path']}\n")
+        w(f"  id {report.get('id')} schema {report.get('schema')}\n")
+        w(f"  cause={report.get('cause')} detail={report.get('detail')}\n")
+        for c in report.get("coalesced", []):
+            w(f"  coalesced: cause={c.get('cause')} "
+              f"detail={c.get('detail')}\n")
+        for name, summary in sorted(report.get("sections", {}).items()):
+            w(f"    {name:<22} {summary}\n")
+    if _breached(report):
+        w("BREACH\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="base URL of a live node's API")
+    src.add_argument("--bundle", help="path to a saved incident bundle")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable report")
+    args = ap.parse_args(argv)
+    report = (report_from_url(args.url) if args.url
+              else report_from_bundle(args.bundle))
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        render(report)
+    return 1 if _breached(report) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Connection-scaling baseline for the wire fabric (ISSUE 17).
+
+The thread-per-peer -> event-loop reactor refactor (ROADMAP) needs a
+BEFORE number: what one WireNode pays per connection today.  This
+bench boots one hub WireNode with the fleet TelemetryHub attached and
+sweeps peer counts with RAW-socket clients (hand-crafted HELLO frames,
+one shared drain thread — a client WireNode would cost two threads per
+connection and measure the client, not the hub):
+
+  idle phase    connect N clients, settle, record RSS-per-connection
+                and process thread count (the hub pays one reader
+                thread per peer — the number the reactor deletes)
+  active phase  every client fires PING bursts; p99 frame-dispatch
+                latency is read from the hub's telemetry chokepoint
+
+The last stdout line is a single JSON object (the bench.py
+`config_wire_scale` lane parses exactly that).
+
+Usage:
+    python tools/wire_scale_bench.py
+    python tools/wire_scale_bench.py --peers 256,1024,4096 --pings 20
+"""
+
+import argparse
+import json
+import os
+import select
+import socket
+import struct
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _uvarint(n):
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out += bytes([b | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _frame(ftype, body):
+    payload = bytes([ftype]) + body
+    return _uvarint(len(payload)) + payload
+
+
+def _hello_body(pid):
+    from lighthouse_tpu.network.wire import StatusMessage
+    from lighthouse_tpu.ssz import encode
+
+    pidb = pid.encode()
+    return (bytes([len(pidb)]) + pidb
+            + bytes(encode(StatusMessage, StatusMessage()))
+            + struct.pack("<H", 0))
+
+
+def _max_safe_peers():
+    """Each connection costs two fds in this process (client socket +
+    hub-accepted socket); leave margin for everything else."""
+    try:
+        import resource
+
+        soft, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
+        return max(16, (soft - 64) // 2)
+    except Exception:  # noqa: BLE001
+        return 256
+
+
+class _Drain(threading.Thread):
+    """One shared reader over every client socket: discards whatever
+    the hub sends back (HELLO replies, PEERS announces, PONGs) so hub
+    writer threads never block on an unread client."""
+
+    def __init__(self):
+        super().__init__(name="client-drain", daemon=True)
+        self.socks = []
+        self._lock = threading.Lock()
+        self.stop_flag = False
+        self.bytes_drained = 0
+
+    def add(self, sock):
+        sock.setblocking(False)
+        with self._lock:
+            self.socks.append(sock)
+
+    def run(self):
+        while not self.stop_flag:
+            with self._lock:
+                socks = list(self.socks)
+            if not socks:
+                time.sleep(0.05)
+                continue
+            # poll in slices: select() fd caps bite past ~1000 sockets
+            for i in range(0, len(socks), 512):
+                try:
+                    ready, _, _ = select.select(socks[i:i + 512], [], [], 0)
+                except (OSError, ValueError):
+                    continue
+                for s in ready:
+                    try:
+                        data = s.recv(65536)
+                        self.bytes_drained += len(data)
+                    except (BlockingIOError, OSError):
+                        continue
+            time.sleep(0.02)
+
+
+def run_sweep(peer_counts, pings, settle_s):
+    from lighthouse_tpu.fleet.telemetry import TelemetryHub
+    from lighthouse_tpu.network.wire import PING, WireNode
+    from lighthouse_tpu.utils import process_metrics
+
+    hub = WireNode(accept_any_fork=True, quotas={}, peer_id="wirescale-hub")
+    hub.telemetry = TelemetryHub()
+    drain = _Drain()
+    drain.start()
+    clients = []
+    results = []
+    base_rss = process_metrics.read_rss_bytes()
+    base_threads = threading.active_count()
+    try:
+        for target in peer_counts:
+            t_conn0 = time.monotonic()
+            while len(clients) < target:
+                i = len(clients)
+                s = socket.create_connection(("127.0.0.1", hub.port),
+                                             timeout=10.0)
+                s.sendall(_frame(1, _hello_body(f"client-{i:05d}")))
+                clients.append(s)
+                drain.add(s)
+            # settle: wait until the hub registered every client (the
+            # accept/reader threads lag the connect loop)
+            deadline = time.monotonic() + max(30.0, settle_s * 10)
+            while len(hub.peers) < target and time.monotonic() < deadline:
+                time.sleep(0.1)
+            time.sleep(settle_s)
+            connect_s = time.monotonic() - t_conn0
+            rss = process_metrics.read_rss_bytes()
+            threads = threading.active_count()
+            idle = {
+                "peers": target,
+                "registered": len(hub.peers),
+                "connect_s": round(connect_s, 3),
+                "rss_bytes": rss,
+                "rss_per_conn_bytes": int((rss - base_rss) / target),
+                "threads": threads,
+                "threads_per_conn": round(
+                    (threads - base_threads) / target, 3),
+            }
+            # active phase: PING bursts through the dispatch chokepoint
+            base_count = hub.telemetry.dispatch_stats()["count"]
+            t0 = time.monotonic()
+            sent = 0
+            for burst in range(pings):
+                for j, s in enumerate(clients):
+                    try:
+                        s.sendall(_frame(PING, struct.pack(
+                            "<Q", burst * len(clients) + j)))
+                        sent += 1
+                    except OSError:
+                        continue
+                time.sleep(0.01)   # spread bursts; drain keeps up
+            # wait for the hub to chew through the backlog
+            deadline = time.monotonic() + 60.0
+            stats = hub.telemetry.dispatch_stats()
+            while stats["count"] - base_count < sent * 0.99 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.2)
+                stats = hub.telemetry.dispatch_stats()
+            active_s = time.monotonic() - t0
+            idle.update({
+                "pings_sent": sent,
+                "dispatched": stats["count"] - base_count,
+                "dispatch_p50_ms": stats["p50_ms"],
+                "dispatch_p99_ms": stats["p99_ms"],
+                "active_s": round(active_s, 3),
+                "frames_per_s": int(stats["count"] / active_s)
+                if active_s > 0 else 0,
+            })
+            results.append(idle)
+            print(f"peers={target} rss/conn="
+                  f"{idle['rss_per_conn_bytes']}B threads={threads} "
+                  f"p99={stats['p99_ms']}ms", flush=True)
+    finally:
+        drain.stop_flag = True
+        for s in clients:
+            try:
+                s.close()
+            except OSError:
+                pass
+        hub.stop()
+    return results
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--peers", default="256,1024",
+                    help="comma-separated peer counts to sweep")
+    ap.add_argument("--pings", type=int, default=10,
+                    help="PING bursts per client in the active phase")
+    ap.add_argument("--settle", type=float, default=1.0,
+                    help="idle settle seconds before sampling RSS")
+    args = ap.parse_args(argv)
+    counts = sorted({int(x) for x in args.peers.split(",") if x.strip()})
+    cap = _max_safe_peers()
+    clamped = [min(c, cap) for c in counts]
+    if clamped != counts:
+        print(f"clamped sweep {counts} -> {clamped} "
+              f"(RLIMIT_NOFILE headroom)", flush=True)
+    t0 = time.monotonic()
+    sweep = run_sweep(sorted(set(clamped)), args.pings, args.settle)
+    out = {
+        "sweep": sweep,
+        "max_peers": sweep[-1]["peers"] if sweep else 0,
+        "rss_per_conn_bytes": sweep[-1]["rss_per_conn_bytes"]
+        if sweep else 0,
+        "threads": sweep[-1]["threads"] if sweep else 0,
+        "dispatch_p99_ms": sweep[-1]["dispatch_p99_ms"] if sweep else 0.0,
+        "wall_s": round(time.monotonic() - t0, 3),
+        "model": "thread-per-peer",   # the reactor refactor flips this
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
