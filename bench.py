@@ -1161,6 +1161,62 @@ def config_wire_scale(json_path=None):
     }
 
 
+def config_epoch_profile(json_path=None):
+    """Epoch-stage profile lane: tools/epoch_profile_bench.py in a
+    CPU-pinned subprocess — one epoch replayed plain then with
+    LTPU_STATE_PROFILE=1 into a fresh registry, reporting the per-stage
+    wall table, the stage-sum totality ratio, the armed-vs-plain
+    overhead, and the state-diff digest summary.  Merges an
+    `epoch_profile` key into BENCH_SCALE.json: the recorded BEFORE
+    baseline the ROADMAP epoch-on-device work will be diffed against
+    (the wire_scale BEFORE-row pattern)."""
+    import subprocess
+
+    n = int(os.environ.get("BENCH_EPOCH_PROFILE_VALIDATORS", "65536"))
+    est = 30.0 + n / 20_000.0
+    if not _fits(est, "epoch_profile"):
+        return
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "epoch_profile_bench.py"),
+           "--validators", str(n)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                           timeout=max(240.0, 4 * est))
+    except subprocess.TimeoutExpired:
+        note("epoch_profile_error", error="timeout", validators=n)
+        return
+    try:
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+    except Exception:
+        note("epoch_profile_error", rc=r.returncode, validators=n,
+             stderr=r.stderr[-300:])
+        return
+    note("epoch_profile", validators=out["n_validators"],
+         replay_wall_ms_plain=out["replay_wall_ms_plain"],
+         replay_wall_ms_profiled=out["replay_wall_ms_profiled"],
+         profiler_overhead_pct=out["profiler_overhead_pct"],
+         stage_sum_over_wall=out["stage_sum_over_wall"],
+         digest_records=out["digests"]["records"])
+    # merge the BEFORE row into BENCH_SCALE.json beside the scale lane's
+    # replay economics (recorded even when --scale didn't run this time:
+    # the key rides whatever artifact is committed)
+    scale_path = json_path or "BENCH_SCALE.json"
+    try:
+        with open(scale_path) as f:
+            scale_doc = json.load(f)
+    except (OSError, ValueError):
+        scale_doc = {}
+    scale_doc["epoch_profile"] = out
+    try:
+        with open(scale_path, "w") as f:
+            json.dump(scale_doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+    except OSError:
+        pass
+
+
 def config_kernels():
     """mont_mul candidate shoot-out: f32-HIGHEST GEMM vs int32 einsum vs
     the fused Pallas kernel, one jit each on a wide batch — a single
@@ -1514,15 +1570,15 @@ def main():
     stages = (
         (config_device_retry, config_gossip_latency, config_native_shapes,
          config5, config_aggregation, config_soak, config_overlay,
-         config_serve, config_wire_scale, config_mesh,
-         run_device_smoke_and_curve,
+         config_serve, config_wire_scale, config_epoch_profile,
+         config_mesh, run_device_smoke_and_curve,
          config_kernels, config1, config4, config_compile_cache)
         if _DEVICE_ALIVE else
         (config_gossip_latency, config_native_shapes, config5,
          config_aggregation, config_soak, config_overlay, config_serve,
-         config_wire_scale, config_mesh, config_device_retry,
-         run_device_smoke_and_curve, config_kernels, config1, config4,
-         config_compile_cache)
+         config_wire_scale, config_epoch_profile, config_mesh,
+         config_device_retry, run_device_smoke_and_curve, config_kernels,
+         config1, config4, config_compile_cache)
     )
     for fn in stages:
         if _left() < 120:

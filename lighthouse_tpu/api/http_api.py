@@ -972,6 +972,32 @@ class _Handler(JsonHandler):
 
             return self._json({"data": profile.get_registry().snapshot()})
 
+        if path == "/lighthouse/state-profile":
+            # state-transition observatory: per-(fork, stage, validator
+            # bucket) epoch-stage timings (enable with LTPU_STATE_PROFILE=1;
+            # honest {"enabled": false} shell otherwise) plus the recent
+            # epoch-boundary state-diff digest ring
+            from ..observability import stage_profile, state_diff
+
+            if not stage_profile.enabled():
+                return self._json({"data": {"enabled": False}})
+            data = stage_profile.get_registry().snapshot()
+            data["enabled"] = True
+            data["stage_totals"] = stage_profile.get_registry().stage_totals()
+            data["recent_digests"] = state_diff.get_recorder().recent(16)
+            return self._json({"data": data})
+
+        if path == "/lighthouse/forkchoice":
+            # fork-choice forensics: recent find_head explains (per-
+            # candidate weight breakdown) and the head-change forensic
+            # record ring (reorg/advance, ancestor depth, swing weight)
+            forensics = getattr(chain, "forensics", None)
+            if forensics is None:
+                return self._json({"data": {"enabled": False}})
+            data = forensics.snapshot()
+            data["enabled"] = True
+            return self._json({"data": data})
+
         if path == "/lighthouse/mesh":
             # verification mesh plan: dp×mp layout, per-device
             # platform/kind inventory, sharded-vs-single launch

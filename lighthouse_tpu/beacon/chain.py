@@ -177,6 +177,15 @@ class BeaconChain:
         self.proposer_preparations = {}   # validator index -> fee recipient
         self._advanced_head = None   # (head_root, slot, state) pre-advance
 
+        # fork-choice forensics (observability/): every get_head captures
+        # an explain entry; every head CHANGE appends a forensic record
+        # with the attestation batches applied since the previous change
+        from ..observability.forkchoice_forensics import Forensics
+
+        self.forensics = Forensics()
+        self.fork_choice.forensics = self.forensics
+        self._att_batches_since_head = 0
+
         self.current_slot = int(genesis_state.slot)
 
     # head accessors: one tuple read keeps (root, state) consistent under
@@ -548,6 +557,8 @@ class BeaconChain:
         )
         # feed block attestations into fork choice (import path applies
         # them immediately — fork_choice.rs on_attestation is_from_block)
+        if len(block.body.attestations):
+            self._att_batches_since_head += 1
         for att in block.body.attestations:
             try:
                 indexed = phase0.get_indexed_attestation(
@@ -783,6 +794,9 @@ class BeaconChain:
                             results[owner][2] = AttestationError(
                                 "invalid signature"
                             )
+            if any(err is None and indexed is not None
+                   for _, indexed, err in results):
+                self._att_batches_since_head += 1
             for att, indexed, err in results:
                 if err is not None or indexed is None:
                     continue
@@ -884,6 +898,9 @@ class BeaconChain:
                             results[owner][2] = AttestationError(
                                 "invalid signature"
                             )
+            if any(err is None and indexed is not None
+                   for _, indexed, err in results):
+                self._att_batches_since_head += 1
             for sa, indexed, err in results:
                 if err is not None or indexed is None:
                     continue
@@ -1297,6 +1314,28 @@ class BeaconChain:
                 return self.head_root
             new_state = state.copy()
             self._head = (head_root, new_state)
+            try:
+                from ..utils import tracing
+
+                trace = tracing.current_trace()
+                record = self.forensics.record_head_change(
+                    self.fork_choice,
+                    old_root,
+                    head_root,
+                    att_batches=self._att_batches_since_head,
+                    trace_id=trace.trace_id if trace is not None else None,
+                )
+                if trace is not None:
+                    trace.add_span(
+                        "forkchoice.head_change",
+                        kind=record["kind"],
+                        old_head=record["old_head"],
+                        new_head=record["new_head"],
+                        depth=record["old_depth"],
+                    )
+            except Exception:  # noqa: BLE001 — forensics must not stall import
+                log.exception("fork-choice forensics record failed")
+            self._att_batches_since_head = 0
             self._register_block_delays(head_root, int(new_state.slot))
             self.events.publish(
                 EventKind.HEAD,

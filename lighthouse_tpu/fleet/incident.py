@@ -152,6 +152,28 @@ class IncidentManager:
             "rss_bytes": process_metrics.read_rss_bytes(),
             "depths": process_metrics.structure_depths(self.chain),
         })
+
+        from ..observability import stage_profile, state_diff
+
+        def _state_profile_section():
+            if not stage_profile.enabled():
+                return {"enabled": False}
+            reg = stage_profile.get_registry()
+            return {
+                "enabled": True,
+                **reg.snapshot(),
+                "stage_totals": reg.stage_totals(),
+                "recent_digests": state_diff.get_recorder().recent(16),
+            }
+
+        def _forkchoice_section():
+            forensics = getattr(self.chain, "forensics", None)
+            if forensics is None:
+                return {"enabled": False}
+            return {"enabled": True, **forensics.snapshot()}
+
+        grab("state_profile", _state_profile_section)
+        grab("forkchoice_forensics", _forkchoice_section)
         if self.telemetry is not None:
             grab("telemetry", lambda: self.telemetry.fleet_table())
         if self.slo is not None:

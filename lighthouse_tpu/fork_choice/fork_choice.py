@@ -59,6 +59,9 @@ class ForkChoice:
         self.proto = proto_array
         self.preset = preset
         self.queued_attestations: list[QueuedAttestation] = []
+        # observability.forkchoice_forensics.Forensics, attached by the
+        # chain; when set, every get_head captures an explain entry
+        self.forensics = None
 
     # ------------------------------------------------------------ factory
 
@@ -233,7 +236,7 @@ class ForkChoice:
         boost_root = self.store.proposer_boost_root
         if boost_root is not None:
             boost_amount = self._proposer_score()
-        return self.proto.find_head(
+        head = self.proto.find_head(
             self.store.justified_checkpoint[1],
             {
                 v: b
@@ -245,6 +248,18 @@ class ForkChoice:
             proposer_boost_root=boost_root,
             proposer_boost_amount=boost_amount,
         )
+        if self.forensics is not None:
+            self.forensics.note_find_head(
+                self.proto,
+                justified_root=self.store.justified_checkpoint[1],
+                head_root=head,
+                boost_root=boost_root,
+                boost_amount=boost_amount,
+                justified_epoch=self.store.justified_checkpoint[0],
+                finalized_epoch=self.store.finalized_checkpoint[0],
+                current_slot=self.store.current_slot,
+            )
+        return head
 
     def _proposer_score(self):
         """Spec get_proposer_score: 40% of the per-slot committee weight."""

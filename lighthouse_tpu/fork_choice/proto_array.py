@@ -126,6 +126,67 @@ class ProtoArrayForkChoice:
             raise RuntimeError("best node is not viable for head")
         return head.root
 
+    # ----------------------------------------------------------- explain
+
+    def explain(self, justified_root, boost_root=None, boost_amount=0):
+        """Per-candidate weight breakdown at the justified root — one row
+        per child branch, over the weights the last find_head elected
+        with.  Read-only: no deltas are applied here.
+
+        Each row: the branch's first block, the tip find_head would chase
+        to (``best_descendant``), the branch weight, how much of it is
+        proposer boost (when the boost landed inside the branch), and the
+        justified/finalized viability verdicts that gate election."""
+        start = self.indices.get(justified_root)
+        if start is None:
+            return []
+        boost_idx = (
+            self.indices.get(boost_root) if boost_root is not None else None
+        )
+        rows = []
+        for idx, node in enumerate(self.nodes):
+            if node.parent != start:
+                continue
+            tip = (
+                self.nodes[node.best_descendant]
+                if node.best_descendant is not None
+                else node
+            )
+            boost_in_branch = False
+            if boost_idx is not None:
+                j = boost_idx
+                while j is not None:
+                    if j == idx:
+                        boost_in_branch = True
+                        break
+                    j = self.nodes[j].parent
+            rows.append({
+                "root": node.root.hex(),
+                "slot": node.slot,
+                "weight": node.weight,
+                "vote_weight": node.weight - (
+                    int(boost_amount) if boost_in_branch else 0
+                ),
+                "proposer_boost": (
+                    int(boost_amount) if boost_in_branch else 0
+                ),
+                "tip_root": tip.root.hex(),
+                "tip_slot": tip.slot,
+                "tip_weight": tip.weight,
+                "viable_justified": (
+                    node.justified_epoch == self.justified_epoch
+                    or self.justified_epoch == 0
+                ),
+                "viable_finalized": (
+                    node.finalized_epoch == self.finalized_epoch
+                    or self.finalized_epoch == 0
+                ),
+                "leads_to_viable_head": self._node_leads_to_viable_head(node),
+                "invalid": node.invalid,
+            })
+        rows.sort(key=lambda r: -r["weight"])
+        return rows
+
     # ---------------------------------------------------------- internals
 
     def _compute_deltas(self, new_balances):

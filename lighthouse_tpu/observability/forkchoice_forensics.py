@@ -1,0 +1,202 @@
+"""Fork-choice forensics: find_head explains + head-change records.
+
+Two bounded rings, chain-owned (``chain.forensics``):
+
+  * **explain ring** — every ``find_head`` pass through a forensics-
+    attached ``ForkChoice`` captures the per-candidate weight breakdown
+    at the justified root: for each competing branch its tip (the
+    ``best_descendant`` the chase would elect), total LMD weight, how
+    much of that weight is proposer boost, and the justified/finalized
+    viability verdicts.  The elected head is always consistent with
+    this table — the heaviest viable candidate's tip.
+  * **forensic records** — every head CHANGE appends one record: old
+    and new head, their common ancestor with the orphaned/adopted
+    depths (hops back to the ancestor — a reorg orphans ``old_depth``
+    blocks), the swing weight (new minus old head weight at election
+    time), how many attestation batches were applied since the previous
+    head change, the kind (``reorg`` when history was orphaned,
+    ``advance`` for a fast-forward that still rode the explain path),
+    and the trace id of the import that triggered it (PR-12 stitching).
+
+Served at ``GET /lighthouse/forkchoice``; joined into incident bundles
+as the ``forkchoice_forensics`` section; ring depths ride
+``utils/process_metrics.structure_depths``.
+"""
+
+import time
+from collections import deque
+
+from ..utils import locks, metrics
+
+EXPLAIN_RING = 32
+RECORD_RING = 64
+
+HEAD_CHANGES = metrics.counter(
+    "forkchoice_head_changes_total",
+    "Head changes recorded by the fork-choice forensics ring, by kind "
+    "(advance = fast-forward, reorg = ancestors orphaned)",
+    labels=("kind",),
+)
+EXPLAINS = metrics.counter(
+    "forkchoice_find_head_explains_total",
+    "find_head passes captured into the fork-choice explain ring",
+)
+LAST_REORG_DEPTH = metrics.gauge(
+    "forkchoice_last_reorg_depth",
+    "Blocks orphaned (old-head hops to the common ancestor) by the "
+    "most recent reorg-kind head change",
+)
+
+
+def _hex(root):
+    return root.hex() if isinstance(root, (bytes, bytearray)) else str(root)
+
+
+class Forensics:
+    """Bounded explain + forensic-record rings for one chain."""
+
+    def __init__(self, explain_ring=EXPLAIN_RING, record_ring=RECORD_RING):
+        self._lock = locks.lock("observability.forensics")
+        self._explains = deque(maxlen=explain_ring)
+        self._records = deque(maxlen=record_ring)
+        locks.guarded(self, "_explains", self._lock)
+        locks.guarded(self, "_records", self._lock)
+
+    # ---------------------------------------------------------- explains
+
+    def note_find_head(self, proto, *, justified_root, head_root,
+                       boost_root=None, boost_amount=0,
+                       justified_epoch=None, finalized_epoch=None,
+                       current_slot=None):
+        """One find_head pass: candidate branches at the justified root
+        with their weight/boost/viability breakdown (computed from the
+        proto-array AFTER the pass applied its deltas, so the numbers
+        are exactly the ones the election used)."""
+        entry = {
+            "at_mono": time.monotonic(),
+            "current_slot": current_slot,
+            "justified_root": _hex(justified_root),
+            "justified_epoch": justified_epoch,
+            "finalized_epoch": finalized_epoch,
+            "head_root": _hex(head_root),
+            "proposer_boost_root": (
+                _hex(boost_root) if boost_root is not None else None
+            ),
+            "proposer_boost_amount": int(boost_amount or 0),
+            "candidates": proto.explain(
+                justified_root, boost_root=boost_root,
+                boost_amount=boost_amount,
+            ),
+        }
+        with self._lock:
+            locks.access(self, "_explains", "write")
+            self._explains.append(entry)
+        EXPLAINS.inc()
+        return entry
+
+    # ----------------------------------------------------------- records
+
+    def record_head_change(self, fork_choice, old_root, new_root,
+                           att_batches=0, trace_id=None):
+        """One head change: ancestry walk + swing weight joined with
+        the latest explain entry for the same election."""
+        proto = fork_choice.proto
+        ancestor, old_depth, new_depth = self._common_ancestor(
+            proto, old_root, new_root
+        )
+        kind = "advance" if ancestor == old_root else "reorg"
+
+        def _weight(root):
+            idx = proto.indices.get(root)
+            return proto.nodes[idx].weight if idx is not None else None
+
+        old_w, new_w = _weight(old_root), _weight(new_root)
+        with self._lock:
+            locks.access(self, "_explains", "read")
+            explain = self._explains[-1] if self._explains else None
+        record = {
+            "at_unix": time.time(),
+            "kind": kind,
+            "old_head": _hex(old_root),
+            "new_head": _hex(new_root),
+            "common_ancestor": _hex(ancestor) if ancestor else None,
+            "old_depth": old_depth,       # blocks orphaned on a reorg
+            "new_depth": new_depth,       # blocks adopted past the fork
+            "old_weight": old_w,
+            "new_weight": new_w,
+            "swing_weight": (
+                new_w - old_w
+                if old_w is not None and new_w is not None else None
+            ),
+            "att_batches_since_last_head": int(att_batches),
+            "trace_id": trace_id,
+            "explain": explain,
+        }
+        with self._lock:
+            locks.access(self, "_records", "write")
+            self._records.append(record)
+        HEAD_CHANGES.with_labels(kind).inc()
+        if kind == "reorg":
+            LAST_REORG_DEPTH.set(old_depth if old_depth is not None else 0)
+        return record
+
+    @staticmethod
+    def _common_ancestor(proto, old_root, new_root):
+        """(ancestor_root, old_hops, new_hops) via proto parent walks;
+        (None, None, None) when either side is unknown (pruned)."""
+        old_idx = proto.indices.get(old_root)
+        new_idx = proto.indices.get(new_root)
+        if old_idx is None or new_idx is None:
+            return None, None, None
+        new_chain = {}
+        idx, hops = new_idx, 0
+        while idx is not None:
+            new_chain[idx] = hops
+            idx = proto.nodes[idx].parent
+            hops += 1
+        idx, old_hops = old_idx, 0
+        while idx is not None:
+            if idx in new_chain:
+                return proto.nodes[idx].root, old_hops, new_chain[idx]
+            idx = proto.nodes[idx].parent
+            old_hops += 1
+        return None, None, None
+
+    # ------------------------------------------------------------- reads
+
+    def recent_explains(self, limit=None):
+        with self._lock:
+            locks.access(self, "_explains", "read")
+            out = list(self._explains)
+        out.reverse()
+        return out[:limit] if limit else out
+
+    def recent_records(self, limit=None):
+        with self._lock:
+            locks.access(self, "_records", "read")
+            out = list(self._records)
+        out.reverse()
+        return out[:limit] if limit else out
+
+    def snapshot(self):
+        return {
+            "explains": self.recent_explains(8),
+            "records": self.recent_records(),
+            "depths": self.depths(),
+        }
+
+    def depths(self):
+        with self._lock:
+            locks.access(self, "_explains", "read")
+            locks.access(self, "_records", "read")
+            return {
+                "explain_ring": len(self._explains),
+                "forensic_records": len(self._records),
+            }
+
+    def clear(self):
+        with self._lock:
+            locks.access(self, "_explains", "write")
+            locks.access(self, "_records", "write")
+            self._explains.clear()
+            self._records.clear()

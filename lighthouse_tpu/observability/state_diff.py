@@ -1,0 +1,150 @@
+"""Epoch-boundary state-diff digests: the bit-for-bit oracle.
+
+After each epoch transition the driver (``phase0.process_slots``, armed
+by ``LTPU_STATE_PROFILE=1`` — the digests ride the profiler gate so the
+production path stays untouched) records one compact record per epoch
+boundary into a bounded ring:
+
+  * sha256 digests over the dense arrays the epoch transition mutates —
+    balances, current/previous participation flags (altair+), and the
+    justification bits — taken on the exact little-endian bytes the SSZ
+    arrays hold, so "same digest" means "same serialized state slice";
+  * summary deltas vs the pre-transition snapshot: how many balances
+    changed, total rewards (sum of increases), total penalties (sum of
+    decreases), and how many participation flag bytes were set/cleared.
+
+The device-vectorization work (ROADMAP "epoch processing on device")
+diffs its kernel output against these records epoch by epoch; the
+fleet incident bundles and ``GET /lighthouse/state-profile`` carry the
+recent ring so a divergence is attributable after the fact.
+"""
+
+import hashlib
+import threading
+from collections import deque
+
+import numpy as np
+
+from ..utils import metrics
+
+RING = 64       # epoch records retained
+
+DIGESTS = metrics.counter(
+    "state_profile_epoch_digests_total",
+    "Epoch-boundary state-diff digest records written by the "
+    "state-transition observatory",
+)
+
+
+def _sha(arr_bytes):
+    return hashlib.sha256(arr_bytes).hexdigest()
+
+
+def _participation_np(state, which):
+    part = getattr(state, which + "_epoch_participation", None)
+    if part is None:
+        return None
+    return part.np
+
+
+def digest_state(state):
+    """Byte-exact digests of the epoch-mutated dense arrays.  Stable
+    across copies of an identical state; any single-lane mutation flips
+    the corresponding digest."""
+    balances = state.balances.np
+    out = {
+        "slot": int(state.slot),
+        "n_validators": len(state.validators),
+        "balances_sha256": _sha(balances.astype("<u8").tobytes()),
+        "justification_bits_sha256": _sha(
+            bytes(int(b) & 1 for b in state.justification_bits)
+        ),
+    }
+    for which in ("current", "previous"):
+        part = _participation_np(state, which)
+        if part is not None:
+            out[f"{which}_participation_sha256"] = _sha(
+                part.astype("|u1").tobytes()
+            )
+    return out
+
+
+def pre_snapshot(state):
+    """The cheap pre-transition capture the deltas are computed
+    against: one balances copy plus the participation set-bit count."""
+    snap = {"balances": state.balances.np.copy()}
+    part = _participation_np(state, "current")
+    if part is not None:
+        snap["participation_nonzero"] = int(np.count_nonzero(part))
+    return snap
+
+
+class DiffRecorder:
+    """Bounded ring of per-epoch digest records."""
+
+    def __init__(self, ring=RING):
+        self._ring = deque(maxlen=ring)
+        self._lock = threading.Lock()   # ring-append only; plain by design
+
+    def record_boundary(self, state, pre, epoch=None):
+        """One epoch boundary: `state` is the post-transition state,
+        `pre` the ``pre_snapshot`` taken before it, `epoch` the epoch
+        the transition just closed (the caller knows the preset)."""
+        post = state.balances.np
+        prev = pre["balances"]
+        n = min(len(prev), len(post))
+        delta = post[:n].astype(np.int64) - prev[:n].astype(np.int64)
+        record = digest_state(state)
+        if epoch is not None:
+            record["epoch"] = int(epoch)
+        record["deltas"] = {
+            "balances_changed": int(np.count_nonzero(delta)),
+            "total_rewards": int(delta[delta > 0].sum()),
+            "total_penalties": int(-delta[delta < 0].sum()),
+            "appended_validators": len(post) - n,
+        }
+        part = _participation_np(state, "current")
+        if part is not None and "participation_nonzero" in pre:
+            record["deltas"]["participation_nonzero_delta"] = (
+                int(np.count_nonzero(part)) - pre["participation_nonzero"]
+            )
+        with self._lock:
+            self._ring.append(record)
+        DIGESTS.inc()
+        return record
+
+    def recent(self, limit=None):
+        with self._lock:
+            records = list(self._ring)
+        records.reverse()
+        return records[:limit] if limit else records
+
+    def depth(self):
+        with self._lock:
+            return len(self._ring)
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+
+
+_RECORDER = None
+_REC_LOCK = threading.Lock()
+
+
+def get_recorder() -> DiffRecorder:
+    global _RECORDER
+    with _REC_LOCK:
+        if _RECORDER is None:
+            _RECORDER = DiffRecorder()
+        return _RECORDER
+
+
+def set_recorder(recorder):
+    global _RECORDER
+    with _REC_LOCK:
+        _RECORDER = recorder
+
+
+def depth():
+    return get_recorder().depth()

@@ -13,6 +13,7 @@ pending attestations are translated into participation flags.
 
 import numpy as np
 
+from ..observability import stage_profile
 from ..ssz import hash_tree_root
 from ..types import Domain
 from ..types.state import state_types
@@ -207,14 +208,24 @@ def sync_committee_validator_indices(state, preset, committee=None):
 
 def process_epoch(state, preset, spec=None):
     """altair.rs:22 process_epoch."""
-    process_justification_and_finalization(state, preset)
-    process_inactivity_updates(state, preset)
-    process_rewards_and_penalties(state, preset)
-    phase0.process_registry_updates(state, preset, spec=spec)
-    process_slashings(state, preset)
-    phase0.process_final_updates_partial(state, preset)
-    process_participation_flag_updates(state)
-    process_sync_committee_updates(state, preset)
+    prof = stage_profile.timer(state)
+    n = len(state.validators)
+    with prof.stage("justification_finalization", ops=n):
+        process_justification_and_finalization(state, preset)
+    with prof.stage("inactivity_updates", ops=n):
+        process_inactivity_updates(state, preset)
+    with prof.stage("rewards_penalties", ops=n):
+        process_rewards_and_penalties(state, preset)
+    with prof.stage("registry_updates", ops=n):
+        phase0.process_registry_updates(state, preset, spec=spec)
+    with prof.stage("slashings", ops=n):
+        process_slashings(state, preset)
+    with prof.stage("final_updates", ops=n):
+        phase0.process_final_updates_partial(state, preset)
+    with prof.stage("participation_flag_updates", ops=n):
+        process_participation_flag_updates(state)
+    with prof.stage("sync_committee_updates", ops=n):
+        process_sync_committee_updates(state, preset)
 
 
 def process_justification_and_finalization(state, preset):

@@ -17,6 +17,7 @@ is_merge_transition_complete.
 
 import numpy as np
 
+from ..observability import stage_profile
 from ..ssz import hash_tree_root
 from ..types.state import state_types
 from . import altair, phase0
@@ -58,19 +59,30 @@ def is_merge_transition_complete(state):
 
 def process_epoch(state, preset, spec=None):
     """Altair's flag-based epoch transition with bellatrix constants."""
-    altair.process_justification_and_finalization(state, preset)
-    altair.process_inactivity_updates(state, preset)
-    process_rewards_and_penalties(state, preset)
-    phase0.process_registry_updates(state, preset, spec=spec)
-    phase0.process_slashings_with_multiplier(
-        state, preset, PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX
-    )
-    phase0.process_final_updates_partial(
-        state, preset, historical_roots=not is_capella_state(state)
-    )
-    process_historical_summaries(state, preset)
-    altair.process_participation_flag_updates(state)
-    altair.process_sync_committee_updates(state, preset)
+    prof = stage_profile.timer(state)
+    n = len(state.validators)
+    with prof.stage("justification_finalization", ops=n):
+        altair.process_justification_and_finalization(state, preset)
+    with prof.stage("inactivity_updates", ops=n):
+        altair.process_inactivity_updates(state, preset)
+    with prof.stage("rewards_penalties", ops=n):
+        process_rewards_and_penalties(state, preset)
+    with prof.stage("registry_updates", ops=n):
+        phase0.process_registry_updates(state, preset, spec=spec)
+    with prof.stage("slashings", ops=n):
+        phase0.process_slashings_with_multiplier(
+            state, preset, PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX
+        )
+    with prof.stage("final_updates", ops=n):
+        phase0.process_final_updates_partial(
+            state, preset, historical_roots=not is_capella_state(state)
+        )
+    with prof.stage("historical_summaries", ops=n):
+        process_historical_summaries(state, preset)
+    with prof.stage("participation_flag_updates", ops=n):
+        altair.process_participation_flag_updates(state)
+    with prof.stage("sync_committee_updates", ops=n):
+        altair.process_sync_committee_updates(state, preset)
 
 
 def process_rewards_and_penalties(state, preset):

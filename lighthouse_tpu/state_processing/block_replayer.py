@@ -14,6 +14,7 @@ Mirrors two reference seams (SURVEY.md §2.4-2.5):
     the shape the TPU kernel is built for.
 """
 
+from ..observability import stage_profile
 from ..ssz import hash_tree_root
 from .phase0 import (
     BlockProcessingError,
@@ -81,15 +82,16 @@ class BlockReplayer:
                 self.pre_block_hook(self.state, signed)
             if self.state.slot < slot:
                 self.state = process_slots(self.state, slot, self.spec.preset, spec=self.spec)
-            per_block_processing(
-                self.state,
-                signed,
-                self.spec,
-                signature_strategy=self.signature_strategy,
-                verify_fn=self.verify_fn,
-                collected_sets=collected,
-                payload_optimistic=not self.verify_payloads,
-            )
+            with stage_profile.timer(self.state).stage("block_processing"):
+                per_block_processing(
+                    self.state,
+                    signed,
+                    self.spec,
+                    signature_strategy=self.signature_strategy,
+                    verify_fn=self.verify_fn,
+                    collected_sets=collected,
+                    payload_optimistic=not self.verify_payloads,
+                )
             if self.verify_state_roots:
                 if signed.message.state_root != hash_tree_root(self.state):
                     raise BlockProcessingError("state root mismatch in replay")

@@ -18,6 +18,7 @@ for a given epoch cannot change once the seed is observable.
 
 import numpy as np
 
+from ..observability import stage_profile
 from .shuffle import shuffle_list
 
 
@@ -52,9 +53,10 @@ def committees_for_epoch(state, epoch, preset):
     key = (epoch, seed, len(state.validators))
     cache = caches.get(key)
     if cache is None:
-        indices = phase0.get_active_validator_indices_np(state, epoch)
-        per_slot = phase0.get_committee_count_per_slot(state, epoch, preset)
-        cache = EpochCommittees(indices, seed, per_slot, preset)
+        with stage_profile.timer(state).stage("committee_cache_build"):
+            indices = phase0.get_active_validator_indices_np(state, epoch)
+            per_slot = phase0.get_committee_count_per_slot(state, epoch, preset)
+            cache = EpochCommittees(indices, seed, per_slot, preset)
         if len(caches) > 8:
             caches.clear()
         caches[key] = cache

@@ -17,6 +17,7 @@ import hashlib
 
 import numpy as np
 
+from ..observability import stage_profile, state_diff
 from ..ssz import hash_tree_root, uint64
 from ..types import Domain, compute_signing_root
 from ..types.containers import Checkpoint, BeaconBlockHeader
@@ -356,7 +357,12 @@ def process_slots(state, slot, preset, spec=None):
         process_slot(state, preset)
         next_is_epoch_start = (state.slot + 1) % preset.slots_per_epoch == 0
         if next_is_epoch_start:
+            pre = state_diff.pre_snapshot(state) if stage_profile.enabled() else None
             process_epoch_for_fork(state, preset, spec=spec)
+            if pre is not None:
+                state_diff.get_recorder().record_boundary(
+                    state, pre, epoch=int(state.slot) // preset.slots_per_epoch
+                )
         state.slot += 1
         if next_is_epoch_start and spec is not None:
             epoch = state.slot // preset.slots_per_epoch
@@ -391,25 +397,29 @@ def process_slots(state, slot, preset, spec=None):
 
 def process_epoch_for_fork(state, preset, spec=None):
     """Fork-dispatching epoch transition (per_epoch_processing.rs:31)."""
-    if hasattr(state, "latest_execution_payload_header"):
-        from . import bellatrix
+    with stage_profile.timer(state).stage(
+        "epoch_total", ops=len(state.validators)
+    ):
+        if hasattr(state, "latest_execution_payload_header"):
+            from . import bellatrix
 
-        bellatrix.process_epoch(state, preset, spec=spec)
-    elif hasattr(state, "previous_epoch_participation"):
-        from . import altair
+            bellatrix.process_epoch(state, preset, spec=spec)
+        elif hasattr(state, "previous_epoch_participation"):
+            from . import altair
 
-        altair.process_epoch(state, preset, spec=spec)
-    else:
-        process_epoch(state, preset, spec=spec)
+            altair.process_epoch(state, preset, spec=spec)
+        else:
+            process_epoch(state, preset, spec=spec)
 
 
 def process_slot(state, preset):
-    previous_state_root = hash_tree_root(state)
-    state.state_roots[state.slot % preset.slots_per_historical_root] = previous_state_root
-    if state.latest_block_header.state_root == bytes(32):
-        state.latest_block_header.state_root = previous_state_root
-    previous_block_root = hash_tree_root(state.latest_block_header)
-    state.block_roots[state.slot % preset.slots_per_historical_root] = previous_block_root
+    with stage_profile.timer(state).stage("ssz_hashing"):
+        previous_state_root = hash_tree_root(state)
+        state.state_roots[state.slot % preset.slots_per_historical_root] = previous_state_root
+        if state.latest_block_header.state_root == bytes(32):
+            state.latest_block_header.state_root = previous_state_root
+        previous_block_root = hash_tree_root(state.latest_block_header)
+        state.block_roots[state.slot % preset.slots_per_historical_root] = previous_block_root
 
 
 # ------------------------------------------------------------------ epoch
@@ -417,11 +427,18 @@ def process_slot(state, preset):
 
 def process_epoch(state, preset, spec=None):
     """per_epoch_processing/base.rs process_epoch."""
-    process_justification_and_finalization(state, preset)
-    process_rewards_and_penalties(state, preset)
-    process_registry_updates(state, preset, spec=spec)
-    process_slashings(state, preset)
-    process_final_updates(state, preset)
+    prof = stage_profile.timer(state)
+    n = len(state.validators)
+    with prof.stage("justification_finalization", ops=n):
+        process_justification_and_finalization(state, preset)
+    with prof.stage("rewards_penalties", ops=n):
+        process_rewards_and_penalties(state, preset)
+    with prof.stage("registry_updates", ops=n):
+        process_registry_updates(state, preset, spec=spec)
+    with prof.stage("slashings", ops=n):
+        process_slashings(state, preset)
+    with prof.stage("final_updates", ops=n):
+        process_final_updates(state, preset)
 
 
 def _matching_source_attestations(state, epoch, preset):

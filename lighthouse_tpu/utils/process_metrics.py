@@ -22,6 +22,13 @@ and the structures that landed after PR 13:
     overlay_pending_partials unsettled (slot, committee) stores
     incident_ring            on-disk fleet incident bundles retained
 
+and the state-transition observatory rings (PR 18):
+
+    state_profile_registry      (fork, stage, bucket) stage-timer keys
+    state_diff_ring             epoch-boundary digest records retained
+    forkchoice_explain_ring     find_head explain entries retained
+    forkchoice_forensic_records head-change forensic records retained
+
 `sample(chain)` refreshes the gauges AND returns the values, so the
 soak gate and the `/metrics` scrape read the same numbers — no
 shelling out to `ps`.
@@ -72,12 +79,15 @@ def structure_depths(chain=None):
     the `chain` argument (the soak and `/metrics` both pass it)."""
     from ..crypto.tpu import bls as tb
     from ..crypto.tpu.profile import get_registry
+    from ..observability import stage_profile, state_diff
     from . import tracing
 
     depths = {
         "pk_cache": len(tb.PK_CACHE),
         "tracing_ring": tracing.depth(),
         "profile_registry": get_registry().key_count(),
+        "state_profile_registry": stage_profile.get_registry().key_count(),
+        "state_diff_ring": state_diff.depth(),
     }
     if chain is not None:
         depths["op_pool_entries"] = chain.op_pool.aggregation.stats()["entries"]
@@ -95,6 +105,11 @@ def structure_depths(chain=None):
         fleet = getattr(chain, "fleet", None)
         if fleet is not None:
             depths["incident_ring"] = fleet.incidents.ring_depth()
+        forensics = getattr(chain, "forensics", None)
+        if forensics is not None:
+            fc = forensics.depths()
+            depths["forkchoice_explain_ring"] = fc["explain_ring"]
+            depths["forkchoice_forensic_records"] = fc["forensic_records"]
     return depths
 
 

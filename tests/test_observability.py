@@ -1,0 +1,405 @@
+"""State-transition observatory (observability/): epoch-stage profiler
+totality + disabled-path identity, state-diff digest stability and
+delta accounting, fork-choice forensics on a forced reorg, the
+/lighthouse/state-profile + /lighthouse/forkchoice routes, and the
+structure-depth leak-watch rows.
+
+The profiler rides LTPU_STATE_PROFILE exactly like the race witness
+rides LTPU_RACE_WITNESS: tests arm it through the `armed` fixture
+(fresh in-memory registry + digest ring, env restored after), and the
+disabled-path tests assert the null-singleton identity the production
+hot path depends on.
+"""
+
+import json
+import time
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.beacon.chain import BeaconChain
+from lighthouse_tpu.crypto.backend import SignatureVerifier
+from lighthouse_tpu.observability import stage_profile, state_diff
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.state_processing import phase0
+from lighthouse_tpu.testing import scale, soak
+from lighthouse_tpu.types import ChainSpec, MinimalPreset
+from lighthouse_tpu.utils import process_metrics
+
+SPEC = ChainSpec(preset=MinimalPreset, altair_fork_epoch=0)
+PRESET = SPEC.preset
+SPE = PRESET.slots_per_epoch
+
+
+@pytest.fixture(scope="module")
+def pk_pool():
+    return scale.make_pubkey_pool(16)
+
+
+@pytest.fixture(scope="module")
+def sig_pool():
+    return scale.make_signature_pool(32)
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    """Profiler ON into a fresh in-memory registry + digest ring; the
+    process defaults (and the cached env gate) are restored after."""
+    monkeypatch.setenv("LTPU_STATE_PROFILE", "1")
+    stage_profile.reset()
+    old_reg = stage_profile._REGISTRY
+    old_rec = state_diff._RECORDER
+    reg = stage_profile.StageProfileRegistry()      # no path: memory only
+    rec = state_diff.DiffRecorder()
+    stage_profile.set_registry(reg)
+    state_diff.set_recorder(rec)
+    yield SimpleNamespace(registry=reg, recorder=rec)
+    stage_profile.set_registry(old_reg)
+    state_diff.set_recorder(old_rec)
+    monkeypatch.delenv("LTPU_STATE_PROFILE", raising=False)
+    stage_profile.reset()
+
+
+def _boot_chain(pk_pool, n=64, epoch=1, seed=0):
+    state = scale.make_scaled_state(
+        n, SPEC, epoch=epoch, seed=seed, pubkey_pool=pk_pool, fork="altair"
+    )
+    soak.pin_anchor_checkpoints(state, PRESET)
+    return BeaconChain(state, SPEC, verifier=SignatureVerifier("fake"))
+
+
+def _advance(chain, sig_pool, n_slots):
+    start = int(chain.head_state.slot)
+    for slot in range(start + 1, start + 1 + n_slots):
+        chain.on_tick(slot)
+        blk = soak.produce_block(chain, slot, sig_pool, si=slot)
+        root = chain.process_block(blk)
+        chain.recompute_head()
+        assert chain.head_root == root
+
+
+# ------------------------------------------------------- disabled identity
+
+
+def test_disabled_timer_is_the_null_singleton(monkeypatch):
+    monkeypatch.delenv("LTPU_STATE_PROFILE", raising=False)
+    stage_profile.reset()
+    # never touches the state argument on the disabled path
+    assert stage_profile.timer(object()) is stage_profile.NULL_TIMER
+    assert (stage_profile.NULL_TIMER.stage("anything", ops=9)
+            is stage_profile.NULL_STAGE)
+    with stage_profile.NULL_STAGE:
+        pass                                    # reusable, no-op
+
+
+def test_disabled_replay_records_nothing(monkeypatch, pk_pool):
+    monkeypatch.delenv("LTPU_STATE_PROFILE", raising=False)
+    stage_profile.reset()
+    assert not stage_profile.enabled()
+    reg = stage_profile.StageProfileRegistry()
+    rec = state_diff.DiffRecorder()
+    old_reg, old_rec = stage_profile._REGISTRY, state_diff._RECORDER
+    stage_profile.set_registry(reg)
+    state_diff.set_recorder(rec)
+    try:
+        state = scale.make_scaled_state(
+            32, SPEC, epoch=1, seed=3, pubkey_pool=pk_pool, fork="altair"
+        )
+        phase0.process_slots(
+            state, int(state.slot) + SPE + 1, PRESET, spec=SPEC
+        )
+    finally:
+        stage_profile.set_registry(old_reg)
+        state_diff.set_recorder(old_rec)
+    assert reg.key_count() == 0                 # no stage rows
+    assert rec.depth() == 0                     # no boundary digests
+
+
+# ------------------------------------------------------------ stage timer
+
+
+def test_stage_registry_accumulation_and_buckets():
+    reg = stage_profile.StageProfileRegistry()
+    for wall_ms in (1.0, 2.0, 4.0):
+        reg.record_stage("altair", "rewards_penalties", 64,
+                         wall_ms / 1e3, ops=64)
+    rows = reg.rows()
+    assert len(rows) == 1
+    e = rows[0]
+    assert (e["fork"], e["stage"], e["vbucket"]) == (
+        "altair", "rewards_penalties", "<=256")
+    assert e["calls"] == 3 and e["ops"] == 192
+    assert e["total_ms"] == pytest.approx(7.0, abs=0.01)
+    assert e["ewma_ms"] == pytest.approx(1.76, abs=0.01)   # EWMA(0.2)
+    assert e["min_ms"] == pytest.approx(1.0, abs=0.01)
+    assert e["max_ms"] == pytest.approx(4.0, abs=0.01)
+    assert sum(e["hist"]) == 3
+    assert e["mean_ms"] == pytest.approx(7.0 / 3, abs=0.01)
+    # a different validator scale lands in its own row
+    reg.record_stage("altair", "rewards_penalties", 50_000, 0.001)
+    assert reg.key_count() == 2
+    totals = reg.stage_totals()
+    assert totals["rewards_penalties"]["calls"] == 4
+
+
+def test_vbucket_and_fork_name():
+    assert stage_profile.vbucket(64) == "<=256"
+    assert stage_profile.vbucket(257) == "<=1k"
+    assert stage_profile.vbucket(65536) == "<=64k"
+    assert stage_profile.vbucket(2_000_000) == ">1M"
+    assert stage_profile.fork_name(SimpleNamespace()) == "phase0"
+    assert stage_profile.fork_name(
+        SimpleNamespace(previous_epoch_participation=1)) == "altair"
+    assert stage_profile.fork_name(SimpleNamespace(
+        previous_epoch_participation=1,
+        latest_execution_payload_header=1)) == "bellatrix"
+
+
+def test_stage_registry_persistence_roundtrip(tmp_path):
+    p = str(tmp_path / "state_profile.json")
+    reg = stage_profile.StageProfileRegistry(p)
+    reg.record_stage("altair", "slashings", 64, 0.003, ops=64)
+    assert reg.save(force=True)
+    reborn = stage_profile.StageProfileRegistry(p)
+    e = reborn.rows()[0]
+    assert e["stage"] == "slashings" and e["calls"] == 1
+    reborn.record_stage("altair", "slashings", 64, 0.003)
+    assert reborn.rows()[0]["calls"] == 2
+    # corrupt file starts empty, never raises
+    (tmp_path / "bad.json").write_text("{nope")
+    assert stage_profile.StageProfileRegistry(
+        str(tmp_path / "bad.json")).rows() == []
+
+
+def test_stage_totality_over_epoch_replay(armed, pk_pool):
+    """The acceptance shape at unit scale: instrumented stages (minus
+    the epoch_total parent row) account for ~the whole measured
+    process_slots wall across an epoch boundary."""
+    state = scale.make_scaled_state(
+        64, SPEC, epoch=1, seed=0, pubkey_pool=pk_pool, fork="altair"
+    )
+    hash_tree_root(state)                       # prime the hasher
+    t0 = time.perf_counter()
+    state = phase0.process_slots(
+        state, int(state.slot) + SPE + 1, PRESET, spec=SPEC
+    )
+    wall_ms = (time.perf_counter() - t0) * 1e3
+
+    totals = armed.registry.stage_totals()
+    assert "epoch_total" in totals and "ssz_hashing" in totals
+    assert {"justification_finalization", "rewards_penalties",
+            "registry_updates", "slashings", "final_updates",
+            "participation_flag_updates"} <= set(totals)
+    stage_sum = sum(t["total_ms"] for name, t in totals.items()
+                    if name != "epoch_total")
+    # child stages never exceed the wall; at tiny N the per-slot loop
+    # overhead is a real fraction, so the floor is loose
+    assert stage_sum <= wall_ms * 1.10 + 2.0, (stage_sum, wall_ms)
+    assert stage_sum >= wall_ms * 0.3 - 2.0, (stage_sum, wall_ms)
+    # the epoch stages sit under their parent row
+    epoch_children = sum(
+        t["total_ms"] for name, t in totals.items()
+        if name not in ("epoch_total", "ssz_hashing"))
+    assert epoch_children <= totals["epoch_total"]["total_ms"] * 1.05 + 1.0
+
+    # exactly one epoch boundary was crossed -> one digest record
+    recs = armed.recorder.recent()
+    assert len(recs) == 1
+    r = recs[0]
+    assert r["epoch"] == (int(state.slot) - 1) // SPE - 1
+    assert set(r["deltas"]) >= {"balances_changed", "total_rewards",
+                                "total_penalties", "appended_validators"}
+    assert "participation_nonzero_delta" in r["deltas"]
+
+
+# ------------------------------------------------------------ state diff
+
+
+def test_digest_stable_across_copies_and_flips_on_mutation(pk_pool):
+    state = scale.make_scaled_state(
+        32, SPEC, epoch=1, seed=7, pubkey_pool=pk_pool, fork="altair"
+    )
+    clone = state.copy()
+    d0, d1 = state_diff.digest_state(state), state_diff.digest_state(clone)
+    assert d0 == d1
+    assert {"balances_sha256", "justification_bits_sha256",
+            "current_participation_sha256",
+            "previous_participation_sha256"} <= set(d0)
+    clone.balances[3] = int(clone.balances[3]) + 1
+    d2 = state_diff.digest_state(clone)
+    assert d2["balances_sha256"] != d0["balances_sha256"]
+    assert (d2["current_participation_sha256"]
+            == d0["current_participation_sha256"])
+
+
+def test_record_boundary_delta_accounting(pk_pool):
+    state = scale.make_scaled_state(
+        32, SPEC, epoch=1, seed=7, pubkey_pool=pk_pool, fork="altair"
+    )
+    pre = state_diff.pre_snapshot(state)
+    assert "participation_nonzero" in pre
+    # synthesize the transition: one reward, one penalty
+    state.balances[0] = int(state.balances[0]) + 1000
+    state.balances[1] = int(state.balances[1]) - 300
+    rec = state_diff.DiffRecorder(ring=4)
+    record = rec.record_boundary(state, pre, epoch=9)
+    assert record["epoch"] == 9
+    assert record["deltas"]["balances_changed"] == 2
+    assert record["deltas"]["total_rewards"] == 1000
+    assert record["deltas"]["total_penalties"] == 300
+    assert record["deltas"]["appended_validators"] == 0
+    # ring is bounded, newest first
+    for i in range(6):
+        rec.record_boundary(state, pre, epoch=10 + i)
+    assert rec.depth() == 4
+    assert rec.recent()[0]["epoch"] == 15
+    assert rec.recent(limit=2)[1]["epoch"] == 14
+
+
+# ----------------------------------------------------- forkchoice forensics
+
+
+def test_forced_reorg_emits_one_consistent_record(pk_pool, sig_pool):
+    chain = _boot_chain(pk_pool, n=64, epoch=1, seed=0)
+    _advance(chain, sig_pool, 3)
+    assert chain.forensics.depths()["explain_ring"] > 0
+    # the honest advances so far are advance-kind records
+    kinds = {r["kind"] for r in chain.forensics.recent_records()}
+    assert kinds <= {"advance"}
+
+    chain.forensics.clear()
+    old, new = soak.force_reorg(chain, sig_pool, si=7)
+    assert chain.head_root == new
+
+    recs = chain.forensics.recent_records()
+    assert len(recs) == 1, [r["kind"] for r in recs]
+    r = recs[0]
+    assert r["kind"] == "reorg"
+    assert r["old_head"] == old.hex()
+    assert r["new_head"] == new.hex()
+    # sibling fork: one block orphaned, one adopted past the shared parent
+    assert (r["old_depth"], r["new_depth"]) == (1, 1)
+    assert r["common_ancestor"] not in (None, r["old_head"])
+    assert r["att_batches_since_last_head"] >= 0
+    # joined explain is the election that produced this head
+    ex = r["explain"]
+    assert ex is not None and ex["head_root"] == r["new_head"]
+    assert ex["candidates"], ex
+    # the elected head is the winning candidate's tip
+    assert ex["candidates"][0]["tip_root"] == r["new_head"]
+    assert ex["candidates"][0]["leads_to_viable_head"]
+    # reorg gauge + snapshot shape
+    snap = chain.forensics.snapshot()
+    assert snap["depths"]["forensic_records"] == 1
+    assert snap["records"][0]["kind"] == "reorg"
+
+
+def test_explain_weights_are_self_consistent(pk_pool, sig_pool):
+    chain = _boot_chain(pk_pool, n=64, epoch=1, seed=1)
+    _advance(chain, sig_pool, 2)
+    ex = chain.forensics.recent_explains(1)[0]
+    for cand in ex["candidates"]:
+        assert cand["weight"] >= cand["vote_weight"] >= 0
+        assert cand["proposer_boost"] >= 0
+        assert cand["tip_slot"] >= cand["slot"]
+    # unknown justified root explains to an empty candidate table
+    assert chain.fork_choice.proto.explain(b"\x00" * 32) == []
+
+
+def test_common_ancestor_walk_units():
+    class _N(SimpleNamespace):
+        pass
+
+    #      0
+    #     / \
+    #    1   2
+    #        |
+    #        3
+    nodes = [_N(root=b"a", parent=None), _N(root=b"b", parent=0),
+             _N(root=b"c", parent=0), _N(root=b"d", parent=2)]
+    proto = SimpleNamespace(
+        nodes=nodes, indices={n.root: i for i, n in enumerate(nodes)}
+    )
+    from lighthouse_tpu.observability.forkchoice_forensics import Forensics
+
+    assert Forensics._common_ancestor(proto, b"b", b"d") == (b"a", 1, 2)
+    assert Forensics._common_ancestor(proto, b"a", b"d") == (b"a", 0, 2)
+    assert Forensics._common_ancestor(proto, b"x", b"d") == (None, None, None)
+
+
+# ----------------------------------------------------------- HTTP + depths
+
+
+def test_structure_depths_carry_observatory_rings(pk_pool, sig_pool):
+    chain = _boot_chain(pk_pool, n=64, epoch=1, seed=2)
+    _advance(chain, sig_pool, 1)
+    depths = process_metrics.structure_depths(chain)
+    assert {"state_profile_registry", "state_diff_ring",
+            "forkchoice_explain_ring",
+            "forkchoice_forensic_records"} <= set(depths)
+    assert depths["forkchoice_explain_ring"] >= 1
+
+
+def test_http_state_profile_route_disabled(monkeypatch, pk_pool):
+    from lighthouse_tpu.api.http_api import BeaconApiServer
+
+    monkeypatch.delenv("LTPU_STATE_PROFILE", raising=False)
+    stage_profile.reset()
+    chain = _boot_chain(pk_pool, n=32, epoch=1, seed=4)
+    server = BeaconApiServer(chain).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/lighthouse/state-profile") as r:
+            data = json.load(r)["data"]
+        assert data == {"enabled": False}
+    finally:
+        server.stop()
+
+
+def test_http_observatory_routes_armed(armed, pk_pool, sig_pool):
+    from lighthouse_tpu.api.http_api import BeaconApiServer
+
+    chain = _boot_chain(pk_pool, n=64, epoch=1, seed=5)
+    _advance(chain, sig_pool, 1)
+    armed.registry.record_stage("altair", "rewards_penalties", 64,
+                                0.002, ops=64)
+    server = BeaconApiServer(chain).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/lighthouse/state-profile") as r:
+            sp = json.load(r)["data"]
+        with urllib.request.urlopen(base + "/lighthouse/forkchoice") as r:
+            fc = json.load(r)["data"]
+    finally:
+        server.stop()
+    assert sp["enabled"] is True
+    assert any(row["stage"] == "rewards_penalties" for row in sp["rows"])
+    assert "stage_totals" in sp and "recent_digests" in sp
+    assert fc["enabled"] is True
+    assert fc["depths"]["explain_ring"] >= 1
+    assert isinstance(fc["records"], list)
+
+
+def test_incident_bundle_carries_observatory_sections(
+    armed, pk_pool, sig_pool, tmp_path, monkeypatch
+):
+    """The fleet incident bundle includes both new sections —
+    state_profile enabled payload + forensics."""
+    from lighthouse_tpu.fleet.incident import IncidentManager
+
+    chain = _boot_chain(pk_pool, n=64, epoch=1, seed=6)
+    _advance(chain, sig_pool, 1)
+    armed.registry.record_stage("altair", "slashings", 64, 0.001)
+    mgr = IncidentManager(directory=str(tmp_path / "incidents"))
+    mgr.chain = chain
+    incident_id = mgr.capture("test", detail="observatory sections")
+    sections = mgr.get(incident_id)["sections"]
+    assert sections["state_profile"]["enabled"] is True
+    assert any(row["stage"] == "slashings"
+               for row in sections["state_profile"]["rows"])
+    assert sections["state_profile"]["recent_digests"] == []
+    assert sections["forkchoice_forensics"]["enabled"] is True
+    assert sections["forkchoice_forensics"]["depths"]["explain_ring"] >= 1
+    assert sections["forkchoice_forensics"]["records"]

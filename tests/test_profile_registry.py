@@ -253,3 +253,71 @@ def test_report_tool_errors_on_missing_empty_and_malformed(tmp_path):
     res = _run_report("--path", str(garbage))
     assert res.returncode == 1
     assert "error" in res.stderr
+
+
+def test_report_tool_errors_on_zero_launch_rows(tmp_path):
+    """A registry holding only zero-launch keys (cost/pad rows that
+    never saw a launch) is as vacuous as an empty one — the CI contract
+    fails it instead of rendering an all-zero table."""
+    p = tmp_path / "zero.json"
+    p.write_text(json.dumps({"schema": 1, "rows": [{
+        "kernel": "k", "shape": "32x2", "topology": "d1",
+        "launches": 0, "total_ms": 0.0,
+    }]}))
+    res = _run_report("--path", str(p), "--json")
+    assert res.returncode == 1
+    assert "no recorded launches" in json.loads(res.stdout)["error"]
+
+
+def test_report_tool_state_mode(tmp_path):
+    """--state runs the same summarize/exit contract over the
+    state-transition observatory registry."""
+    from lighthouse_tpu.observability import stage_profile
+
+    p = str(tmp_path / "state_profile.json")
+    reg = stage_profile.StageProfileRegistry(p)
+    reg.record_stage("altair", "rewards_penalties", 64, 0.004, ops=64)
+    reg.record_stage("altair", "ssz_hashing", 64, 0.001)
+    assert reg.save(force=True)
+    res = _run_report("--state", "--path", p, "--json")
+    assert res.returncode == 0, res.stderr
+    out = json.loads(res.stdout)
+    assert out["total_calls"] == 2
+    assert out["top_sinks"][0]["stage"] == "rewards_penalties"
+    assert out["stages"]["ssz_hashing"]["calls"] == 1
+    # human table renders too
+    res = _run_report("--state", "--path", p)
+    assert res.returncode == 0
+    assert "rewards_penalties" in res.stdout
+    assert "wall-time sinks" in res.stdout
+
+
+def test_report_tool_state_mode_error_contract(tmp_path):
+    # missing file
+    res = _run_report("--state", "--path", str(tmp_path / "no.json"),
+                      "--json")
+    assert res.returncode == 1
+    assert "error" in json.loads(res.stdout)
+    # empty registry
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"schema": 1, "rows": []}))
+    res = _run_report("--state", "--path", str(empty), "--json")
+    assert res.returncode == 1
+    assert "no stages recorded" in json.loads(res.stdout)["error"]
+    # rows but zero calls
+    zero = tmp_path / "zero.json"
+    zero.write_text(json.dumps({"schema": 1, "rows": [{
+        "fork": "altair", "stage": "slashings", "vbucket": "<=256",
+        "calls": 0, "total_ms": 0.0,
+    }]}))
+    res = _run_report("--state", "--path", str(zero), "--json")
+    assert res.returncode == 1
+    assert "no recorded calls" in json.loads(res.stdout)["error"]
+    # malformed row (kernel-profile shape fed to --state)
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"schema": 1, "rows": [{
+        "kernel": "k", "shape": "s", "topology": "d1",
+        "launches": 3, "total_ms": 1.0,
+    }]}))
+    res = _run_report("--state", "--path", str(bad), "--json")
+    assert res.returncode == 1

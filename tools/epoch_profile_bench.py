@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Epoch-stage profile baseline (the ROADMAP "epoch processing on
+device" BEFORE row).
+
+Builds an N-validator state (the config5 epoch-replay shape), replays
+one epoch of slots twice through `phase0.process_slots`:
+
+  1. profiler DISABLED (LTPU_STATE_PROFILE unset) — the production
+     wall time the <2% no-overhead acceptance gate diffs against;
+  2. profiler ARMED into a fresh in-memory `StageProfileRegistry` —
+     per-stage wall attribution plus the epoch-boundary state-diff
+     digest records.
+
+Reports the per-stage table (`stage_totals`), the stage-sum totality
+ratio (stages excluding the `epoch_total` parent row vs the measured
+replay wall — the within-15% acceptance gate), the armed-vs-plain
+overhead, and the digest-ring summary.  bench.py's
+`config_epoch_profile` lane runs this in a CPU-pinned subprocess and
+merges the result into BENCH_SCALE.json under `epoch_profile`.
+
+Usage:
+    python tools/epoch_profile_bench.py [--validators 65536]
+        [--fork altair] [--epochs 1] [--json out.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_state(args, spec):
+    from lighthouse_tpu.ssz import hash_tree_root
+    from lighthouse_tpu.testing import scale
+
+    pubkey_pool = scale.make_pubkey_pool(args.pubkey_pool)
+    state = scale.make_scaled_state(
+        args.validators, spec, epoch=args.epoch, seed=args.seed,
+        pubkey_pool=pubkey_pool, fork=args.fork,
+    )
+    hash_tree_root(state)   # prime the incremental hasher (config5 idiom)
+    return state
+
+
+def _replay(state, spec, n_slots):
+    from lighthouse_tpu.ssz import hash_tree_root
+    from lighthouse_tpu.state_processing import phase0
+
+    work = state.copy()
+    hash_tree_root(work)    # the copy primes its own hasher
+    t0 = time.perf_counter()
+    work = phase0.process_slots(
+        work, int(work.slot) + n_slots, spec.preset, spec=spec
+    )
+    hash_tree_root(work)
+    return (time.perf_counter() - t0) * 1e3
+
+
+def run(args):
+    from lighthouse_tpu.observability import stage_profile, state_diff
+    from lighthouse_tpu.types import ChainSpec, MainnetPreset
+
+    spec = ChainSpec(
+        preset=MainnetPreset,
+        altair_fork_epoch=0 if args.fork == "altair" else None,
+    )
+    n_slots = args.epochs * spec.preset.slots_per_epoch + 1
+
+    t0 = time.monotonic()
+    state = _build_state(args, spec)
+    build_seconds = time.monotonic() - t0
+
+    # 1. plain replay — the production (unset-env) wall time
+    os.environ.pop("LTPU_STATE_PROFILE", None)
+    stage_profile.reset()
+    assert not stage_profile.enabled()
+    wall_plain_ms = _replay(state, spec, n_slots)
+
+    # 2. armed replay — fresh in-memory registry + digest ring
+    os.environ["LTPU_STATE_PROFILE"] = "1"
+    stage_profile.reset()
+    registry = stage_profile.StageProfileRegistry()
+    stage_profile.set_registry(registry)
+    recorder = state_diff.DiffRecorder()
+    state_diff.set_recorder(recorder)
+    wall_profiled_ms = _replay(state, spec, n_slots)
+    os.environ.pop("LTPU_STATE_PROFILE", None)
+    stage_profile.reset()
+
+    totals = registry.stage_totals()
+    stage_sum_ms = round(sum(
+        t["total_ms"] for name, t in totals.items() if name != "epoch_total"
+    ), 3)
+    epoch_total_ms = (totals.get("epoch_total") or {}).get("total_ms", 0.0)
+    digests = recorder.recent()
+    return {
+        "n_validators": args.validators,
+        "fork": args.fork,
+        "epochs": args.epochs,
+        "slots_replayed": n_slots,
+        "platform": os.environ.get("JAX_PLATFORMS", ""),
+        "build_seconds": round(build_seconds, 2),
+        "replay_wall_ms_plain": round(wall_plain_ms, 3),
+        "replay_wall_ms_profiled": round(wall_profiled_ms, 3),
+        "profiler_overhead_pct": round(
+            (wall_profiled_ms - wall_plain_ms) / wall_plain_ms * 100.0, 2
+        ),
+        "stage_sum_ms": stage_sum_ms,
+        "epoch_total_ms": epoch_total_ms,
+        # the totality gate: instrumented stages (excl. the epoch_total
+        # parent row) must account for ~the whole measured replay wall
+        "stage_sum_over_wall": round(stage_sum_ms / wall_profiled_ms, 4),
+        "stages": totals,
+        "registry_keys": registry.key_count(),
+        "digests": {
+            "records": len(digests),
+            "ring_depth": recorder.depth(),
+            "last": digests[0] if digests else None,
+        },
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--validators", type=int, default=65536)
+    ap.add_argument("--fork", choices=("phase0", "altair"), default="altair")
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--epoch", type=int, default=4,
+                    help="state epoch the registry is built at")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--pubkey-pool", type=int, default=64)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args(argv)
+    out = run(args)
+    line = json.dumps(out)
+    print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

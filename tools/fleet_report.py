@@ -47,8 +47,11 @@ def report_from_url(base):
     fleet = _fetch(base, "/lighthouse/fleet")
     slo = _fetch(base, "/lighthouse/slo")
     incidents = _fetch(base, "/lighthouse/incidents")
+    state_profile = _fetch(base, "/lighthouse/state-profile")
+    forkchoice = _fetch(base, "/lighthouse/forkchoice")
     return {"mode": "url", "url": base, "fleet": fleet, "slo": slo,
-            "incidents": incidents}
+            "incidents": incidents, "state_profile": state_profile,
+            "forkchoice_forensics": forkchoice}
 
 
 def report_from_bundle(path):
@@ -76,6 +79,9 @@ def report_from_bundle(path):
         "coalesced": bundle.get("coalesced", []),
         "sections": sections,
         "slo": (bundle.get("sections") or {}).get("slo"),
+        "state_profile": (bundle.get("sections") or {}).get("state_profile"),
+        "forkchoice_forensics":
+            (bundle.get("sections") or {}).get("forkchoice_forensics"),
     }
 
 
@@ -87,6 +93,35 @@ def _breached(report):
         if isinstance(st, dict) and st.get("state") == "breach":
             return True
     return False
+
+
+def _render_observatory(report, w):
+    """The state-transition observatory sections, shared by both modes."""
+    sp = report.get("state_profile")
+    if isinstance(sp, dict) and "error" not in sp:
+        if not sp.get("enabled", False):
+            w("  state profile: disabled (LTPU_STATE_PROFILE unset)\n")
+        else:
+            totals = sp.get("stage_totals") or {}
+            digests = sp.get("recent_digests") or []
+            w(f"  state profile: {len(sp.get('rows') or [])} key(s), "
+              f"{len(digests)} recent digest(s)\n")
+            for stage, t in sorted(
+                totals.items(), key=lambda kv: -kv[1].get("total_ms", 0)
+            )[:6]:
+                w(f"    {stage:<28} {t.get('total_ms', 0):>10.3f} ms "
+                  f"over {t.get('calls', 0)} call(s)\n")
+    fc = report.get("forkchoice_forensics")
+    if isinstance(fc, dict) and "error" not in fc:
+        records = fc.get("records") or []
+        depths = fc.get("depths") or {}
+        w(f"  forkchoice forensics: {len(records)} head change(s), "
+          f"{depths.get('explain_ring', 0)} explain(s) in ring\n")
+        for r in records[:3]:
+            w(f"    {r.get('kind'):<8} {str(r.get('old_head'))[:10]} -> "
+              f"{str(r.get('new_head'))[:10]} depth={r.get('old_depth')} "
+              f"swing={r.get('swing_weight')} "
+              f"att_batches={r.get('att_batches_since_last_head')}\n")
 
 
 def render(report, out=sys.stdout):
@@ -130,6 +165,7 @@ def render(report, out=sys.stdout):
             w(f"    {b.get('id')} cause={b.get('cause')} "
               f"detail={b.get('detail')} "
               f"coalesced={b.get('coalesced', 0)}\n")
+        _render_observatory(report, w)
     else:
         w(f"incident bundle — {report['path']}\n")
         w(f"  id {report.get('id')} schema {report.get('schema')}\n")
@@ -139,6 +175,7 @@ def render(report, out=sys.stdout):
               f"detail={c.get('detail')}\n")
         for name, summary in sorted(report.get("sections", {}).items()):
             w(f"    {name:<22} {summary}\n")
+        _render_observatory(report, w)
     if _breached(report):
         w("BREACH\n")
 

@@ -20,10 +20,17 @@ Exit status:
       this is the machine contract CI scripts key off, so an empty
       profile is an error, not a vacuous success
 
+With --state the same contract runs over the state-transition
+observatory registry (observability/stage_profile.py, persisted as
+state_profile.json beside the kernel profile): per-(fork, stage,
+validator-bucket) rows, the aggregated per-stage totals, and the same
+exit-1-on-empty machine contract.
+
 Usage:
   python tools/profile_report.py                    # default registry
   python tools/profile_report.py --path p.json --top 10
   python tools/profile_report.py --json             # machine-readable
+  python tools/profile_report.py --state            # epoch-stage profile
 """
 
 import argparse
@@ -55,7 +62,83 @@ def _load_rows(path):
             return None, f"malformed kernel profile: bad row {i}"
     if not rows:
         return None, "kernel profile is empty (no launches recorded)"
+    if not any(row.get("launches") for row in rows):
+        # a registry of only zero-launch keys is as vacuous as an empty
+        # one — the CI contract must fail it, not render an all-zero table
+        return None, "kernel profile has rows but no recorded launches"
     return rows, None
+
+
+def _load_state_rows(path):
+    """(rows, error) from a state-profile registry file — the
+    observability/stage_profile.py schema ((fork, stage, vbucket) keys,
+    'calls' instead of 'launches')."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return None, f"no state profile at {path}"
+    except (OSError, ValueError) as e:
+        return None, f"unreadable state profile {path}: {e}"
+    if not isinstance(data, dict):
+        return None, "malformed state profile: top level is not an object"
+    rows = data.get("rows")
+    if not isinstance(rows, list):
+        return None, "malformed state profile: missing 'rows' list"
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict) or not {
+            "fork", "stage", "vbucket", "calls", "total_ms",
+        } <= set(row):
+            return None, f"malformed state profile: bad row {i}"
+    if not rows:
+        return None, "state profile is empty (no stages recorded)"
+    if not any(row.get("calls") for row in rows):
+        return None, "state profile has rows but no recorded calls"
+    return rows, None
+
+
+def summarize_state(rows, top=5):
+    rows = sorted(rows, key=lambda r: -r["total_ms"])
+    stages = {}
+    for r in rows:
+        s = stages.setdefault(r["stage"],
+                              {"total_ms": 0.0, "calls": 0, "ops": 0})
+        s["total_ms"] = round(s["total_ms"] + r["total_ms"], 4)
+        s["calls"] += r["calls"]
+        s["ops"] += r.get("ops", 0)
+    return {
+        "rows": rows,
+        "stages": stages,
+        "top_sinks": [
+            {"fork": r["fork"], "stage": r["stage"],
+             "vbucket": r["vbucket"], "total_ms": r["total_ms"],
+             "calls": r["calls"]}
+            for r in rows[:top]
+        ],
+        "total_wall_ms": round(sum(r["total_ms"] for r in rows), 3),
+        "total_calls": sum(r["calls"] for r in rows),
+    }
+
+
+def print_state_table(summary):
+    hdr = (f"{'fork':<10} {'stage':<28} {'vbucket':<8} "
+           f"{'calls':>7} {'ewma_ms':>9} {'mean_ms':>9} {'total_ms':>10}")
+    print(hdr)
+    print("-" * len(hdr))
+    for r in summary["rows"]:
+        mean = (r["total_ms"] / r["calls"]) if r["calls"] else None
+        print(
+            f"{r['fork']:<10} {r['stage']:<28} {r['vbucket']:<8} "
+            f"{r['calls']:>7} {_fmt(r.get('ewma_ms'), 4):>9} "
+            f"{_fmt(mean, 4):>9} {r['total_ms']:>10.3f}"
+        )
+    print()
+    print(f"top {len(summary['top_sinks'])} wall-time sinks:")
+    for i, s in enumerate(summary["top_sinks"], 1):
+        print(f"  {i}. {s['fork']}/{s['stage']} [{s['vbucket']}] "
+              f"{s['total_ms']:.3f} ms over {s['calls']} calls")
+    print(f"total: {summary['total_wall_ms']:.1f} ms across "
+          f"{summary['total_calls']} stage calls")
 
 
 def _gflops(row):
@@ -131,25 +214,40 @@ def main(argv=None):
                     help="top-N wall-time sinks to highlight")
     ap.add_argument("--json", action="store_true",
                     help="emit machine-readable summary JSON")
+    ap.add_argument("--state", action="store_true",
+                    help="report over the state-transition observatory "
+                         "registry (state_profile.json) instead of the "
+                         "kernel profile")
     args = ap.parse_args(argv)
 
     path = args.path
     if path is None:
-        from lighthouse_tpu.crypto.tpu.profile import _default_path
+        if args.state:
+            from lighthouse_tpu.observability.stage_profile import (
+                _default_path,
+            )
+        else:
+            from lighthouse_tpu.crypto.tpu.profile import _default_path
 
         path = _default_path()
-    rows, err = _load_rows(path)
+    rows, err = (_load_state_rows if args.state else _load_rows)(path)
     if rows is None:
         if args.json:
             print(json.dumps({"error": err}))
         else:
             print(f"error: {err}", file=sys.stderr)
         return 1
-    summary = summarize(rows, top=args.top)
+    if args.state:
+        summary = summarize_state(rows, top=args.top)
+    else:
+        summary = summarize(rows, top=args.top)
     if args.json:
         print(json.dumps(summary, indent=1, sort_keys=True))
     else:
-        print_table(summary)
+        if args.state:
+            print_state_table(summary)
+        else:
+            print_table(summary)
     return 0
 
 
