@@ -111,6 +111,7 @@ _SOAK_SUMMARY = None   # multi-epoch adversarial soak gates (ISSUE 13)
 _OVERLAY_SUMMARY = None   # aggregation overlay tree-vs-flat (ISSUE 15)
 _SERVE_SUMMARY = None     # light-client serving tier swarm (ISSUE 16)
 _WIRE_SCALE_SUMMARY = None   # wire connection-scaling baseline (ISSUE 17)
+_FLEET_SUMMARY = None     # fleet-sharded coordinator/worker sweep (ISSUE 20)
 
 
 def _load_prior_primary():
@@ -188,6 +189,22 @@ def _serve_exit_code():
     if _SERVE_SUMMARY is None or _SERVE_SUMMARY.get("gates_passed", True):
         return 0
     note("serve_regression", failed_gates=_SERVE_SUMMARY.get("failed_gates"))
+    return 1
+
+
+def _fleet_exit_code():
+    """The fleet-shard lane's hard gates: zero lost verdicts at every K
+    (including the mid-batch worker-kill failover leg) and the
+    post-epoch head state root byte-identical to the single-process
+    control at every K.  A run where sharding changed chain semantics
+    or dropped a verdict must not ship green on throughput alone (same
+    bypass env as the other guards)."""
+    if os.environ.get("BENCH_NO_REGRESSION_GUARD"):
+        return 0
+    if _FLEET_SUMMARY is None or _FLEET_SUMMARY.get("gates_passed", True):
+        return 0
+    note("fleet_shard_regression",
+         failed_gates=_FLEET_SUMMARY.get("failed_gates"))
     return 1
 
 
@@ -1217,6 +1234,70 @@ def config_epoch_profile(json_path=None):
         pass
 
 
+def config_fleet_shard(json_path=None):
+    """Fleet-sharding lane: tools/fleet_shard_bench.py in a CPU-pinned
+    subprocess — a K=1,2,4 sweep of the coordinator + committee-bucket
+    worker fleet over real wire sockets, measuring batched sets/s and
+    epoch-replay wall per K against a single-process control, plus one
+    mid-batch worker-kill failover leg recording the re-home latency.
+    Merges a `fleet_shard` key into BENCH_SCALE.json; a lost verdict or
+    a diverged head root at any K fails the run via
+    _fleet_exit_code."""
+    global _FLEET_SUMMARY
+    import subprocess
+
+    est = 150.0
+    if not _fits(est, "fleet_shard"):
+        return
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "fleet_shard_bench.py"),
+           "--ks", os.environ.get("BENCH_FLEET_KS", "1,2,4"),
+           "--validators", os.environ.get("BENCH_FLEET_VALIDATORS", "128")]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                           timeout=max(300.0, 4 * est))
+    except subprocess.TimeoutExpired:
+        note("fleet_shard_error", error="timeout")
+        return
+    try:
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+    except Exception:
+        note("fleet_shard_error", rc=r.returncode, stderr=r.stderr[-300:])
+        return
+    note("fleet_shard", ks=out["ks"], gates=out["gates"],
+         failover=out["failover"],
+         per_k={k: {"sets_per_sec": v["sets_per_sec"],
+                    "epoch_wall_s": v["epoch_wall_s"]}
+                for k, v in out["per_k"].items()})
+    _FLEET_SUMMARY = {
+        "ks": out["ks"],
+        "per_k": out["per_k"],
+        "failover": out["failover"],
+        "gates_passed": out["gates_passed"],
+    }
+    if not out["gates_passed"]:
+        _FLEET_SUMMARY["failed_gates"] = [
+            k for k, v in out["gates"].items() if not v
+        ]
+    # merge beside the other scaling rows (epoch_profile pattern): the
+    # recorded K-sweep the ROADMAP fleet item's numbers point at
+    scale_path = json_path or "BENCH_SCALE.json"
+    try:
+        with open(scale_path) as f:
+            scale_doc = json.load(f)
+    except (OSError, ValueError):
+        scale_doc = {}
+    scale_doc["fleet_shard"] = out
+    try:
+        with open(scale_path, "w") as f:
+            json.dump(scale_doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+    except OSError:
+        pass
+
+
 def config_kernels():
     """mont_mul candidate shoot-out: f32-HIGHEST GEMM vs int32 einsum vs
     the fused Pallas kernel, one jit each on a wide batch — a single
@@ -1571,14 +1652,14 @@ def main():
         (config_device_retry, config_gossip_latency, config_native_shapes,
          config5, config_aggregation, config_soak, config_overlay,
          config_serve, config_wire_scale, config_epoch_profile,
-         config_mesh, run_device_smoke_and_curve,
+         config_fleet_shard, config_mesh, run_device_smoke_and_curve,
          config_kernels, config1, config4, config_compile_cache)
         if _DEVICE_ALIVE else
         (config_gossip_latency, config_native_shapes, config5,
          config_aggregation, config_soak, config_overlay, config_serve,
-         config_wire_scale, config_epoch_profile, config_mesh,
-         config_device_retry, run_device_smoke_and_curve, config_kernels,
-         config1, config4, config_compile_cache)
+         config_wire_scale, config_epoch_profile, config_fleet_shard,
+         config_mesh, config_device_retry, run_device_smoke_and_curve,
+         config_kernels, config1, config4, config_compile_cache)
     )
     for fn in stages:
         if _left() < 120:
@@ -1610,12 +1691,14 @@ def main():
                 "note": "no config completed within budget",
             }
         ), flush=True)
-        return _soak_exit_code() or _overlay_exit_code() or _serve_exit_code()
+        return (_soak_exit_code() or _overlay_exit_code()
+                or _serve_exit_code() or _fleet_exit_code())
     _emit_primary(primary, final=True)
     return _regression_exit_code(
         _PRIMARY if _PRIMARY is not None else primary,
         _PRIMARY_PLATFORM or jax.devices()[0].platform,
-    ) or _soak_exit_code() or _overlay_exit_code() or _serve_exit_code()
+    ) or _soak_exit_code() or _overlay_exit_code() or _serve_exit_code() \
+        or _fleet_exit_code()
 
 
 if __name__ == "__main__":

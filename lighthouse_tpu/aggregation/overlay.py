@@ -154,10 +154,18 @@ class AggregationOverlay:
                  fanout=None, push_timeout=None, audit_rate=None,
                  breaker_threshold=None, breaker_cooldown=None,
                  quarantine_cooldown=None, ttl=None, seed=None,
-                 clock=time.monotonic):
+                 root_pin=None, clock=time.monotonic):
         self.wire = wire
         self.tier = tier
         self.node_id = wire.peer_id
+        # root pinning (fleet sharding, ISSUE 20): a sharded fleet needs
+        # EVERY committee's partials to settle at the coordinator — its
+        # tier feeds block packing — so the pinned member is forced to
+        # the front of every per-key order (root for all keys) instead
+        # of the load-spreading hash shuffle.  All members must agree on
+        # the pin (fleet construction sets it fleet-wide); None keeps
+        # the classic Wonderboom behavior.
+        self.root_pin = str(root_pin) if root_pin is not None else None
         env = os.environ.get
         self.parents_n = max(1, int(
             parents if parents is not None else env("LTPU_OVERLAY_PARENTS", "2")
@@ -234,11 +242,18 @@ class AggregationOverlay:
 
     def _order(self, key):
         """Members ordered for `key`: sha256(id || key) — deterministic
-        across nodes, different per committee so root load spreads."""
+        across nodes, different per committee so root load spreads.
+        A pinned root (fleet mode) is moved to the front for every key;
+        the rest keep their hash order."""
         members = self.members    # atomic ref read (list replaced whole)
-        return sorted(
+        ordered = sorted(
             members, key=lambda m: hashlib.sha256(m.encode() + key).digest()
         )
+        pin = self.root_pin
+        if pin is not None and pin in ordered and ordered[0] != pin:
+            ordered.remove(pin)
+            ordered.insert(0, pin)
+        return ordered
 
     def parent_candidates(self, key):
         """Full parent preference list for this node under `key`: the

@@ -939,6 +939,20 @@ class _Handler(JsonHandler):
                 "ring": fleet.incidents.ring,
                 "bundles": fleet.incidents.list(),
             }})
+        if path == "/lighthouse/shard":
+            # fleet-sharded processing: the node's shard role object —
+            # a coordinator answers with the full assignment/failover
+            # snapshot, a worker with its adopted slice (honest
+            # {"enabled": false} shell on an unsharded node)
+            shard = getattr(chain, "shard", None)
+            if shard is None:
+                return self._json({"data": {"enabled": False}})
+            if hasattr(shard, "rehomes"):          # coordinator
+                data = shard.snapshot()
+            else:                                  # worker
+                data = shard.status()
+            data["enabled"] = True
+            return self._json({"data": data})
         m = re.fullmatch(r"/lighthouse/incidents/([A-Za-z0-9_.-]+)", path)
         if m:
             fleet = getattr(chain, "fleet", None)

@@ -174,6 +174,7 @@ class BeaconChain:
         self.builder = None               # attached via attach_builder()
         self.serve_tier = None            # attached via attach_serve_tier()
         self.fleet = None                 # attached via attach_fleet()
+        self.shard = None                 # attached via attach_shard()
         self.proposer_preparations = {}   # validator index -> fee recipient
         self._advanced_head = None   # (head_root, slot, state) pre-advance
 
@@ -1407,6 +1408,19 @@ class BeaconChain:
         self.fleet = fleet
         return fleet
 
+    def attach_shard(self, shard):
+        """Enroll the fleet-shard role object (coordinator or worker,
+        lighthouse_tpu/fleet/shard): persist() records the assignment
+        generation so a restarted coordinator resumes at a generation
+        no older than the fleet has seen — a re-join after restart
+        always bumps PAST every assignment shipped before the crash."""
+        self.shard = shard
+        pending = getattr(self, "_pending_shard_generation", None)
+        if pending is not None and hasattr(shard, "resume_generation"):
+            shard.resume_generation(int(pending))
+        self._pending_shard_generation = None
+        return shard
+
     def persist(self):
         """PersistedBeaconChain + PersistedForkChoice + PersistedOperationPool
         (beacon_chain/src/persisted_*.rs, operation_pool/persistence.rs):
@@ -1465,6 +1479,13 @@ class BeaconChain:
             "votes": votes,
         }
         self.store.put_meta("persisted_chain", payload)
+        shard = getattr(self, "shard", None)
+        if shard is not None and hasattr(shard, "generation"):
+            # assignment generation survives a coordinator restart so
+            # the re-joined fleet bumps past every pre-crash assignment
+            self.store.put_meta(
+                "persisted_shard", {"generation": int(shard.generation)}
+            )
         if hasattr(self.store.kv, "flush"):
             self.store.kv.flush()
         return True
@@ -1535,6 +1556,9 @@ class BeaconChain:
             # the overlay (if any) is attached later by the builder —
             # its pending partials wait on the chain until then
             chain._pending_overlay_partials = pool.get("overlay_partials")
+        shard_meta = store.get_meta("persisted_shard")
+        if shard_meta is not None:
+            chain._pending_shard_generation = shard_meta.get("generation")
         return chain
 
     def on_invalid_execution_payload(self, block_root):

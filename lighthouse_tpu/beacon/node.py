@@ -621,6 +621,36 @@ class ClientBuilder:
             from ..fleet import FleetPlane
 
             chain.attach_fleet(FleetPlane(chain=chain, wire=wire))
+        if wire is not None:
+            # fleet-sharded processing (fleet/shard): LTPU_SHARD_ROLE
+            # picks the role; a coordinator reads LTPU_SHARD_WORKERS
+            # ('name=host:port,...') and fans verify batches out over
+            # the slices, a worker serves its slice and heartbeats back
+            from ..fleet.shard import role_from_env, workers_from_env
+
+            shard_role = role_from_env()
+            if shard_role == "worker":
+                from ..fleet import ShardWorker
+
+                shard = ShardWorker(
+                    wire.peer_id, wire=wire, service=verify_service,
+                )
+                chain.attach_shard(shard)
+                shard.beat_forever()
+            elif shard_role == "coordinator":
+                from ..fleet import ShardCoordinator
+
+                plane = getattr(chain, "fleet", None)
+                shard = ShardCoordinator(
+                    wire, workers_from_env(),
+                    audit_verifier=SignatureVerifier("native"),
+                    telemetry=plane.telemetry if plane else None,
+                    incidents=plane.incidents if plane else None,
+                )
+                chain.attach_shard(shard)
+                # the coordinator IS this node's remote pool: the
+                # service's remote tier routes by bucket ownership
+                verify_service.attach_remote(shard)
         discovery = None
         if self._disc_boot is not None and wire is not None:
             import secrets

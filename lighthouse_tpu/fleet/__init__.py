@@ -28,7 +28,27 @@ from .slo import SloEngine, default_specs
 from .telemetry import TelemetryHub
 
 __all__ = ["FleetPlane", "TelemetryHub", "SloEngine", "IncidentManager",
-           "default_specs"]
+           "default_specs", "ShardCoordinator", "ShardWorker",
+           "compute_assignment", "shard_bucket"]
+
+
+def __getattr__(name):
+    # fleet-shard classes import wire/verify_service machinery; resolve
+    # lazily so `import lighthouse_tpu.fleet` stays light for nodes
+    # that never shard
+    if name in ("ShardCoordinator",):
+        from .coordinator import ShardCoordinator
+
+        return ShardCoordinator
+    if name in ("ShardWorker",):
+        from .worker import ShardWorker
+
+        return ShardWorker
+    if name in ("compute_assignment", "shard_bucket"):
+        from . import shard
+
+        return getattr(shard, name)
+    raise AttributeError(name)
 
 log = logging.getLogger("lighthouse_tpu.fleet")
 

@@ -77,6 +77,52 @@ FLEET_INCIDENT_RING = metrics.gauge(
     "fleet_incident_ring",
     "Incident bundles currently retained in the bounded on-disk ring",
 )
+FLEET_DIGESTS_REFUSED = metrics.counter(
+    "fleet_digests_refused_total",
+    "TELEM_PUSH digests discarded at the hub gate (quarantined sender "
+    "or stale shard generation) — refused, never merged into the fleet "
+    "table",
+)
+
+# -------------------------------------------------------- fleet sharding
+
+FLEET_SHARD_FRAMES = metrics.counter(
+    "fleet_shard_frames_total",
+    "SHARD_ASSIGN/SHARD_STATUS control frames, by direction and result "
+    "(ok / invalid / refused)",
+    labels=("direction", "result"),
+)
+SHARD_GENERATION = metrics.gauge(
+    "fleet_shard_generation",
+    "The coordinator's current assignment generation (bumped on every "
+    "quarantine re-home and worker re-join)",
+)
+SHARD_WORKERS_LIVE = metrics.gauge(
+    "fleet_shard_workers_live",
+    "Workers currently admitted and holding a committee-bucket slice",
+)
+SHARD_DISPATCHES = metrics.counter(
+    "fleet_shard_dispatches_total",
+    "Coordinator -> worker verify dispatches, by outcome (ok / failed / "
+    "redispatched / local)",
+    labels=("outcome",),
+)
+SHARD_QUARANTINES = metrics.counter(
+    "fleet_shard_quarantines_total",
+    "Worker quarantines, by cause (missed_heartbeat / rpc_failure / "
+    "audit)",
+    labels=("cause",),
+)
+SHARD_REHOMES = metrics.counter(
+    "fleet_shard_rehomes_total",
+    "Committee-bucket re-assignments to survivors after a worker "
+    "quarantine or re-join (one per generation bump)",
+)
+SHARD_PENDING = metrics.gauge(
+    "fleet_shard_pending",
+    "Batches in the coordinator's pending table (in flight to workers; "
+    "re-dispatched from here on worker death, so none are lost)",
+)
 
 # ----------------------------------------------------------- SLO engine
 

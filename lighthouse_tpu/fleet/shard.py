@@ -1,0 +1,121 @@
+"""Fleet sharding: deterministic committee-bucket assignment (ISSUE 20).
+
+One logical beacon node splits into a coordinator process (fork choice +
+head import) and K worker processes, each owning a slice of committee
+space.  The slice is expressed in BUCKETS: signature work is routed by
+`sha256(signing message) mod N_SHARD_BUCKETS` — for attestations the
+message is the AttestationData signing root, so one (slot, committee)
+always lands in one bucket — and the bucket space is split contiguously
+among the live workers.
+
+The split itself reuses the Wonderboom overlay's rule: workers are
+ordered by `sha256(worker_id ‖ generation key)` and the bucket space is
+cut into contiguous runs in that order.  Same inputs -> same mapping on
+every node, no negotiation; a generation bump (worker death or re-join)
+re-cuts deterministically over the survivors.
+
+This module is pure math + env-knob parsing; the processes live in
+worker.py / coordinator.py.
+"""
+
+import hashlib
+import os
+import struct
+
+N_SHARD_BUCKETS = 256
+
+
+def shard_bucket(message, n_buckets=N_SHARD_BUCKETS):
+    """The bucket one signing message routes to.  Committee-stable: an
+    attestation's message is the AttestationData signing root, so every
+    signature over one (slot, committee, data) lands in one bucket —
+    the coordinator ships whole buckets, never splits a committee."""
+    h = hashlib.sha256(bytes(message)).digest()
+    return int.from_bytes(h[:4], "little") % int(n_buckets)
+
+
+def assignment_order(worker_ids, generation):
+    """Workers ordered for one generation: sha256(id ‖ generation key)
+    — the overlay's per-key ordering rule, keyed by generation so a
+    re-home reshuffles which survivor inherits which run."""
+    key = b"ltpu-shard" + struct.pack("<Q", int(generation))
+    return sorted(
+        map(str, worker_ids),
+        key=lambda w: hashlib.sha256(w.encode() + key).digest(),
+    )
+
+
+def compute_assignment(worker_ids, generation, n_buckets=N_SHARD_BUCKETS):
+    """worker_id -> list of half-open [start, end) bucket ranges (one
+    contiguous run each; runs differ by at most one bucket in size).
+    Deterministic in (worker set, generation); empty input -> {}."""
+    order = assignment_order(worker_ids, generation)
+    k = len(order)
+    out = {}
+    if k == 0:
+        return out
+    base, extra = divmod(int(n_buckets), k)
+    start = 0
+    for i, wid in enumerate(order):
+        size = base + (1 if i < extra else 0)
+        out[wid] = [(start, start + size)] if size else []
+        start += size
+    return out
+
+
+def ranges_cover(ranges, bucket):
+    return any(s <= bucket < e for s, e in ranges)
+
+
+def owner_of(bucket, assignment):
+    """The worker owning `bucket` under an assignment mapping, or None
+    when no live worker covers it (all quarantined)."""
+    for wid, ranges in assignment.items():
+        if ranges_cover(ranges, bucket):
+            return wid
+    return None
+
+
+def partition_sets(sets, assignment, n_buckets=N_SHARD_BUCKETS):
+    """Split one batch of SignatureSets by owning worker.  Returns
+    (groups, orphans): groups is {worker_id: [set index, ...]} in
+    original order, orphans the indices no live worker covers."""
+    groups, orphans = {}, []
+    for i, s in enumerate(sets):
+        wid = owner_of(shard_bucket(s.message, n_buckets), assignment)
+        if wid is None:
+            orphans.append(i)
+        else:
+            groups.setdefault(wid, []).append(i)
+    return groups, orphans
+
+
+# ------------------------------------------------------------- env knobs
+
+
+def role_from_env(env=None):
+    """LTPU_SHARD_ROLE: '' (off), 'coordinator', or 'worker'."""
+    env = os.environ if env is None else env
+    role = (env.get("LTPU_SHARD_ROLE") or "").strip().lower()
+    if role in ("", "0", "off", "none"):
+        return None
+    if role not in ("coordinator", "worker"):
+        raise ValueError(f"unknown LTPU_SHARD_ROLE {role!r}")
+    return role
+
+
+def workers_from_env(env=None):
+    """LTPU_SHARD_WORKERS: comma-separated worker endpoints, each
+    either 'name=host:port' or bare 'host:port' (the address doubles as
+    the worker id).  Returns [(worker_id, address), ...]."""
+    env = os.environ if env is None else env
+    raw = (env.get("LTPU_SHARD_WORKERS") or "").strip()
+    out = []
+    for item in filter(None, (p.strip() for p in raw.split(","))):
+        name, sep, addr = item.partition("=")
+        if not sep:
+            name, addr = item, item
+        if ":" not in addr:
+            raise ValueError(f"bad LTPU_SHARD_WORKERS entry {item!r}")
+        out.append((name, addr))
+    return out

@@ -30,7 +30,8 @@ FRAME_NAMES = {
     5: "request", 6: "response", 7: "goodbye", 8: "ping", 9: "pong",
     10: "peers", 11: "graft", 12: "prune", 13: "ihave", 14: "iwant",
     15: "verify_req", 16: "verify_resp", 17: "agg_push", 18: "agg_ack",
-    19: "telem_push", 20: "telem_ack",
+    19: "telem_push", 20: "telem_ack", 21: "shard_assign",
+    22: "shard_status",
 }
 
 DISPATCH_RING = 512          # recent dispatch latencies kept per peer
@@ -105,11 +106,19 @@ class TelemetryHub:
         self._lock = locks.lock("fleet.telemetry")
         self._conns = {}             # peer_id -> ConnStats
         self._digests = {}           # peer_id -> (digest dict, mono ts)
+        # digest gates (ISSUE 20 satellite): a quarantined peer's
+        # digests are DISCARDED (blocked=True), and a peer behind a
+        # shard generation bump must report at least min_generation in
+        # its `shard_generation` key or be refused — a lying or stale
+        # worker cannot keep merging "healthy" rows into the fleet table
+        self._gates = {}             # peer_id -> {"blocked", "min_generation"}
+        self.refused_digests = 0
         self._last_local = None      # the digest we last built/shipped
         self._tp_prev = None         # (mono ts, sets_submitted_total)
         self._tp_ewma = 0.0
         locks.guarded(self, "_conns", self._lock)
         locks.guarded(self, "_digests", self._lock)
+        locks.guarded(self, "_gates", self._lock)
 
     # -------------------------------------------------- wire chokepoint
 
@@ -166,16 +175,78 @@ class TelemetryHub:
     # ------------------------------------------------------ digest side
 
     def record_digest(self, peer_id, digest):
+        """Merge one peer's TELEM_PUSH digest into the fleet table.
+        Returns False (digest discarded, nothing merged) when the peer
+        is gated: quarantined outright, or reporting a stale
+        `shard_generation` after an assignment bump.  The wire answers
+        a refused ack in that case."""
         with self._lock:
+            locks.access(self, "_gates", "read")
+            gate = self._gates.get(peer_id)
+            if gate is not None:
+                min_gen = gate.get("min_generation")
+                stale = (
+                    min_gen is not None
+                    and float(digest.get("shard_generation", -1.0)) < min_gen
+                )
+                if gate.get("blocked") or stale:
+                    self.refused_digests += 1
+                    refused = self.refused_digests
+                    n = None
+                else:
+                    refused = None
+            else:
+                refused = None
+            if refused is None:
+                locks.access(self, "_digests", "write")
+                self._digests[peer_id] = (dict(digest), self._clock())
+                n = len(self._digests)
+        if refused is not None:
+            M.FLEET_DIGESTS_REFUSED.inc()
+            return False
+        M.FLEET_PEERS.set(n)
+        return True
+
+    def gate_peer(self, peer_id, blocked=False, min_generation=None):
+        """Install (or tighten) one peer's digest gate and drop its
+        already-stored digest — quarantine must remove the peer's
+        self-reported health from the fleet table, not just freeze it."""
+        with self._lock:
+            locks.access(self, "_gates", "write")
+            self._gates[peer_id] = {
+                "blocked": bool(blocked),
+                "min_generation": (
+                    None if min_generation is None else float(min_generation)
+                ),
+            }
             locks.access(self, "_digests", "write")
-            self._digests[peer_id] = (dict(digest), self._clock())
+            self._digests.pop(peer_id, None)
             n = len(self._digests)
         M.FLEET_PEERS.set(n)
+
+    def ungate_peer(self, peer_id):
+        with self._lock:
+            locks.access(self, "_gates", "write")
+            self._gates.pop(peer_id, None)
+
+    def gates(self):
+        with self._lock:
+            locks.access(self, "_gates", "read")
+            return {pid: dict(g) for pid, g in self._gates.items()}
 
     def digest_count(self):
         with self._lock:
             locks.access(self, "_digests", "read")
             return len(self._digests)
+
+    def digest_age(self, peer_id):
+        """Seconds since `peer_id`'s last accepted digest, or None when
+        none is on record (the shard coordinator's missed-heartbeat
+        probe — a gated peer's refused digests never refresh this)."""
+        with self._lock:
+            locks.access(self, "_digests", "read")
+            dg = self._digests.get(peer_id)
+            return None if dg is None else self._clock() - dg[1]
 
     def conn_count(self):
         with self._lock:

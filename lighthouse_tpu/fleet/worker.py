@@ -1,0 +1,221 @@
+"""Fleet-shard worker: one fault-isolated committee-slice process
+(ISSUE 20).
+
+A ShardWorker is the process-granularity analogue of a PR-5 supervised
+thread: a chainless WireNode serving VERIFY_REQ batches for its bucket
+slice through a local VerificationService, enrolled in the fleet via
+the SHARD_ASSIGN/SHARD_STATUS control frames, and heartbeating into the
+coordinator's fleet table over TELEM_PUSH.  Everything it holds that
+the fleet cannot afford to lose rides the persist snapshot (the PR-6
+rule lifted to the worker): generation, adopted ranges, and epoch — a
+restarted worker resumes from the snapshot and re-joins via an
+assignment generation bump, so its stale pre-crash pushes are refused
+by the coordinator's hub gate.
+"""
+
+import threading
+import time
+
+from ..utils import failpoints, locks
+from ..utils.logging import get_logger
+from .shard import N_SHARD_BUCKETS
+
+log = get_logger("fleet_shard")
+
+# worker roles on the wire (mirrors network/wire.py constants; imported
+# lazily there to keep this module import-light)
+ROLE_WORKER = 2
+
+PERSIST_KEY = "shard_worker"
+
+
+class ShardWorker:
+    """One committee worker: wire + verify service + shard membership.
+
+    `persist` is an optional MutableMapping (a plain dict in tests, a
+    store-meta shim in a real node) the adopted assignment is written
+    through on every change; a worker constructed over a non-empty
+    persist resumes from it."""
+
+    def __init__(self, name, backend="fake", wire=None, service=None,
+                 persist=None, target_batch=8, clock=time.monotonic):
+        from ..crypto.backend import SignatureVerifier
+        from ..verify_service import VerificationService
+
+        self.node_id = str(name)
+        self._clock = clock
+        self._lock = locks.lock("fleet.shard_worker")
+        self.service = service or VerificationService(
+            SignatureVerifier(backend), target_batch=target_batch
+        )
+        if wire is None:
+            from ..network.wire import WireNode
+
+            wire = WireNode(
+                None, accept_any_fork=True, peer_id=self.node_id,
+                verify_service=self.service,
+            )
+            self._owns_wire = True
+        else:
+            self._owns_wire = False
+        self.wire = wire
+        self.wire.shard = self
+        self.generation = 0
+        self.ranges = []            # half-open [start, end) buckets
+        self.epoch = 0
+        self.assigns = 0
+        self.refused_assigns = 0
+        self.beats = 0
+        self.coordinator_peer = None    # learned from the first assign
+        self.persist = persist
+        locks.guarded(self, "ranges", self._lock)
+        if persist:
+            snap = persist.get(PERSIST_KEY)
+            if snap:
+                self.restore(snap)
+
+    @property
+    def address(self):
+        return f"127.0.0.1:{self.wire.port}"
+
+    # ------------------------------------------------- shard role object
+
+    def on_assign(self, from_peer, generation, ranges, epoch):
+        """Adopt one assignment (wire reader thread).  A stale
+        generation is REFUSED (returns None -> R_RESOURCE_UNAVAILABLE):
+        after a re-home the coordinator's bumped generation is the only
+        one a worker may hold, and a delayed frame from before the bump
+        must not roll the slice back."""
+        with self._lock:
+            if int(generation) < self.generation:
+                self.refused_assigns += 1
+                return None
+            # whoever assigns is the coordinator — heartbeats go back
+            # there (node-mode beat_forever resolves it lazily)
+            self.coordinator_peer = from_peer
+            locks.access(self, "ranges", "write")
+            self.generation = int(generation)
+            self.ranges = [tuple(r) for r in ranges]
+            self.epoch = int(epoch)
+            self.assigns += 1
+        log.info(
+            "worker %s adopted generation %d (%d range(s), epoch %d)",
+            self.node_id, generation, len(self.ranges), epoch,
+        )
+        self._persist()
+        return self.status()
+
+    def status(self):
+        with self._lock:
+            locks.access(self, "ranges", "read")
+            served = 0
+            try:
+                served = int(self.service.stats().get("sets", 0))
+            except Exception:  # noqa: BLE001 — status is best-effort
+                pass
+            return {
+                "role": ROLE_WORKER,
+                "generation": self.generation,
+                "ranges": list(self.ranges),
+                "served": served,
+                "refused": self.refused_assigns,
+                "pending": int(getattr(self.service, "_queued_sets", 0)),
+            }
+
+    # ---------------------------------------------------------- liveness
+
+    def beat(self, coordinator_peer_id, timeout=5.0):
+        """Push one heartbeat digest to the coordinator over TELEM_PUSH.
+        The digest carries the shard keys the coordinator's hub gate
+        checks (`shard_generation`) plus coarse health; a wedged worker
+        (the `shard.worker_wedge` delay failpoint) simply stops beating
+        — the coordinator's missed-heartbeat supervision quarantines it.
+        Returns True when the coordinator acked the digest."""
+        # chaos seam: `delay` wedges the heartbeat (missed-heartbeat
+        # quarantine trigger), `error` drops this beat on the floor
+        failpoints.hit("shard.worker_wedge")
+        with self._lock:
+            self.beats += 1
+            digest = {
+                "shard_role": float(ROLE_WORKER),
+                "shard_generation": float(self.generation),
+                "shard_buckets": float(
+                    sum(e - s for s, e in self.ranges)
+                ),
+                "beat_seq": float(self.beats),
+                "verify_queued_sets": float(
+                    getattr(self.service, "_queued_sets", 0)
+                ),
+            }
+        return self.wire.push_telemetry(
+            coordinator_peer_id, digest=digest, timeout=timeout
+        )
+
+    def beat_forever(self, coordinator_peer_id=None, interval_s=1.0):
+        """Background heartbeat thread (node-mode wiring); returns the
+        started thread.  With no explicit target, beats go to the
+        coordinator learned from the latest SHARD_ASSIGN (silent until
+        the worker is enrolled).  Beats best-effort: a refused/failed
+        beat is the coordinator's signal, not the worker's problem."""
+        def loop():
+            while not self._stopped():
+                target = coordinator_peer_id or self.coordinator_peer
+                if target is not None:
+                    try:
+                        self.beat(target)
+                    except Exception:  # noqa: BLE001 — supervision reads silence
+                        pass
+                time.sleep(interval_s)
+
+        t = threading.Thread(
+            target=loop, name=f"shard_beat_{self.node_id}", daemon=True
+        )
+        t.start()
+        return t
+
+    def _stopped(self):
+        return getattr(self.wire, "_stopped", False)
+
+    # ----------------------------------------------------------- persist
+
+    def snapshot(self):
+        """The worker's persist payload: what a restart must resume
+        with.  Verify work is stateless (the coordinator's pending
+        table re-dispatches in-flight batches), so membership state is
+        the whole snapshot."""
+        with self._lock:
+            locks.access(self, "ranges", "read")
+            return {
+                "generation": self.generation,
+                "ranges": [list(r) for r in self.ranges],
+                "epoch": self.epoch,
+            }
+
+    def restore(self, snap):
+        with self._lock:
+            locks.access(self, "ranges", "write")
+            self.generation = int(snap.get("generation", 0))
+            self.ranges = [tuple(r) for r in snap.get("ranges", ())]
+            self.epoch = int(snap.get("epoch", 0))
+
+    def _persist(self):
+        if self.persist is None:
+            return
+        try:
+            self.persist[PERSIST_KEY] = self.snapshot()
+        except Exception:  # noqa: BLE001 — persist is advisory for a worker
+            log.warning("worker %s persist write failed", self.node_id)
+
+    # -------------------------------------------------------------- stop
+
+    def stop(self):
+        """Tear the worker down hard (the SIGKILL stand-in for
+        in-process tests/soak: the wire sockets die mid-whatever)."""
+        if self._owns_wire:
+            self.wire.stop()
+            self.service.stop()
+
+    def buckets_owned(self, n_buckets=N_SHARD_BUCKETS):
+        with self._lock:
+            locks.access(self, "ranges", "read")
+            return sum(e - s for s, e in self.ranges)

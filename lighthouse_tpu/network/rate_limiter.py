@@ -55,6 +55,10 @@ DEFAULT_QUOTAS = {
     # honest rate (~15 s); 60/10 s tolerates reconnect bursts while a
     # digest-spamming peer is refused R_RESOURCE_UNAVAILABLE
     "telem_push": Quota(60, 10.0),
+    # fleet-shard control frames: honest traffic is one assignment per
+    # generation bump plus occasional status queries — 60/10 s rides
+    # out a re-home storm while an assign-spamming peer is refused
+    "shard_assign": Quota(60, 10.0),
 }
 
 

@@ -64,6 +64,8 @@ AGG_PUSH = 17     # aggregation overlay: partial aggregate + bitset upstream
 AGG_ACK = 18      # aggregation overlay: push acknowledgement + stored digest
 TELEM_PUSH = 19   # fleet telemetry: compact health digest (flag-gated)
 TELEM_ACK = 20    # fleet telemetry: digest acknowledgement
+SHARD_ASSIGN = 21  # fleet shard: committee-bucket assignment / status query
+SHARD_STATUS = 22  # fleet shard: role + generation + ranges actually held
 
 # mesh degree bounds (gossipsub D / D_lo / D_hi; service/gossipsub defaults)
 MESH_D = 6
@@ -137,6 +139,21 @@ TELEM_VERSION = 1                 # digest schema version byte
 MAX_TELEM_ENTRIES = 48            # key/value pairs per digest
 MAX_TELEM_KEY = 48                # UTF-8 bytes per metric key
 MAX_TELEM_BODY = 4096             # encoded digest payload bytes
+
+# fleet-shard codec caps (trust contract as above: malformed frames
+# raise typed WireError, are answered R_INVALID_REQUEST, and the
+# connection survives).  SHARD_ASSIGN carries the coordinator's
+# committee-bucket assignment for one worker (or a status query);
+# SHARD_STATUS answers with the role/generation/ranges actually held.
+# Both are only ever SENT inside an enrolled fleet — a legacy peer
+# never sees frame types 21/22 (the TELEM/overlay mixed-fleet contract).
+SHARD_VERSION = 1                 # assignment schema version byte
+MAX_SHARD_RANGES = 64             # half-open [start, end) ranges per frame
+MAX_SHARD_BODY = 1024             # encoded assign/status payload bytes
+SHARD_F_QUERY = 0x01              # status query: answer, do not adopt
+SHARD_ROLE_NONE = 0
+SHARD_ROLE_COORDINATOR = 1
+SHARD_ROLE_WORKER = 2
 
 
 class StatusMessage(Container):
@@ -683,6 +700,155 @@ def decode_telem_push(payload):
     return digest
 
 
+def _check_shard_ranges(ranges, what):
+    """Shared range validation: each half-open [start, end) pair bounded
+    to u16, strictly increasing and non-overlapping — a hostile frame
+    cannot smuggle a double-owned or inverted bucket range past the
+    codec into assignment state."""
+    if len(ranges) > MAX_SHARD_RANGES:
+        raise WireError(
+            f"{len(ranges)} {what} ranges exceed {MAX_SHARD_RANGES}"
+        )
+    prev_end = 0
+    for start, end in ranges:
+        if not 0 <= start < end <= 0xFFFF:
+            raise WireError(f"bad {what} range [{start}, {end})")
+        if start < prev_end:
+            raise WireError(f"overlapping/unsorted {what} range [{start}, {end})")
+        prev_end = end
+
+
+def encode_shard_assign(generation, ranges, epoch=0, query=False):
+    """SHARD_ASSIGN payload: one worker's committee-bucket assignment.
+
+      version:u8 || flags:u8 || generation:u32 || epoch:u32 ||
+      n:u16 || n * (start:u16 || end:u16)
+
+    Ranges are half-open [start, end) shard buckets, sorted and
+    disjoint (the codec enforces it on both sides).  `query` asks the
+    receiver to answer its current status without adopting anything."""
+    ranges = [(int(s), int(e)) for s, e in ranges]
+    _check_shard_ranges(ranges, "assign")
+    if not 0 <= int(generation) <= 0xFFFFFFFF:
+        raise WireError(f"shard generation {generation} outside u32")
+    if not 0 <= int(epoch) <= 0xFFFFFFFF:
+        raise WireError(f"shard epoch {epoch} outside u32")
+    flags = SHARD_F_QUERY if query else 0
+    body = struct.pack(
+        "<BBIIH", SHARD_VERSION, flags, int(generation), int(epoch),
+        len(ranges),
+    ) + b"".join(struct.pack("<HH", s, e) for s, e in ranges)
+    if len(body) > MAX_SHARD_BODY:
+        raise WireError(f"SHARD_ASSIGN payload {len(body)}B exceeds {MAX_SHARD_BODY}")
+    return body
+
+
+def decode_shard_assign(payload):
+    """Inverse of encode_shard_assign under the verify-codec trust
+    contract: caps before allocation, malformed shapes (unknown
+    version/flags, inverted or overlapping ranges, truncation, trailing
+    bytes) raise WireError — answered R_INVALID_REQUEST, the connection
+    survives."""
+    end = len(payload)
+    if end > MAX_SHARD_BODY:
+        raise WireError(f"SHARD_ASSIGN payload {end}B exceeds {MAX_SHARD_BODY}")
+    pos = 0
+
+    def take(k, what):
+        nonlocal pos
+        if pos + k > end:
+            raise WireError(f"truncated SHARD_ASSIGN ({what})")
+        chunk = payload[pos:pos + k]
+        pos += k
+        return chunk
+
+    version, flags, generation, epoch, n = struct.unpack(
+        "<BBIIH", take(12, "header")
+    )
+    if version != SHARD_VERSION:
+        raise WireError(f"unknown SHARD_ASSIGN version {version}")
+    if flags & ~SHARD_F_QUERY:
+        raise WireError(f"unknown SHARD_ASSIGN flags {flags:#x}")
+    if n > MAX_SHARD_RANGES:
+        raise WireError(f"{n} assign ranges exceed {MAX_SHARD_RANGES}")
+    ranges = [
+        struct.unpack("<HH", take(4, "range")) for _ in range(n)
+    ]
+    _check_shard_ranges(ranges, "assign")
+    if pos != end:
+        raise WireError(f"{end - pos} trailing bytes after SHARD_ASSIGN payload")
+    return generation, [tuple(r) for r in ranges], epoch, bool(flags & SHARD_F_QUERY)
+
+
+def encode_shard_status(status):
+    """SHARD_STATUS payload: the role/generation/ranges a fleet member
+    actually holds, plus coarse progress counters.
+
+      version:u8 || role:u8 || generation:u32 || served:u32 ||
+      refused:u32 || pending:u32 || n:u16 || n * (start:u16 || end:u16)
+    """
+    role = int(status.get("role", SHARD_ROLE_NONE))
+    if role not in (SHARD_ROLE_NONE, SHARD_ROLE_COORDINATOR, SHARD_ROLE_WORKER):
+        raise WireError(f"unknown shard role {role}")
+    generation = int(status.get("generation", 0))
+    if not 0 <= generation <= 0xFFFFFFFF:
+        raise WireError(f"shard generation {generation} outside u32")
+    ranges = [(int(s), int(e)) for s, e in status.get("ranges", ())]
+    _check_shard_ranges(ranges, "status")
+
+    def ctr(key):
+        return min(0xFFFFFFFF, max(0, int(status.get(key, 0))))
+
+    body = struct.pack(
+        "<BBIIIIH", SHARD_VERSION, role, generation, ctr("served"),
+        ctr("refused"), ctr("pending"), len(ranges),
+    ) + b"".join(struct.pack("<HH", s, e) for s, e in ranges)
+    if len(body) > MAX_SHARD_BODY:
+        raise WireError(f"SHARD_STATUS payload {len(body)}B exceeds {MAX_SHARD_BODY}")
+    return body
+
+
+def decode_shard_status(payload):
+    """Inverse of encode_shard_status, same trust contract as
+    decode_shard_assign."""
+    end = len(payload)
+    if end > MAX_SHARD_BODY:
+        raise WireError(f"SHARD_STATUS payload {end}B exceeds {MAX_SHARD_BODY}")
+    pos = 0
+
+    def take(k, what):
+        nonlocal pos
+        if pos + k > end:
+            raise WireError(f"truncated SHARD_STATUS ({what})")
+        chunk = payload[pos:pos + k]
+        pos += k
+        return chunk
+
+    version, role, generation, served, refused, pending, n = struct.unpack(
+        "<BBIIIIH", take(20, "header")
+    )
+    if version != SHARD_VERSION:
+        raise WireError(f"unknown SHARD_STATUS version {version}")
+    if role not in (SHARD_ROLE_NONE, SHARD_ROLE_COORDINATOR, SHARD_ROLE_WORKER):
+        raise WireError(f"unknown shard role {role}")
+    if n > MAX_SHARD_RANGES:
+        raise WireError(f"{n} status ranges exceed {MAX_SHARD_RANGES}")
+    ranges = [
+        struct.unpack("<HH", take(4, "range")) for _ in range(n)
+    ]
+    _check_shard_ranges(ranges, "status")
+    if pos != end:
+        raise WireError(f"{end - pos} trailing bytes after SHARD_STATUS payload")
+    return {
+        "role": role,
+        "generation": generation,
+        "served": served,
+        "refused": refused,
+        "pending": pending,
+        "ranges": [tuple(r) for r in ranges],
+    }
+
+
 class GossipCodec:
     """topic prefix -> SSZ encode/decode of the gossip payloads
     (types/pubsub.rs PubsubMessage::decode)."""
@@ -844,10 +1010,22 @@ class WireNode:
         # is only ever SENT under LTPU_TELEM=1 (same mixed-fleet
         # contract as overlay frames).
         self.telemetry = None
+        # fleet-shard role (lighthouse_tpu/fleet/shard): the object
+        # answering SHARD_ASSIGN frames — a ShardWorker adopting its
+        # committee-bucket slice, or a ShardCoordinator answering status
+        # queries.  None -> not enrolled; assigns are answered
+        # R_RESOURCE_UNAVAILABLE (same contract as overlay/telemetry).
+        self.shard = None
         # per-host serve slowdown (seconds) — the chaos harness's
         # per-target analogue of the process-global `remote.serve`
         # delay failpoint (simulator slow-verifier scenario)
         self.verify_serve_delay = 0.0
+        # per-host byzantine knob (lying-worker scenarios): when set,
+        # every verdict bitmap this host serves is flipped pre-send —
+        # the targetable analogue of the process-global
+        # `remote.verdict_corrupt` failpoint, so ONE node in a
+        # multi-host fabric can lie while the others stay honest
+        self.verdict_corrupt = False
         # bound concurrent verify-serve work: each VERIFY_REQ decodes on
         # its own thread, so without a cap a hostile peer flooding
         # frames buys unbounded threads/CPU regardless of the
@@ -1281,6 +1459,10 @@ class WireNode:
             self._on_telem_push(peer, body)
         elif ftype == TELEM_ACK:
             self._on_telem_ack(peer, body)
+        elif ftype == SHARD_ASSIGN:
+            self._on_shard_assign(peer, body)
+        elif ftype == SHARD_STATUS:
+            self._on_shard_status(peer, body)
         elif ftype == GOODBYE_FRAME:
             peer.close()
         else:
@@ -2084,9 +2266,12 @@ class WireNode:
             # span-timing tail), which the client's random-recombination
             # audit must catch
             bm_end = 6 + (len(verdicts) + 7) // 8
-            resp = resp[:6] + failpoints.hit(
+            bitmap = failpoints.hit(
                 "remote.verdict_corrupt", data=resp[6:bm_end]
-            ) + resp[bm_end:]
+            )
+            if self.verdict_corrupt:
+                bitmap = bytes(b ^ 0xFF for b in bitmap)
+            resp = resp[:6] + bitmap + resp[bm_end:]
             peer.send_frame(
                 VERIFY_RESP, struct.pack("<IB", rid, code) + resp
             )
@@ -2218,8 +2403,14 @@ class WireNode:
             else:
                 self.limiter.check(peer.peer_id, "telem_push", 1)
                 digest = decode_telem_push(body[4:])
-                self.telemetry.record_digest(peer.peer_id, digest)
-                code = R_SUCCESS
+                if self.telemetry.record_digest(peer.peer_id, digest):
+                    code = R_SUCCESS
+                else:
+                    # gated peer (quarantined or stale shard generation):
+                    # the digest is DISCARDED, not merged — a lying
+                    # worker cannot keep reporting itself healthy
+                    code = R_RESOURCE_UNAVAILABLE
+                    result = "refused"
         except RateLimited:
             code = R_RESOURCE_UNAVAILABLE
             result = "refused"
@@ -2280,6 +2471,119 @@ class WireNode:
                 raise WireError(f"telemetry push failed: code {rec[2]}")
             fleet_metrics.FLEET_TELEM_FRAMES.with_labels("out", "ok").inc()
             return True
+        finally:
+            with self._lock:
+                locks.access(self, "_pending", "write")
+                self._pending.pop(rid, None)
+
+    # ----------------------------------------------------- fleet shard role
+
+    def _on_shard_assign(self, peer, body):
+        """SHARD_ASSIGN dispatch (reader thread): hand the decoded
+        assignment (or status query) to the attached shard role object
+        and answer SHARD_STATUS with the role/generation/ranges actually
+        held.  Same failure contract as TELEM_PUSH: every addressable
+        failure answers a typed SHARD_STATUS and the connection
+        survives; only an unaddressable flood past the body cap drops
+        it.  A stale-generation assignment the role refuses (on_assign
+        returning None) answers R_RESOURCE_UNAVAILABLE — refused, not
+        invalid."""
+        from ..fleet import metrics as fleet_metrics
+
+        if len(body) < 4:
+            raise WireError("truncated shard assign")
+        if len(body) > MAX_SHARD_BODY + 4:
+            raise WireError("shard assign exceeds size cap")
+        rid = struct.unpack("<I", body[:4])[0]
+        status, result = None, "ok"
+        try:
+            if self.shard is None:
+                code = R_RESOURCE_UNAVAILABLE   # not enrolled in a fleet
+                result = "refused"
+            else:
+                self.limiter.check(peer.peer_id, "shard_assign", 1)
+                generation, ranges, epoch, query = decode_shard_assign(
+                    body[4:]
+                )
+                if query:
+                    status = self.shard.status()
+                else:
+                    status = self.shard.on_assign(
+                        peer.peer_id, generation, ranges, epoch
+                    )
+                if status is None:
+                    code = R_RESOURCE_UNAVAILABLE   # stale generation
+                    result = "refused"
+                else:
+                    code = R_SUCCESS
+        except RateLimited:
+            code = R_RESOURCE_UNAVAILABLE
+            result = "refused"
+            self._score(peer, -5.0)
+        except WireError:
+            code = R_INVALID_REQUEST
+            result = "invalid"
+            self._score(peer, -5.0)
+        except Exception:
+            code = R_SERVER_ERROR
+            result = "invalid"
+        fleet_metrics.FLEET_SHARD_FRAMES.with_labels("in", result).inc()
+        try:
+            payload = b"" if status is None else encode_shard_status(status)
+            peer.send_frame(
+                SHARD_STATUS, struct.pack("<IB", rid, code) + payload
+            )
+        except (ConnectionError, OSError):
+            pass   # assigner gone; its timeout handles the rest
+
+    def _on_shard_status(self, peer, body):
+        """Client side: complete the pending shard assign/query."""
+        if len(body) < 5:
+            raise WireError("truncated shard status")
+        rid, code = struct.unpack("<IB", body[:5])
+        with self._lock:
+            rec = self._pending.get(rid)
+        if rec is None or rec[3] is not peer or rec[6] != "shard":
+            return
+        if code == R_SUCCESS and len(body) > 5:
+            rec[1] = decode_shard_status(body[5:])
+        rec[2] = code
+        rec[0].set()
+
+    def shard_assign(self, peer_id, generation=0, ranges=(), epoch=0,
+                     query=False, timeout=5.0):
+        """Ship one committee-bucket assignment (or, with `query`, a
+        status query) to a fleet member and wait for its SHARD_STATUS.
+        Returns the decoded status dict.  Raises PeerRateLimited when
+        the receiver refused (quota / not enrolled / stale generation),
+        WireError on every other failure."""
+        from ..fleet import metrics as fleet_metrics
+
+        peer = self.peers.get(peer_id)
+        if peer is None:
+            raise WireError(f"not connected to {peer_id}")
+        # chaos seam: `error` fails the assignment push (a partitioned
+        # worker at re-home time), `delay` models a slow control plane
+        failpoints.hit("shard.assign")
+        payload = encode_shard_assign(
+            generation, ranges, epoch=epoch, query=query
+        )
+        with self._lock:
+            locks.access(self, "_pending", "write")
+            self._req_id += 1
+            rid = self._req_id
+            rec = [threading.Event(), None, None, peer, {}, None, "shard"]
+            self._pending[rid] = rec
+        try:
+            peer.send_frame(SHARD_ASSIGN, struct.pack("<I", rid) + payload)
+            if not rec[0].wait(timeout):
+                raise WireError("shard assign timed out")
+            if rec[2] == R_RESOURCE_UNAVAILABLE:
+                raise PeerRateLimited("shard assign refused (quota/role/stale)")
+            if rec[2] != R_SUCCESS or rec[1] is None:
+                raise WireError(f"shard assign failed: code {rec[2]}")
+            fleet_metrics.FLEET_SHARD_FRAMES.with_labels("out", "ok").inc()
+            return rec[1]
         finally:
             with self._lock:
                 locks.access(self, "_pending", "write")

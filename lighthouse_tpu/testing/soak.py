@@ -18,7 +18,12 @@ the continuation pieces the soak driver (tools/soak_bench.py) composes:
     backfills over req/resp on a second thread while the driver keeps
     feeding it live head blocks: the store-write interleaving race, plus
     the payload-pruned `BlockReplayer` historical-state reconstruction
-    check at the end.
+    check at the end;
+  * `FleetHarness` — fleet mode (ISSUE 20): one logical verification
+    plane sharded over a coordinator + K fault-isolated ShardWorkers,
+    with kill / restart-from-persist / re-join helpers, so the soak
+    driver, the simulator chaos scenarios and the bench all build the
+    same fleet the same way.
 
 The rig requires the chain's default `MemoryStore` (churn mutates the
 stored head state in place — a serializing store would snapshot it).
@@ -219,6 +224,124 @@ def apply_churn(chain, *, epoch, exits, deposits, pubkey_pool, seed=0):
         "deposited": len(new_range),
         "limbs_dropped": dropped,
     }
+
+
+class FleetHarness:
+    """One fleet-sharded logical node, in-process (ISSUE 20).
+
+    K `ShardWorker`s (each its own chainless WireNode + local
+    VerificationService on the fake/chosen backend) behind one
+    `ShardCoordinator` (its own WireNode + WireTransport), with a
+    consuming `VerificationService` whose remote tier IS the
+    coordinator — the exact shape a sharded node builds via
+    LTPU_SHARD_ROLE, minus the chain.  Worker ids double as wire peer
+    ids and telemetry digest keys (the supervision join).
+
+    Failure drills: `kill(name)` is the SIGKILL stand-in (wire sockets
+    die mid-whatever, persist dict survives), `restart(name)` builds a
+    fresh worker over the SAME persist dict and re-joins it through
+    the coordinator's generation bump."""
+
+    def __init__(self, k=2, backend="fake", heartbeat_budget_s=1.0,
+                 rpc_timeout=2.0, breaker_threshold=2,
+                 breaker_cooldown=0.5, audit_rate=0.0,
+                 quarantine_cooldown=30.0, incidents=None, persist=None):
+        from ..crypto.backend import SignatureVerifier
+        from ..fleet.coordinator import ShardCoordinator
+        from ..fleet.worker import ShardWorker
+        from ..network.wire import WireNode
+        from ..verify_service import VerificationService
+
+        self.backend = backend
+        self.persist = persist if persist is not None else {}
+        self.workers = {}
+        for i in range(k):
+            name = f"shardw{i}"
+            self.workers[name] = ShardWorker(
+                name, backend=backend,
+                persist=self.persist.setdefault(name, {}),
+            )
+        self.coordinator_wire = WireNode(
+            None, accept_any_fork=True, peer_id="shard-coord"
+        )
+        self.coordinator = ShardCoordinator(
+            self.coordinator_wire,
+            [(name, w.address) for name, w in self.workers.items()],
+            audit_verifier=SignatureVerifier(backend),
+            audit_rate=audit_rate,
+            incidents=incidents,
+            heartbeat_budget_s=heartbeat_budget_s,
+            rpc_timeout=rpc_timeout,
+            breaker_threshold=breaker_threshold,
+            breaker_cooldown=breaker_cooldown,
+            quarantine_cooldown=quarantine_cooldown,
+        )
+        self.service = VerificationService(SignatureVerifier(backend))
+        self.service.attach_remote(self.coordinator)
+        self._keypairs = None
+
+    # ---------------------------------------------------------- plumbing
+
+    def probe_sets(self, n=8, tag=1):
+        """Honestly signed sets with per-set DISTINCT messages, so one
+        batch spreads over the bucket space (and thus the workers)
+        instead of collapsing into a single committee bucket."""
+        from ..crypto.ref import bls
+        from ..state_processing.genesis import interop_keypairs
+
+        if self._keypairs is None:
+            self._keypairs = interop_keypairs(16)
+        out = []
+        for i in range(n):
+            sk, pk = self._keypairs[i % len(self._keypairs)]
+            msg = bytes([tag & 0xFF, i & 0xFF]) * 16
+            out.append(bls.SignatureSet(bls.sign(sk, msg), [pk], msg))
+        return out
+
+    def submit(self, sets, priority="attestation"):
+        """Async submit through the consuming service (the path import
+        work rides); returns the VerifyFuture."""
+        return self.service.submit(sets, priority=priority,
+                                   want_per_set=True)
+
+    def beat_all(self):
+        """One heartbeat from every live worker into the coordinator's
+        fleet table (the driver's stand-in for beat_forever)."""
+        for w in self.workers.values():
+            try:
+                w.beat("shard-coord")
+            except Exception:  # noqa: BLE001 — silence IS the signal
+                pass
+
+    # ---------------------------------------------------- failure drills
+
+    def kill(self, name):
+        """SIGKILL stand-in: the worker's wire sockets and service die
+        mid-whatever; its persist dict survives for `restart`."""
+        w = self.workers.pop(name)
+        w.stop()
+        return w
+
+    def restart(self, name):
+        """Crash recovery: a fresh worker over the SAME persist dict
+        (resumes generation/ranges from the snapshot), re-joined
+        through the coordinator's generation bump.  Returns
+        (worker, generation)."""
+        from ..fleet.worker import ShardWorker
+
+        w = ShardWorker(
+            name, backend=self.backend, persist=self.persist[name]
+        )
+        self.workers[name] = w
+        gen = self.coordinator.rejoin(name, w.address)
+        return w, gen
+
+    def stop(self):
+        self.coordinator.stop()
+        self.service.stop()
+        self.coordinator_wire.stop()
+        for w in self.workers.values():
+            w.stop()
 
 
 class BackfillRacer:

@@ -462,6 +462,15 @@ declare("remote.verdict_corrupt",
 declare("backfill.replay",
         "historical backfill replay loop (testing/soak BackfillRacer, "
         "per backfill batch)")
+declare("shard.assign",
+        "fleet-shard assignment push (network/wire.shard_assign, "
+        "coordinator -> worker control plane)")
+declare("shard.worker_rpc",
+        "fleet-shard coordinator -> worker verify dispatch "
+        "(fleet/coordinator._call_worker)")
+declare("shard.worker_wedge",
+        "fleet-shard worker heartbeat tick (fleet/worker.beat — delay "
+        "wedges heartbeats, the missed-heartbeat quarantine trigger)")
 
 
 def _load_env():

@@ -268,6 +268,7 @@ class RaceChecker:
         self._witness = witness
         self._fields = {}           # (objid, field) -> state dict
         self.reports = deque(maxlen=128)
+        self._dead = deque()        # keys whose object was collected
 
     def _held_names(self):
         w = self._witness if self._witness is not None else get_witness()
@@ -276,7 +277,8 @@ class RaceChecker:
     def register(self, obj, field, guards):
         key = (id(obj), field)
         with self._mu:
-            st = self._fields.get(key)
+            self._prune_locked()    # before get: a dead entry must not
+            st = self._fields.get(key)  # alias this (recycled) id
             if st is None:
                 st = self._fields[key] = {
                     "label": f"{type(obj).__name__}.{field}",
@@ -299,9 +301,24 @@ class RaceChecker:
             pass                    # non-weakrefable: lives forever
 
     def _forget(self, key):
-        with self._mu:
+        # weakref.finalize callbacks run synchronously inside whatever
+        # allocation triggered the GC — including allocations made while
+        # _mu is already held (report()'s result dicts did exactly
+        # that: GC fired mid-iteration and this re-acquire self-
+        # deadlocked the suite).  Never take the mutex here; deque
+        # appends are atomic and _prune_locked reaps at the next entry.
+        self._dead.append(key)
+
+    def _prune_locked(self):
+        """Reap keys whose object died; caller holds ``_mu``.  Popping
+        from a deque never allocates, so no GC/finalize can re-enter."""
+        while True:
+            try:
+                key = self._dead.popleft()
+            except IndexError:
+                break
             self._fields.pop(key, None)
-            RACE_GUARDED.set(len(self._fields))
+        RACE_GUARDED.set(len(self._fields))
 
     def note_access(self, obj, field, kind):
         key = (id(obj), field)
@@ -311,6 +328,7 @@ class RaceChecker:
         tid = threading.get_ident()
         report = None
         with self._mu:
+            self._prune_locked()
             if st["candidates"] is None:
                 st["candidates"] = set(st["guards"])
             if st["owner"] is None:
@@ -351,6 +369,7 @@ class RaceChecker:
 
     def report(self):
         with self._mu:
+            self._prune_locked()
             return {
                 "enabled": True,
                 "guarded_fields": len(self._fields),

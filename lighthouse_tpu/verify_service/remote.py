@@ -146,6 +146,45 @@ class RemoteTarget:
             }
 
 
+def audit_verdicts(audit_verifier, sets, verdicts, priority, rng, log=log):
+    """Class-aware 2G2T check of one untrusted verdict stream against a
+    local truth source: one blinded recombination over the claimed-valid
+    subset, plus re-verification of claimed-invalid sets (every one for
+    ALWAYS_AUDIT_CLASSES, one random probe for bulk classes).
+
+    Returns (ok, why): (True, None) when the verdicts are consistent;
+    (False, <reason>) when the stream lied — the caller quarantines the
+    source; (False, None) when the audit pass itself errored — trust
+    nothing, quarantine nobody, re-verify locally.
+
+    Shared by the RemoteVerifierPool audit and the fleet-shard
+    coordinator (ISSUE 20): both face the same adversary, an untrusted
+    verifier host whose bitmap may vouch for invalid sets or censor
+    valid ones."""
+    ok_sets = [s for s, v in zip(sets, verdicts) if v]
+    bad_sets = [s for s, v in zip(sets, verdicts) if not v]
+    try:
+        if ok_sets and not audit_verifier.verify_signature_sets(ok_sets):
+            # the random recombination over the claimed-valid subset
+            # failed locally: the source vouched for an invalid set
+            return False, "claimed-valid subset failed"
+        if bad_sets:
+            probes = (
+                bad_sets if priority in ALWAYS_AUDIT_CLASSES
+                else [bad_sets[rng.randrange(len(bad_sets))]]
+            )
+            if any(
+                audit_verifier.verify_signature_sets([p]) for p in probes
+            ):
+                # a claimed-invalid set verifies locally: censorship
+                # (or a corrupted verdict stream)
+                return False, "claimed-invalid set verifies locally"
+    except Exception:
+        log.warning("audit pass errored; batch re-verified locally")
+        return False, None
+    return True, None
+
+
 def quarantine_target(target, cooldown, why, log=log):
     """Quarantine one RemoteTarget: breaker forced OPEN for `cooldown`
     seconds and the target flagged until a post-cooldown probe succeeds
@@ -642,38 +681,15 @@ class RemoteVerifierPool:
         verdicts = job.result
         with self._lock:
             self.audits += 1
-        ok_sets = [s for s, v in zip(job.sets, verdicts) if v]
-        bad_sets = [s for s, v in zip(job.sets, verdicts) if not v]
-        try:
-            if ok_sets and not self.audit_verifier.verify_signature_sets(
-                ok_sets
-            ):
-                # the random recombination over the claimed-valid subset
-                # failed locally: the target vouched for an invalid set
-                self._audit_caught(target, "claimed-valid subset failed")
-                return False
-            if bad_sets:
-                probes = (
-                    bad_sets if job.priority in ALWAYS_AUDIT_CLASSES
-                    else [bad_sets[self._rng.randrange(len(bad_sets))]]
-                )
-                if any(
-                    self.audit_verifier.verify_signature_sets([p])
-                    for p in probes
-                ):
-                    # a claimed-invalid set verifies locally: censorship
-                    # (or a corrupted verdict stream)
-                    self._audit_caught(
-                        target, "claimed-invalid set verifies locally"
-                    )
-                    return False
-        except Exception:
-            # the audit path itself failed: trust nothing, quarantine
-            # nobody — the batch just re-verifies locally
-            log.warning("remote audit pass errored; batch re-verified "
-                        "locally", target=target.name if target else None)
-            return False
-        return True
+        ok, why = audit_verdicts(
+            self.audit_verifier, job.sets, verdicts, job.priority,
+            self._rng,
+        )
+        if ok:
+            return True
+        if why is not None:
+            self._audit_caught(target, why)
+        return False
 
     def _audit_caught(self, target, why):
         with self._lock:

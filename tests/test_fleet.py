@@ -59,6 +59,7 @@ def test_frame_names_aligned_with_wire_constants():
         wire.VERIFY_REQ: "verify_req", wire.VERIFY_RESP: "verify_resp",
         wire.AGG_PUSH: "agg_push", wire.AGG_ACK: "agg_ack",
         wire.TELEM_PUSH: "telem_push", wire.TELEM_ACK: "telem_ack",
+        wire.SHARD_ASSIGN: "shard_assign", wire.SHARD_STATUS: "shard_status",
     }
     assert FRAME_NAMES == expected
 

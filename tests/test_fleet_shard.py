@@ -1,0 +1,432 @@
+"""Fleet-sharded beacon processing (ISSUE 20): SHARD_ASSIGN/STATUS
+codec discipline, deterministic assignment math, coordinator routing +
+audited failover, worker crash/restart/re-join, and the satellite hub
+gating fix (quarantined/stale-generation TELEM_PUSH digests discarded).
+"""
+
+import struct
+import time
+
+import pytest
+
+from lighthouse_tpu.fleet.shard import (
+    N_SHARD_BUCKETS,
+    compute_assignment,
+    owner_of,
+    partition_sets,
+    ranges_cover,
+    role_from_env,
+    shard_bucket,
+    workers_from_env,
+)
+from lighthouse_tpu.fleet.telemetry import TelemetryHub
+from lighthouse_tpu.network.wire import (
+    MAX_SHARD_RANGES,
+    PeerRateLimited,
+    SHARD_ASSIGN,
+    SHARD_ROLE_WORKER,
+    WireError,
+    WireNode,
+    decode_shard_assign,
+    decode_shard_status,
+    encode_shard_assign,
+    encode_shard_status,
+)
+
+
+def _wait(cond, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+# ------------------------------------------------------------- codec
+
+
+def test_shard_assign_roundtrip():
+    ranges = [(0, 64), (128, 200)]
+    gen, got, epoch, query = decode_shard_assign(
+        encode_shard_assign(7, ranges, epoch=3)
+    )
+    assert (gen, got, epoch, query) == (7, ranges, 3, False)
+
+
+def test_shard_assign_query_roundtrip():
+    gen, got, epoch, query = decode_shard_assign(
+        encode_shard_assign(0, [], query=True)
+    )
+    assert (gen, got, epoch, query) == (0, [], 0, True)
+
+
+def test_shard_status_roundtrip():
+    status = {
+        "role": SHARD_ROLE_WORKER, "generation": 9, "served": 123,
+        "refused": 4, "pending": 2, "ranges": [(16, 32)],
+    }
+    assert decode_shard_status(encode_shard_status(status)) == status
+
+
+@pytest.mark.parametrize("ranges", [
+    [(10, 10)],                # empty range
+    [(20, 10)],                # inverted
+    [(0, 10), (5, 20)],        # overlapping
+    [(10, 20), (0, 5)],        # out of order
+    [(0, N_SHARD_BUCKETS + 70000)],   # end past u16
+])
+def test_shard_assign_bad_ranges_rejected(ranges):
+    with pytest.raises((WireError, struct.error)):
+        encode_shard_assign(1, ranges)
+
+
+def test_shard_assign_too_many_ranges_rejected():
+    ranges = [(i * 2, i * 2 + 1) for i in range(MAX_SHARD_RANGES + 1)]
+    with pytest.raises(WireError):
+        encode_shard_assign(1, ranges)
+
+
+def test_shard_decode_truncation_and_trailing_rejected():
+    good = encode_shard_assign(5, [(0, 8)])
+    for cut in range(1, len(good)):
+        with pytest.raises(WireError):
+            decode_shard_assign(good[:cut])
+    with pytest.raises(WireError):
+        decode_shard_assign(good + b"\x00")
+    good = encode_shard_status({"role": 2, "generation": 1,
+                                "ranges": [(0, 8)]})
+    for cut in range(1, len(good)):
+        with pytest.raises(WireError):
+            decode_shard_status(good[:cut])
+    with pytest.raises(WireError):
+        decode_shard_status(good + b"\x00")
+
+
+def test_shard_decode_bad_version_rejected():
+    bad = b"\x7f" + encode_shard_assign(1, [(0, 4)])[1:]
+    with pytest.raises(WireError):
+        decode_shard_assign(bad)
+
+
+def test_shard_decode_fuzz_never_hangs_or_crashes():
+    import random
+
+    rng = random.Random(20)
+    for _ in range(300):
+        blob = bytes(rng.randrange(256)
+                     for _ in range(rng.randrange(0, 64)))
+        for dec in (decode_shard_assign, decode_shard_status):
+            try:
+                dec(blob)
+            except WireError:
+                pass
+
+
+# -------------------------------------------------- assignment math
+
+
+def test_assignment_covers_disjoint_and_deterministic():
+    workers = [f"w{i}" for i in range(5)]
+    a1 = compute_assignment(workers, generation=3)
+    a2 = compute_assignment(list(reversed(workers)), generation=3)
+    assert a1 == a2                       # order-independent
+    seen = set()
+    for rs in a1.values():
+        for s, e in rs:
+            assert 0 <= s < e <= N_SHARD_BUCKETS
+            span = set(range(s, e))
+            assert not (span & seen)      # disjoint
+            seen |= span
+    assert len(seen) == N_SHARD_BUCKETS   # full cover
+    assert compute_assignment(workers, generation=4) != a1  # gen-keyed
+
+
+def test_assignment_empty_and_single():
+    assert compute_assignment([], generation=1) == {}
+    a = compute_assignment(["only"], generation=1)
+    assert a == {"only": [(0, N_SHARD_BUCKETS)]}
+    assert ranges_cover(a["only"], 0) and ranges_cover(a["only"], 255)
+
+
+def test_bucket_routing_and_partition():
+    class FakeSet:
+        def __init__(self, message):
+            self.message = message
+
+    workers = ["a", "b", "c"]
+    assignment = compute_assignment(workers, generation=1)
+    sets = [FakeSet(bytes([i]) * 32) for i in range(32)]
+    groups, orphans = partition_sets(sets, assignment)
+    assert not orphans
+    routed = sorted(i for members in groups.values() for i in members)
+    assert routed == list(range(32))
+    for wid, members in groups.items():
+        for i in members:
+            b = shard_bucket(sets[i].message)
+            assert owner_of(b, assignment) == wid
+
+
+def test_env_parsing(monkeypatch):
+    monkeypatch.delenv("LTPU_SHARD_ROLE", raising=False)
+    assert role_from_env() is None
+    monkeypatch.setenv("LTPU_SHARD_ROLE", "coordinator")
+    assert role_from_env() == "coordinator"
+    monkeypatch.setenv("LTPU_SHARD_ROLE", "bogus")
+    with pytest.raises(ValueError):
+        role_from_env()
+    monkeypatch.setenv(
+        "LTPU_SHARD_WORKERS", "w0=127.0.0.1:9000, 127.0.0.1:9001"
+    )
+    assert workers_from_env() == [
+        ("w0", "127.0.0.1:9000"), ("127.0.0.1:9001", "127.0.0.1:9001"),
+    ]
+
+
+# ------------------------------------------------------ wire frames
+
+
+def test_shard_assign_over_live_wire_and_garbage_survives():
+    """A well-formed assign adopts; a garbage body gets a typed nack on
+    the SAME connection, which then still serves."""
+    from lighthouse_tpu.fleet.worker import ShardWorker
+
+    worker = ShardWorker("shard-live-w")
+    client = WireNode(None, accept_any_fork=True, peer_id="shard-live-c")
+    try:
+        pid = client.dial("127.0.0.1", worker.wire.port)
+        status = client.shard_assign(pid, 4, [(0, 100)])
+        assert status["generation"] == 4
+        assert status["ranges"] == [(0, 100)]
+        assert worker.generation == 4
+        # garbage body: nacked, not dropped
+        client.peers[pid].send_frame(
+            SHARD_ASSIGN, struct.pack("<I", 999) + b"\xff\xff\xff"
+        )
+        status = client.shard_assign(pid, 5, [(0, 100)])
+        assert status["generation"] == 5
+        # stale generation refused as PeerRateLimited(resource)
+        with pytest.raises(PeerRateLimited):
+            client.shard_assign(pid, 3, [(0, 10)])
+        assert worker.generation == 5    # rollback refused
+        # query does not adopt
+        status = client.shard_assign(pid, query=True)
+        assert status["generation"] == 5
+        assert worker.refused_assigns == 1
+    finally:
+        client.stop()
+        worker.stop()
+
+
+def test_shard_assign_quota_enforced():
+    from lighthouse_tpu.fleet.worker import ShardWorker
+    from lighthouse_tpu.network.rate_limiter import Quota
+    from lighthouse_tpu.network.wire import WireNode as WN
+    from lighthouse_tpu.verify_service import VerificationService
+    from lighthouse_tpu.crypto.backend import SignatureVerifier
+
+    service = VerificationService(SignatureVerifier("fake"))
+    wire = WN(None, accept_any_fork=True, peer_id="shard-quota-w",
+              verify_service=service,
+              quotas={"shard_assign": Quota(2, 1000.0)})
+    worker = ShardWorker("shard-quota-w", wire=wire, service=service)
+    client = WireNode(None, accept_any_fork=True, peer_id="shard-quota-c")
+    try:
+        pid = client.dial("127.0.0.1", wire.port)
+        client.shard_assign(pid, 1, [(0, 8)])
+        client.shard_assign(pid, 2, [(0, 8)])
+        with pytest.raises(PeerRateLimited):
+            client.shard_assign(pid, 3, [(0, 8)])
+        assert pid in client.peers       # refused, not dropped
+    finally:
+        client.stop()
+        wire.stop()
+        service.stop()
+
+
+def test_unsharded_node_refuses_assign():
+    server = WireNode(None, accept_any_fork=True, peer_id="noshard-s")
+    client = WireNode(None, accept_any_fork=True, peer_id="noshard-c")
+    try:
+        pid = client.dial("127.0.0.1", server.port)
+        with pytest.raises(PeerRateLimited):
+            client.shard_assign(pid, 1, [(0, 8)])
+    finally:
+        client.stop()
+        server.stop()
+
+
+# ------------------------------------- satellite: hub digest gating
+
+
+def test_hub_discards_blocked_peer_digests():
+    hub = TelemetryHub()
+    assert hub.record_digest("w0", {"x": 1.0}) is True
+    hub.gate_peer("w0", blocked=True)
+    assert hub.digest_count() == 0          # stored digest dropped too
+    assert hub.record_digest("w0", {"x": 2.0}) is False
+    assert hub.refused_digests == 1
+    hub.ungate_peer("w0")
+    assert hub.record_digest("w0", {"x": 3.0}) is True
+
+
+def test_hub_discards_stale_generation_digests():
+    hub = TelemetryHub()
+    hub.gate_peer("w1", min_generation=5)
+    assert hub.record_digest("w1", {"shard_generation": 4.0}) is False
+    assert hub.record_digest("w1", {"shard_generation": 5.0}) is True
+    # a digest with NO generation key from a gated peer is stale too
+    assert hub.record_digest("w1", {"other": 1.0}) is False
+    assert hub.refused_digests == 2
+
+
+def test_wire_acks_refused_digest_without_drop():
+    """Satellite fix end-to-end: the gated peer's push is answered
+    (resource-refused), its digest is NOT merged, the connection
+    survives, and after the gate lifts the next push lands."""
+    server = WireNode(None, accept_any_fork=True, peer_id="gate-s")
+    server.telemetry = TelemetryHub()
+    server.telemetry.gate_peer("gate-c", blocked=True)
+    client = WireNode(None, accept_any_fork=True, peer_id="gate-c")
+    try:
+        pid = client.dial("127.0.0.1", server.port)
+        with pytest.raises(PeerRateLimited):
+            client.push_telemetry(pid, digest={"x": 1.0})
+        assert server.telemetry.digest_count() == 0
+        assert pid in client.peers
+        server.telemetry.ungate_peer("gate-c")
+        assert client.push_telemetry(pid, digest={"x": 2.0}) is True
+        assert server.telemetry.digest_count() == 1
+    finally:
+        client.stop()
+        server.stop()
+
+
+# --------------------------------------------- coordinator failover
+
+
+def test_worker_kill_midbatch_zero_lost_verdicts():
+    from lighthouse_tpu.testing.simulator import ShardFleetFabric
+
+    fabric = ShardFleetFabric(k=2, breaker_cooldown=0.3)
+    try:
+        snap = fabric.scenario_worker_loss_midbatch()
+        assert snap["lost_verdicts"] == 0
+        assert snap["redispatches"] >= 1
+    finally:
+        fabric.stop()
+
+
+def test_lying_worker_caught_quarantined_reverified():
+    from lighthouse_tpu.testing.simulator import ShardFleetFabric
+
+    fabric = ShardFleetFabric(k=2)
+    try:
+        snap = fabric.scenario_lying_worker(liar=0)
+        assert snap["audit_catches"] >= 1
+        assert snap["lost_verdicts"] == 0
+    finally:
+        fabric.stop()
+
+
+def test_missed_heartbeat_supervision_quarantines():
+    from lighthouse_tpu.testing.soak import FleetHarness
+
+    h = FleetHarness(k=2, heartbeat_budget_s=0.2)
+    try:
+        h.beat_all()
+        assert h.coordinator.supervise() == []
+        h.kill("shardw1")                  # stops beating
+        time.sleep(0.3)
+        h.beat_all()                       # only shardw0 beats
+        assert h.coordinator.supervise() == ["shardw1"]
+        snap = h.coordinator.snapshot()
+        assert "shardw1" not in snap["assignment"]
+        assert snap["workers"]["shardw1"]["quarantined"]
+        # survivors still cover the whole space and serve
+        fut = h.submit(h.probe_sets(n=6, tag=9))
+        assert fut.result(timeout=15) == [True] * 6
+        assert h.coordinator.lost_verdicts == 0
+    finally:
+        h.stop()
+
+
+# ------------------------------------ crash / restart / re-join
+
+
+def test_restart_resumes_from_persist_and_refuses_stale():
+    from lighthouse_tpu.testing.simulator import ShardFleetFabric
+
+    fabric = ShardFleetFabric(k=2, breaker_cooldown=0.3)
+    try:
+        # SIGKILL stand-in mid-epoch, then the full recovery drill:
+        # restart over the SAME persist dict, generation-bumped re-join,
+        # stale pre-crash pushes refused (asserted inside)
+        snap = fabric.scenario_restart_rejoin(victim=1)
+        assert snap["lost_verdicts"] == 0
+        w = fabric.fleet.workers["shardw1"]
+        # the persist snapshot is live: a fresh worker over the same
+        # dict resumes the bumped generation, and a replayed pre-crash
+        # assignment (older generation) is refused by the worker itself
+        assert w.persist["shard_worker"]["generation"] == w.generation
+        assert w.on_assign("x", w.generation - 1, [(0, 1)], 0) is None
+    finally:
+        fabric.stop()
+
+
+def test_post_restart_state_byte_identical_to_control():
+    """The acceptance oracle at test scale: the same probe traffic
+    through (a) a fleet that loses + restarts a worker mid-run and
+    (b) an undisturbed control fleet resolves to identical verdict
+    streams, and both coordinators report zero lost verdicts."""
+    from lighthouse_tpu.testing.soak import FleetHarness
+
+    def run(crash):
+        h = FleetHarness(k=2, breaker_cooldown=0.2)
+        out = []
+        try:
+            for tag in range(3):
+                fut = h.submit(h.probe_sets(n=8, tag=tag + 50),
+                               priority="block")
+                out.append(fut.result(timeout=30))
+                if crash and tag == 0:
+                    h.kill("shardw1")
+                    h.coordinator.quarantine_worker("shardw1", "killed")
+                if crash and tag == 1:
+                    h.restart("shardw1")
+            assert h.coordinator.lost_verdicts == 0
+            return out
+        finally:
+            h.stop()
+
+    assert run(crash=True) == run(crash=False)
+
+
+def test_coordinator_resume_generation_floor():
+    from lighthouse_tpu.testing.soak import FleetHarness
+
+    h = FleetHarness(k=2)
+    try:
+        assert h.coordinator.resume_generation(10) == 10
+        assert h.coordinator.resume_generation(3) == 10   # never lowers
+        h.coordinator.quarantine_worker("shardw1", "probe")
+        assert h.coordinator.generation == 11             # bumps PAST
+    finally:
+        h.stop()
+
+
+# ----------------------------------------------- overlay root pinning
+
+
+def test_overlay_root_pin_fixes_root_for_every_key():
+    from lighthouse_tpu.testing.simulator import OverlayFabric
+
+    fabric = OverlayFabric(n=4, root_pin="agg2")
+    try:
+        for idx in range(6):
+            key = fabric.key_of(fabric.data(index=idx))
+            assert fabric.root_node(key).name == "agg2"
+        pairs = fabric.scenario_clean_tree()
+        assert pairs                       # settled AND byte-identical
+    finally:
+        fabric.stop()

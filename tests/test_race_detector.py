@@ -18,6 +18,7 @@ Two halves, mirroring the rule itself:
    /lighthouse/races`` serves the report.
 """
 
+import gc
 import json
 import subprocess
 import sys
@@ -214,6 +215,26 @@ def test_first_owner_exclusive_phase_never_reports():
     for _ in range(4):
         chk.note_access(tier, "entries", "write")   # bare, single thread
     assert chk.report()["reports"] == []
+
+
+def test_finalizer_never_takes_checker_mutex():
+    """Weakref finalizers run synchronously inside whatever allocation
+    triggered GC — including allocations made while the checker's own
+    mutex is held (report()'s result dicts).  _forget must therefore
+    defer to the dead-key deque instead of locking; a dead object's
+    entry still disappears at the next report()."""
+    _lk, chk, tier = _checker()
+    assert chk.report()["guarded_fields"] == 1
+    # simulate the GC-inside-report interleaving: finalize fires while
+    # _mu is already held — with a locking _forget this deadlocks
+    with chk._mu:
+        chk._forget((id(tier), "entries"))
+        assert list(chk._dead)      # deferred, not dropped
+    del tier
+    gc.collect()                    # real finalizer also only defers
+    rep = chk.report()              # prunes at entry
+    assert rep["guarded_fields"] == 0
+    assert not chk._dead
 
 
 def test_read_only_sharing_never_reports():

@@ -49,9 +49,10 @@ def report_from_url(base):
     incidents = _fetch(base, "/lighthouse/incidents")
     state_profile = _fetch(base, "/lighthouse/state-profile")
     forkchoice = _fetch(base, "/lighthouse/forkchoice")
+    shard = _fetch(base, "/lighthouse/shard")
     return {"mode": "url", "url": base, "fleet": fleet, "slo": slo,
             "incidents": incidents, "state_profile": state_profile,
-            "forkchoice_forensics": forkchoice}
+            "forkchoice_forensics": forkchoice, "shard": shard}
 
 
 def report_from_bundle(path):
@@ -93,6 +94,47 @@ def _breached(report):
         if isinstance(st, dict) and st.get("state") == "breach":
             return True
     return False
+
+
+# SHARD_STATUS role codes (network/wire.py SHARD_ROLE_*); 0/none means
+# the peer is not part of a sharded fleet and gets no role column
+_SHARD_ROLES = {1: "coordinator", 2: "worker"}
+
+
+def _render_shard(report, w):
+    """The fleet-sharding section (URL mode): coordinator assignment +
+    per-worker rows, or the worker's own slice."""
+    shard = report.get("shard")
+    if not isinstance(shard, dict) or "error" in shard:
+        return
+    if not shard.get("enabled", False):
+        w("  shard: disabled (LTPU_SHARD_ROLE unset)\n")
+        return
+    if shard.get("role") == "coordinator":
+        w(f"  shard: coordinator gen={shard.get('generation')} "
+          f"workers={len(shard.get('workers') or {})} "
+          f"jobs remote/local={shard.get('jobs_remote')}"
+          f"/{shard.get('jobs_local')} "
+          f"lost={shard.get('lost_verdicts')} "
+          f"rehomes={len(shard.get('rehomes') or [])} "
+          f"audit_catches={shard.get('audit_catches')}\n")
+        assignment = shard.get("assignment") or {}
+        for wid, entry in sorted((shard.get("workers") or {}).items()):
+            ranges = ",".join(
+                f"{lo}-{hi}" for lo, hi in assignment.get(wid, [])
+            ) or "-"
+            w(f"    {wid:<18} quarantined={entry.get('quarantined')} "
+              f"gen_acked={entry.get('generation_acked')} "
+              f"buckets=[{ranges}] "
+              f"digest_age={entry.get('digest_age_s')}\n")
+    else:
+        ranges = ",".join(
+            f"{lo}-{hi}" for lo, hi in shard.get("ranges") or []
+        ) or "-"
+        w(f"  shard: worker gen={shard.get('generation')} "
+          f"buckets=[{ranges}] served={shard.get('served')} "
+          f"refused={shard.get('refused')} "
+          f"pending={shard.get('pending')}\n")
 
 
 def _render_observatory(report, w):
@@ -151,6 +193,10 @@ def render(report, out=sys.stdout):
                              f"breaker={dg.get('breaker_state', '?')} "
                              f"rss={_fmt_bytes(dg.get('rss_bytes', 0))}"
                              f"{stale}")
+                    role = _SHARD_ROLES.get(int(dg.get("shard_role", 0)))
+                    if role:
+                        line += (f" role={role} "
+                                 f"gen={int(dg.get('shard_generation', 0))}")
                 w(line + "\n")
         w(f"  slo: {slo.get('state', 'unknown')}"
           f" ({slo.get('ticks', 0)} tick(s))\n")
@@ -165,6 +211,7 @@ def render(report, out=sys.stdout):
             w(f"    {b.get('id')} cause={b.get('cause')} "
               f"detail={b.get('detail')} "
               f"coalesced={b.get('coalesced', 0)}\n")
+        _render_shard(report, w)
         _render_observatory(report, w)
     else:
         w(f"incident bundle — {report['path']}\n")

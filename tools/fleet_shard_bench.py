@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""Fleet-sharding bench: verify throughput + epoch-replay wall vs K.
+
+For each worker count K the tool builds the same fleet the node builder
+wires under LTPU_SHARD_ROLE (a ShardCoordinator over K ShardWorkers on
+real loopback wire sockets, `testing/soak.FleetHarness`) and measures:
+
+  * ``sets_per_sec``   — batched SignatureSet verification pushed
+                         through the consuming VerificationService
+                         whose remote tier is the coordinator;
+  * ``epoch_wall_s``   — one full epoch of block production + import +
+                         gossip traffic on a scaled chain whose
+                         verifier rides the fleet, against a
+                         single-process control replay (K=0) of the
+                         same seeds;
+  * ``head_state_root``— the post-epoch head state root, which must be
+                         BYTE-IDENTICAL across every K and the control
+                         (the sharding-is-semantically-invisible gate);
+
+plus one failover leg at the largest K: a worker SIGKILLed mid-batch,
+its buckets re-homed, the re-home latency recorded — with zero lost
+verdicts throughout.
+
+Hard gates (``gates`` map in the JSON; exit 1 when any fails — the
+bench.py lane turns that into _fleet_exit_code):
+
+  * ``zero_lost_verdicts`` — no K (including the failover leg) lost a
+                             single verdict;
+  * ``head_roots_identical`` — every K's post-epoch root equals the
+                             single-process control's.
+
+Usage:
+    python tools/fleet_shard_bench.py [--ks 1,2,4] [--validators 256]
+        [--batches 24] [--batch-size 32] [--json BENCH_FLEET.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _drain(processor):
+    while processor.process_pending():
+        pass
+
+
+def _replay_epoch(spec, state, sig_pool, pool, seed):
+    """One epoch of produce + import + gossip traffic on a fresh chain
+    whose verifier's remote tier is `pool` (same shape as the soak
+    rig's measured loop, minus faults).  Returns
+    (wall_s, head_state_root_hex, unresolved)."""
+    from lighthouse_tpu.beacon.beacon_processor import BeaconProcessor
+    from lighthouse_tpu.beacon.chain import BeaconChain
+    from lighthouse_tpu.crypto.backend import SignatureVerifier
+    from lighthouse_tpu.ssz import hash_tree_root
+    from lighthouse_tpu.testing import scale, soak
+    from lighthouse_tpu.verify_service import VerificationService
+
+    spe = spec.preset.slots_per_epoch
+    service = VerificationService(
+        SignatureVerifier("fake"), remote_pool=pool
+    )
+    chain = BeaconChain(state.copy(), spec, verifier=service)
+    processor = BeaconProcessor(chain)
+
+    traffic = scale.make_epoch_traffic(
+        chain.head_state, spec, bytes(chain.head_root),
+        seed=seed, sig_pool=sig_pool,
+    )
+    start = int(chain.head_state.slot)
+    t0 = time.monotonic()
+    for slot in range(start + 1, start + spe):
+        chain.on_tick(slot)
+        chain.process_block(
+            soak.produce_block(chain, slot, sig_pool, si=slot)
+        )
+        chain.recompute_head()
+    enq = 0
+    for sa in traffic["aggregates"]:
+        processor.enqueue_aggregate(sa)
+        enq += 1
+    for a in traffic["attestations"]:
+        processor.enqueue_attestation(a)
+        enq += 1
+    _drain(processor)
+    done = 0
+    while processor.results:
+        processor.results.popleft()
+        done += 1
+    wall = time.monotonic() - t0
+    root = hash_tree_root(chain.head_state).hex()
+    service.stop()
+    return wall, root, enq - done
+
+
+def _throughput(harness, batches, batch_size):
+    """Batched verification through the consuming service; returns
+    (sets_per_sec, lost_at_coordinator)."""
+    futs = []
+    t0 = time.monotonic()
+    for b in range(batches):
+        # tight deadline: measure dispatch + wire + verify throughput,
+        # not the class coalescing window
+        futs.append(harness.service.submit(
+            harness.probe_sets(n=batch_size, tag=b % 200),
+            priority="attestation", deadline=0.05, want_per_set=True,
+        ))
+    bad = 0
+    for fut in futs:
+        verdicts = fut.result(timeout=60)
+        if list(verdicts) != [True] * batch_size:
+            bad += 1
+    wall = time.monotonic() - t0
+    total = batches * batch_size
+    return total / wall if wall > 0 else 0.0, bad
+
+
+def _failover_leg(harness):
+    """SIGKILL one worker mid-batch at the current K; returns the
+    re-home record + verdict accounting."""
+    victim = sorted(harness.workers)[0]
+    harness.workers[victim].wire.verify_serve_delay = 0.4
+    fut = harness.submit(harness.probe_sets(n=16, tag=250))
+    time.sleep(0.1)                # groups now in flight at the victim
+    harness.kill(victim)
+    verdicts = fut.result(timeout=60)
+    snap = harness.coordinator.snapshot()
+    return {
+        "victim": victim,
+        "verdicts_correct": list(verdicts) == [True] * 16,
+        "redispatches": snap["redispatches"],
+        "rehomes": len(snap["rehomes"]),
+        "rehome_latency_s": snap["last_rehome_latency_s"],
+        "lost_verdicts": snap["lost_verdicts"],
+    }
+
+
+def run(args):
+    from lighthouse_tpu.testing import scale, soak
+    from lighthouse_tpu.types import ChainSpec, MinimalPreset
+    from lighthouse_tpu.verify_service.remote import (
+        InProcessTransport,
+        RemoteVerifierPool,
+    )
+
+    spec = ChainSpec(preset=MinimalPreset, altair_fork_epoch=0)
+    pubkey_pool = scale.make_pubkey_pool(64)
+    sig_pool = scale.make_signature_pool(128)
+    state = scale.make_scaled_state(
+        args.validators, spec, epoch=2, seed=args.seed,
+        pubkey_pool=pubkey_pool, fork="altair",
+    )
+    soak.pin_anchor_checkpoints(state, spec.preset)
+
+    # single-process control: the root every fleet K must reproduce
+    def local_backend(sets, priority, deadline_s):
+        return [True] * len(sets), 0.0
+
+    control_pool = RemoteVerifierPool(
+        ["ctl"], InProcessTransport({"ctl": local_backend}),
+        audit_rate=0.0,
+    )
+    ctl_wall, ctl_root, ctl_lost = _replay_epoch(
+        spec, state, sig_pool, control_pool, args.seed
+    )
+
+    ks = [int(k) for k in args.ks.split(",") if k.strip()]
+    per_k = {}
+    failover = None
+    for k in ks:
+        harness = soak.FleetHarness(
+            k=k, breaker_threshold=2, breaker_cooldown=0.3
+        )
+        try:
+            sps, bad = _throughput(harness, args.batches, args.batch_size)
+            wall, root, lost_replay = _replay_epoch(
+                spec, state, sig_pool, harness.coordinator, args.seed
+            )
+            snap = harness.coordinator.snapshot()
+            per_k[str(k)] = {
+                "sets_per_sec": round(sps, 1),
+                "epoch_wall_s": round(wall, 3),
+                "head_state_root": root,
+                "jobs_remote": snap["jobs_remote"],
+                "jobs_local": snap["jobs_local"],
+                "lost_verdicts": snap["lost_verdicts"],
+                "replay_unresolved": lost_replay,
+                "bad_batches": bad,
+            }
+            if k == max(ks) and k >= 2:
+                failover = _failover_leg(harness)
+        finally:
+            harness.stop()
+
+    gates = {
+        "zero_lost_verdicts": (
+            all(v["lost_verdicts"] == 0 and v["replay_unresolved"] == 0
+                and v["bad_batches"] == 0 for v in per_k.values())
+            and ctl_lost == 0
+            and (failover is None or (failover["lost_verdicts"] == 0
+                                      and failover["verdicts_correct"]))
+        ),
+        "head_roots_identical": all(
+            v["head_state_root"] == ctl_root for v in per_k.values()
+        ),
+    }
+    return {
+        "validators": args.validators,
+        "batches": args.batches,
+        "batch_size": args.batch_size,
+        "ks": ks,
+        "control": {
+            "epoch_wall_s": round(ctl_wall, 3),
+            "head_state_root": ctl_root,
+        },
+        "per_k": per_k,
+        "failover": failover,
+        "gates": gates,
+        "gates_passed": all(gates.values()),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ks", default="1,2,4",
+                    help="comma-separated worker counts to sweep")
+    ap.add_argument("--validators", type=int, default=128)
+    ap.add_argument("--batches", type=int, default=12)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args(argv)
+
+    out = run(args)
+    line = json.dumps(out)
+    print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(line + "\n")
+    return 0 if out["gates_passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -129,11 +129,54 @@ def _warmup(args, spec, state, pubkey_pool, sig_pool):
     gc.collect()
 
 
-def run_soak(args, schedule_text, *, with_racer=True, warmup=True):
+def _fleet_storm(fleet, incidents, events, epoch_idx):
+    """Deterministic per-epoch fleet fault storm (--fleet mode): arm a
+    lying worker in epoch 1; heal + re-join it and SIGKILL another in
+    epoch 2; restart the victim from its persist snapshot in epoch 3
+    and replay a delayed pre-crash heartbeat the hub gate must refuse.
+    Mutates `events` with what actually happened."""
+    names = sorted(fleet.workers) or sorted(fleet.persist)
+    coord = fleet.coordinator
+    if epoch_idx == 1 and len(names) >= 2:
+        liar = names[-1]
+        fleet.workers[liar].wire.verdict_corrupt = True
+        events["liar"] = {"epoch": epoch_idx, "worker": liar}
+    elif epoch_idx == 2 and "liar" in events:
+        # heal the caught liar (fresh incarnation, bumped generation)...
+        liar = events["liar"]["worker"]
+        fleet.workers[liar].wire.verdict_corrupt = False
+        coord.rejoin(liar)
+        # ...then SIGKILL a different worker mid-epoch: its heartbeats
+        # stop and its in-flight dispatches fail over
+        victim = names[0]
+        events["kill"] = {
+            "epoch": epoch_idx, "worker": victim,
+            "pre_generation": fleet.workers[victim].generation,
+        }
+        fleet.kill(victim)
+    elif epoch_idx == 3 and "kill" in events:
+        victim = events["kill"]["worker"]
+        coord.quarantine_worker(victim, "missed_heartbeat")  # idempotent
+        _w, gen = fleet.restart(victim)
+        stale_ok = coord.telemetry.record_digest(
+            victim,
+            {"shard_generation": float(events["kill"]["pre_generation"])},
+        )
+        events["rejoin"] = {
+            "epoch": epoch_idx, "generation": gen,
+            "stale_digest_refused": not stale_ok,
+        }
+
+
+def run_soak(args, schedule_text, *, with_racer=True, warmup=True,
+             fleet_k=0):
     """One full soak run; `schedule_text=None` is the no-fault control
     replay (same seeds, same churn/reorg/traffic — only the fault
     schedule and the side-band backfill racer differ, neither of which
-    touches main-chain state)."""
+    touches main-chain state).  `fleet_k > 0` replaces the in-process
+    remote pool with a fleet-sharded coordinator + K workers over real
+    wire sockets (ISSUE 20) and runs the shard fault storm on top of
+    the phased failpoint schedule."""
     from lighthouse_tpu.beacon.beacon_processor import BeaconProcessor
     from lighthouse_tpu.beacon.chain import BeaconChain
     from lighthouse_tpu.crypto.backend import SignatureVerifier
@@ -164,14 +207,35 @@ def run_soak(args, schedule_text, *, with_racer=True, warmup=True):
     if warmup:
         _warmup(args, spec, state, pubkey_pool, sig_pool)
 
-    def remote_backend(sets, priority, deadline_s):
-        return [True] * len(sets), 0.0
+    fleet = incidents = None
+    fleet_events = {}
+    if fleet_k:
+        import tempfile
 
-    pool = RemoteVerifierPool(
-        ["soak-remote"],
-        InProcessTransport({"soak-remote": remote_backend}),
-        audit_rate=0.0,
-    )
+        from lighthouse_tpu.fleet.incident import IncidentManager
+
+        # long cooldown: the whole storm (liar catch + kill) must
+        # coalesce into exactly ONE incident bundle however slow the
+        # host is — the behavior the fleet_one_incident gate pins
+        incidents = IncidentManager(
+            directory=tempfile.mkdtemp(prefix="ltpu-soak-shard-"),
+            cooldown_s=3600.0,
+        )
+        fleet = soak.FleetHarness(
+            k=fleet_k, incidents=incidents,
+            heartbeat_budget_s=2.0, breaker_threshold=2,
+            breaker_cooldown=0.3,
+        )
+        pool = fleet.coordinator
+    else:
+        def remote_backend(sets, priority, deadline_s):
+            return [True] * len(sets), 0.0
+
+        pool = RemoteVerifierPool(
+            ["soak-remote"],
+            InProcessTransport({"soak-remote": remote_backend}),
+            audit_rate=0.0,
+        )
     service = VerificationService(SignatureVerifier("fake"), remote_pool=pool)
     chain = BeaconChain(state, spec, verifier=service)
     processor = BeaconProcessor(chain)
@@ -247,6 +311,14 @@ def run_soak(args, schedule_text, *, with_racer=True, warmup=True):
     for e in range(args.epochs):
         if schedule is not None:
             schedule.enter(e)
+        if fleet is not None:
+            # heartbeats land first (live workers stay fresh), then the
+            # scripted storm, then one supervision pass — the kill's
+            # quarantine itself comes from the rpc breaker tripping on
+            # this epoch's live dispatches
+            fleet.beat_all()
+            _fleet_storm(fleet, incidents, fleet_events, e)
+            fleet.coordinator.supervise()
         abs_epoch = args.anchor_epoch + e
         epoch_start = abs_epoch * spe
         e_lost_before = dict(by_kind)
@@ -366,8 +438,37 @@ def run_soak(args, schedule_text, *, with_racer=True, warmup=True):
     tier = chain.op_pool.aggregation.stats()
     service.stop()
 
+    fleet_out = None
+    if fleet is not None:
+        snap = fleet.coordinator.snapshot()
+        shard_bundles = [
+            b for b in incidents.list()
+            if b["cause"] == "shard_quarantine"
+        ]
+        fleet_out = {
+            "k": fleet_k,
+            "generation": snap["generation"],
+            "lost_verdicts": snap["lost_verdicts"],
+            "jobs_remote": snap["jobs_remote"],
+            "jobs_local": snap["jobs_local"],
+            "audits": snap["audits"],
+            "audit_catches": snap["audit_catches"],
+            "redispatches": snap["redispatches"],
+            "rehomes": len(snap["rehomes"]),
+            "rehome_latencies_s": [
+                r["latency_s"] for r in snap["rehomes"]
+            ],
+            "last_rehome_latency_s": snap["last_rehome_latency_s"],
+            "stale_digest_refusals":
+                fleet.coordinator.telemetry.refused_digests,
+            "shard_incident_bundles": len(shard_bundles),
+            "events": fleet_events,
+        }
+        fleet.stop()
+
     lost = sum(total_enqueued.values()) - sum(total_resolved.values())
     return {
+        "fleet": fleet_out,
         "epochs": epochs_out,
         "soak_seconds": round(soak_seconds, 2),
         "build_seconds": round(build_seconds, 2),
@@ -394,7 +495,11 @@ def run_soak(args, schedule_text, *, with_racer=True, warmup=True):
 
 
 def run(args):
-    fault = run_soak(args, args.schedule, with_racer=True)
+    fleet_k = getattr(args, "fleet", 0)
+    fault = run_soak(args, args.schedule, with_racer=True,
+                     fleet_k=fleet_k)
+    # the control replay is ALWAYS single-process: fleet mode's root
+    # comparison is sharded-fleet vs single-process, byte-for-byte
     control = run_soak(args, None, with_racer=False, warmup=False)
 
     rss_by_epoch = [e["rss_bytes"] for e in fault["epochs"]]
@@ -419,7 +524,18 @@ def run(args):
             fault["head_state_root"] == control["head_state_root"]
         ),
     }
+    if fault["fleet"] is not None:
+        fl = fault["fleet"]
+        gates["fleet_zero_lost"] = fl["lost_verdicts"] == 0
+        # the whole storm (liar catch + worker kill) must surface as
+        # exactly ONE cooldown-coalesced incident bundle
+        gates["fleet_one_incident"] = fl["shard_incident_bundles"] == 1
+        gates["fleet_stale_refused"] = fl["stale_digest_refusals"] >= 1
+        gates["fleet_rejoined"] = bool(
+            fl["events"].get("rejoin", {}).get("stale_digest_refused")
+        )
     return {
+        "fleet": fault["fleet"],
         "n_validators": args.validators,
         "epochs": args.epochs,
         "backend": "fake",
@@ -473,6 +589,11 @@ def main(argv=None):
     ap.add_argument("--singles-per-committee", type=int, default=1)
     ap.add_argument("--pubkey-pool", type=int, default=64)
     ap.add_argument("--sig-pool", type=int, default=128)
+    ap.add_argument("--fleet", type=int, default=0, metavar="K",
+                    help="fleet mode: shard verification over a "
+                         "coordinator + K workers (real wire sockets) "
+                         "and run the shard fault storm — one lying "
+                         "worker, one SIGKILL + restart + re-join")
     ap.add_argument("--json", default=None)
     args = ap.parse_args(argv)
 
